@@ -1,0 +1,43 @@
+"""sparse_tpu: a TPU-native distributed sparse linear algebra framework.
+
+A drop-in ``scipy.sparse``-style library with the capabilities of
+nv-legate/legate.sparse, built on JAX/XLA/Pallas. See SURVEY.md at the repo
+root for the reference layer map this package mirrors:
+
+  L1 task library      -> sparse_tpu.ops + sparse_tpu.kernels (Pallas)
+  L2 runtime glue      -> sparse_tpu.config / sparse_tpu.parallel.mesh
+  L3 partitioning      -> sparse_tpu.parallel
+  L4 formats & ops     -> csr/csc/coo/dia + module constructors + io
+  L5 algorithms        -> linalg / integrate / spatial / quantum
+"""
+
+from ._version import __version__  # noqa: F401
+from .base import SparseArray  # noqa: F401
+from .coo import coo_array  # noqa: F401
+from .csc import csc_array  # noqa: F401
+from .csr import csr_array  # noqa: F401
+from .dia import dia_array  # noqa: F401
+from .module import (  # noqa: F401
+    diags,
+    eye,
+    identity,
+    is_sparse_matrix,
+    issparse,
+    isspmatrix,
+    isspmatrix_coo,
+    isspmatrix_csc,
+    isspmatrix_csr,
+    isspmatrix_dia,
+    kron,
+    rand,
+    random,
+    spdiags,
+)
+
+# scipy.sparse.*_matrix aliases (coverage layer parity, coverage.py:226-276)
+csr_matrix = csr_array
+csc_matrix = csc_array
+coo_matrix = coo_array
+dia_matrix = dia_array
+
+from . import io, linalg  # noqa: F401,E402

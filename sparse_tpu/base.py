@@ -1,0 +1,179 @@
+"""Shared sparse-array machinery.
+
+Reference analog: ``sparse/base.py`` — ``CompressedBase`` (nnz->pos scan, sum,
+asformat, zero-preserving ufunc grafting, base.py:28-188) and ``DenseSparseBase``
+(nnz-balanced partitioning, base.py:194-296). On TPU the rect1 pos arrays are
+plain ``indptr`` prefix sums, and "balance()" becomes choosing nnz-balanced
+row-block boundaries for the device mesh (see ``sparse_tpu.parallel``).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from .utils import host_int
+
+
+class SparseArray:
+    """Common surface shared by all formats (scipy.sparse.sparray analog)."""
+
+    ndim = 2
+    # Make numpy defer binary ops (B @ A, B * A, ...) to our reflected methods.
+    __array_ufunc__ = None
+    __array_priority__ = 100.0
+
+    @property
+    def shape(self):
+        return self._shape
+
+    @property
+    def dtype(self):
+        return self._dtype
+
+    @property
+    def nnz(self) -> int:
+        raise NotImplementedError
+
+    def getnnz(self) -> int:
+        return self.nnz
+
+    def count_nonzero(self) -> int:
+        return host_int((self._data_array() != 0).sum())
+
+    def _data_array(self):
+        raise NotImplementedError
+
+    # ---- format dispatch -------------------------------------------------
+    def asformat(self, format: str):
+        """Convert to the named format ('csr', 'csc', 'coo', 'dia', 'dense').
+
+        Reference: base.py:150-170.
+        """
+        if format is None or format == self.format:
+            return self
+        conv = getattr(self, "to" + format, None)
+        if conv is None:
+            raise ValueError(f"Format {format} is unknown.")
+        return conv()
+
+    def todense(self):
+        return self.toarray()
+
+    # ---- generic arithmetic wired through format-specific primitives -----
+    def __neg__(self):
+        return self._with_data(-self._data_array())
+
+    def __abs__(self):
+        return self._with_data(jnp.abs(self._data_array()))
+
+    def conjugate(self):
+        return self._with_data(jnp.conjugate(self._data_array()))
+
+    conj = conjugate
+
+    def power(self, n):
+        return self._with_data(self._data_array() ** n)
+
+    def astype(self, dtype):
+        return self._with_data(self._data_array().astype(dtype))
+
+    def copy(self):
+        return self._with_data(self._data_array())
+
+    # Zero-preserving elementwise functions grafted onto every format
+    # (reference grafts cunumeric ufuncs at base.py:120-148).
+    def sqrt(self):
+        return self._with_data(jnp.sqrt(self._data_array()))
+
+    def rint(self):
+        return self._with_data(jnp.rint(self._data_array()))
+
+    def sign(self):
+        return self._with_data(jnp.sign(self._data_array()))
+
+    def expm1(self):
+        return self._with_data(jnp.expm1(self._data_array()))
+
+    def log1p(self):
+        return self._with_data(jnp.log1p(self._data_array()))
+
+    def sin(self):
+        return self._with_data(jnp.sin(self._data_array()))
+
+    def sinh(self):
+        return self._with_data(jnp.sinh(self._data_array()))
+
+    def tan(self):
+        return self._with_data(jnp.tan(self._data_array()))
+
+    def tanh(self):
+        return self._with_data(jnp.tanh(self._data_array()))
+
+    def arcsin(self):
+        return self._with_data(jnp.arcsin(self._data_array()))
+
+    def arcsinh(self):
+        return self._with_data(jnp.arcsinh(self._data_array()))
+
+    def arctan(self):
+        return self._with_data(jnp.arctan(self._data_array()))
+
+    def arctanh(self):
+        return self._with_data(jnp.arctanh(self._data_array()))
+
+    def deg2rad(self):
+        return self._with_data(jnp.deg2rad(self._data_array()))
+
+    def rad2deg(self):
+        return self._with_data(jnp.rad2deg(self._data_array()))
+
+    def trunc(self):
+        return self._with_data(jnp.trunc(self._data_array()))
+
+    def ceil(self):
+        return self._with_data(jnp.ceil(self._data_array()))
+
+    def floor(self):
+        return self._with_data(jnp.floor(self._data_array()))
+
+    # ---- python numeric protocol -----------------------------------------
+    def __sub__(self, other):
+        return self + (-other)
+
+    def __rsub__(self, other):
+        return (-self) + other
+
+    def __truediv__(self, other):
+        if np.isscalar(other) or getattr(other, "ndim", 1) == 0:
+            return self._with_data(self._data_array() / other)
+        return NotImplemented
+
+    def __rmul__(self, other):
+        return self.__mul__(other)
+
+    def __matmul__(self, other):
+        return self.dot(other)
+
+    def __rmatmul__(self, other):
+        return self._rdot(other)
+
+    def mean(self, axis=None):
+        s = self.sum(axis=axis)
+        m, n = self.shape
+        if axis is None:
+            return s / (m * n)
+        if axis in (0, -2):
+            return s / m
+        return s / n
+
+
+def _resolve_shape(shape, rows, cols):
+    if shape is not None:
+        return (int(shape[0]), int(shape[1]))
+    if rows.shape[0] == 0:
+        return (0, 0)
+    return (
+        host_int(rows.max()) + 1,
+        host_int(cols.max()) + 1,
+    )

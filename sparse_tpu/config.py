@@ -1,0 +1,48 @@
+"""Environment-backed settings for sparse_tpu.
+
+Reference analog: ``sparse/settings.py:23-33`` (PrioritizedSetting flags) and
+``sparse/runtime.py:61-70`` (env overrides + mapper tunables). On TPU there is no
+mapper; device/topology discovery lives in ``sparse_tpu.parallel.mesh``. This module
+holds the small flag system.
+
+Flags (all env-overridable):
+  SPARSE_TPU_PRECISE_WINDOWS  - analog of LEGATE_SPARSE_PRECISE_IMAGES: compute exact
+                                per-shard column windows for the SpMV x-gather instead
+                                of cheap min/max bounds.
+  SPARSE_TPU_SPMV_MODE        - 'auto' | 'segment' | 'ell' | 'pallas': SpMV kernel choice.
+  SPARSE_TPU_FORCE_SERIAL     - force single-shard execution of distributed conversions
+                                (mirrors the force_serial special case in coo.py:242).
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+
+
+def _env_bool(name: str, default: bool) -> bool:
+    v = os.environ.get(name)
+    if v is None:
+        return default
+    return v.lower() not in ("0", "false", "no", "off", "")
+
+
+def _env_str(name: str, default: str) -> str:
+    return os.environ.get(name, default)
+
+
+@dataclass
+class Settings:
+    precise_windows: bool = field(
+        default_factory=lambda: _env_bool("SPARSE_TPU_PRECISE_WINDOWS", False)
+    )
+    spmv_mode: str = field(default_factory=lambda: _env_str("SPARSE_TPU_SPMV_MODE", "auto"))
+    force_serial: bool = field(
+        default_factory=lambda: _env_bool("SPARSE_TPU_FORCE_SERIAL", False)
+    )
+    # Max nnz/row (relative to mean) at which the padded-row (ELL) SpMV fast path kicks
+    # in when spmv_mode == 'auto'.
+    ell_max_ratio: float = 4.0
+
+
+settings = Settings()

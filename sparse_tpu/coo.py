@@ -1,0 +1,155 @@
+"""COO sparse array.
+
+Reference analog: ``sparse/coo.py`` (class at coo.py:72; distributed sort-based
+tocsr/tocsc at coo.py:233-349 using SORT_BY_KEY + NCCL/CPU communicators). On TPU
+the conversion is one fused device sort (``ops.coords.sort_coo``); the sharded
+samplesort over a mesh lives in ``sparse_tpu.parallel.sort``.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .base import SparseArray, _resolve_shape
+from .ops import conv
+from .types import index_dtype_for
+from .utils import asjnp, common_dtype
+
+
+@jax.tree_util.register_pytree_node_class
+class coo_array(SparseArray):
+    format = "coo"
+
+    def __init__(self, arg, shape=None, dtype=None, copy=False):
+        if isinstance(arg, coo_array):
+            row, col, data, shape = arg.row, arg.col, arg.data, arg.shape
+        elif isinstance(arg, SparseArray):
+            c = arg.tocoo()
+            row, col, data, shape = c.row, c.col, c.data, c.shape
+        elif isinstance(arg, tuple) and len(arg) == 2 and isinstance(arg[1], tuple):
+            data, (row, col) = arg
+            data, row, col = asjnp(data), asjnp(row), asjnp(col)
+            shape = _resolve_shape(shape, row, col)
+        elif isinstance(arg, tuple) and len(arg) == 2 and all(
+            isinstance(s, (int, np.integer)) for s in arg
+        ):
+            shape = (int(arg[0]), int(arg[1]))
+            row = col = jnp.zeros((0,), dtype=np.int32)
+            data = jnp.zeros((0,), dtype=dtype or np.float32)
+        elif hasattr(arg, "tocoo"):  # scipy sparse
+            c = arg.tocoo()
+            row, col, data = asjnp(c.row), asjnp(c.col), asjnp(c.data)
+            shape = c.shape
+        else:  # dense
+            d = asjnp(arg)
+            if d.ndim != 2:
+                raise ValueError("COO arrays must be 2-D")
+            indptr, cols, vals, _ = conv.dense_to_csr(d)
+            from .ops.coords import expand_rows
+
+            row = expand_rows(indptr, vals.shape[0])
+            col, data, shape = cols, vals, d.shape
+        if dtype is not None:
+            data = data.astype(dtype)
+        idt = index_dtype_for(shape, data.shape[0])
+        self.row = asjnp(row, idt)
+        self.col = asjnp(col, idt)
+        self.data = asjnp(data)
+        self._shape = (int(shape[0]), int(shape[1]))
+        self._dtype = np.dtype(self.data.dtype)
+
+    # -- pytree protocol ---------------------------------------------------
+    def tree_flatten(self):
+        return (self.data, self.row, self.col), self._shape
+
+    @classmethod
+    def tree_unflatten(cls, shape, children):
+        data, row, col = children
+        obj = object.__new__(cls)
+        obj.data, obj.row, obj.col = data, row, col
+        obj._shape = shape
+        obj._dtype = np.dtype(data.dtype)
+        return obj
+
+    # ----------------------------------------------------------------------
+    @property
+    def nnz(self) -> int:
+        return int(self.data.shape[0])
+
+    def _data_array(self):
+        return self.data
+
+    def _with_data(self, data):
+        return coo_array((data, (self.row, self.col)), shape=self.shape)
+
+    def tocoo(self):
+        return self
+
+    def tocsr(self):
+        from .csr import csr_array
+
+        indptr, indices, data = conv.coo_to_csr(
+            self.row, self.col, self.data, self.shape
+        )
+        return csr_array.from_parts(data, indices, indptr, self.shape)
+
+    def tocsc(self):
+        from .csc import csc_array
+
+        indptr, indices, data = conv.coo_to_csc(
+            self.row, self.col, self.data, self.shape
+        )
+        return csc_array.from_parts(data, indices, indptr, self.shape)
+
+    def todia(self):
+        return self.tocsc().todia()
+
+    def toarray(self):
+        return conv.coo_to_dense(self.row, self.col, self.data, self.shape)
+
+    def transpose(self, axes=None):
+        if axes is not None:
+            raise ValueError("transpose with axes != None is unsupported")
+        return coo_array(
+            (self.data, (self.col, self.row)),
+            shape=(self.shape[1], self.shape[0]),
+        )
+
+    @property
+    def T(self):
+        return self.transpose()
+
+    def dot(self, other):
+        return self.tocsr().dot(other)
+
+    def _rdot(self, other):
+        return self.tocsr()._rdot(other)
+
+    def __add__(self, other):
+        return self.tocsr() + other
+
+    def __mul__(self, other):
+        if np.isscalar(other) or getattr(other, "ndim", 1) == 0:
+            return self._with_data(self.data * other)
+        return self.tocsr() * other
+
+    def multiply(self, other):
+        return self.tocsr().multiply(other)
+
+    def sum(self, axis=None):
+        if axis is None:
+            return self.data.sum()
+        return self.tocsr().sum(axis=axis)
+
+    def diagonal(self, k=0):
+        return self.tocsr().diagonal(k=k)
+
+    def __str__(self):
+        return (
+            f"<{self.shape[0]}x{self.shape[1]} COO array, nnz={self.nnz},"
+            f" dtype={self.dtype}>"
+        )
+
+    __repr__ = __str__

@@ -1,0 +1,225 @@
+"""CSC sparse array.
+
+Reference analog: ``sparse/csc.py`` (682 LoC; class at csc.py:78, col-split SpMV
+csc.py:523, SpMM csc.py:630, SDDMM csc.py:556, dot csc.py:368). Shares all
+machinery with CSR through transposition: a CSC matrix is the CSR encoding of
+its transpose, so most ops route through zero-copy reinterpretation.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .base import SparseArray
+from .ops import conv, sddmm as sddmm_ops, spmv as spmv_ops
+from .utils import asjnp, host_int
+
+
+@jax.tree_util.register_pytree_node_class
+class csc_array(SparseArray):
+    format = "csc"
+
+    def __init__(self, arg, shape=None, dtype=None, copy=False):
+        from .coo import coo_array
+
+        if isinstance(arg, csc_array):
+            data, indices, indptr, shape = arg.data, arg.indices, arg.indptr, arg.shape
+        elif isinstance(arg, SparseArray):
+            c = arg.tocsc()
+            data, indices, indptr, shape = c.data, c.indices, c.indptr, c.shape
+        elif isinstance(arg, tuple) and len(arg) == 3:
+            data, indices, indptr = (asjnp(a) for a in arg)
+            if shape is None:
+                nrows = host_int(indices.max()) + 1 if indices.shape[0] else 0
+                shape = (nrows, indptr.shape[0] - 1)
+        elif isinstance(arg, tuple) and len(arg) == 2 and isinstance(arg[1], tuple):
+            c = coo_array(arg, shape=shape).tocsc()
+            data, indices, indptr, shape = c.data, c.indices, c.indptr, c.shape
+        elif isinstance(arg, tuple) and len(arg) == 2:
+            shape = (int(arg[0]), int(arg[1]))
+            indptr = jnp.zeros((shape[1] + 1,), dtype=np.int32)
+            indices = jnp.zeros((0,), dtype=np.int32)
+            data = jnp.zeros((0,), dtype=dtype or np.float32)
+        elif hasattr(arg, "tocsc"):  # scipy
+            s = arg.tocsc()
+            data, indices, indptr = asjnp(s.data), asjnp(s.indices), asjnp(s.indptr)
+            shape = s.shape
+        else:  # dense
+            d = asjnp(arg)
+            if d.ndim != 2:
+                raise ValueError("CSC arrays must be 2-D")
+            indptr, indices, data, _ = conv.dense_to_csc(d)
+            shape = d.shape
+        if dtype is not None:
+            data = data.astype(dtype)
+        self.data = asjnp(data)
+        self.indices = asjnp(indices)
+        self.indptr = asjnp(indptr)
+        self._shape = (int(shape[0]), int(shape[1]))
+        self._dtype = np.dtype(self.data.dtype)
+
+    @classmethod
+    def from_parts(cls, data, indices, indptr, shape):
+        obj = object.__new__(cls)
+        obj.data = asjnp(data)
+        obj.indices = asjnp(indices)
+        obj.indptr = asjnp(indptr)
+        obj._shape = (int(shape[0]), int(shape[1]))
+        obj._dtype = np.dtype(obj.data.dtype)
+        return obj
+
+    # -- pytree protocol ---------------------------------------------------
+    def tree_flatten(self):
+        return (self.data, self.indices, self.indptr), self._shape
+
+    @classmethod
+    def tree_unflatten(cls, shape, children):
+        data, indices, indptr = children
+        return cls.from_parts(data, indices, indptr, shape)
+
+    # ----------------------------------------------------------------------
+    @property
+    def nnz(self) -> int:
+        return int(self.data.shape[0])
+
+    def _data_array(self):
+        return self.data
+
+    def _with_data(self, data):
+        return csc_array.from_parts(data, self.indices, self.indptr, self.shape)
+
+    # -- products ----------------------------------------------------------
+    def dot(self, other, out=None):
+        """A @ other with A CSC: column-split SpMV/SpMM (csc.py:368,523,630)."""
+        if isinstance(other, SparseArray):
+            return self.tocsr().dot(other)
+        x = asjnp(other)
+        if x.ndim == 1:
+            if x.shape[0] != self.shape[1]:
+                raise ValueError(f"dimension mismatch: {self.shape} @ {x.shape}")
+            y = spmv_ops.csc_spmv(
+                self.indptr, self.indices, self.data, x, self.shape[0]
+            )
+        elif x.ndim == 2:
+            if x.shape[0] != self.shape[1]:
+                raise ValueError(f"dimension mismatch: {self.shape} @ {x.shape}")
+            # C = A @ B with A CSC == (rspmm of B.T through A-as-CSR-of-A.T).T
+            y = spmv_ops.rspmm(
+                self.indptr, self.indices, self.data, x.T, self.shape[0]
+            ).T
+        else:
+            raise ValueError("can only multiply by 1-D or 2-D arrays")
+        if out is not None and out.shape != y.shape:
+            raise ValueError("out has the wrong shape")
+        return y
+
+    def _rdot(self, other):
+        B = asjnp(other)
+        # B @ A where A [m,n] CSC == CSR of A.T [n,m]: (A.T @ B.T).T
+        if B.ndim == 1:
+            return spmv_ops.csr_spmv_segment(
+                self.indptr, self.indices, self.data, B, self.shape[1]
+            )
+        return spmv_ops.csr_spmm_segment(
+            self.indptr, self.indices, self.data, B.T, self.shape[1]
+        ).T
+
+    def matvec(self, x, out=None):
+        return self.dot(x, out=out)
+
+    def sddmm(self, C, D):
+        vals = sddmm_ops.csc_sddmm(
+            self.indptr, self.indices, self.data, asjnp(C), asjnp(D)
+        )
+        return self._with_data(vals)
+
+    # -- elementwise / reductions ------------------------------------------
+    def __add__(self, other):
+        if isinstance(other, SparseArray):
+            return (self.tocsr() + other).tocsc()
+        return self.tocsr() + other  # scalar raises there; dense densifies there
+
+    def __mul__(self, other):
+        if np.isscalar(other) or getattr(other, "ndim", 1) == 0:
+            return self._with_data(self.data * other)
+        return self.tocsr().multiply(other).tocsc()
+
+    def multiply(self, other):
+        return self.__mul__(other)
+
+    def sum(self, axis=None):
+        if axis is None:
+            return self.data.sum()
+        # CSC of A == CSR of A.T: flip the axis and reuse CSR reduction
+        from .ops import elementwise
+
+        flip = {0: 1, -2: 1, 1: 0, -1: 0}[axis]
+        return elementwise.csr_sum(
+            self.indptr, self.indices, self.data,
+            (self.shape[1], self.shape[0]), axis=flip,
+        )
+
+    def diagonal(self, k=0):
+        from .ops import elementwise
+
+        return elementwise.csr_diagonal(
+            self.indptr, self.indices, self.data,
+            (self.shape[1], self.shape[0]), k=-k,
+        )
+
+    # -- conversions -------------------------------------------------------
+    def tocsc(self):
+        return self
+
+    def tocsr(self):
+        from .csr import csr_array
+
+        indptr, indices, data = conv.csr_to_csc(
+            self.indptr, self.indices, self.data, (self.shape[1], self.shape[0])
+        )
+        return csr_array.from_parts(data, indices, indptr, self.shape)
+
+    def tocoo(self):
+        from .coo import coo_array
+        from .ops.coords import expand_rows
+
+        cols = expand_rows(self.indptr, self.nnz)
+        return coo_array(
+            (self.data, (self.indices, cols)), shape=self.shape
+        )
+
+    def todia(self):
+        from .dia import dia_array
+
+        return dia_array(self.tocoo())
+
+    def toarray(self):
+        return conv.csr_to_dense(
+            self.indptr, self.indices, self.data, (self.shape[1], self.shape[0])
+        ).T
+
+    def transpose(self, axes=None):
+        if axes is not None:
+            raise ValueError("transpose with axes != None is unsupported")
+        from .csr import csr_array
+
+        return csr_array.from_parts(
+            self.data, self.indices, self.indptr, (self.shape[1], self.shape[0])
+        )
+
+    @property
+    def T(self):
+        return self.transpose()
+
+    def balance(self, num_shards=None):
+        return self
+
+    def __str__(self):
+        return (
+            f"<{self.shape[0]}x{self.shape[1]} CSC array, nnz={self.nnz},"
+            f" dtype={self.dtype}>"
+        )
+
+    __repr__ = __str__

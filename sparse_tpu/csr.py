@@ -1,0 +1,355 @@
+"""CSR sparse array — the workhorse format.
+
+Reference analog: ``sparse/csr.py`` (1731 LoC; class at csr.py:99, op free
+functions spmv csr.py:863 / add csr.py:972 / mult csr.py:1033 / spmm csr.py:1151 /
+rspmm csr.py:1209 / sddmm csr.py:1244 / spgemm csr.py:1317,1495 / tropical
+csr.py:366). The Legion pos/crd/vals stores become plain ``indptr/indices/data``
+jax.Arrays; partition constraints become either XLA GSPMD shardings or explicit
+``shard_map`` row-blocks (``sparse_tpu.parallel``).
+
+TPU-first detail: construction optionally caches a padded-row (ELL) layout when
+the row-length profile is tight (all reference benchmarks are banded), switching
+SpMV/SpMM from scatter-shaped to gather-shaped kernels.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .base import SparseArray
+from .config import settings
+from .ops import conv, elementwise, sddmm as sddmm_ops, spgemm as spgemm_ops, spmv as spmv_ops
+from .ops.coords import expand_rows
+from .utils import asjnp, host_int, user_warning
+
+
+@jax.tree_util.register_pytree_node_class
+class csr_array(SparseArray):
+    format = "csr"
+
+    def __init__(self, arg, shape=None, dtype=None, copy=False):
+        from .coo import coo_array
+
+        if isinstance(arg, csr_array):
+            data, indices, indptr, shape = arg.data, arg.indices, arg.indptr, arg.shape
+        elif isinstance(arg, SparseArray):
+            c = arg.tocsr()
+            data, indices, indptr, shape = c.data, c.indices, c.indptr, c.shape
+        elif isinstance(arg, tuple) and len(arg) == 3:
+            data, indices, indptr = (asjnp(a) for a in arg)
+            if shape is None:
+                ncols = host_int(indices.max()) + 1 if indices.shape[0] else 0
+                shape = (indptr.shape[0] - 1, ncols)
+        elif isinstance(arg, tuple) and len(arg) == 2 and isinstance(arg[1], tuple):
+            c = coo_array(arg, shape=shape).tocsr()
+            data, indices, indptr, shape = c.data, c.indices, c.indptr, c.shape
+        elif isinstance(arg, tuple) and len(arg) == 2:
+            shape = (int(arg[0]), int(arg[1]))
+            indptr = jnp.zeros((shape[0] + 1,), dtype=np.int32)
+            indices = jnp.zeros((0,), dtype=np.int32)
+            data = jnp.zeros((0,), dtype=dtype or np.float32)
+        elif hasattr(arg, "tocsr") and hasattr(arg, "indptr"):  # scipy csr
+            s = arg.tocsr()
+            data, indices, indptr = asjnp(s.data), asjnp(s.indices), asjnp(s.indptr)
+            shape = s.shape
+        elif hasattr(arg, "tocsr"):  # other scipy formats
+            s = arg.tocsr()
+            data, indices, indptr = asjnp(s.data), asjnp(s.indices), asjnp(s.indptr)
+            shape = s.shape
+        else:  # dense
+            d = asjnp(arg)
+            if d.ndim != 2:
+                raise ValueError("CSR arrays must be 2-D")
+            indptr, indices, data, _ = conv.dense_to_csr(d)
+            shape = d.shape
+        if dtype is not None:
+            data = data.astype(dtype)
+        self.data = asjnp(data)
+        self.indices = asjnp(indices)
+        self.indptr = asjnp(indptr)
+        self._shape = (int(shape[0]), int(shape[1]))
+        self._dtype = np.dtype(self.data.dtype)
+        self._ell = None  # lazy (ell_indices, ell_data) cache
+        self._balanced_splits = None
+
+    @classmethod
+    def from_parts(cls, data, indices, indptr, shape):
+        obj = object.__new__(cls)
+        obj.data = asjnp(data)
+        obj.indices = asjnp(indices)
+        obj.indptr = asjnp(indptr)
+        obj._shape = (int(shape[0]), int(shape[1]))
+        obj._dtype = np.dtype(obj.data.dtype)
+        obj._ell = None
+        obj._balanced_splits = None
+        return obj
+
+    # -- pytree protocol ---------------------------------------------------
+    def tree_flatten(self):
+        return (self.data, self.indices, self.indptr), self._shape
+
+    @classmethod
+    def tree_unflatten(cls, shape, children):
+        data, indices, indptr = children
+        return cls.from_parts(data, indices, indptr, shape)
+
+    # ----------------------------------------------------------------------
+    @property
+    def nnz(self) -> int:
+        return int(self.data.shape[0])
+
+    def _data_array(self):
+        return self.data
+
+    def _with_data(self, data):
+        out = csr_array.from_parts(data, self.indices, self.indptr, self.shape)
+        out._balanced_splits = self._balanced_splits
+        return out
+
+    # -- ELL fast path -----------------------------------------------------
+    def _ell_width(self) -> int:
+        """Max row length; host-synced once and cached."""
+        if not hasattr(self, "_ell_width_cache") or self._ell_width_cache is None:
+            counts = self.indptr[1:] - self.indptr[:-1]
+            self._ell_width_cache = host_int(counts.max()) if self.shape[0] else 0
+        return self._ell_width_cache
+
+    def _maybe_ell(self):
+        """Build/cache the padded-row layout when profitable (settings.spmv_mode)."""
+        mode = settings.spmv_mode
+        if mode == "segment":
+            return None
+        m = self.shape[0]
+        if m == 0 or self.nnz == 0:
+            return None
+        k = self._ell_width()
+        mean = max(self.nnz / m, 1.0)
+        if mode in ("ell", "pallas") or k <= settings.ell_max_ratio * mean:
+            if self._ell is None:
+                self._ell = conv.csr_to_ell(
+                    self.indptr, self.indices, self.data, m, max(k, 1)
+                )
+            return self._ell
+        return None
+
+    # -- products ----------------------------------------------------------
+    def dot(self, other, out=None, spmv_domain_part=False):
+        """A @ other. Vector -> SpMV; dense 2-D -> SpMM; sparse -> SpGEMM.
+
+        ``spmv_domain_part`` mirrors the reference's column-split SpMV flag
+        (csr.py:442); on TPU the contraction-split path lives in the
+        distributed layer, so here it only changes the kernel to the CSC-style
+        scatter variant (useful for testing parity).
+        """
+        from .csc import csc_array
+
+        if isinstance(other, SparseArray):
+            if out is not None:
+                raise ValueError("out= is not supported for spgemm")
+            if self.shape[1] != other.shape[0]:
+                raise ValueError(
+                    f"dimension mismatch: {self.shape} @ {other.shape}"
+                )
+            b = other.tocsr()
+            indptr, indices, data = spgemm_ops.spgemm_csr_csr(
+                self.indptr, self.indices, self.data,
+                b.indptr, b.indices, b.data,
+                self.shape, b.shape,
+            )
+            return csr_array.from_parts(
+                data, indices, indptr, (self.shape[0], b.shape[1])
+            )
+        x = asjnp(other)
+        if x.ndim == 1:
+            if x.shape[0] != self.shape[1]:
+                raise ValueError(
+                    f"dimension mismatch: {self.shape} @ {x.shape}"
+                )
+            y = self._spmv(x)
+        elif x.ndim == 2:
+            if x.shape[0] != self.shape[1]:
+                raise ValueError(
+                    f"dimension mismatch: {self.shape} @ {x.shape}"
+                )
+            y = self._spmm(x)
+        else:
+            raise ValueError("can only multiply by 1-D or 2-D arrays")
+        if out is not None:
+            # The reference writes into a pre-allocated store (csr.py:501-503);
+            # jax arrays are immutable, so out= is advisory — we just check shape.
+            if out.shape != y.shape:
+                raise ValueError("out has the wrong shape")
+        return y
+
+    def _spmv(self, x):
+        ell = self._maybe_ell()
+        if ell is not None:
+            return spmv_ops.csr_spmv_ell(ell[0], ell[1], x)
+        return spmv_ops.csr_spmv_segment(
+            self.indptr, self.indices, self.data, x, self.shape[0]
+        )
+
+    def _spmm(self, B):
+        ell = self._maybe_ell()
+        if ell is not None:
+            return spmv_ops.csr_spmm_ell(ell[0], ell[1], B)
+        return spmv_ops.csr_spmm_segment(
+            self.indptr, self.indices, self.data, B, self.shape[0]
+        )
+
+    def _rdot(self, other):
+        """other @ A for dense other (SPMM_DENSE_CSR, csr.py:1209)."""
+        B = asjnp(other)
+        if B.ndim == 1:
+            return spmv_ops.rspmm(
+                self.indptr, self.indices, self.data, B[None, :], self.shape[1]
+            )[0]
+        return spmv_ops.rspmm(
+            self.indptr, self.indices, self.data, B, self.shape[1]
+        )
+
+    def matvec(self, x, out=None):
+        return self.dot(x, out=out)
+
+    def sddmm(self, C, D):
+        """Structure-preserving sampled dense-dense matmul (csr.py:1244)."""
+        vals = sddmm_ops.csr_sddmm(
+            self.indptr, self.indices, self.data, asjnp(C), asjnp(D)
+        )
+        return self._with_data(vals)
+
+    def tropical_spmv(self, x):
+        """(max, +) semiring SpMV over 3-tuple vectors (csr.py:366).
+
+        Powers AMG MIS aggregation. x is [n, 3]; comparison is lexicographic on
+        (x0 + a, x1, x2)? — see ops.tropical for the exact semiring.
+        """
+        from .ops import tropical
+
+        ell = self._maybe_ell()
+        return tropical.tropical_spmv(
+            self.indptr, self.indices, self.data, asjnp(x), self.shape[0],
+            ell_idx=ell[0] if ell is not None else None,
+        )
+
+    # -- elementwise -------------------------------------------------------
+    def __add__(self, other):
+        if np.isscalar(other):
+            if other == 0:
+                return self.copy()
+            raise NotImplementedError("adding a nonzero scalar densifies")
+        if isinstance(other, SparseArray):
+            b = other.tocsr()
+            indptr, indices, data = elementwise.csr_add_csr(
+                self.indptr, self.indices, self.data,
+                b.indptr, b.indices, b.data, self.shape,
+            )
+            return csr_array.from_parts(data, indices, indptr, self.shape)
+        # dense other -> dense result
+        return self.toarray() + asjnp(other)
+
+    def __radd__(self, other):
+        return self.__add__(other)
+
+    def __mul__(self, other):
+        if np.isscalar(other) or getattr(other, "ndim", 1) == 0:
+            return self._with_data(self.data * other)
+        return self.multiply(other)
+
+    def multiply(self, other):
+        if np.isscalar(other) or getattr(other, "ndim", 1) == 0:
+            return self._with_data(self.data * other)
+        if isinstance(other, SparseArray):
+            b = other.tocsr()
+            indptr, indices, data = elementwise.csr_mult_csr(
+                self.indptr, self.indices, self.data,
+                b.indptr, b.indices, b.data, self.shape,
+            )
+            return csr_array.from_parts(data, indices, indptr, self.shape)
+        d = asjnp(other)
+        d = jnp.broadcast_to(d, self.shape)
+        vals = elementwise.csr_mult_dense(
+            self.indptr, self.indices, self.data, d, self.shape
+        )
+        return self._with_data(vals)
+
+    # -- reductions / extraction -------------------------------------------
+    def sum(self, axis=None):
+        return elementwise.csr_sum(
+            self.indptr, self.indices, self.data, self.shape, axis=axis
+        )
+
+    def diagonal(self, k=0):
+        return elementwise.csr_diagonal(
+            self.indptr, self.indices, self.data, self.shape, k=k
+        )
+
+    # -- conversions -------------------------------------------------------
+    def tocsr(self):
+        return self
+
+    def tocoo(self):
+        from .coo import coo_array
+
+        rows, cols, data = conv.csr_to_coo(
+            self.indptr, self.indices, self.data, self.shape
+        )
+        return coo_array((data, (rows, cols)), shape=self.shape)
+
+    def tocsc(self):
+        from .csc import csc_array
+
+        indptr, indices, data = conv.csr_to_csc(
+            self.indptr, self.indices, self.data, self.shape
+        )
+        return csc_array.from_parts(data, indices, indptr, self.shape)
+
+    def todia(self):
+        return self.tocoo().todia()
+
+    def toarray(self):
+        return conv.csr_to_dense(self.indptr, self.indices, self.data, self.shape)
+
+    def transpose(self, axes=None):
+        """Zero-copy transpose: reinterpret the same buffers as CSC (like scipy)."""
+        if axes is not None:
+            raise ValueError("transpose with axes != None is unsupported")
+        from .csc import csc_array
+
+        return csc_array.from_parts(
+            self.data, self.indices, self.indptr, (self.shape[1], self.shape[0])
+        )
+
+    @property
+    def T(self):
+        return self.transpose()
+
+    # -- distribution ------------------------------------------------------
+    def balance(self, num_shards=None):
+        """Compute nnz-balanced row-block boundaries and cache them.
+
+        Reference: ``DenseSparseBase.balance`` (base.py:198-282) — preimage of an
+        equal nnz split back to rows. On TPU: one host-side searchsorted over
+        indptr; the splits are consumed by ``sparse_tpu.parallel`` when sharding.
+        """
+        from .parallel.partition import balanced_row_splits
+
+        if num_shards is None:
+            num_shards = len(jax.devices())
+        self._balanced_splits = balanced_row_splits(self.indptr, num_shards)
+        return self
+
+    def __str__(self):
+        return (
+            f"<{self.shape[0]}x{self.shape[1]} CSR array, nnz={self.nnz},"
+            f" dtype={self.dtype}>"
+        )
+
+    __repr__ = __str__
+
+
+def spmv(A: csr_array, x, y=None):
+    """Free-function SpMV, mirroring the reference's ``spmv`` (csr.py:863)."""
+    return A.dot(x, out=y)

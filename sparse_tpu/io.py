@@ -1,0 +1,116 @@
+"""MatrixMarket IO.
+
+Reference analog: ``sparse/io.py:24-63`` (mmread via the single-task C++ parser
+READ_MTX_TO_COO, ``src/sparse/io/mtx_to_coo.cc:44-145``, with symmetry expansion
+and unbound outputs + scalar futures for m/n/nnz). Here: a vectorized
+numpy-based parser on the host (file IO is host work either way), producing a
+device-resident ``coo_array``. A native (C) accelerated reader is planned in
+``src/`` for large files. Also adds ``mmwrite`` (the reference is read-only).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .coo import coo_array
+from .utils import asjnp
+
+
+def _parse_header(line: str):
+    parts = line.strip().split()
+    if len(parts) != 5 or parts[0] != "%%MatrixMarket" or parts[1] != "matrix":
+        raise ValueError(f"invalid MatrixMarket header: {line!r}")
+    fmt, field, symmetry = parts[2], parts[3], parts[4]
+    if fmt not in ("coordinate", "array"):
+        raise ValueError(f"unsupported MatrixMarket format {fmt}")
+    if field not in ("real", "double", "integer", "complex", "pattern"):
+        raise ValueError(f"unsupported MatrixMarket field {field}")
+    if symmetry not in ("general", "symmetric", "skew-symmetric", "hermitian"):
+        raise ValueError(f"unsupported MatrixMarket symmetry {symmetry}")
+    return fmt, field, symmetry
+
+
+def mmread(path) -> coo_array:
+    """Read a MatrixMarket file into a COO array (reference io.py:24)."""
+    with open(path, "r") as f:
+        header = f.readline()
+        fmt, field, symmetry = _parse_header(header)
+        # skip comments
+        line = f.readline()
+        while line.startswith("%"):
+            line = f.readline()
+        dims = line.split()
+        if fmt == "coordinate":
+            m, n, nnz = int(dims[0]), int(dims[1]), int(dims[2])
+            body = np.loadtxt(f, ndmin=2) if nnz else np.zeros((0, 3))
+            if body.shape[0] != nnz:
+                raise ValueError(
+                    f"expected {nnz} entries, found {body.shape[0]}"
+                )
+            rows = body[:, 0].astype(np.int64) - 1
+            cols = body[:, 1].astype(np.int64) - 1
+            if field == "pattern":
+                vals = np.ones((nnz,), dtype=np.float64)
+            elif field == "complex":
+                vals = body[:, 2] + 1j * body[:, 3]
+            elif field == "integer":
+                vals = body[:, 2]
+            else:
+                vals = body[:, 2]
+        else:  # dense "array" format, column-major
+            m, n = int(dims[0]), int(dims[1])
+            body = np.loadtxt(f, ndmin=2)
+            if field == "complex":
+                flat = body[:, 0] + 1j * body[:, 1]
+            else:
+                flat = body[:, 0] if body.ndim == 2 else body
+            if symmetry == "general":
+                dense = flat.reshape((n, m)).T
+            else:
+                # symmetric array files store the lower triangle column-major:
+                # column j contributes rows j..m-1, in order
+                dense = np.zeros((m, n), dtype=flat.dtype)
+                c = np.repeat(np.arange(n), m - np.arange(n))
+                r = np.concatenate([np.arange(j, m) for j in range(n)])
+                dense[r, c] = flat
+            mask = dense != 0
+            rows, cols = np.nonzero(mask)
+            vals = dense[rows, cols]
+            nnz = rows.shape[0]
+        if symmetry != "general":
+            off = rows != cols
+            r2, c2 = cols[off], rows[off]
+            if symmetry == "skew-symmetric":
+                v2 = -vals[off]
+            elif symmetry == "hermitian":
+                v2 = np.conjugate(vals[off])
+            else:
+                v2 = vals[off]
+            rows = np.concatenate([rows, r2])
+            cols = np.concatenate([cols, c2])
+            vals = np.concatenate([vals, v2])
+    return coo_array((asjnp(vals), (rows, cols)), shape=(m, n))
+
+
+def mmwrite(path, A, comment: str = "", precision: int = 16) -> None:
+    """Write a sparse array as a MatrixMarket coordinate file."""
+    c = A.tocoo() if hasattr(A, "tocoo") else coo_array(A)
+    rows = np.asarray(c.row) + 1
+    cols = np.asarray(c.col) + 1
+    vals = np.asarray(c.data)
+    complex_ = np.iscomplexobj(vals)
+    field = "complex" if complex_ else "real"
+    with open(path, "w") as f:
+        f.write(f"%%MatrixMarket matrix coordinate {field} general\n")
+        if comment:
+            for ln in comment.splitlines():
+                f.write(f"%{ln}\n")
+        f.write(f"{c.shape[0]} {c.shape[1]} {c.nnz}\n")
+        if complex_:
+            for r, cc, v in zip(rows, cols, vals):
+                f.write(
+                    f"{r} {cc} {v.real:.{precision}g} {v.imag:.{precision}g}\n"
+                )
+        else:
+            for r, cc, v in zip(rows, cols, vals):
+                f.write(f"{r} {cc} {v:.{precision}g}\n")

@@ -1,0 +1,5 @@
+"""Pallas TPU kernels for the hot ops (cuSPARSE-variant analogs).
+
+Populated incrementally; every kernel has a pure-XLA fallback in
+``sparse_tpu.ops`` that serves as its test oracle.
+"""

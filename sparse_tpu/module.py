@@ -1,0 +1,189 @@
+"""Top-level constructors: spdiags/diags/eye/identity/kron/random/rand + predicates.
+
+Reference analog: ``sparse/module.py:59-510``.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from .base import SparseArray
+from .coo import coo_array
+from .csc import csc_array
+from .csr import csr_array
+from .dia import dia_array
+from .utils import asjnp
+
+
+def _as_format(A, format):
+    if format is None:
+        return A
+    return A.asformat(format)
+
+
+def diags(diagonals, offsets=0, shape=None, format=None, dtype=None):
+    """scipy.sparse.diags-compatible constructor (reference module.py:96)."""
+    if np.isscalar(offsets):
+        offsets = [offsets]
+        if np.isscalar(diagonals) or (
+            hasattr(diagonals, "ndim") and getattr(diagonals, "ndim", 1) == 1
+        ) or (
+            isinstance(diagonals, (list, tuple))
+            and diagonals
+            and np.isscalar(diagonals[0])
+        ):
+            diagonals = [np.asarray(diagonals)]
+    diagonals = [np.atleast_1d(np.asarray(d)) for d in diagonals]
+    offsets = np.atleast_1d(np.asarray(offsets, dtype=np.int64))
+    if len(diagonals) != len(offsets):
+        raise ValueError("number of diagonals does not match number of offsets")
+    if shape is None:
+        m = max(len(d) + abs(int(o)) for d, o in zip(diagonals, offsets))
+        shape = (m, m)
+    m, n = int(shape[0]), int(shape[1])
+    if dtype is None:
+        dtype = np.result_type(*[d.dtype for d in diagonals])
+    L = n
+    data = np.zeros((len(offsets), L), dtype=dtype)
+    for k, (d, off) in enumerate(zip(diagonals, offsets)):
+        off = int(off)
+        length = min(m + min(off, 0), n - max(off, 0))
+        if length < 0:
+            raise ValueError(f"offset {off} out of bounds for shape {shape}")
+        lo = max(off, 0)
+        if d.size == 1 and length > 1:
+            d = np.full((length,), d[0])
+        if d.size < length:
+            raise ValueError(
+                f"diagonal {k} has wrong length {d.size}, needs {length}"
+            )
+        data[k, lo : lo + length] = d[:length]
+    A = dia_array((asjnp(data), offsets), shape=(m, n))
+    return _as_format(A, format)
+
+
+def spdiags(data, diags_offsets, m=None, n=None, format=None):
+    """scipy.sparse.spdiags-compatible (reference module.py:59)."""
+    if m is None and n is None:
+        raise ValueError("spdiags requires m, n")
+    if n is None:
+        m, n = m
+    A = dia_array((asjnp(np.atleast_2d(np.asarray(data))),
+                   np.atleast_1d(np.asarray(diags_offsets, dtype=np.int64))),
+                  shape=(int(m), int(n)))
+    return _as_format(A, format)
+
+
+def eye(m, n=None, k=0, dtype=np.float64, format="csr"):
+    """Identity-like matrix (reference module.py:221)."""
+    if n is None:
+        n = m
+    m, n = int(m), int(n)
+    length = min(m + min(k, 0), n - max(k, 0))
+    if length <= 0:
+        A = csr_array((m, n), dtype=dtype)
+        return _as_format(A, format)
+    d = np.ones((length,), dtype=dtype)
+    return diags([d], [k], shape=(m, n), format=format, dtype=dtype)
+
+
+def identity(n, dtype=np.float64, format=None):
+    return eye(n, dtype=dtype, format=format or "csr")
+
+
+def kron(A, B, format=None):
+    """Kronecker product of sparse matrices (reference module.py:253).
+
+    COO outer-product expansion: nnz(A) x nnz(B) triples in one vectorized
+    broadcast — no loops, one fused sort in the CSR conversion.
+    """
+    A = coo_array(A) if not isinstance(A, SparseArray) else A.tocoo()
+    B = coo_array(B) if not isinstance(B, SparseArray) else B.tocoo()
+    ma, na = A.shape
+    mb, nb = B.shape
+    out_shape = (ma * mb, na * nb)
+    if A.nnz == 0 or B.nnz == 0:
+        return _as_format(csr_array(out_shape), format)
+    rows = (A.row.astype(jnp.int64)[:, None] * mb + B.row.astype(jnp.int64)[None, :]).ravel()
+    cols = (A.col.astype(jnp.int64)[:, None] * nb + B.col.astype(jnp.int64)[None, :]).ravel()
+    vals = (A.data[:, None] * B.data[None, :]).ravel()
+    out = coo_array((vals, (rows, cols)), shape=out_shape)
+    if format in (None, "coo"):
+        return out
+    return out.asformat(format)
+
+
+def random(
+    m,
+    n,
+    density=0.01,
+    format="coo",
+    dtype=None,
+    random_state=None,
+    data_rvs=None,
+):
+    """Sparse random matrix (reference module.py:360)."""
+    m, n = int(m), int(n)
+    if density < 0 or density > 1:
+        raise ValueError("density expected in [0, 1]")
+    mn = m * n
+    k = int(round(density * mn))
+    if random_state is None:
+        rng = np.random.default_rng()
+    elif isinstance(random_state, (int, np.integer)):
+        rng = np.random.default_rng(int(random_state))
+    else:
+        rng = random_state
+    if mn > 0 and k > 0:
+        if mn < (1 << 26):
+            flat = rng.choice(mn, size=k, replace=False)
+        else:  # sample-and-dedup for huge index spaces
+            cand = rng.integers(0, mn, size=int(k * 1.2) + 16)
+            flat = np.unique(cand)[:k]
+            k = flat.shape[0]
+    else:
+        flat = np.zeros((0,), dtype=np.int64)
+        k = 0
+    rows = (flat // n).astype(np.int64)
+    cols = (flat % n).astype(np.int64)
+    if data_rvs is not None:
+        vals = np.asarray(data_rvs(k))
+    else:
+        vals = rng.random(k)
+    if dtype is not None:
+        vals = vals.astype(dtype)
+    out = coo_array((asjnp(vals), (rows, cols)), shape=(m, n))
+    return _as_format(out, format)
+
+
+def rand(m, n, density=0.01, format="coo", dtype=None, random_state=None):
+    return random(m, n, density, format, dtype, random_state)
+
+
+def issparse(o) -> bool:
+    return isinstance(o, SparseArray)
+
+
+def is_sparse_matrix(o) -> bool:
+    return isinstance(o, SparseArray)
+
+
+def isspmatrix(o) -> bool:
+    return isinstance(o, SparseArray)
+
+
+def isspmatrix_csr(o) -> bool:
+    return isinstance(o, csr_array)
+
+
+def isspmatrix_csc(o) -> bool:
+    return isinstance(o, csc_array)
+
+
+def isspmatrix_coo(o) -> bool:
+    return isinstance(o, coo_array)
+
+
+def isspmatrix_dia(o) -> bool:
+    return isinstance(o, dia_array)

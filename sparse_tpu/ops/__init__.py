@@ -1,0 +1,13 @@
+"""Single-device compute kernels (the L1 task-library analog, SURVEY §2b).
+
+Each reference Legion task family maps to a module here:
+  spmv.py        - CSR/CSC SpMV, SpMM, rSpMM
+  spgemm.py      - SpGEMM (ESC formulation)
+  sddmm.py       - sampled dense-dense matmul
+  elementwise.py - add / multiply / diagonal / sum
+  conv.py        - format conversions (2-pass count+fill)
+  coords.py      - coordinate plumbing (pos<->rows, sort, dedup)
+  tropical.py    - (max, +) lexicographic semiring SpMV
+"""
+
+from . import conv, coords, elementwise, sddmm, spgemm, spmv, tropical  # noqa: F401

@@ -1,0 +1,93 @@
+"""Elementwise CSR ops: add (union), multiply (intersection), dense multiply,
+diagonal extraction.
+
+Reference analog: ADD_CSR_CSR{_NNZ,} (``src/sparse/array/csr/add.*``, 2-pass
+row-merge union), ELEM_MULT_CSR_CSR{_NNZ,} / ELEM_MULT_CSR_DENSE
+(``csr/mult.*``), CSR_DIAGONAL (``csr/get_diagonal.*``) — SURVEY §2b. The
+2-pass count+fill becomes: device-side sort/search, one host sync for the
+result nnz, fixed-shape fill.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..types import index_dtype_for
+from .coords import dedup_sorted, expand_rows, linearize, rows_to_indptr
+
+
+def csr_add_csr(indptr_a, indices_a, data_a, indptr_b, indices_b, data_b, shape):
+    """Union add: concatenate COO triples, fused sort, collapse duplicates."""
+    m = int(shape[0])
+    rows_a = expand_rows(indptr_a, data_a.shape[0])
+    rows_b = expand_rows(indptr_b, data_b.shape[0])
+    rows = jnp.concatenate([rows_a.astype(jnp.int32), rows_b.astype(jnp.int32)])
+    cols = jnp.concatenate([indices_a.astype(jnp.int32), indices_b.astype(jnp.int32)])
+    dt = jnp.result_type(data_a.dtype, data_b.dtype)
+    vals = jnp.concatenate([data_a.astype(dt), data_b.astype(dt)])
+    keys = linearize(rows, cols, shape)
+    order = jnp.argsort(keys, stable=True)
+    urows, ucols, uvals, nunique = dedup_sorted(keys[order], vals[order], shape)
+    idt = index_dtype_for(shape, nunique)
+    indptr = rows_to_indptr(urows, m, dtype=idt)
+    return indptr, ucols.astype(idt), uvals
+
+
+def csr_mult_csr(indptr_a, indices_a, data_a, indptr_b, indices_b, data_b, shape):
+    """Intersection multiply: binary-search A's keys in B's sorted keys."""
+    from ..utils import host_int
+
+    m = int(shape[0])
+    rows_a = expand_rows(indptr_a, data_a.shape[0])
+    rows_b = expand_rows(indptr_b, data_b.shape[0])
+    keys_a = linearize(rows_a, indices_a, shape)
+    keys_b = linearize(rows_b, indices_b, shape)
+    idx = jnp.searchsorted(keys_b, keys_a)
+    idx_c = jnp.clip(idx, 0, max(keys_b.shape[0] - 1, 0))
+    match = (keys_b[idx_c] == keys_a) if keys_b.shape[0] else jnp.zeros_like(keys_a, dtype=bool)
+    n_match = host_int(match.sum())
+    take = jnp.nonzero(match, size=n_match)[0]
+    dt = jnp.result_type(data_a.dtype, data_b.dtype)
+    vals = data_a[take].astype(dt) * data_b[idx_c[take]].astype(dt)
+    idt = index_dtype_for(shape, n_match)
+    indptr = rows_to_indptr(rows_a[take], m, dtype=idt)
+    return indptr, indices_a[take].astype(idt), vals
+
+
+def csr_mult_dense(indptr, indices, data, dense, shape):
+    """Structure-preserving multiply by a dense matrix (ELEM_MULT_CSR_DENSE)."""
+    rows = expand_rows(indptr, data.shape[0])
+    return data * dense[rows, indices]
+
+
+def csr_diagonal(indptr, indices, data, shape, k: int = 0):
+    """Extract the k-th diagonal. Reference task supports k=0 only
+    (csr.py:636-639); we support any k by shifting the column target."""
+    m, n = shape
+    out_len = min(m + min(k, 0), n - max(k, 0))
+    if out_len <= 0:
+        return jnp.zeros((0,), dtype=data.dtype)
+    nnz = data.shape[0]
+    if nnz == 0:
+        return jnp.zeros((out_len,), dtype=data.dtype)
+    rows = expand_rows(indptr, nnz)
+    on_diag = indices.astype(rows.dtype) == rows + k
+    # Entry A[i, i+k] lands at diagonal slot i (== row) for k>=0, i+k (== col) for k<0.
+    d_idx = rows if k >= 0 else indices.astype(rows.dtype)
+    contrib = jnp.where(on_diag, data, jnp.zeros((), dtype=data.dtype))
+    return jax.ops.segment_sum(contrib, d_idx, num_segments=max(m, n))[:out_len]
+
+
+def csr_sum(indptr, indices, data, shape, axis=None):
+    m, n = shape
+    if axis is None:
+        return data.sum()
+    if axis in (0, -2):
+        return jax.ops.segment_sum(data, indices, num_segments=n)
+    if axis in (1, -1):
+        rows = expand_rows(indptr, data.shape[0])
+        return jax.ops.segment_sum(
+            data, rows, num_segments=m, indices_are_sorted=True
+        )
+    raise ValueError(f"invalid axis {axis}")

@@ -1,0 +1,35 @@
+"""SDDMM: sampled dense-dense matmul, out_vals = A_vals * (C @ D) at A's sparsity.
+
+Reference analog: CSR_SDDMM / CSC_SDDMM (``src/sparse/array/csr/sddmm.*``,
+``csc/sddmm.*``) — B o (C @ D) fused, structure-preserving. TPU-native: gather
+the needed rows of C and columns of D per nnz and contract — a batched dot that
+XLA tiles onto the MXU for large k.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .coords import expand_rows
+
+
+def csr_sddmm(indptr, indices, data, C, D):
+    """vals_out[e] = data[e] * dot(C[row_e, :], D[:, col_e])."""
+    nnz = data.shape[0]
+    if nnz == 0:
+        return data
+    rows = expand_rows(indptr, nnz)
+    dt = jnp.result_type(data.dtype, C.dtype, D.dtype)
+    inner = jnp.einsum("ek,ek->e", C[rows].astype(dt), D.T[indices].astype(dt))
+    return data.astype(dt) * inner
+
+
+def csc_sddmm(indptr, indices, data, C, D):
+    """CSC variant: compressed axis is columns, indices are rows."""
+    nnz = data.shape[0]
+    if nnz == 0:
+        return data
+    cols = expand_rows(indptr, nnz)
+    dt = jnp.result_type(data.dtype, C.dtype, D.dtype)
+    inner = jnp.einsum("ek,ek->e", C[indices].astype(dt), D.T[cols].astype(dt))
+    return data.astype(dt) * inner

@@ -1,0 +1,75 @@
+"""SpGEMM: sparse @ sparse -> sparse.
+
+Reference analog: SPGEMM_CSR_CSR_CSR{_NNZ,,_GPU} (``src/sparse/array/csr/
+spgemm_csr_csr_csr.*`` — CPU: 2-pass Gustavson; GPU: per-rank cuSPARSE) and the
+3-phase 2-D CSRxCSC algorithm (``spgemm_csr_csr_csc.*``, csr.py:1495-1728).
+
+TPU-native design: Gustavson's row-wise merge is scalar-loop-shaped, so instead
+we use **ESC (expand-sort-compress)** — the standard GPU SpGEMM formulation that
+is pure gather/sort/segment-reduce and maps directly onto XLA's sort machinery:
+
+  1. expand: each A-nnz (i,k,a) pairs with every B-nnz in row k -> COO triples
+     (i, j, a*b); the expansion offsets come from one prefix-sum over B row
+     lengths gathered at A's column ids.
+  2. sort: one fused-key device sort of the expanded triples.
+  3. compress: collapse duplicate (i,j) with a segment-sum.
+
+One host sync for the expansion size, one for the result nnz (the reference
+blocks on the same two quantities via FutureMap scans, csr.py:827-859).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..types import index_dtype_for
+from ..utils import host_int
+from .coords import (
+    counts_to_indptr,
+    dedup_sorted,
+    expand_rows,
+    linearize,
+    rows_to_indptr,
+)
+
+
+def spgemm_csr_csr(
+    indptr_a, indices_a, data_a, indptr_b, indices_b, data_b, shape_a, shape_b
+):
+    """C = A @ B, both CSR. Returns (indptr, indices, data) of C (CSR)."""
+    m = int(shape_a[0])
+    n = int(shape_b[1])
+    out_shape = (m, n)
+    dt = jnp.result_type(data_a.dtype, data_b.dtype)
+    nnz_a = data_a.shape[0]
+    if nnz_a == 0 or data_b.shape[0] == 0:
+        idt = index_dtype_for(out_shape, 0)
+        return (
+            jnp.zeros((m + 1,), dtype=idt),
+            jnp.zeros((0,), dtype=idt),
+            jnp.zeros((0,), dtype=dt),
+        )
+    rows_a = expand_rows(indptr_a, nnz_a)
+    # expansion counts: |B row| at each A column id
+    counts = indptr_b[indices_a + 1] - indptr_b[indices_a]
+    offsets = counts_to_indptr(counts, dtype=jnp.int64)
+    total = host_int(offsets[-1])
+    if total == 0:
+        idt = index_dtype_for(out_shape, 0)
+        return (
+            jnp.zeros((m + 1,), dtype=idt),
+            jnp.zeros((0,), dtype=idt),
+            jnp.zeros((0,), dtype=dt),
+        )
+    t = jnp.arange(total, dtype=jnp.int64)
+    src = jnp.searchsorted(offsets, t, side="right") - 1  # source A-nnz per product
+    p = indptr_b[indices_a[src]].astype(jnp.int64) + (t - offsets[src])
+    out_rows = rows_a[src]
+    out_cols = indices_b[p]
+    out_vals = data_a[src].astype(dt) * data_b[p].astype(dt)
+    keys = linearize(out_rows, out_cols, out_shape)
+    order = jnp.argsort(keys, stable=True)
+    urows, ucols, uvals, nunique = dedup_sorted(keys[order], out_vals[order], out_shape)
+    idt = index_dtype_for(out_shape, nunique)
+    indptr = rows_to_indptr(urows, m, dtype=idt)
+    return indptr, ucols.astype(idt), uvals

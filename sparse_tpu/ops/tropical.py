@@ -1,0 +1,62 @@
+"""Tropical-semiring SpMV: y[i] = lexicographic-max over j in row(i) of x[j].
+
+Reference analog: CSR_SPMV_ROW_SPLIT_TROPICAL_SEMIRING
+(``src/sparse/array/csr/tropical_spmv.cc:25-57``): x is an [n, f] integer tuple
+array, y[i] initializes to the 0-tuple and takes the lexicographically largest
+x[j] among the row's neighbors. Structure-only (A's values unused). Powers the
+AMG MIS aggregation (``examples/amg.py:199-276``).
+
+TPU-native: padded-row gather -> [m, k, f] candidates -> vectorized
+lexicographic tournament reduction over k (log-depth, no scalar loops).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .conv import csr_to_ell
+from ..utils import host_int
+
+
+def _lex_ge(a, b):
+    """[.., f] lexicographic a >= b, vectorized over leading dims."""
+    diff = a - b
+    neq = diff != 0
+    has = neq.any(axis=-1)
+    first = jnp.argmax(neq, axis=-1)
+    d = jnp.take_along_axis(diff, first[..., None], axis=-1)[..., 0]
+    return jnp.where(has, d > 0, True)
+
+
+def _lex_max(a, b):
+    return jnp.where(_lex_ge(a, b)[..., None], a, b)
+
+
+def tropical_spmv(indptr, indices, data, x, m: int, ell_idx=None):
+    """ell_idx: optional prebuilt [m, k] padded-row index plane (csr_array's
+    cached ELL layout) — avoids re-syncing the max row length per call on the
+    AMG aggregation hot path."""
+    if x.ndim != 2:
+        raise ValueError("tropical_spmv expects a 2-D tuple array")
+    f = x.shape[1]
+    nnz = indices.shape[0]
+    if nnz == 0 or m == 0:
+        return jnp.zeros((m, f), dtype=x.dtype)
+    lens = indptr[1:] - indptr[:-1]
+    if ell_idx is None:
+        k = host_int(lens.max())
+        ell_idx, _ = csr_to_ell(indptr, indices, data, m, max(k, 1))
+    k = ell_idx.shape[1]
+    valid = jnp.arange(k, dtype=lens.dtype)[None, :] < lens[:, None]
+    cand = jnp.where(valid[:, :, None], x[ell_idx], jnp.zeros((), dtype=x.dtype))
+    # log-depth pairwise tournament over the k axis
+    while cand.shape[1] > 1:
+        kk = cand.shape[1]
+        half = (kk + 1) // 2
+        pad = half * 2 - kk
+        if pad:
+            cand = jnp.concatenate(
+                [cand, jnp.zeros((m, pad, f), dtype=cand.dtype)], axis=1
+            )
+        cand = _lex_max(cand[:, ::2], cand[:, 1::2])
+    return cand[:, 0, :]

@@ -1,0 +1,8 @@
+"""Distribution layer: device meshes, sharded containers, collectives.
+
+Reference analog: L0/L3 of SURVEY — Legion partitioning + NCCL/coll become
+`jax.sharding.Mesh` + `shard_map` + XLA collectives (psum/all_gather/
+ppermute/all_to_all) over ICI/DCN.
+"""
+
+from .partition import balanced_row_splits, column_windows, equal_row_splits  # noqa: F401

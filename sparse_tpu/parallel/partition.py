@@ -1,0 +1,60 @@
+"""Row-block partitioning policies for the device mesh.
+
+Reference analog: ``sparse/partition.py`` (CompressedImagePartition
+partition.py:56-137, MinMaxImagePartition partition.py:139-214, DensePreimage
+partition.py:216-280) and ``DenseSparseBase.balance`` (base.py:198-282).
+
+On TPU, Legion's dependent partitioning collapses into static host-side
+decisions made once per matrix:
+  * equal row tiles            -> `equal_row_splits`
+  * nnz-balanced row tiles     -> `balanced_row_splits` (the balance() analog)
+  * per-shard column windows   -> `column_windows` (the MinMaxImage analog:
+    what slice of x each shard's SpMV needs)
+The splits feed ``sparse_tpu.parallel.dist`` which materializes padded,
+mesh-sharded arrays.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def equal_row_splits(m: int, num_shards: int) -> np.ndarray:
+    """Row-tile boundaries [0, ..., m], equal rows per shard (the default key
+    partition, csr.py:242-246)."""
+    return np.linspace(0, m, num_shards + 1).astype(np.int64)
+
+
+def balanced_row_splits(indptr, num_shards: int) -> np.ndarray:
+    """nnz-balanced row boundaries: preimage of an equal nnz split (base.py:198).
+
+    One host-side searchsorted over the monotone indptr."""
+    iptr = np.asarray(indptr)
+    m = iptr.shape[0] - 1
+    nnz = int(iptr[-1])
+    targets = np.linspace(0, nnz, num_shards + 1)
+    splits = np.searchsorted(iptr, targets, side="left").astype(np.int64)
+    splits[0], splits[-1] = 0, m
+    return np.maximum.accumulate(splits)
+
+
+def column_windows(indptr, indices, splits) -> np.ndarray:
+    """Per-shard [lo, hi) bounds of the column ids touched by each row block.
+
+    The MinMaxImagePartition analog (partition.py:139-214): what window of x a
+    shard's SpMV must gather. For banded matrices the windows are narrow and
+    overlap only with mesh neighbors -> halo exchange over ICI.
+    """
+    iptr = np.asarray(indptr)
+    idx = np.asarray(indices)
+    S = len(splits) - 1
+    out = np.zeros((S, 2), dtype=np.int64)
+    for s in range(S):
+        lo, hi = int(iptr[splits[s]]), int(iptr[splits[s + 1]])
+        if hi > lo:
+            seg = idx[lo:hi]
+            out[s, 0] = int(seg.min())
+            out[s, 1] = int(seg.max()) + 1
+        else:
+            out[s] = (0, 0)
+    return out

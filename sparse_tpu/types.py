@@ -1,0 +1,26 @@
+"""Canonical dtypes for sparse index/value data.
+
+Reference analog: ``sparse/types.py:18-25`` (coord=int64, nnz=uint64). On TPU we
+default to int32 coordinates (native lane width; int64 requires x64 emulation) and
+promote to int64 only when a dimension or nnz count demands it.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+# Default coordinate (row/col index) dtype. int32 covers dims < 2**31.
+coord_ty = np.int32
+# Dtype used for nnz counters / indptr offsets.
+nnz_ty = np.int32
+# Wide variants, used when shapes/nnz exceed int32 range.
+coord_ty_wide = np.int64
+nnz_ty_wide = np.int64
+
+_INT32_MAX = np.iinfo(np.int32).max
+
+
+def index_dtype_for(shape, nnz: int):
+    """Pick an index dtype large enough for ``shape`` and ``nnz``."""
+    m = max([int(nnz), *[int(s) for s in shape]] or [0])
+    return coord_ty_wide if m > _INT32_MAX else coord_ty

@@ -1,0 +1,76 @@
+"""Shared helpers: dtype promotion, grid factorization, user-level warnings.
+
+Reference analog: ``sparse/utils.py`` (store<->cunumeric bridges at utils.py:41-91
+disappear on TPU — everything is a jax.Array; the dtype-promotion and grid helpers
+at utils.py:120-150 carry over).
+"""
+
+from __future__ import annotations
+
+import math
+import warnings
+
+import jax.numpy as jnp
+import numpy as np
+
+
+def find_last_user_stacklevel() -> int:
+    """Stack level of the first frame outside sparse_tpu, for warnings.warn.
+
+    Reference: ``sparse/utils.py:31-37``.
+    """
+    import inspect
+
+    level = 1
+    for frame, _ in zip(inspect.stack(), range(64)):
+        if "sparse_tpu" not in frame.filename:
+            break
+        level += 1
+    return level
+
+
+def user_warning(msg: str) -> None:
+    warnings.warn(msg, stacklevel=find_last_user_stacklevel())
+
+
+def cast_to_common_type(*arrays):
+    """Promote all arrays to a common dtype (reference: utils.py:120-141)."""
+    dt = np.result_type(*[a.dtype for a in arrays])
+    return tuple(a.astype(dt) for a in arrays)
+
+
+def common_dtype(*arrays_or_dtypes):
+    return np.result_type(
+        *[getattr(a, "dtype", a) for a in arrays_or_dtypes]
+    )
+
+
+def factor_int(n: int) -> tuple[int, int]:
+    """Factor n into a near-square (x, y) grid, x*y == n.
+
+    Reference: ``sparse/utils.py:144-150`` — used for 2-D processor-grid launches
+    (SpGEMM CSRxCSC, cdist, quantum). On TPU this shapes 2-D device meshes.
+    """
+    x = int(math.isqrt(n))
+    while n % x != 0:
+        x -= 1
+    y = n // x
+    return (max(x, y), min(x, y))
+
+
+def asjnp(a, dtype=None):
+    """Convert to a jax array, passing device arrays through untouched."""
+    out = jnp.asarray(a)
+    if dtype is not None and out.dtype != np.dtype(dtype):
+        out = out.astype(dtype)
+    return out
+
+
+def host_int(x) -> int:
+    """Materialize a device scalar on the host (an explicit blocking point).
+
+    Reference analog: reading a Legion future, e.g. ``int.from_bytes`` of the nnz
+    future at ``sparse/io.py:45-47`` / ``sparse/base.py:47-48``. Every dynamic-nnz
+    site goes through here so the control/device sync boundaries stay auditable.
+    """
+    return int(x)

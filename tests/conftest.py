@@ -1,0 +1,25 @@
+"""Test harness configuration.
+
+Mirrors the reference's distributed-testing strategy (SURVEY §4): the same
+correctness tests run under multiple resource shapes. Here: a virtual 8-device
+CPU mesh via --xla_force_host_platform_device_count, with x64 enabled so
+scipy-oracle comparisons are exact-dtype.
+
+Must run before jax initializes a backend, hence the env mutation at import.
+"""
+
+import os
+
+# The harness pre-sets JAX_PLATFORMS (e.g. to the axon TPU tunnel); tests must
+# run on the virtual CPU mesh, so override rather than setdefault.
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)

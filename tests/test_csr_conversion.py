@@ -1,0 +1,81 @@
+"""Format conversion round-trips vs scipy.
+
+Reference analog: ``tests/integration/test_csr_conversion.py`` and test_coo/
+test_csc/test_dia conversion coverage.
+"""
+
+import numpy as np
+import pytest
+import scipy.io as sci_io
+import scipy.sparse as sp
+
+import sparse_tpu as sparse
+from .utils.common import test_mtx_files
+from .utils.sample import sample_csr, sample_dense
+
+
+@pytest.mark.parametrize("filename", test_mtx_files)
+def test_mtx_roundtrip_formats(filename):
+    s = sci_io.mmread(filename)
+    ours = sparse.io.mmread(filename)
+    dense = s.toarray()
+    assert np.allclose(np.asarray(ours.toarray()), dense)
+    assert np.allclose(np.asarray(ours.tocsr().toarray()), dense)
+    assert np.allclose(np.asarray(ours.tocsc().toarray()), dense)
+    assert np.allclose(np.asarray(ours.tocsr().tocoo().toarray()), dense)
+    assert np.allclose(np.asarray(ours.tocsc().tocsr().toarray()), dense)
+    assert np.allclose(np.asarray(ours.tocsr().tocsc().toarray()), dense)
+
+
+def test_dense_roundtrip():
+    d = sample_dense(12, 17, seed=3)
+    d[d < 0.5] = 0.0
+    arr = sparse.csr_array(d)
+    s = sp.csr_matrix(d)
+    assert arr.nnz == s.nnz
+    assert np.allclose(np.asarray(arr.toarray()), d)
+    assert np.allclose(np.asarray(sparse.csc_array(d).toarray()), d)
+    assert np.allclose(np.asarray(sparse.coo_array(d).toarray()), d)
+
+
+def test_coo_duplicates_sum():
+    rows = np.array([0, 0, 1, 2, 0])
+    cols = np.array([1, 1, 2, 0, 1])
+    vals = np.array([1.0, 2.0, 3.0, 4.0, 5.0])
+    ours = sparse.coo_array((vals, (rows, cols)), shape=(3, 3)).tocsr()
+    ref = sp.coo_matrix((vals, (rows, cols)), shape=(3, 3)).tocsr()
+    assert np.allclose(np.asarray(ours.toarray()), ref.toarray())
+    assert ours.nnz == ref.nnz
+
+
+def test_transpose():
+    s = sample_csr(11, 7, seed=5)
+    arr = sparse.csr_array(s)
+    assert np.allclose(np.asarray(arr.T.toarray()), s.T.toarray())
+    assert arr.T.format == "csc"
+    assert np.allclose(np.asarray(arr.T.T.toarray()), s.toarray())
+
+
+def test_dia_conversions():
+    s = sp.diags(
+        [np.full(9, -1.0), np.full(10, 2.0), np.full(9, -1.0)], [-1, 0, 1]
+    )
+    ours = sparse.diags(
+        [np.full(9, -1.0), np.full(10, 2.0), np.full(9, -1.0)], [-1, 0, 1]
+    )
+    assert ours.format == "dia"
+    dense = s.toarray()
+    assert np.allclose(np.asarray(ours.toarray()), dense)
+    assert np.allclose(np.asarray(ours.tocsr().toarray()), dense)
+    assert np.allclose(np.asarray(ours.tocsc().toarray()), dense)
+    assert np.allclose(np.asarray(ours.T.toarray()), dense.T)
+    assert np.allclose(np.asarray(ours.tocsc().T.toarray()), dense.T)
+
+
+def test_empty_matrix():
+    arr = sparse.csr_array((4, 5))
+    assert arr.nnz == 0
+    assert np.allclose(np.asarray(arr.toarray()), np.zeros((4, 5)))
+    assert np.allclose(np.asarray(arr @ np.ones(5)), np.zeros(4))
+    assert np.allclose(np.asarray(arr.tocsc().toarray()), np.zeros((4, 5)))
+    assert np.allclose(np.asarray(arr.tocoo().toarray()), np.zeros((4, 5)))

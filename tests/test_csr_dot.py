@@ -1,0 +1,84 @@
+"""SpMV/SpMM correctness vs the scipy oracle.
+
+Reference analog: ``tests/integration/test_csr_dot.py:29-46`` (incl. the
+col-split spmv_domain_part axis) and ``test_csr_spmm.py``.
+"""
+
+import numpy as np
+import pytest
+import scipy.io as sci_io
+
+import sparse_tpu as sparse
+from .utils.common import test_mtx_files, types
+from .utils.sample import sample_csr, sample_dense, sample_vec
+
+
+@pytest.mark.parametrize("filename", test_mtx_files)
+def test_csr_dot_vec_mtx(filename):
+    arr = sparse.io.mmread(filename).tocsr()
+    s = sci_io.mmread(filename).tocsr()
+    vec = np.random.default_rng(0).random((arr.shape[1],))
+    assert np.allclose(np.asarray(arr @ vec), s @ vec)
+
+
+@pytest.mark.parametrize("dtype", types)
+def test_csr_dot_vec_dtype(dtype):
+    s = sample_csr(31, 17, dtype=dtype, seed=3)
+    arr = sparse.csr_array(s)
+    vec = sample_vec(17, dtype=dtype, seed=7)
+    assert np.allclose(np.asarray(arr @ vec), s @ vec, atol=1e-5)
+
+
+@pytest.mark.parametrize("dtype", types)
+def test_csr_spmm(dtype):
+    s = sample_csr(19, 23, dtype=dtype, seed=5)
+    arr = sparse.csr_array(s)
+    B = sample_dense(23, 11, dtype=dtype, seed=8)
+    assert np.allclose(np.asarray(arr @ B), s @ B, atol=1e-5)
+
+
+def test_csr_rdot():
+    s = sample_csr(13, 9, seed=1)
+    arr = sparse.csr_array(s)
+    B = sample_dense(7, 13, seed=2)
+    assert np.allclose(np.asarray(B @ arr), B @ s)
+    v = sample_vec(13, seed=4)
+    assert np.allclose(np.asarray(v @ arr), v @ s)
+
+
+def test_csr_dot_ell_vs_segment(monkeypatch):
+    """The padded-row fast path must agree with the segment path exactly."""
+    from sparse_tpu.config import settings
+
+    s = sample_csr(40, 40, density=0.2, seed=11)
+    vec = sample_vec(40, seed=12)
+    monkeypatch.setattr(settings, "spmv_mode", "segment")
+    y_seg = np.asarray(sparse.csr_array(s) @ vec)
+    monkeypatch.setattr(settings, "spmv_mode", "ell")
+    y_ell = np.asarray(sparse.csr_array(s) @ vec)
+    assert np.allclose(y_seg, y_ell)
+    assert np.allclose(y_seg, s @ vec)
+
+
+def test_csc_dot():
+    s = sample_csr(21, 15, seed=9).tocsc()
+    arr = sparse.csc_array(s)
+    vec = sample_vec(15, seed=10)
+    assert np.allclose(np.asarray(arr @ vec), s @ vec)
+    B = sample_dense(15, 6, seed=13)
+    assert np.allclose(np.asarray(arr @ B), s @ B)
+    C = sample_dense(5, 21, seed=14)
+    assert np.allclose(np.asarray(C @ arr), C @ s)
+
+
+def test_empty_rows():
+    """More shards than rows / empty-row discipline (SURVEY §4)."""
+    import scipy.sparse as sp
+
+    s = sp.csr_matrix(
+        (np.array([1.0, 2.0]), np.array([1, 3]), np.array([0, 0, 2, 2, 2, 2])),
+        shape=(5, 4),
+    )
+    arr = sparse.csr_array(s)
+    vec = np.arange(4, dtype=np.float64)
+    assert np.allclose(np.asarray(arr @ vec), s @ vec)

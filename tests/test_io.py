@@ -1,0 +1,39 @@
+"""MatrixMarket IO vs the scipy oracle (reference: tests/integration/test_io.py)."""
+
+import numpy as np
+import pytest
+import scipy.io as sci_io
+
+import sparse_tpu as sparse
+from .utils.common import test_mtx_files
+
+
+@pytest.mark.parametrize("filename", test_mtx_files)
+def test_mmread(filename):
+    ours = sparse.io.mmread(filename)
+    ref = sci_io.mmread(filename)
+    assert ours.shape == ref.shape
+    assert np.allclose(np.asarray(ours.toarray()), ref.toarray())
+
+
+def test_mmwrite_roundtrip(tmp_path):
+    from .utils.sample import sample_csr
+
+    s = sample_csr(13, 11, seed=21)
+    ours = sparse.csr_array(s)
+    path = tmp_path / "out.mtx"
+    sparse.io.mmwrite(str(path), ours)
+    back = sci_io.mmread(str(path))
+    assert np.allclose(back.toarray(), s.toarray())
+    ours_back = sparse.io.mmread(str(path))
+    assert np.allclose(np.asarray(ours_back.toarray()), s.toarray())
+
+
+def test_mmwrite_complex_roundtrip(tmp_path):
+    from .utils.sample import sample_csr
+
+    s = sample_csr(7, 9, dtype=np.complex128, seed=22)
+    path = tmp_path / "out.mtx"
+    sparse.io.mmwrite(str(path), sparse.csr_array(s))
+    back = sci_io.mmread(str(path))
+    assert np.allclose(back.toarray(), s.toarray())

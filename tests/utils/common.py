@@ -1,0 +1,19 @@
+"""Shared test fixtures.
+
+Reference analog: ``tests/integration/utils/common.py:24-34`` — the fixture
+matrix list and the dtype axis {f32, f64, c64, c128}.
+"""
+
+import os
+
+import numpy as np
+
+TESTDATA = os.path.join(os.path.dirname(__file__), "..", "..", "testdata")
+
+test_mtx_files = [
+    os.path.join(TESTDATA, f)
+    for f in ["small.mtx", "rect.mtx", "graph.mtx", "ints.mtx", "banded.mtx"]
+]
+
+types = [np.float32, np.float64, np.complex64, np.complex128]
+real_types = [np.float32, np.float64]

@@ -1,0 +1,49 @@
+"""Generate the MatrixMarket fixture set in testdata/.
+
+Plays the role of the reference's testdata/ (test.mtx, GlossGT.mtx,
+Ragusa18.mtx, cage4.mtx, karate.mtx — SURVEY §4) with freshly generated
+matrices covering the same axes: small general real, rectangular, symmetric
+pattern graph, integer-valued, banded. Run once; outputs are committed.
+"""
+
+import numpy as np
+import scipy.io
+import scipy.sparse as sp
+
+
+def main(outdir="testdata"):
+    rng = np.random.default_rng(42)
+
+    # small square general real (analog of test.mtx)
+    a = sp.random(10, 10, density=0.3, random_state=rng, format="coo")
+    scipy.io.mmwrite(f"{outdir}/small.mtx", a)
+
+    # rectangular real (analog of Ragusa18: nonsquare, weighted)
+    b = sp.random(23, 14, density=0.2, random_state=rng, format="coo")
+    scipy.io.mmwrite(f"{outdir}/rect.mtx", b)
+
+    # symmetric pattern graph (analog of karate.mtx)
+    g = sp.random(34, 34, density=0.12, random_state=rng, format="coo")
+    g = ((g + g.T) > 0).astype(np.int64)
+    g.setdiag(0)
+    g.eliminate_zeros()
+    scipy.io.mmwrite(f"{outdir}/graph.mtx", sp.coo_matrix(g), field="pattern", symmetry="symmetric")
+
+    # small structured matrix with integer entries (analog of cage4-ish)
+    c = sp.random(9, 9, density=0.35, random_state=rng, format="coo")
+    c.data = np.round(c.data * 10).astype(np.float64) + 1
+    scipy.io.mmwrite(f"{outdir}/ints.mtx", c, field="integer")
+
+    # banded SPD 5-pt Laplacian-ish (the solver fixture)
+    n = 16
+    lap = sp.diags(
+        [-1.0, -1.0, 4.0, -1.0, -1.0],
+        [-4, -1, 0, 1, 4],
+        shape=(n, n),
+        format="coo",
+    )
+    scipy.io.mmwrite(f"{outdir}/banded.mtx", lap)
+
+
+if __name__ == "__main__":
+    main()

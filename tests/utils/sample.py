@@ -1,0 +1,30 @@
+"""Seeded random sparse matrix generator.
+
+Reference analog: ``tests/integration/utils/sample.py:25-43``.
+"""
+
+import numpy as np
+import scipy.sparse as sp
+
+
+def sample_csr(m, n, density=0.3, dtype=np.float64, seed=0):
+    """Random scipy CSR with the given density; complex dtypes get imag parts."""
+    rng = np.random.default_rng(seed)
+    a = sp.random(m, n, density=density, random_state=rng, format="csr")
+    data = a.data
+    if np.issubdtype(dtype, np.complexfloating):
+        data = data + 1j * rng.random(data.shape[0])
+    a = sp.csr_matrix((data.astype(dtype), a.indices, a.indptr), shape=(m, n))
+    return a
+
+
+def sample_dense(m, n, dtype=np.float64, seed=0):
+    rng = np.random.default_rng(seed)
+    d = rng.random((m, n))
+    if np.issubdtype(dtype, np.complexfloating):
+        d = d + 1j * rng.random((m, n))
+    return d.astype(dtype)
+
+
+def sample_vec(n, dtype=np.float64, seed=0):
+    return sample_dense(n, 1, dtype, seed)[:, 0]
