@@ -62,13 +62,26 @@ class LinearOperator:
         return self.matmat(x)
 
     @property
-    def T(self):
+    def H(self):
+        """Adjoint (conjugate transpose): matvec = this operator's rmatvec."""
         return LinearOperator(
             (self.shape[1], self.shape[0]),
-            matvec=self._rmatvec_impl,
-            rmatvec=self._matvec_impl,
+            matvec=self.rmatvec,  # bound methods: works for subclasses that
+            rmatvec=self.matvec,  # override matvec/rmatvec directly
             dtype=self.dtype,
         )
+
+    @property
+    def T(self):
+        """Transpose. For complex operators: conj . rmatvec . conj."""
+        if np.issubdtype(self.dtype, np.complexfloating):
+            return LinearOperator(
+                (self.shape[1], self.shape[0]),
+                matvec=lambda x: jnp.conj(self.rmatvec(jnp.conj(x))),
+                rmatvec=lambda x: jnp.conj(self.matvec(jnp.conj(x))),
+                dtype=self.dtype,
+            )
+        return self.H
 
 
 class IdentityOperator(LinearOperator):
@@ -91,6 +104,11 @@ class _SparseMatrixLinearOperator(LinearOperator):
         return self.A.dot(x)
 
     def rmatvec(self, x, out=None):
+        # rmatvec is the ADJOINT (A^H x), matching scipy's protocol and the
+        # dense operator; conjugate x instead of the matrix data (O(n), and
+        # A.T stays the zero-copy CSC reinterpretation)
+        if np.issubdtype(self.dtype, np.complexfloating):
+            return jnp.conj(self.A.T.dot(jnp.conj(x)))
         return self.A.T.dot(x)
 
 
@@ -298,7 +316,8 @@ def bicg(A, b, x0=None, tol=1e-08, maxiter=None, callback=None, conv_test_iters=
         pt_n = jnp.where(first, rt, rt + beta * pt)
         q = A.matvec(p_n)
         qt = A.rmatvec(pt_n)
-        alpha = rho_new / _vdot(pt_n, q)
+        ptq = _vdot(pt_n, q)
+        alpha = rho_new / jnp.where(ptq == 0, 1, ptq)  # 0/0 guard: b=0/exact x0
         x_n = x + alpha * p_n
         r_n = r - alpha * q
         rt_n = rt - alpha * qt
@@ -344,7 +363,8 @@ def bicgstab(A, b, x0=None, tol=1e-08, maxiter=None, callback=None, conv_test_it
         )
         p_n = jnp.where(first, r, r + beta * (p - omega * v))
         v_n = A.matvec(p_n)
-        alpha_n = rho_new / _vdot(rtilde, v_n)
+        rv = _vdot(rtilde, v_n)
+        alpha_n = rho_new / jnp.where(rv == 0, 1, rv)  # 0/0 guard: b=0/exact x0
         s = r - alpha_n * v_n
         t = A.matvec(s)
         omega_n = _vdot(t, s) / jnp.where(_vdot(t, t) == 0, 1, _vdot(t, t))
@@ -401,7 +421,9 @@ def gmres(
     for _outer in range(maxiter):
         r = M.matvec(b - A.matvec(x))
         beta = jnp.linalg.norm(r)
-        if float(beta) <= float(target) and _outer > 0:
+        # converged (or b == 0 / exact x0, where beta == 0): stop before a
+        # cycle would divide by beta
+        if float(beta) <= max(float(target), 1e-30):
             break
         x, inner = _gmres_cycle(A, M, x, r, beta, restart, target)
         total_iters += inner
@@ -440,21 +462,24 @@ def _gmres_cycle(A, M, x, r, beta, restart, target):
         if float(hkk) > 1e-30:
             V = V.at[k + 1].set(w / hkk)
         # apply accumulated Givens rotations to the new column
+        # (real cs, possibly-complex sn: [c, s; -conj(s), c] is unitary)
         for i in range(k):
             t = cs[i] * H[i, k] + sn[i] * H[i + 1, k]
-            H[i + 1, k] = -sn[i] * H[i, k] + cs[i] * H[i + 1, k]
+            H[i + 1, k] = -np.conj(sn[i]) * H[i, k] + cs[i] * H[i + 1, k]
             H[i, k] = t
         denom = np.hypot(abs(H[k, k]), abs(H[k + 1, k]))
         if denom == 0:
             k_used = k + 1
             break
-        cs[k] = abs(H[k, k]) / denom if denom else 1.0
-        sn[k] = H[k + 1, k] / denom * (1 if H[k, k] >= 0 else -1) if denom else 0.0
-        # standard real Givens; for complex fall back to numpy lartg-style
-        rkk = cs[k] * H[k, k] + sn[k] * H[k + 1, k]
-        H[k, k] = rkk
+        if H[k, k] == 0:
+            cs[k] = 0.0
+            sn[k] = np.conj(H[k + 1, k]) / abs(H[k + 1, k])
+        else:
+            cs[k] = abs(H[k, k]) / denom
+            sn[k] = (H[k, k] / abs(H[k, k])) * np.conj(H[k + 1, k]) / denom
+        H[k, k] = cs[k] * H[k, k] + sn[k] * H[k + 1, k]
         H[k + 1, k] = 0.0
-        g[k + 1] = -sn[k] * g[k]
+        g[k + 1] = -np.conj(sn[k]) * g[k]
         g[k] = cs[k] * g[k]
         k_used = k + 1
         if abs(g[k + 1]) < float(target):
@@ -471,44 +496,115 @@ def _gmres_cycle(A, M, x, r, beta, restart, target):
 # LSQR (linalg.py:937) — Golub-Kahan bidiagonalization
 # ---------------------------------------------------------------------------
 def lsqr(A, b, damp=0.0, atol=1e-08, btol=1e-08, conlim=1e8, iter_lim=None):
+    """Golub-Kahan bidiagonalization least squares (reference linalg.py:937).
+
+    The bidiagonalization matvecs run on device; the O(1) rotation/norm
+    recurrences (Paige & Saunders' stopping estimates, as in scipy) are host
+    scalars. Returns (x, istop, itn, r1norm).
+    """
     b = asjnp(b)
     A = make_linear_operator(A)
     m, n = A.shape
     if iter_lim is None:
         iter_lim = 2 * n
+    dampsq = damp * damp
+    eps = float(np.finfo(np.dtype(b.dtype)).eps) if np.issubdtype(
+        np.dtype(b.dtype), np.floating
+    ) else float(np.finfo(np.float64).eps)
+    ctol = 1.0 / conlim if conlim > 0 else 0.0
+
     x = jnp.zeros((n,), dtype=b.dtype)
-    beta = jnp.linalg.norm(b)
-    u = jnp.where(beta > 0, 1.0 / jnp.where(beta == 0, 1, beta), 0.0) * b
+    bnorm = float(jnp.linalg.norm(b))
+    if bnorm == 0.0:
+        return x, 0, 0, 0.0
+    beta = bnorm
+    u = b / beta
     v = A.rmatvec(u)
-    alpha = jnp.linalg.norm(v)
-    v = jnp.where(alpha > 0, 1.0 / jnp.where(alpha == 0, 1, alpha), 0.0) * v
+    alpha = float(jnp.linalg.norm(v))
+    if alpha > 0:
+        v = v / alpha
     w = v
-    phibar = beta
-    rhobar = alpha
-    itn = 0
-    for itn in range(1, iter_lim + 1):
+    phibar, rhobar = beta, alpha
+    rnorm = r1norm = beta
+    anorm = acond = ddnorm = res2 = xxnorm = z = 0.0
+    cs2, sn2 = -1.0, 0.0
+    arnorm = alpha * beta
+    if arnorm == 0.0:
+        return x, 0, 0, r1norm
+    istop = itn = 0
+    while itn < iter_lim:
+        itn += 1
         u = A.matvec(v) - alpha * u
-        beta = jnp.linalg.norm(u)
-        u = jnp.where(beta > 0, u / jnp.where(beta == 0, 1, beta), u)
-        v = A.rmatvec(u) - beta * v
-        alpha = jnp.linalg.norm(v)
-        v = jnp.where(alpha > 0, v / jnp.where(alpha == 0, 1, alpha), v)
+        beta = float(jnp.linalg.norm(u))
+        if beta > 0:
+            u = u / beta
+            anorm = np.sqrt(anorm**2 + alpha**2 + beta**2 + dampsq)
+            v = A.rmatvec(u) - beta * v
+            alpha = float(jnp.linalg.norm(v))
+            if alpha > 0:
+                v = v / alpha
+        # eliminate the damping diagonal with its own rotation
         if damp:
-            rhobar1 = jnp.sqrt(rhobar**2 + damp**2)
+            rhobar1 = np.sqrt(rhobar**2 + dampsq)
+            cs1 = rhobar / rhobar1
+            sn1 = damp / rhobar1
+            psi = sn1 * phibar
+            phibar = cs1 * phibar
         else:
-            rhobar1 = rhobar
-        rho = jnp.sqrt(rhobar1**2 + beta**2)
-        c = rhobar1 / rho
-        s = beta / rho
-        theta = s * alpha
-        rhobar = -c * alpha
-        phi = c * phibar
-        phibar = s * phibar
+            rhobar1, psi = rhobar, 0.0
+        # plane rotation annihilating beta
+        rho = np.sqrt(rhobar1**2 + beta**2)
+        cs = rhobar1 / rho
+        sn = beta / rho
+        theta = sn * alpha
+        rhobar = -cs * alpha
+        phi = cs * phibar
+        phibar = sn * phibar
+        tau = sn * phi
         x = x + (phi / rho) * w
+        ddnorm = ddnorm + float(jnp.vdot(w, w).real) / rho**2
         w = v - (theta / rho) * w
-        if float(phibar) < atol * float(jnp.linalg.norm(b)) + btol:
+        # estimate ||x||, cond(A), residual norms (Paige & Saunders)
+        delta = sn2 * rho
+        gambar = -cs2 * rho
+        rhs = phi - delta * z
+        zbar = rhs / gambar
+        xnorm = np.sqrt(xxnorm + zbar**2)
+        gamma = np.sqrt(gambar**2 + theta**2)
+        cs2 = gambar / gamma
+        sn2 = theta / gamma
+        z = rhs / gamma
+        xxnorm = xxnorm + z**2
+        acond = anorm * np.sqrt(ddnorm)
+        res1 = phibar**2
+        res2 = res2 + psi**2
+        rnorm = np.sqrt(res1 + res2)
+        arnorm = alpha * abs(tau)
+        r1sq = rnorm**2 - dampsq * xxnorm
+        r1norm = np.sqrt(abs(r1sq)) * (1.0 if r1sq >= 0 else -1.0)
+        # convergence tests
+        test1 = rnorm / bnorm
+        test2 = arnorm / (anorm * rnorm + eps)
+        test3 = 1.0 / (acond + eps)
+        t1 = test1 / (1 + anorm * xnorm / bnorm)
+        rtol = btol + atol * anorm * xnorm / bnorm
+        if itn >= iter_lim:
+            istop = 7
+        if 1 + test3 <= 1:
+            istop = 6
+        if 1 + test2 <= 1:
+            istop = 5
+        if 1 + t1 <= 1:
+            istop = 4
+        if test3 <= ctol:
+            istop = 3
+        if test2 <= atol:
+            istop = 2
+        if test1 <= rtol:
+            istop = 1
+        if istop != 0:
             break
-    return x, itn, float(phibar)
+    return x, istop, itn, r1norm
 
 
 # ---------------------------------------------------------------------------

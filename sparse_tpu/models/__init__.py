@@ -1,0 +1,15 @@
+"""Benchmark/application model builders (the examples' compute cores).
+
+Reference analog: the workload-construction halves of ``examples/pde.py``,
+``examples/gmg.py``, ``examples/amg.py`` — kept importable here so the driver
+entrypoint (``__graft_entry__.py``), ``bench.py``, and the example scripts all
+share one implementation.
+"""
+
+from .poisson import (  # noqa: F401
+    cg_ell,
+    cg_step_ell,
+    laplacian_2d_csr,
+    laplacian_2d_ell,
+    poisson_cg_state,
+)

@@ -105,6 +105,9 @@ def kron(A, B, format=None):
     out_shape = (ma * mb, na * nb)
     if A.nnz == 0 or B.nnz == 0:
         return _as_format(csr_array(out_shape), format)
+    from .ops.coords import require_x64_keys
+
+    require_x64_keys(out_shape)  # loud error instead of silent int32 wrap
     rows = (A.row.astype(jnp.int64)[:, None] * mb + B.row.astype(jnp.int64)[None, :]).ravel()
     cols = (A.col.astype(jnp.int64)[:, None] * nb + B.col.astype(jnp.int64)[None, :]).ravel()
     vals = (A.data[:, None] * B.data[None, :]).ravel()
@@ -139,9 +142,13 @@ def random(
         if mn < (1 << 26):
             flat = rng.choice(mn, size=k, replace=False)
         else:  # sample-and-dedup for huge index spaces
-            cand = rng.integers(0, mn, size=int(k * 1.2) + 16)
-            flat = np.unique(cand)[:k]
-            k = flat.shape[0]
+            uniq = np.unique(rng.integers(0, mn, size=int(k * 1.2) + 16))
+            while uniq.shape[0] < k:  # top up until k distinct positions
+                more = rng.integers(0, mn, size=int(k * 0.4) + 16)
+                uniq = np.unique(np.concatenate([uniq, more]))
+            # subsample uniformly — truncating the sorted uniques would bias
+            # every draw toward low row indices
+            flat = rng.choice(uniq, size=k, replace=False)
     else:
         flat = np.zeros((0,), dtype=np.int64)
         k = 0

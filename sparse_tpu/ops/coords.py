@@ -48,10 +48,28 @@ def rows_to_indptr(sorted_rows, m: int, dtype=None):
     return jnp.searchsorted(sorted_rows, targets, side="left").astype(dtype)
 
 
+def require_x64_keys(shape) -> bool:
+    """True when (row, col) keys for ``shape`` need int64.
+
+    Raises loudly when int64 is needed but x64 is disabled: jnp silently
+    truncates int64->int32 in that configuration, which would corrupt every
+    sort-based conversion for m*n > 2**31 with no error.
+    """
+    m, n = int(shape[0]), int(shape[1])
+    if m * n <= np.iinfo(np.int32).max:
+        return False
+    if not jax.config.jax_enable_x64:
+        raise ValueError(
+            f"matrix shape {shape} needs int64 sort keys (m*n > 2**31); "
+            "enable them with jax.config.update('jax_enable_x64', True)"
+        )
+    return True
+
+
 def linearize(rows, cols, shape):
     """(row, col) -> single sort key. int64 when the flat index could overflow int32."""
-    m, n = int(shape[0]), int(shape[1])
-    if m * n > np.iinfo(np.int32).max:
+    n = int(shape[1])
+    if require_x64_keys(shape):
         return rows.astype(jnp.int64) * n + cols.astype(jnp.int64)
     return rows.astype(jnp.int32) * np.int32(n) + cols.astype(jnp.int32)
 

@@ -28,14 +28,36 @@ def csr_spmv_segment(indptr, indices, data, x, m: int):
     return jax.ops.segment_sum(prod, rows, num_segments=m, indices_are_sorted=True)
 
 
+# Max ELL width unrolled into the trace; wider matrices take a fori_loop so
+# the program size stays O(1) in the row degree.
+ELL_UNROLL_MAX = 32
+
+
 def csr_spmv_ell(ell_indices, ell_data, x):
-    """y = A @ x on the padded-row (ELL) layout: [m, k] gathers + row reduction.
+    """y = A @ x on the padded-row (ELL) layout: k 1-D gathers + VPU adds.
 
     For banded/bounded-degree matrices (every reference benchmark: 5-pt/9-pt
     Laplacians, 11-diag SpMV microbench) this is pure gather + VPU reduce —
-    no scatter, no segment ids.
+    no scatter, no segment ids. The k planes are processed as separate [m]
+    gathers: a single [m, k] fancy-index gather acquires a trailing
+    length-1 index dim that TPU tiles to (8, 128) — an ~128x padded s32
+    buffer in HBM — while 1-D gathers lay out exactly. Small k is unrolled;
+    large k runs the same plane-gather under lax.fori_loop.
     """
-    return jnp.einsum("mk,mk->m", ell_data, x[ell_indices])
+    k = ell_data.shape[1]
+    if k <= ELL_UNROLL_MAX:
+        acc = ell_data[:, 0] * x[ell_indices[:, 0]]
+        for kk in range(1, k):
+            acc = acc + ell_data[:, kk] * x[ell_indices[:, kk]]
+        return acc
+    idx_t, dat_t = ell_indices.T, ell_data.T  # [k, m]: plane-major slices
+
+    def body(kk, acc):
+        return acc + dat_t[kk] * x[idx_t[kk]]
+
+    out_dt = jnp.result_type(ell_data.dtype, x.dtype)
+    acc0 = jnp.zeros((ell_data.shape[0],), dtype=out_dt)
+    return jax.lax.fori_loop(0, k, body, acc0)
 
 
 def csr_spmm_segment(indptr, indices, data, B, m: int):
@@ -51,9 +73,23 @@ def csr_spmm_segment(indptr, indices, data, B, m: int):
 
 
 def csr_spmm_ell(ell_indices, ell_data, B):
-    """C = A @ B on the ELL layout: batched gather of B rows + contraction.
-    [m, k] x [m, k, n] -> [m, n]; XLA fuses the gather into the reduce."""
-    return jnp.einsum("mk,mkn->mn", ell_data, B[ell_indices])
+    """C = A @ B on the ELL layout: k row-gathers of B + fused accumulate.
+    Unrolled over small static ELL widths (same TPU-layout reason as
+    csr_spmv_ell), fori_loop above ELL_UNROLL_MAX."""
+    k = ell_data.shape[1]
+    if k <= ELL_UNROLL_MAX:
+        acc = ell_data[:, 0, None] * B[ell_indices[:, 0]]
+        for kk in range(1, k):
+            acc = acc + ell_data[:, kk, None] * B[ell_indices[:, kk]]
+        return acc
+    idx_t, dat_t = ell_indices.T, ell_data.T  # [k, m]
+
+    def body(kk, acc):
+        return acc + dat_t[kk][:, None] * B[idx_t[kk]]
+
+    out_dt = jnp.result_type(ell_data.dtype, B.dtype)
+    acc0 = jnp.zeros((ell_data.shape[0], B.shape[1]), dtype=out_dt)
+    return jax.lax.fori_loop(0, k, body, acc0)
 
 
 def csc_spmv(indptr, indices, data, x, m: int):

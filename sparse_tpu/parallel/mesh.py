@@ -1,0 +1,81 @@
+"""Device mesh discovery and creation — the runtime singleton analog.
+
+Reference analog: ``sparse/runtime.py:56-130`` (proc/GPU counts from mapper
+tunables, eager NCCL init, store creation). On TPU the "runtime" collapses to:
+``jax.distributed.initialize`` (the NCCL-init analog, runtime.py:85-87) plus a
+``jax.sharding.Mesh`` over the visible devices. XLA owns placement and
+collective routing over ICI/DCN; there is no mapper.
+
+The mesh axis naming convention used throughout ``sparse_tpu.parallel``:
+  * ``"shards"`` — the 1-D row-block data-parallel axis (the key-partition
+    analog, csr.py:242-246).
+  * 2-D grids for SpGEMM/cdist/quantum use ``("gx", "gy")`` shaped by
+    ``utils.factor_int`` (utils.py:144-150 analog).
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+_initialized = False
+
+
+def initialize_distributed(**kwargs) -> None:
+    """Multi-host bring-up: the ``jax.distributed.initialize`` wrapper.
+
+    The NCCL/coll eager-initialization analog (runtime.py:75-87). Idempotent;
+    no-op for single-process runs (the common case under pytest and on a
+    single chip).
+    """
+    global _initialized
+    if _initialized:
+        return
+    if os.environ.get("JAX_COORDINATOR_ADDRESS") or kwargs.get("coordinator_address"):
+        jax.distributed.initialize(**kwargs)
+    _initialized = True
+
+
+def num_procs() -> int:
+    """Total device count (the NUM_PROCS/NUM_GPUS tunable analog, mapper.cc:64-84).
+
+    Env-overridable like LEGATE_SPARSE_NUM_PROCS (runtime.py:61-63).
+    """
+    env = os.environ.get("SPARSE_TPU_NUM_PROCS")
+    if env is not None:
+        return int(env)
+    return len(jax.devices())
+
+
+def get_mesh(num_shards: int | None = None, axis: str = "shards") -> Mesh:
+    """A 1-D mesh over the first ``num_shards`` devices (default: all)."""
+    devs = jax.devices()
+    if num_shards is None:
+        num_shards = len(devs)
+    if num_shards > len(devs):
+        raise ValueError(
+            f"requested {num_shards} shards but only {len(devs)} devices"
+        )
+    return Mesh(np.array(devs[:num_shards]), (axis,))
+
+
+def get_mesh_2d(num_procs_: int | None = None, axes=("gx", "gy")) -> Mesh:
+    """A near-square 2-D mesh (factor_int analog) for 2-D-grid algorithms."""
+    from ..utils import factor_int
+
+    devs = jax.devices()
+    if num_procs_ is None:
+        num_procs_ = len(devs)
+    gx, gy = factor_int(num_procs_)
+    return Mesh(np.array(devs[: gx * gy]).reshape(gx, gy), axes)
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def row_sharded(mesh: Mesh, axis: str = "shards") -> NamedSharding:
+    return NamedSharding(mesh, P(axis))

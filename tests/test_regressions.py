@@ -1,0 +1,118 @@
+"""Regression tests for review findings (solver edge cases, layout caps)."""
+
+import numpy as np
+import pytest
+import scipy.sparse as sp
+
+import sparse_tpu
+from sparse_tpu import linalg
+
+from .utils.sample import sample_csr
+
+
+def spd(n, seed=0):
+    a = sample_csr(n, n, density=0.3, seed=seed)
+    s = (a + a.T).toarray() + n * np.eye(n)
+    return s
+
+
+def test_lsqr_damp_identity():
+    # min ||x - b||^2 + ||x||^2 has solution b/2
+    A = sparse_tpu.identity(5)
+    b = np.arange(1.0, 6.0)
+    x, *_ = linalg.lsqr(A, b, damp=1.0)
+    np.testing.assert_allclose(np.asarray(x), b / 2, rtol=1e-6)
+
+
+def test_lsqr_damp_matches_scipy():
+    s = sample_csr(20, 12, density=0.4, seed=5)
+    b = np.random.default_rng(0).standard_normal(20)
+    x_ref = sp.linalg.lsqr(s, b, damp=0.7, atol=1e-12, btol=1e-12)[0]
+    x, *_ = linalg.lsqr(sparse_tpu.csr_array(s), b, damp=0.7, atol=1e-12, btol=1e-12)
+    np.testing.assert_allclose(np.asarray(x), x_ref, rtol=1e-5, atol=1e-8)
+
+
+@pytest.mark.parametrize("solver", [linalg.cg, linalg.bicg, linalg.bicgstab, linalg.cgs])
+def test_zero_rhs_returns_zeros(solver):
+    A = sparse_tpu.csr_array(spd(8))
+    x, _ = solver(A, np.zeros(8), maxiter=100)
+    assert np.all(np.isfinite(np.asarray(x)))
+    np.testing.assert_allclose(np.asarray(x), 0.0)
+
+
+def test_gmres_zero_rhs():
+    A = sparse_tpu.csr_array(spd(8))
+    x, iters = linalg.gmres(A, np.zeros(8))
+    np.testing.assert_allclose(np.asarray(x), 0.0)
+    assert np.all(np.isfinite(np.asarray(x)))
+
+
+def test_gmres_complex():
+    rng = np.random.default_rng(3)
+    n = 12
+    d = rng.standard_normal((n, n)) + 1j * rng.standard_normal((n, n))
+    d = d + n * np.eye(n)  # well conditioned
+    d[np.abs(d) < 0.8] = 0
+    d += n * np.eye(n)
+    A = sparse_tpu.csr_array(d)
+    xtrue = rng.standard_normal(n) + 1j * rng.standard_normal(n)
+    b = d @ xtrue
+    x, _ = linalg.gmres(A, b, tol=1e-10, restart=n, maxiter=50)
+    np.testing.assert_allclose(np.asarray(x), xtrue, rtol=1e-6, atol=1e-8)
+
+
+def test_linear_operator_transpose_of_sparse():
+    s = sample_csr(9, 7, density=0.4, seed=2)
+    op = linalg.aslinearoperator(sparse_tpu.csr_array(s))
+    x = np.random.default_rng(1).standard_normal(9)
+    np.testing.assert_allclose(np.asarray(op.T.matvec(x)), s.T @ x, rtol=1e-12)
+
+
+def test_linear_operator_transpose_complex():
+    s = sample_csr(6, 5, density=0.5, seed=2, dtype=np.complex128)
+    op = linalg.aslinearoperator(sparse_tpu.csr_array(s))
+    x = np.random.default_rng(1).standard_normal(6)
+    np.testing.assert_allclose(
+        np.asarray(op.T.matvec(x)), s.T.toarray() @ x, rtol=1e-12
+    )
+    np.testing.assert_allclose(
+        np.asarray(op.H.matvec(x)), s.conj().T.toarray() @ x, rtol=1e-12
+    )
+
+
+def test_wide_ell_spmv_fori_path():
+    # force the ELL path on a matrix wider than ELL_UNROLL_MAX
+    from sparse_tpu.config import settings
+    from sparse_tpu.ops.spmv import ELL_UNROLL_MAX
+
+    n = ELL_UNROLL_MAX + 17
+    d = np.random.default_rng(0).standard_normal((8, n))
+    A = sparse_tpu.csr_array(d)
+    old = settings.spmv_mode
+    settings.spmv_mode = "ell"
+    try:
+        x = np.random.default_rng(1).standard_normal(n)
+        np.testing.assert_allclose(np.asarray(A @ x), d @ x, rtol=1e-10)
+        B = np.random.default_rng(2).standard_normal((n, 4))
+        np.testing.assert_allclose(np.asarray(A @ B), d @ B, rtol=1e-10)
+    finally:
+        settings.spmv_mode = old
+
+
+def test_random_large_path_covers_high_rows():
+    A = sparse_tpu.random(10000, 10000, density=1e-5, random_state=0)
+    assert A.nnz == 1000
+    # the fixed sampler must reach the top of the index space
+    assert np.asarray(A.row).max() > 5000
+
+
+def test_wide_shape_requires_x64_message():
+    import jax
+
+    from sparse_tpu.ops.coords import require_x64_keys
+
+    if jax.config.jax_enable_x64:
+        assert require_x64_keys((60000, 60000))
+    else:
+        with pytest.raises(ValueError, match="x64"):
+            require_x64_keys((60000, 60000))
