@@ -1,0 +1,343 @@
+"""Algebraic multigrid (smoothed aggregation) preconditioned CG.
+
+Reference analog: ``examples/amg.py`` (569 LoC; the BASELINE.md north-star
+workload — 4096^2 Poisson at >=80% weak-scaling efficiency). Same algorithm:
+strength-filtered MIS(2) aggregation computed with the tropical-semiring SpMV
+(amg.py:199-283), tentative prolongator from near-nullspace candidates
+(fit_candidates), Jacobi-smoothed prolongator, Galerkin coarse operators via
+SpGEMM, V-cycle preconditioned CG.
+
+TPU-first redesigns:
+  * the MIS tournament runs on int32 tuples (index tie-break makes the order
+    strict regardless of random-value collisions, so int64 randomness is not
+    required — TPU-native lane width);
+  * the V-cycle is fully traceable: smoothers are jnp elementwise ops and the
+    coarse solve is a jnp dense solve, so CG + preconditioner compile into
+    one XLA program;
+  * per-level workspace caching (amg.py:284-331) is unnecessary — XLA owns
+    buffers.
+
+Run:  python examples/amg.py -n 128 -maxiter 200
+"""
+
+import argparse
+
+import numpy as np
+
+from benchmark import get_phase_procs, parse_common_args
+
+parser = argparse.ArgumentParser()
+parser.add_argument("-n", type=int, default=64)
+parser.add_argument("-data", default="poisson", choices=["poisson", "diffusion"])
+parser.add_argument("-theta", type=float, default=0.0)
+parser.add_argument("-max_coarse", type=int, default=10)
+parser.add_argument("-maxiter", type=int, default=None)
+parser.add_argument("-tol", type=float, default=1e-8)
+parser.add_argument("-verbose", action="store_true")
+args, _ = parser.parse_known_args()
+common, timer, _np, sparse, linalg, use_tpu = parse_common_args()
+
+if use_tpu:
+    import jax.numpy as jnp
+else:
+    jnp = np
+
+
+# ---------------------------------------------------------------------------
+# Problem construction (amg.py:48-132) — vectorized stencil_grid
+# ---------------------------------------------------------------------------
+def stencil_grid(S, grid):
+    """Sparse operator from a stencil S over an N-d grid: one COO slab per
+    stencil offset with boundary masking (vectorized; the reference zeroes
+    boundary connections diagonal-by-diagonal, amg.py:48-103)."""
+    S = np.asarray(S, dtype=np.float64)
+    grid = tuple(grid)
+    N_v = int(np.prod(grid))
+    idx = np.arange(N_v, dtype=np.int64)
+    coords = np.unravel_index(idx, grid)
+    center = tuple(s // 2 for s in S.shape)
+    rows_l, cols_l, vals_l = [], [], []
+    for off in np.ndindex(S.shape):
+        w = S[off]
+        if w == 0:
+            continue
+        d = tuple(o - c for o, c in zip(off, center))
+        nbr = [coords[k] + d[k] for k in range(len(grid))]
+        ok = np.ones(N_v, dtype=bool)
+        for k in range(len(grid)):
+            ok &= (nbr[k] >= 0) & (nbr[k] < grid[k])
+        cols = np.ravel_multi_index([n[ok] for n in nbr], grid)
+        rows_l.append(idx[ok])
+        cols_l.append(cols)
+        vals_l.append(np.full(int(ok.sum()), w))
+    rows = np.concatenate(rows_l)
+    cols = np.concatenate(cols_l)
+    vals = np.concatenate(vals_l)
+    if use_tpu:
+        return sparse.coo_array((vals, (rows, cols)), shape=(N_v, N_v)).tocsr()
+    return sparse.coo_matrix((vals, (rows, cols)), shape=(N_v, N_v)).tocsr()
+
+
+def poisson2D(N):
+    M = 2
+    stencil = np.zeros((3,) * M)
+    for i in range(M):
+        stencil[(1,) * i + (0,) + (1,) * (M - i - 1)] = -1
+        stencil[(1,) * i + (2,) + (1,) * (M - i - 1)] = -1
+    stencil[(1,) * M] = 2 * M
+    return stencil_grid(stencil, (N, N))
+
+
+def diffusion2D(N, epsilon=1.0, theta=0.0):
+    eps, th = float(epsilon), float(theta)
+    C, S = np.cos(th), np.sin(th)
+    CS, CC, SS = C * S, C**2, S**2
+    a = (-1 * eps - 1) * CC + (-1 * eps - 1) * SS + (3 * eps - 3) * CS
+    b = (2 * eps - 4) * CC + (-4 * eps + 2) * SS
+    c = (-1 * eps - 1) * CC + (-1 * eps - 1) * SS + (-3 * eps + 3) * CS
+    d = (-4 * eps + 2) * CC + (2 * eps - 4) * SS
+    e = (8 * eps + 8) * CC + (8 * eps + 8) * SS
+    stencil = np.array([[a, b, c], [d, e, d], [c, b, a]]) / 6.0
+    return stencil_grid(stencil, (N, N))
+
+
+# ---------------------------------------------------------------------------
+# Smoothed-aggregation setup (amg.py:134-283)
+# ---------------------------------------------------------------------------
+def strength(A, theta=0.0):
+    """Symmetric strength-of-connection filter (amg.py:134)."""
+    if theta == 0:
+        return A
+    B = abs(A.copy()).tocoo()
+    D = np.asarray(A.diagonal())
+    data = np.asarray(B.data)
+    row, col = np.asarray(B.row), np.asarray(B.col)
+    keep = data >= theta * np.sqrt(np.abs(D[row] * D[col]))
+    data = np.where(keep, data, 0.0)
+    # column-wise normalization by the max entry
+    colmax = np.zeros(A.shape[1])
+    np.maximum.at(colmax, col, data)
+    data = data / np.where(colmax[col] == 0, 1.0, colmax[col])
+    nz = data != 0
+    if use_tpu:
+        return sparse.coo_array(
+            (data[nz], (row[nz], col[nz])), shape=A.shape
+        ).tocsr()
+    return sparse.coo_matrix((data[nz], (row[nz], col[nz])), shape=A.shape).tocsr()
+
+
+def fit_candidates(AggOp, B):
+    """Tentative prolongator from near-nullspace candidates (amg.py:148)."""
+    Q = AggOp.tocoo()
+    Bsq = np.asarray(B).ravel() ** 2
+    data = Bsq[np.asarray(Q.row)] * np.asarray(Q.data)
+    colsum = np.zeros(AggOp.shape[1])
+    np.add.at(colsum, np.asarray(Q.col), data)
+    R = np.sqrt(colsum)
+    data = data / np.where(R[np.asarray(Q.col)] == 0, 1.0, R[np.asarray(Q.col)])
+    # data entries are B[row] * B[row] / R[col]; the tentative prolongator
+    # has value B[row] / R[col] per (row, aggregate) pair
+    vals = np.asarray(B).ravel()[np.asarray(Q.row)] / np.where(
+        R[np.asarray(Q.col)] == 0, 1.0, R[np.asarray(Q.col)]
+    )
+    if use_tpu:
+        T = sparse.coo_array(
+            (vals, (np.asarray(Q.row), np.asarray(Q.col))), shape=AggOp.shape
+        ).tocsr()
+    else:
+        T = sparse.coo_matrix(
+            (vals, (np.asarray(Q.row), np.asarray(Q.col))), shape=AggOp.shape
+        ).tocsr()
+    return T, R.reshape(-1, 1)
+
+
+def estimate_spectral_radius(A, maxiter=15, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.random(A.shape[0])
+    y = x
+    for _ in range(maxiter):
+        x = x / np.linalg.norm(x)
+        y = np.asarray(A @ x)
+        x, y = y, x
+    return float(np.dot(x, y) / np.linalg.norm(y))
+
+
+def smooth_prolongator(A, T, k=1, omega=4.0 / 3.0, D=None):
+    """P = (I - (omega/rho) D^-1 A) T (amg.py:171)."""
+    if D is None:
+        D = np.asarray(A.diagonal())
+    D_inv = 1.0 / D
+    D_inv_S = A.multiply(D_inv[:, None])
+    rho = estimate_spectral_radius(D_inv_S)
+    D_inv_S = D_inv_S * (omega / rho)
+    P = T.tocsr()
+    for _ in range(k):
+        P = P - (D_inv_S @ P)
+    return P, rho
+
+
+def maximal_independent_set(C, k=1, invalid=None, seed=0):
+    """MIS(k) by tropical-semiring tournament (amg.py:199)."""
+    assert C.shape[0] == C.shape[1]
+    N = C.shape[0]
+    rng = np.random.default_rng(seed)
+    # int32 tuples: the index component breaks ties, so the lexicographic
+    # order stays strict even under random-value collisions
+    random_values = rng.integers(0, np.iinfo(np.int32).max, size=N, dtype=np.int32)
+    x = np.stack(
+        [np.ones(N, np.int32), random_values, np.arange(N, dtype=np.int32)], axis=1
+    )
+    active = N
+    if invalid is not None:
+        x[invalid, 0] = -1
+        active -= int(invalid.sum())
+    C = C.tocsr()
+    while True:
+        z = np.array(C.tropical_spmv(x))
+        for _ in range(1, k):
+            z = np.array(C.tropical_spmv(z))
+        mis_node = np.nonzero((x[:, 0] == 1) & (z[:, 2] == np.arange(N)))[0]
+        x[mis_node, 0] = 2
+        non_mis = np.nonzero((x[:, 0] == 1) & (z[:, 0] == 2))[0]
+        x[non_mis, 0] = 0
+        active -= len(mis_node) + len(non_mis)
+        if active == 0:
+            break
+        assert 0 < active < N
+    return np.nonzero(x[:, 0] == 2)[0]
+
+
+def mis_aggregate(C):
+    """Aggregates = nearest MIS(2) root, found by two tropical hops (amg.py:259)."""
+    C = C.tocsr()
+    mis = maximal_independent_set(C, 2)
+    N_fine, N_coarse = C.shape[0], mis.size
+    x = np.zeros((N_fine, 2), dtype=np.int32)
+    x[mis, 0] = 2
+    x[mis, 1] = np.arange(N_coarse, dtype=np.int32)
+    y = np.array(C.tropical_spmv(x))
+    y[:, 0] += x[:, 0]
+    z = np.array(C.tropical_spmv(y))
+    data = np.ones(N_fine)
+    row = np.arange(N_fine)
+    col = z[:, 1]
+    if use_tpu:
+        agg = sparse.coo_array((data, (row, col)), shape=(N_fine, N_coarse))
+    else:
+        agg = sparse.coo_matrix((data, (row, col)), shape=(N_fine, N_coarse))
+    return agg.tocsr(), mis
+
+
+# ---------------------------------------------------------------------------
+# Hierarchy + V-cycle (amg.py:284-427)
+# ---------------------------------------------------------------------------
+class Level:
+    def __init__(self, R=None, A=None, P=None, D=None, B=None, rho_DinvA=None):
+        self.R, self.A, self.P, self.D, self.B = R, A, P, D, B
+        self.rho_DinvA = rho_DinvA
+        self.dense_A = None
+
+    def presmoother(self, x, b, omega=4.0 / 3.0):
+        return (omega / self.rho_DinvA) * b / self._D()
+
+    def postsmoother(self, x, b, omega=4.0 / 3.0):
+        return x + (omega / self.rho_DinvA) * (b - self.A @ x) / self._D()
+
+    def _D(self):
+        return jnp.asarray(self.D) if use_tpu else self.D
+
+
+def build_hierarchy(A, B, theta=0.0, max_coarse=10):
+    levels = [Level(A=A, B=B)]
+    while levels[-1].A.shape[0] > max_coarse:
+        A = levels[-1].A
+        B = levels[-1].B
+        D = np.asarray(A.diagonal())
+        C = strength(A, theta=theta)
+        AggOp, roots = mis_aggregate(C)
+        T, B_coarse = fit_candidates(AggOp, B)
+        P, rho = smooth_prolongator(A, T, k=1, D=D)
+        R = P.T.tocsr()
+        levels[-1] = Level(R, A, P, D, B, rho)
+        A_coarse = (R @ A @ P).tocsr()
+        levels.append(Level(A=A_coarse, B=B_coarse))
+    levels[-1].dense_A = np.asarray(levels[-1].A.toarray())
+    return levels
+
+
+def cycle(levels, lvl, b):
+    """Traceable V-cycle: returns x (jnp under sparse_tpu)."""
+    level = levels[lvl]
+    x = level.presmoother(None, b)
+    residual = b - level.A @ x
+    coarse_b = level.R @ residual
+    if lvl == len(levels) - 2:
+        dense = levels[-1].dense_A
+        coarse_x = (
+            jnp.linalg.solve(jnp.asarray(dense), coarse_b)
+            if use_tpu
+            else np.linalg.solve(dense, coarse_b)
+        )
+    else:
+        coarse_x = cycle(levels, lvl + 1, coarse_b)
+    x = x + level.P @ coarse_x
+    return level.postsmoother(x, b)
+
+
+def operator_complexity(levels):
+    return sum(level.A.nnz for level in levels) / levels[0].A.nnz
+
+
+def grid_complexity(levels):
+    return sum(level.A.shape[0] for level in levels) / levels[0].A.shape[0]
+
+
+def main():
+    N = args.n
+    build, solve = get_phase_procs(use_tpu)
+    timer.start()
+    with build:
+        A = poisson2D(N) if args.data == "poisson" else diffusion2D(N)
+        B = np.ones((A.shape[0], 1))
+    print(f"Data creation time: {timer.stop():.1f} ms")
+
+    timer.start()
+    with build:
+        levels = build_hierarchy(A, B, theta=args.theta, max_coarse=args.max_coarse)
+    print(f"AMG setup time: {timer.stop():.1f} ms")
+    print(f"levels: {len(levels)}  sizes: {[lv.A.shape[0] for lv in levels]}")
+    print(f"operator complexity: {operator_complexity(levels):.2f}")
+    print(f"grid complexity: {grid_complexity(levels):.2f}")
+
+    b = np.ones(A.shape[0])
+    with solve:
+        if use_tpu:
+            M = linalg.LinearOperator(
+                A.shape, matvec=lambda r: cycle(levels, 0, r), dtype=np.float64
+            )
+            _ = float(np.linalg.norm(np.asarray(A @ np.zeros(A.shape[1]))))
+            timer.start()
+            x, iters = linalg.cg(
+                A, b, tol=args.tol, maxiter=args.maxiter, M=M, conv_test_iters=5
+            )
+            total_ms = timer.stop(fence=x)
+        else:
+            import scipy.sparse.linalg as sla
+
+            M = sla.LinearOperator(
+                A.shape, matvec=lambda r: cycle(levels, 0, r), dtype=np.float64
+            )
+            it = [0]
+            timer.start()
+            x, _ = linalg.cg(A, b, rtol=args.tol, maxiter=args.maxiter, M=M,
+                             callback=lambda xk: it.__setitem__(0, it[0] + 1))
+            iters = it[0]
+            total_ms = timer.stop()
+
+    resid = float(np.linalg.norm(np.asarray(A @ x) - b))
+    print(f"Iterations: {iters}  residual: {resid:.3e}")
+    print(f"Iterations / sec: {iters / (total_ms / 1000.0):.3f}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,105 @@
+"""Shared benchmark harness for the examples.
+
+Reference analog: ``examples/benchmark.py`` — Timer protocol (LegateTimer uses
+time futures so timing doesn't synchronize, benchmark.py:18-31), per-phase
+machine scoping (benchmark.py:92-117), and the ``--package legate|cupy|scipy``
+switch (benchmark.py:120-140).
+
+TPU translation:
+  * the future-based timer becomes a fetch-fence timer: ``stop(fence=arr)``
+    pulls one scalar from the last result, which orders the host clock after
+    all device work (jax dispatch is async; plain block_until_ready is not a
+    reliable fence through remote-tunnel platforms);
+  * machine phase scoping becomes ``jax.default_device`` scoping: build
+    phases can run on CPU while solve phases run on the TPU chip;
+  * ``--package sparse_tpu|scipy`` keeps the scipy oracle runnable from every
+    example for comparison runs.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+# allow running the examples straight from the repo checkout
+_repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _repo_root not in sys.path:
+    sys.path.insert(0, _repo_root)
+
+
+class Timer:
+    def start(self):
+        self._t0 = time.perf_counter()
+
+    def stop(self, fence=None) -> float:
+        """Milliseconds since start(). ``fence`` orders the clock after device
+        work by fetching one scalar from the given array."""
+        if fence is not None:
+            _fetch_scalar(fence)
+        return (time.perf_counter() - self._t0) * 1000.0
+
+
+def _fetch_scalar(arr):
+    import numpy as np
+
+    a = arr
+    while getattr(a, "ndim", 0) > 0:
+        a = a[tuple(0 for _ in range(a.ndim))]
+    return float(np.real(np.asarray(a)))
+
+
+def parse_common_args(extra=None):
+    """Returns (args, timer, np_like, sparse, linalg, use_tpu_package)."""
+    parser = argparse.ArgumentParser(add_help=False)
+    parser.add_argument(
+        "--package", default="sparse_tpu", choices=["sparse_tpu", "scipy"]
+    )
+    parser.add_argument(
+        "--precision", default="f64", choices=["f32", "f64"],
+        help="f64 enables x64 (emulated on TPU); f32 is TPU-native",
+    )
+    parser.add_argument("--build-on-cpu", action="store_true",
+                        help="run construction phases on the host CPU device")
+    args, _ = parser.parse_known_args()
+
+    if args.package == "sparse_tpu":
+        import jax
+
+        # honor JAX_PLATFORMS=cpu even when a platform plugin tries to
+        # override it (same pattern as tests/conftest.py)
+        if os.environ.get("JAX_PLATFORMS", "").lower() == "cpu":
+            jax.config.update("jax_platforms", "cpu")
+        if args.precision == "f64":
+            jax.config.update("jax_enable_x64", True)
+        import numpy as np
+
+        import sparse_tpu as sparse
+        from sparse_tpu import linalg
+
+        return args, Timer(), np, sparse, linalg, True
+    else:
+        import numpy as np
+        import scipy.sparse as sparse
+        import scipy.sparse.linalg as linalg
+
+        return args, Timer(), np, sparse, linalg, False
+
+
+def get_phase_procs(use_tpu: bool):
+    """(build_scope, solve_scope) context managers — the machine-scoping
+    analog (benchmark.py:92-117). On TPU: device placement scopes."""
+    import contextlib
+
+    if not use_tpu:
+        return contextlib.nullcontext(), contextlib.nullcontext()
+    import jax
+
+    cpus = jax.devices("cpu") if any(
+        d.platform == "cpu" for d in jax.devices()
+    ) else None
+    accel = jax.devices()[0]
+    build = jax.default_device(cpus[0]) if cpus and accel.platform != "cpu" else contextlib.nullcontext()
+    solve = jax.default_device(accel)
+    return build, solve

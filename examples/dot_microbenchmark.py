@@ -1,0 +1,50 @@
+"""SpMV/SpMM microbenchmark on a banded matrix.
+
+Reference analog: ``examples/dot_microbenchmark.py`` (the BASELINE.md "CSR
+SpMV" row: 10M rows/GPU, 11 nnz/row, f64, iterations/sec).
+
+Run:  python examples/dot_microbenchmark.py -n 10000000 -i 25 --precision f32
+"""
+
+import argparse
+
+from benchmark import get_phase_procs, parse_common_args
+
+parser = argparse.ArgumentParser()
+parser.add_argument("-n", type=int, default=100)
+parser.add_argument("-i", type=int, default=25)
+parser.add_argument("-nnz-per-row", type=int, default=11)
+parser.add_argument("-op", choices=["spmv", "spmm"], default="spmv")
+parser.add_argument("-k", type=int, default=32)
+args, _ = parser.parse_known_args()
+common, timer, np, sparse, _, use_tpu = parse_common_args()
+n, iters, nnz_per_row = args.n, args.i, args.nnz_per_row
+
+init_procs, bench_procs = get_phase_procs(use_tpu)
+
+dtype = np.float32 if (use_tpu and common.precision == "f32") else np.float64
+
+with init_procs:
+    A = sparse.diags(
+        [1] * nnz_per_row,
+        [x - (nnz_per_row // 2) for x in range(nnz_per_row)],
+        shape=(n, n),
+        format="csr",
+        dtype=dtype,
+    )
+
+with bench_procs:
+    if args.op == "spmv":
+        x = np.ones((n,), dtype=dtype)
+    else:
+        x = np.ones((n, args.k), dtype=dtype)
+
+    y = A.dot(x)  # warm up / compile
+    timer.start()
+    for _ in range(iters):
+        y = A.dot(x)
+    total = timer.stop(fence=y) / 1000.0 if use_tpu else timer.stop() / 1000.0
+
+flops = 2 * A.nnz * (1 if args.op == "spmv" else args.k)
+print(f"Iterations / sec: {iters / total:.3f}")
+print(f"GFLOP/s: {flops * iters / total / 1e9:.2f}")

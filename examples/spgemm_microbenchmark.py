@@ -1,0 +1,35 @@
+"""SpGEMM microbenchmark: banded A @ A.
+
+Reference analog: ``examples/spgemm_microbenchmark.py``.
+
+Run:  python examples/spgemm_microbenchmark.py -n 100000 -i 10
+"""
+
+import argparse
+
+from benchmark import parse_common_args
+
+parser = argparse.ArgumentParser()
+parser.add_argument("-n", type=int, default=100)
+parser.add_argument("-i", type=int, default=25)
+parser.add_argument("-nnz-per-row", type=int, default=11)
+args, _ = parser.parse_known_args()
+common, timer, np, sparse, _, use_tpu = parse_common_args()
+n, iters, nnz_per_row = args.n, args.i, args.nnz_per_row
+
+A = sparse.diags(
+    [1] * nnz_per_row,
+    [x - (nnz_per_row // 2) for x in range(nnz_per_row)],
+    shape=(n, n),
+    format="csr",
+    dtype=np.float64,
+)
+B = A.copy()
+
+C = A @ B  # warm up
+timer.start()
+for _ in range(iters):
+    C = A @ B
+total = (timer.stop(fence=C.data) if use_tpu else timer.stop()) / 1000.0
+
+print(f"Iterations / sec: {iters / total:.3f}")

@@ -183,6 +183,20 @@ def cg(
         return _cg_host_loop(A, b, x, tol, maxiter, M, callback, conv_test_iters)
 
     r = b - A.matvec(x)
+    try:
+        return _cg_device_loop(A, b, x, r, tol, maxiter, M, conv_test_iters)
+    except (
+        jax.errors.TracerArrayConversionError,
+        jax.errors.TracerBoolConversionError,
+        jax.errors.ConcretizationTypeError,
+    ):
+        # A or M is a host-side Python operator (e.g. a numpy-based
+        # preconditioner): run the reference-style host loop instead
+        return _cg_host_loop(A, b, x, tol, maxiter, M, None, conv_test_iters)
+
+
+def _cg_device_loop(A, b, x, r, tol, maxiter, M, conv_test_iters):
+    """Whole-solve lax.while_loop: scalars stay on device, one final sync."""
     tol2 = jnp.asarray(tol, dtype=jnp.real(r).dtype) ** 2
 
     def body(state):

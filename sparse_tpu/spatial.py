@@ -1,0 +1,74 @@
+"""Spatial distance computations.
+
+Reference analog: ``sparse/spatial.py:33-85`` — euclidean ``cdist`` via the
+EUCLIDEAN_CDIST task (``src/sparse/spatial/euclidean_distance.*``) launched on
+a 2-D manual processor grid with XA row-tiled over grid-i and XB row-tiled
+over grid-j.
+
+TPU-first redesign: the pairwise-distance matrix is exactly an MXU workload:
+``||a - b||^2 = ||a||^2 + ||b||^2 - 2 a.b`` — one [m, k] x [k, n] matmul plus
+rank-1 row/col corrections, all fused by XLA. The 2-D grid distribution
+becomes a 2-D mesh sharding of the output (see ``parallel.mesh.get_mesh_2d``);
+single-chip here, sharded when inputs carry shardings.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .utils import asjnp
+
+
+@jax.jit
+def _cdist_euclidean(XA, XB):
+    sqa = jnp.sum(XA * XA, axis=1)[:, None]
+    sqb = jnp.sum(XB * XB, axis=1)[None, :]
+    # the MXU term; bf16/f32 inputs hit the systolic array directly
+    cross = XA @ XB.T
+    d2 = jnp.maximum(sqa + sqb - 2.0 * cross, 0.0)
+    return jnp.sqrt(d2)
+
+
+@jax.jit
+def _cdist_sqeuclidean(XA, XB):
+    sqa = jnp.sum(XA * XA, axis=1)[:, None]
+    sqb = jnp.sum(XB * XB, axis=1)[None, :]
+    return jnp.maximum(sqa + sqb - 2.0 * (XA @ XB.T), 0.0)
+
+
+def cdist(XA, XB, metric: str = "euclidean"):
+    """Pairwise distances between rows of XA [m, k] and XB [n, k].
+
+    Reference supports euclidean only (spatial.py:39-43); sqeuclidean and
+    cityblock are cheap extensions.
+    """
+    XA = asjnp(XA)
+    XB = asjnp(XB)
+    if XA.ndim != 2 or XB.ndim != 2:
+        raise ValueError("XA and XB must be 2-dimensional")
+    if XA.shape[1] != XB.shape[1]:
+        raise ValueError(
+            f"XA and XB must have the same number of columns "
+            f"({XA.shape[1]} != {XB.shape[1]})"
+        )
+    if metric == "euclidean":
+        return _cdist_euclidean(XA, XB)
+    if metric == "sqeuclidean":
+        return _cdist_sqeuclidean(XA, XB)
+    if metric == "cityblock":
+        return _cdist_cityblock(XA, XB)
+    raise ValueError(f"unsupported metric {metric!r}")
+
+
+@jax.jit
+def _cdist_cityblock(XA, XB):
+    # accumulate one [m, n] plane per feature — O(m*n) peak memory instead
+    # of materializing the [m, n, k] broadcast difference tensor
+    XA_t, XB_t = XA.T, XB.T  # [k, m], [k, n]
+
+    def body(i, acc):
+        return acc + jnp.abs(XA_t[i][:, None] - XB_t[i][None, :])
+
+    acc0 = jnp.zeros((XA.shape[0], XB.shape[0]), dtype=XA.dtype)
+    return jax.lax.fori_loop(0, XA.shape[1], body, acc0)

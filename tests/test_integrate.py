@@ -1,0 +1,130 @@
+"""solve_ivp tests against scipy ground truth.
+
+Reference analog: the reference tests integrate via the quantum demo; here we
+compare directly with scipy.integrate.solve_ivp on classic systems (the
+SURVEY §4 oracle pattern).
+"""
+
+import numpy as np
+import pytest
+import scipy.integrate as si
+
+from sparse_tpu import integrate
+
+METHODS = ["RK23", "RK45", "DOP853"]
+
+
+def exp_decay(t, y):
+    return -0.5 * y
+
+
+def lotka(t, y):
+    a, b, c, d = 1.5, 1.0, 3.0, 1.0
+    return np.array([a * y[0] - b * y[0] * y[1], -c * y[1] + d * y[0] * y[1]])
+
+
+@pytest.mark.parametrize("method", METHODS)
+def test_exp_decay_vs_scipy(method):
+    ref = si.solve_ivp(exp_decay, (0, 10), [2.0, 4.0], method=method, rtol=1e-8, atol=1e-10)
+    out = integrate.solve_ivp(
+        exp_decay, (0, 10), [2.0, 4.0], method=method, rtol=1e-8, atol=1e-10
+    )
+    assert out.success
+    np.testing.assert_allclose(
+        np.asarray(out.y)[:, -1], ref.y[:, -1], rtol=1e-7
+    )
+    np.testing.assert_allclose(
+        np.asarray(out.y)[:, -1], 2 * np.exp(-5) * np.array([1.0, 2.0]), rtol=1e-6
+    )
+
+
+@pytest.mark.parametrize("method", METHODS)
+def test_lotka_volterra_t_eval(method):
+    t_eval = np.linspace(0, 10, 31)
+    ref = si.solve_ivp(
+        lotka, (0, 10), [10.0, 5.0], method=method, t_eval=t_eval, rtol=1e-9, atol=1e-11
+    )
+    out = integrate.solve_ivp(
+        lotka, (0, 10), [10.0, 5.0], method=method, t_eval=t_eval, rtol=1e-9, atol=1e-11
+    )
+    np.testing.assert_allclose(out.t, ref.t)
+    np.testing.assert_allclose(np.asarray(out.y), ref.y, rtol=1e-5, atol=1e-7)
+
+
+@pytest.mark.parametrize("method", METHODS)
+def test_dense_output(method):
+    out = integrate.solve_ivp(
+        exp_decay, (0, 5), [1.0], method=method, dense_output=True, rtol=1e-9, atol=1e-11
+    )
+    tq = np.linspace(0, 5, 17)
+    yq = np.asarray(out.sol(tq))
+    np.testing.assert_allclose(yq[0], np.exp(-0.5 * tq), rtol=1e-6)
+
+
+@pytest.mark.parametrize("method", METHODS)
+def test_complex_oscillator(method):
+    # dy/dt = -i y  -> y = exp(-i t): the quantum-evolution shape (SURVEY §3.5)
+    out = integrate.solve_ivp(
+        lambda t, y: -1j * y,
+        (0, 2 * np.pi),
+        np.array([1.0 + 0j]),
+        method=method,
+        rtol=1e-9,
+        atol=1e-11,
+    )
+    np.testing.assert_allclose(np.asarray(out.y)[0, -1], 1.0 + 0j, atol=1e-5)
+
+
+def test_event_terminal():
+    def hit_ground(t, y):
+        return y[0]
+
+    hit_ground.terminal = True
+    hit_ground.direction = -1
+
+    def cannon(t, y):
+        return np.array([y[1], -9.8])
+
+    out = integrate.solve_ivp(
+        cannon, (0, 100), [0.0, 10.0], events=hit_ground, rtol=1e-9, atol=1e-11
+    )
+    assert out.status == 1
+    # ballistic flight time 2*v/g
+    np.testing.assert_allclose(out.t_events[0][0], 2 * 10.0 / 9.8, rtol=1e-6)
+    ref = si.solve_ivp(
+        cannon, (0, 100), [0.0, 10.0], events=hit_ground, rtol=1e-9, atol=1e-11
+    )
+    np.testing.assert_allclose(out.t_events[0], ref.t_events[0], rtol=1e-6)
+
+
+def test_backward_integration():
+    out = integrate.solve_ivp(exp_decay, (10, 0), [2 * np.exp(-5)], rtol=1e-9, atol=1e-11)
+    assert out.success
+    np.testing.assert_allclose(np.asarray(out.y)[0, -1], 2.0, rtol=1e-6)
+
+
+def test_sparse_matvec_rhs():
+    """ODE whose RHS is a sparse SpMV — the quantum-evolution composition."""
+    import sparse_tpu
+
+    H = sparse_tpu.diags(
+        [np.full(9, 1.0), np.full(10, -2.0), np.full(9, 1.0)], [-1, 0, 1]
+    ).tocsr()
+    y0 = np.zeros(10)
+    y0[5] = 1.0
+
+    out = integrate.solve_ivp(
+        lambda t, y: H @ y, (0, 1), y0, method="RK45", rtol=1e-9, atol=1e-11
+    )
+    import scipy.sparse as sp
+
+    Hs = sp.diags([np.full(9, 1.0), np.full(10, -2.0), np.full(9, 1.0)], [-1, 0, 1]).tocsr()
+    ref = si.solve_ivp(lambda t, y: Hs @ y, (0, 1), y0, method="RK45", rtol=1e-9, atol=1e-11)
+    np.testing.assert_allclose(np.asarray(out.y)[:, -1], ref.y[:, -1], rtol=1e-6, atol=1e-9)
+
+
+def test_args_passing():
+    out = integrate.solve_ivp(
+        lambda t, y, k: -k * y, (0, 1), [1.0], args=(2.0,), rtol=1e-9, atol=1e-11
+    )
+    np.testing.assert_allclose(np.asarray(out.y)[0, -1], np.exp(-2.0), rtol=1e-6)

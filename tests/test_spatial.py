@@ -1,0 +1,44 @@
+"""cdist tests vs scipy (reference: tests/integration/test_spatial.py)."""
+
+import numpy as np
+import pytest
+import scipy.spatial.distance as sd
+
+from sparse_tpu import spatial
+
+
+@pytest.mark.parametrize("m,n,k", [(10, 7, 3), (33, 33, 8), (1, 5, 2)])
+def test_cdist_euclidean(m, n, k):
+    rng = np.random.default_rng(0)
+    XA = rng.standard_normal((m, k))
+    XB = rng.standard_normal((n, k))
+    np.testing.assert_allclose(
+        np.asarray(spatial.cdist(XA, XB)), sd.cdist(XA, XB), rtol=1e-10, atol=1e-12
+    )
+
+
+def test_cdist_sqeuclidean_cityblock():
+    rng = np.random.default_rng(1)
+    XA = rng.standard_normal((9, 4))
+    XB = rng.standard_normal((6, 4))
+    np.testing.assert_allclose(
+        np.asarray(spatial.cdist(XA, XB, "sqeuclidean")),
+        sd.cdist(XA, XB, "sqeuclidean"),
+        rtol=1e-10,
+        atol=1e-12,
+    )
+    np.testing.assert_allclose(
+        np.asarray(spatial.cdist(XA, XB, "cityblock")),
+        sd.cdist(XA, XB, "cityblock"),
+        rtol=1e-10,
+        atol=1e-12,
+    )
+
+
+def test_cdist_errors():
+    with pytest.raises(ValueError):
+        spatial.cdist(np.zeros((3, 2)), np.zeros((3, 4)))
+    with pytest.raises(ValueError):
+        spatial.cdist(np.zeros(3), np.zeros((3, 4)))
+    with pytest.raises(ValueError):
+        spatial.cdist(np.zeros((3, 2)), np.zeros((3, 2)), metric="cosine")
