@@ -40,4 +40,4 @@ csc_matrix = csc_array
 coo_matrix = coo_array
 dia_matrix = dia_array
 
-from . import integrate, io, linalg, spatial  # noqa: F401,E402
+from . import integrate, io, linalg, quantum, spatial  # noqa: F401,E402

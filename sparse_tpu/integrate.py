@@ -207,7 +207,7 @@ class RungeKutta(OdeSolver):
                 self.direction,
                 self.error_estimator_order,
                 self.rtol,
-                np.atleast_1d(self.atol).mean() if np.ndim(self.atol) else self.atol,
+                self.atol,  # full (possibly per-component) tolerances
             )
             self.nfev += 1
         else:
@@ -539,17 +539,23 @@ class OdeSolution:
 # ---------------------------------------------------------------------------
 # Event handling
 # ---------------------------------------------------------------------------
-def prepare_events(events):
+def prepare_events(events, args=()):
     if callable(events):
         events = (events,)
     if events is None:
         return None, None, None
     is_terminal = np.empty(len(events), dtype=bool)
     direction = np.empty(len(events))
+    wrapped = []
     for i, event in enumerate(events):
         is_terminal[i] = bool(getattr(event, "terminal", False))
         direction[i] = getattr(event, "direction", 0)
-    return events, is_terminal, direction
+        if args:
+            # scipy contract: events receive the same extra args as fun
+            wrapped.append(lambda t, y, event=event: event(t, y, *args))
+        else:
+            wrapped.append(event)
+    return wrapped, is_terminal, direction
 
 
 def solve_event_equation(event, sol, t_old, t):
@@ -644,7 +650,6 @@ def solve_ivp(
             raise ValueError("Values in `t_eval` are not properly sorted.")
         if tf < t0:
             t_eval = t_eval[::-1]
-        t_eval_i = 0
 
     if isinstance(method, str):
         method = METHODS[method]
@@ -658,7 +663,7 @@ def solve_ivp(
         ys = []
     interpolants = []
 
-    events, is_terminal, event_dir = prepare_events(events)
+    events, is_terminal, event_dir = prepare_events(events, args or ())
     if events is not None:
         g = [float(np.asarray(event(t0, y0))) for event in events]
         t_events = [[] for _ in range(len(events))]

@@ -30,6 +30,36 @@ def _parse_header(line: str):
     return fmt, field, symmetry
 
 
+def _parse_coordinate_body(f, nnz: int, field: str):
+    """(rows, cols, vals) from the coordinate body — native tokenizer when
+    available (the READ_MTX_TO_COO analog, mtx_to_coo.cc:44-145), numpy
+    loadtxt fallback."""
+    from . import native
+
+    kind = {"pattern": 0, "complex": 2}.get(field, 1)
+    if nnz and native.lib() is not None:
+        parsed = native.parse_mtx_body(f.read().encode(), nnz, kind)
+        if parsed is not None:
+            rows, cols, re, im = parsed
+            vals = re + 1j * im if field == "complex" else re
+            return rows, cols, vals
+        raise ValueError(
+            f"MatrixMarket body does not contain exactly {nnz} entries"
+        )
+    body = np.loadtxt(f, ndmin=2) if nnz else np.zeros((0, 3))
+    if body.shape[0] != nnz:
+        raise ValueError(f"expected {nnz} entries, found {body.shape[0]}")
+    rows = body[:, 0].astype(np.int64) - 1
+    cols = body[:, 1].astype(np.int64) - 1
+    if field == "pattern":
+        vals = np.ones((nnz,), dtype=np.float64)
+    elif field == "complex":
+        vals = body[:, 2] + 1j * body[:, 3]
+    else:
+        vals = body[:, 2]
+    return rows, cols, vals
+
+
 def mmread(path) -> coo_array:
     """Read a MatrixMarket file into a COO array (reference io.py:24)."""
     with open(path, "r") as f:
@@ -42,21 +72,7 @@ def mmread(path) -> coo_array:
         dims = line.split()
         if fmt == "coordinate":
             m, n, nnz = int(dims[0]), int(dims[1]), int(dims[2])
-            body = np.loadtxt(f, ndmin=2) if nnz else np.zeros((0, 3))
-            if body.shape[0] != nnz:
-                raise ValueError(
-                    f"expected {nnz} entries, found {body.shape[0]}"
-                )
-            rows = body[:, 0].astype(np.int64) - 1
-            cols = body[:, 1].astype(np.int64) - 1
-            if field == "pattern":
-                vals = np.ones((nnz,), dtype=np.float64)
-            elif field == "complex":
-                vals = body[:, 2] + 1j * body[:, 3]
-            elif field == "integer":
-                vals = body[:, 2]
-            else:
-                vals = body[:, 2]
+            rows, cols, vals = _parse_coordinate_body(f, nnz, field)
         else:  # dense "array" format, column-major
             m, n = int(dims[0]), int(dims[1])
             body = np.loadtxt(f, ndmin=2)

@@ -1,0 +1,151 @@
+"""Loader for the native runtime library (``src/sparse_tpu_native.cc``).
+
+Reference analog: ``sparse/config.py:21-58`` (``LegateSparseLib`` loading
+``liblegate_sparse.so`` and exposing its C ABI through CFFI). Here the native
+surface is small — host-side work outside the XLA compute path (bitset BFS
+expansion, MatrixMarket tokenizing) — and is bound with ctypes. The library
+is compiled on first use with g++ -O3 into the package directory; every
+caller must handle ``lib() is None`` (pure-numpy fallback), so missing
+toolchains degrade gracefully.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+_lock = threading.Lock()
+_lib = None
+_tried = False
+
+_PKG_DIR = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(os.path.dirname(_PKG_DIR), "src", "sparse_tpu_native.cc")
+_SO = os.path.join(_PKG_DIR, "_sparse_tpu_native.so")
+
+
+def _build() -> str | None:
+    if not os.path.exists(_SRC):
+        return None
+    if os.path.exists(_SO) and os.path.getmtime(_SO) >= os.path.getmtime(_SRC):
+        return _SO
+    try:
+        subprocess.run(
+            ["g++", "-O3", "-march=native", "-shared", "-fPIC", "-o", _SO, _SRC],
+            check=True,
+            capture_output=True,
+            timeout=120,
+        )
+        return _SO
+    except (OSError, subprocess.SubprocessError):
+        return None
+
+
+def lib():
+    """The loaded CDLL, or None when no native library is available."""
+    global _lib, _tried
+    if _tried:
+        return _lib
+    with _lock:
+        if _tried:
+            return _lib
+        path = os.environ.get("SPARSE_TPU_NATIVE_LIB") or _build()
+        if path and os.path.exists(path):
+            try:
+                cdll = ctypes.CDLL(path)
+                _declare(cdll)
+                _lib = cdll
+            except OSError:
+                _lib = None
+        _tried = True
+    return _lib
+
+
+def _declare(cdll) -> None:
+    i64 = ctypes.c_int64
+    u64p = ctypes.POINTER(ctypes.c_uint64)
+    i64p = ctypes.POINTER(ctypes.c_int64)
+    f64p = ctypes.POINTER(ctypes.c_double)
+    cdll.ind_sets_count.restype = i64
+    cdll.ind_sets_count.argtypes = [u64p, i64, i64]
+    cdll.ind_sets_expand.restype = None
+    cdll.ind_sets_expand.argtypes = [u64p, u64p, u64p, i64, i64, i64, u64p, u64p]
+    cdll.mtx_parse_body.restype = i64
+    cdll.mtx_parse_body.argtypes = [
+        ctypes.c_char_p, i64, i64, ctypes.c_int32, i64p, i64p, f64p, f64p,
+    ]
+    cdll.mtx_parse_dense.restype = i64
+    cdll.mtx_parse_dense.argtypes = [ctypes.c_char_p, i64, i64, f64p]
+
+
+def _as_u64p(a):
+    import numpy as np
+
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64))
+
+
+def expand_level(sets, queues, comp_gt, n):
+    """Native BFS level expansion; raises if the library is unavailable."""
+    import numpy as np
+
+    L = lib()
+    if L is None:
+        raise RuntimeError("native library unavailable")
+    S, W = queues.shape
+    sets = np.ascontiguousarray(sets)
+    queues = np.ascontiguousarray(queues)
+    comp_gt = np.ascontiguousarray(comp_gt)
+    count = L.ind_sets_count(_as_u64p(queues), S, W)
+    new_sets = np.empty((count, W), dtype=np.uint64)
+    new_queues = np.empty((count, W), dtype=np.uint64)
+    L.ind_sets_expand(
+        _as_u64p(sets), _as_u64p(queues), _as_u64p(comp_gt),
+        S, W, n, _as_u64p(new_sets), _as_u64p(new_queues),
+    )
+    return new_sets, new_queues
+
+
+def parse_mtx_body(body: bytes, nnz: int, kind: int):
+    """Native coordinate-body parse -> (rows, cols, re, im) or None.
+
+    Parses with room for one extra entry so a body that declares nnz entries
+    but holds more is rejected (matching the numpy fallback) instead of
+    silently truncated.
+    """
+    import numpy as np
+
+    L = lib()
+    if L is None:
+        return None
+    cap = nnz + 1
+    rows = np.empty(cap, dtype=np.int64)
+    cols = np.empty(cap, dtype=np.int64)
+    re = np.empty(cap, dtype=np.float64)
+    im = np.zeros(cap, dtype=np.float64)
+    got = L.mtx_parse_body(
+        body, len(body), cap, kind,
+        rows.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        cols.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        re.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+        im.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+    )
+    if got != nnz:
+        return None  # wrong entry count: caller raises the clear error
+    return rows[:nnz], cols[:nnz], re[:nnz], im[:nnz]
+
+
+def parse_mtx_dense(body: bytes, count: int):
+    import numpy as np
+
+    L = lib()
+    if L is None:
+        return None
+    out = np.empty(count, dtype=np.float64)
+    got = L.mtx_parse_dense(
+        body, len(body), count,
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+    )
+    if got != count:
+        return None
+    return out

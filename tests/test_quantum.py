@@ -1,0 +1,126 @@
+"""Quantum MIS Hamiltonian tests against brute-force oracles.
+
+Reference analog: the quantum workload (SURVEY §3.5). The oracle here is a
+direct itertools enumeration of independent sets.
+"""
+
+from itertools import combinations
+
+import networkx as nx
+import numpy as np
+import pytest
+
+from sparse_tpu import quantum
+
+
+def brute_independent_sets(graph, k):
+    nodes = list(graph.nodes)
+    out = []
+    for comb in combinations(nodes, k):
+        if not any(graph.has_edge(u, v) for u, v in combinations(comb, 2)):
+            out.append(frozenset(comb))
+    return set(out)
+
+
+def sets_to_frozensets(sets, n):
+    B = quantum._bits_to_bool(sets, n)
+    return [frozenset(np.nonzero(row)[0].tolist()) for row in B]
+
+
+GRAPHS = [
+    nx.cycle_graph(6),
+    nx.path_graph(7),
+    nx.complete_graph(5),
+    nx.erdos_renyi_graph(10, 0.4, seed=3),
+    nx.empty_graph(4),
+]
+
+
+@pytest.mark.parametrize("graph", GRAPHS)
+def test_enumeration_matches_bruteforce(graph):
+    n = graph.number_of_nodes()
+    sets = queues = None
+    for k in range(1, n + 1):
+        sets, queues = quantum.enumerate_independent_sets(graph, k, sets, queues)
+        expect = brute_independent_sets(graph, k)
+        got = sets_to_frozensets(sets, n)
+        assert len(got) == len(set(got)), "duplicate sets"
+        assert set(got) == expect, f"k={k}"
+        if sets.shape[0] == 0 or quantum.popcount(queues).sum() == 0:
+            break
+
+
+@pytest.mark.parametrize("graph", GRAPHS)
+def test_independence_polynomial(graph):
+    n = graph.number_of_nodes()
+    ip = quantum.independence_polynomial(graph)
+    expect = [1]
+    for k in range(1, n + 1):
+        cnt = len(brute_independent_sets(graph, k))
+        if cnt == 0:
+            break
+        expect.append(cnt)
+    assert ip == expect
+
+
+def test_driver_hamiltonian_structure():
+    g = nx.cycle_graph(5)
+    drv = quantum.HamiltonianDriver(graph=g)
+    H = drv.hamiltonian
+    nstates = drv.nstates
+    assert H.shape == (nstates, nstates)
+    Hd = np.asarray(H.toarray())
+    # symmetric 0/1 matrix
+    np.testing.assert_array_equal(Hd, Hd.T)
+    assert set(np.unique(Hd.real)) <= {0.0, 1.0}
+    # every size-k set connects to exactly k subsets + supersets:
+    # row degree of a state of size k is k + #extensions; check total edge
+    # count = 2 * sum_k k * ip[k]
+    expected_edges = 2 * sum(k * c for k, c in enumerate(drv.ip))
+    assert H.nnz == expected_edges
+    # no diagonal entries
+    assert np.all(Hd.diagonal() == 0)
+
+
+def test_mis_hamiltonian_diagonal():
+    g = nx.cycle_graph(5)
+    drv = quantum.HamiltonianDriver(graph=g)
+    mis = quantum.HamiltonianMIS(graph=g, poly=drv.ip)
+    assert mis.nstates == drv.nstates
+    d = np.asarray(mis.hamiltonian.toarray()).real
+    np.testing.assert_array_equal(d, np.diag(d.diagonal()))
+    # C5 has MIS size 2
+    assert mis.optimum == 2.0
+    assert mis.minimum_energy == 0.0
+    # last state is the null state (level 0)
+    assert d[-1, -1] == 0.0
+
+
+def test_driver_levels_consistent_with_mis_ordering():
+    """The flipped state ordering must agree between driver and MIS diag:
+    states connected by the driver differ by exactly one in MIS cost."""
+    g = nx.erdos_renyi_graph(8, 0.35, seed=1)
+    drv = quantum.HamiltonianDriver(graph=g)
+    mis = quantum.HamiltonianMIS(graph=g, poly=drv.ip)
+    C = np.asarray(mis.hamiltonian.toarray()).real.diagonal()
+    H = drv.hamiltonian.tocoo()
+    rows, cols = np.asarray(H.row), np.asarray(H.col)
+    assert np.all(np.abs(C[rows] - C[cols]) == 1)
+
+
+def test_evolution_preserves_norm():
+    """-i H evolution through solve_ivp keeps the state normalized."""
+    from sparse_tpu import integrate
+
+    g = nx.cycle_graph(6)
+    drv = quantum.HamiltonianDriver(graph=g, dtype=np.complex128)
+    H = drv.hamiltonian
+    y0 = np.zeros(drv.nstates, dtype=np.complex128)
+    y0[-1] = 1.0  # start in the null state
+    out = integrate.solve_ivp(
+        lambda t, y: -1j * (H @ y), (0, 1.0), y0, method="DOP853",
+        rtol=1e-9, atol=1e-11,
+    )
+    assert out.success
+    norms = np.linalg.norm(np.asarray(out.y), axis=0)
+    np.testing.assert_allclose(norms, 1.0, rtol=1e-7)
