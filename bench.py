@@ -29,19 +29,19 @@ def _sync(out):
     jax.block_until_ready is not a reliable fence through remote-tunnel
     platforms (axon), so timing fences on a host fetch of the rho scalar.
     """
-    return float(out[3])
+    return float(out[-1])
 
 
 def run_size(n: int, iters: int):
-    from sparse_tpu.models.poisson import cg_ell, poisson_cg_state
+    from sparse_tpu.models.poisson import cg_dia, poisson_cg_state_dia
 
-    state = poisson_cg_state(n)
-    out = cg_ell(state[0], state[1], *state[2:], iters=iters)  # compile+warm
+    state, step = poisson_cg_state_dia(n)
+    out = cg_dia(step, *state, iters=iters)  # compile + warm up
     _sync(out)
     best = 0.0
     for _ in range(3):
         t0 = time.perf_counter()
-        out = cg_ell(state[0], state[1], *state[2:], iters=iters)
+        out = cg_dia(step, *state, iters=iters)
         _sync(out)
         dt = time.perf_counter() - t0
         best = max(best, iters / dt)

@@ -43,6 +43,11 @@ class Settings:
     # Max nnz/row (relative to mean) at which the padded-row (ELL) SpMV fast path kicks
     # in when spmv_mode == 'auto'.
     ell_max_ratio: float = 4.0
+    # Banded auto-detection for CSR SpMV: matrices with at most this many
+    # distinct diagonals (and bounded fill blowup) route through the
+    # zero-gather DIA kernel.
+    dia_max_diags: int = 32
+    dia_max_fill: float = 4.0
 
 
 settings = Settings()

@@ -72,6 +72,7 @@ class csr_array(SparseArray):
         self._shape = (int(shape[0]), int(shape[1]))
         self._dtype = np.dtype(self.data.dtype)
         self._ell = None  # lazy (ell_indices, ell_data) cache
+        self._dia = False  # False = unchecked, None = not banded, else planes
         self._balanced_splits = None
 
     @classmethod
@@ -83,6 +84,7 @@ class csr_array(SparseArray):
         obj._shape = (int(shape[0]), int(shape[1]))
         obj._dtype = np.dtype(obj.data.dtype)
         obj._ell = None
+        obj._dia = False
         obj._balanced_splits = None
         return obj
 
@@ -183,7 +185,51 @@ class csr_array(SparseArray):
                 raise ValueError("out has the wrong shape")
         return y
 
+    def _maybe_dia(self):
+        """Detect banded structure and cache DIA planes for zero-gather SpMV.
+
+        Matrices living on a handful of diagonals (every reference
+        benchmark: Laplacians, the 11-diag microbench) skip index gathers
+        entirely — SpMV becomes shifted vector adds (ops.dia_spmv). Pure
+        structure detection (mode-independent; _spmv applies the mode);
+        one host sync at first use, result cached (None = not banded).
+        """
+        if self._dia is not False:
+            return self._dia
+        self._dia = None
+        m, n = self.shape
+        nnz = self.nnz
+        if nnz == 0:
+            return None
+        rows = expand_rows(self.indptr, nnz)
+        # bounded-size unique: >max_diags distinct offsets still yields
+        # max_diags+1 values, which the gate below rejects
+        offs_dev = jnp.unique(self.indices.astype(jnp.int64) - rows.astype(jnp.int64),
+                              size=min(settings.dia_max_diags + 1, nnz),
+                              fill_value=jnp.iinfo(jnp.int32).max)
+        offs = np.unique(np.asarray(offs_dev))
+        offs = offs[offs != np.iinfo(np.int32).max]
+        D = len(offs)
+        if D > settings.dia_max_diags or D * n > settings.dia_max_fill * nnz:
+            return None
+        from .dia import _coo_to_dia  # duplicate-summing plane build
+
+        planes, offsets, _ = _coo_to_dia(self.tocoo())
+        self._dia = (planes, tuple(int(o) for o in offsets))
+        return self._dia
+
     def _spmv(self, x):
+        mode = settings.spmv_mode
+        if mode in ("auto", "pallas"):
+            dia = self._maybe_dia()
+            if dia is not None:
+                if mode == "pallas":
+                    from .kernels.dia_spmv import dia_spmv_pallas
+
+                    return dia_spmv_pallas(dia[0], dia[1], x, self.shape)
+                from .ops.dia_spmv import dia_spmv_xla
+
+                return dia_spmv_xla(dia[0], dia[1], x, self.shape)
         ell = self._maybe_ell()
         if ell is not None:
             return spmv_ops.csr_spmv_ell(ell[0], ell[1], x)

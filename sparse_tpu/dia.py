@@ -134,8 +134,31 @@ class dia_array(SparseArray):
     def T(self):
         return self.transpose()
 
-    # -- arithmetic (route through CSR) ------------------------------------
+    # -- arithmetic --------------------------------------------------------
     def dot(self, other):
+        """SpMV stays in DIA: the diagonal layout needs no gathers at all
+        (ops.dia_spmv — shifted vector adds). Everything else routes
+        through CSR."""
+        x = other
+        if not isinstance(x, SparseArray):
+            x = asjnp(x)
+            # fast path requires scipy-width data planes (data.shape[1] == n);
+            # transpose of a non-square matrix can leave wider planes
+            if (
+                x.ndim == 1
+                and x.shape[0] == self.shape[1]
+                and self.data.shape[1] == self.shape[1]
+            ):
+                from .config import settings
+
+                offs = tuple(int(o) for o in self.offsets)
+                if settings.spmv_mode == "pallas":
+                    from .kernels.dia_spmv import dia_spmv_pallas
+
+                    return dia_spmv_pallas(self.data, offs, x, self.shape)
+                from .ops.dia_spmv import dia_spmv_xla
+
+                return dia_spmv_xla(self.data, offs, x, self.shape)
         return self.tocsr().dot(other)
 
     def _rdot(self, other):

@@ -7,9 +7,13 @@ share one implementation.
 """
 
 from .poisson import (  # noqa: F401
+    cg_dia,
     cg_ell,
     cg_step_ell,
     laplacian_2d_csr,
+    laplacian_2d_dia,
     laplacian_2d_ell,
+    make_cg_step_dia,
     poisson_cg_state,
+    poisson_cg_state_dia,
 )

@@ -1,0 +1,122 @@
+"""DIA SpMV kernels (XLA + Pallas-interpret) and the CSR banded fast path."""
+
+import numpy as np
+import pytest
+import scipy.sparse as sp
+
+import sparse_tpu
+from sparse_tpu.config import settings
+from sparse_tpu.kernels.dia_spmv import dia_spmv_pallas
+from sparse_tpu.ops.dia_spmv import dia_spmv_xla
+
+CASES = [
+    (50, 50, [-5, -1, 0, 1, 5]),
+    (40, 60, [-3, 0, 2, 10]),
+    (60, 40, [-7, 0, 1]),
+    (7, 7, [0]),
+    (300, 300, [-17, -1, 0, 1, 17]),
+]
+
+
+@pytest.mark.parametrize("m,n,offs", CASES)
+def test_dia_spmv_xla(m, n, offs):
+    rng = np.random.default_rng(m + n)
+    data = rng.standard_normal((len(offs), n))
+    s = sp.dia_matrix((data, offs), shape=(m, n))
+    x = rng.standard_normal(n)
+    got = np.asarray(dia_spmv_xla(data, tuple(offs), x, (m, n)))
+    np.testing.assert_allclose(got, s @ x, rtol=1e-12, atol=1e-12)
+
+
+@pytest.mark.parametrize("m,n,offs", CASES)
+def test_dia_spmv_pallas_interpret(m, n, offs):
+    rng = np.random.default_rng(m)
+    data = rng.standard_normal((len(offs), n))
+    s = sp.dia_matrix((data, offs), shape=(m, n))
+    x = rng.standard_normal(n)
+    got = np.asarray(
+        dia_spmv_pallas(data, tuple(offs), x, (m, n), interpret=True)
+    )
+    np.testing.assert_allclose(got, s @ x, rtol=1e-12, atol=1e-12)
+
+
+def test_dia_array_dot_uses_dia_path():
+    offs = [-2, 0, 3]
+    data = np.random.default_rng(0).standard_normal((3, 30))
+    s = sp.dia_matrix((data, offs), shape=(30, 30))
+    A = sparse_tpu.dia_array((data, offs), shape=(30, 30))
+    x = np.random.default_rng(1).standard_normal(30)
+    np.testing.assert_allclose(np.asarray(A @ x), s @ x, rtol=1e-12)
+
+
+def test_csr_banded_autodetect():
+    s = sp.diags([1.0, -2.0, 1.0], [-1, 0, 1], shape=(64, 64), format="csr")
+    A = sparse_tpu.csr_array(s)
+    assert A._maybe_dia() is not None  # detected as banded
+    x = np.random.default_rng(2).standard_normal(64)
+    np.testing.assert_allclose(np.asarray(A @ x), s @ x, rtol=1e-12)
+
+
+def test_csr_unbanded_rejects_dia():
+    from .utils.sample import sample_csr
+
+    s = sample_csr(80, 80, density=0.3, seed=1)
+    A = sparse_tpu.csr_array(s)
+    assert A._maybe_dia() is None  # ~everything is a distinct diagonal
+    x = np.random.default_rng(3).standard_normal(80)
+    np.testing.assert_allclose(np.asarray(A @ x), s @ x, rtol=1e-10)
+
+
+def test_with_data_invalidates_dia_cache():
+    s = sp.diags([1.0, -2.0, 1.0], [-1, 0, 1], shape=(32, 32), format="csr")
+    A = sparse_tpu.csr_array(s)
+    _ = A._maybe_dia()
+    B = A * 2.0
+    x = np.random.default_rng(4).standard_normal(32)
+    np.testing.assert_allclose(np.asarray(B @ x), 2.0 * (s @ x), rtol=1e-12)
+
+
+def test_dia_transpose_nonsquare_dot():
+    # transpose leaves wider data planes; must fall back to CSR, not crash
+    A = sparse_tpu.dia_array((np.ones((1, 60)), [0]), shape=(40, 60))
+    At = A.T
+    got = np.asarray(At @ np.ones(40))
+    want = sp.dia_matrix((np.ones((1, 60)), [0]), shape=(40, 60)).T @ np.ones(40)
+    np.testing.assert_allclose(got, want)
+
+
+def test_dia_pallas_wide_matrix():
+    m, n, offs = 100, 390, (0, 5)
+    rng = np.random.default_rng(9)
+    data = rng.standard_normal((2, n))
+    s = sp.dia_matrix((data, offs), shape=(m, n))
+    x = rng.standard_normal(n)
+    got = np.asarray(dia_spmv_pallas(data, offs, x, (m, n), interpret=True))
+    np.testing.assert_allclose(got, s @ x, rtol=1e-12, atol=1e-12)
+
+
+def test_spmv_mode_ell_overrides_dia():
+    s = sp.diags([1.0, -2.0, 1.0], [-1, 0, 1], shape=(32, 32), format="csr")
+    A = sparse_tpu.csr_array(s)
+    x = np.random.default_rng(5).standard_normal(32)
+    old = settings.spmv_mode
+    try:
+        settings.spmv_mode = "ell"
+        np.testing.assert_allclose(np.asarray(A @ x), s @ x, rtol=1e-12)
+        settings.spmv_mode = "segment"
+        np.testing.assert_allclose(np.asarray(A @ x), s @ x, rtol=1e-12)
+        settings.spmv_mode = "auto"
+        np.testing.assert_allclose(np.asarray(A @ x), s @ x, rtol=1e-12)
+    finally:
+        settings.spmv_mode = old
+
+
+def test_csr_duplicate_entries_dia_path_sums():
+    # non-canonical CSR with duplicate (i, j): banded fast path must sum
+    indptr = np.array([0, 2, 3])
+    indices = np.array([0, 0, 1])
+    data = np.array([1.0, 2.0, 5.0])
+    A = sparse_tpu.csr_array.from_parts(data, indices, indptr, (2, 2))
+    assert A._maybe_dia() is not None
+    got = np.asarray(A @ np.array([1.0, 1.0]))
+    np.testing.assert_allclose(got, [3.0, 5.0])
