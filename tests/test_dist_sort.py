@@ -1,0 +1,52 @@
+"""Distributed odd-even block sort (SORT_BY_KEY analog) on the CPU mesh."""
+
+import numpy as np
+import pytest
+import scipy.sparse as sp
+
+from sparse_tpu.parallel.sort import coo_to_csr_distributed, dist_sort_host
+
+
+@pytest.mark.parametrize("num_shards", [1, 2, 3, 8])
+@pytest.mark.parametrize("n", [0, 1, 7, 100, 1000])
+def test_dist_sort_random(num_shards, n):
+    rng = np.random.default_rng(n + num_shards)
+    keys = rng.integers(0, 10_000, size=n).astype(np.int64)
+    payload = rng.standard_normal(n)
+    sk, (spay,) = dist_sort_host(keys, (payload,), num_shards)
+    order = np.argsort(keys, kind="stable")
+    np.testing.assert_array_equal(sk, keys[order])
+    # same multiset of (key, payload) pairs, keys sorted
+    got = sorted(zip(sk.tolist(), spay.tolist()))
+    want = sorted(zip(keys.tolist(), payload.tolist()))
+    assert got == want
+
+
+@pytest.mark.parametrize("num_shards", [2, 8])
+def test_dist_sort_with_duplicates(num_shards):
+    rng = np.random.default_rng(0)
+    keys = rng.integers(0, 10, size=500).astype(np.int64)
+    payload = np.arange(500, dtype=np.float64)
+    sk, (spay,) = dist_sort_host(keys, (payload,), num_shards)
+    np.testing.assert_array_equal(sk, np.sort(keys))
+    assert set(spay.tolist()) == set(payload.tolist())
+
+
+@pytest.mark.parametrize("num_shards", [1, 3, 8])
+def test_coo_to_csr_distributed(num_shards):
+    rng = np.random.default_rng(1)
+    m, n, nnz = 40, 37, 300
+    rows = rng.integers(0, m, nnz)
+    cols = rng.integers(0, n, nnz)
+    vals = rng.standard_normal(nnz)
+    A = coo_to_csr_distributed(rows, cols, vals, (m, n), num_shards)
+    want = sp.coo_matrix((vals, (rows, cols)), shape=(m, n)).tocsr().toarray()
+    np.testing.assert_allclose(np.asarray(A.toarray()), want, rtol=1e-12)
+
+
+def test_coo_to_csr_distributed_empty():
+    A = coo_to_csr_distributed(
+        np.zeros(0, np.int64), np.zeros(0, np.int64), np.zeros(0), (5, 4), 8
+    )
+    assert A.nnz == 0
+    assert A.shape == (5, 4)
