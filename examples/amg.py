@@ -34,6 +34,12 @@ parser.add_argument("-max_coarse", type=int, default=10)
 parser.add_argument("-maxiter", type=int, default=None)
 parser.add_argument("-tol", type=float, default=1e-8)
 parser.add_argument("-verbose", action="store_true")
+parser.add_argument(
+    "-dist",
+    action="store_true",
+    help="build the hierarchy with mesh-distributed SpGEMM (Galerkin R@A@P) "
+    "and solve with a distributed V-cycle-preconditioned CG over the mesh",
+)
 args, _ = parser.parse_known_args()
 common, timer, _np, sparse, linalg, use_tpu = parse_common_args()
 
@@ -41,6 +47,17 @@ if use_tpu:
     import jax.numpy as jnp
 else:
     jnp = np
+
+
+def spg(X, Y):
+    """Sparse @ sparse, routed through the mesh-distributed row-gather
+    SpGEMM (parallel.spgemm.dist_spgemm; reference csr.py:1390-1490) under
+    -dist."""
+    if args.dist and use_tpu:
+        from sparse_tpu.parallel import dist_spgemm
+
+        return dist_spgemm(X.tocsr(), Y.tocsr())
+    return X @ Y
 
 
 # ---------------------------------------------------------------------------
@@ -172,7 +189,7 @@ def smooth_prolongator(A, T, k=1, omega=4.0 / 3.0, D=None):
     D_inv_S = D_inv_S * (omega / rho)
     P = T.tocsr()
     for _ in range(k):
-        P = P - (D_inv_S @ P)
+        P = P - spg(D_inv_S, P)
     return P, rho
 
 
@@ -259,7 +276,7 @@ def build_hierarchy(A, B, theta=0.0, max_coarse=10):
         P, rho = smooth_prolongator(A, T, k=1, D=D)
         R = P.T.tocsr()
         levels[-1] = Level(R, A, P, D, B, rho)
-        A_coarse = (R @ A @ P).tocsr()
+        A_coarse = spg(spg(R, A), P).tocsr()
         levels.append(Level(A=A_coarse, B=B_coarse))
     levels[-1].dense_A = np.asarray(levels[-1].A.toarray())
     return levels
@@ -282,6 +299,83 @@ def cycle(levels, lvl, b):
         coarse_x = cycle(levels, lvl + 1, coarse_b)
     x = x + level.P @ coarse_x
     return level.postsmoother(x, b)
+
+
+def build_dist_cycle(levels, mesh):
+    """Wrap the hierarchy in mesh-sharded operators and return (A0_dist, M).
+
+    Every level's R/A/P becomes a ``DistCSR`` with PINNED equal row splits so
+    the padded vector spaces line up across levels (no repacking between
+    restriction and prolongation), and the V-cycle becomes one traceable
+    function on padded vectors — usable as the dist_cg preconditioner. The
+    coarse dense solve runs replicated (the reference's coarse-level
+    serialization, SURVEY §6, without the collapse: it's one tiny dense solve
+    inside the compiled program).
+    """
+    from sparse_tpu.parallel.dist import shard_csr
+    from sparse_tpu.parallel.partition import equal_row_splits
+
+    S = int(mesh.devices.size)
+    splits = [equal_row_splits(lv.A.shape[0], S) for lv in levels]
+    omega = 4.0 / 3.0
+    if len(levels) == 1:
+        # Hierarchy never coarsened (n <= max_coarse): the "V-cycle" is the
+        # replicated dense solve itself.
+        A0 = levels[0].A
+        spl0 = splits[0]
+        Ad = shard_csr(A0, mesh=mesh, row_splits=spl0, col_splits=spl0)
+        n0 = A0.shape[0]
+        g = np.arange(n0, dtype=np.int64)
+        shard = np.clip(np.searchsorted(spl0, g, side="right") - 1, 0, S - 1)
+        imap = jnp.asarray(shard * Ad.R + (g - spl0[shard]))
+        dense = jnp.asarray(np.asarray(A0.toarray()))
+
+        def direct(rp):
+            x = jnp.linalg.solve(dense, rp[imap])
+            return jnp.zeros((Ad.m_pad,), x.dtype).at[imap].set(x)
+
+        return Ad, direct
+    dlevels = []
+    for i, lv in enumerate(levels[:-1]):
+        Ad = shard_csr(
+            lv.A, mesh=mesh, row_splits=splits[i], col_splits=splits[i]
+        )
+        Rd = shard_csr(
+            lv.R, mesh=mesh, row_splits=splits[i + 1], col_splits=splits[i]
+        )
+        Pd = shard_csr(
+            lv.P, mesh=mesh, row_splits=splits[i], col_splits=splits[i + 1]
+        )
+        # diagonal in padded layout; padding entries get 1 (divide-safe)
+        Dp = Ad.pad_out_vector(np.asarray(lv.D) - 1.0) + 1.0
+        dlevels.append((Ad, Rd, Pd, Dp, omega / lv.rho_DinvA))
+
+    # bottom level: replicated dense solve with static unpad/repad maps
+    bottom = levels[-1]
+    nc = bottom.A.shape[0]
+    spl = splits[-1]
+    Rc = max(int(np.max(np.diff(spl))), 1)
+    g = np.arange(nc, dtype=np.int64)
+    shard = np.clip(np.searchsorted(spl, g, side="right") - 1, 0, S - 1)
+    idx_map = jnp.asarray(shard * Rc + (g - spl[shard]))
+    dense_A = jnp.asarray(bottom.dense_A)
+    m_pad_bottom = S * Rc
+
+    def cycle_padded(lvl, bp):
+        Ad, Rd, Pd, Dp, c0 = dlevels[lvl]
+        x = c0 * bp / Dp
+        residual = bp - Ad.spmv_padded(x)
+        coarse_b = Rd.spmv_padded(residual)
+        if lvl == len(dlevels) - 1:
+            cb = coarse_b[idx_map]
+            cx = jnp.linalg.solve(dense_A, cb)
+            coarse_x = jnp.zeros((m_pad_bottom,), cx.dtype).at[idx_map].set(cx)
+        else:
+            coarse_x = cycle_padded(lvl + 1, coarse_b)
+        x = x + Pd.spmv_padded(coarse_x)
+        return x + c0 * (bp - Ad.spmv_padded(x)) / Dp
+
+    return dlevels[0][0], lambda rp: cycle_padded(0, rp)
 
 
 def operator_complexity(levels):
@@ -311,7 +405,25 @@ def main():
 
     b = np.ones(A.shape[0])
     with solve:
-        if use_tpu:
+        if use_tpu and args.dist:
+            from sparse_tpu.parallel.dist import make_dist_cg
+            from sparse_tpu.parallel.mesh import get_mesh
+
+            mesh = get_mesh()
+            A0d, M = build_dist_cycle(levels, mesh)
+            solver = make_dist_cg(
+                A0d, tol=args.tol, maxiter=args.maxiter or 200, M=M,
+                conv_test_iters=5,
+            )
+            bp = A0d.pad_out_vector(b)
+            x0p = jnp.zeros_like(bp)
+            solver(bp, x0p)[0].block_until_ready()  # compile outside timing
+            timer.start()
+            xp, iters, _ = solver(bp, x0p)
+            iters = int(iters)
+            x = A0d.unpad_vector(xp)
+            total_ms = timer.stop(fence=xp)
+        elif use_tpu:
             M = linalg.LinearOperator(
                 A.shape, matvec=lambda r: cycle(levels, 0, r), dtype=np.float64
             )

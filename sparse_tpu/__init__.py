@@ -41,3 +41,5 @@ coo_matrix = coo_array
 dia_matrix = dia_array
 
 from . import integrate, io, linalg, quantum, spatial  # noqa: F401,E402
+
+from .coverage import coverage_report, track_provenance  # noqa: F401,E402

@@ -48,6 +48,10 @@ class Settings:
     # zero-gather DIA kernel.
     dia_max_diags: int = 32
     dia_max_fill: float = 4.0
+    # Max |col - row| band at which the Pallas ELL SpMV (windowed x DMA)
+    # applies under spmv_mode == 'pallas'; wider bands exceed the VMEM
+    # window budget and take the XLA gather path.
+    pallas_max_band: int = 8192
 
 
 settings = Settings()

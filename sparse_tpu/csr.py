@@ -19,6 +19,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from .base import SparseArray
+from .coverage import track_provenance
 from .config import settings
 from .ops import conv, elementwise, sddmm as sddmm_ops, spgemm as spgemm_ops, spmv as spmv_ops
 from .ops.coords import expand_rows
@@ -137,13 +138,15 @@ class csr_array(SparseArray):
         return None
 
     # -- products ----------------------------------------------------------
+    @track_provenance
     def dot(self, other, out=None, spmv_domain_part=False):
         """A @ other. Vector -> SpMV; dense 2-D -> SpMM; sparse -> SpGEMM.
 
         ``spmv_domain_part`` mirrors the reference's column-split SpMV flag
-        (csr.py:442); on TPU the contraction-split path lives in the
-        distributed layer, so here it only changes the kernel to the CSC-style
-        scatter variant (useful for testing parity).
+        (csr.py:442/869-927): the contraction dimension is split into
+        ``parallel.mesh.num_procs()`` domains reduced separately
+        (ops.spmv.csr_spmv_colsplit). The mesh version of the same strategy
+        is ``parallel.dist.shard_csr_cols`` (psum_scatter over ICI).
         """
         from .csc import csc_array
 
@@ -169,7 +172,15 @@ class csr_array(SparseArray):
                 raise ValueError(
                     f"dimension mismatch: {self.shape} @ {x.shape}"
                 )
-            y = self._spmv(x)
+            if spmv_domain_part:
+                from .parallel.mesh import num_procs
+
+                y = spmv_ops.csr_spmv_colsplit(
+                    self.indptr, self.indices, self.data, x, self.shape[0],
+                    max(num_procs(), 1),
+                )
+            else:
+                y = self._spmv(x)
         elif x.ndim == 2:
             if x.shape[0] != self.shape[1]:
                 raise ValueError(
@@ -232,6 +243,15 @@ class csr_array(SparseArray):
                 return dia_spmv_xla(dia[0], dia[1], x, self.shape)
         ell = self._maybe_ell()
         if ell is not None:
+            if mode == "pallas":
+                from .kernels.ell_spmv import ell_band, ell_spmv_pallas
+
+                if not hasattr(self, "_ell_band_cache"):
+                    self._ell_band_cache = ell_band(ell[0], ell[1])
+                if self._ell_band_cache <= settings.pallas_max_band:
+                    return ell_spmv_pallas(
+                        ell[0], ell[1], x, band=self._ell_band_cache
+                    )
             return spmv_ops.csr_spmv_ell(ell[0], ell[1], x)
         return spmv_ops.csr_spmv_segment(
             self.indptr, self.indices, self.data, x, self.shape[0]
@@ -259,6 +279,7 @@ class csr_array(SparseArray):
     def matvec(self, x, out=None):
         return self.dot(x, out=out)
 
+    @track_provenance
     def sddmm(self, C, D):
         """Structure-preserving sampled dense-dense matmul (csr.py:1244)."""
         vals = sddmm_ops.csr_sddmm(
@@ -266,6 +287,7 @@ class csr_array(SparseArray):
         )
         return self._with_data(vals)
 
+    @track_provenance
     def tropical_spmv(self, x):
         """(max, +) semiring SpMV over 3-tuple vectors (csr.py:366).
 
@@ -281,6 +303,7 @@ class csr_array(SparseArray):
         )
 
     # -- elementwise -------------------------------------------------------
+    @track_provenance
     def __add__(self, other):
         if np.isscalar(other):
             if other == 0:
@@ -304,6 +327,7 @@ class csr_array(SparseArray):
             return self._with_data(self.data * other)
         return self.multiply(other)
 
+    @track_provenance
     def multiply(self, other):
         if np.isscalar(other) or getattr(other, "ndim", 1) == 0:
             return self._with_data(self.data * other)

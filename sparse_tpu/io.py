@@ -74,12 +74,25 @@ def mmread(path) -> coo_array:
             m, n, nnz = int(dims[0]), int(dims[1]), int(dims[2])
             rows, cols, vals = _parse_coordinate_body(f, nnz, field)
         else:  # dense "array" format, column-major
+            from . import native
+
             m, n = int(dims[0]), int(dims[1])
-            body = np.loadtxt(f, ndmin=2)
-            if field == "complex":
-                flat = body[:, 0] + 1j * body[:, 1]
-            else:
-                flat = body[:, 0] if body.ndim == 2 else body
+            count = m * n if symmetry == "general" else n * m - n * (n - 1) // 2
+            flat = None
+            if field != "complex" and count and native.lib() is not None:
+                # native single-pass tokenizer (READ_MTX_TO_COO analog)
+                flat = native.parse_mtx_dense(f.read().encode(), count)
+                if flat is None:
+                    raise ValueError(
+                        f"MatrixMarket array body does not contain exactly "
+                        f"{count} entries"
+                    )
+            if flat is None:
+                body = np.loadtxt(f, ndmin=2)
+                if field == "complex":
+                    flat = body[:, 0] + 1j * body[:, 1]
+                else:
+                    flat = body[:, 0] if body.ndim == 2 else body
             if symmetry == "general":
                 dense = flat.reshape((n, m)).T
             else:

@@ -1,11 +1,13 @@
 """Pallas TPU kernel: DIA SpMV with explicit VMEM windowing.
 
 The XLA formulation (``ops.dia_spmv``) already avoids gathers; this kernel
-additionally controls the memory schedule: the x vector stays in HBM, each
-grid step DMAs exactly the [TM + 2B] window its row tile needs into VMEM,
-and the D diagonal contributions are accumulated as statically-shifted VMEM
-slices on the VPU. One x load + one data load + one y store per element —
-the HBM-bandwidth lower bound for banded SpMV.
+additionally controls the memory schedule: data and x stay in HBM, each grid
+step DMAs the [D, TM + 2B] data tile and the [TM + 2B] x window its row tile
+needs into VMEM, and the diagonal contributions — **including the data*x
+multiply** — are computed in VMEM as statically-shifted slices on the VPU.
+Per element that is one data load + one (windowed) x load + one y store,
+plus a one-time [D, 2B]-per-row-tile halo pad of the data planes — no
+full-size intermediate product array ever exists in HBM.
 
 Reference analog: the cuSPARSE-backed CSR SpMV task
 (``src/sparse/array/csr/spmv.cu:42-116``) with the shifted-pointer trick;
@@ -27,15 +29,26 @@ def _round_up(v: int, m: int) -> int:
     return (v + m - 1) // m * m
 
 
+def dia_spmv_pallas(data, offsets, x, shape, tile=16384, interpret=None):
+    """See ``_dia_spmv_pallas``; ``interpret=None`` auto-selects interpret
+    mode off-TPU (Pallas TPU kernels only compile natively on tpu)."""
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    return _dia_spmv_pallas(
+        data, tuple(offsets), x, tuple(shape), tile=tile, interpret=interpret
+    )
+
+
 @partial(jax.jit, static_argnames=("offsets", "shape", "tile", "interpret"))
-def dia_spmv_pallas(
+def _dia_spmv_pallas(
     data, offsets: tuple, x, shape: tuple, tile: int = 16384, interpret: bool = False
 ):
     """y = A @ x, A in DIA layout (scipy convention), banded offsets.
 
-    ``tile`` rows per grid step (multiple of 128). The per-tile x window is
-    [tile + 2B] where B is the bandwidth; windows of neighboring tiles
-    overlap by 2B — the halo. DMA'd from HBM per step.
+    ``tile`` rows per grid step (multiple of 128). The per-tile x/data window
+    is [tile + 2B] where B is the bandwidth; windows of neighboring tiles
+    overlap by 2B — the halo. Both are DMA'd from HBM per step and multiplied
+    in VMEM (contribution of diagonal o to row i is data[k, i+o] * x[i+o]).
     """
     m, n = shape
     D = len(offsets)
@@ -43,40 +56,47 @@ def dia_spmv_pallas(
     TM = min(tile, _round_up(max(m, 128), 128))
     G = (m + TM - 1) // TM
     m_pad = G * TM
-
-    # prod[k, j] = data[k, j] * x[j]; shifted windows of prod are summed.
-    prod = data * x[None, :n]  # [D, n]
-    # pad so that window [g*TM, g*TM + TM + 2B) is always in range after a
-    # left shift of B: padded index j' = j + B (right pad clamped for wide
-    # matrices where n > m_pad)
-    prod = jnp.pad(prod, ((0, 0), (B, max(m_pad - n, 0) + B)))
-    prod = prod[:, : m_pad + 2 * B]
-
     win = TM + 2 * B
 
-    def kernel(prod_hbm, y_ref, xwin, sem):
+    # Halo-pad data planes and x into a shared padded coordinate system
+    # (index j' = j + B); a copy of the inputs, NOT a product intermediate.
+    pad_hi = max(m_pad - n, 0) + B
+    data_p = jnp.pad(data, ((0, 0), (B, pad_hi)))[:, : m_pad + 2 * B]
+    x_p = jnp.pad(x, (B, pad_hi))[: m_pad + 2 * B]
+    out_dt = jnp.result_type(data.dtype, x.dtype)
+
+    def kernel(data_hbm, x_hbm, y_ref, dwin, xwin, sems):
         g = pl.program_id(0)
-        dma = pltpu.make_async_copy(
-            prod_hbm.at[:, pl.ds(g * TM, win)], xwin, sem
+        d_dma = pltpu.make_async_copy(
+            data_hbm.at[:, pl.ds(g * TM, win)], dwin, sems.at[0]
         )
-        dma.start()
-        dma.wait()
+        x_dma = pltpu.make_async_copy(
+            x_hbm.at[pl.ds(g * TM, win)], xwin, sems.at[1]
+        )
+        d_dma.start()
+        x_dma.start()
+        d_dma.wait()
+        x_dma.wait()
         acc = jnp.zeros((TM,), dtype=y_ref.dtype)
         for k, o in enumerate(offsets):
             lo = B + int(o)
-            acc = acc + xwin[k, lo : lo + TM]
+            acc = acc + dwin[k, lo : lo + TM] * xwin[lo : lo + TM]
         y_ref[:] = acc
 
     y = pl.pallas_call(
         kernel,
         grid=(G,),
-        in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
+        in_specs=[
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+        ],
         out_specs=pl.BlockSpec((TM,), lambda g: (g,), memory_space=pltpu.VMEM),
-        out_shape=jax.ShapeDtypeStruct((m_pad,), prod.dtype),
+        out_shape=jax.ShapeDtypeStruct((m_pad,), out_dt),
         scratch_shapes=[
-            pltpu.VMEM((D, win), prod.dtype),
-            pltpu.SemaphoreType.DMA,
+            pltpu.VMEM((D, win), data.dtype),
+            pltpu.VMEM((win,), x.dtype),
+            pltpu.SemaphoreType.DMA((2,)),
         ],
         interpret=interpret,
-    )(prod)
+    )(data_p, x_p)
     return y[:m]

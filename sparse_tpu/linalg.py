@@ -24,6 +24,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from .base import SparseArray
+from .coverage import track_provenance
 from .utils import asjnp, host_int
 
 
@@ -157,6 +158,7 @@ def _vdot(a, b):
 # ---------------------------------------------------------------------------
 # CG (linalg.py:499)
 # ---------------------------------------------------------------------------
+@track_provenance
 def cg(
     A,
     b,
@@ -252,6 +254,7 @@ def _cg_host_loop(A, b, x, tol, maxiter, M, callback, conv_test_iters):
     return x, iters
 
 
+@track_provenance
 def spsolve(A, b, **kwargs):
     """Sparse solve via CG (reference linalg.py:88)."""
     x, _ = cg(A, b, **kwargs)
@@ -261,6 +264,7 @@ def spsolve(A, b, **kwargs):
 # ---------------------------------------------------------------------------
 # CGS (linalg.py:570)
 # ---------------------------------------------------------------------------
+@track_provenance
 def cgs(A, b, x0=None, tol=1e-08, maxiter=None, callback=None, conv_test_iters=25):
     b = asjnp(b)
     n = b.shape[0]
@@ -310,6 +314,7 @@ def cgs(A, b, x0=None, tol=1e-08, maxiter=None, callback=None, conv_test_iters=2
 # ---------------------------------------------------------------------------
 # BiCG (linalg.py:620)
 # ---------------------------------------------------------------------------
+@track_provenance
 def bicg(A, b, x0=None, tol=1e-08, maxiter=None, callback=None, conv_test_iters=25):
     b = asjnp(b)
     n = b.shape[0]
@@ -357,6 +362,7 @@ def bicg(A, b, x0=None, tol=1e-08, maxiter=None, callback=None, conv_test_iters=
 # ---------------------------------------------------------------------------
 # BiCGSTAB (linalg.py:796 — marked broken in the reference; working here)
 # ---------------------------------------------------------------------------
+@track_provenance
 def bicgstab(A, b, x0=None, tol=1e-08, maxiter=None, callback=None, conv_test_iters=25):
     b = asjnp(b)
     n = b.shape[0]
@@ -407,6 +413,7 @@ def bicgstab(A, b, x0=None, tol=1e-08, maxiter=None, callback=None, conv_test_it
 # ---------------------------------------------------------------------------
 # GMRES (linalg.py:670) — restarted, Givens-rotation least squares
 # ---------------------------------------------------------------------------
+@track_provenance
 def gmres(
     A,
     b,
@@ -509,12 +516,18 @@ def _gmres_cycle(A, M, x, r, beta, restart, target):
 # ---------------------------------------------------------------------------
 # LSQR (linalg.py:937) — Golub-Kahan bidiagonalization
 # ---------------------------------------------------------------------------
-def lsqr(A, b, damp=0.0, atol=1e-08, btol=1e-08, conlim=1e8, iter_lim=None):
+@track_provenance
+def lsqr(
+    A, b, damp=0.0, atol=1e-08, btol=1e-08, conlim=1e8, iter_lim=None,
+    calc_var=False,
+):
     """Golub-Kahan bidiagonalization least squares (reference linalg.py:937).
 
     The bidiagonalization matvecs run on device; the O(1) rotation/norm
     recurrences (Paige & Saunders' stopping estimates, as in scipy) are host
-    scalars. Returns (x, istop, itn, r1norm).
+    scalars. Returns scipy's full 10-tuple
+    (x, istop, itn, r1norm, r2norm, anorm, acond, arnorm, xnorm, var);
+    ``var`` is estimated only under ``calc_var=True`` (zeros otherwise).
     """
     b = asjnp(b)
     A = make_linear_operator(A)
@@ -528,9 +541,10 @@ def lsqr(A, b, damp=0.0, atol=1e-08, btol=1e-08, conlim=1e8, iter_lim=None):
     ctol = 1.0 / conlim if conlim > 0 else 0.0
 
     x = jnp.zeros((n,), dtype=b.dtype)
+    var = jnp.zeros((n,), dtype=b.dtype)
     bnorm = float(jnp.linalg.norm(b))
     if bnorm == 0.0:
-        return x, 0, 0, 0.0
+        return x, 0, 0, 0.0, 0.0, 0.0, 0.0, 0.0, 0.0, var
     beta = bnorm
     u = b / beta
     v = A.rmatvec(u)
@@ -540,11 +554,11 @@ def lsqr(A, b, damp=0.0, atol=1e-08, btol=1e-08, conlim=1e8, iter_lim=None):
     w = v
     phibar, rhobar = beta, alpha
     rnorm = r1norm = beta
-    anorm = acond = ddnorm = res2 = xxnorm = z = 0.0
+    anorm = acond = ddnorm = res2 = xxnorm = xnorm = z = 0.0
     cs2, sn2 = -1.0, 0.0
     arnorm = alpha * beta
     if arnorm == 0.0:
-        return x, 0, 0, r1norm
+        return x, 0, 0, r1norm, rnorm, anorm, acond, arnorm, 0.0, var
     istop = itn = 0
     while itn < iter_lim:
         itn += 1
@@ -577,6 +591,8 @@ def lsqr(A, b, damp=0.0, atol=1e-08, btol=1e-08, conlim=1e8, iter_lim=None):
         tau = sn * phi
         x = x + (phi / rho) * w
         ddnorm = ddnorm + float(jnp.vdot(w, w).real) / rho**2
+        if calc_var:
+            var = var + (w / rho) ** 2
         w = v - (theta / rho) * w
         # estimate ||x||, cond(A), residual norms (Paige & Saunders)
         delta = sn2 * rho
@@ -618,7 +634,9 @@ def lsqr(A, b, damp=0.0, atol=1e-08, btol=1e-08, conlim=1e8, iter_lim=None):
             istop = 1
         if istop != 0:
             break
-    return x, istop, itn, r1norm
+    return (
+        x, istop, itn, r1norm, rnorm, anorm, acond, arnorm, xnorm, var,
+    )
 
 
 # ---------------------------------------------------------------------------
@@ -669,6 +687,7 @@ def _select_ritz(w_all, which, k):
     return np.sort(sel)
 
 
+@track_provenance
 def eigsh(A, k=6, which="LM", v0=None, maxiter=None, tol=0.0, return_eigenvectors=True):
     """Symmetric eigensolver: restarted Lanczos with full reorthogonalization.
 

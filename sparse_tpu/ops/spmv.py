@@ -14,6 +14,7 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from .coords import expand_rows
 
@@ -90,6 +91,41 @@ def csr_spmm_ell(ell_indices, ell_data, B):
     out_dt = jnp.result_type(ell_data.dtype, B.dtype)
     acc0 = jnp.zeros((ell_data.shape[0], B.shape[1]), dtype=out_dt)
     return jax.lax.fori_loop(0, k, body, acc0)
+
+
+def csr_spmv_colsplit(indptr, indices, data, x, m: int, nblocks: int):
+    """y = A @ x with the contraction (column) dimension split into
+    ``nblocks`` equal domains, each reduced separately, then summed.
+
+    Reference: CSR_SPMV_COL_SPLIT (``src/sparse/array/csr/spmv.cu:126-153``,
+    driven by ``spmv_domain_part`` at csr.py:869-927) — the column-domain
+    partition with ADD-reduction into y. On one chip the partials live as a
+    [nblocks, m] plane reduced on-device; on the mesh the same structure is
+    ``parallel.dist.DistCSRCol`` where the reduction is a psum_scatter.
+    """
+    nnz = data.shape[0]
+    if nnz == 0:
+        return jnp.zeros((m,), dtype=jnp.result_type(data.dtype, x.dtype))
+    n = x.shape[0]
+    idt = jnp.int32
+    if max(n, m) * nblocks > np.iinfo(np.int32).max:
+        # int32 would wrap in `indices * nblocks` / `block * m + rows` and
+        # silently misroute segments (jnp truncates int64 under x32) — fail
+        # loudly like ops.coords.require_x64_keys.
+        if not jax.config.jax_enable_x64:
+            raise ValueError(
+                f"column-split SpMV on shape ({m}, {n}) with {nblocks} "
+                "blocks needs int64 segment keys; enable them with "
+                "jax.config.update('jax_enable_x64', True)"
+            )
+        idt = jnp.int64
+    rows = expand_rows(indptr, nnz)
+    block = (indices.astype(idt) * nblocks) // max(n, 1)
+    seg = block * m + rows.astype(idt)
+    part = jax.ops.segment_sum(
+        data * x[indices], seg, num_segments=nblocks * m
+    )
+    return part.reshape(nblocks, m).sum(axis=0)
 
 
 def csc_spmv(indptr, indices, data, x, m: int):

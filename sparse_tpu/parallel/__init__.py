@@ -6,3 +6,5 @@ ppermute/all_to_all) over ICI/DCN.
 """
 
 from .partition import balanced_row_splits, column_windows, equal_row_splits  # noqa: F401
+from .dist import DistCSR, DistCSRCol, dist_cg, shard_csr, shard_csr_cols  # noqa: F401
+from .spgemm import dist_spgemm, dist_spgemm_2d  # noqa: F401

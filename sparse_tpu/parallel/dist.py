@@ -39,7 +39,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..utils import asjnp
 from .mesh import get_mesh
-from .partition import balanced_row_splits, equal_row_splits
+from .partition import balanced_row_splits, column_windows, equal_row_splits
 
 try:  # jax>=0.8 top-level; older releases keep it in experimental
     from jax import shard_map
@@ -63,7 +63,8 @@ class DistCSR:
     col_splits: np.ndarray  # [S+1] host (x-vector layout)
     R: int  # padded rows per shard
     C: int  # padded cols (x entries) per shard
-    H: int  # halo width (cols), 0 when mode == "gather"
+    HL: int  # left halo width (cols), 0 when mode == "gather"
+    HR: int  # right halo width; == HL unless settings.precise_windows
     mode: str  # "halo" | "gather"
     layout: str  # "ell" | "csr"
     dtype: np.dtype
@@ -74,6 +75,8 @@ class DistCSR:
     nz_cols: jax.Array | None = None  # [S, K] padded-space col ids (rel. to window)
     nz_vals: jax.Array | None = None  # [S, K]
     _spmv_fn: object = field(default=None, repr=False, compare=False)
+    _spmm_fn: object = field(default=None, repr=False, compare=False)
+    _rspmm_fn: object = field(default=None, repr=False, compare=False)
 
     @property
     def S(self) -> int:
@@ -86,6 +89,10 @@ class DistCSR:
     @property
     def n_pad(self) -> int:
         return self.S * self.C
+
+    @property
+    def H(self) -> int:
+        return max(self.HL, self.HR)
 
     # -- vector layout helpers --------------------------------------------
     def pad_vector(self, x, splits=None, width=None) -> jax.Array:
@@ -135,63 +142,152 @@ class DistCSR:
             ),
         )
 
+    # -- SpMM --------------------------------------------------------------
+    def pad_matrix(self, B, splits=None, width=None) -> jax.Array:
+        """Host [n, nB] -> padded row-block layout [S*width, nB], sharded."""
+        splits = self.col_splits if splits is None else splits
+        width = self.C if width is None else width
+        B = np.asarray(B)
+        S = self.S
+        out = np.zeros((S, width, B.shape[1]), dtype=B.dtype)
+        for s in range(S):
+            lo, hi = int(splits[s]), int(splits[s + 1])
+            out[s, : hi - lo] = B[lo:hi]
+        return jax.device_put(
+            out.reshape(S * width, B.shape[1]),
+            NamedSharding(self.mesh, P(self.axis, None)),
+        )
+
+    def unpad_matrix(self, Cp, splits=None, width=None) -> np.ndarray:
+        splits = self.row_splits if splits is None else splits
+        width = self.R if width is None else width
+        Cs = np.asarray(Cp).reshape(self.S, width, -1)
+        return np.concatenate(
+            [Cs[s, : int(splits[s + 1]) - int(splits[s])] for s in range(self.S)]
+        )
+
+    def spmm_padded(self, Bp: jax.Array) -> jax.Array:
+        """C = A @ B in padded layout ([n_pad, nB] -> [m_pad, nB]).
+
+        Row-split SpMM (reference SPMM_CSR_DENSE, csr.py:1151-1205): B rows
+        follow x's layout; each shard halo-exchanges (or all_gathers) the B
+        row-window it needs, then runs the local ELL/segment kernel.
+        """
+        if self._spmm_fn is None:
+            # one jitted wrapper for all widths — jax.jit caches per shape
+            self._spmm_fn = _build_spmv(self, matrix=True)
+        return self._spmm_fn(Bp, *self._blocks())
+
+    def rspmm_padded(self, Bp: jax.Array) -> jax.Array:
+        """C = B @ A with dense B in padded *row-space* layout [p, m_pad].
+
+        k-split with output reduction (reference SPMM_DENSE_CSR,
+        csr.py:1209-1240): each shard contracts its row block of A against
+        its column slice of B and scatters into a full [p, n_pad] output;
+        one ``psum`` over the mesh replicates the result — exactly the
+        reference's ADD-reduction into a broadcast C.
+        """
+        if self._rspmm_fn is None:
+            self._rspmm_fn = _build_rspmm(self)
+        return self._rspmm_fn(Bp)
+
+    def _blocks(self):
+        return (
+            (self.ell_idx, self.ell_val)
+            if self.layout == "ell"
+            else (self.nz_rows, self.nz_cols, self.nz_vals)
+        )
+
     def dot(self, x) -> np.ndarray:
-        """Convenience global-vector SpMV (pads, multiplies, unpads)."""
-        xp = self.pad_vector(np.asarray(x))
+        """Convenience global SpMV/SpMM (pads, multiplies, unpads)."""
+        x = np.asarray(x)
+        if x.ndim == 2:
+            Bp = self.pad_matrix(x)
+            Cp = self.spmm_padded(Bp)
+            return self.unpad_matrix(Cp)
+        xp = self.pad_vector(x)
         yp = self.spmv_padded(xp)
         return self.unpad_vector(yp)
+
+    def rdot(self, B) -> np.ndarray:
+        """B @ A for dense host B ([p, m] -> [p, n])."""
+        B = np.asarray(B)
+        squeeze = B.ndim == 1
+        if squeeze:
+            B = B[None]
+        Bp = self.pad_matrix(B.T, splits=self.row_splits, width=self.R).T
+        Cp = self.rspmm_padded(Bp)
+        Cs = np.asarray(Cp)  # [p, n_pad] replicated
+        out = np.concatenate(
+            [
+                Cs[:, s * self.C : s * self.C + int(self.col_splits[s + 1]) - int(self.col_splits[s])]
+                for s in range(self.S)
+            ],
+            axis=1,
+        )
+        return out[0] if squeeze else out
 
     def matvec(self, x, out=None):
         return self.dot(x)
 
 
-def _build_spmv(A: DistCSR):
-    """Compile the shard_map SpMV for this matrix's layout/mode."""
-    mesh, axis, S, R, C, H = A.mesh, A.axis, A.S, A.R, A.C, A.H
+def _build_spmv(A: DistCSR, matrix: bool = False):
+    """Compile the shard_map SpMV/SpMM for this matrix's layout/mode.
+
+    ``matrix=False`` -> vector SpMV ([n_pad] -> [m_pad]);
+    ``matrix=True``  -> row-split SpMM ([n_pad, nB] -> [m_pad, nB]).
+    """
+    mesh, axis, S, R, C = A.mesh, A.axis, A.S, A.R, A.C
+    HL, HR = A.HL, A.HR
     mode, layout = A.mode, A.layout
     perm_right = [(i, i + 1) for i in range(S - 1)]  # tail -> right neighbor
     perm_left = [(i + 1, i) for i in range(S - 1)]  # head -> left neighbor
+    is_mat = matrix
 
     def gather_x(x_l):
-        """Produce each shard's addressable x slab from its local block [C]."""
+        """Each shard's addressable x/B slab from its local block (leading
+        axis = the n dimension; halo/all_gather both slice it)."""
         if mode == "gather":
-            # Replicate-x fallback: one all_gather over the mesh axis.
-            return jax.lax.all_gather(x_l, axis, tiled=True)  # [S*C]
-        if S == 1 or H == 0:
+            # Replicate fallback: one all_gather over the mesh axis.
+            return jax.lax.all_gather(x_l, axis, tiled=True)  # [S*C, ...]
+        if S == 1 or HL + HR == 0:
             return x_l
-        left = jax.lax.ppermute(x_l[-H:], axis, perm_right)  # from left nbr
-        right = jax.lax.ppermute(x_l[:H], axis, perm_left)  # from right nbr
-        return jnp.concatenate([left, x_l, right])  # [C + 2H]
+        parts = []
+        if HL:
+            parts.append(jax.lax.ppermute(x_l[-HL:], axis, perm_right))
+        parts.append(x_l)
+        if HR:
+            parts.append(jax.lax.ppermute(x_l[:HR], axis, perm_left))
+        return jnp.concatenate(parts)  # [HL + C + HR, ...]
 
     if layout == "ell":
 
-        from ..ops.spmv import csr_spmv_ell
-
-        def local_kernel(x_slab, ell_idx_l, ell_val_l):
-            # k unrolled 1-D gathers + VPU adds (see csr_spmv_ell).
-            return csr_spmv_ell(ell_idx_l, ell_val_l, x_slab)
+        from ..ops.spmv import csr_spmm_ell, csr_spmv_ell
 
         def shard_fn(x_l, ell_idx_l, ell_val_l):
-            return local_kernel(
-                gather_x(x_l), ell_idx_l.squeeze(0), ell_val_l.squeeze(0)
-            )[None]
+            slab = gather_x(x_l)
+            idx, val = ell_idx_l.squeeze(0), ell_val_l.squeeze(0)
+            if is_mat:
+                return csr_spmm_ell(idx, val, slab)  # [R, nB]
+            return csr_spmv_ell(idx, val, slab)[None]
 
         in_specs = (P(axis), P(axis, None, None), P(axis, None, None))
     else:
 
-        def local_kernel(x_slab, rows_l, cols_l, vals_l):
-            prod = vals_l * x_slab[cols_l]
-            return jax.ops.segment_sum(
-                prod, rows_l, num_segments=R, indices_are_sorted=True
-            )
-
         def shard_fn(x_l, rows_l, cols_l, vals_l):
-            return local_kernel(
-                gather_x(x_l),
+            slab = gather_x(x_l)
+            rows, cols, vals = (
                 rows_l.squeeze(0),
                 cols_l.squeeze(0),
                 vals_l.squeeze(0),
-            )[None]
+            )
+            prod = (
+                vals[:, None] * slab[cols] if is_mat else vals * slab[cols]
+            )
+            out = jax.ops.segment_sum(
+                prod, rows, num_segments=R, indices_are_sorted=True
+            )
+            return out if is_mat else out[None]
 
         in_specs = (P(axis), P(axis, None), P(axis, None), P(axis, None))
 
@@ -203,11 +299,222 @@ def _build_spmv(A: DistCSR):
         check_vma=False,
     )
 
+    if is_mat:
+        return jax.jit(smapped)
+
     @jax.jit
     def spmv(xp, *blocks):
         return smapped(xp, *blocks).reshape(S * R)
 
     return spmv
+
+
+def _build_rspmm(A: DistCSR):
+    """Compile the k-split dense x sparse SpMM: C = B @ A with B [p, m_pad]
+    sharded on its column (contraction) axis; each shard scatters its local
+    contribution into [p, n_pad] and one ``psum`` replicates C (the
+    reference's ADD reduction into a broadcast store, csr.py:1209-1240)."""
+    mesh, axis, S, R, C, HL = A.mesh, A.axis, A.S, A.R, A.C, A.HL
+    mode, layout = A.mode, A.layout
+    n_pad = S * C
+
+    def shard_fn(B_l, *blocks):
+        s = jax.lax.axis_index(axis)
+        if layout == "ell":
+            ell_idx, ell_val = (b.squeeze(0) for b in blocks)
+            k = ell_idx.shape[1]
+            rows = jnp.repeat(jnp.arange(R, dtype=jnp.int32), k)
+            cols = ell_idx.reshape(-1)
+            vals = ell_val.reshape(-1)
+        else:
+            rows, cols, vals = (b.squeeze(0) for b in blocks)
+        # window-local col ids -> padded global col ids
+        if mode != "gather":
+            cols = cols.astype(jnp.int32) + s * C - HL
+        cols = jnp.clip(cols, 0, n_pad - 1)  # padding entries carry val 0
+        contrib = B_l[:, rows] * vals  # [p, Kf]
+        out = jax.ops.segment_sum(contrib.T, cols, num_segments=n_pad)
+        return jax.lax.psum(out.T, axis)  # [p, n_pad] replicated
+
+    if layout == "ell":
+        block_specs = (P(axis, None, None), P(axis, None, None))
+    else:
+        block_specs = (P(axis, None), P(axis, None), P(axis, None))
+
+    smapped = shard_map(
+        shard_fn,
+        mesh=mesh,
+        in_specs=(P(None, axis), *block_specs),
+        out_specs=P(None, None),
+        check_vma=False,
+    )
+
+    @jax.jit
+    def rspmm(Bp):
+        return smapped(Bp, *A._blocks())
+
+    return rspmm
+
+
+# ---------------------------------------------------------------------------
+# Column-split SpMV — the contraction-dim ("TP-style") strategy.
+# ---------------------------------------------------------------------------
+@dataclass(eq=False)
+class DistCSRCol:
+    """A CSR matrix laid out over the mesh by COLUMN blocks.
+
+    The reference's domain-partitioned SpMV (csr.py:869-927,
+    ``spmv_domain_part``; SURVEY §2c-4): x is sharded on the contraction
+    dimension, each shard owns the nonzeros whose column falls in its x
+    block, computes a full-height partial y, and a ``psum_scatter`` over
+    the mesh both reduces and re-shards y into row-block layout — the
+    ring-reduction shape (this is the framework's reduce-scatter analog of
+    sequence parallelism).
+    """
+
+    mesh: Mesh
+    axis: str
+    shape: tuple
+    row_splits: np.ndarray  # [S+1] layout of the OUTPUT y
+    col_splits: np.ndarray  # [S+1] layout of the INPUT x (ownership)
+    R: int
+    C: int
+    dtype: np.dtype
+    nz_rows: jax.Array | None = None  # [S, K] padded-space global row ids
+    nz_cols: jax.Array | None = None  # [S, K] local col ids in [0, C)
+    nz_vals: jax.Array | None = None  # [S, K]
+    _spmv_fn: object = field(default=None, repr=False, compare=False)
+
+    @property
+    def S(self) -> int:
+        return int(self.mesh.devices.size)
+
+    @property
+    def m_pad(self) -> int:
+        return self.S * self.R
+
+    @property
+    def n_pad(self) -> int:
+        return self.S * self.C
+
+    pad_vector = DistCSR.pad_vector
+    pad_out_vector = DistCSR.pad_out_vector
+    unpad_vector = DistCSR.unpad_vector
+
+    def spmv_padded(self, xp: jax.Array) -> jax.Array:
+        if self._spmv_fn is None:
+            self._spmv_fn = _build_spmv_col(self)
+        return self._spmv_fn(xp, self.nz_rows, self.nz_cols, self.nz_vals)
+
+    def dot(self, x) -> np.ndarray:
+        xp = self.pad_vector(np.asarray(x))
+        yp = self.spmv_padded(xp)
+        return self.unpad_vector(yp)
+
+    def matvec(self, x, out=None):
+        return self.dot(x)
+
+
+def _build_spmv_col(A: DistCSRCol):
+    mesh, axis, S, R = A.mesh, A.axis, A.S, A.R
+    m_pad = S * R
+
+    def shard_fn(x_l, rows_l, cols_l, vals_l):
+        x = x_l.reshape(-1)
+        rows, cols, vals = (
+            rows_l.squeeze(0),
+            cols_l.squeeze(0),
+            vals_l.squeeze(0),
+        )
+        prod = vals * x[cols]
+        y_full = jax.ops.segment_sum(
+            prod, rows, num_segments=m_pad, indices_are_sorted=True
+        )
+        if S == 1:
+            return y_full
+        # reduce partial sums across the mesh AND re-shard to row blocks in
+        # one collective (rides ICI as a ring reduce-scatter)
+        return jax.lax.psum_scatter(y_full, axis, tiled=True)
+
+    smapped = shard_map(
+        shard_fn,
+        mesh=mesh,
+        in_specs=(P(axis), P(axis, None), P(axis, None), P(axis, None)),
+        out_specs=P(axis),
+        check_vma=False,
+    )
+    return jax.jit(smapped)
+
+
+def shard_csr_cols(
+    A,
+    mesh: Mesh | None = None,
+    axis: str = "shards",
+    row_splits: np.ndarray | None = None,
+) -> DistCSRCol:
+    """Lay a ``csr_array`` out over the mesh by column blocks (domain split).
+
+    ``row_splits`` fixes the output layout (defaults to equal row tiles) so
+    the result vector can feed a row-split matrix without repacking.
+    """
+    if mesh is None:
+        mesh = get_mesh()
+    S = int(mesh.devices.size)
+    indptr = np.asarray(A.indptr)
+    indices = np.asarray(A.indices)
+    data = np.asarray(A.data)
+    m, n = A.shape
+    nnz = data.shape[0]
+
+    col_splits = equal_row_splits(n, S)
+    if row_splits is None:
+        row_splits = equal_row_splits(m, S)
+    R = max(int(np.max(np.diff(row_splits))), 1)
+    C = max(int(np.max(np.diff(col_splits))), 1)
+
+    counts = np.diff(indptr)
+    nnz_row = np.repeat(np.arange(m, dtype=np.int64), counts)
+    row_shard = np.clip(
+        np.searchsorted(row_splits, nnz_row, side="right") - 1, 0, S - 1
+    )
+    pad_rows = row_shard * R + (nnz_row - row_splits[row_shard])
+    col_shard = np.clip(
+        np.searchsorted(col_splits, indices, side="right") - 1, 0, S - 1
+    )
+    local_cols = indices.astype(np.int64) - col_splits[col_shard]
+
+    # Bucket nonzeros by owning column shard, row-sorted within each bucket
+    # (CSR order is already row-sorted; a stable bucket argsort keeps it).
+    order = np.argsort(col_shard, kind="stable")
+    shard_counts = np.bincount(col_shard, minlength=S)
+    K = max(int(shard_counts.max()), 1) if nnz else 1
+    starts = np.zeros(S, dtype=np.int64)
+    starts[1:] = np.cumsum(shard_counts)[:-1]
+    slot = np.arange(nnz, dtype=np.int64) - starts[col_shard[order]]
+
+    idt = np.int32 if S * max(R, C) < 2**31 else np.int64
+    # padding: row m_pad-1 (keeps sortedness), col 0, val 0
+    nz_rows = np.full((S, K), S * R - 1, dtype=idt)
+    nz_cols = np.zeros((S, K), dtype=idt)
+    nz_vals = np.zeros((S, K), dtype=data.dtype)
+    nz_rows[col_shard[order], slot] = pad_rows[order]
+    nz_cols[col_shard[order], slot] = local_cols[order]
+    nz_vals[col_shard[order], slot] = data[order]
+
+    sharding2 = NamedSharding(mesh, P(axis, None))
+    return DistCSRCol(
+        mesh=mesh,
+        axis=axis,
+        shape=(int(m), int(n)),
+        row_splits=np.asarray(row_splits),
+        col_splits=col_splits,
+        R=R,
+        C=C,
+        dtype=np.dtype(data.dtype),
+        nz_rows=jax.device_put(nz_rows, sharding2),
+        nz_cols=jax.device_put(nz_cols, sharding2),
+        nz_vals=jax.device_put(nz_vals, sharding2),
+    )
 
 
 def shard_csr(
@@ -217,6 +524,8 @@ def shard_csr(
     balanced: bool = True,
     layout: str = "auto",
     halo_max_ratio: float = 1.0,
+    row_splits: np.ndarray | None = None,
+    col_splits: np.ndarray | None = None,
 ) -> DistCSR:
     """Lay a ``csr_array`` out over a mesh.
 
@@ -224,7 +533,9 @@ def shard_csr(
     ``layout`` is 'ell' | 'csr' | 'auto' (ELL when max row degree is within
     ``settings.ell_max_ratio`` of the mean, mirroring the single-chip
     heuristic); a shard's column window overhang beyond ``halo_max_ratio * C``
-    forces the all_gather fallback.
+    forces the all_gather fallback. Explicit ``row_splits``/``col_splits``
+    pin the layout so chains of rectangular operators (AMG's R/A/P) share
+    vector spaces without repacking.
     """
     from ..config import settings
 
@@ -237,16 +548,19 @@ def shard_csr(
     m, n = A.shape
     nnz = data.shape[0]
 
-    if balanced and nnz > 0:
-        row_splits = balanced_row_splits(indptr, S)
+    if row_splits is None:
+        if balanced and nnz > 0:
+            row_splits = balanced_row_splits(indptr, S)
+        else:
+            row_splits = equal_row_splits(m, S)
     else:
-        row_splits = equal_row_splits(m, S)
+        row_splits = np.asarray(row_splits, dtype=np.int64)
     # x follows an equal split of the column space; for square matrices this
     # is aligned with the row space so solver vectors live in one layout.
-    if m == n:
-        col_splits = row_splits
+    if col_splits is None:
+        col_splits = row_splits if m == n else equal_row_splits(n, S)
     else:
-        col_splits = equal_row_splits(n, S)
+        col_splits = np.asarray(col_splits, dtype=np.int64)
 
     R = max(int(np.max(np.diff(row_splits))), 1)
     C = max(int(np.max(np.diff(col_splits))), 1)
@@ -259,20 +573,27 @@ def shard_csr(
         indices.astype(np.int64) - col_splits[col_shard]
     )
 
-    # Per-shard window -> halo width (MinMaxImage analog).
-    H = 0
+    # Per-shard column windows -> halo widths (MinMaxImage analog,
+    # partition.py:139-214). settings.precise_windows keeps the left/right
+    # overhangs separate (tighter slabs on asymmetric bands, at the cost of
+    # the exact per-side analysis — the LEGATE_SPARSE_PRECISE_IMAGES analog);
+    # the default collapses them to one symmetric width.
+    windows = column_windows(indptr, pad_cols, row_splits)
+    HL = HR = 0
     mode = "halo"
     for s in range(S):
-        lo, hi = int(indptr[row_splits[s]]), int(indptr[row_splits[s + 1]])
+        lo, hi = windows[s]
         if hi <= lo:
             continue
-        seg = pad_cols[lo:hi]
-        H = max(H, int(s * C - seg.min()), int(seg.max() + 1 - (s + 1) * C))
+        HL = max(HL, int(s * C - lo))
+        HR = max(HR, int(hi - (s + 1) * C))
+    if not settings.precise_windows:
+        HL = HR = max(HL, HR)
     if S == 1:
-        H = 0
-    if H > halo_max_ratio * C:
+        HL = HR = 0
+    if HL + HR > 2 * halo_max_ratio * C:
         mode = "gather"
-        H = 0
+        HL = HR = 0
 
     # Row degree stats for layout choice.
     counts = np.diff(indptr)
@@ -288,7 +609,7 @@ def shard_csr(
         ]
     )
     dt = data.dtype
-    idt = np.int32 if S * max(R, C) + 2 * H < 2**31 else np.int64
+    idt = np.int32 if S * max(R, C) + HL + HR < 2**31 else np.int64
     sharding2 = NamedSharding(mesh, P(axis, None))
     sharding3 = NamedSharding(mesh, P(axis, None, None))
 
@@ -300,52 +621,50 @@ def shard_csr(
         col_splits=col_splits,
         R=R,
         C=C,
-        H=H,
+        HL=HL,
+        HR=HR,
         mode=mode,
         layout=layout,
         dtype=np.dtype(dt),
     )
 
-    def to_local(pc, s):
-        """Padded-space col ids -> the shard's slab coordinates."""
-        if mode == "gather":
-            return pc  # slab is the full [S*C] gathered x
-        return pc - (s * C - H)  # slab is [C + 2H] starting at s*C - H
+    # Vectorized layout construction: one pass of repeat/searchsorted/scatter
+    # over the nnz (no per-row Python loops — a 36M-row matrix lays out in
+    # seconds of host time, like ops/conv.csr_to_ell).
+    counts = np.diff(indptr)
+    nnz_row = np.repeat(np.arange(m, dtype=np.int64), counts)  # global row/nnz
+    nnz_shard = np.clip(
+        np.searchsorted(row_splits, nnz_row, side="right") - 1, 0, S - 1
+    )
+    local_row = nnz_row - row_splits[nnz_shard]
+    if mode == "gather":
+        local_col = pad_cols  # slab is the full [S*C] gathered x
+    else:  # slab is [C + 2H] starting at shard*C - H
+        local_col = pad_cols - (nnz_shard * C - HL)
 
     if layout == "ell":
         k = max(kmax, 1)
+        pos_in_row = np.arange(nnz, dtype=np.int64) - np.repeat(
+            indptr[:-1].astype(np.int64), counts
+        )
         ell_idx = np.zeros((S, R, k), dtype=idt)
         ell_val = np.zeros((S, R, k), dtype=dt)
-        for s in range(S):
-            r0, r1 = int(row_splits[s]), int(row_splits[s + 1])
-            for li, r in enumerate(range(r0, r1)):
-                lo, hi = int(indptr[r]), int(indptr[r + 1])
-                if hi > lo:
-                    ell_idx[s, li, : hi - lo] = to_local(pad_cols[lo:hi], s)
-                    ell_val[s, li, : hi - lo] = data[lo:hi]
+        ell_idx[nnz_shard, local_row, pos_in_row] = local_col
+        ell_val[nnz_shard, local_row, pos_in_row] = data
         dist.ell_idx = jax.device_put(ell_idx, sharding3)
         dist.ell_val = jax.device_put(ell_val, sharding3)
     else:
         K = max(int(shard_nnz.max()), 1)
-        nz_rows = np.full((S, K), R - 1, dtype=idt)  # pad rows -> last row
+        shard_nnz_start = indptr[row_splits[:-1]].astype(np.int64)
+        slot = np.arange(nnz, dtype=np.int64) - shard_nnz_start[nnz_shard]
+        # padding entries: row R-1 (>= any real local row id, keeps sorted
+        # order for segment_sum), col 0, val 0
+        nz_rows = np.full((S, K), R - 1, dtype=idt)
         nz_cols = np.zeros((S, K), dtype=idt)
         nz_vals = np.zeros((S, K), dtype=dt)
-        for s in range(S):
-            r0, r1 = int(row_splits[s]), int(row_splits[s + 1])
-            lo, hi = int(indptr[r0]), int(indptr[r1])
-            cnt = hi - lo
-            if cnt:
-                local_rows = (
-                    np.searchsorted(indptr, np.arange(lo, hi), side="right")
-                    - 1
-                    - r0
-                )
-                nz_rows[s, :cnt] = local_rows
-                nz_cols[s, :cnt] = to_local(pad_cols[lo:hi], s)
-                nz_vals[s, :cnt] = data[lo:hi]
-            # padding entries: row R-1, col 0, val 0 (sorted order preserved
-            # because padding rows come after all real rows only when the last
-            # block is full; use row R-1 which is >= any local row id)
+        nz_rows[nnz_shard, slot] = local_row
+        nz_cols[nnz_shard, slot] = local_col
+        nz_vals[nnz_shard, slot] = data
         dist.nz_rows = jax.device_put(nz_rows, sharding2)
         dist.nz_cols = jax.device_put(nz_cols, sharding2)
         dist.nz_vals = jax.device_put(nz_vals, sharding2)
@@ -355,42 +674,39 @@ def shard_csr(
 # ---------------------------------------------------------------------------
 # Distributed CG — the full "training step" over the mesh (solver north star).
 # ---------------------------------------------------------------------------
-def dist_cg(
+def make_dist_cg(
     A: DistCSR,
-    b,
-    x0=None,
     tol: float = 1e-8,
+    atol: float = 0.0,
     maxiter: int | None = None,
     conv_test_iters: int = 25,
+    M=None,
 ):
-    """Conjugate gradient over the mesh.
+    """Build the compiled mesh-CG program once; returns run(bp, xp).
 
-    Mirrors ``linalg.cg`` (reference linalg.py:499) but every vector is a
-    padded mesh-sharded array and every reduction (dot products, norms) is a
-    GSPMD ``psum`` inserted by XLA. One compiled ``lax.while_loop``; the host
-    syncs once at the end — strictly less blocking than the reference's
-    every-25-iterations future read.
+    Callers that time repeated solves (benchmarks) should hold on to the
+    returned function — each call to :func:`dist_cg` builds a fresh
+    ``jax.jit`` wrapper and therefore recompiles.
     """
-    bp = b if isinstance(b, jax.Array) and b.shape == (A.m_pad,) else A.pad_out_vector(np.asarray(b))
-    n = A.shape[0]
     if maxiter is None:
-        maxiter = n * 10
-    xp = (
-        jnp.zeros_like(bp)
-        if x0 is None
-        else (x0 if isinstance(x0, jax.Array) and x0.shape == (A.m_pad,) else A.pad_out_vector(np.asarray(x0)))
-    )
+        maxiter = A.shape[0] * 10
+    precond = M if M is not None else (lambda r: r)
 
     @jax.jit
     def run(bp, xp):
         r = bp - A.spmv_padded(xp)
-        tol2 = jnp.asarray(tol, dtype=r.dtype) ** 2
+        bnorm2 = jnp.real(jnp.vdot(bp, bp))
+        tol2 = jnp.maximum(
+            jnp.asarray(tol, dtype=bnorm2.dtype) ** 2 * bnorm2,
+            jnp.asarray(atol, dtype=bnorm2.dtype) ** 2,
+        )
 
         def body(state):
             x, r, p, rho, iters = state
-            rho_new = jnp.vdot(r, r)
+            z = precond(r)
+            rho_new = jnp.vdot(r, z)
             beta = rho_new / jnp.where(rho == 0, 1, rho)
-            p = jnp.where(iters == 0, r, r + beta * p)
+            p = jnp.where(iters == 0, z, z + beta * p)
             q = A.spmv_padded(p)
             pq = jnp.vdot(p, q)
             alpha = rho_new / jnp.where(pq == 0, 1, pq)
@@ -405,7 +721,43 @@ def dist_cg(
 
         state = (xp, r, jnp.zeros_like(bp), jnp.zeros((), bp.dtype), jnp.zeros((), jnp.int32))
         x, r, _, _, iters = jax.lax.while_loop(cond, body, state)
-        return x, iters
+        rnorm2 = jnp.real(jnp.vdot(r, r))
+        return x, iters, rnorm2 < tol2
 
-    xp, iters = run(bp, xp)
-    return xp, int(iters)
+    return run
+
+
+def dist_cg(
+    A: DistCSR,
+    b,
+    x0=None,
+    tol: float = 1e-8,
+    atol: float = 0.0,
+    maxiter: int | None = None,
+    conv_test_iters: int = 25,
+    M=None,
+):
+    """(Preconditioned) conjugate gradient over the mesh.
+
+    Mirrors ``linalg.cg`` (reference linalg.py:499) but every vector is a
+    padded mesh-sharded array and every reduction (dot products, norms) is a
+    GSPMD ``psum`` inserted by XLA. One compiled ``lax.while_loop``; the host
+    syncs once at the end — strictly less blocking than the reference's
+    every-25-iterations future read.
+
+    ``M``: optional traceable preconditioner on padded vectors
+    (zp = M(rp)) — e.g. a distributed AMG V-cycle. Convergence uses scipy
+    semantics: ||r|| < max(tol * ||b||, atol). Returns (xp, iters, converged).
+    """
+    bp = b if isinstance(b, jax.Array) and b.shape == (A.m_pad,) else A.pad_out_vector(np.asarray(b))
+    xp = (
+        jnp.zeros_like(bp)
+        if x0 is None
+        else (x0 if isinstance(x0, jax.Array) and x0.shape == (A.m_pad,) else A.pad_out_vector(np.asarray(x0)))
+    )
+    run = make_dist_cg(
+        A, tol=tol, atol=atol, maxiter=maxiter,
+        conv_test_iters=conv_test_iters, M=M,
+    )
+    xp, iters, converged = run(bp, xp)
+    return xp, int(iters), bool(converged)

@@ -66,17 +66,26 @@ def dist_sort(keys, payloads, mesh: Mesh | None = None, axis: str = "shards"):
             perm = pairs + [(j, i) for i, j in pairs]
             other_k = jax.lax.ppermute(k, axis, perm)
             other_ps = [jax.lax.ppermute(p, axis, perm) for p in ps]
-            both_k = jnp.concatenate([k, other_k])
-            order2 = jnp.argsort(both_k, stable=True)
-            lows, highs = order2[:L], order2[L:]
             q = me - start
             paired = (q >= 0) & (q < len(pairs) * 2)
             is_left = paired & (q % 2 == 0)
+            # Build the 2L merge input in canonical global (left, right)
+            # order on BOTH partners, so the stable argsort breaks ties
+            # identically and the two halves partition the pair's payloads
+            # exactly (duplicate keys straddling the boundary stay attached
+            # to their own payloads).
+            both_k = jnp.concatenate(
+                [jnp.where(is_left, k, other_k), jnp.where(is_left, other_k, k)]
+            )
+            order2 = jnp.argsort(both_k, stable=True)
+            lows, highs = order2[:L], order2[L:]
             idx = jnp.where(is_left, lows, highs)
             k = jnp.where(paired, both_k[idx], k)
             new_ps = []
             for p, op in zip(ps, other_ps):
-                both_p = jnp.concatenate([p, op])
+                both_p = jnp.concatenate(
+                    [jnp.where(is_left, p, op), jnp.where(is_left, op, p)]
+                )
                 new_ps.append(jnp.where(paired, both_p[idx], p))
             ps = new_ps
         return (k[None], *[p[None] for p in ps])
@@ -102,8 +111,14 @@ def dist_sort_host(keys, payloads=(), num_shards: int | None = None):
     """Convenience wrapper: host arrays in, globally sorted host arrays out.
 
     Pads to a shard-divisible length with sentinels, runs ``dist_sort`` over
-    the default mesh, strips padding.
+    the default mesh, strips padding. ``settings.force_serial`` pins the
+    sort to a single shard (the reference's force_serial special case for
+    tiny inputs / debugging, coo.py:242).
     """
+    from ..config import settings
+
+    if settings.force_serial:
+        num_shards = 1
     mesh = get_mesh(num_shards)
     S = int(mesh.devices.size)
     keys = np.asarray(keys)

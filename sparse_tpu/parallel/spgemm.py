@@ -1,0 +1,195 @@
+"""Distributed SpGEMM over the device mesh.
+
+Reference analogs:
+  * row-gather CSR x CSR (``/root/reference/sparse/csr.py:1317-1490``): each
+    rank computes a LOCAL CSR tile of ``A_rowblock @ B`` (GPU path: per-rank
+    cuSPARSE SpGEMM), then a Python-side FutureMap scan stitches the local
+    ``pos`` arrays into the global CSR (csr.py:1377-1389).
+  * 3-phase 2-D CSR x CSC (``csr.py:1495-1728``): a (gx, gy) processor grid;
+    B's rows replicated along grid-j, C's columns along grid-i; local tiles
+    -> comm plan -> shuffle gather.
+
+TPU-native redesign: sparse output sizes are data-dependent, so SpGEMM is a
+setup-phase op here exactly as in the reference (which blocks on nnz futures
+at csr.py:996 and scans pos on the control thread). Each tile is computed by
+the single-device ESC kernel (``ops.spgemm``) ON ITS OWN DEVICE of the mesh
+— per-shard inputs are committed to device s, so XLA dispatches the tile
+programs concurrently across the mesh — and the host performs the pos-scan
+stitch. The solver-facing hot path stays in ``parallel.dist`` (static-shape
+SPMD); this module is how distributed hierarchies (AMG's Galerkin R@A@P)
+get BUILT.
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+
+from .mesh import get_mesh, get_mesh_2d
+from .partition import balanced_row_splits, equal_row_splits
+
+
+def _row_block(indptr, indices, data, r0: int, r1: int):
+    """Host-side zero-copy row slice of a CSR triple."""
+    lo, hi = int(indptr[r0]), int(indptr[r1])
+    return indptr[r0 : r1 + 1] - indptr[r0], indices[lo:hi], data[lo:hi]
+
+
+def dist_spgemm(A, B, mesh=None, balanced: bool = True):
+    """C = A @ B (both ``csr_array``) with A row-split over the mesh.
+
+    The row-gather algorithm (csr.py:1390-1490): shard s computes
+    ``A[rows_s] @ B`` as a local CSR tile on device s (B replicated, like
+    the reference's gathered-C), then the host stitches tiles with one pos
+    scan. Returns a ``csr_array``.
+    """
+    import sparse_tpu
+
+    if mesh is None:
+        mesh = get_mesh()
+    devs = list(mesh.devices.reshape(-1))
+    S = len(devs)
+    m, k = A.shape
+    k2, n = B.shape
+    if k != k2:
+        raise ValueError(f"dimension mismatch: {A.shape} @ {B.shape}")
+
+    indptr = np.asarray(A.indptr)
+    indices = np.asarray(A.indices)
+    data = np.asarray(A.data)
+    splits = (
+        balanced_row_splits(indptr, S) if balanced else equal_row_splits(m, S)
+    )
+
+    from ..ops.spgemm import spgemm_csr_csr
+
+    tiles = []
+    for s in range(S):
+        r0, r1 = int(splits[s]), int(splits[s + 1])
+        if r1 <= r0:
+            tiles.append(None)
+            continue
+        ip, ix, dv = _row_block(indptr, indices, data, r0, r1)
+        dev = devs[s]
+        args = [jax.device_put(np.ascontiguousarray(a), dev) for a in (ip, ix, dv)]
+        bargs = [jax.device_put(np.asarray(a), dev) for a in (B.indptr, B.indices, B.data)]
+        tiles.append(
+            spgemm_csr_csr(
+                args[0], args[1], args[2],
+                bargs[0], bargs[1], bargs[2],
+                (r1 - r0, k), (k, n),
+            )
+        )
+    # Host pos-scan stitch (scan_local_results_and_scale_pos analog).
+    out_indptr = np.zeros(m + 1, dtype=np.int64)
+    parts_ix, parts_dv = [], []
+    offset = 0
+    for s in range(S):
+        r0, r1 = int(splits[s]), int(splits[s + 1])
+        if tiles[s] is None:
+            out_indptr[r0 + 1 : r1 + 1] = offset
+            continue
+        tip, tix, tdv = (np.asarray(t) for t in tiles[s])
+        out_indptr[r0 + 1 : r1 + 1] = tip[1:].astype(np.int64) + offset
+        offset += int(tip[-1])
+        parts_ix.append(tix)
+        parts_dv.append(tdv)
+    out_indices = (
+        np.concatenate(parts_ix) if parts_ix else np.zeros(0, dtype=np.int32)
+    )
+    out_data = (
+        np.concatenate(parts_dv)
+        if parts_dv
+        else np.zeros(0, dtype=np.result_type(A.dtype, B.dtype))
+    )
+    return sparse_tpu.csr_array.from_parts(
+        out_data, out_indices, out_indptr, (m, n)
+    )
+
+
+def dist_spgemm_2d(A, B, mesh2d=None):
+    """C = A @ B on a 2-D (gx, gy) processor grid — the CSR x CSC analog.
+
+    Tile (i, j) = ``A[rowblock_i] @ B[:, colblock_j]`` computed on device
+    (i, j): A's row blocks are replicated along grid-j and B's column blocks
+    along grid-i, matching the reference's 2-D replicated layout
+    (csr.py:1495-1571). B may be ``csc_array`` (column slicing is an indptr
+    slice) or ``csr_array`` (converted once). The shuffle phase
+    (csr.py:1592-1728) collapses into the host stitch: tiles of one row
+    block concatenate in grid-j order, already column-sorted.
+    """
+    import sparse_tpu
+
+    if mesh2d is None:
+        mesh2d = get_mesh_2d()
+    grid = mesh2d.devices
+    gx, gy = grid.shape
+    m, k = A.shape
+    k2, n = B.shape
+    if k != k2:
+        raise ValueError(f"dimension mismatch: {A.shape} @ {B.shape}")
+
+    Bcsc = B.tocsc()
+    b_indptr = np.asarray(Bcsc.indptr)
+    b_indices = np.asarray(Bcsc.indices)
+    b_data = np.asarray(Bcsc.data)
+
+    a_indptr = np.asarray(A.indptr)
+    a_indices = np.asarray(A.indices)
+    a_data = np.asarray(A.data)
+    row_splits = balanced_row_splits(a_indptr, gx)
+    col_splits = equal_row_splits(n, gy)
+
+    from ..ops.conv import csr_to_csc
+    from ..ops.spgemm import spgemm_csr_csr
+
+    tiles = {}
+    for i in range(gx):
+        r0, r1 = int(row_splits[i]), int(row_splits[i + 1])
+        if r1 <= r0:
+            continue
+        aip, aix, adv = _row_block(a_indptr, a_indices, a_data, r0, r1)
+        for j in range(gy):
+            c0, c1 = int(col_splits[j]), int(col_splits[j + 1])
+            if c1 <= c0:
+                continue
+            dev = grid[i, j]
+            # column block of B as a CSC triple, then to CSR on-device
+            bip, bix, bdv = _row_block(b_indptr, b_indices, b_data, c0, c1)
+            dev_put = lambda a: jax.device_put(np.ascontiguousarray(a), dev)
+            # the CSC triple of B[:, c0:c1] is the CSR of its transpose
+            # [c, k]; csr_to_csc of that transpose is the CSR of the block
+            tb_ip, tb_ix, tb_dv = csr_to_csc(
+                dev_put(bip), dev_put(bix), dev_put(bdv), (c1 - c0, k)
+            )
+            tiles[(i, j)] = spgemm_csr_csr(
+                dev_put(aip), dev_put(aix), dev_put(adv),
+                tb_ip, tb_ix, tb_dv,
+                (r1 - r0, k), (k, c1 - c0),
+            )
+
+    # Stitch: per row block, merge grid-j tiles row-by-row (vectorized
+    # lexsort assembly — the host-side analog of the 3-phase shuffle).
+    rows_all, cols_all, vals_all = [], [], []
+    for (i, j), (tip, tix, tdv) in tiles.items():
+        tip = np.asarray(tip).astype(np.int64)
+        tix = np.asarray(tix).astype(np.int64)
+        tdv = np.asarray(tdv)
+        cnt = np.diff(tip)
+        trows = np.repeat(np.arange(len(cnt), dtype=np.int64), cnt)
+        rows_all.append(trows + int(row_splits[i]))
+        cols_all.append(tix + int(col_splits[j]))
+        vals_all.append(tdv)
+    if rows_all:
+        rows = np.concatenate(rows_all)
+        cols = np.concatenate(cols_all)
+        vals = np.concatenate(vals_all)
+        order = np.lexsort((cols, rows))
+        rows, cols, vals = rows[order], cols[order], vals[order]
+    else:
+        rows = cols = np.zeros(0, dtype=np.int64)
+        vals = np.zeros(0, dtype=np.result_type(A.dtype, B.dtype))
+    indptr = np.zeros(m + 1, dtype=np.int64)
+    np.add.at(indptr, rows + 1, 1)
+    indptr = np.cumsum(indptr)
+    return sparse_tpu.csr_array.from_parts(vals, cols, indptr, (m, n))

@@ -29,6 +29,27 @@ def test_csr_dot_vec_dtype(dtype):
     assert np.allclose(np.asarray(arr @ vec), s @ vec, atol=1e-5)
 
 
+@pytest.mark.parametrize("filename", test_mtx_files)
+def test_csr_dot_vec_domain_part(filename):
+    """The reference's spmv_domain_part=True axis
+    (tests/integration/test_csr_dot.py:27-35): the contraction-split kernel
+    must match scipy."""
+    arr = sparse.io.mmread(filename).tocsr()
+    s = sci_io.mmread(filename).tocsr()
+    vec = np.random.default_rng(0).random((arr.shape[1],))
+    got = arr.dot(vec, spmv_domain_part=True)
+    assert np.allclose(np.asarray(got), s @ vec)
+
+
+@pytest.mark.parametrize("dtype", types)
+def test_csr_dot_domain_part_dtype(dtype):
+    s = sample_csr(31, 17, dtype=dtype, seed=3)
+    arr = sparse.csr_array(s)
+    vec = sample_vec(17, dtype=dtype, seed=7)
+    got = arr.dot(vec, spmv_domain_part=True)
+    assert np.allclose(np.asarray(got), s @ vec, atol=1e-5)
+
+
 @pytest.mark.parametrize("dtype", types)
 def test_csr_spmm(dtype):
     s = sample_csr(19, 23, dtype=dtype, seed=5)

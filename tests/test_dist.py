@@ -85,10 +85,11 @@ def test_dist_cg_poisson(num_shards):
     rng = np.random.default_rng(0)
     xtrue = rng.standard_normal(s.shape[0])
     b = s @ xtrue
-    xp, iters = dist_cg(D, b, tol=1e-10, maxiter=2000)
+    xp, iters, converged = dist_cg(D, b, tol=1e-8, maxiter=2000)
     x = D.unpad_vector(xp)
     np.testing.assert_allclose(x, xtrue, rtol=1e-6, atol=1e-7)
     assert iters < 2000
+    assert converged
 
 
 def test_dist_matches_single_chip():
@@ -97,3 +98,40 @@ def test_dist_matches_single_chip():
     D = shard_csr(A, mesh=get_mesh(8))
     x = np.random.default_rng(4).standard_normal(s.shape[0])
     np.testing.assert_allclose(D.dot(x), np.asarray(A @ x), rtol=1e-12)
+
+
+def test_precise_windows_asymmetric_halo(monkeypatch):
+    """settings.precise_windows keeps left/right halos separate: an upper
+    bidiagonal matrix needs no left halo (LEGATE_SPARSE_PRECISE_IMAGES
+    analog, partition.py:152-160)."""
+    import scipy.sparse as sp
+
+    from sparse_tpu.config import settings
+
+    n = 64
+    s = sp.diags([np.full(n, 2.0), np.full(n - 1, -1.0)], [0, 1], format="csr")
+    x = np.random.default_rng(3).standard_normal(n)
+    monkeypatch.setattr(settings, "precise_windows", True)
+    D = shard_csr(sparse_tpu.csr_array(s), mesh=get_mesh(8), balanced=False)
+    assert D.HL == 0 and D.HR >= 1
+    np.testing.assert_allclose(D.dot(x), s @ x, rtol=1e-12)
+    monkeypatch.setattr(settings, "precise_windows", False)
+    D2 = shard_csr(sparse_tpu.csr_array(s), mesh=get_mesh(8), balanced=False)
+    assert D2.HL == D2.HR
+    np.testing.assert_allclose(D2.dot(x), s @ x, rtol=1e-12)
+
+
+def test_force_serial_sort(monkeypatch):
+    """settings.force_serial pins the distributed sort to one shard
+    (reference coo.py:242)."""
+    from sparse_tpu.config import settings
+    from sparse_tpu.parallel.sort import dist_sort_host
+
+    monkeypatch.setattr(settings, "force_serial", True)
+    rng = np.random.default_rng(5)
+    keys = rng.integers(0, 50, size=101)
+    payload = rng.standard_normal(101)
+    sk, (spay,) = dist_sort_host(keys, (payload,))
+    order = np.argsort(keys, kind="stable")
+    np.testing.assert_array_equal(sk, keys[order])
+    np.testing.assert_allclose(spay, payload[order])
