@@ -54,6 +54,125 @@ def run_size(n: int, iters: int):
     return best
 
 
+# Approximate HBM bandwidth per device kind for the roofline fraction.
+# Labeled approximate: the fraction is a diagnostic, not a spec claim.
+_HBM_GBPS = {
+    "TPU v2": 700.0,
+    "TPU v3": 900.0,
+    "TPU v4": 1228.0,
+    "TPU v5 lite": 819.0,
+    "TPU v5e": 819.0,
+    "TPU v5p": 2765.0,
+    "TPU v6 lite": 1640.0,
+    "TPU v6e": 1640.0,
+}
+
+
+def _time_kernel(step, x, reps=3, slope_k=16):
+    """Seconds per application of ``step`` (an [N]->[N] map).
+
+    Host-chained dispatch (v = step(v) repeatedly, data dependence serializes
+    on device) with ONE fence per chain; per-op time comes from the slope
+    between a 1-op and a (1+slope_k)-op chain, which cancels the fence cost —
+    a full round trip through a remote-tunnel backend, easily 100x a fast
+    kernel. Each application is scaled by 0.125 so values decay instead of
+    overflowing. (A lax.fori_loop would amortize the same way, but
+    segment_sum inside fori_loop faults the TPU worker on current libtpu.)
+    """
+    import jax.numpy as jnp
+
+    scale = jnp.asarray(0.125, x.dtype)
+
+    def chain(k):
+        best = float("inf")
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            v = x
+            for _ in range(k):
+                v = step(v) * scale
+            float(v.reshape(-1)[-1])
+            best = min(best, time.perf_counter() - t0)
+        return best
+
+    v = step(x)
+    float(v.reshape(-1)[-1])  # compile + warm
+    t1 = chain(1)
+    if t1 > 0.5:  # slow kernel: fence cost is noise, one-op chain is enough
+        return t1
+    tk = chain(1 + slope_k)
+    return max(tk - t1, 1e-9) / slope_k
+
+
+def kernel_sweep(n: int, platform: str) -> dict:
+    """SpMV kernel comparison on the n^2-row 5-point Laplacian.
+
+    Reports GFLOP/s for the segment (general CSR), ELL-gather, DIA (XLA)
+    and Pallas paths, plus each path's fraction of the device's approximate
+    HBM roofline (VERDICT r1 #6). Pallas variants only run natively on TPU.
+    """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from sparse_tpu.models.poisson import laplacian_2d_dia, laplacian_2d_ell
+    from sparse_tpu.ops.dia_spmv import dia_spmv_xla
+    from sparse_tpu.ops.spmv import csr_spmv_ell, csr_spmv_segment
+
+    N = n * n
+    ell_idx, ell_val = laplacian_2d_ell(n)
+    planes, offsets = laplacian_2d_dia(n)
+    x = jnp.ones((N,), dtype=jnp.float32)
+    nnz = int(jnp.sum(ell_val != 0))
+    flops = 2.0 * nnz
+
+    # bytes per SpMV pass (f32 vals / i32 ids): value+index (or DIA planes)
+    # loads + one x load + one y store
+    ell_bytes = nnz * 8 + N * 8
+    dia_bytes = planes.size * 4 + N * 8
+
+    indptr = jnp.arange(0, N * ell_idx.shape[1] + 1, ell_idx.shape[1], dtype=jnp.int32)
+    cols = ell_idx.reshape(-1)
+    vals = ell_val.reshape(-1)
+
+    out = {}
+
+    def record(name, seconds, bytes_moved):
+        bw_gbps = _HBM_GBPS.get(
+            getattr(jax.devices()[0], "device_kind", ""), None
+        )
+        entry = {"gflops": round(flops / seconds / 1e9, 2)}
+        if bw_gbps:
+            entry["hbm_frac"] = round(bytes_moved / seconds / (bw_gbps * 1e9), 3)
+        out[name] = entry
+
+    def attempt(name, step, bytes_moved):
+        try:
+            record(name, _time_kernel(step, x), bytes_moved)
+        except Exception as e:  # one kernel failing must not hide the rest
+            out[name] = {"error": str(e)[:200]}
+            traceback.print_exc(file=sys.stderr)
+
+    attempt("segment", lambda xx: csr_spmv_segment(indptr, cols, vals, xx, N), ell_bytes)
+    attempt("ell_xla", lambda xx: csr_spmv_ell(ell_idx, ell_val, xx), ell_bytes)
+    attempt("dia_xla", lambda xx: dia_spmv_xla(planes, offsets, xx, (N, N)), dia_bytes)
+
+    if platform == "tpu":
+        from sparse_tpu.kernels.dia_spmv import dia_spmv_pallas
+        from sparse_tpu.kernels.ell_spmv import ell_spmv_pallas
+
+        attempt(
+            "dia_pallas",
+            lambda xx: dia_spmv_pallas(planes, offsets, xx, (N, N)),
+            dia_bytes,
+        )
+        attempt(
+            "ell_pallas",
+            lambda xx: ell_spmv_pallas(ell_idx, ell_val, xx, band=n),
+            ell_bytes,
+        )
+    return out
+
+
 def worker(platform_arg: str) -> None:
     """Run the measurement on one platform; print the JSON line on success.
 
@@ -79,16 +198,19 @@ def worker(platform_arg: str) -> None:
             print(f"bench worker: size {n} failed; trying next", file=sys.stderr)
             continue
         vs = (best * n * n) / (BASELINE_ITERS_PER_S * BASELINE_N * BASELINE_N)
-        print(
-            json.dumps(
-                {
-                    "metric": f"cg_iters_per_s_pde{n}_{platform}",
-                    "value": round(best, 2),
-                    "unit": "iters/s",
-                    "vs_baseline": round(vs, 3),
-                }
-            )
-        )
+        rec = {
+            "metric": f"cg_iters_per_s_pde{n}_{platform}",
+            "value": round(best, 2),
+            "unit": "iters/s",
+            "vs_baseline": round(vs, 3),
+        }
+        try:  # per-kernel GFLOPS/roofline diagnostics (never fatal)
+            sweep_n = min(n, 2000) if platform == "tpu" else 256
+            rec["kernels"] = kernel_sweep(sweep_n, platform)
+            rec["kernels_n"] = sweep_n
+        except Exception:
+            traceback.print_exc(file=sys.stderr)
+        print(json.dumps(rec))
         sys.stdout.flush()
         return
     sys.exit(3)  # every size failed

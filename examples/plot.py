@@ -1,0 +1,82 @@
+"""Visualization harness: draw a grid graph with its MIS/aggregates.
+
+Reference analog: ``examples/plot.py`` — trimesh + draw_graph + plot_mis
+over a structured mesh, coloring MIS nodes. Headless-friendly: figures save
+to PNG (``-o``) instead of requiring a display.
+
+Run:  python examples/plot.py -n 8 -o mis.png
+"""
+
+import argparse
+import math
+
+import numpy as np
+
+
+def trimesh(vertices, indices, ax):
+    from matplotlib import collections
+
+    vertices, indices = np.asarray(vertices), np.asarray(indices)
+    triangles = vertices[indices.ravel(), :].reshape(
+        (indices.shape[0], indices.shape[1], 2)
+    )
+    col = collections.PolyCollection(
+        triangles, lw=1, edgecolor="black", facecolor="gray", alpha=0.5
+    )
+    ax.add_collection(col, autolim=True)
+    ax.axis("off")
+    ax.autoscale_view()
+
+
+def draw_graph(mesh, P, out=None, labels=True):
+    """mesh: COO adjacency over an N*N grid; P: 0/1 per-node coloring."""
+    import matplotlib
+
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+
+    N = int(math.sqrt(mesh.shape[0]))
+    grid = np.meshgrid(range(N), range(N))
+    V = np.vstack(list(map(np.ravel, grid))).T
+    E = np.vstack((np.asarray(mesh.row), np.asarray(mesh.col))).T
+    c = ["red" if p == 0 else "green" for p in P]
+
+    fig = plt.figure()
+    ax = plt.gca()
+    trimesh(V, E, ax)
+    ax.scatter(V[:, 0], V[:, 1], marker="o", s=400, c=c)
+    if labels:
+        for i in range(V.shape[0]):
+            ax.annotate(str(i), (V[i, 0], V[i, 1]), ha="center", va="center")
+    if out:
+        fig.savefig(out, dpi=120, bbox_inches="tight")
+        print(f"wrote {out}")
+    else:
+        plt.show()
+    plt.close(fig)
+
+
+def plot_mis(A, out=None):
+    from amg import maximal_independent_set
+
+    mis = maximal_independent_set(A.tocsr())
+    P = np.zeros(A.shape[0])
+    P[np.asarray(mis)] = 1
+    draw_graph(A.tocoo(), P, out=out)
+    return mis
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.path.insert(0, "examples")
+    parser = argparse.ArgumentParser()
+    parser.add_argument("-n", type=int, default=8)
+    parser.add_argument("-o", "--out", default="mis.png")
+    args, _ = parser.parse_known_args()
+
+    from amg import poisson2D
+
+    A = poisson2D(args.n)
+    mis = plot_mis(A, out=args.out)
+    print(f"MIS size {len(mis)} of {A.shape[0]} nodes")

@@ -1,0 +1,6 @@
+"""pyamg -> sparse_tpu external-ecosystem adapter.
+
+Reference analog: ``/root/reference/examples/pyamg_to_legate/`` — route
+pyamg's smoothed-aggregation building blocks through the accelerated sparse
+library by patching every imported alias of the target symbols.
+"""

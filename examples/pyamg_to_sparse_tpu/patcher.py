@@ -1,0 +1,34 @@
+"""Patch every imported alias of a symbol across loaded modules.
+
+Reference analog: ``examples/pyamg_to_legate/patcher.py`` (itself the
+standard unittest.mock recipe for replacing a function everywhere it has
+already been imported, including ``from x import y as z`` aliases).
+"""
+
+from __future__ import annotations
+
+import sys
+import unittest.mock as mock
+
+
+def patch_symbol_everywhere(target, replacement, match_prefix=None, skip_substring="test"):
+    """Start a mock patcher for every module-level binding of ``target``.
+
+    Walks ``sys.modules``, finds names bound to ``target`` (however they
+    were imported), and patches each to call ``replacement``. Returns the
+    list of active patchers; call ``.stop()`` on each to undo.
+    """
+    patchers = []
+    for module in list(sys.modules.values()):
+        name = getattr(module, "__name__", "")
+        if match_prefix is not None and not name.startswith(match_prefix):
+            continue
+        if skip_substring is not None and skip_substring in name:
+            continue
+        for local_name, local in list(getattr(module, "__dict__", {}).items()):
+            if local is target:
+                p = mock.patch(f"{name}.{local_name}", autospec=True)
+                m = p.start()
+                m.side_effect = replacement
+                patchers.append(p)
+    return patchers

@@ -1,0 +1,105 @@
+"""sparse_tpu-backed implementations of pyamg's smoothed-aggregation core.
+
+Reference analog: ``examples/pyamg_to_legate/wrapper.py`` — the same six
+entry points pyamg dispatches through (strength of connection, aggregation,
+tentative prolongator, prolongation smoother, Jacobi relaxation, stencil
+gallery), each re-routed to the TPU-native library. The heavy lifting lives
+in ``examples/amg.py`` (tropical-semiring MIS aggregation, SpGEMM Galerkin
+products); this module adapts pyamg's calling conventions and numpy interop,
+and ``patch(pyamg)`` swaps them in everywhere pyamg already imported the
+originals.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+import numpy as np
+
+_EXAMPLES = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _EXAMPLES not in sys.path:
+    sys.path.insert(0, _EXAMPLES)
+
+import amg as _amg  # examples/amg.py: the sparse_tpu AMG building blocks
+import sparse_tpu as sparse
+
+
+def symmetric_strength_of_connection(A, theta=0.0):
+    """pyamg.strength.symmetric_strength_of_connection analog."""
+    return _amg.strength(sparse.csr_array(A.tocsr()), theta=theta)
+
+
+def standard_aggregation(C, **kwargs):
+    """pyamg.aggregation.standard_aggregation analog: MIS(2) aggregation
+    driven by the tropical-semiring SpMV tournament (reference
+    wrapper.py:118-139 PMIS)."""
+    AggOp, mis = _amg.mis_aggregate(sparse.csr_array(C.tocsr()))
+    return AggOp, np.asarray(mis)
+
+
+def fit_candidates(AggOp, B):
+    """pyamg.aggregation.fit_candidates analog."""
+    if not isinstance(AggOp, sparse.SparseArray):
+        AggOp = sparse.csr_array(AggOp.tocsr())
+    return _amg.fit_candidates(AggOp, np.asarray(B))
+
+
+def jacobi_prolongation_smoother(S, T, C, B, omega=4.0 / 3.0, degree=1, **kwargs):
+    """pyamg.aggregation.jacobi_prolongation_smoother analog:
+    P = (I - (omega/rho) D^-1 S)^degree T."""
+    Ss = S if isinstance(S, sparse.SparseArray) else sparse.csr_array(S.tocsr())
+    Ts = T if isinstance(T, sparse.SparseArray) else sparse.csr_array(T.tocsr())
+    P, rho = _amg.smooth_prolongator(Ss, Ts, k=degree, omega=omega)
+    S.rho_D_inv = rho  # cached like the reference (wrapper.py:76)
+    return P
+
+
+def jacobi(A, x, b, iterations=1, omega=1.0):
+    """pyamg.relaxation.relaxation.jacobi analog (in-place on x)."""
+    D = np.asarray(A.diagonal())
+    rho = getattr(A, "rho_D_inv", None)
+    if rho is None:
+        Dinv_A = A.multiply((1.0 / D)[:, None])
+        rho = _amg.estimate_spectral_radius(Dinv_A)
+        A.rho_D_inv = rho
+    for _ in range(iterations):
+        y = np.asarray(A @ x)
+        x += (omega / rho) * (np.asarray(b) - y) / D
+
+
+def stencil_grid(S, grid, dtype=None, format=None):
+    """pyamg.gallery.stencil_grid analog (vectorized assembly)."""
+    A = _amg.stencil_grid(np.asarray(S), tuple(grid))
+    A = sparse.csr_array(A.tocsr()) if not isinstance(A, sparse.SparseArray) else A
+    if dtype is not None:
+        A = A.astype(dtype)
+    return A.asformat(format) if format else A
+
+
+def patch(pyamg):
+    """Swap the sparse_tpu implementations into every alias pyamg's loaded
+    modules hold (reference wrapper.py:200-248)."""
+    _HERE = os.path.dirname(os.path.abspath(__file__))
+    if _HERE not in sys.path:
+        sys.path.insert(0, _HERE)
+    from patcher import patch_symbol_everywhere
+
+    pairs = [
+        (
+            pyamg.strength.symmetric_strength_of_connection,
+            symmetric_strength_of_connection,
+        ),
+        (pyamg.aggregation.standard_aggregation, standard_aggregation),
+        (pyamg.aggregation.fit_candidates, fit_candidates),
+        (
+            pyamg.aggregation.jacobi_prolongation_smoother,
+            jacobi_prolongation_smoother,
+        ),
+        (pyamg.relaxation.relaxation.jacobi, jacobi),
+        (pyamg.gallery.stencil_grid, stencil_grid),
+    ]
+    patchers = []
+    for target, repl in pairs:
+        patchers.extend(patch_symbol_everywhere(target, repl))
+    return patchers

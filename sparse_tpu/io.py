@@ -77,7 +77,13 @@ def mmread(path) -> coo_array:
             from . import native
 
             m, n = int(dims[0]), int(dims[1])
-            count = m * n if symmetry == "general" else n * m - n * (n - 1) // 2
+            if symmetry == "general":
+                count = m * n
+            elif symmetry == "skew-symmetric":
+                # strict lower triangle only (diagonal is implicitly zero)
+                count = n * (n - 1) // 2
+            else:  # symmetric / hermitian: lower triangle incl. diagonal
+                count = n * (n + 1) // 2
             flat = None
             if field != "complex" and count and native.lib() is not None:
                 # native single-pass tokenizer (READ_MTX_TO_COO analog)
@@ -96,11 +102,13 @@ def mmread(path) -> coo_array:
             if symmetry == "general":
                 dense = flat.reshape((n, m)).T
             else:
-                # symmetric array files store the lower triangle column-major:
-                # column j contributes rows j..m-1, in order
+                # symmetric/hermitian array files store the lower triangle
+                # column-major (column j: rows j..m-1); skew-symmetric the
+                # STRICT lower triangle (column j: rows j+1..m-1)
+                lo = 1 if symmetry == "skew-symmetric" else 0
                 dense = np.zeros((m, n), dtype=flat.dtype)
-                c = np.repeat(np.arange(n), m - np.arange(n))
-                r = np.concatenate([np.arange(j, m) for j in range(n)])
+                c = np.repeat(np.arange(n), np.maximum(m - np.arange(n) - lo, 0))
+                r = np.concatenate([np.arange(j + lo, m) for j in range(n)])
                 dense[r, c] = flat
             mask = dense != 0
             rows, cols = np.nonzero(mask)

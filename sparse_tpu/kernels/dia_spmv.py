@@ -52,8 +52,14 @@ def _dia_spmv_pallas(
     """
     m, n = shape
     D = len(offsets)
-    B = _round_up(max(max((abs(int(o)) for o in offsets), default=0), 1), 128)
-    TM = min(tile, _round_up(max(m, 128), 128))
+    # Mosaic DMA alignment: 2-D slices align to the (8, 128) tile, and 1-D
+    # HBM memrefs carry a (1024,) tiling — so the plane count pads to a
+    # multiple of 8 (zero planes, skipped in the compute loop), the row tile
+    # TM to 1024, and the halo B to 512 (making win = TM + 2B and every
+    # slice start g*TM multiples of 1024).
+    Dp = _round_up(D, 8)
+    B = _round_up(max(max((abs(int(o)) for o in offsets), default=0), 1), 512)
+    TM = min(_round_up(tile, 1024), _round_up(max(m, 1024), 1024))
     G = (m + TM - 1) // TM
     m_pad = G * TM
     win = TM + 2 * B
@@ -61,7 +67,7 @@ def _dia_spmv_pallas(
     # Halo-pad data planes and x into a shared padded coordinate system
     # (index j' = j + B); a copy of the inputs, NOT a product intermediate.
     pad_hi = max(m_pad - n, 0) + B
-    data_p = jnp.pad(data, ((0, 0), (B, pad_hi)))[:, : m_pad + 2 * B]
+    data_p = jnp.pad(data, ((0, Dp - D), (B, pad_hi)))[:, : m_pad + 2 * B]
     x_p = jnp.pad(x, (B, pad_hi))[: m_pad + 2 * B]
     out_dt = jnp.result_type(data.dtype, x.dtype)
 
@@ -93,7 +99,7 @@ def _dia_spmv_pallas(
         out_specs=pl.BlockSpec((TM,), lambda g: (g,), memory_space=pltpu.VMEM),
         out_shape=jax.ShapeDtypeStruct((m_pad,), out_dt),
         scratch_shapes=[
-            pltpu.VMEM((D, win), data.dtype),
+            pltpu.VMEM((Dp, win), data.dtype),
             pltpu.VMEM((win,), x.dtype),
             pltpu.SemaphoreType.DMA((2,)),
         ],

@@ -38,9 +38,24 @@ def ell_band(ell_indices, ell_data) -> int:
 
 def ell_spmv_pallas(ell_indices, ell_data, x, band, tile=4096, interpret=None):
     """See ``_ell_spmv_pallas``; ``interpret=None`` auto-selects interpret
-    mode off-TPU (Pallas TPU kernels only compile natively on tpu)."""
+    mode off-TPU (Pallas TPU kernels only compile natively on tpu).
+
+    Mosaic's in-VMEM dynamic gather currently lowers only for single-tile
+    (8, 128) same-shape ``take_along_axis`` — an arbitrary windowed gather
+    (what ELL needs) does not compile on real TPUs yet. Until Mosaic grows
+    multi-tile dynamic_gather, the native-TPU path delegates to the XLA
+    gather formulation (``ops.spmv.csr_spmv_ell``), which lowers to the
+    hardware's HBM gather; the in-kernel-DMA version below remains the
+    interpret-mode/reference implementation and the intended kernel once
+    the lowering exists. DIA-shaped matrices get the true Pallas schedule
+    via ``kernels.dia_spmv`` (static slices, no gather).
+    """
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
+    if not interpret and jax.default_backend() == "tpu":
+        from ..ops.spmv import csr_spmv_ell
+
+        return csr_spmv_ell(ell_indices, ell_data, x)
     return _ell_spmv_pallas(
         ell_indices, ell_data, x, band=int(band), tile=tile, interpret=interpret
     )

@@ -1,4 +1,4 @@
-"""Loader for the native runtime library (``src/sparse_tpu_native.cc``).
+"""Loader for the native runtime library (``sparse_tpu/src/sparse_tpu_native.cc``).
 
 Reference analog: ``sparse/config.py:21-58`` (``LegateSparseLib`` loading
 ``liblegate_sparse.so`` and exposing its C ABI through CFFI). Here the native
@@ -21,7 +21,9 @@ _lib = None
 _tried = False
 
 _PKG_DIR = os.path.dirname(os.path.abspath(__file__))
-_SRC = os.path.join(os.path.dirname(_PKG_DIR), "src", "sparse_tpu_native.cc")
+# source ships as package data so pip-installed copies can rebuild the
+# native library for the local toolchain
+_SRC = os.path.join(_PKG_DIR, "src", "sparse_tpu_native.cc")
 _SO = os.path.join(_PKG_DIR, "_sparse_tpu_native.so")
 
 

@@ -1,0 +1,190 @@
+"""2-D processor-grid algorithms: distributed cdist and quantum set lookup.
+
+Reference analogs:
+  * ``sparse/spatial.py:48-84`` — EUCLIDEAN_CDIST launched on a manual 2-D
+    grid, XA row-tiled over grid-i and XB row-tiled over grid-j;
+  * ``sparse/quantum.py:81-151`` — CREATE_HAMILTONIANS on a 2-D replication
+    grid: grid-x partitions the current independent sets, grid-y partitions
+    the prior sets, each processor matching its (x, y) tile pair.
+
+TPU-native redesign: both are ``shard_map`` programs over a
+``get_mesh_2d()`` mesh. GSPMD replicates each operand along the orthogonal
+grid axis automatically from the in_specs — the reference's promote/
+projection-functor machinery disappears. cdist needs no collectives at all
+(the output is disjoint 2-D tiles); the set lookup combines per-tile hits
+with one ``psum`` along grid-y (each query matches in exactly one y-tile).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .mesh import get_mesh_2d
+
+try:  # jax>=0.8 top-level; older releases keep it in experimental
+    from jax import shard_map
+except ImportError:  # pragma: no cover
+    from jax.experimental.shard_map import shard_map
+
+
+def _pad_rows(X: np.ndarray, mult: int) -> np.ndarray:
+    m = X.shape[0]
+    pad = (-m) % mult
+    if pad == 0:
+        return X
+    return np.concatenate([X, np.zeros((pad, *X.shape[1:]), dtype=X.dtype)])
+
+
+def cdist_2d(XA, XB, mesh: Mesh | None = None, metric: str = "euclidean"):
+    """Pairwise distances with the output tiled over a 2-D device mesh.
+
+    XA rows tile along gx, XB rows along gy (the reference's launch grid,
+    spatial.py:48-84); tile (i, j) computes its [m/gx, n/gy] block with the
+    local MXU formulation. Returns the full [m, n] host array.
+    """
+    from ..spatial import _cdist_euclidean, _cdist_sqeuclidean
+
+    if metric == "euclidean":
+        tile_fn = _cdist_euclidean
+    elif metric == "sqeuclidean":
+        tile_fn = _cdist_sqeuclidean
+    else:
+        raise ValueError(f"unsupported metric {metric!r}")
+    if mesh is None:
+        mesh = get_mesh_2d()
+    ax_x, ax_y = mesh.axis_names
+    gx, gy = mesh.devices.shape
+
+    XA = np.asarray(XA)
+    XB = np.asarray(XB)
+    m, n = XA.shape[0], XB.shape[0]
+    XAp = _pad_rows(XA, gx)
+    XBp = _pad_rows(XB, gy)
+
+    smapped = shard_map(
+        lambda a, b: tile_fn(a, b),
+        mesh=mesh,
+        in_specs=(P(ax_x, None), P(ax_y, None)),
+        out_specs=P(ax_x, ax_y),
+        check_vma=False,
+    )
+    Ap = jax.device_put(XAp, NamedSharding(mesh, P(ax_x, None)))
+    Bp = jax.device_put(XBp, NamedSharding(mesh, P(ax_y, None)))
+    out = jax.jit(smapped)(Ap, Bp)
+    return np.asarray(out)[:m, :n]
+
+
+# ---------------------------------------------------------------------------
+# Quantum: 2-D replicated subset lookup (CREATE_HAMILTONIANS grid analog)
+# ---------------------------------------------------------------------------
+def _lex_less_equal(q, s):
+    """Lexicographic q <= s for [..., W] uint64 word rows (vectorized)."""
+    # walk words most-significant first; strictly-less at the first
+    # differing word decides
+    W = q.shape[-1]
+    lt = jnp.zeros(q.shape[:-1], dtype=bool)
+    eq = jnp.ones(q.shape[:-1], dtype=bool)
+    for w in range(W):
+        lt = lt | (eq & (q[..., w] < s[..., w]))
+        eq = eq & (q[..., w] == s[..., w])
+    return lt | eq
+
+
+def _searchsorted_rows(sorted_block, queries):
+    """Binary-search each query row in a lex-sorted [S, W] block.
+
+    Returns (pos, found): pos is the insertion index, found whether
+    sorted_block[pos] == query. Pure lax ops — runs on device inside
+    shard_map (the per-tile body of the reference's CREATE_HAMILTONIANS
+    task, quantum.cc:163-197).
+    """
+    S = sorted_block.shape[0]
+    Q = queries.shape[0]
+    steps = max(int(np.ceil(np.log2(max(S, 1)))) + 1, 1)
+
+    def body(_, lohi):
+        lo, hi = lohi  # [Q] int32: search window [lo, hi)
+        mid = (lo + hi) // 2
+        le = _lex_less_equal(queries, sorted_block[mid])  # q <= s[mid]
+        new_hi = jnp.where(le, mid, hi)
+        new_lo = jnp.where(le, lo, mid + 1)
+        return new_lo, new_hi
+
+    lo0 = jnp.zeros(Q, dtype=jnp.int32)
+    hi0 = jnp.full(Q, S, dtype=jnp.int32)
+    lo, hi = jax.lax.fori_loop(0, steps, body, (lo0, hi0))
+    pos = jnp.clip(hi, 0, S - 1)
+    found = jnp.all(sorted_block[pos] == queries, axis=-1)
+    return pos, found
+
+
+def _to_u32_words(a: np.ndarray) -> np.ndarray:
+    """[N, W] uint64 -> [N, 2W] uint32, preserving lexicographic order
+    (hi word first). Keeps the kernel off uint64, which jax only carries
+    under x64 mode."""
+    a = a.astype(np.uint64, copy=False)
+    hi = (a >> np.uint64(32)).astype(np.uint32)
+    lo = (a & np.uint64(0xFFFFFFFF)).astype(np.uint32)
+    out = np.empty((a.shape[0], a.shape[1] * 2), dtype=np.uint32)
+    out[:, 0::2] = hi
+    out[:, 1::2] = lo
+    return out
+
+
+def lookup_2d(sorted_sets: np.ndarray, queries: np.ndarray, mesh: Mesh | None = None):
+    """Find each query row's index in lex-sorted ``sorted_sets`` on a 2-D mesh.
+
+    grid-x partitions the queries (the current level's removed-subsets),
+    grid-y partitions the sorted prior sets — the reference's 2-D replication
+    strategy (quantum.py:86-107). Each tile binary-searches its local y-block;
+    one ``psum`` along grid-y combines (exactly one block holds each query).
+    Returns positions into ``sorted_sets`` ([Q] int64); raises if any query
+    is missing (lookup-failed discipline of quantum.py's std::map).
+    """
+    if mesh is None:
+        mesh = get_mesh_2d()
+    ax_x, ax_y = mesh.axis_names
+    gx, gy = mesh.devices.shape
+    sorted_sets = _to_u32_words(np.asarray(sorted_sets))
+    queries = _to_u32_words(np.asarray(queries))
+    S, W = sorted_sets.shape
+    Q = queries.shape[0]
+
+    # pad: sets to a multiple of gy with +inf rows (all-ones sorts last and
+    # never equals a real set since queries are proper subsets), queries to
+    # a multiple of gx with all-ones rows (never found; masked off at end)
+    pad_row = np.full((1, W), np.uint32(0xFFFFFFFF), dtype=np.uint32)
+    Sp = S + ((-S) % gy)
+    Qp = Q + ((-Q) % gx)
+    sets_p = np.concatenate([sorted_sets, np.repeat(pad_row, Sp - S, 0)])
+    qs_p = np.concatenate([queries, np.repeat(pad_row, Qp - Q, 0)])
+    Sl = Sp // gy
+
+    def tile(q_l, s_l):
+        j = jax.lax.axis_index(ax_y)
+        pos, found = _searchsorted_rows(s_l, q_l)
+        gpos = jnp.where(found, pos.astype(jnp.int64) + j.astype(jnp.int64) * Sl, 0)
+        # each query is found in exactly one y-block; psum combines
+        return (
+            jax.lax.psum(gpos, ax_y),
+            jax.lax.psum(found.astype(jnp.int32), ax_y),
+        )
+
+    smapped = shard_map(
+        tile,
+        mesh=mesh,
+        in_specs=(P(ax_x, None), P(ax_y, None)),
+        out_specs=(P(ax_x), P(ax_x)),
+        check_vma=False,
+    )
+    qd = jax.device_put(qs_p, NamedSharding(mesh, P(ax_x, None)))
+    sd = jax.device_put(sets_p, NamedSharding(mesh, P(ax_y, None)))
+    gpos, found = jax.jit(smapped)(qd, sd)
+    gpos = np.asarray(gpos)[:Q]
+    found = np.asarray(found)[:Q]
+    if not np.all(found == 1):
+        raise RuntimeError("subset lookup failed: predecessor set missing")
+    return gpos

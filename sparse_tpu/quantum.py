@@ -182,8 +182,16 @@ class HamiltonianDriver:
     (quantum.py:258-276), so the all-ones ground state sits last.
     """
 
-    def __init__(self, energies: tuple = (1,), graph=None, dtype=np.complex64):
+    def __init__(
+        self, energies: tuple = (1,), graph=None, dtype=np.complex64, mesh=None
+    ):
+        """``mesh``: optional 2-D device mesh; routes the subset lookup
+        (the CREATE_HAMILTONIANS inner loop) through the 2-D replication
+        grid of reference quantum.py:86-107 — grid-x tiles the current
+        level's queries, grid-y the prior sets (parallel.grid2d.lookup_2d).
+        Default None keeps the single-host searchsorted path."""
         self.energies = energies
+        self._mesh2d = mesh
         adj = _adjacency(graph)
         n = adj.shape[0]
         self.ip = [1]
@@ -210,7 +218,12 @@ class HamiltonianDriver:
                 i_idx, node_idx = np.nonzero(Bm)
                 removed = new_sets[i_idx] & ~planes[node_idx]
                 order = _lex_order(sets)
-                pos = _lookup(sets[order], removed)
+                if self._mesh2d is not None:
+                    from .parallel.grid2d import lookup_2d
+
+                    pos = lookup_2d(sets[order], removed, self._mesh2d)
+                else:
+                    pos = _lookup(sets[order], removed)
                 pred_idx = prev_offset + order[pos]
                 rows_u.append(offset + i_idx.astype(np.int64))
                 cols_u.append(pred_idx.astype(np.int64))

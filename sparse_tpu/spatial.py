@@ -37,12 +37,19 @@ def _cdist_sqeuclidean(XA, XB):
     return jnp.maximum(sqa + sqb - 2.0 * (XA @ XB.T), 0.0)
 
 
-def cdist(XA, XB, metric: str = "euclidean"):
+def cdist(XA, XB, metric: str = "euclidean", mesh=None):
     """Pairwise distances between rows of XA [m, k] and XB [n, k].
 
     Reference supports euclidean only (spatial.py:39-43); sqeuclidean and
-    cityblock are cheap extensions.
+    cityblock are cheap extensions. ``mesh``: optional 2-D device mesh — the
+    output is computed in disjoint 2-D tiles over it, XA rows along grid-x
+    and XB rows along grid-y (the reference's manual launch grid,
+    spatial.py:48-84; see ``parallel.grid2d.cdist_2d``).
     """
+    if mesh is not None:
+        from .parallel.grid2d import cdist_2d
+
+        return cdist_2d(XA, XB, mesh=mesh, metric=metric)
     XA = asjnp(XA)
     XB = asjnp(XB)
     if XA.ndim != 2 or XB.ndim != 2:

@@ -1,0 +1,90 @@
+"""COO format surface oracle tests vs scipy.
+
+Reference analog: ``tests/integration/test_coo.py``.
+"""
+
+import numpy as np
+import pytest
+import scipy.io as sci_io
+import scipy.sparse as scpy
+
+import sparse_tpu as sparse
+from .utils.common import test_mtx_files, types
+from .utils.sample import sample_csr, sample_vec
+
+
+@pytest.mark.parametrize("filename", test_mtx_files)
+@pytest.mark.parametrize("dtype", [np.float32, np.float64])
+def test_coo_from_scipy(filename, dtype):
+    s = sci_io.mmread(filename).astype(dtype)
+    arr = sparse.coo_array(s)
+    assert arr.dtype == dtype
+    assert np.allclose(np.asarray(arr.todense()), s.todense())
+
+
+def test_coo_from_arrays():
+    row = np.array([0, 3, 1, 0])
+    col = np.array([0, 3, 1, 2])
+    data = np.array([4.0, 5.0, 7.0, 9.0])
+    arr = sparse.coo_array((data, (row, col)), shape=(4, 4))
+    exp = scpy.coo_matrix((data, (row, col)), shape=(4, 4))
+    assert np.allclose(np.asarray(arr.todense()), exp.todense())
+
+
+def test_coo_duplicates_sum():
+    """Duplicate (i, j) entries must sum on conversion (the dist_sort
+    duplicate-key regression surface)."""
+    row = np.array([0, 0, 1, 1, 0])
+    col = np.array([1, 1, 2, 2, 1])
+    data = np.array([1.0, 2.0, 3.0, 4.0, 5.0])
+    arr = sparse.coo_array((data, (row, col)), shape=(3, 3)).tocsr()
+    exp = scpy.coo_matrix((data, (row, col)), shape=(3, 3)).tocsr()
+    assert np.allclose(np.asarray(arr.todense()), exp.todense())
+
+
+@pytest.mark.parametrize("filename", test_mtx_files)
+def test_coo_transpose(filename):
+    arr = sparse.io.mmread(filename).T
+    s = sci_io.mmread(filename).T
+    assert np.allclose(np.asarray(arr.todense()), np.asarray(s.todense()))
+
+
+@pytest.mark.parametrize("filename", test_mtx_files)
+def test_coo_matmul(filename):
+    arr = sparse.io.mmread(filename)
+    s = sci_io.mmread(filename).tocsr()
+    B = np.random.default_rng(1).random((arr.shape[1], 6))
+    assert np.allclose(np.asarray(arr @ B), s @ B, atol=1e-6)
+
+
+@pytest.mark.parametrize("filename", test_mtx_files)
+def test_coo_mul(filename):
+    arr = sparse.io.mmread(filename)
+    s = sci_io.mmread(filename)
+    res = arr * 2.5
+    assert np.allclose(np.asarray(res.todense()), (s * 2.5).todense())
+
+
+@pytest.mark.parametrize("vec_type", types)
+def test_coo_dot(vec_type):
+    sa = sample_csr(15, 21, density=0.3, seed=97).tocoo()
+    v = sample_vec(21, dtype=vec_type, seed=98)
+    arr = sparse.coo_array(sa)
+    assert np.allclose(np.asarray(arr @ v), sa.tocsr() @ v, atol=1e-5)
+
+
+def test_coo_row_col_attributes():
+    sa = sample_csr(8, 9, density=0.4, seed=99).tocoo()
+    arr = sparse.coo_array(sa)
+    got = scpy.coo_matrix(
+        (np.asarray(arr.data), (np.asarray(arr.row), np.asarray(arr.col))),
+        shape=arr.shape,
+    )
+    assert np.allclose(got.todense(), sa.todense())
+
+
+def test_coo_tocsc_roundtrip():
+    sa = sample_csr(12, 10, density=0.3, seed=100).tocoo()
+    arr = sparse.coo_array(sa)
+    assert np.allclose(np.asarray(arr.tocsc().todense()), sa.tocsc().todense())
+    assert np.allclose(np.asarray(arr.todia().todense()), sa.todia().todense())

@@ -1,0 +1,101 @@
+"""CSC format surface oracle tests vs scipy.
+
+Reference analog: ``tests/integration/test_csc.py``.
+"""
+
+import numpy as np
+import pytest
+import scipy.io as sci_io
+
+import sparse_tpu as sparse
+from .utils.common import test_mtx_files, types
+from .utils.sample import sample_csr, sample_dense, sample_vec
+
+
+@pytest.mark.parametrize("filename", test_mtx_files)
+def test_csc_from_dense(filename):
+    s = sci_io.mmread(filename)
+    arr = sparse.csc_array(np.asarray(s.todense()))
+    assert np.allclose(np.asarray(arr.todense()), s.todense())
+
+
+@pytest.mark.parametrize("filename", test_mtx_files)
+def test_csc_to_coo(filename):
+    arr = sparse.io.mmread(filename).tocsc()
+    s = sci_io.mmread(filename).tocsc()
+    assert np.allclose(np.asarray(arr.tocoo().todense()), s.tocoo().todense())
+
+
+@pytest.mark.parametrize("filename", test_mtx_files)
+def test_csc_to_csr(filename):
+    arr = sparse.io.mmread(filename).tocsc()
+    s = sci_io.mmread(filename).tocsc()
+    assert np.allclose(np.asarray(arr.tocsr().todense()), s.tocsr().todense())
+
+
+@pytest.mark.parametrize("filename", test_mtx_files)
+def test_csc_elemwise_mul(filename):
+    arr = sparse.io.mmread(filename).tocsc()
+    s = sci_io.mmread(filename).tocsc()
+    rolled = np.roll(np.asarray(arr.todense()), 1)
+    res = arr * sparse.csc_array(rolled)
+    res_sci = s.multiply(np.roll(np.asarray(s.todense()), 1))
+    assert np.allclose(np.asarray(res.todense()), np.asarray(res_sci.todense()), atol=1e-6)
+
+
+@pytest.mark.parametrize("filename", test_mtx_files)
+def test_csc_elemwise_add(filename):
+    arr = sparse.io.mmread(filename).tocsc()
+    s = sci_io.mmread(filename).tocsc()
+    rolled = np.roll(np.asarray(arr.todense()), 1)
+    res = arr + sparse.csc_array(rolled)
+    import scipy.sparse as scpy
+
+    res_sci = s + scpy.csc_matrix(np.roll(np.asarray(s.todense()), 1))
+    assert np.allclose(np.asarray(res.todense()), np.asarray(res_sci.todense()), atol=1e-6)
+
+
+@pytest.mark.parametrize("filename", test_mtx_files)
+def test_csc_transpose(filename):
+    arr = sparse.io.mmread(filename).tocsc().T
+    s = sci_io.mmread(filename).tocsc().T
+    assert np.allclose(np.asarray(arr.todense()), np.asarray(s.todense()))
+
+
+def test_csc_conj():
+    sa = sample_csr(9, 11, density=0.3, dtype=np.complex128, seed=91).tocsc()
+    got = sparse.csc_array(sa).conj()
+    assert np.allclose(np.asarray(got.todense()), sa.conj().todense())
+
+
+@pytest.mark.parametrize("b_type", [np.float32, np.complex128])
+@pytest.mark.parametrize("c_type", types)
+def test_csc_spmm(b_type, c_type):
+    sa = sample_csr(18, 22, density=0.25, dtype=b_type, seed=92).tocsc()
+    B = sample_dense(22, 7, dtype=c_type, seed=93)
+    got = np.asarray(sparse.csc_array(sa) @ B)
+    exp = sa @ B
+    assert got.dtype == exp.dtype
+    assert np.allclose(got, exp, atol=1e-5)
+
+
+@pytest.mark.parametrize("vec_type", types)
+def test_csc_dot(vec_type):
+    sa = sample_csr(18, 22, density=0.25, seed=94).tocsc()
+    v = sample_vec(22, dtype=vec_type, seed=95)
+    assert np.allclose(np.asarray(sparse.csc_array(sa) @ v), sa @ v, atol=1e-5)
+
+
+@pytest.mark.parametrize("filename", test_mtx_files)
+def test_csc_todense(filename):
+    arr = sparse.io.mmread(filename).tocsc()
+    s = sci_io.mmread(filename).tocsc()
+    assert np.allclose(np.asarray(arr.todense()), np.asarray(s.todense()))
+
+
+@pytest.mark.parametrize("axis", [None, 0, 1])
+def test_csc_sum(axis):
+    sa = sample_csr(13, 17, density=0.3, seed=96).tocsc()
+    got = np.asarray(sparse.csc_array(sa).sum(axis=axis))
+    exp = np.asarray(sa.sum(axis=axis)).squeeze()
+    assert np.allclose(got, exp)

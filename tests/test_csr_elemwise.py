@@ -1,0 +1,119 @@
+"""Elementwise CSR arithmetic oracle tests vs scipy.
+
+Reference analog: ``tests/integration/test_csr_elemwise.py`` — sparse*sparse,
+sparse*dense, sparse+sparse over the fixture files with a dtype axis, plus
+scalar mul, subtract, power, neg and dense broadcast.
+"""
+
+import numpy as np
+import pytest
+import scipy.io as sci_io
+import scipy.sparse as scpy
+
+import sparse_tpu as sparse
+from .utils.common import test_mtx_files, types
+from .utils.sample import sample_csr, sample_dense
+
+
+@pytest.mark.parametrize("filename", test_mtx_files)
+@pytest.mark.parametrize("b_type", types)
+def test_csr_elemwise_mul(filename, b_type):
+    arr = sparse.io.mmread(filename)
+    s = sci_io.mmread(filename)
+    rolled = np.roll(np.asarray(arr.todense()), 1)
+    res = arr.tocsr().astype(b_type) * sparse.csr_array(rolled).astype(b_type)
+    res_sci = s.tocsr().astype(b_type).multiply(
+        scpy.csr_matrix(np.roll(np.asarray(s.todense()), 1)).astype(b_type)
+    )
+    assert np.allclose(np.asarray(res.todense()), res_sci.todense(), atol=1e-6)
+
+
+@pytest.mark.parametrize("filename", test_mtx_files)
+@pytest.mark.parametrize("b_type", types)
+def test_csr_dense_elemwise_mul(filename, b_type):
+    arr = sparse.io.mmread(filename).tocsr().astype(b_type)
+    s = sci_io.mmread(filename).tocsr().astype(b_type)
+    c = sample_dense(*arr.shape, dtype=b_type, seed=81)
+    res = arr * c
+    res_sci = s.multiply(c)
+    assert np.allclose(np.asarray(res.todense()), res_sci.todense(), atol=1e-6)
+
+
+@pytest.mark.parametrize("filename", test_mtx_files)
+@pytest.mark.parametrize("b_type", types)
+def test_csr_elemwise_add(filename, b_type):
+    arr = sparse.io.mmread(filename)
+    s = sci_io.mmread(filename)
+    rolled = np.roll(np.asarray(arr.todense()), 1)
+    res = arr.tocsr().astype(b_type) + sparse.csr_array(rolled).astype(b_type)
+    res_sci = s.tocsr().astype(b_type) + scpy.csr_matrix(
+        np.roll(np.asarray(s.todense()), 1)
+    ).astype(b_type)
+    assert np.allclose(np.asarray(res.todense()), res_sci.todense(), atol=1e-6)
+
+
+@pytest.mark.parametrize("filename", test_mtx_files)
+def test_csr_mul_scalar(filename):
+    arr = sparse.io.mmread(filename).tocsr()
+    s = sci_io.mmread(filename).tocsr()
+    assert np.allclose(np.asarray((arr * 3.0).todense()), (s * 3.0).todense())
+    assert np.allclose(np.asarray((3.0 * arr).todense()), (s * 3.0).todense())
+    assert np.allclose(np.asarray((arr / 2.0).todense()), (s / 2.0).todense())
+
+
+@pytest.mark.parametrize("filename", test_mtx_files)
+def test_csr_subtract(filename):
+    arr = sparse.io.mmread(filename).tocsr()
+    s = sci_io.mmread(filename).tocsr()
+    rolled = np.roll(np.asarray(arr.todense()), 1)
+    res = arr - sparse.csr_array(rolled)
+    res_sci = s - scpy.csr_matrix(np.roll(np.asarray(s.todense()), 1))
+    assert np.allclose(np.asarray(res.todense()), res_sci.todense(), atol=1e-6)
+
+
+def test_csr_power():
+    sa = sample_csr(15, 12, density=0.3, seed=82).tocsr()
+    got = sparse.csr_array(sa).power(2)
+    exp = sa.power(2)
+    assert np.allclose(np.asarray(got.todense()), exp.todense())
+
+
+def test_csr_neg_abs_conj():
+    sa = sample_csr(15, 12, density=0.3, dtype=np.complex128, seed=83).tocsr()
+    arr = sparse.csr_array(sa)
+    assert np.allclose(np.asarray((-arr).todense()), (-sa).todense())
+    assert np.allclose(np.asarray(abs(arr).todense()), abs(sa).todense())
+    assert np.allclose(np.asarray(arr.conj().todense()), sa.conj().todense())
+
+
+def test_mult_dense_broadcast():
+    """Row-vector broadcast multiply (reference test_csr_elemwise.py:98)."""
+    sa = sample_csr(14, 10, density=0.4, seed=84).tocsr()
+    arr = sparse.csr_array(sa)
+    row = sample_dense(1, 10, seed=85)
+    got = arr * row
+    exp = sa.multiply(row)
+    assert np.allclose(np.asarray(got.todense()), exp.todense(), atol=1e-6)
+
+
+@pytest.mark.parametrize("axis", [None, 0, 1])
+def test_csr_sum_mean(axis):
+    sa = sample_csr(17, 13, density=0.3, seed=86).tocsr()
+    arr = sparse.csr_array(sa)
+    assert np.allclose(np.asarray(arr.sum(axis=axis)), np.asarray(sa.sum(axis=axis)).squeeze())
+    assert np.allclose(np.asarray(arr.mean(axis=axis)), np.asarray(sa.mean(axis=axis)).squeeze())
+
+
+@pytest.mark.parametrize("k", [-2, -1, 0, 1, 2])
+def test_csr_diagonal_k(k):
+    sa = sample_csr(12, 15, density=0.4, seed=87).tocsr()
+    got = sparse.csr_array(sa).diagonal(k=k)
+    assert np.allclose(np.asarray(got), sa.diagonal(k=k))
+
+
+def test_zero_preserving_ufuncs():
+    sa = sample_csr(11, 9, density=0.4, seed=88).tocsr()
+    arr = sparse.csr_array(sa)
+    assert np.allclose(np.asarray(arr.sqrt().todense()), np.sqrt(sa.todense()))
+    assert np.allclose(np.asarray(arr.sin().todense()), np.sin(np.asarray(sa.todense())))
+    assert np.allclose(np.asarray(arr.expm1().todense()), np.expm1(np.asarray(sa.todense())))

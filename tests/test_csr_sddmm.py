@@ -1,0 +1,67 @@
+"""SDDMM oracle tests: vals_out = A_vals * (C @ D) at A's sparsity.
+
+Reference analog: ``tests/integration/test_csr_sddmm.py`` (kdim axis +
+balanced variant) and ``test_csc.py:141-163`` (CSC variant).
+"""
+
+import numpy as np
+import pytest
+import scipy.io as sci_io
+
+import sparse_tpu as sparse
+from .utils.common import test_mtx_files
+from .utils.sample import sample_csr, sample_dense
+
+
+def _oracle(s, C, D):
+    s = s.tocsr()
+    out = s.copy()
+    full = C @ D
+    rows = np.repeat(np.arange(s.shape[0]), np.diff(s.indptr))
+    out.data = s.data * np.asarray(full)[rows, s.indices]
+    return out
+
+
+@pytest.mark.parametrize("filename", test_mtx_files)
+@pytest.mark.parametrize("kdim", [2, 8, 17])
+def test_csr_sddmm(filename, kdim):
+    arr = sparse.io.mmread(filename).tocsr()
+    s = sci_io.mmread(filename).tocsr()
+    m, n = arr.shape
+    C = sample_dense(m, kdim, seed=70)
+    D = sample_dense(kdim, n, seed=71)
+    got = arr.sddmm(C, D)
+    exp = _oracle(s, C, D)
+    assert np.allclose(np.asarray(got.todense()), exp.todense(), atol=1e-6)
+
+
+def test_csr_sddmm_balanced():
+    sa = sample_csr(29, 23, density=0.2, seed=72).tocsr()
+    arr = sparse.csr_array(sa)
+    arr.balance()
+    C = sample_dense(29, 5, seed=73)
+    D = sample_dense(5, 23, seed=74)
+    got = arr.sddmm(C, D)
+    exp = _oracle(sa, C, D)
+    assert np.allclose(np.asarray(got.todense()), exp.todense(), atol=1e-6)
+
+
+@pytest.mark.parametrize("kdim", [3, 11])
+def test_csc_sddmm(kdim):
+    sa = sample_csr(19, 31, density=0.2, seed=75).tocsc()
+    arr = sparse.csc_array(sa)
+    m, n = arr.shape
+    C = sample_dense(m, kdim, seed=76)
+    D = sample_dense(kdim, n, seed=77)
+    got = arr.sddmm(C, D)
+    exp = _oracle(sa, C, D)
+    assert np.allclose(np.asarray(got.todense()), exp.todense(), atol=1e-6)
+
+
+def test_sddmm_complex():
+    sa = sample_csr(13, 17, density=0.3, dtype=np.complex128, seed=78).tocsr()
+    C = sample_dense(13, 4, dtype=np.complex128, seed=79)
+    D = sample_dense(4, 17, dtype=np.complex128, seed=80)
+    got = sparse.csr_array(sa).sddmm(C, D)
+    exp = _oracle(sa, C, D)
+    assert np.allclose(np.asarray(got.todense()), exp.todense(), atol=1e-6)

@@ -1,0 +1,68 @@
+"""SpGEMM oracle tests vs scipy.
+
+Reference analog: ``tests/integration/test_csr_spgemm.py`` — CSR@CSR and
+CSR@CSC products over the fixture files with a dtype cross axis.
+"""
+
+import numpy as np
+import pytest
+import scipy.io as sci_io
+
+import sparse_tpu as sparse
+from .utils.common import test_mtx_files, types
+from .utils.sample import sample_csr
+
+
+@pytest.mark.parametrize("filename", test_mtx_files)
+@pytest.mark.parametrize("b_type", types)
+def test_csr_csr_csr_spgemm(filename, b_type):
+    arr = sparse.io.mmread(filename)
+    s = sci_io.mmread(filename).tocsr()
+    # A @ A for square fixtures, A @ A^T for the rectangular one
+    other = arr.tocsr() if arr.shape[0] == arr.shape[1] else arr.T.tocsr()
+    s_other = s if s.shape[0] == s.shape[1] else s.T.tocsr()
+    res = arr.tocsr().astype(b_type) @ other.astype(b_type)
+    res_sci = s.astype(b_type) @ s_other.astype(b_type)
+    assert np.allclose(np.asarray(res.todense()), res_sci.todense(), atol=1e-5)
+
+
+@pytest.mark.parametrize("b_type", [np.float32, np.complex128])
+@pytest.mark.parametrize("c_type", types)
+def test_csr_spgemm_mixed_dtypes(b_type, c_type):
+    sa = sample_csr(23, 17, density=0.3, dtype=b_type, seed=50)
+    sb = sample_csr(17, 29, density=0.3, dtype=c_type, seed=51)
+    res = sparse.csr_array(sa) @ sparse.csr_array(sb)
+    res_sci = sa @ sb
+    assert res.dtype == res_sci.dtype
+    assert np.allclose(np.asarray(res.todense()), res_sci.todense(), atol=1e-5)
+
+
+@pytest.mark.parametrize("filename", test_mtx_files)
+def test_csr_csr_csc_spgemm(filename):
+    arr = sparse.io.mmread(filename)
+    s = sci_io.mmread(filename)
+    other = arr if arr.shape[0] == arr.shape[1] else arr.T
+    s_other = s if s.shape[0] == s.shape[1] else s.T
+    res = arr.tocsr() @ other.tocsc()
+    res_sci = s.tocsr() @ s_other.tocsc()
+    assert np.allclose(np.asarray(res.todense()), res_sci.todense(), atol=1e-5)
+
+
+def test_spgemm_rectangular_chain():
+    """Galerkin-style triple product R @ A @ P (the AMG hot path)."""
+    A = sample_csr(40, 40, density=0.15, seed=52)
+    P = sample_csr(40, 12, density=0.3, seed=53)
+    R = P.T.tocsr()
+    got = sparse.csr_array(R) @ (sparse.csr_array(A) @ sparse.csr_array(P))
+    exp = R @ (A @ P)
+    assert np.allclose(np.asarray(got.todense()), exp.todense(), atol=1e-6)
+
+
+def test_spgemm_empty_result():
+    import scipy.sparse as sp
+
+    a = sp.csr_matrix((5, 7))
+    b = sp.csr_matrix((7, 3))
+    got = sparse.csr_array(a) @ sparse.csr_array(b)
+    assert got.shape == (5, 3)
+    assert got.nnz == 0

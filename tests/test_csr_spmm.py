@@ -1,0 +1,60 @@
+"""SpMM (sparse x dense, dense x sparse) oracle tests vs scipy.
+
+Reference analog: ``tests/integration/test_csr_spmm.py`` — fixture files x
+dtype cross, plus the rmatmul (dense @ CSR) k-split path and the balanced
+variant.
+"""
+
+import numpy as np
+import pytest
+import scipy.io as sci_io
+
+import sparse_tpu as sparse
+from .utils.common import test_mtx_files, types
+from .utils.sample import sample_csr, sample_dense
+
+
+@pytest.mark.parametrize("filename", test_mtx_files)
+@pytest.mark.parametrize("b_type", types)
+def test_csr_spmm(filename, b_type):
+    arr = sparse.io.mmread(filename).tocsr().astype(b_type)
+    s = sci_io.mmread(filename).tocsr().astype(b_type)
+    B = sample_dense(arr.shape[1], 9, dtype=b_type, seed=60)
+    assert np.allclose(np.asarray(arr @ B), s @ B, atol=1e-5)
+
+
+@pytest.mark.parametrize("filename", test_mtx_files)
+@pytest.mark.parametrize("idim", [1, 4, 33])
+def test_csr_spmm_rmatmul(filename, idim):
+    arr = sparse.io.mmread(filename).tocsr()
+    s = sci_io.mmread(filename).tocsr()
+    C = sample_dense(idim, arr.shape[0], seed=61)
+    assert np.allclose(np.asarray(C @ arr), C @ s, atol=1e-5)
+
+
+@pytest.mark.parametrize("b_type", [np.float32, np.complex128])
+@pytest.mark.parametrize("c_type", types)
+def test_csr_spmm_rmatmul_types(b_type, c_type):
+    sa = sample_csr(21, 27, density=0.25, dtype=b_type, seed=62).tocsr()
+    C = sample_dense(6, 21, dtype=c_type, seed=63)
+    got = np.asarray(C @ sparse.csr_array(sa))
+    exp = C @ sa
+    assert got.dtype == exp.dtype
+    assert np.allclose(got, exp, atol=1e-5)
+
+
+def test_csr_rmatmul_balanced():
+    """rmatmul after balance() (reference test_csr_spmm.py:79)."""
+    sa = sample_csr(33, 19, density=0.2, seed=64).tocsr()
+    arr = sparse.csr_array(sa)
+    arr.balance()
+    C = sample_dense(5, 33, seed=65)
+    assert np.allclose(np.asarray(C @ arr), C @ sa, atol=1e-6)
+
+
+def test_csr_spmm_result_dtype_promotion():
+    sa = sample_csr(11, 13, dtype=np.float32, seed=66).tocsr()
+    B = sample_dense(13, 4, dtype=np.float64, seed=67)
+    got = np.asarray(sparse.csr_array(sa) @ B)
+    assert got.dtype == np.float64
+    assert np.allclose(got, sa @ B, atol=1e-6)

@@ -1,0 +1,70 @@
+"""DIA format surface oracle tests vs scipy.
+
+Reference analog: ``tests/integration/test_dia.py``.
+"""
+
+import numpy as np
+import pytest
+import scipy.sparse as scpy
+
+import sparse_tpu as sparse
+from .utils.sample import sample_csr, sample_vec
+
+
+def test_dia_to_csr():
+    s = scpy.diags([1.0, 2.0, 3.0], [-1, 0, 1], shape=(6, 6)).todia()
+    arr = sparse.dia_array(s)
+    assert np.allclose(np.asarray(arr.tocsr().todense()), s.tocsr().todense())
+
+
+def test_spdiags_roundtrip():
+    data = np.arange(12.0).reshape(3, 4)
+    offsets = np.array([0, -1, 2])
+    got = sparse.spdiags(data, offsets, 4, 4)
+    exp = scpy.spdiags(data, offsets, 4, 4)
+    assert np.allclose(np.asarray(got.todense()), exp.todense())
+
+
+@pytest.mark.parametrize("m,n,k", [(5, 5, 0), (4, 6, 1), (6, 4, -1)])
+def test_eye_dia(m, n, k):
+    got = sparse.eye(m, n, k=k, format="dia")
+    exp = scpy.eye(m, n, k=k, format="dia")
+    assert got.format == "dia"
+    assert np.allclose(np.asarray(got.todense()), exp.todense())
+
+
+@pytest.mark.parametrize("m,n,k", [(5, 5, 0), (5, 8, 2), (8, 5, -2)])
+def test_dia_diagonal(m, n, k):
+    s = sample_csr(m, n, density=0.5, seed=101).todia()
+    arr = sparse.dia_array(s)
+    assert np.allclose(np.asarray(arr.diagonal(k=k)), s.diagonal(k=k))
+
+
+@pytest.mark.parametrize("m,n", [(5, 5), (4, 7), (7, 4)])
+def test_dia_to_coo(m, n):
+    s = sample_csr(m, n, density=0.5, seed=102).todia()
+    arr = sparse.dia_array(s)
+    assert np.allclose(np.asarray(arr.tocoo().todense()), s.tocoo().todense())
+
+
+def test_dia_spmv_matches_scipy():
+    s = scpy.diags(
+        [np.full(63, -1.0), np.full(64, 2.0), np.full(63, -1.0)],
+        [-1, 0, 1],
+    ).todia()
+    arr = sparse.dia_array(s)
+    v = sample_vec(64, seed=103)
+    assert np.allclose(np.asarray(arr @ v), s @ v)
+
+
+def test_dia_transpose():
+    s = sample_csr(6, 9, density=0.4, seed=104).todia()
+    arr = sparse.dia_array(s)
+    assert np.allclose(np.asarray(arr.T.todense()), s.T.todense())
+
+
+def test_dia_sum_scalar_mul():
+    s = sample_csr(7, 7, density=0.4, seed=105).todia()
+    arr = sparse.dia_array(s)
+    assert np.allclose(float(np.asarray(arr.sum())), s.sum())
+    assert np.allclose(np.asarray((arr * 2.0).todense()), (s * 2).todense())

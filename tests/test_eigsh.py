@@ -1,0 +1,61 @@
+"""eigsh oracle tests vs numpy's dense symmetric eigensolver.
+
+Reference analog: ``tests/integration/test_eigsh.py:24`` (Lanczos extremal
+eigenvalues of a random symmetric matrix vs the dense oracle).
+"""
+
+import numpy as np
+import pytest
+import scipy.sparse as sp
+
+import sparse_tpu as sparse
+import sparse_tpu.linalg as linalg
+from .utils.sample import sample_csr
+
+
+def _sym(n, seed=0, density=0.15):
+    s = sample_csr(n, n, density=density, seed=seed)
+    return (0.5 * (s + s.T)).tocsr()
+
+
+@pytest.mark.parametrize("which", ["LM", "SM", "LA", "SA"])
+def test_eigsh_extremal(which):
+    n, k = 60, 4
+    s = _sym(n, seed=40)
+    dense_w = np.linalg.eigvalsh(s.toarray())
+    w_ret, v = linalg.eigsh(sparse.csr_array(s), k=k, which=which, tol=1e-9)
+    w_ret = np.asarray(w_ret)
+    w = np.sort(w_ret)
+    if which == "LM":
+        exp = np.sort(dense_w[np.argsort(np.abs(dense_w))[-k:]])
+    elif which == "SM":
+        exp = np.sort(dense_w[np.argsort(np.abs(dense_w))[:k]])
+    elif which == "LA":
+        exp = dense_w[-k:]
+    else:
+        exp = dense_w[:k]
+    assert np.allclose(w, exp, atol=1e-5)
+    # eigenvector residuals (order as returned)
+    A = s.toarray()
+    Vr = np.asarray(v)
+    for i in range(k):
+        ri = A @ Vr[:, i] - float(w_ret[i]) * Vr[:, i]
+        assert np.linalg.norm(ri) < 1e-4 * max(1.0, abs(float(w_ret[i])))
+
+
+def test_eigsh_no_vectors():
+    n = 40
+    s = _sym(n, seed=41)
+    w = linalg.eigsh(sparse.csr_array(s), k=3, return_eigenvectors=False, tol=1e-9)
+    dense_w = np.linalg.eigvalsh(s.toarray())
+    exp = np.sort(dense_w[np.argsort(np.abs(dense_w))[-3:]])
+    assert np.allclose(np.sort(np.asarray(w)), exp, atol=1e-5)
+
+
+def test_eigsh_laplacian_smallest():
+    """The 1-D Laplacian's extreme eigenvalues are known analytically."""
+    n = 32
+    L = sp.diags([-1.0, 2.0, -1.0], [-1, 0, 1], shape=(n, n)).tocsr()
+    w = linalg.eigsh(sparse.csr_array(L), k=1, which="LA", return_eigenvectors=False, tol=1e-10)
+    exact = 2 - 2 * np.cos(np.pi * n / (n + 1))
+    assert np.allclose(np.asarray(w), [exact], atol=1e-6)

@@ -37,3 +37,28 @@ def test_mmwrite_complex_roundtrip(tmp_path):
     sparse.io.mmwrite(str(path), sparse.csr_array(s))
     back = sci_io.mmread(str(path))
     assert np.allclose(back.toarray(), s.toarray())
+
+
+def test_mmread_array_skew_symmetric(tmp_path):
+    """Array-format skew-symmetric files store only the STRICT lower
+    triangle (diagonal implicitly zero) — r2 code-review regression."""
+    path = tmp_path / "skew.mtx"
+    path.write_text(
+        "%%MatrixMarket matrix array real skew-symmetric\n3 3\n1.0\n2.0\n3.0\n"
+    )
+    got = np.asarray(sparse.io.mmread(str(path)).todense())
+    exp = np.array([[0.0, -1.0, -2.0], [1.0, 0.0, -3.0], [2.0, 3.0, 0.0]])
+    assert np.allclose(got, exp)
+    s = sci_io.mmread(str(path))
+    assert np.allclose(got, np.asarray(s))
+
+
+def test_mmread_array_symmetric(tmp_path):
+    path = tmp_path / "sym.mtx"
+    path.write_text(
+        "%%MatrixMarket matrix array real symmetric\n3 3\n"
+        "1.0\n2.0\n3.0\n4.0\n5.0\n6.0\n"
+    )
+    got = np.asarray(sparse.io.mmread(str(path)).todense())
+    s = sci_io.mmread(str(path))
+    assert np.allclose(got, np.asarray(s))

@@ -1,0 +1,117 @@
+"""Module-level constructor/predicate oracle tests vs scipy.
+
+Reference analog: ``tests/integration/test_module.py`` (kron, diagonal, sum
+over formats) plus the constructor surface (diags/spdiags/eye/identity/
+random/rand) from ``sparse/module.py``.
+"""
+
+import numpy as np
+import pytest
+import scipy.io as sci_io
+import scipy.sparse as scpy
+
+import sparse_tpu as sparse
+from .utils.common import test_mtx_files
+from .utils.sample import sample_csr
+
+
+@pytest.mark.parametrize("filename", test_mtx_files)
+@pytest.mark.parametrize("format", ["csr", "csc", "coo"])
+def test_kron(filename, format):
+    arr = sparse.io.mmread(filename).asformat(format)
+    s = sci_io.mmread(filename).asformat(format)
+    rolled = np.roll(np.asarray(arr.todense()), 1)
+    res = sparse.kron(arr, sparse.coo_array(rolled), format=format)
+    res_sci = scpy.kron(s, np.roll(np.asarray(s.todense()), 1), format=format)
+    assert res.format == format
+    assert np.allclose(np.asarray(res.todense()), np.asarray(res_sci.todense()))
+
+
+@pytest.mark.parametrize("filename", test_mtx_files)
+@pytest.mark.parametrize("k", [-1, 0, 2])
+@pytest.mark.parametrize("format", ["coo", "csr", "csc"])
+def test_diagonal(filename, k, format):
+    arr = sparse.io.mmread(filename).asformat(format)
+    s = sci_io.mmread(filename).asformat(format)
+    assert np.allclose(np.asarray(arr.diagonal(k=k)), s.todia().diagonal(k=k))
+
+
+@pytest.mark.parametrize("filename", test_mtx_files)
+@pytest.mark.parametrize("format", ["coo", "csr", "csc"])
+@pytest.mark.parametrize("axis", [None, 0, 1])
+def test_sum(filename, format, axis):
+    arr = sparse.io.mmread(filename).asformat(format)
+    s = sci_io.mmread(filename).asformat(format)
+    got = np.asarray(arr.sum(axis=axis))
+    exp = np.asarray(s.sum(axis=axis)).squeeze()
+    assert np.allclose(got, exp)
+
+
+@pytest.mark.parametrize("offsets", [0, [0], [-1, 0, 2]])
+@pytest.mark.parametrize("format", [None, "csr", "dia"])
+def test_diags(offsets, format):
+    n = 9
+    if isinstance(offsets, list):
+        diagonals = [np.arange(1.0, n + 1)[: n - abs(o)] for o in offsets]
+    else:  # scalar offset: scipy requires the bare 1-D diagonal
+        diagonals = np.arange(1.0, n + 1)
+    got = sparse.diags(diagonals, offsets, format=format)
+    exp = scpy.diags(diagonals, offsets, format=format)
+    assert np.allclose(np.asarray(got.todense()), exp.todense())
+
+
+def test_spdiags():
+    data = np.array([[1, 2, 3, 4.0], [1, 2, 3, 4], [1, 2, 3, 4]])
+    diags_offsets = np.array([0, -1, 2])
+    got = sparse.spdiags(data, diags_offsets, 4, 4)
+    exp = scpy.spdiags(data, diags_offsets, 4, 4)
+    assert np.allclose(np.asarray(got.todense()), exp.todense())
+
+
+@pytest.mark.parametrize("m,n,k", [(5, 5, 0), (5, 7, 0), (7, 5, -2), (5, 7, 3)])
+def test_eye(m, n, k):
+    got = sparse.eye(m, n, k=k)
+    exp = scpy.eye(m, n, k=k)
+    assert np.allclose(np.asarray(got.todense()), exp.todense())
+
+
+def test_identity():
+    got = sparse.identity(6, dtype=np.float32)
+    assert got.dtype == np.float32
+    assert np.allclose(np.asarray(got.todense()), np.eye(6))
+
+
+@pytest.mark.parametrize("format", ["coo", "csr", "csc"])
+def test_random(format):
+    a = sparse.random(30, 20, density=0.2, format=format, random_state=7)
+    assert a.shape == (30, 20)
+    assert a.format == format
+    dense = np.asarray(a.todense())
+    frac = np.count_nonzero(dense) / dense.size
+    assert 0.05 < frac <= 0.3
+
+
+def test_rand():
+    a = sparse.rand(10, 10, density=0.5, random_state=3)
+    dense = np.asarray(a.todense())
+    assert np.all(dense >= 0)
+
+
+def test_predicates():
+    c = sparse.csr_array(sample_csr(4, 4, seed=89))
+    assert sparse.issparse(c)
+    assert sparse.isspmatrix(c)
+    assert sparse.isspmatrix_csr(c)
+    assert not sparse.isspmatrix_csc(c)
+    assert sparse.isspmatrix_csc(c.tocsc())
+    assert sparse.isspmatrix_coo(c.tocoo())
+    assert sparse.isspmatrix_dia(sparse.eye(4, format="dia"))
+    assert not sparse.issparse(np.zeros((3, 3)))
+
+
+def test_csr_matrix_alias():
+    """scipy-compat aliases exist and build the same objects."""
+    s = sample_csr(5, 5, seed=90)
+    assert isinstance(sparse.csr_matrix(s), sparse.csr_array)
+    assert isinstance(sparse.csc_matrix(s.tocsc()), sparse.csc_array)
+    assert isinstance(sparse.coo_matrix(s.tocoo()), sparse.coo_array)

@@ -1,0 +1,47 @@
+"""Layout-construction performance discipline: no per-row host loops.
+
+VERDICT r1 #4: sharding a 1M-row Laplacian must be vectorized
+(searchsorted + scatter) — seconds, not minutes. The wall-clock bound here
+is deliberately loose (CI machines vary); the real guard is the scaling
+assert: 4x the rows must cost < 20x the time (a per-row-Python-loop
+implementation fails that by orders of magnitude).
+"""
+
+import time
+
+import numpy as np
+
+from sparse_tpu.models.poisson import laplacian_2d_csr_host
+from sparse_tpu.parallel.dist import shard_csr
+from sparse_tpu.parallel.mesh import get_mesh
+
+
+def _time_shard(A, mesh):
+    t0 = time.perf_counter()
+    D = shard_csr(A, mesh=mesh, balanced=True)
+    dt = time.perf_counter() - t0
+    return D, dt
+
+
+def test_shard_csr_1m_rows_vectorized():
+    mesh = get_mesh(8)
+    small = laplacian_2d_csr_host(500, dtype=np.float32)  # 250k rows
+    big = laplacian_2d_csr_host(1000, dtype=np.float32)  # 1M rows
+    _time_shard(small, mesh)  # warm jax dispatch paths
+    _, dt_small = _time_shard(small, mesh)
+    D, dt_big = _time_shard(big, mesh)
+    assert D.m_pad >= 1_000_000
+    assert dt_big < 5.0, f"1M-row shard_csr took {dt_big:.2f}s"
+    assert dt_big < 20 * max(dt_small, 0.05), (
+        f"superlinear layout construction: {dt_small:.3f}s -> {dt_big:.3f}s"
+    )
+    # spot-check the layout is correct at this scale: one SpMV vs host
+    import scipy.sparse as sp
+
+    x = np.random.default_rng(0).standard_normal(big.shape[0]).astype(np.float32)
+    y = D.dot(x)
+    oracle = sp.csr_matrix(
+        (np.asarray(big.data), np.asarray(big.indices), np.asarray(big.indptr)),
+        shape=big.shape,
+    )
+    assert np.allclose(y, oracle @ x, atol=1e-3)

@@ -1,0 +1,136 @@
+"""Krylov solver oracle tests vs scipy-solved systems.
+
+Reference analogs: ``tests/integration/test_cg_solve.py``,
+``test_cgs_solve.py``, ``test_bicg_solve.py`` — SPD systems built from a
+seeded random sparse matrix, solved and checked by residual (the reference
+asserts ``A @ x_pred ~= y``).
+"""
+
+import numpy as np
+import pytest
+import scipy.sparse as sp
+
+import sparse_tpu as sparse
+import sparse_tpu.linalg as linalg
+from .utils.common import real_types, types
+from .utils.sample import sample_csr, sample_vec
+
+
+def _spd(n, dtype=np.float64, seed=0, density=0.1):
+    """SPD (hermitian for complex) CSR: 0.5(S + S^H) + n*I."""
+    s = sample_csr(n, n, density=density, dtype=dtype, seed=seed)
+    a = 0.5 * (s + s.conjugate().T) + n * sp.identity(n, dtype=dtype)
+    return a.tocsr()
+
+
+@pytest.mark.parametrize("dtype", types)
+def test_cg_solve(dtype):
+    n = 100
+    s = _spd(n, dtype=dtype)
+    A = sparse.csr_array(s)
+    x = sample_vec(n, dtype=dtype, seed=7)
+    y = np.asarray(s @ x)
+    x_pred, iters = linalg.cg(A, y, tol=1e-8)
+    assert iters > 0
+    assert np.allclose(np.asarray(A @ x_pred), y, atol=1e-5)
+
+
+def test_cg_solve_with_callback():
+    n = 64
+    s = _spd(n, seed=3)
+    A = sparse.csr_array(s)
+    y = np.asarray(s @ sample_vec(n, seed=8))
+    seen = []
+    x_pred, iters = linalg.cg(A, y, tol=1e-8, callback=lambda xk: seen.append(np.asarray(xk)))
+    assert len(seen) == iters
+    assert np.allclose(np.asarray(A @ x_pred), y, atol=1e-6)
+
+
+def test_cg_solve_with_identity_preconditioner():
+    n = 64
+    s = _spd(n, seed=4)
+    A = sparse.csr_array(s)
+    y = np.asarray(s @ sample_vec(n, seed=9))
+    M = linalg.IdentityOperator((n, n), dtype=np.float64)
+    x_pred, _ = linalg.cg(A, y, tol=1e-8, M=M)
+    assert np.allclose(np.asarray(A @ x_pred), y, atol=1e-6)
+
+
+def test_cg_solve_with_jacobi_preconditioner():
+    """A real (non-identity) preconditioner must not change the answer."""
+    n = 64
+    s = _spd(n, seed=5)
+    A = sparse.csr_array(s)
+    y = np.asarray(s @ sample_vec(n, seed=10))
+    dinv = 1.0 / s.diagonal()
+    M = linalg.LinearOperator((n, n), matvec=lambda r: dinv * r, dtype=np.float64)
+    x_pred, _ = linalg.cg(A, y, tol=1e-10, M=M)
+    assert np.allclose(np.asarray(A @ x_pred), y, atol=1e-6)
+
+
+def test_cg_solve_with_linear_operator():
+    """Matrix-free operator (reference test_cg_solve.py:79)."""
+    n = 64
+    s = _spd(n, seed=6)
+    y = np.asarray(s @ sample_vec(n, seed=11))
+    sj = sparse.csr_array(s)
+    op = linalg.LinearOperator((n, n), matvec=lambda x: sj @ x, dtype=np.float64)
+    x_pred, _ = linalg.cg(op, y, tol=1e-8)
+    assert np.allclose(np.asarray(sj @ x_pred), y, atol=1e-6)
+
+
+def test_spsolve():
+    n = 48
+    s = _spd(n, seed=12)
+    A = sparse.csr_array(s)
+    y = np.asarray(s @ sample_vec(n, seed=13))
+    x_pred = linalg.spsolve(A, y, tol=1e-10)
+    assert np.allclose(np.asarray(A @ x_pred), y, atol=1e-6)
+
+
+@pytest.mark.parametrize("dtype", real_types)
+def test_cgs_solve(dtype):
+    n = 80
+    s = _spd(n, dtype=dtype, seed=14)
+    A = sparse.csr_array(s)
+    y = np.asarray(s @ sample_vec(n, dtype=dtype, seed=15))
+    x_pred, _ = linalg.cgs(A, y, tol=1e-8)
+    assert np.allclose(np.asarray(A @ x_pred), y, atol=1e-4)
+
+
+@pytest.mark.parametrize("dtype", real_types)
+def test_bicg_solve(dtype):
+    """BiCG on a NONsymmetric diagonally-dominant system
+    (reference test_bicg_solve.py:23 uses an unsymmetrized sample)."""
+    n = 80
+    s = sample_csr(n, n, density=0.1, dtype=dtype, seed=16)
+    s = (s + n * sp.identity(n, dtype=dtype)).tocsr()
+    A = sparse.csr_array(s)
+    y = np.asarray(s @ sample_vec(n, dtype=dtype, seed=17))
+    x_pred, _ = linalg.bicg(A, y, tol=1e-8)
+    assert np.allclose(np.asarray(A @ x_pred), y, atol=1e-4)
+
+
+@pytest.mark.parametrize("dtype", real_types)
+def test_bicgstab_solve(dtype):
+    n = 80
+    s = sample_csr(n, n, density=0.1, dtype=dtype, seed=18)
+    s = (s + n * sp.identity(n, dtype=dtype)).tocsr()
+    A = sparse.csr_array(s)
+    y = np.asarray(s @ sample_vec(n, dtype=dtype, seed=19))
+    x_pred, _ = linalg.bicgstab(A, y, tol=1e-8)
+    assert np.allclose(np.asarray(A @ x_pred), y, atol=1e-4)
+
+
+def test_cg_x0_and_maxiter():
+    """x0 is honored; maxiter caps the iteration count."""
+    n = 64
+    s = _spd(n, seed=20)
+    A = sparse.csr_array(s)
+    xstar = sample_vec(n, seed=21)
+    y = np.asarray(s @ xstar)
+    x_pred, iters = linalg.cg(A, y, x0=xstar, tol=1e-6, conv_test_iters=1)
+    assert iters <= 1
+    assert np.allclose(np.asarray(x_pred), xstar, atol=1e-6)
+    _, iters = linalg.cg(A, y, maxiter=3, conv_test_iters=100)
+    assert iters <= 3
