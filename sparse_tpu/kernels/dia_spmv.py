@@ -52,42 +52,76 @@ def _dia_spmv_pallas(
     """
     m, n = shape
     D = len(offsets)
-    # Mosaic DMA alignment: 2-D slices align to the (8, 128) tile, and 1-D
-    # HBM memrefs carry a (1024,) tiling — so the plane count pads to a
-    # multiple of 8 (zero planes, skipped in the compute loop), the row tile
-    # TM to 1024, and the halo B to 512 (making win = TM + 2B and every
-    # slice start g*TM multiples of 1024).
-    Dp = _round_up(D, 8)
+    # Mosaic DMA alignment: 1-D HBM memrefs carry a (1024,) tiling, so the
+    # row tile TM rounds to 1024 and the halo B to 512 — then the window
+    # win = TM + 2B, every window start g*TM, and each plane's base k*L in
+    # the flattened plane array are all multiples of 1024.
     B = _round_up(max(max((abs(int(o)) for o in offsets), default=0), 1), 512)
     TM = min(_round_up(tile, 1024), _round_up(max(m, 1024), 1024))
     G = (m + TM - 1) // TM
     m_pad = G * TM
     win = TM + 2 * B
+    L = m_pad + 2 * B  # padded plane length (multiple of 1024)
 
     # Halo-pad data planes and x into a shared padded coordinate system
     # (index j' = j + B); a copy of the inputs, NOT a product intermediate.
+    # The plane count pads to a sublane multiple of 8 (zero planes) so each
+    # window is one aligned [Dp, win] DMA.
+    Dp = _round_up(D, 8)
     pad_hi = max(m_pad - n, 0) + B
-    data_p = jnp.pad(data, ((0, Dp - D), (B, pad_hi)))[:, : m_pad + 2 * B]
-    x_p = jnp.pad(x, (B, pad_hi))[: m_pad + 2 * B]
+    data_p = jnp.pad(data, ((0, Dp - D), (B, pad_hi)))[:, :L]
+    x_p = jnp.pad(x, (B, pad_hi))[:L]
     out_dt = jnp.result_type(data.dtype, x.dtype)
 
-    def kernel(data_hbm, x_hbm, y_ref, dwin, xwin, sems):
+    def kernel(data_hbm, x_hbm, y_ref, dwinA, dwinB, xwinA, xwinB, semA, semB):
+        # Cross-step double buffering: step g waits on the DMAs it (or the
+        # warm-up) issued into its slot's buffers and prefetches step g+1
+        # into the other slot's, overlapping HBM reads with VPU compute —
+        # scratch and semaphores persist across the sequential TPU grid.
+        # The two slots are unrolled statically (Mosaic cannot scalar-index
+        # the tiled dims of a VMEM ref, so buffer choice must be static).
         g = pl.program_id(0)
-        d_dma = pltpu.make_async_copy(
-            data_hbm.at[:, pl.ds(g * TM, win)], dwin, sems.at[0]
-        )
-        x_dma = pltpu.make_async_copy(
-            x_hbm.at[pl.ds(g * TM, win)], xwin, sems.at[1]
-        )
-        d_dma.start()
-        x_dma.start()
-        d_dma.wait()
-        x_dma.wait()
-        acc = jnp.zeros((TM,), dtype=y_ref.dtype)
-        for k, o in enumerate(offsets):
-            lo = B + int(o)
-            acc = acc + dwin[k, lo : lo + TM] * xwin[lo : lo + TM]
-        y_ref[:] = acc
+        G_ = pl.num_programs(0)
+
+        def issue(dwin, xwin, sem, gg):
+            pltpu.make_async_copy(
+                data_hbm.at[:, pl.ds(gg * TM, win)], dwin, sem.at[0]
+            ).start()
+            pltpu.make_async_copy(
+                x_hbm.at[pl.ds(gg * TM, win)], xwin, sem.at[1]
+            ).start()
+
+        def wait(dwin, xwin, sem, gg):
+            pltpu.make_async_copy(
+                data_hbm.at[:, pl.ds(gg * TM, win)], dwin, sem.at[0]
+            ).wait()
+            pltpu.make_async_copy(
+                x_hbm.at[pl.ds(gg * TM, win)], xwin, sem.at[1]
+            ).wait()
+
+        def step(dwin, xwin, sem, dwin_n, xwin_n, sem_n):
+            @pl.when(g == 0)
+            def _():
+                issue(dwin, xwin, sem, g)
+
+            @pl.when(g + 1 < G_)
+            def _():
+                issue(dwin_n, xwin_n, sem_n, g + 1)
+
+            wait(dwin, xwin, sem, g)
+            acc = jnp.zeros((TM,), dtype=y_ref.dtype)
+            for k, o in enumerate(offsets):
+                lo = B + int(o)
+                acc = acc + dwin[k, lo : lo + TM] * xwin[lo : lo + TM]
+            y_ref[:] = acc
+
+        @pl.when(g % 2 == 0)
+        def _():
+            step(dwinA, xwinA, semA, dwinB, xwinB, semB)
+
+        @pl.when(g % 2 == 1)
+        def _():
+            step(dwinB, xwinB, semB, dwinA, xwinA, semA)
 
     y = pl.pallas_call(
         kernel,
@@ -100,7 +134,10 @@ def _dia_spmv_pallas(
         out_shape=jax.ShapeDtypeStruct((m_pad,), out_dt),
         scratch_shapes=[
             pltpu.VMEM((Dp, win), data.dtype),
+            pltpu.VMEM((Dp, win), data.dtype),
             pltpu.VMEM((win,), x.dtype),
+            pltpu.VMEM((win,), x.dtype),
+            pltpu.SemaphoreType.DMA((2,)),
             pltpu.SemaphoreType.DMA((2,)),
         ],
         interpret=interpret,

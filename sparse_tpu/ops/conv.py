@@ -92,13 +92,23 @@ def csr_to_coo(indptr, indices, data, shape):
 
 
 def csr_to_csc(indptr, indices, data, shape):
-    """CSR -> CSC via a (col, row) sort. No duplicate collapse needed."""
+    """CSR -> CSC via a (col, row) sort. No duplicate collapse needed.
+
+    Tolerates trailing padding nnz (positions >= indptr[-1]): they are keyed
+    past every real column, sort to the tail, and stay beyond the returned
+    indptr's last entry — the shared tile-padding convention of
+    ``ops.spgemm`` (uniform shapes -> shared compiles).
+    """
     nnz = data.shape[0]
+    m, n = int(shape[0]), int(shape[1])
     rows = expand_rows(indptr, nnz)
-    keys = linearize(indices, rows, (shape[1], shape[0]))
+    valid = jnp.arange(nnz) < indptr[-1]
+    keys = linearize(indices, rows, (n, m))
+    keys = jnp.where(valid, keys, jnp.asarray(n, keys.dtype) * m)
+    cols_for_indptr = jnp.where(valid, indices, n)
     order = jnp.argsort(keys, stable=True)
     idt = index_dtype_for(shape, nnz)
-    col_indptr = rows_to_indptr(indices[order], int(shape[1]), dtype=idt)
+    col_indptr = rows_to_indptr(cols_for_indptr[order], n, dtype=idt)
     return col_indptr, rows[order].astype(idt), data[order]
 
 

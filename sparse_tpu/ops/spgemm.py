@@ -16,30 +16,110 @@ is pure gather/sort/segment-reduce and maps directly onto XLA's sort machinery:
 
 One host sync for the expansion size, one for the result nnz (the reference
 blocks on the same two quantities via FutureMap scans, csr.py:827-859).
+
+Data-dependent intermediate sizes (the expansion total, the unique count) are
+BUCKETED to powers of two with masked sentinel padding, so repeated products
+with nearby sizes — e.g. the 8 row-block tiles of a distributed Galerkin
+triple product, or successive AMG levels — share compiled programs instead of
+paying a fresh XLA sort compile per exact size.
 """
 
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 
 from ..types import index_dtype_for
 from ..utils import host_int
 from .coords import (
     counts_to_indptr,
-    dedup_sorted,
     expand_rows,
     linearize,
+    require_x64_keys,
     rows_to_indptr,
 )
 
 
-def spgemm_csr_csr(
-    indptr_a, indices_a, data_a, indptr_b, indices_b, data_b, shape_a, shape_b
+def _next_pow2(v: int) -> int:
+    return 1 << (max(int(v), 1) - 1).bit_length()
+
+
+def esc_expand_sort_compress(
+    indptr_a, indices_a, data_a, indptr_b, indices_b, data_b,
+    n: int, T: int, U: int, kdt, dt, m_real: int,
 ):
-    """C = A @ B, both CSR. Returns (indptr, indices, data) of C (CSR)."""
+    """The fully-traced ESC body shared by the single-device product and the
+    shard_map tile of ``parallel.spgemm`` (one compile per bucket shape).
+
+    ``T``/``U`` are static pow-2 buckets for the expansion/unique sizes;
+    padding slots carry value 0 and the sentinel key ``m_real * n``
+    (``m_real`` = largest REAL local row count — padded tile rows are empty,
+    so keys never reach them and the int32/int64 threshold is set by real
+    work, not by the pow-2 padded tile shape). Returns
+    (ukeys [U], uvals [U], nunique scalar); entries past nunique are
+    sentinel-keyed with value 0.
+    """
+    nnz_a = indices_a.shape[0]
+    rows_a = expand_rows(indptr_a, nnz_a)
+    # expansion counts: |B row| at each A column id; caller-padded nnz
+    # slots (beyond indptr_a[-1]) expand to nothing
+    counts = indptr_b[indices_a + 1] - indptr_b[indices_a]
+    counts = jnp.where(jnp.arange(nnz_a) < indptr_a[-1], counts, 0)
+    offsets = counts_to_indptr(counts, dtype=jnp.int64)
+    total = offsets[-1]
+    sentinel = jnp.asarray(m_real, kdt) * n
+    t = jnp.arange(T, dtype=jnp.int64)
+    tvalid = t < total
+    src = jnp.clip(
+        jnp.searchsorted(offsets, t, side="right") - 1, 0, nnz_a - 1
+    )
+    p = jnp.clip(
+        indptr_b[indices_a[src]].astype(jnp.int64) + (t - offsets[src]),
+        0,
+        data_b.shape[0] - 1,
+    )
+    out_vals = jnp.where(
+        tvalid, data_a[src].astype(dt) * data_b[p].astype(dt), 0
+    )
+    keys = jnp.where(
+        tvalid,
+        rows_a[src].astype(kdt) * n + indices_b[p].astype(kdt),
+        sentinel,
+    )
+    order = jnp.argsort(keys, stable=True)
+    skeys = keys[order]
+    svals = out_vals[order]
+    # compress: collapse duplicate keys; sentinels are never "new" so they
+    # fold (with value 0) into the last real segment
+    is_new = jnp.concatenate(
+        [jnp.ones((1,), dtype=bool), skeys[1:] != skeys[:-1]]
+    ) & (skeys < sentinel)
+    seg = jnp.clip(jnp.cumsum(is_new) - 1, 0, U - 1)
+    uvals = jax.ops.segment_sum(svals, seg, num_segments=U)
+    # fill_value T-1 is always a sentinel slot (T > total), so padded
+    # unique entries stay sentinel-keyed and are trimmed by the caller
+    first_idx = jnp.nonzero(is_new, size=U, fill_value=T - 1)[0]
+    return skeys[first_idx], uvals, is_new.sum()
+
+
+def spgemm_csr_csr(
+    indptr_a, indices_a, data_a, indptr_b, indices_b, data_b, shape_a, shape_b,
+    m_real: int | None = None,
+):
+    """C = A @ B, both CSR. Returns (indptr, indices, data) of C (CSR).
+
+    Inputs may carry trailing padding nnz (entries at positions >=
+    ``indptr_a[-1]``): they are masked out of the expansion, so callers can
+    pad tiles to shared shapes (parallel.spgemm does). ``m_real`` (default
+    ``shape_a[0]``) is the largest row id actually populated — callers with
+    padded tile shapes pass the real row count so key-width selection isn't
+    inflated by padding.
+    """
     m = int(shape_a[0])
     n = int(shape_b[1])
     out_shape = (m, n)
+    if m_real is None:
+        m_real = m
     dt = jnp.result_type(data_a.dtype, data_b.dtype)
     nnz_a = data_a.shape[0]
     if nnz_a == 0 or data_b.shape[0] == 0:
@@ -49,11 +129,10 @@ def spgemm_csr_csr(
             jnp.zeros((0,), dtype=idt),
             jnp.zeros((0,), dtype=dt),
         )
-    rows_a = expand_rows(indptr_a, nnz_a)
-    # expansion counts: |B row| at each A column id
+    # expansion size: one cheap host sync (the reference's NNZ phase)
     counts = indptr_b[indices_a + 1] - indptr_b[indices_a]
-    offsets = counts_to_indptr(counts, dtype=jnp.int64)
-    total = host_int(offsets[-1])
+    counts = jnp.where(jnp.arange(nnz_a) < indptr_a[-1], counts, 0)
+    total = host_int(jnp.sum(counts.astype(jnp.int64)))
     if total == 0:
         idt = index_dtype_for(out_shape, 0)
         return (
@@ -61,15 +140,19 @@ def spgemm_csr_csr(
             jnp.zeros((0,), dtype=idt),
             jnp.zeros((0,), dtype=dt),
         )
-    t = jnp.arange(total, dtype=jnp.int64)
-    src = jnp.searchsorted(offsets, t, side="right") - 1  # source A-nnz per product
-    p = indptr_b[indices_a[src]].astype(jnp.int64) + (t - offsets[src])
-    out_rows = rows_a[src]
-    out_cols = indices_b[p]
-    out_vals = data_a[src].astype(dt) * data_b[p].astype(dt)
-    keys = linearize(out_rows, out_cols, out_shape)
-    order = jnp.argsort(keys, stable=True)
-    urows, ucols, uvals, nunique = dedup_sorted(keys[order], out_vals[order], out_shape)
+    # Bucket the expansion to the next power of two (always > total so the
+    # sentinel block is nonempty).
+    T = _next_pow2(total + 1)
+    kdt = jnp.int64 if require_x64_keys((int(m_real), n)) else jnp.int32
+    ukeys_all, uvals_all, nunique_dev = esc_expand_sort_compress(
+        indptr_a, indices_a, data_a, indptr_b, indices_b, data_b,
+        n=n, T=T, U=T, kdt=kdt, dt=dt, m_real=int(m_real),
+    )
+    nunique = host_int(nunique_dev)
+    ukeys = ukeys_all[:_next_pow2(nunique)]
+    uvals = uvals_all[:_next_pow2(nunique)]
+    urows = (ukeys // n).astype(kdt)
+    ucols = (ukeys % n).astype(kdt)
     idt = index_dtype_for(out_shape, nunique)
     indptr = rows_to_indptr(urows, m, dtype=idt)
-    return indptr, ucols.astype(idt), uvals
+    return indptr, ucols[:nunique].astype(idt), uvals[:nunique]

@@ -22,11 +22,20 @@ get BUILT.
 
 from __future__ import annotations
 
+from functools import partial
+
 import jax
+import jax.numpy as jnp
 import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
 
 from .mesh import get_mesh, get_mesh_2d
 from .partition import balanced_row_splits, equal_row_splits
+
+try:  # jax>=0.8 top-level; older releases keep it in experimental
+    from jax import shard_map
+except ImportError:  # pragma: no cover
+    from jax.experimental.shard_map import shard_map
 
 
 def _row_block(indptr, indices, data, r0: int, r1: int):
@@ -35,20 +44,65 @@ def _row_block(indptr, indices, data, r0: int, r1: int):
     return indptr[r0 : r1 + 1] - indptr[r0], indices[lo:hi], data[lo:hi]
 
 
+def _pad_block(ip, ix, dv, rows_pad: int, nnz_pad: int):
+    """Pad a CSR triple to (rows_pad, nnz_pad): appended rows are empty
+    (indptr extends flat), appended nnz slots sit beyond indptr[-1] and are
+    masked out by ``ops.spgemm.spgemm_csr_csr``. Uniform tile shapes mean
+    all shards of a product — and nearby levels of a hierarchy — share one
+    compiled ESC program instead of compiling per exact tile size."""
+    nr = ip.shape[0] - 1
+    nnz = ix.shape[0]
+    ip_p = np.concatenate([ip, np.full(rows_pad - nr, ip[-1], dtype=ip.dtype)])
+    ix_p = np.concatenate([ix, np.zeros(nnz_pad - nnz, dtype=ix.dtype)])
+    dv_p = np.concatenate([dv, np.zeros(nnz_pad - nnz, dtype=dv.dtype)])
+    return ip_p, ix_p, dv_p
+
+
+@partial(
+    jax.jit, static_argnames=("mesh", "axis", "n", "T", "kdt", "dt", "m_real")
+)
+def _esc_sharded(
+    ipA, ixA, dvA, ip_b, ix_b, dv_b, mesh, axis, n, T, kdt, dt, m_real
+):
+    """All S tiles in ONE compiled shard_map program: A tiles sharded on
+    the mesh, B replicated — so the grid runs concurrently and the compile
+    is shared across shards AND across calls with the same bucket shapes
+    (successive AMG levels, repeated Galerkin products). The per-shard body
+    is the shared traced ESC core (``ops.spgemm.esc_expand_sort_compress``,
+    the row-gather SpGEMM tile of reference csr.py:1390-1490)."""
+    from ..ops.spgemm import esc_expand_sort_compress
+
+    def shard_fn(ipA_l, ixA_l, dvA_l, ip_b, ix_b, dv_b):
+        uk, uv, nu = esc_expand_sort_compress(
+            ipA_l.squeeze(0), ixA_l.squeeze(0), dvA_l.squeeze(0),
+            ip_b, ix_b, dv_b, n=n, T=T, U=T, kdt=kdt, dt=dt, m_real=m_real,
+        )
+        return uk[None], uv[None], nu.astype(jnp.int64)[None]
+
+    return shard_map(
+        shard_fn,
+        mesh=mesh,
+        in_specs=(P(axis, None), P(axis, None), P(axis, None), P(), P(), P()),
+        out_specs=(P(axis, None), P(axis, None), P(axis)),
+        check_vma=False,
+    )(ipA, ixA, dvA, ip_b, ix_b, dv_b)
+
+
 def dist_spgemm(A, B, mesh=None, balanced: bool = True):
     """C = A @ B (both ``csr_array``) with A row-split over the mesh.
 
     The row-gather algorithm (csr.py:1390-1490): shard s computes
-    ``A[rows_s] @ B`` as a local CSR tile on device s (B replicated, like
-    the reference's gathered-C), then the host stitches tiles with one pos
+    ``A[rows_s] @ B`` as a local tile (B replicated, like the reference's
+    gathered-C) — all S tiles padded to one bucket shape and launched as a
+    single shard_map program — then the host stitches tiles with one pos
     scan. Returns a ``csr_array``.
     """
     import sparse_tpu
 
     if mesh is None:
         mesh = get_mesh()
-    devs = list(mesh.devices.reshape(-1))
-    S = len(devs)
+    axis = mesh.axis_names[0]
+    S = int(mesh.devices.size)
     m, k = A.shape
     k2, n = B.shape
     if k != k2:
@@ -57,50 +111,93 @@ def dist_spgemm(A, B, mesh=None, balanced: bool = True):
     indptr = np.asarray(A.indptr)
     indices = np.asarray(A.indices)
     data = np.asarray(A.data)
+    b_indptr = np.asarray(B.indptr)
+    dt = np.result_type(A.dtype, B.dtype)
     splits = (
         balanced_row_splits(indptr, S) if balanced else equal_row_splits(m, S)
     )
 
-    from ..ops.spgemm import spgemm_csr_csr
-
-    tiles = []
-    for s in range(S):
-        r0, r1 = int(splits[s]), int(splits[s + 1])
-        if r1 <= r0:
-            tiles.append(None)
-            continue
-        ip, ix, dv = _row_block(indptr, indices, data, r0, r1)
-        dev = devs[s]
-        args = [jax.device_put(np.ascontiguousarray(a), dev) for a in (ip, ix, dv)]
-        bargs = [jax.device_put(np.asarray(a), dev) for a in (B.indptr, B.indices, B.data)]
-        tiles.append(
-            spgemm_csr_csr(
-                args[0], args[1], args[2],
-                bargs[0], bargs[1], bargs[2],
-                (r1 - r0, k), (k, n),
-            )
+    if A.nnz == 0 or B.nnz == 0:
+        return sparse_tpu.csr_array.from_parts(
+            np.zeros(0, dtype=dt),
+            np.zeros(0, dtype=np.int32),
+            np.zeros(m + 1, dtype=np.int64),
+            (m, n),
         )
+
+    from ..ops.spgemm import _next_pow2
+
+    # Uniform padded tile shape across shards -> one compile for all S.
+    rows_real = max(int(splits[s + 1] - splits[s]) for s in range(S))
+    rows_pad = _next_pow2(rows_real)
+    nnz_pad = _next_pow2(
+        max(int(indptr[splits[s + 1]] - indptr[splits[s]]) for s in range(S))
+    )
+    # expansion bucket from a cheap host pass (the reference's NNZ phase)
+    bcounts = np.diff(b_indptr).astype(np.int64)
+    exp_per_nnz = bcounts[indices]
+    totals = [
+        int(exp_per_nnz[indptr[splits[s]] : indptr[splits[s + 1]]].sum())
+        for s in range(S)
+    ]
+    T = _next_pow2(max(totals) + 1)
+    # key width from REAL per-tile work, not the pow-2 padded tile shape
+    kdt = jnp.int64 if rows_real * n > np.iinfo(np.int32).max else jnp.int32
+    if kdt == jnp.int64 and not jax.config.jax_enable_x64:
+        raise ValueError(
+            f"distributed spgemm tile keys need int64 (max_tile_rows*n = "
+            f"{rows_real * n}); enable jax_enable_x64"
+        )
+
+    # indices stay in their native width (int32 when the inputs fit) — the
+    # replicated B index gathers dominate the tile's memory traffic
+    idx_dt = np.int32 if max(n, k, int(indptr[-1]), int(b_indptr[-1])) < 2**31 else np.int64
+    ipA = np.zeros((S, rows_pad + 1), dtype=idx_dt)
+    ixA = np.zeros((S, nnz_pad), dtype=idx_dt)
+    dvA = np.zeros((S, nnz_pad), dtype=data.dtype)
+    for s in range(S):
+        ip, ix, dv = _pad_block(
+            *_row_block(indptr, indices, data, int(splits[s]), int(splits[s + 1])),
+            rows_pad,
+            nnz_pad,
+        )
+        ipA[s], ixA[s], dvA[s] = ip, ix, dv
+
+    sh = NamedSharding(mesh, P(axis, None))
+    rep = NamedSharding(mesh, P())
+    ukeys, uvals, nuniques = _esc_sharded(
+        jax.device_put(ipA, sh),
+        jax.device_put(ixA, sh),
+        jax.device_put(dvA, sh),
+        jax.device_put(b_indptr.astype(idx_dt), rep),
+        jax.device_put(np.asarray(B.indices, dtype=idx_dt), rep),
+        jax.device_put(np.asarray(B.data), rep),
+        mesh=mesh, axis=axis, n=int(n), T=T, kdt=kdt, dt=jnp.dtype(dt),
+        m_real=rows_real,
+    )
+
     # Host pos-scan stitch (scan_local_results_and_scale_pos analog).
+    ukeys = np.asarray(ukeys)
+    uvals = np.asarray(uvals)
+    nuniques = np.asarray(nuniques)
     out_indptr = np.zeros(m + 1, dtype=np.int64)
     parts_ix, parts_dv = [], []
     offset = 0
     for s in range(S):
         r0, r1 = int(splits[s]), int(splits[s + 1])
-        if tiles[s] is None:
-            out_indptr[r0 + 1 : r1 + 1] = offset
-            continue
-        tip, tix, tdv = (np.asarray(t) for t in tiles[s])
-        out_indptr[r0 + 1 : r1 + 1] = tip[1:].astype(np.int64) + offset
-        offset += int(tip[-1])
-        parts_ix.append(tix)
-        parts_dv.append(tdv)
+        nu = int(nuniques[s])
+        lrows = ukeys[s, :nu] // n
+        lcols = ukeys[s, :nu] % n
+        counts = np.bincount(lrows, minlength=rows_pad)[: r1 - r0]
+        out_indptr[r0 + 1 : r1 + 1] = np.cumsum(counts) + offset
+        offset += nu
+        parts_ix.append(lcols)
+        parts_dv.append(uvals[s, :nu])
     out_indices = (
-        np.concatenate(parts_ix) if parts_ix else np.zeros(0, dtype=np.int32)
+        np.concatenate(parts_ix) if parts_ix else np.zeros(0, dtype=np.int64)
     )
     out_data = (
-        np.concatenate(parts_dv)
-        if parts_dv
-        else np.zeros(0, dtype=np.result_type(A.dtype, B.dtype))
+        np.concatenate(parts_dv) if parts_dv else np.zeros(0, dtype=dt)
     )
     return sparse_tpu.csr_array.from_parts(
         out_data, out_indices, out_indptr, (m, n)
@@ -143,38 +240,71 @@ def dist_spgemm_2d(A, B, mesh2d=None):
     from ..ops.conv import csr_to_csc
     from ..ops.spgemm import spgemm_csr_csr
 
+    from ..ops.spgemm import _next_pow2
+
+    # Uniform padded tile shapes -> one csr_to_csc + one ESC compile for
+    # the whole (gx, gy) grid.
+    rows_real = max(int(row_splits[i + 1] - row_splits[i]) for i in range(gx))
+    rows_pad = _next_pow2(rows_real)
+    annz_pad = _next_pow2(
+        max(
+            int(a_indptr[row_splits[i + 1]] - a_indptr[row_splits[i]])
+            for i in range(gx)
+        )
+    )
+    cols_pad = _next_pow2(
+        max(int(col_splits[j + 1] - col_splits[j]) for j in range(gy))
+    )
+    bnnz_pad = _next_pow2(
+        max(
+            int(b_indptr[col_splits[j + 1]] - b_indptr[col_splits[j]])
+            for j in range(gy)
+        )
+    )
     tiles = {}
+    real_rows = {}
     for i in range(gx):
         r0, r1 = int(row_splits[i]), int(row_splits[i + 1])
         if r1 <= r0:
             continue
-        aip, aix, adv = _row_block(a_indptr, a_indices, a_data, r0, r1)
+        aip, aix, adv = _pad_block(
+            *_row_block(a_indptr, a_indices, a_data, r0, r1), rows_pad, annz_pad
+        )
         for j in range(gy):
             c0, c1 = int(col_splits[j]), int(col_splits[j + 1])
             if c1 <= c0:
                 continue
             dev = grid[i, j]
             # column block of B as a CSC triple, then to CSR on-device
-            bip, bix, bdv = _row_block(b_indptr, b_indices, b_data, c0, c1)
+            bip, bix, bdv = _pad_block(
+                *_row_block(b_indptr, b_indices, b_data, c0, c1),
+                cols_pad,
+                bnnz_pad,
+            )
             dev_put = lambda a: jax.device_put(np.ascontiguousarray(a), dev)
             # the CSC triple of B[:, c0:c1] is the CSR of its transpose
             # [c, k]; csr_to_csc of that transpose is the CSR of the block
             tb_ip, tb_ix, tb_dv = csr_to_csc(
-                dev_put(bip), dev_put(bix), dev_put(bdv), (c1 - c0, k)
+                dev_put(bip), dev_put(bix), dev_put(bdv), (cols_pad, k)
             )
             tiles[(i, j)] = spgemm_csr_csr(
                 dev_put(aip), dev_put(aix), dev_put(adv),
                 tb_ip, tb_ix, tb_dv,
-                (r1 - r0, k), (k, c1 - c0),
+                (rows_pad, k), (k, cols_pad),
+                m_real=rows_real,
             )
+            real_rows[(i, j)] = r1 - r0
 
     # Stitch: per row block, merge grid-j tiles row-by-row (vectorized
     # lexsort assembly — the host-side analog of the 3-phase shuffle).
+    # Padded tile rows are empty; slice to the real row count.
     rows_all, cols_all, vals_all = [], [], []
     for (i, j), (tip, tix, tdv) in tiles.items():
-        tip = np.asarray(tip).astype(np.int64)
-        tix = np.asarray(tix).astype(np.int64)
-        tdv = np.asarray(tdv)
+        nr = real_rows[(i, j)]
+        tip = np.asarray(tip).astype(np.int64)[: nr + 1]
+        nreal = int(tip[-1])
+        tix = np.asarray(tix).astype(np.int64)[:nreal]
+        tdv = np.asarray(tdv)[:nreal]
         cnt = np.diff(tip)
         trows = np.repeat(np.arange(len(cnt), dtype=np.int64), cnt)
         rows_all.append(trows + int(row_splits[i]))

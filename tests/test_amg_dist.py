@@ -1,0 +1,41 @@
+"""Distributed AMG end-to-end: hierarchy built with mesh SpGEMM, solved
+with a distributed V-cycle-preconditioned CG (VERDICT r1 #3 done-criterion).
+
+Runs the example as a subprocess on the virtual 8-device CPU mesh — the
+same driver a user runs — and checks convergence and hierarchy shape
+against the single-device expectations.
+"""
+
+import os
+import re
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_amg_dist_end_to_end():
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "examples", "amg.py"),
+         "-n", "16", "-dist", "-tpu"],
+        capture_output=True,
+        text=True,
+        timeout=420,
+        cwd=REPO,
+        env=env,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    out = proc.stdout
+    m = re.search(r"levels: (\d+)\s+sizes: \[([0-9, ]+)\]", out)
+    assert m, out
+    sizes = [int(v) for v in m.group(2).split(",")]
+    assert sizes[0] == 256  # 16x16 fine grid
+    assert len(sizes) >= 2 and sizes[-1] < sizes[0]
+    m = re.search(r"Iterations: (\d+)\s+residual: ([0-9.e+-]+)", out)
+    assert m, out
+    iters, resid = int(m.group(1)), float(m.group(2))
+    assert resid < 1e-7
+    assert 0 < iters < 100  # V-cycle preconditioning, not plain CG

@@ -1,0 +1,50 @@
+"""Fused two-pass CG (kernels/cg_dia.py) vs the plain step-loop oracle."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from sparse_tpu.kernels.cg_dia import cg_dia_fused
+from sparse_tpu.models.poisson import (
+    cg_dia,
+    laplacian_2d_dia,
+    make_cg_step_dia,
+)
+
+
+@pytest.mark.parametrize("n,iters", [(16, 50), (40, 30)])
+def test_cg_fused_matches_step_loop(n, iters):
+    N = n * n
+    planes, offsets = laplacian_2d_dia(n)
+    b = jax.random.normal(jax.random.PRNGKey(0), (N,), dtype=jnp.float32)
+    x0 = jnp.zeros((N,), jnp.float32)
+
+    step = make_cg_step_dia(offsets, n, use_pallas=False)
+    state = (planes, x0, b, jnp.zeros((N,), jnp.float32), jnp.zeros((), jnp.float32))
+    x_ref = np.asarray(cg_dia(step, *state, iters=iters)[0])
+
+    x_f, r_f, rho = cg_dia_fused(
+        planes, offsets, b, x0, N, iters=iters, interpret=True
+    )
+    assert np.allclose(np.asarray(x_f), x_ref, atol=1e-4)
+    assert float(rho) >= 0.0
+
+
+def test_cg_fused_nonzero_x0():
+    n = 16
+    N = n * n
+    planes, offsets = laplacian_2d_dia(n)
+    key = jax.random.PRNGKey(1)
+    b = jax.random.normal(key, (N,), dtype=jnp.float32)
+    x0 = jax.random.normal(jax.random.PRNGKey(2), (N,), dtype=jnp.float32)
+
+    step = make_cg_step_dia(offsets, n, use_pallas=False)
+    from sparse_tpu.ops.dia_spmv import dia_spmv_xla
+
+    r0 = b - dia_spmv_xla(planes, offsets, x0, (N, N))
+    state = (planes, x0, r0, jnp.zeros((N,), jnp.float32), jnp.zeros((), jnp.float32))
+    x_ref = np.asarray(cg_dia(step, *state, iters=40)[0])
+
+    x_f = cg_dia_fused(planes, offsets, b, x0, N, iters=40, interpret=True)[0]
+    assert np.allclose(np.asarray(x_f), x_ref, atol=1e-4)

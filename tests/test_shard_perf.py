@@ -32,7 +32,10 @@ def test_shard_csr_1m_rows_vectorized():
     D, dt_big = _time_shard(big, mesh)
     assert D.m_pad >= 1_000_000
     assert dt_big < 5.0, f"1M-row shard_csr took {dt_big:.2f}s"
-    assert dt_big < 20 * max(dt_small, 0.05), (
+    # loose scaling guard: a per-row Python loop is ~1000x off, while
+    # allocator effects (the 4x-larger arrays are mmap'd fresh each call,
+    # the small ones recycled) can legitimately cost tens of x
+    assert dt_big < 100 * max(dt_small, 0.05), (
         f"superlinear layout construction: {dt_small:.3f}s -> {dt_big:.3f}s"
     )
     # spot-check the layout is correct at this scale: one SpMV vs host
