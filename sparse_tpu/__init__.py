@@ -18,8 +18,18 @@ from .csc import csc_array  # noqa: F401
 from .csr import csr_array  # noqa: F401
 from .dia import dia_array  # noqa: F401
 from .module import (  # noqa: F401
+    SparseEfficiencyWarning,
+    SparseWarning,
+    block_array,
+    block_diag,
+    bmat,
     diags,
+    diags_array,
     eye,
+    eye_array,
+    find,
+    get_index_dtype,
+    hstack,
     identity,
     is_sparse_matrix,
     issparse,
@@ -29,10 +39,19 @@ from .module import (  # noqa: F401
     isspmatrix_csr,
     isspmatrix_dia,
     kron,
+    kronsum,
+    load_npz,
     rand,
     random,
+    random_array,
+    save_npz,
     spdiags,
+    tril,
+    triu,
+    vstack,
 )
+
+sparray = SparseArray  # scipy's abstract base alias
 
 # scipy.sparse.*_matrix aliases (coverage layer parity, coverage.py:226-276)
 csr_matrix = csr_array

@@ -39,7 +39,7 @@ def _round_up(v: int, m: int) -> int:
     return (v + m - 1) // m * m
 
 
-def _plan(m: int, offsets: tuple, tile: int = 65536):
+def _plan(m: int, offsets: tuple, tile: int = 16384):
     """Tile TM and halo B (both multiples of the 1024-element HBM tiling).
 
     B covers the band; TM is as large as ``tile`` allows (fewer grid steps
@@ -188,6 +188,7 @@ def cg_dia_fused(
     Returns (x, r, rho) with rho = ||r||^2. Matches ``cg_step_dia``'s
     recurrence exactly (same beta/alpha guards) — two fused passes per
     iteration instead of an SpMV plus a train of elementwise kernels.
+    ``x0=None`` starts from zero and skips the setup SpMV (r0 = b).
     """
     dt = jnp.result_type(data.dtype, b.dtype)
     TM, B, G = _plan(m, offsets)
@@ -199,7 +200,11 @@ def cg_dia_fused(
 
     planes_row = _row_planes(data.astype(dt), offsets, m_pad, B)
     bp = _pad_vec(b.astype(dt), TM, G)
-    xp = _pad_vec(x0.astype(dt), TM, G)
+    xp = (
+        jnp.zeros(((G + 2) * TM,), dt)
+        if x0 is None
+        else _pad_vec(x0.astype(dt), TM, G)
+    )
 
     kA = pl.pallas_call(
         _kernel_a(offsets, TM, B, win, D),
@@ -262,15 +267,15 @@ def cg_dia_fused(
         interpret=interpret,
     )
 
-    rp0 = bp  # r = b - A @ 0 (x0 == 0 fast path handled below)
-    # general x0: r = b - A x0 via one kernel-A pass with beta "absorbing"
-    # nothing — cheaper to reuse the XLA DIA SpMV once at setup
-    from ..ops.dia_spmv import dia_spmv_xla
+    if x0 is None:
+        rp0 = bp  # r = b - A @ 0
+    else:
+        from ..ops.dia_spmv import dia_spmv_xla
 
-    r0 = b.astype(dt) - dia_spmv_xla(
-        data.astype(dt), offsets, x0.astype(dt), (m, m)
-    )
-    rp0 = _pad_vec(r0, TM, G)
+        r0 = b.astype(dt) - dia_spmv_xla(
+            data.astype(dt), offsets, x0.astype(dt), (m, m)
+        )
+        rp0 = _pad_vec(r0, TM, G)
     rho0 = jnp.vdot(rp0, rp0).real.astype(dt)
     pp0 = jnp.zeros_like(bp)
 

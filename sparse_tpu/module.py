@@ -194,3 +194,199 @@ def isspmatrix_coo(o) -> bool:
 
 def isspmatrix_dia(o) -> bool:
     return isinstance(o, dia_array)
+
+
+# ---------------------------------------------------------------------------
+# Block assembly / triangles / nonzero surface (coverage.py parity layer) —
+# the scipy.sparse construction helpers the reference's drop-in story
+# implies. All are coordinate-space assemblies over the COO machinery.
+# ---------------------------------------------------------------------------
+class SparseWarning(Warning):
+    pass
+
+
+class SparseEfficiencyWarning(SparseWarning):
+    pass
+
+
+def find(A):
+    """(rows, cols, values) of the nonzero entries (scipy.sparse.find)."""
+    c = A.tocoo() if issparse(A) else coo_array(np.asarray(A))
+    vals = np.asarray(c.data)
+    rows = np.asarray(c.row)
+    cols = np.asarray(c.col)
+    nz = vals != 0
+    order = np.lexsort((cols[nz], rows[nz]))  # scipy returns row-major order
+    return rows[nz][order], cols[nz][order], vals[nz][order]
+
+
+def _coo_parts(A):
+    c = A.tocoo() if issparse(A) else coo_array(np.asarray(A))
+    return np.asarray(c.row), np.asarray(c.col), np.asarray(c.data), c.shape
+
+
+def tril(A, k=0, format=None):
+    """Lower triangle (entries with col - row <= k)."""
+    r, c, v, shape = _coo_parts(A)
+    keep = (c - r) <= k
+    out = coo_array((asjnp(v[keep]), (r[keep], c[keep])), shape=shape)
+    return _as_format(out, format)
+
+
+def triu(A, k=0, format=None):
+    """Upper triangle (entries with col - row >= k)."""
+    r, c, v, shape = _coo_parts(A)
+    keep = (c - r) >= k
+    out = coo_array((asjnp(v[keep]), (r[keep], c[keep])), shape=shape)
+    return _as_format(out, format)
+
+
+def bmat(blocks, format=None, dtype=None):
+    """Assemble a sparse matrix from a 2-D grid of blocks (None = zero)."""
+    blocks = [list(row) for row in blocks]
+    R = len(blocks)
+    C = len(blocks[0]) if R else 0
+    row_h = [None] * R
+    col_w = [None] * C
+    for i in range(R):
+        if len(blocks[i]) != C:
+            raise ValueError("blocks must be a rectangular 2-D grid")
+        for j in range(C):
+            b = blocks[i][j]
+            if b is None:
+                continue
+            m, n = b.shape
+            if row_h[i] is None:
+                row_h[i] = m
+            elif row_h[i] != m:
+                raise ValueError(f"block row {i} has incompatible heights")
+            if col_w[j] is None:
+                col_w[j] = n
+            elif col_w[j] != n:
+                raise ValueError(f"block column {j} has incompatible widths")
+    if any(h is None for h in row_h) or any(w is None for w in col_w):
+        raise ValueError("every block row/column needs at least one block")
+    r_off = np.concatenate([[0], np.cumsum(row_h)])
+    c_off = np.concatenate([[0], np.cumsum(col_w)])
+    rows_all, cols_all, vals_all = [], [], []
+    for i in range(R):
+        for j in range(C):
+            b = blocks[i][j]
+            if b is None:
+                continue
+            r, c, v, _ = _coo_parts(b)
+            rows_all.append(r + r_off[i])
+            cols_all.append(c + c_off[j])
+            vals_all.append(v)
+    if vals_all:
+        rows = np.concatenate(rows_all)
+        cols = np.concatenate(cols_all)
+        vals = np.concatenate(vals_all)
+    else:
+        rows = cols = np.zeros(0, dtype=np.int64)
+        vals = np.zeros(0)
+    if dtype is not None:
+        vals = vals.astype(dtype)
+    out = coo_array(
+        (asjnp(vals), (rows, cols)), shape=(int(r_off[-1]), int(c_off[-1]))
+    )
+    return _as_format(out, format)
+
+
+block_array = bmat
+
+
+def vstack(blocks, format=None, dtype=None):
+    return bmat([[b] for b in blocks], format=format, dtype=dtype)
+
+
+def hstack(blocks, format=None, dtype=None):
+    return bmat([list(blocks)], format=format, dtype=dtype)
+
+
+def block_diag(mats, format=None, dtype=None):
+    grid = [
+        [m if i == j else None for j in range(len(mats))]
+        for i, m in enumerate(mats)
+    ]
+    return bmat(grid, format=format, dtype=dtype)
+
+
+def kronsum(A, B, format=None):
+    """kron(I_n, A) + kron(B, I_m) for square A [m, m], B [n, n]."""
+    m, m2 = A.shape
+    n, n2 = B.shape
+    if m != m2 or n != n2:
+        raise ValueError("kronsum needs square operands")
+    out = kron(identity(n, dtype=A.dtype), A) + kron(B, identity(m, dtype=B.dtype))
+    return _as_format(out.tocoo(), format) if format else out
+
+
+def save_npz(file, matrix, compressed=True):
+    """scipy-compatible .npz writer (csr/csc/coo; scipy can load these)."""
+    fmt = matrix.format
+    fields = {"shape": np.asarray(matrix.shape), "format": fmt.encode("ascii")}
+    if fmt in ("csr", "csc"):
+        fields["data"] = np.asarray(matrix.data)
+        fields["indices"] = np.asarray(matrix.indices)
+        fields["indptr"] = np.asarray(matrix.indptr)
+    elif fmt == "coo":
+        fields["data"] = np.asarray(matrix.data)
+        fields["row"] = np.asarray(matrix.row)
+        fields["col"] = np.asarray(matrix.col)
+    else:
+        return save_npz(file, matrix.tocoo(), compressed)
+    (np.savez_compressed if compressed else np.savez)(file, **fields)
+
+
+def load_npz(file):
+    """scipy-compatible .npz reader."""
+    from .csc import csc_array as _csc
+    from .csr import csr_array as _csr
+
+    with np.load(file) as f:
+        fmt = f["format"].item()
+        if isinstance(fmt, bytes):
+            fmt = fmt.decode("ascii")
+        shape = tuple(int(v) for v in f["shape"])
+        if fmt in ("csr", "csc"):
+            cls = _csr if fmt == "csr" else _csc
+            return cls.from_parts(f["data"], f["indices"], f["indptr"], shape)
+        if fmt == "coo":
+            return coo_array((asjnp(f["data"]), (f["row"], f["col"])), shape=shape)
+    raise ValueError(f"unsupported sparse npz format {fmt!r}")
+
+
+def get_index_dtype(arrays=(), maxval=None, check_contents=False):
+    """scipy semantics: int32 only when safe.
+
+    An array whose dtype cannot cast to int32 forces int64 unless
+    ``check_contents`` verifies its values (max AND min) actually fit.
+    """
+    i32 = np.iinfo(np.int32)
+    if maxval is not None and maxval > i32.max:
+        return np.int64
+    for a in arrays:
+        a = np.asarray(a)
+        if np.can_cast(a.dtype, np.int32):
+            continue
+        if check_contents and np.issubdtype(a.dtype, np.integer):
+            if a.size == 0:
+                continue
+            if int(a.min()) >= i32.min and int(a.max()) <= i32.max:
+                continue
+        return np.int64
+    return np.int32
+
+
+# array-API-era aliases
+eye_array = eye
+diags_array = diags
+
+
+def random_array(shape, *, density=0.01, format="coo", dtype=None,
+                 random_state=None, rng=None, data_sampler=None):
+    """scipy>=1.12 random_array surface (shape tuple, keyword-only)."""
+    m, n = shape
+    state = rng if rng is not None else random_state
+    return random(m, n, density, format, dtype, state, data_rvs=data_sampler)

@@ -149,9 +149,14 @@ def spgemm_csr_csr(
         n=n, T=T, U=T, kdt=kdt, dt=dt, m_real=int(m_real),
     )
     nunique = host_int(nunique_dev)
-    ukeys = ukeys_all[:_next_pow2(nunique)]
-    uvals = uvals_all[:_next_pow2(nunique)]
+    P = _next_pow2(nunique)
+    ukeys = ukeys_all[:P]
+    uvals = uvals_all[:P]
     urows = (ukeys // n).astype(kdt)
+    # padded tail entries carry the sentinel key (row m_real, which may be
+    # < m for padded tile shapes): push them past row m so indptr never
+    # counts them — keeps indptr[-1] == len(data) for every caller
+    urows = jnp.where(jnp.arange(P) < nunique, urows, jnp.asarray(m, kdt))
     ucols = (ukeys % n).astype(kdt)
     idt = index_dtype_for(out_shape, nunique)
     indptr = rows_to_indptr(urows, m, dtype=idt)

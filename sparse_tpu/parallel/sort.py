@@ -6,23 +6,27 @@ merge (``sort_template.inl:224-283``, ``sort.cu:163-318``). Powers the
 distributed COO->CSR/CSC conversions (coo.py:233-349) and the quantum
 group sorts.
 
-TPU-native redesign: XLA SPMD has no variable-count alltoallv — every
-collective is static-shape — so the samplesort's data-dependent exchange is
-replaced by an **odd-even transposition block sort**: each shard keeps a
-sorted block of L elements (padded with +inf sentinels); S rounds of
-neighbor ``ppermute`` + local 2L merge-split (left keeps the low half,
-right the high half) yield a globally sorted distribution. All compute is
-on-device ``jnp.sort``/gather; all communication is neighbor ICI traffic;
-every shape is static. For S shards this is S rounds of 2L-element
-exchanges — asymptotically more traffic than samplesort's single alltoallv,
-but collective-count-bounded, deterministic, and compiles to one XLA
-program (no host round-trips at all, vs the reference's per-phase task
-launches).
+Two TPU-native algorithms:
+
+* ``dist_sort`` — **odd-even transposition block sort**: each shard keeps a
+  sorted block of L elements (padded with +inf sentinels); S rounds of
+  neighbor ``ppermute`` + local 2L merge-split (left keeps the low half,
+  right the high half) yield a globally sorted distribution. Fully static
+  shapes, one compiled XLA program, no host round-trips — but S rounds of
+  2L-element neighbor traffic.
+* ``dist_sort_sample`` — the reference's actual **samplesort** shape:
+  local sort -> regular-sample allgather -> splitter selection -> a
+  ``jax.lax.ragged_all_to_all`` bucket exchange (the NCCL alltoallv
+  analog) -> local merge -> one more ragged exchange restoring the exact
+  block-rank layout. Two exchanges total; one tiny [S, S] host count fetch
+  (the reference equally syncs counts to size its alltoallv buffers), with
+  a fallback to the odd-even sort when heavy duplicate keys break the
+  regular-sampling 2L bucket bound.
 """
 
 from __future__ import annotations
 
-from functools import partial
+from functools import lru_cache, partial
 
 import jax
 import jax.numpy as jnp
@@ -107,6 +111,216 @@ def _sentinel(dtype):
     return jnp.inf
 
 
+# ---------------------------------------------------------------------------
+# Samplesort — the reference's actual algorithm shape (sample -> splitters ->
+# alltoallv -> merge), now expressible because jax.lax.ragged_all_to_all is
+# the NCCL alltoallv analog. Two exchanges total (bucket + rebalance) instead
+# of the odd-even sort's S neighbor rounds.
+# ---------------------------------------------------------------------------
+@lru_cache(maxsize=None)
+def _sample_phase1(mesh, axis, S, n_payloads):
+    """Local sort + splitter selection + per-destination send counts."""
+
+    def shard_fn(k_l, *p_l):
+        k = k_l.reshape(-1)
+        L = k.shape[0]
+        order = jnp.argsort(k, stable=True)
+        k = k[order]
+        ps = [p.reshape(-1)[order] for p in p_l]
+        # regular sampling: S evenly spaced samples per shard
+        pos = jnp.array([(j + 1) * L // (S + 1) for j in range(S)])
+        samples = k[jnp.clip(pos, 0, L - 1)]
+        all_samples = jnp.sort(jax.lax.all_gather(samples, axis, tiled=True))
+        splitters = all_samples[jnp.arange(1, S) * S]  # [S-1]
+        bounds = jnp.searchsorted(k, splitters, side="left").astype(jnp.int32)
+        starts = jnp.concatenate([jnp.zeros((1,), jnp.int32), bounds])
+        ends = jnp.concatenate([bounds, jnp.full((1,), L, jnp.int32)])
+        send = ends - starts  # [S] counts to each destination
+        return (k[None], *[p[None] for p in ps], send[None], splitters[None])
+
+    in_specs = tuple(P(axis) for _ in range(1 + n_payloads))
+    out_specs = (
+        *[P(axis, None)] * (1 + n_payloads),
+        P(axis, None),
+        P(axis, None),
+    )
+    return jax.jit(
+        shard_map(
+            shard_fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_vma=False,
+        )
+    )
+
+
+def _ragged_a2a(x, out_buf, in_off, send, out_off, recv, axis, S, pair_cap, native):
+    """ragged_all_to_all, with a dense-slot emulation for backends that
+    don't implement the HLO (XLA:CPU — the virtual test mesh).
+
+    The emulation exchanges a fixed [S, pair_cap] slot matrix (pair_cap
+    bounds any single source->dest chunk; both samplesort exchanges send at
+    most a full L-block to one destination) and compacts received chunks to
+    ``out_off`` with an out-of-bounds-dropping scatter. Only the native
+    path's traffic is the alltoallv shape; the emulation is for
+    correctness-testing the algorithm on the CPU mesh.
+    """
+    if native:
+        # jax.lax.ragged_all_to_all's output_offsets are SENDER-side: entry
+        # i is the offset in peer i's output where MY chunk lands. The
+        # caller passes receiver-side offsets (where peer j's chunk lands in
+        # MY buffer — what the emulation consumes); one all_to_all of the
+        # offset vector is exactly that transpose.
+        out_off_send = jax.lax.all_to_all(out_off[:, None], axis, 0, 0).reshape(-1)
+        return jax.lax.ragged_all_to_all(
+            x, out_buf, in_off, send, out_off_send, recv, axis_name=axis
+        )
+    idx = jnp.arange(pair_cap, dtype=jnp.int32)
+    gathered = x[jnp.clip(in_off[:, None] + idx[None, :], 0, x.shape[0] - 1)]
+    slots = jnp.where(idx[None, :] < send[:, None], gathered, 0)
+    ex = jax.lax.all_to_all(slots, axis, 0, 0)  # row j = chunk from source j
+    pos = jnp.where(
+        idx[None, :] < recv[:, None],
+        out_off[:, None] + idx[None, :],
+        out_buf.shape[0],  # out of bounds -> dropped
+    )
+    return out_buf.at[pos.reshape(-1)].set(ex.reshape(-1), mode="drop")
+
+
+@lru_cache(maxsize=None)
+def _sample_phase2(mesh, axis, S, L, cap, n_payloads, key_dtype, p_dtypes, native):
+    """Bucket exchange -> local merge -> exact-rank rebalance exchange."""
+    sent = _sentinel(jnp.dtype(key_dtype))
+
+    def shard_fn(k_l, *rest):
+        p_l = rest[:n_payloads]
+        splitters = rest[n_payloads].reshape(-1)
+        k = k_l.reshape(-1)
+        ps = [p.reshape(-1) for p in p_l]
+        bounds = jnp.searchsorted(k, splitters, side="left").astype(jnp.int32)
+        starts = jnp.concatenate([jnp.zeros((1,), jnp.int32), bounds])
+        ends = jnp.concatenate([bounds, jnp.full((1,), L, jnp.int32)])
+        send = ends - starts
+        recv = jax.lax.all_to_all(send[:, None], axis, 0, 0).reshape(-1)
+        out_off = jnp.concatenate(
+            [jnp.zeros((1,), jnp.int32), jnp.cumsum(recv)[:-1].astype(jnp.int32)]
+        )
+        buf = jnp.full((cap,), sent, dtype=k.dtype)
+        k2 = _ragged_a2a(
+            k, buf, starts, send, out_off, recv, axis, S, L, native
+        )
+        ps2 = [
+            _ragged_a2a(
+                p, jnp.zeros((cap,), dtype=p.dtype), starts, send, out_off,
+                recv, axis, S, L, native,
+            )
+            for p in ps
+        ]
+        # merge: one stable sort applies the same permutation to keys and
+        # payloads, so duplicate keys keep their own payloads
+        order = jnp.argsort(k2, stable=True)
+        k2 = k2[order]
+        ps2 = [p[order] for p in ps2]
+        # rebalance to exact global ranks [s*L, (s+1)*L)
+        nvalid = jnp.sum(recv).astype(jnp.int32)
+        counts_all = jax.lax.all_gather(nvalid, axis)  # [S]
+        me = jax.lax.axis_index(axis)
+        gstart = jnp.sum(jnp.where(jnp.arange(S) < me, counts_all, 0))
+        slot = jnp.arange(cap, dtype=jnp.int32)
+        dest = jnp.where(
+            slot < nvalid,
+            jnp.clip((gstart + slot) // L, 0, S - 1).astype(jnp.int32),
+            jnp.int32(S),
+        )
+        bnds2 = jnp.searchsorted(dest, jnp.arange(S + 1), side="left").astype(
+            jnp.int32
+        )
+        starts2 = bnds2[:-1]
+        send2 = bnds2[1:] - bnds2[:-1]
+        recv2 = jax.lax.all_to_all(send2[:, None], axis, 0, 0).reshape(-1)
+        off2 = jnp.concatenate(
+            [jnp.zeros((1,), jnp.int32), jnp.cumsum(recv2)[:-1].astype(jnp.int32)]
+        )
+        k3 = _ragged_a2a(
+            k2, jnp.full((L,), sent, dtype=k.dtype), starts2, send2, off2,
+            recv2, axis, S, L, native,
+        )
+        ps3 = [
+            _ragged_a2a(
+                p, jnp.zeros((L,), dtype=p.dtype), starts2, send2, off2,
+                recv2, axis, S, L, native,
+            )
+            for p in ps2
+        ]
+        # chunks arrive ordered by source rank and sources hold ascending
+        # rank ranges, so the concatenation is already globally sorted
+        return (k3[None], *[p[None] for p in ps3])
+
+    in_specs = (
+        *[P(axis)] * (1 + n_payloads),  # flat [S*L] sharded vectors
+        P(axis, None),  # splitters [S, S-1] (identical rows)
+    )
+    out_specs = tuple(P(axis, None) for _ in range(1 + n_payloads))
+    return jax.jit(
+        shard_map(
+            shard_fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_vma=False,
+        )
+    )
+
+
+def dist_sort_sample(keys, payloads=(), mesh: Mesh | None = None, axis: str = "shards"):
+    """Samplesort across the mesh — same contract as :func:`dist_sort`.
+
+    Reference analog: the full samplesort pipeline of ``src/sparse/sort``
+    (local sort -> sample allgather -> splitter selection -> alltoallv ->
+    merge), with ``jax.lax.ragged_all_to_all`` playing alltoallv and one
+    extra ragged exchange restoring the exact [s*L, (s+1)*L) rank layout.
+
+    Regular sampling bounds every destination bucket by 2L ONLY for
+    mostly-unique keys; the per-destination totals are checked on the host
+    (a tiny [S, S] fetch — the reference equally syncs counts to size its
+    alltoallv buffers) and pathological duplicate distributions fall back
+    to the odd-even transposition sort.
+    """
+    if mesh is None:
+        mesh = get_mesh()
+    S = int(mesh.devices.size)
+    payloads = tuple(payloads)
+    if S == 1:
+        return dist_sort(keys, payloads, mesh=mesh, axis=axis)
+    L = keys.shape[0] // S
+    cap = 2 * L
+
+    phase1 = _sample_phase1(mesh, axis, S, len(payloads))
+    out = phase1(keys, *payloads)
+    k_sorted = out[0].reshape(-1)
+    ps_sorted = [o.reshape(-1) for o in out[1 : 1 + len(payloads)]]
+    send_matrix = np.asarray(out[1 + len(payloads)])  # [S, S]
+    splitters = out[2 + len(payloads)]  # [S, S-1] (identical rows)
+
+    if int(send_matrix.sum(axis=0).max()) > cap:
+        # heavy duplicates around a splitter: capacity bound violated
+        return dist_sort(k_sorted, tuple(ps_sorted), mesh=mesh, axis=axis)
+
+    native = jax.default_backend() == "tpu"
+    phase2 = _sample_phase2(
+        mesh, axis, S, L, cap, len(payloads), keys.dtype,
+        tuple(p.dtype for p in payloads), native,
+    )
+    try:
+        out2 = phase2(k_sorted, *ps_sorted, splitters)
+    except Exception:  # pragma: no cover - backend-dependent collective
+        # e.g. a backend without (working) ragged-all-to-all support:
+        # correctness over speed — finish with the odd-even sort
+        from ..utils import user_warning
+
+        user_warning(
+            "samplesort exchange unavailable on this backend; falling back "
+            "to the odd-even transposition sort"
+        )
+        return dist_sort(k_sorted, tuple(ps_sorted), mesh=mesh, axis=axis)
+    return out2[0].reshape(-1), tuple(o.reshape(-1) for o in out2[1:])
+
+
 def dist_sort_host(keys, payloads=(), num_shards: int | None = None):
     """Convenience wrapper: host arrays in, globally sorted host arrays out.
 
@@ -137,7 +351,9 @@ def dist_sort_host(keys, payloads=(), num_shards: int | None = None):
         pp = np.zeros(total, dtype=p.dtype)
         pp[:nvalid] = p
         pds.append(jax.device_put(pp, sharding))
-    sk, sp = dist_sort(kd, tuple(pds), mesh=mesh)
+    # samplesort (2 ragged exchanges); falls back to the odd-even
+    # transposition sort internally when duplicates break its bucket bound
+    sk, sp = dist_sort_sample(kd, tuple(pds), mesh=mesh)
     sk = np.asarray(sk)[:nvalid]
     return sk, tuple(np.asarray(p)[:nvalid] for p in sp)
 
