@@ -176,6 +176,24 @@ def kernel_sweep(n: int, platform: str) -> dict:
     return out
 
 
+SPMV_BASELINE_ITERS_PER_S = 347.7  # reference: 10M rows, 11-diag banded, f64, 1x V100
+
+
+def run_spmv_11diag(rows: int = 10_000_000):
+    """The reference's CSR SpMV microbenchmark shape (BASELINE.md row 1):
+    banded 11 nnz/row at 10M rows — here in the DIA layout on the Pallas
+    windowed kernel. Returns iterations/second."""
+    import jax.numpy as jnp
+
+    from sparse_tpu.kernels.dia_spmv import dia_spmv_pallas
+
+    offsets = tuple(range(-5, 6))
+    planes = jnp.ones((11, rows), dtype=jnp.float32)
+    x = jnp.ones((rows,), dtype=jnp.float32)
+    step = lambda xx: dia_spmv_pallas(planes, offsets, xx, (rows, rows))
+    return 1.0 / _time_kernel(step, x)
+
+
 def run_fused(n: int, iters: int):
     """Fused two-pass CG iterations/second (kernels/cg_dia.py)."""
     import jax
@@ -238,6 +256,14 @@ def worker(platform_arg: str) -> None:
         except Exception:
             traceback.print_exc(file=sys.stderr)
         if platform == "tpu":
+            try:  # the reference's SpMV microbenchmark row (347.7 iters/s)
+                v = run_spmv_11diag()
+                rec["spmv_11diag_iters_per_s"] = round(v, 1)
+                rec["spmv_11diag_vs_baseline"] = round(
+                    v / SPMV_BASELINE_ITERS_PER_S, 2
+                )
+            except Exception:
+                traceback.print_exc(file=sys.stderr)
             # fused two-pass CG (kernels/cg_dia.py): attempted LAST so a
             # kernel fault cannot lose the headline measurement above
             try:

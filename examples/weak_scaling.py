@@ -1,0 +1,76 @@
+"""Weak-scaling harness: constant per-chip problem size over a growing mesh.
+
+Reference analog: the Summit sweep scripts (``scripts/summit/run_legate_pde.sh``
+— grid side scales as n*sqrt(g)) behind every BASELINE.md scaling row. On a
+real TPU pod this measures ICI-scaling of the distributed CG (halo ppermute +
+GSPMD psums); on the virtual CPU mesh it validates the harness itself.
+
+Run:  python examples/weak_scaling.py -n 512 -shards 1,2,4,8 -iters 100
+"""
+
+import argparse
+import json
+import math
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("-n", type=int, default=512, help="grid side per chip")
+    parser.add_argument("-shards", default="1,2,4,8")
+    parser.add_argument("-iters", type=int, default=100)
+    args, _ = parser.parse_known_args()
+
+    import jax
+
+    if os.environ.get("JAX_PLATFORMS", "") in ("", "cpu"):
+        # the axon TPU-tunnel plugin overrides the env var; pin the knob
+        jax.config.update("jax_platforms", os.environ.get("JAX_PLATFORMS") or None)
+
+    import numpy as np
+
+    from sparse_tpu.models.poisson import laplacian_2d_csr_host
+    from sparse_tpu.parallel.dist import make_dist_cg, shard_csr
+    from sparse_tpu.parallel.mesh import get_mesh
+
+    shards = [int(s) for s in args.shards.split(",")]
+    results = []
+    base_rate = None
+    for S in shards:
+        side = int(round(args.n * math.sqrt(S)))
+        A = laplacian_2d_csr_host(side, dtype=np.float32)
+        mesh = get_mesh(S)
+        D = shard_csr(A, mesh=mesh, balanced=True)
+        b = np.random.default_rng(0).standard_normal(A.shape[0]).astype(np.float32)
+        bp = D.pad_out_vector(b)
+        run = make_dist_cg(D, tol=0.0, maxiter=args.iters, conv_test_iters=args.iters)
+        import jax.numpy as jnp
+
+        xp, iters, _ = run(bp, jnp.zeros_like(bp))
+        int(iters)  # compile + warm
+        best = 0.0
+        for _ in range(3):
+            t0 = time.perf_counter()
+            xp, iters, _ = run(bp, jnp.zeros_like(bp))
+            int(iters)
+            best = max(best, args.iters / (time.perf_counter() - t0))
+        if base_rate is None:
+            base_rate = best
+        eff = best / base_rate
+        results.append(
+            {"shards": S, "rows": A.shape[0], "iters_per_s": round(best, 2),
+             "efficiency": round(eff, 3)}
+        )
+        print(
+            f"S={S:3d}  rows={A.shape[0]:>10,}  {best:8.2f} iters/s  "
+            f"efficiency {eff:6.1%}"
+        )
+    print(json.dumps({"weak_scaling": results}))
+
+
+if __name__ == "__main__":
+    main()

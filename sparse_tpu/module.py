@@ -211,7 +211,9 @@ class SparseEfficiencyWarning(SparseWarning):
 
 def find(A):
     """(rows, cols, values) of the nonzero entries (scipy.sparse.find)."""
-    c = A.tocoo() if issparse(A) else coo_array(np.asarray(A))
+    # round-trip through CSR first: scipy sums duplicate COO entries before
+    # selecting nonzeros (cancelling duplicates must not appear)
+    c = (A if issparse(A) else coo_array(np.asarray(A))).tocsr().tocoo()
     vals = np.asarray(c.data)
     rows = np.asarray(c.row)
     cols = np.asarray(c.col)
@@ -389,4 +391,7 @@ def random_array(shape, *, density=0.01, format="coo", dtype=None,
     """scipy>=1.12 random_array surface (shape tuple, keyword-only)."""
     m, n = shape
     state = rng if rng is not None else random_state
-    return random(m, n, density, format, dtype, state, data_rvs=data_sampler)
+    # scipy calls data_sampler with the size KEYWORD; random() passes its
+    # sampler a positional count
+    rvs = None if data_sampler is None else (lambda k: data_sampler(size=k))
+    return random(m, n, density, format, dtype, state, data_rvs=rvs)

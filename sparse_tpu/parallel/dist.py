@@ -230,6 +230,53 @@ class DistCSR:
     def matvec(self, x, out=None):
         return self.dot(x)
 
+    def as_operator(self, with_rmatvec: bool = False, source=None):
+        """A LinearOperator over PADDED mesh-sharded vectors.
+
+        This is how the generic Krylov solvers (``linalg.cg``, ``bicgstab``,
+        ``gmres``, ...) run distributed WITHOUT dedicated mesh variants: the
+        operator maps [n_pad] -> [m_pad] sharded arrays, the solver's whole
+        ``lax.while_loop`` traces over them, and GSPMD turns every vdot/norm
+        into a ``psum`` automatically — the reference gets the same effect
+        from Legion's implicit partitioning of its task launches. Square
+        matrices only (solver iterates live in one coordinate space).
+
+        ``with_rmatvec`` additionally shards the TRANSPOSE layout (from
+        ``source``, the host ``csr_array`` this layout was built from) on
+        the swapped splits, so adjoint-needing solvers (``bicg``, ``lsqr``)
+        run on the mesh too.
+        """
+        from ..linalg import LinearOperator
+
+        if self.shape[0] != self.shape[1]:
+            raise ValueError("as_operator() needs a square matrix")
+
+        rmatvec = None
+        if with_rmatvec:
+            if source is None:
+                raise ValueError(
+                    "with_rmatvec needs the source csr_array to build the "
+                    "transpose layout"
+                )
+            Dt = shard_csr(
+                source.T.tocsr(),
+                mesh=self.mesh,
+                axis=self.axis,
+                row_splits=self.col_splits,
+                col_splits=self.row_splits,
+            )
+            if np.issubdtype(self.dtype, np.complexfloating):
+                rmatvec = lambda x: jnp.conj(Dt.spmv_padded(jnp.conj(x)))
+            else:
+                rmatvec = Dt.spmv_padded
+
+        return LinearOperator(
+            (self.m_pad, self.n_pad),
+            matvec=self.spmv_padded,
+            rmatvec=rmatvec,
+            dtype=self.dtype,
+        )
+
 
 def _build_spmv(A: DistCSR, matrix: bool = False):
     """Compile the shard_map SpMV/SpMM for this matrix's layout/mode.

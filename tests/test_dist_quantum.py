@@ -1,0 +1,47 @@
+"""Distributed quantum evolution: solve_ivp over a mesh-sharded Hamiltonian.
+
+The BASELINE.md quantum workload at scale: the Hamiltonian is a DistCSR
+(complex), the state vector a padded mesh-sharded array, and the RK step's
+norms/dots become GSPMD psums — so the same solve_ivp drives single-chip
+and mesh runs.
+"""
+
+import networkx as nx
+import numpy as np
+import pytest
+
+import sparse_tpu.integrate as integrate
+from sparse_tpu import quantum
+from sparse_tpu.parallel.dist import shard_csr
+from sparse_tpu.parallel.mesh import get_mesh
+
+
+@pytest.mark.parametrize("num_shards", [2, 8])
+def test_quantum_evolution_distributed_matches_single(num_shards):
+    g = nx.cycle_graph(7)
+    driver = quantum.HamiltonianDriver(graph=g, dtype=np.complex128)
+    H = driver.hamiltonian
+    n = H.shape[0]
+    y0 = np.zeros(n, dtype=np.complex128)
+    y0[0] = 1.0
+
+    def rhs_single(t, y):
+        return -1j * (H @ y)
+
+    sol = integrate.solve_ivp(rhs_single, (0.0, 0.5), y0, method="RK45",
+                              rtol=1e-8, atol=1e-10)
+    y_ref = np.asarray(sol.y[:, -1])
+
+    mesh = get_mesh(num_shards)
+    D = shard_csr(H, mesh=mesh, balanced=True)
+    y0p = D.pad_vector(y0)
+
+    def rhs_dist(t, yp):
+        return -1j * D.spmv_padded(yp)
+
+    sol_d = integrate.solve_ivp(rhs_dist, (0.0, 0.5), y0p, method="RK45",
+                                rtol=1e-8, atol=1e-10)
+    y_dist = D.unpad_vector(np.asarray(sol_d.y[:, -1]))
+    assert np.allclose(y_dist, y_ref, atol=1e-6)
+    # unitary evolution: norm preserved
+    assert abs(np.linalg.norm(y_dist) - 1.0) < 1e-6

@@ -101,3 +101,22 @@ def test_coverage_report_shrinks():
     for name in ["bmat", "vstack", "hstack", "tril", "triu", "find",
                  "kronsum", "save_npz", "load_npz", "block_diag", "sparray"]:
         assert name in rep["implemented"], name
+
+
+def test_find_coalesces_duplicates():
+    """Cancelling duplicate COO entries must not appear (r2 review)."""
+    a = sparse.coo_array(
+        (np.array([1.0, -1.0]), (np.array([0, 0]), np.array([1, 1]))),
+        shape=(2, 2),
+    )
+    r, c, v = sparse.find(a)
+    assert r.size == 0 and c.size == 0 and v.size == 0
+
+
+def test_random_array_keyword_sampler():
+    """scipy-1.12-style samplers take size as a KEYWORD (r2 review)."""
+    sampler = lambda *, size: np.ones(size)
+    a = sparse.random_array((6, 6), density=0.5, rng=1, data_sampler=sampler)
+    dense = np.asarray(a.todense())
+    assert set(np.unique(dense)) <= {0.0, 1.0}
+    assert np.count_nonzero(dense) == 18
