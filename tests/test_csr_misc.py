@@ -1,0 +1,40 @@
+"""CSR misc surface: balance, transpose, diagonal.
+
+Reference analog: ``tests/integration/test_csr_misc.py``.
+"""
+
+import numpy as np
+import pytest
+import scipy.io as sci_io
+
+import sparse_tpu as sparse
+from .utils.common import test_mtx_files
+
+
+@pytest.mark.parametrize("filename", test_mtx_files)
+def test_balance_row_partitions(filename):
+    arr = sparse.io.mmread(filename).tocsr()
+    arr.balance()
+    s = sci_io.mmread(filename).tocsr()
+    vec = np.random.default_rng(3).random(arr.shape[1])
+    assert np.allclose(np.asarray(arr @ vec), s @ vec)
+    mat = np.random.default_rng(4).random((arr.shape[1], 2))
+    assert np.allclose(np.asarray(arr @ mat), s @ mat)
+
+
+@pytest.mark.parametrize("filename", test_mtx_files)
+def test_csr_transpose(filename):
+    arr = sparse.io.mmread(filename).tocsr().T
+    s = sci_io.mmread(filename).tocsr().T
+    assert np.allclose(np.asarray(arr.todense()), np.asarray(s.todense()))
+    # transpose of the transpose round-trips
+    assert np.allclose(
+        np.asarray(arr.T.todense()), np.asarray(s.T.todense())
+    )
+
+
+@pytest.mark.parametrize("filename", test_mtx_files)
+def test_csr_diagonal_default(filename):
+    arr = sparse.io.mmread(filename).tocsr()
+    s = sci_io.mmread(filename).tocsr()
+    assert np.allclose(np.asarray(arr.diagonal()), s.diagonal())
