@@ -53,22 +53,18 @@ def _plan(m: int, offsets: tuple, tile: int = 16384):
     return TM, B, G
 
 
-def _row_planes(data, offsets: tuple, m_pad: int, B: int):
-    """Column-indexed scipy DIA planes -> row-indexed [Dp, m_pad] planes."""
-    D = len(offsets)
-    Dp = _round_up(D, 8)
-    buf = jnp.zeros((D, m_pad + 2 * B), dtype=data.dtype)
-    buf = jax.lax.dynamic_update_slice(buf, data, (0, B))
-    rows = [
-        jax.lax.dynamic_slice(buf[k], (B + int(o),), (m_pad,))
-        for k, o in enumerate(offsets)
-    ]
-    out = jnp.stack(rows)
-    if Dp > D:
-        out = jnp.concatenate(
-            [out, jnp.zeros((Dp - D, m_pad), dtype=data.dtype)]
-        )
-    return out
+def _row_planes(data, offsets: tuple, TM: int, B: int, G: int, m: int):
+    """Column-indexed scipy DIA planes -> flat row-indexed [D * m_pad].
+
+    Flat 1-D packing (not [Dp, m_pad]) so kernel A fetches exactly D
+    aligned [TM] plane slices per tile by manual DMA — no ceil8(D) zero
+    planes and no halo on the plane stream. Delegates to
+    :func:`..dia_spmv.dia_pack` (single source for the packing identity).
+    ``m`` is the true row count — the junk-row mask bound — which may be
+    smaller than the plane width (scipy accepts over-wide DIA data)."""
+    from .dia_spmv import DiaPlan, dia_pack
+
+    return dia_pack(data, DiaPlan(offsets, m, data.shape[1], TM, B, G))
 
 
 def _pad_vec(v, TM: int, G: int):
@@ -83,11 +79,14 @@ def _unpad_vec(vp, m: int, TM: int):
     return jax.lax.dynamic_slice(vp, (TM,), (m,))
 
 
-def _kernel_a(offsets: tuple, TM: int, B: int, win: int, D: int):
-    """p_new (windowed), q, and the <p, q> partial."""
+def _kernel_a(offsets: tuple, TM: int, B: int, win: int, D: int, m_pad: int):
+    """p_new (windowed), q, and the <p, q> partial.
 
-    def kernel(beta_ref, r_hbm, p_hbm, planes_ref, pnew_ref, q_ref, pq_ref,
-               rwinA, rwinB, pwinA, pwinB, semA, semB):
+    r/p windows AND the D flat row-indexed plane slices are all manual
+    double-buffered DMAs (sem slots: 0=r, 1=p, 2..2+D-1=planes)."""
+
+    def kernel(beta_ref, r_hbm, p_hbm, planes_hbm, pnew_ref, q_ref, pq_ref,
+               rwinA, rwinB, pwinA, pwinB, dwinA, dwinB, semA, semB):
         gg = pl.program_id(0)
         Gp2 = pl.num_programs(0)
 
@@ -95,42 +94,47 @@ def _kernel_a(offsets: tuple, TM: int, B: int, win: int, D: int):
         def _():
             pq_ref[0, 0] = jnp.zeros((), pq_ref.dtype)
 
-        def issue(rwin, pwin, sem, g2):
+        def copies(rwin, pwin, dwin, sem, g2):
             start = g2 * TM - B
-            pltpu.make_async_copy(
+            yield pltpu.make_async_copy(
                 r_hbm.at[pl.ds(start, win)], rwin, sem.at[0]
-            ).start()
-            pltpu.make_async_copy(
+            )
+            yield pltpu.make_async_copy(
                 p_hbm.at[pl.ds(start, win)], pwin, sem.at[1]
-            ).start()
+            )
+            for k in range(D):
+                yield pltpu.make_async_copy(
+                    planes_hbm.at[pl.ds(k * m_pad + (g2 - 1) * TM, TM)],
+                    dwin.at[k],
+                    sem.at[2 + k],
+                )
 
-        def wait(rwin, pwin, sem, g2):
-            start = g2 * TM - B
-            pltpu.make_async_copy(
-                r_hbm.at[pl.ds(start, win)], rwin, sem.at[0]
-            ).wait()
-            pltpu.make_async_copy(
-                p_hbm.at[pl.ds(start, win)], pwin, sem.at[1]
-            ).wait()
+        def issue(rwin, pwin, dwin, sem, g2):
+            for c in copies(rwin, pwin, dwin, sem, g2):
+                c.start()
 
-        def interior(rwin, pwin, sem, rwin_n, pwin_n, sem_n):
+        def wait(rwin, pwin, dwin, sem, g2):
+            for c in copies(rwin, pwin, dwin, sem, g2):
+                c.wait()
+
+        def interior(rwin, pwin, dwin, sem, rwin_n, pwin_n, dwin_n, sem_n):
             # windows address padded coords [gg*TM - B, (gg+1)*TM + B);
             # the first interior tile (gg == 1) starts at TM - B >= 0
             @pl.when(gg == 1)
             def _():
-                issue(rwin, pwin, sem, gg)
+                issue(rwin, pwin, dwin, sem, gg)
 
             @pl.when(gg + 1 < Gp2 - 1)
             def _():
-                issue(rwin_n, pwin_n, sem_n, gg + 1)
+                issue(rwin_n, pwin_n, dwin_n, sem_n, gg + 1)
 
-            wait(rwin, pwin, sem, gg)
+            wait(rwin, pwin, dwin, sem, gg)
             beta = beta_ref[0, 0]
             pw = rwin[:] + beta * pwin[:]
             acc = jnp.zeros((TM,), dtype=q_ref.dtype)
             for k, o in enumerate(offsets):
                 lo = B + int(o)
-                acc = acc + planes_ref[k, :] * pw[lo : lo + TM]
+                acc = acc + dwin[k, :] * pw[lo : lo + TM]
             mid = pw[B : B + TM]
             pnew_ref[:] = mid
             q_ref[:] = acc
@@ -144,11 +148,11 @@ def _kernel_a(offsets: tuple, TM: int, B: int, win: int, D: int):
 
         @pl.when(~is_halo & (gg % 2 == 1))
         def _():
-            interior(rwinA, pwinA, semA, rwinB, pwinB, semB)
+            interior(rwinA, pwinA, dwinA, semA, rwinB, pwinB, dwinB, semB)
 
         @pl.when(~is_halo & (gg % 2 == 0))
         def _():
-            interior(rwinB, pwinB, semB, rwinA, pwinA, semA)
+            interior(rwinB, pwinB, dwinB, semB, rwinA, pwinA, dwinA, semA)
 
         @pl.when(is_halo)
         def _():
@@ -178,10 +182,11 @@ def _kernel_b():
 
 @partial(
     jax.jit,
-    static_argnames=("offsets", "m", "iters", "interpret"),
+    static_argnames=("offsets", "m", "iters", "tile", "interpret"),
 )
 def cg_dia_fused(
-    data, offsets: tuple, b, x0, m: int, iters: int = 300, interpret: bool = False
+    data, offsets: tuple, b, x0, m: int, iters: int = 300, tile: int = 16384,
+    interpret: bool = False
 ):
     """``iters`` fixed CG iterations on the DIA matrix (throughput mode).
 
@@ -191,14 +196,14 @@ def cg_dia_fused(
     ``x0=None`` starts from zero and skips the setup SpMV (r0 = b).
     """
     dt = jnp.result_type(data.dtype, b.dtype)
-    TM, B, G = _plan(m, offsets)
+    TM, B, G = _plan(m, offsets, tile=tile)
     win = TM + 2 * B
     m_pad = G * TM
     L = (G + 2) * TM
     D = len(offsets)
     Dp = _round_up(D, 8)
 
-    planes_row = _row_planes(data.astype(dt), offsets, m_pad, B)
+    planes_row = _row_planes(data.astype(dt), offsets, TM, B, G, m)
     bp = _pad_vec(b.astype(dt), TM, G)
     xp = (
         jnp.zeros(((G + 2) * TM,), dt)
@@ -207,21 +212,13 @@ def cg_dia_fused(
     )
 
     kA = pl.pallas_call(
-        _kernel_a(offsets, TM, B, win, D),
+        _kernel_a(offsets, TM, B, win, D, m_pad),
         grid=(G + 2,),
         in_specs=[
             pl.BlockSpec(memory_space=pltpu.SMEM),
             pl.BlockSpec(memory_space=pl.ANY),
             pl.BlockSpec(memory_space=pl.ANY),
-            # clamp both ends: gg runs over [0, G+2) but plane blocks only
-            # exist for the G interior tiles — an unclamped gg-1 at the
-            # last halo step reads one block past the array (an OOB HBM
-            # fetch that faults the TPU worker on large arrays)
-            pl.BlockSpec(
-                (Dp, TM),
-                lambda gg: (0, jnp.clip(gg - 1, 0, G - 1)),
-                memory_space=pltpu.VMEM,
-            ),
+            pl.BlockSpec(memory_space=pl.ANY),
         ],
         out_specs=[
             pl.BlockSpec((TM,), lambda gg: (gg,), memory_space=pltpu.VMEM),
@@ -238,8 +235,10 @@ def cg_dia_fused(
             pltpu.VMEM((win,), dt),
             pltpu.VMEM((win,), dt),
             pltpu.VMEM((win,), dt),
-            pltpu.SemaphoreType.DMA((2,)),
-            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.VMEM((Dp, TM), dt),
+            pltpu.VMEM((Dp, TM), dt),
+            pltpu.SemaphoreType.DMA((2 + D,)),
+            pltpu.SemaphoreType.DMA((2 + D,)),
         ],
         interpret=interpret,
     )

@@ -29,6 +29,185 @@ def _round_up(v: int, m: int) -> int:
     return (v + m - 1) // m * m
 
 
+# ---------------------------------------------------------------------------
+# Prepared-layout variant: row-indexed planes, packed once, reused per SpMV.
+#
+# The original kernel below re-pads the scipy-layout planes on every call —
+# an extra read+write of the whole matrix per SpMV — and DMAs Dp = ceil8(D)
+# column-indexed planes with a 2B halo each. Preparing a row-indexed flat
+# plane array once removes both: plane k's coefficient for row i is
+# pr[k, i] = data[k, i + o_k], so each grid step needs exactly [D, TM]
+# plane elements (no halo, no pad planes) fetched as D aligned 1-D DMAs
+# from the flattened [D * m_pad] buffer. Only the x window keeps the 2B
+# halo. Per-element traffic drops from ~Dp(TM+2B)/D·TM to 1 plane load +
+# ~1 x load + 1 y store — the true bandwidth floor for DIA SpMV.
+# ---------------------------------------------------------------------------
+
+
+class DiaPlan:
+    """Static geometry of a prepared DIA operator (hashable => jit-static)."""
+
+    __slots__ = ("offsets", "m", "n", "TM", "B", "G", "D")
+
+    def __init__(self, offsets, m, n, TM, B, G):
+        self.offsets = tuple(int(o) for o in offsets)
+        self.m, self.n, self.TM, self.B, self.G = m, n, TM, B, G
+        self.D = len(self.offsets)
+
+    def _key(self):
+        return (self.offsets, self.m, self.n, self.TM, self.B, self.G)
+
+    def __hash__(self):
+        return hash(self._key())
+
+    def __eq__(self, other):
+        return isinstance(other, DiaPlan) and self._key() == other._key()
+
+
+def dia_plan(offsets, shape, tile: int = 65536) -> DiaPlan:
+    m, n = shape
+    B = _round_up(max(max((abs(int(o)) for o in offsets), default=0), 1), 512)
+    TM = min(_round_up(tile, 1024), _round_up(max(m, 1024), 1024))
+    G = (m + TM - 1) // TM
+    return DiaPlan(offsets, m, n, TM, B, G)
+
+
+@partial(jax.jit, static_argnames=("plan",))
+def dia_pack(data, plan: DiaPlan):
+    """scipy-layout [D, n] planes -> flat row-indexed [D * m_pad] buffer.
+
+    Columns beyond m_pad + B - 1 can never be touched (row i reads column
+    i + o <= m_pad - 1 + B), so wide matrices are truncated to that bound —
+    without it, dynamic_update_slice would CLAMP the start when the operand
+    overruns the buffer and silently shift every coefficient.
+    """
+    m_pad = plan.G * plan.TM
+    B = plan.B
+    ncap = min(plan.n, m_pad + B)
+    buf = jnp.zeros((plan.D, m_pad + 2 * B), dtype=data.dtype)
+    buf = jax.lax.dynamic_update_slice(buf, data[:, :ncap], (0, B))
+    # Row mask: scipy ignores DIA slots whose row j - o falls outside the
+    # matrix, but the arrays may hold junk there. Those slots land in
+    # pr rows i >= m; zeroing them keeps padded rows exactly zero — vital
+    # for cg_dia_fused, where nonzero padded q would leak into r and rho.
+    valid = jnp.arange(m_pad) < plan.m
+    rows = [
+        jnp.where(valid, jax.lax.dynamic_slice(buf[k], (B + o,), (m_pad,)), 0)
+        for k, o in enumerate(plan.offsets)
+    ]
+    return jnp.concatenate(rows)  # [D * m_pad]
+
+
+@partial(jax.jit, static_argnames=("plan",))
+def dia_pad_x(x, plan: DiaPlan):
+    """[n] -> [m_pad + 2B] with x at offset B (zeros elsewhere).
+
+    Same wide-matrix truncation as :func:`dia_pack`: entries past
+    m_pad + B - 1 are unreachable by any in-band diagonal.
+    """
+    m_pad = plan.G * plan.TM
+    ncap = min(x.shape[0], m_pad + plan.B)
+    out = jnp.zeros((m_pad + 2 * plan.B,), dtype=x.dtype)
+    return jax.lax.dynamic_update_slice(out, x[:ncap], (plan.B,))
+
+
+@partial(jax.jit, static_argnames=("plan", "interpret"))
+def dia_spmv_packed(planes_flat, x_padded, plan: DiaPlan, interpret: bool = False):
+    """y = A @ x from the prepared layout; returns the [m_pad] padded y.
+
+    ``planes_flat`` from :func:`dia_pack`, ``x_padded`` from
+    :func:`dia_pad_x` — keep both resident across calls (solvers keep their
+    vectors in padded coordinates and never repack).
+    """
+    TM, B, G, D = plan.TM, plan.B, plan.G, plan.D
+    win = TM + 2 * B
+    m_pad = G * TM
+    out_dt = jnp.result_type(planes_flat.dtype, x_padded.dtype)
+
+    def kernel(planes_hbm, x_hbm, y_ref, dwinA, dwinB, xwinA, xwinB, semA, semB):
+        g = pl.program_id(0)
+        G_ = pl.num_programs(0)
+
+        def issue(dwin, xwin, sem, gg):
+            for k in range(D):
+                pltpu.make_async_copy(
+                    planes_hbm.at[pl.ds(k * m_pad + gg * TM, TM)],
+                    dwin.at[k],
+                    sem.at[k],
+                ).start()
+            pltpu.make_async_copy(
+                x_hbm.at[pl.ds(gg * TM, win)], xwin, sem.at[D]
+            ).start()
+
+        def wait(dwin, xwin, sem, gg):
+            for k in range(D):
+                pltpu.make_async_copy(
+                    planes_hbm.at[pl.ds(k * m_pad + gg * TM, TM)],
+                    dwin.at[k],
+                    sem.at[k],
+                ).wait()
+            pltpu.make_async_copy(
+                x_hbm.at[pl.ds(gg * TM, win)], xwin, sem.at[D]
+            ).wait()
+
+        def step(dwin, xwin, sem, dwin_n, xwin_n, sem_n):
+            @pl.when(g == 0)
+            def _():
+                issue(dwin, xwin, sem, g)
+
+            @pl.when(g + 1 < G_)
+            def _():
+                issue(dwin_n, xwin_n, sem_n, g + 1)
+
+            wait(dwin, xwin, sem, g)
+            acc = jnp.zeros((TM,), dtype=y_ref.dtype)
+            for k, o in enumerate(plan.offsets):
+                lo = B + o
+                acc = acc + dwin[k, :] * xwin[lo : lo + TM]
+            y_ref[:] = acc
+
+        @pl.when(g % 2 == 0)
+        def _():
+            step(dwinA, xwinA, semA, dwinB, xwinB, semB)
+
+        @pl.when(g % 2 == 1)
+        def _():
+            step(dwinB, xwinB, semB, dwinA, xwinA, semA)
+
+    Dp = _round_up(D, 8)
+    return pl.pallas_call(
+        kernel,
+        grid=(G,),
+        in_specs=[
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+        ],
+        out_specs=pl.BlockSpec((TM,), lambda g: (g,), memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((m_pad,), out_dt),
+        scratch_shapes=[
+            pltpu.VMEM((Dp, TM), planes_flat.dtype),
+            pltpu.VMEM((Dp, TM), planes_flat.dtype),
+            pltpu.VMEM((win,), x_padded.dtype),
+            pltpu.VMEM((win,), x_padded.dtype),
+            pltpu.SemaphoreType.DMA((D + 1,)),
+            pltpu.SemaphoreType.DMA((D + 1,)),
+        ],
+        interpret=interpret,
+    )(planes_flat, x_padded)
+
+
+def dia_spmv_pallas_v2(data, offsets, x, shape, tile=65536, interpret=None):
+    """One-shot wrapper over the prepared path (packs per call — for tests
+    and drop-in use; hot loops should pack once via dia_pack/dia_pad_x)."""
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    plan = dia_plan(tuple(offsets), tuple(shape), tile=tile)
+    y = dia_spmv_packed(
+        dia_pack(data, plan), dia_pad_x(x, plan), plan, interpret=interpret
+    )
+    return y[: plan.m]
+
+
 def dia_spmv_pallas(data, offsets, x, shape, tile=16384, interpret=None):
     """See ``_dia_spmv_pallas``; ``interpret=None`` auto-selects interpret
     mode off-TPU (Pallas TPU kernels only compile natively on tpu)."""
@@ -55,10 +234,10 @@ def _dia_spmv_pallas(
     # Mosaic DMA alignment: 1-D HBM memrefs carry a (1024,) tiling, so the
     # row tile TM rounds to 1024 and the halo B to 512 — then the window
     # win = TM + 2B, every window start g*TM, and each plane's base k*L in
-    # the flattened plane array are all multiples of 1024.
-    B = _round_up(max(max((abs(int(o)) for o in offsets), default=0), 1), 512)
-    TM = min(_round_up(tile, 1024), _round_up(max(m, 1024), 1024))
-    G = (m + TM - 1) // TM
+    # the flattened plane array are all multiples of 1024. (Geometry shared
+    # with the prepared path via dia_plan — single source.)
+    _p = dia_plan(offsets, shape, tile=tile)
+    B, TM, G = _p.B, _p.TM, _p.G
     m_pad = G * TM
     win = TM + 2 * B
     L = m_pad + 2 * B  # padded plane length (multiple of 1024)

@@ -48,3 +48,53 @@ def test_cg_fused_nonzero_x0():
 
     x_f = cg_dia_fused(planes, offsets, b, x0, N, iters=40, interpret=True)[0]
     assert np.allclose(np.asarray(x_f), x_ref, atol=1e-4)
+
+
+def test_cg_fused_junk_dia_tail_slots():
+    """scipy-ignored out-of-band DIA slots must not leak into the solve.
+
+    Dense-random planes are a legal sp.dia_matrix input whose slots for
+    nonexistent rows hold junk; the packing must mask them or padded rows
+    of q contaminate r/rho (regression: residual was ~1e5 before the
+    row-mask in dia_pack).
+    """
+    import scipy.sparse as sp
+
+    m, offsets = 600, (-1, 0, 1)
+    rng = np.random.default_rng(3)
+    off = rng.uniform(0.5, 1.0, m).astype(np.float32)  # A[j+1, j] = off[j]
+    data = np.zeros((3, m), dtype=np.float32)
+    data[0, :] = off                      # o=-1: data[0][j] = A[j+1, j]
+    data[1, :] = 4.0
+    data[2, 1:] = off[:-1]                # o=+1: data[2][j] = A[j-1, j] (symmetric)
+    data[0, m - 1] = 1e6                  # scipy-ignored slots: junk
+    data[2, 0] = -1e6
+    A = sp.dia_matrix((data, offsets), shape=(m, m)).tocsr()
+    b = rng.standard_normal(m).astype(np.float32)
+
+    x = np.asarray(
+        cg_dia_fused(jnp.asarray(data), offsets, jnp.asarray(b), None, m,
+                     iters=80, tile=1024, interpret=True)[0]
+    )
+    assert np.linalg.norm(A @ x - b) < 1e-2
+
+
+def test_cg_fused_multi_tile():
+    """G > 1 exercises the double-buffered plane/window DMA machinery."""
+    import scipy.sparse as sp
+
+    m = 2500  # three 1024-tiles
+    offsets = (-50, -1, 0, 1, 50)
+    rng = np.random.default_rng(5)
+    A = sp.diags(
+        [np.full(m - 50, -1.0), np.full(m - 1, -1.0), np.full(m, 4.2),
+         np.full(m - 1, -1.0), np.full(m - 50, -1.0)],
+        offsets, shape=(m, m), format="dia",
+    )
+    data = A.data.astype(np.float32)
+    b = rng.standard_normal(m).astype(np.float32)
+    x = np.asarray(
+        cg_dia_fused(jnp.asarray(data), offsets, jnp.asarray(b), None, m,
+                     iters=120, tile=1024, interpret=True)[0]
+    )
+    assert np.linalg.norm(A.tocsr() @ x - b) < 1e-2

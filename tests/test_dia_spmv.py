@@ -40,6 +40,50 @@ def test_dia_spmv_pallas_interpret(m, n, offs):
     np.testing.assert_allclose(got, s @ x, rtol=1e-12, atol=1e-12)
 
 
+@pytest.mark.parametrize("m,n,offs", CASES)
+def test_dia_spmv_packed_interpret(m, n, offs):
+    from sparse_tpu.kernels.dia_spmv import dia_spmv_pallas_v2
+
+    rng = np.random.default_rng(m * 3 + n)
+    data = rng.standard_normal((len(offs), n)).astype(np.float32)
+    s = sp.dia_matrix((data, offs), shape=(m, n))
+    x = rng.standard_normal(n).astype(np.float32)
+    got = np.asarray(
+        dia_spmv_pallas_v2(data, tuple(offs), x, (m, n), tile=1024, interpret=True)
+    )
+    np.testing.assert_allclose(got, s @ x, rtol=1e-5, atol=1e-5)
+
+
+def test_dia_packed_multi_tile_interpret():
+    from sparse_tpu.kernels.dia_spmv import dia_spmv_pallas_v2
+
+    m = 2500  # three 1024-tiles with a ragged tail
+    offs = (-70, -1, 0, 1, 70)
+    rng = np.random.default_rng(7)
+    data = rng.standard_normal((len(offs), m)).astype(np.float32)
+    s = sp.dia_matrix((data, offs), shape=(m, m))
+    x = rng.standard_normal(m).astype(np.float32)
+    got = np.asarray(
+        dia_spmv_pallas_v2(data, offs, x, (m, m), tile=1024, interpret=True)
+    )
+    np.testing.assert_allclose(got, s @ x, rtol=1e-4, atol=1e-4)
+
+
+def test_dia_packed_wide_matrix_interpret():
+    # n >> m_pad + B: packing must truncate, not let update-slice clamp
+    from sparse_tpu.kernels.dia_spmv import dia_spmv_pallas_v2
+
+    m, n, offs = 100, 2000, (0, 5)
+    rng = np.random.default_rng(11)
+    data = rng.standard_normal((2, n)).astype(np.float32)
+    s = sp.dia_matrix((data, offs), shape=(m, n))
+    x = rng.standard_normal(n).astype(np.float32)
+    got = np.asarray(
+        dia_spmv_pallas_v2(data, offs, x, (m, n), tile=1024, interpret=True)
+    )
+    np.testing.assert_allclose(got, s @ x, rtol=1e-5, atol=1e-5)
+
+
 def test_dia_array_dot_uses_dia_path():
     offs = [-2, 0, 3]
     data = np.random.default_rng(0).standard_normal((3, 30))
