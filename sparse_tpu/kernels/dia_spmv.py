@@ -198,7 +198,7 @@ def dia_spmv_packed(planes_flat, x_padded, plan: DiaPlan, interpret: bool = Fals
 
 def dia_spmv_pallas_v2(data, offsets, x, shape, tile=65536, interpret=None):
     """One-shot wrapper over the prepared path (packs per call — for tests
-    and drop-in use; hot loops should pack once via dia_pack/dia_pad_x)."""
+    and drop-in use; hot loops should pack once via PreparedDia)."""
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     plan = dia_plan(tuple(offsets), tuple(shape), tile=tile)
@@ -206,6 +206,31 @@ def dia_spmv_pallas_v2(data, offsets, x, shape, tile=65536, interpret=None):
         dia_pack(data, plan), dia_pad_x(x, plan), plan, interpret=interpret
     )
     return y[: plan.m]
+
+
+class PreparedDia:
+    """A DIA operator packed once into the kernel-native layout.
+
+    Holds the flat row-indexed plane buffer on device; each call pads x
+    into window coordinates, runs :func:`dia_spmv_packed`, and trims the
+    result. Format classes cache one of these per matrix so solver loops
+    never repack (the reference likewise keeps its CSR stores resident
+    across task launches rather than re-materializing per SpMV).
+    """
+
+    __slots__ = ("plan", "planes")
+
+    def __init__(self, data, offsets, shape, tile: int = 65536):
+        self.plan = dia_plan(tuple(int(o) for o in offsets), tuple(shape), tile=tile)
+        self.planes = dia_pack(data, self.plan)
+
+    def __call__(self, x, interpret=None):
+        if interpret is None:
+            interpret = jax.default_backend() != "tpu"
+        y = dia_spmv_packed(
+            self.planes, dia_pad_x(x, self.plan), self.plan, interpret=interpret
+        )
+        return y[: self.plan.m]
 
 
 def dia_spmv_pallas(data, offsets, x, shape, tile=16384, interpret=None):

@@ -13,6 +13,12 @@ import os
 # The harness pre-sets JAX_PLATFORMS (e.g. to the axon TPU tunnel); tests must
 # run on the virtual CPU mesh, so override rather than setdefault.
 os.environ["JAX_PLATFORMS"] = "cpu"
+# The axon sitecustomize hook dials the TPU tunnel from EVERY python process
+# whose env carries PALLAS_AXON_POOL_IPS — including the subprocesses that
+# example smoke tests spawn. When the tunnel is wedged that registration
+# blocks for minutes before giving up, so drop the trigger for this process
+# tree; CPU-mesh tests never need the tunnel.
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (

@@ -164,3 +164,28 @@ def test_csr_duplicate_entries_dia_path_sums():
     assert A._maybe_dia() is not None
     got = np.asarray(A @ np.array([1.0, 1.0]))
     np.testing.assert_allclose(got, [3.0, 5.0])
+
+
+def test_spmv_mode_pallas_prepared_cache():
+    """spmv_mode='pallas' routes through the cached PreparedDia operator
+    (interpret mode off-TPU) for both dia_array and banded csr_array."""
+    offs = [-2, 0, 3]
+    rng = np.random.default_rng(21)
+    data = rng.standard_normal((3, 40)).astype(np.float32)
+    s = sp.dia_matrix((data, offs), shape=(40, 40))
+    x = rng.standard_normal(40).astype(np.float32)
+    old = settings.spmv_mode
+    try:
+        settings.spmv_mode = "pallas"
+        A = sparse_tpu.dia_array((data, offs), shape=(40, 40))
+        np.testing.assert_allclose(np.asarray(A @ x), s @ x, rtol=1e-4, atol=1e-5)
+        assert getattr(A, "_prepared", None) is not None
+        np.testing.assert_allclose(np.asarray(A @ x), s @ x, rtol=1e-4, atol=1e-5)
+        C = sparse_tpu.csr_array(s.tocsr())
+        np.testing.assert_allclose(np.asarray(C @ x), s @ x, rtol=1e-4, atol=1e-5)
+        assert getattr(C, "_dia_prepared", None) is not None
+        # mutation produces a fresh object -> fresh cache
+        C2 = C * 2.0
+        np.testing.assert_allclose(np.asarray(C2 @ x), 2 * (s @ x), rtol=1e-4, atol=1e-5)
+    finally:
+        settings.spmv_mode = old
