@@ -234,16 +234,14 @@ class csr_array(SparseArray):
         if mode in ("auto", "pallas"):
             dia = self._maybe_dia()
             if dia is not None:
-                band = max((abs(int(o)) for o in dia[1]), default=0)
-                if mode == "pallas" and band <= settings.pallas_max_band:
-                    # wider bands exceed the VMEM window; XLA path below
-                    from .kernels.dia_spmv import PreparedDia
+                if mode == "pallas":
+                    from .kernels.dia_spmv import cached_prepared_spmv
 
-                    prepared = getattr(self, "_dia_prepared", None)
-                    if prepared is None:
-                        prepared = PreparedDia(dia[0], dia[1], self.shape)
-                        self._dia_prepared = prepared
-                    return prepared(x)
+                    y = cached_prepared_spmv(
+                        self, "_dia_prepared", dia[0], dia[1], self.shape, x
+                    )
+                    if y is not None:  # None: band too wide for VMEM
+                        return y
                 from .ops.dia_spmv import dia_spmv_xla
 
                 return dia_spmv_xla(dia[0], dia[1], x, self.shape)

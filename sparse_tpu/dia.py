@@ -152,16 +152,14 @@ class dia_array(SparseArray):
                 from .config import settings
 
                 offs = tuple(int(o) for o in self.offsets)
-                band = max((abs(o) for o in offs), default=0)
-                if settings.spmv_mode == "pallas" and band <= settings.pallas_max_band:
-                    # wider bands exceed the VMEM window; XLA path below
-                    from .kernels.dia_spmv import PreparedDia
+                if settings.spmv_mode == "pallas":
+                    from .kernels.dia_spmv import cached_prepared_spmv
 
-                    prepared = getattr(self, "_prepared", None)
-                    if prepared is None:
-                        prepared = PreparedDia(self.data, offs, self.shape)
-                        self._prepared = prepared
-                    return prepared(x)
+                    y = cached_prepared_spmv(
+                        self, "_prepared", self.data, offs, self.shape, x
+                    )
+                    if y is not None:  # None: band too wide for VMEM
+                        return y
                 from .ops.dia_spmv import dia_spmv_xla
 
                 return dia_spmv_xla(self.data, offs, x, self.shape)

@@ -233,6 +233,27 @@ class PreparedDia:
         return y[: self.plan.m]
 
 
+def cached_prepared_spmv(obj, attr: str, data, offsets, shape, x):
+    """Shared band-gated PreparedDia dispatch for the format classes.
+
+    Returns ``None`` when the band exceeds ``settings.pallas_max_band``
+    (caller falls back to the XLA formulation); otherwise caches a
+    :class:`PreparedDia` on ``obj`` under ``attr`` and applies it. Fresh
+    objects from ``_with_data``/constructors start without the attribute,
+    so mutation invalidates the cache for free.
+    """
+    from ..config import settings
+
+    band = max((abs(int(o)) for o in offsets), default=0)
+    if band > settings.pallas_max_band:
+        return None
+    prepared = getattr(obj, attr, None)
+    if prepared is None:
+        prepared = PreparedDia(data, offsets, shape)
+        setattr(obj, attr, prepared)
+    return prepared(x)
+
+
 def dia_spmv_pallas(data, offsets, x, shape, tile=16384, interpret=None):
     """See ``_dia_spmv_pallas``; ``interpret=None`` auto-selects interpret
     mode off-TPU (Pallas TPU kernels only compile natively on tpu)."""
