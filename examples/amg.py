@@ -313,16 +313,16 @@ def build_dist_cycle(levels, mesh):
     inside the compiled program).
     """
     from sparse_tpu.parallel.dist import shard_csr
+    from sparse_tpu.parallel.multigrid import make_dist_vcycle, shard_hierarchy
     from sparse_tpu.parallel.partition import equal_row_splits
 
     S = int(mesh.devices.size)
-    splits = [equal_row_splits(lv.A.shape[0], S) for lv in levels]
     omega = 4.0 / 3.0
     if len(levels) == 1:
         # Hierarchy never coarsened (n <= max_coarse): the "V-cycle" is the
         # replicated dense solve itself.
         A0 = levels[0].A
-        spl0 = splits[0]
+        spl0 = equal_row_splits(A0.shape[0], S)
         Ad = shard_csr(A0, mesh=mesh, row_splits=spl0, col_splits=spl0)
         n0 = A0.shape[0]
         g = np.arange(n0, dtype=np.int64)
@@ -335,47 +335,34 @@ def build_dist_cycle(levels, mesh):
             return jnp.zeros((Ad.m_pad,), x.dtype).at[imap].set(x)
 
         return Ad, direct
-    dlevels = []
+    # shared mesh-hierarchy machinery (parallel.multigrid); the Jacobi
+    # multiplier is W = (omega / rho(D^-1 A)) / diag(A) in padded layout
+    As = [lv.A for lv in levels]
+    RPs = [(lv.R, lv.P) for lv in levels[:-1]]
+    ops, spl_list = shard_hierarchy(As, RPs, mesh)
+    weights = []
     for i, lv in enumerate(levels[:-1]):
-        Ad = shard_csr(
-            lv.A, mesh=mesh, row_splits=splits[i], col_splits=splits[i]
-        )
-        Rd = shard_csr(
-            lv.R, mesh=mesh, row_splits=splits[i + 1], col_splits=splits[i]
-        )
-        Pd = shard_csr(
-            lv.P, mesh=mesh, row_splits=splits[i], col_splits=splits[i + 1]
-        )
-        # diagonal in padded layout; padding entries get 1 (divide-safe)
+        Ad = ops[i][0]
         Dp = Ad.pad_out_vector(np.asarray(lv.D) - 1.0) + 1.0
-        dlevels.append((Ad, Rd, Pd, Dp, omega / lv.rho_DinvA))
+        weights.append((omega / lv.rho_DinvA) / Dp)
+    weights.append(None)  # bottom level uses the dense solve below
 
     # bottom level: replicated dense solve with static unpad/repad maps
     bottom = levels[-1]
     nc = bottom.A.shape[0]
-    spl = splits[-1]
-    Rc = max(int(np.max(np.diff(spl))), 1)
+    spl = spl_list[-1]
+    Rc = ops[-1][0].R
     g = np.arange(nc, dtype=np.int64)
     shard = np.clip(np.searchsorted(spl, g, side="right") - 1, 0, S - 1)
     idx_map = jnp.asarray(shard * Rc + (g - spl[shard]))
     dense_A = jnp.asarray(bottom.dense_A)
     m_pad_bottom = S * Rc
 
-    def cycle_padded(lvl, bp):
-        Ad, Rd, Pd, Dp, c0 = dlevels[lvl]
-        x = c0 * bp / Dp
-        residual = bp - Ad.spmv_padded(x)
-        coarse_b = Rd.spmv_padded(residual)
-        if lvl == len(dlevels) - 1:
-            cb = coarse_b[idx_map]
-            cx = jnp.linalg.solve(dense_A, cb)
-            coarse_x = jnp.zeros((m_pad_bottom,), cx.dtype).at[idx_map].set(cx)
-        else:
-            coarse_x = cycle_padded(lvl + 1, coarse_b)
-        x = x + Pd.spmv_padded(coarse_x)
-        return x + c0 * (bp - Ad.spmv_padded(x)) / Dp
+    def coarse_apply(coarse_b):
+        cx = jnp.linalg.solve(dense_A, coarse_b[idx_map])
+        return jnp.zeros((m_pad_bottom,), cx.dtype).at[idx_map].set(cx)
 
-    return dlevels[0][0], lambda rp: cycle_padded(0, rp)
+    return ops[0][0], make_dist_vcycle(ops, weights, coarse_apply)
 
 
 def operator_complexity(levels):
@@ -406,23 +393,13 @@ def main():
     b = np.ones(A.shape[0])
     with solve:
         if use_tpu and args.dist:
-            from sparse_tpu.parallel.dist import make_dist_cg
+            from benchmark import solve_dist_cg_timed
             from sparse_tpu.parallel.mesh import get_mesh
 
-            mesh = get_mesh()
-            A0d, M = build_dist_cycle(levels, mesh)
-            solver = make_dist_cg(
-                A0d, tol=args.tol, maxiter=args.maxiter or 200, M=M,
-                conv_test_iters=5,
+            A0d, M = build_dist_cycle(levels, get_mesh())
+            x, iters, total_ms = solve_dist_cg_timed(
+                A0d, M, b, timer, tol=args.tol, maxiter=args.maxiter or 200
             )
-            bp = A0d.pad_out_vector(b)
-            x0p = jnp.zeros_like(bp)
-            solver(bp, x0p)[0].block_until_ready()  # compile outside timing
-            timer.start()
-            xp, iters, _ = solver(bp, x0p)
-            iters = int(iters)
-            x = A0d.unpad_vector(xp)
-            total_ms = timer.stop(fence=xp)
         elif use_tpu:
             M = linalg.LinearOperator(
                 A.shape, matvec=lambda r: cycle(levels, 0, r), dtype=np.float64

@@ -103,3 +103,26 @@ def get_phase_procs(use_tpu: bool):
     build = jax.default_device(cpus[0]) if cpus and accel.platform != "cpu" else contextlib.nullcontext()
     solve = jax.default_device(accel)
     return build, solve
+
+
+def solve_dist_cg_timed(A0d, cycle, b, timer, tol, maxiter, conv_test_iters=5):
+    """Shared -dist solve block for the multigrid examples: compile the
+    distributed preconditioned CG outside the timing, fence on a host
+    scalar read, and fetch the full solution only after the clock stops.
+    Returns (x, iters, total_ms)."""
+    import jax.numpy as jnp
+
+    from sparse_tpu.parallel.dist import make_dist_cg
+
+    solver = make_dist_cg(
+        A0d, tol=tol, maxiter=maxiter, M=cycle, conv_test_iters=conv_test_iters
+    )
+    bp = A0d.pad_out_vector(b)
+    x0p = jnp.zeros_like(bp)
+    solver(bp, x0p)[0].block_until_ready()  # compile outside timing
+    timer.start()
+    xp, iters, _ = solver(bp, x0p)
+    iters = int(iters)  # completion fence (host scalar read)
+    total_ms = timer.stop(fence=xp)
+    x = A0d.unpad_vector(xp)  # full-vector fetch outside the timing
+    return x, iters, total_ms

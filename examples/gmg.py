@@ -29,8 +29,24 @@ parser.add_argument("-maxiter", type=int, default=200)
 parser.add_argument("-tol", type=float, default=1e-8)
 parser.add_argument("-gridop", default="linear", choices=["injection", "linear"])
 parser.add_argument("-verbose", action="store_true")
+parser.add_argument(
+    "-dist",
+    action="store_true",
+    help="build Galerkin coarse operators with mesh-distributed SpGEMM and "
+    "solve with a distributed V-cycle-preconditioned CG over the mesh",
+)
 args, _ = parser.parse_known_args()
 common, timer, _np, sparse, linalg, use_tpu = parse_common_args()
+
+
+def _spgemm(X, Y):
+    """Sparse @ sparse; routed through the mesh-distributed row-gather
+    SpGEMM under -dist (parallel.spgemm.dist_spgemm)."""
+    if args.dist and use_tpu:
+        from sparse_tpu.parallel import dist_spgemm
+
+        return dist_spgemm(X.tocsr(), Y.tocsr())
+    return X @ Y
 
 
 def poisson2D(N):
@@ -158,7 +174,7 @@ class GMG:
         for level in range(self.levels - 1):
             R, dim = self.restriction_op(dim)
             P = R.T.tocsr()
-            A = (R @ A @ P).tocsr()  # Galerkin product: two SpGEMMs
+            A = _spgemm(_spgemm(R, A), P).tocsr()  # Galerkin: two SpGEMMs
             self.smoother.init_level_params(A, level + 1)
             operators.append((R, A, P))
         return operators
@@ -191,6 +207,28 @@ class GMG:
         )
 
 
+def build_dist_cycle(mg, mesh):
+    """Mesh-sharded weighted-Jacobi V-cycle over the geometric hierarchy
+    (shared machinery: ``sparse_tpu.parallel.multigrid``). The coarsest
+    level applies the smoother, as in GMG._cycle — no dense solve.
+    """
+    from sparse_tpu.parallel.multigrid import make_dist_vcycle, shard_hierarchy
+
+    As = [mg.A] + [op[1] for op in mg.operators]
+    RPs = [(op[0], op[2]) for op in mg.operators]
+    ops, _ = shard_hierarchy(As, RPs, mesh)
+    weights = []
+    for i, (Ad, _, _) in enumerate(ops):
+        omega, D_inv = mg.smoother.level_params[i]
+        # pad slots get omega*1.0 — inert (padded inputs are exactly zero)
+        weights.append(
+            float(omega) * (Ad.pad_out_vector(np.asarray(D_inv) - 1.0) + 1.0)
+        )
+    return ops[0][0], make_dist_vcycle(
+        ops, weights, coarse_apply=lambda rp: weights[-1] * rp
+    )
+
+
 def main():
     N = args.n
     build, solve = get_phase_procs(use_tpu)
@@ -213,6 +251,19 @@ def main():
             print(f"Residual: {np.linalg.norm(b - np.asarray(A @ x)):.3e}")
 
     with solve:
+        if use_tpu and args.dist:
+            from benchmark import solve_dist_cg_timed
+            from sparse_tpu.parallel.mesh import get_mesh
+
+            A0d, cycle = build_dist_cycle(mg, get_mesh())
+            x, iters, total_ms = solve_dist_cg_timed(
+                A0d, cycle, b, timer, tol=args.tol, maxiter=args.maxiter
+            )
+            resid = float(np.linalg.norm(np.asarray(A @ x) - b))
+            print(f"Iterations: {iters}  residual: {resid:.3e}")
+            print(f"Solve time: {total_ms:.1f} ms")
+            print(f"Iterations / sec: {iters / (total_ms / 1000.0):.3f}")
+            return
         _ = float(np.linalg.norm(np.asarray(A @ np.zeros(A.shape[1]))))  # warm up
         timer.start()
         if use_tpu:

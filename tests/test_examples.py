@@ -81,3 +81,12 @@ def test_weak_scaling_example():
 def test_pyamg_adapter_example():
     pytest.importorskip("pyamg")
     _run("pyamg_sparse_tpu_test.py")
+
+
+def test_gmg_dist_example():
+    """Distributed GMG: Galerkin products via mesh SpGEMM, V-cycle CG on
+    the 8-device mesh, converging like the single-device solver."""
+    out = _run("gmg.py", "-n", "32", "-levels", "3", "-maxiter", "60", "-dist")
+    m = re.search(r"Iterations: (\d+)\s+residual: ([0-9.e+-]+)", out)
+    assert m, out
+    assert float(m.group(2)) < 1e-6
