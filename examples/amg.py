@@ -50,14 +50,11 @@ else:
 
 
 def spg(X, Y):
-    """Sparse @ sparse, routed through the mesh-distributed row-gather
-    SpGEMM (parallel.spgemm.dist_spgemm; reference csr.py:1390-1490) under
-    -dist."""
-    if args.dist and use_tpu:
-        from sparse_tpu.parallel import dist_spgemm
+    """Galerkin sparse @ sparse (mesh-distributed under -dist; shared
+    switch in benchmark.galerkin_spgemm)."""
+    from benchmark import galerkin_spgemm
 
-        return dist_spgemm(X.tocsr(), Y.tocsr())
-    return X @ Y
+    return galerkin_spgemm(X, Y, args.dist and use_tpu)
 
 
 # ---------------------------------------------------------------------------

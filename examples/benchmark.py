@@ -126,3 +126,15 @@ def solve_dist_cg_timed(A0d, cycle, b, timer, tol, maxiter, conv_test_iters=5):
     total_ms = timer.stop(fence=xp)
     x = A0d.unpad_vector(xp)  # full-vector fetch outside the timing
     return x, iters, total_ms
+
+
+def galerkin_spgemm(X, Y, dist: bool):
+    """Sparse @ sparse for hierarchy setup, routed through the
+    mesh-distributed row-gather SpGEMM (parallel.spgemm.dist_spgemm;
+    reference csr.py:1390-1490) when ``dist`` — shared by the -dist modes
+    of the multigrid examples."""
+    if dist:
+        from sparse_tpu.parallel import dist_spgemm
+
+        return dist_spgemm(X.tocsr(), Y.tocsr())
+    return X @ Y

@@ -40,13 +40,11 @@ common, timer, _np, sparse, linalg, use_tpu = parse_common_args()
 
 
 def _spgemm(X, Y):
-    """Sparse @ sparse; routed through the mesh-distributed row-gather
-    SpGEMM under -dist (parallel.spgemm.dist_spgemm)."""
-    if args.dist and use_tpu:
-        from sparse_tpu.parallel import dist_spgemm
+    """Galerkin sparse @ sparse (mesh-distributed under -dist; shared
+    switch in benchmark.galerkin_spgemm)."""
+    from benchmark import galerkin_spgemm
 
-        return dist_spgemm(X.tocsr(), Y.tocsr())
-    return X @ Y
+    return galerkin_spgemm(X, Y, args.dist and use_tpu)
 
 
 def poisson2D(N):

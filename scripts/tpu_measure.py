@@ -1,0 +1,159 @@
+"""TPU kernel measurement sweep (developer tool).
+
+Runs the kernel-level comparisons that guided the Pallas work, one guarded
+step at a time, printing a JSON line per measurement immediately (the
+remote tunnel can die mid-run; everything printed so far survives).
+
+Usage:  python scripts/tpu_measure.py [--sizes 2000,6000] [--skip-cg]
+Timing fences on host scalar fetches with chain-slope correction
+(see bench._time_kernel) — block_until_ready does not fence the tunnel.
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+import traceback
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from bench import _time_kernel  # noqa: E402
+
+
+def emit(name, **kw):
+    print(json.dumps({"step": name, **kw}), flush=True)
+
+
+def guarded(name):
+    def deco(fn):
+        def run(*a, **kw):
+            try:
+                t0 = time.perf_counter()
+                out = fn(*a, **kw)
+                emit(name, ok=True, wall_s=round(time.perf_counter() - t0, 1), **(out or {}))
+                return out
+            except Exception as e:
+                traceback.print_exc(file=sys.stderr)
+                emit(name, ok=False, error=str(e)[:200])
+                return None
+
+        return run
+
+    return deco
+
+
+@guarded("devices")
+def step_devices():
+    import jax
+
+    d = jax.devices()[0]
+    return {"kind": getattr(d, "device_kind", "?"), "platform": d.platform}
+
+
+@guarded("dia_spmv_compare")
+def step_dia_compare(n):
+    """v1 (per-call repack) vs packed v2 DIA SpMV on the n^2 Laplacian."""
+    import jax.numpy as jnp
+
+    from sparse_tpu.kernels.dia_spmv import PreparedDia, dia_spmv_pallas
+    from sparse_tpu.models.poisson import laplacian_2d_dia
+    from sparse_tpu.ops.dia_spmv import dia_spmv_xla
+
+    N = n * n
+    planes, offsets = laplacian_2d_dia(n)
+    x = jnp.ones((N,), jnp.float32)
+    nnz = 5 * N
+    out = {}
+    for name, step in (
+        ("xla", lambda xx: dia_spmv_xla(planes, offsets, xx, (N, N))),
+        ("pallas_v1", lambda xx: dia_spmv_pallas(planes, offsets, xx, (N, N))),
+        ("pallas_packed", PreparedDia(planes, offsets, (N, N))),
+        ("pallas_packed_t16k", PreparedDia(planes, offsets, (N, N), tile=16384)),
+    ):
+        try:
+            s = _time_kernel(step, x)
+            out[name] = {"ms": round(s * 1e3, 3), "gflops": round(2 * nnz / s / 1e9, 1)}
+        except Exception as e:
+            traceback.print_exc(file=sys.stderr)
+            out[name] = {"error": str(e)[:150]}
+    return {"n": n, **out}
+
+
+@guarded("spmv_11diag")
+def step_11diag(rows=10_000_000):
+    import jax.numpy as jnp
+
+    from sparse_tpu.kernels.dia_spmv import PreparedDia
+
+    offsets = tuple(range(-5, 6))
+    planes = jnp.ones((11, rows), jnp.float32)
+    x = jnp.ones((rows,), jnp.float32)
+    s = _time_kernel(PreparedDia(planes, offsets, (rows, rows)), x)
+    return {"rows": rows, "iters_per_s": round(1.0 / s, 1), "vs_v100_347.7": round(1.0 / s / 347.7, 2)}
+
+
+@guarded("cg_variants")
+def step_cg(n, iters=300):
+    import jax
+    import jax.numpy as jnp
+
+    from sparse_tpu.kernels.cg_dia import cg_dia_fused, cg_dia_fused_onepass
+    from sparse_tpu.models.poisson import laplacian_2d_dia, cg_dia, poisson_cg_state_dia
+    from sparse_tpu.ops.dia_spmv import dia_spmv_xla
+
+    N = n * n
+    planes, offsets = laplacian_2d_dia(n)
+    b = dia_spmv_xla(planes, offsets,
+                     jax.random.normal(jax.random.PRNGKey(0), (N,), jnp.float32),
+                     (N, N))
+    out = {"n": n}
+
+    state, stepfn = poisson_cg_state_dia(n)
+    o = cg_dia(stepfn, *state, iters=iters)
+    float(o[-1])
+    best = 0.0
+    for _ in range(2):
+        t0 = time.perf_counter()
+        o = cg_dia(stepfn, *state, iters=iters)
+        float(o[-1])
+        best = max(best, iters / (time.perf_counter() - t0))
+    out["step_loop"] = round(best, 1)
+
+    for fn, name in ((cg_dia_fused, "twopass"), (cg_dia_fused_onepass, "onepass")):
+        for tile in (16384, 65536):
+            key = f"{name}_t{tile // 1024}k"
+            try:
+                o = fn(planes, offsets, b, None, N, iters=iters, tile=tile)
+                rho = float(o[2])
+                best = 0.0
+                for _ in range(2):
+                    t0 = time.perf_counter()
+                    o = fn(planes, offsets, b, None, N, iters=iters, tile=tile)
+                    float(o[2])
+                    best = max(best, iters / (time.perf_counter() - t0))
+                out[key] = {"iters_per_s": round(best, 1), "rho": float(f"{rho:.3e}")}
+            except Exception as e:
+                traceback.print_exc(file=sys.stderr)
+                out[key] = {"error": str(e)[:150]}
+    return out
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--sizes", default="2000,6000")
+    ap.add_argument("--skip-cg", action="store_true")
+    args = ap.parse_args()
+    sizes = [int(s) for s in args.sizes.split(",")]
+
+    if not step_devices():
+        sys.exit(1)
+    step_dia_compare(sizes[0])
+    step_11diag()
+    if not args.skip_cg:
+        for n in sizes[1:] or sizes[:1]:
+            step_cg(n)
+
+
+if __name__ == "__main__":
+    main()
