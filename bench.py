@@ -12,8 +12,10 @@ by row count (same-work throughput), recorded in the metric name.
 
 Fail-soft by design: the measurement runs in a watchdogged SUBPROCESS per
 platform attempt (a hung TPU-tunnel backend init cannot take the parent
-down), every failure is logged to stderr, and exactly one JSON line is
-ALWAYS printed to stdout:
+down), every failure is logged to stderr, and at least one JSON line is
+ALWAYS printed to stdout — the LAST metric line is authoritative (the
+worker checkpoints a record before slow optional sweeps, then prints an
+updated one):
   {"metric": ..., "value": N, "unit": "iters/s", "vs_baseline": N}
 """
 
@@ -221,10 +223,24 @@ def run_fused(n: int, iters: int, tiles=(65536, 16384)):
     b = dia_spmv_xla(planes, offsets, xtrue, (N, N))
     best, label = 0.0, ""
     rho_ref = None
-    for fn, name in ((cg_dia_fused, "twopass"), (cg_dia_fused_onepass, "onepass")):
+    # bf16 plane streaming is tried only when EXACT (stencil coefficients
+    # representable with zero loss) — halves matrix traffic, same result
+    exact_bf16 = bool(
+        jnp.all(planes == planes.astype(jnp.bfloat16).astype(planes.dtype))
+    )
+    variants = [(cg_dia_fused, "twopass", None), (cg_dia_fused_onepass, "onepass", None)]
+    if exact_bf16:
+        variants += [
+            (cg_dia_fused_onepass, "onepass_bf16", jnp.bfloat16),
+            (cg_dia_fused, "twopass_bf16", jnp.bfloat16),
+        ]
+    for fn, name, pdt in variants:
         for tile in tiles:
             try:
-                out = fn(planes, offsets, b, None, N, iters=iters, tile=tile)
+                out = fn(
+                    planes, offsets, b, None, N, iters=iters, tile=tile,
+                    plane_dtype=pdt,
+                )
                 rho = float(out[2])  # compile + warm (+ convergence proxy)
                 if rho_ref is None and name == "twopass" and np.isfinite(rho):
                     rho_ref = rho
@@ -247,7 +263,10 @@ def run_fused(n: int, iters: int, tiles=(65536, 16384)):
                     continue
                 for _ in range(3):
                     t0 = time.perf_counter()
-                    out = fn(planes, offsets, b, None, N, iters=iters, tile=tile)
+                    out = fn(
+                        planes, offsets, b, None, N, iters=iters, tile=tile,
+                        plane_dtype=pdt,
+                    )
                     float(out[2])
                     v = iters / (time.perf_counter() - t0)
                     if v > best:
@@ -306,8 +325,12 @@ def worker(platform_arg: str) -> None:
                 )
             except Exception:
                 traceback.print_exc(file=sys.stderr)
-            # fused two-pass CG (kernels/cg_dia.py): attempted LAST so a
-            # kernel fault cannot lose the headline measurement above
+            # checkpoint the record BEFORE the long fused sweep: the parent
+            # parses the LAST metric line, so a timeout/fault during the
+            # sweep cannot lose the headline measurements above
+            print(json.dumps(rec))
+            sys.stdout.flush()
+            # fused CG variants (kernels/cg_dia.py): attempted LAST
             try:
                 fused_result = run_fused(n, ITERS)
                 if fused_result:
@@ -386,6 +409,7 @@ def _try_gmg(timeout_s: int = 600):
 
 def _try_platform(platform_arg: str, timeout_s: int):
     """Run a worker subprocess; return its parsed JSON line or None."""
+    stdout, stderr, rc = "", "", None
     try:
         proc = subprocess.run(
             [sys.executable, os.path.abspath(__file__), "--worker", platform_arg],
@@ -394,14 +418,21 @@ def _try_platform(platform_arg: str, timeout_s: int):
             timeout=timeout_s,
             cwd=os.path.dirname(os.path.abspath(__file__)),
         )
-    except subprocess.TimeoutExpired:
+        stdout, stderr, rc = proc.stdout, proc.stderr, proc.returncode
+    except subprocess.TimeoutExpired as e:
+        # the worker checkpoints its record before slow optional sweeps —
+        # salvage the last metric line from the partial output
         print(
-            f"bench: platform {platform_arg!r} timed out after {timeout_s}s",
+            f"bench: platform {platform_arg!r} timed out after {timeout_s}s; "
+            "salvaging partial output",
             file=sys.stderr,
         )
-        return None
-    sys.stderr.write(proc.stderr[-4000:])
-    for line in reversed(proc.stdout.strip().splitlines()):
+        def _dec(v):
+            return v.decode(errors="replace") if isinstance(v, bytes) else (v or "")
+
+        stdout, stderr = _dec(e.stdout), _dec(e.stderr)
+    sys.stderr.write(stderr[-4000:])
+    for line in reversed(stdout.strip().splitlines()):
         try:
             rec = json.loads(line)
             if "metric" in rec:
@@ -409,7 +440,7 @@ def _try_platform(platform_arg: str, timeout_s: int):
         except (json.JSONDecodeError, TypeError):
             continue
     print(
-        f"bench: platform {platform_arg!r} exited rc={proc.returncode} "
+        f"bench: platform {platform_arg!r} exited rc={rc} "
         "without a metric line",
         file=sys.stderr,
     )

@@ -82,15 +82,14 @@ def step_dia_compare(n):
 
 @guarded("spmv_11diag")
 def step_11diag(rows=10_000_000):
-    import jax.numpy as jnp
+    from bench import SPMV_BASELINE_ITERS_PER_S, run_spmv_11diag
 
-    from sparse_tpu.kernels.dia_spmv import PreparedDia
-
-    offsets = tuple(range(-5, 6))
-    planes = jnp.ones((11, rows), jnp.float32)
-    x = jnp.ones((rows,), jnp.float32)
-    s = _time_kernel(PreparedDia(planes, offsets, (rows, rows)), x)
-    return {"rows": rows, "iters_per_s": round(1.0 / s, 1), "vs_v100_347.7": round(1.0 / s / 347.7, 2)}
+    v = run_spmv_11diag(rows)
+    return {
+        "rows": rows,
+        "iters_per_s": round(v, 1),
+        "vs_v100": round(v / SPMV_BASELINE_ITERS_PER_S, 2),
+    }
 
 
 @guarded("cg_variants")

@@ -67,6 +67,12 @@ def _row_planes(data, offsets: tuple, TM: int, B: int, G: int, m: int):
     return dia_pack(data, DiaPlan(offsets, m, data.shape[1], TM, B, G))
 
 
+def _resolve_plane_dtype(plane_dtype, dt):
+    """Stream dtype for the packed planes (bf16 halves matrix traffic;
+    callers opt in only when values are exactly representable)."""
+    return jnp.dtype(plane_dtype) if plane_dtype is not None else dt
+
+
 def _pad_vec(v, TM: int, G: int):
     """[m] -> [L] padded with one zero block each side (+ tail zeros)."""
     m = v.shape[0]
@@ -134,7 +140,7 @@ def _kernel_a(offsets: tuple, TM: int, B: int, win: int, D: int, m_pad: int):
             acc = jnp.zeros((TM,), dtype=q_ref.dtype)
             for k, o in enumerate(offsets):
                 lo = B + int(o)
-                acc = acc + dwin[k, :] * pw[lo : lo + TM]
+                acc = acc + dwin[k, :].astype(acc.dtype) * pw[lo : lo + TM]
             mid = pw[B : B + TM]
             pnew_ref[:] = mid
             q_ref[:] = acc
@@ -259,7 +265,7 @@ def _kernel_cgcg(offsets: tuple, TM: int, B: int, win: int, D: int, m_pad: int):
             acc = jnp.zeros((TM,), dtype=wo_ref.dtype)
             for k, o in enumerate(offsets):
                 lo = B + int(o)
-                acc = acc + dwin[k, :] * r_new[lo : lo + TM]
+                acc = acc + dwin[k, :].astype(acc.dtype) * r_new[lo : lo + TM]
             p_new = rwin[B : B + TM] + beta * ptile[:]
             xo_ref[:] = xtile[:] + alpha * p_new
             r_mid = r_new[B : B + TM]
@@ -297,11 +303,11 @@ def _kernel_cgcg(offsets: tuple, TM: int, B: int, win: int, D: int, m_pad: int):
 
 @partial(
     jax.jit,
-    static_argnames=("offsets", "m", "iters", "tile", "interpret"),
+    static_argnames=("offsets", "m", "iters", "tile", "plane_dtype", "interpret"),
 )
 def cg_dia_fused_onepass(
     data, offsets: tuple, b, x0, m: int, iters: int = 300, tile: int = 16384,
-    interpret: bool = False
+    plane_dtype=None, interpret: bool = False
 ):
     """``iters`` Chronopoulos-Gear CG iterations — ONE fused pass each.
 
@@ -324,7 +330,8 @@ def cg_dia_fused_onepass(
     D = len(offsets)
     Dp = _round_up(D, 8)
 
-    planes_row = _row_planes(data.astype(dt), offsets, TM, B, G, m)
+    pdt = _resolve_plane_dtype(plane_dtype, dt)
+    planes_row = _row_planes(data.astype(pdt), offsets, TM, B, G, m)
 
     kern = pl.pallas_call(
         _kernel_cgcg(offsets, TM, B, win, D, m_pad),
@@ -344,13 +351,13 @@ def cg_dia_fused_onepass(
             pltpu.VMEM((win,), dt),
             pltpu.VMEM((TM,), dt),
             pltpu.VMEM((TM,), dt),
-            pltpu.VMEM((Dp, TM), dt),
+            pltpu.VMEM((Dp, TM), pdt),
             pltpu.VMEM((win,), dt),
             pltpu.VMEM((win,), dt),
             pltpu.VMEM((win,), dt),
             pltpu.VMEM((TM,), dt),
             pltpu.VMEM((TM,), dt),
-            pltpu.VMEM((Dp, TM), dt),
+            pltpu.VMEM((Dp, TM), pdt),
             pltpu.SemaphoreType.DMA((5 + D,)),
             pltpu.SemaphoreType.DMA((5 + D,)),
         ],
@@ -398,11 +405,11 @@ def cg_dia_fused_onepass(
 
 @partial(
     jax.jit,
-    static_argnames=("offsets", "m", "iters", "tile", "interpret"),
+    static_argnames=("offsets", "m", "iters", "tile", "plane_dtype", "interpret"),
 )
 def cg_dia_fused(
     data, offsets: tuple, b, x0, m: int, iters: int = 300, tile: int = 16384,
-    interpret: bool = False
+    plane_dtype=None, interpret: bool = False
 ):
     """``iters`` fixed CG iterations on the DIA matrix (throughput mode).
 
@@ -419,7 +426,8 @@ def cg_dia_fused(
     D = len(offsets)
     Dp = _round_up(D, 8)
 
-    planes_row = _row_planes(data.astype(dt), offsets, TM, B, G, m)
+    pdt = _resolve_plane_dtype(plane_dtype, dt)
+    planes_row = _row_planes(data.astype(pdt), offsets, TM, B, G, m)
     bp = _pad_vec(b.astype(dt), TM, G)
     xp = (
         jnp.zeros(((G + 2) * TM,), dt)
@@ -451,8 +459,8 @@ def cg_dia_fused(
             pltpu.VMEM((win,), dt),
             pltpu.VMEM((win,), dt),
             pltpu.VMEM((win,), dt),
-            pltpu.VMEM((Dp, TM), dt),
-            pltpu.VMEM((Dp, TM), dt),
+            pltpu.VMEM((Dp, TM), pdt),
+            pltpu.VMEM((Dp, TM), pdt),
             pltpu.SemaphoreType.DMA((2 + D,)),
             pltpu.SemaphoreType.DMA((2 + D,)),
         ],

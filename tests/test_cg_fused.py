@@ -133,3 +133,19 @@ def test_cg_onepass_multi_tile_and_x0():
                               N, iters=150, tile=1024, interpret=True)[0]
     r1 = np.linalg.norm(np.asarray(dia_spmv_xla(planes, offsets, x1, (N, N))) - b)
     assert r1 < 1e-2
+
+
+def test_cg_fused_bf16_planes_exact():
+    """bf16 plane streaming with exactly-representable stencil values
+    reproduces the f32 result bit-for-bit at the solver level."""
+    n = 16
+    N = n * n
+    planes, offsets = laplacian_2d_dia(n)
+    assert bool(jnp.all(planes == planes.astype(jnp.bfloat16).astype(planes.dtype)))
+    b = np.asarray(jax.random.normal(jax.random.PRNGKey(6), (N,), jnp.float32))
+    x32 = cg_dia_fused(planes, offsets, jnp.asarray(b), None, N,
+                       iters=100, tile=1024, interpret=True)[0]
+    xbf = cg_dia_fused(planes, offsets, jnp.asarray(b), None, N,
+                       iters=100, tile=1024, plane_dtype=jnp.bfloat16,
+                       interpret=True)[0]
+    np.testing.assert_allclose(np.asarray(x32), np.asarray(xbf), rtol=0, atol=0)
