@@ -185,17 +185,19 @@ def kernel_sweep(n: int, platform: str) -> dict:
 SPMV_BASELINE_ITERS_PER_S = 347.7  # reference: 10M rows, 11-diag banded, f64, 1x V100
 
 
-def run_spmv_11diag(rows: int = 10_000_000):
+def run_spmv_11diag(rows: int = 10_000_000, plane_dtype=None):
     """The reference's CSR SpMV microbenchmark shape (BASELINE.md row 1):
     banded 11 nnz/row at 10M rows — here in the prepared DIA layout
     (planes packed once, like the reference's resident CSR stores).
-    Returns iterations/second."""
+    ``plane_dtype=jnp.bfloat16`` streams the planes at half width (exact
+    here: the values are ones); the f32 row stays the headline. Returns
+    iterations/second."""
     import jax.numpy as jnp
 
     from sparse_tpu.kernels.dia_spmv import PreparedDia
 
     offsets = tuple(range(-5, 6))
-    planes = jnp.ones((11, rows), dtype=jnp.float32)
+    planes = jnp.ones((11, rows), dtype=plane_dtype or jnp.float32)
     x = jnp.ones((rows,), dtype=jnp.float32)
     return 1.0 / _time_kernel(PreparedDia(planes, offsets, (rows, rows)), x)
 
@@ -322,6 +324,14 @@ def worker(platform_arg: str) -> None:
                 rec["spmv_11diag_iters_per_s"] = round(v, 1)
                 rec["spmv_11diag_vs_baseline"] = round(
                     v / SPMV_BASELINE_ITERS_PER_S, 2
+                )
+            except Exception:
+                traceback.print_exc(file=sys.stderr)
+            try:  # bf16 plane stream (exact here; separate key)
+                import jax.numpy as jnp
+
+                rec["spmv_11diag_bf16_iters_per_s"] = round(
+                    run_spmv_11diag(plane_dtype=jnp.bfloat16), 1
                 )
             except Exception:
                 traceback.print_exc(file=sys.stderr)

@@ -67,10 +67,13 @@ def _row_planes(data, offsets: tuple, TM: int, B: int, G: int, m: int):
     return dia_pack(data, DiaPlan(offsets, m, data.shape[1], TM, B, G))
 
 
-def _resolve_plane_dtype(plane_dtype, dt):
+def _resolve_plane_dtype(plane_dtype, dt, TM: int = 2048):
     """Stream dtype for the packed planes (bf16 halves matrix traffic;
-    callers opt in only when values are exactly representable)."""
-    return jnp.dtype(plane_dtype) if plane_dtype is not None else dt
+    callers opt in only when values are exactly representable); alignment
+    policy shared with the SpMV kernels (dia_spmv.plane_stream_dtype)."""
+    from .dia_spmv import plane_stream_dtype
+
+    return plane_stream_dtype(plane_dtype, dt, TM)
 
 
 def _pad_vec(v, TM: int, G: int):
@@ -330,7 +333,7 @@ def cg_dia_fused_onepass(
     D = len(offsets)
     Dp = _round_up(D, 8)
 
-    pdt = _resolve_plane_dtype(plane_dtype, dt)
+    pdt = _resolve_plane_dtype(plane_dtype, dt, TM)
     planes_row = _row_planes(data.astype(pdt), offsets, TM, B, G, m)
 
     kern = pl.pallas_call(
@@ -426,7 +429,7 @@ def cg_dia_fused(
     D = len(offsets)
     Dp = _round_up(D, 8)
 
-    pdt = _resolve_plane_dtype(plane_dtype, dt)
+    pdt = _resolve_plane_dtype(plane_dtype, dt, TM)
     planes_row = _row_planes(data.astype(pdt), offsets, TM, B, G, m)
     bp = _pad_vec(b.astype(dt), TM, G)
     xp = (

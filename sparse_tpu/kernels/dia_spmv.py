@@ -44,6 +44,19 @@ def _round_up(v: int, m: int) -> int:
 # ---------------------------------------------------------------------------
 
 
+def plane_stream_dtype(requested, default, TM: int):
+    """Resolve the plane stream dtype against the DMA alignment rule:
+    2-byte elements need 2048-element-aligned starts, so an odd-1024 TM
+    forces the default (4-byte) stream. Single source for every caller
+    (PreparedDia, dia_spmv_packed, the fused CG kernels)."""
+    if requested is None:
+        return jnp.dtype(default)
+    rdt = jnp.dtype(requested)
+    if rdt.itemsize == 2 and TM % 2048:
+        return jnp.dtype(default)
+    return rdt
+
+
 class DiaPlan:
     """Static geometry of a prepared DIA operator (hashable => jit-static)."""
 
@@ -123,6 +136,11 @@ def dia_spmv_packed(planes_flat, x_padded, plan: DiaPlan, interpret: bool = Fals
     win = TM + 2 * B
     m_pad = G * TM
     out_dt = jnp.result_type(planes_flat.dtype, x_padded.dtype)
+    # direct callers may hand us 2-byte planes with a misaligned TM; the
+    # pack-time guard in PreparedDia avoids this per-call cast on hot paths
+    safe_dt = plane_stream_dtype(planes_flat.dtype, out_dt, TM)
+    if safe_dt != planes_flat.dtype:
+        planes_flat = planes_flat.astype(safe_dt)
 
     def kernel(planes_hbm, x_hbm, y_ref, dwinA, dwinB, xwinA, xwinB, semA, semB):
         g = pl.program_id(0)
@@ -163,7 +181,7 @@ def dia_spmv_packed(planes_flat, x_padded, plan: DiaPlan, interpret: bool = Fals
             acc = jnp.zeros((TM,), dtype=y_ref.dtype)
             for k, o in enumerate(plan.offsets):
                 lo = B + o
-                acc = acc + dwin[k, :] * xwin[lo : lo + TM]
+                acc = acc + dwin[k, :].astype(acc.dtype) * xwin[lo : lo + TM]
             y_ref[:] = acc
 
         @pl.when(g % 2 == 0)
@@ -222,6 +240,9 @@ class PreparedDia:
 
     def __init__(self, data, offsets, shape, tile: int = 65536):
         self.plan = dia_plan(tuple(int(o) for o in offsets), tuple(shape), tile=tile)
+        sdt = plane_stream_dtype(data.dtype, jnp.float32, self.plan.TM)
+        if sdt != jnp.dtype(data.dtype):
+            data = data.astype(sdt)  # misaligned TM: stream at f32
         self.planes = dia_pack(data, self.plan)
 
     def __call__(self, x, interpret=None):

@@ -137,15 +137,34 @@ def test_cg_onepass_multi_tile_and_x0():
 
 def test_cg_fused_bf16_planes_exact():
     """bf16 plane streaming with exactly-representable stencil values
-    reproduces the f32 result bit-for-bit at the solver level."""
-    n = 16
+    reproduces the f32 result bit-for-bit at the solver level.
+
+    Geometry matters: TM must be a 2048 multiple or the alignment guard
+    silently falls back to f32 and the test stops testing anything —
+    n=48 (N=2304 -> TM=2048 at tile=2048) keeps the bf16 path live; the
+    planes dtype reaching the kernel is asserted via the packing helper.
+    """
+    from sparse_tpu.kernels.dia_spmv import plane_stream_dtype
+
+    n = 48
     N = n * n
     planes, offsets = laplacian_2d_dia(n)
     assert bool(jnp.all(planes == planes.astype(jnp.bfloat16).astype(planes.dtype)))
+    # the guard must RESOLVE to bf16 for this geometry (TM=2048)
+    assert plane_stream_dtype(jnp.bfloat16, jnp.float32, 2048) == jnp.dtype(jnp.bfloat16)
     b = np.asarray(jax.random.normal(jax.random.PRNGKey(6), (N,), jnp.float32))
     x32 = cg_dia_fused(planes, offsets, jnp.asarray(b), None, N,
-                       iters=100, tile=1024, interpret=True)[0]
+                       iters=100, tile=2048, interpret=True)[0]
     xbf = cg_dia_fused(planes, offsets, jnp.asarray(b), None, N,
-                       iters=100, tile=1024, plane_dtype=jnp.bfloat16,
+                       iters=100, tile=2048, plane_dtype=jnp.bfloat16,
                        interpret=True)[0]
     np.testing.assert_allclose(np.asarray(x32), np.asarray(xbf), rtol=0, atol=0)
+
+
+def test_plane_stream_dtype_alignment_guard():
+    from sparse_tpu.kernels.dia_spmv import plane_stream_dtype
+
+    f32 = jnp.dtype(jnp.float32)
+    assert plane_stream_dtype(None, jnp.float32, 1024) == f32
+    assert plane_stream_dtype(jnp.bfloat16, jnp.float32, 1024) == f32  # odd-1024
+    assert plane_stream_dtype(jnp.bfloat16, jnp.float32, 4096) == jnp.dtype(jnp.bfloat16)
