@@ -102,7 +102,12 @@ def _time_kernel(step, x, reps=3, slope_k=16):
     if t1 > 0.5:  # slow kernel: fence cost is noise, one-op chain is enough
         return t1
     tk = chain(1 + slope_k)
-    return max(tk - t1, 1e-9) / slope_k
+    slope = (tk - t1) / slope_k
+    if slope <= 0:
+        # no measurable slope (overlap/noise ate the chain): report the
+        # whole 1-op chain as a conservative bound instead of a bogus ~0
+        return t1
+    return slope
 
 
 def kernel_sweep(n: int, platform: str) -> dict:

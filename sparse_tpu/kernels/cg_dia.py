@@ -92,10 +92,15 @@ def _kernel_a(offsets: tuple, TM: int, B: int, win: int, D: int, m_pad: int):
     """p_new (windowed), q, and the <p, q> partial.
 
     r/p windows AND the D flat row-indexed plane slices are all manual
-    double-buffered DMAs (sem slots: 0=r, 1=p, 2..2+D-1=planes)."""
+    double-buffered DMAs (sem slots: 0=r, 1=p, 2..2+D-1=planes). Planes
+    land in D separate 1-D (TM,) VMEM buffers per slot — Mosaic rejects
+    DMA into one row of a 2-D (8,128)-tiled scratch."""
 
     def kernel(beta_ref, r_hbm, p_hbm, planes_hbm, pnew_ref, q_ref, pq_ref,
-               rwinA, rwinB, pwinA, pwinB, dwinA, dwinB, semA, semB):
+               *scr):
+        rwinA, rwinB, pwinA, pwinB = scr[:4]
+        dwinA, dwinB = scr[4 : 4 + D], scr[4 + D : 4 + 2 * D]
+        semA, semB = scr[4 + 2 * D :]
         gg = pl.program_id(0)
         Gp2 = pl.num_programs(0)
 
@@ -104,7 +109,10 @@ def _kernel_a(offsets: tuple, TM: int, B: int, win: int, D: int, m_pad: int):
             pq_ref[0, 0] = jnp.zeros((), pq_ref.dtype)
 
         def copies(rwin, pwin, dwin, sem, g2):
-            start = g2 * TM - B
+            # g2*TM - B is divisible by the 1024-element HBM tiling (TM and
+            # B both are), but Mosaic's prover can't see through the
+            # subtraction — assert it explicitly or the compile fails.
+            start = pl.multiple_of(g2 * TM - B, 1024)
             yield pltpu.make_async_copy(
                 r_hbm.at[pl.ds(start, win)], rwin, sem.at[0]
             )
@@ -113,8 +121,10 @@ def _kernel_a(offsets: tuple, TM: int, B: int, win: int, D: int, m_pad: int):
             )
             for k in range(D):
                 yield pltpu.make_async_copy(
-                    planes_hbm.at[pl.ds(k * m_pad + (g2 - 1) * TM, TM)],
-                    dwin.at[k],
+                    planes_hbm.at[
+                        pl.ds(pl.multiple_of(k * m_pad + (g2 - 1) * TM, TM), TM)
+                    ],
+                    dwin[k],
                     sem.at[2 + k],
                 )
 
@@ -143,7 +153,7 @@ def _kernel_a(offsets: tuple, TM: int, B: int, win: int, D: int, m_pad: int):
             acc = jnp.zeros((TM,), dtype=q_ref.dtype)
             for k, o in enumerate(offsets):
                 lo = B + int(o)
-                acc = acc + dwin[k, :].astype(acc.dtype) * pw[lo : lo + TM]
+                acc = acc + dwin[k][:].astype(acc.dtype) * pw[lo : lo + TM]
             mid = pw[B : B + TM]
             pnew_ref[:] = mid
             q_ref[:] = acc
@@ -200,13 +210,17 @@ def _kernel_cgcg(offsets: tuple, TM: int, B: int, win: int, D: int, m_pad: int):
     plus both reduction partials rho_{j+1} = <r,r> and mu_{j+1} = <w,r>.
     The halo regions of s/r are recomputed redundantly per tile (same
     trade as kernel A: FLOPs for a barrier). Sem slots: 0=r, 1=w, 2=s
-    (windows), 3=p, 4=x (tiles), 5..5+D-1=planes."""
+    (windows), 3=p, 4=x (tiles), 5..5+D-1=planes. Planes land in D
+    separate 1-D (TM,) VMEM buffers per slot (Mosaic DMA alignment)."""
 
     def kernel(ab_ref, r_hbm, w_hbm, s_hbm, p_hbm, x_hbm, planes_hbm,
                xo_ref, ro_ref, po_ref, so_ref, wo_ref, dots_ref,
-               rwinA, wwinA, swinA, ptileA, xtileA, dwinA,
-               rwinB, wwinB, swinB, ptileB, xtileB, dwinB,
-               semA, semB):
+               *scr):
+        rwinA, wwinA, swinA, ptileA, xtileA = scr[:5]
+        dwinA = scr[5 : 5 + D]
+        rwinB, wwinB, swinB, ptileB, xtileB = scr[5 + D : 10 + D]
+        dwinB = scr[10 + D : 10 + 2 * D]
+        semA, semB = scr[10 + 2 * D :]
         bufA = (rwinA, wwinA, swinA, ptileA, xtileA, dwinA)
         bufB = (rwinB, wwinB, swinB, ptileB, xtileB, dwinB)
         gg = pl.program_id(0)
@@ -218,7 +232,8 @@ def _kernel_cgcg(offsets: tuple, TM: int, B: int, win: int, D: int, m_pad: int):
             dots_ref[0, 1] = jnp.zeros((), dots_ref.dtype)
 
         def copies(buf, sem, g2):
-            start = g2 * TM - B
+            # see _kernel_a: assert 1024-divisibility past the subtraction
+            start = pl.multiple_of(g2 * TM - B, 1024)
             rwin, wwin, swin, ptile, xtile, dwin = buf
             yield pltpu.make_async_copy(
                 r_hbm.at[pl.ds(start, win)], rwin, sem.at[0]
@@ -237,8 +252,10 @@ def _kernel_cgcg(offsets: tuple, TM: int, B: int, win: int, D: int, m_pad: int):
             )
             for k in range(D):
                 yield pltpu.make_async_copy(
-                    planes_hbm.at[pl.ds(k * m_pad + (g2 - 1) * TM, TM)],
-                    dwin.at[k],
+                    planes_hbm.at[
+                        pl.ds(pl.multiple_of(k * m_pad + (g2 - 1) * TM, TM), TM)
+                    ],
+                    dwin[k],
                     sem.at[5 + k],
                 )
 
@@ -268,7 +285,7 @@ def _kernel_cgcg(offsets: tuple, TM: int, B: int, win: int, D: int, m_pad: int):
             acc = jnp.zeros((TM,), dtype=wo_ref.dtype)
             for k, o in enumerate(offsets):
                 lo = B + int(o)
-                acc = acc + dwin[k, :].astype(acc.dtype) * r_new[lo : lo + TM]
+                acc = acc + dwin[k][:].astype(acc.dtype) * r_new[lo : lo + TM]
             p_new = rwin[B : B + TM] + beta * ptile[:]
             xo_ref[:] = xtile[:] + alpha * p_new
             r_mid = r_new[B : B + TM]
@@ -331,7 +348,6 @@ def cg_dia_fused_onepass(
     m_pad = G * TM
     L = (G + 2) * TM
     D = len(offsets)
-    Dp = _round_up(D, 8)
 
     pdt = _resolve_plane_dtype(plane_dtype, dt, TM)
     planes_row = _row_planes(data.astype(pdt), offsets, TM, B, G, m)
@@ -348,22 +364,28 @@ def cg_dia_fused_onepass(
         + [pl.BlockSpec((1, 2), lambda gg: (0, 0), memory_space=pltpu.SMEM)],
         out_shape=[jax.ShapeDtypeStruct((L,), dt) for _ in range(5)]
         + [jax.ShapeDtypeStruct((1, 2), dt)],
-        scratch_shapes=[
-            pltpu.VMEM((win,), dt),
-            pltpu.VMEM((win,), dt),
-            pltpu.VMEM((win,), dt),
-            pltpu.VMEM((TM,), dt),
-            pltpu.VMEM((TM,), dt),
-            pltpu.VMEM((Dp, TM), pdt),
-            pltpu.VMEM((win,), dt),
-            pltpu.VMEM((win,), dt),
-            pltpu.VMEM((win,), dt),
-            pltpu.VMEM((TM,), dt),
-            pltpu.VMEM((TM,), dt),
-            pltpu.VMEM((Dp, TM), pdt),
-            pltpu.SemaphoreType.DMA((5 + D,)),
-            pltpu.SemaphoreType.DMA((5 + D,)),
-        ],
+        scratch_shapes=(
+            [
+                pltpu.VMEM((win,), dt),
+                pltpu.VMEM((win,), dt),
+                pltpu.VMEM((win,), dt),
+                pltpu.VMEM((TM,), dt),
+                pltpu.VMEM((TM,), dt),
+            ]
+            + [pltpu.VMEM((TM,), pdt)] * D
+            + [
+                pltpu.VMEM((win,), dt),
+                pltpu.VMEM((win,), dt),
+                pltpu.VMEM((win,), dt),
+                pltpu.VMEM((TM,), dt),
+                pltpu.VMEM((TM,), dt),
+            ]
+            + [pltpu.VMEM((TM,), pdt)] * D
+            + [
+                pltpu.SemaphoreType.DMA((5 + D,)),
+                pltpu.SemaphoreType.DMA((5 + D,)),
+            ]
+        ),
         interpret=interpret,
     )
 
@@ -427,7 +449,6 @@ def cg_dia_fused(
     m_pad = G * TM
     L = (G + 2) * TM
     D = len(offsets)
-    Dp = _round_up(D, 8)
 
     pdt = _resolve_plane_dtype(plane_dtype, dt, TM)
     planes_row = _row_planes(data.astype(pdt), offsets, TM, B, G, m)
@@ -457,16 +478,19 @@ def cg_dia_fused(
             jax.ShapeDtypeStruct((L,), dt),
             jax.ShapeDtypeStruct((1, 1), dt),
         ],
-        scratch_shapes=[
-            pltpu.VMEM((win,), dt),
-            pltpu.VMEM((win,), dt),
-            pltpu.VMEM((win,), dt),
-            pltpu.VMEM((win,), dt),
-            pltpu.VMEM((Dp, TM), pdt),
-            pltpu.VMEM((Dp, TM), pdt),
-            pltpu.SemaphoreType.DMA((2 + D,)),
-            pltpu.SemaphoreType.DMA((2 + D,)),
-        ],
+        scratch_shapes=(
+            [
+                pltpu.VMEM((win,), dt),
+                pltpu.VMEM((win,), dt),
+                pltpu.VMEM((win,), dt),
+                pltpu.VMEM((win,), dt),
+            ]
+            + [pltpu.VMEM((TM,), pdt)] * (2 * D)
+            + [
+                pltpu.SemaphoreType.DMA((2 + D,)),
+                pltpu.SemaphoreType.DMA((2 + D,)),
+            ]
+        ),
         interpret=interpret,
     )
 

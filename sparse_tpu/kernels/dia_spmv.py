@@ -142,57 +142,60 @@ def dia_spmv_packed(planes_flat, x_padded, plan: DiaPlan, interpret: bool = Fals
     if safe_dt != planes_flat.dtype:
         planes_flat = planes_flat.astype(safe_dt)
 
-    def kernel(planes_hbm, x_hbm, y_ref, dwinA, dwinB, xwinA, xwinB, semA, semB):
+    # Each plane gets its OWN 1-D (TM,) VMEM buffer: Mosaic rejects DMA into
+    # a single row of a 2-D (8,128)-tiled scratch ("slice along dim 0 must
+    # be aligned to tiling (8)"), while 1-D destinations are unrestricted —
+    # and D separate buffers keep the stream at exactly D planes (no ceil8
+    # padding traffic, the point of the packed layout).
+    def kernel(planes_hbm, x_hbm, y_ref, *scr):
+        dwinsA, dwinsB = scr[:D], scr[D : 2 * D]
+        xwinA, xwinB, semA, semB = scr[2 * D :]
         g = pl.program_id(0)
         G_ = pl.num_programs(0)
 
-        def issue(dwin, xwin, sem, gg):
+        def copies(dwins, xwin, sem, gg):
             for k in range(D):
-                pltpu.make_async_copy(
+                yield pltpu.make_async_copy(
                     planes_hbm.at[pl.ds(k * m_pad + gg * TM, TM)],
-                    dwin.at[k],
+                    dwins[k],
                     sem.at[k],
-                ).start()
-            pltpu.make_async_copy(
+                )
+            yield pltpu.make_async_copy(
                 x_hbm.at[pl.ds(gg * TM, win)], xwin, sem.at[D]
-            ).start()
+            )
 
-        def wait(dwin, xwin, sem, gg):
-            for k in range(D):
-                pltpu.make_async_copy(
-                    planes_hbm.at[pl.ds(k * m_pad + gg * TM, TM)],
-                    dwin.at[k],
-                    sem.at[k],
-                ).wait()
-            pltpu.make_async_copy(
-                x_hbm.at[pl.ds(gg * TM, win)], xwin, sem.at[D]
-            ).wait()
+        def issue(dwins, xwin, sem, gg):
+            for c in copies(dwins, xwin, sem, gg):
+                c.start()
 
-        def step(dwin, xwin, sem, dwin_n, xwin_n, sem_n):
+        def wait(dwins, xwin, sem, gg):
+            for c in copies(dwins, xwin, sem, gg):
+                c.wait()
+
+        def step(dwins, xwin, sem, dwins_n, xwin_n, sem_n):
             @pl.when(g == 0)
             def _():
-                issue(dwin, xwin, sem, g)
+                issue(dwins, xwin, sem, g)
 
             @pl.when(g + 1 < G_)
             def _():
-                issue(dwin_n, xwin_n, sem_n, g + 1)
+                issue(dwins_n, xwin_n, sem_n, g + 1)
 
-            wait(dwin, xwin, sem, g)
+            wait(dwins, xwin, sem, g)
             acc = jnp.zeros((TM,), dtype=y_ref.dtype)
             for k, o in enumerate(plan.offsets):
                 lo = B + o
-                acc = acc + dwin[k, :].astype(acc.dtype) * xwin[lo : lo + TM]
+                acc = acc + dwins[k][:].astype(acc.dtype) * xwin[lo : lo + TM]
             y_ref[:] = acc
 
         @pl.when(g % 2 == 0)
         def _():
-            step(dwinA, xwinA, semA, dwinB, xwinB, semB)
+            step(dwinsA, xwinA, semA, dwinsB, xwinB, semB)
 
         @pl.when(g % 2 == 1)
         def _():
-            step(dwinB, xwinB, semB, dwinA, xwinA, semA)
+            step(dwinsB, xwinB, semB, dwinsA, xwinA, semA)
 
-    Dp = _round_up(D, 8)
     return pl.pallas_call(
         kernel,
         grid=(G,),
@@ -202,9 +205,8 @@ def dia_spmv_packed(planes_flat, x_padded, plan: DiaPlan, interpret: bool = Fals
         ],
         out_specs=pl.BlockSpec((TM,), lambda g: (g,), memory_space=pltpu.VMEM),
         out_shape=jax.ShapeDtypeStruct((m_pad,), out_dt),
-        scratch_shapes=[
-            pltpu.VMEM((Dp, TM), planes_flat.dtype),
-            pltpu.VMEM((Dp, TM), planes_flat.dtype),
+        scratch_shapes=[pltpu.VMEM((TM,), planes_flat.dtype)] * (2 * D)
+        + [
             pltpu.VMEM((win,), x_padded.dtype),
             pltpu.VMEM((win,), x_padded.dtype),
             pltpu.SemaphoreType.DMA((D + 1,)),
