@@ -301,6 +301,10 @@ def worker(platform_arg: str) -> None:
     else:
         import jax
 
+    from sparse_tpu.utils import enable_compilation_cache
+
+    enable_compilation_cache()  # reruns skip the 20-40 s tunnel compiles
+
     platform = jax.devices()[0].platform
     sizes = [6000, 4000, 2000, 512] if platform != "cpu" else [512]
     for n in sizes:

@@ -73,6 +73,9 @@ def parse_common_args(extra=None):
             jax.config.update("jax_platforms", "cpu")
         if args.precision == "f64":
             jax.config.update("jax_enable_x64", True)
+        from sparse_tpu.utils import enable_compilation_cache
+
+        enable_compilation_cache()  # remote-tunnel compiles are 20-40 s each
         import numpy as np
 
         import sparse_tpu as sparse

@@ -104,14 +104,16 @@ def linear_operator(fine_dim):
     return R, coarse_dim
 
 
-def max_eigenvalue(A, iters=15, seed=0):
-    """Power iteration + Rayleigh quotient (gmg.py:134)."""
+def max_eigenvalue(matvec, n, iters=15, seed=0):
+    """Power iteration + Rayleigh quotient (gmg.py:134) on a matvec
+    closure — lets callers estimate rho(D^-1 A) without materializing
+    the scaled matrix (a full SpGEMM+sort per level in the old form)."""
     rng = np.random.default_rng(seed)
-    x1 = rng.random(A.shape[1])
+    x1 = rng.random(n)
     for _ in range(iters):
-        x1 = np.asarray(A @ x1)
+        x1 = np.asarray(matvec(x1))
         x1 = x1 / np.linalg.norm(x1)
-    return float(np.dot(x1, np.asarray(A @ x1)))
+    return float(np.dot(x1, np.asarray(matvec(x1))))
 
 
 class WeightedJacobi:
@@ -121,9 +123,14 @@ class WeightedJacobi:
 
     def init_level_params(self, A, level):
         D_inv = 1.0 / np.asarray(A.diagonal())
-        # pyamg-style: omega / rho(D^-1 A)
-        Dinv_mat = sparse.diags([D_inv], [0], shape=A.shape, format="csr") if use_tpu else __import__("scipy.sparse", fromlist=["diags"]).diags([D_inv], [0], format="csr")
-        spectral_radius = max_eigenvalue(Dinv_mat @ A.tocsr())
+        # pyamg-style: omega / rho(D^-1 A); the scaled operator is applied
+        # as matvec closures (row scale after SpMV) — no materialized
+        # D^-1 A product, no per-level SpGEMM sort
+        Di = self._as_backend(D_inv, D_inv)
+        Ac = A.tocsr()
+        spectral_radius = max_eigenvalue(
+            lambda x: Di * (Ac @ x), A.shape[1]
+        )
         omega = self._init_omega / spectral_radius
         self.level_params.append((omega, D_inv))
         assert len(self.level_params) - 1 == level

@@ -19,6 +19,9 @@ import traceback
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from bench import _time_kernel  # noqa: E402
+from sparse_tpu.utils import enable_compilation_cache  # noqa: E402
+
+enable_compilation_cache()
 
 
 def emit(name, **kw):

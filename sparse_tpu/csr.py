@@ -215,7 +215,10 @@ class csr_array(SparseArray):
         rows = expand_rows(self.indptr, nnz)
         # bounded-size unique: >max_diags distinct offsets still yields
         # max_diags+1 values, which the gate below rejects
-        offs_dev = jnp.unique(self.indices.astype(jnp.int64) - rows.astype(jnp.int64),
+        # col - row fits int32 whenever both dims do (values < 2**31 each,
+        # difference in (-2**31, 2**31)); int64 here would just warn-and-
+        # truncate under the default no-x64 config
+        offs_dev = jnp.unique(self.indices.astype(jnp.int32) - rows.astype(jnp.int32),
                               size=min(settings.dia_max_diags + 1, nnz),
                               fill_value=jnp.iinfo(jnp.int32).max)
         offs = np.unique(np.asarray(offs_dev))

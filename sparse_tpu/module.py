@@ -105,11 +105,15 @@ def kron(A, B, format=None):
     out_shape = (ma * mb, na * nb)
     if A.nnz == 0 or B.nnz == 0:
         return _as_format(csr_array(out_shape), format)
-    from .ops.coords import require_x64_keys
+    from .ops.coords import require_x64_index
 
-    require_x64_keys(out_shape)  # loud error instead of silent int32 wrap
-    rows = (A.row.astype(jnp.int64)[:, None] * mb + B.row.astype(jnp.int64)[None, :]).ravel()
-    cols = (A.col.astype(jnp.int64)[:, None] * nb + B.col.astype(jnp.int64)[None, :]).ravel()
+    # per-DIMENSION escalation only: the sort/dedup machinery works on
+    # (row, col) pairs, so huge m*n products never need int64 — only an
+    # output dimension itself overflowing int32 does
+    rdt = jnp.int64 if require_x64_index(ma * mb) else jnp.int32
+    cdt = jnp.int64 if require_x64_index(na * nb) else jnp.int32
+    rows = (A.row.astype(rdt)[:, None] * jnp.asarray(mb, rdt) + B.row.astype(rdt)[None, :]).ravel()
+    cols = (A.col.astype(cdt)[:, None] * jnp.asarray(nb, cdt) + B.col.astype(cdt)[None, :]).ravel()
     vals = (A.data[:, None] * B.data[None, :]).ravel()
     out = coo_array((vals, (rows, cols)), shape=out_shape)
     if format in (None, "coo"):

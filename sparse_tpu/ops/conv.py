@@ -19,7 +19,7 @@ from ..utils import host_int
 from .coords import (
     dedup_sorted,
     expand_rows,
-    linearize,
+    lexsort_rc,
     rows_to_indptr,
     sort_coo,
 )
@@ -68,9 +68,9 @@ def coo_to_csr(rows, cols, vals, shape, sum_duplicates=True):
     COORDINATES + SORTED_COORDS_TO_COUNTS + nnz_to_pos scan. Single fused sort here.
     """
     m = int(shape[0])
-    srows, scols, svals, skeys = sort_coo(rows, cols, vals, shape, by="row")
+    srows, scols, svals = sort_coo(rows, cols, vals, shape, by="row")
     if sum_duplicates:
-        urows, ucols, uvals, _ = dedup_sorted(skeys, svals, shape)
+        urows, ucols, uvals, _ = dedup_sorted(srows, scols, svals)
     else:
         urows, ucols, uvals = srows, scols, svals
     idt = index_dtype_for(shape, uvals.shape[0])
@@ -103,10 +103,10 @@ def csr_to_csc(indptr, indices, data, shape):
     m, n = int(shape[0]), int(shape[1])
     rows = expand_rows(indptr, nnz)
     valid = jnp.arange(nnz) < indptr[-1]
-    keys = linearize(indices, rows, (n, m))
-    keys = jnp.where(valid, keys, jnp.asarray(n, keys.dtype) * m)
+    # padding entries take column n (past every real column) so they sort
+    # to the tail; primary extent n+1 keeps the fused fast path exact
     cols_for_indptr = jnp.where(valid, indices, n)
-    order = jnp.argsort(keys, stable=True)
+    order = lexsort_rc(cols_for_indptr, rows, (n + 1, m))
     idt = index_dtype_for(shape, nnz)
     col_indptr = rows_to_indptr(cols_for_indptr[order], n, dtype=idt)
     return col_indptr, rows[order].astype(idt), data[order]

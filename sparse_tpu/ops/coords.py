@@ -49,11 +49,13 @@ def rows_to_indptr(sorted_rows, m: int, dtype=None):
 
 
 def require_x64_keys(shape) -> bool:
-    """True when (row, col) keys for ``shape`` need int64.
+    """True when FUSED row*n+col keys for ``shape`` need int64.
 
-    Raises loudly when int64 is needed but x64 is disabled: jnp silently
-    truncates int64->int32 in that configuration, which would corrupt every
-    sort-based conversion for m*n > 2**31 with no error.
+    Only the distributed samplesort (``parallel.sort``) still fuses keys —
+    every single-device path sorts (row, col) pairs via :func:`lexsort_rc`
+    and never needs more than per-dimension int32. Raises loudly when int64
+    is needed but x64 is disabled: jnp silently truncates int64->int32 in
+    that configuration, which would corrupt the sort with no error.
     """
     m, n = int(shape[0]), int(shape[1])
     if m * n <= np.iinfo(np.int32).max:
@@ -66,47 +68,78 @@ def require_x64_keys(shape) -> bool:
     return True
 
 
-def linearize(rows, cols, shape):
-    """(row, col) -> single sort key. int64 when the flat index could overflow int32."""
-    n = int(shape[1])
-    if require_x64_keys(shape):
-        return rows.astype(jnp.int64) * n + cols.astype(jnp.int64)
-    return rows.astype(jnp.int32) * np.int32(n) + cols.astype(jnp.int32)
+def require_x64_index(dim: int) -> bool:
+    """True when a single coordinate dimension exceeds int32 range.
+
+    The loud-raise analog of :func:`require_x64_keys` for per-dimension
+    indices (e.g. ``kron`` output rows = ra*mb + rb): >2**31 rows/cols need
+    int64 index arrays, which need x64 enabled.
+    """
+    if int(dim) <= np.iinfo(np.int32).max:
+        return False
+    if not jax.config.jax_enable_x64:
+        raise ValueError(
+            f"dimension {dim} needs int64 indices (> 2**31); "
+            "enable them with jax.config.update('jax_enable_x64', True)"
+        )
+    return True
+
+
+def lexsort_rc(primary, secondary, shape):
+    """Stable order making (primary, secondary) lexicographically sorted.
+
+    ``shape`` = (extent of primary, extent of secondary) — static bounds on
+    the coordinate values. Fast path: one fused int32 key sort when the
+    product fits int32 (one device sort). Big shapes: two stable int32
+    argsorts (by secondary, then by primary) — the classical LSD radix
+    composition. No int64, no x64 requirement, for any shape whose
+    individual dimensions fit int32 (scipy's own practical bound).
+    """
+    p, s = int(shape[0]), int(shape[1])
+    if p * s <= np.iinfo(np.int32).max:
+        keys = primary.astype(jnp.int32) * np.int32(s) + secondary.astype(
+            jnp.int32
+        )
+        return jnp.argsort(keys, stable=True)
+    o1 = jnp.argsort(secondary.astype(jnp.int32), stable=True)
+    o2 = jnp.argsort(primary.astype(jnp.int32)[o1], stable=True)
+    return o1[o2]
 
 
 def sort_coo(rows, cols, vals, shape, by="row"):
     """Lexicographic sort of COO triples by (row, col) or (col, row).
 
     Reference: the SORT_BY_KEY task (``src/sparse/sort/*``, thrust samplesort +
-    alltoallv). Single-device TPU version: one radix/comparator sort of a fused
-    key via ``jnp.argsort`` (XLA lowers to an efficient on-device sort).
-    The distributed samplesort lives in ``sparse_tpu.parallel.sort``.
+    alltoallv). Single-device TPU version: :func:`lexsort_rc` (fused int32
+    key when it fits, two-pass stable radix composition otherwise — XLA
+    lowers both to efficient on-device sorts). The distributed samplesort
+    lives in ``sparse_tpu.parallel.sort``.
     """
     if by == "row":
-        keys = linearize(rows, cols, shape)
+        order = lexsort_rc(rows, cols, shape)
     else:
-        keys = linearize(cols, rows, (shape[1], shape[0]))
-    order = jnp.argsort(keys, stable=True)
-    return rows[order], cols[order], vals[order], keys[order]
+        order = lexsort_rc(cols, rows, (shape[1], shape[0]))
+    return rows[order], cols[order], vals[order]
 
 
-def dedup_sorted(keys, vals, shape, sum_duplicates=True):
-    """Collapse duplicate (already sorted) keys, summing values.
+def dedup_sorted(rows, cols, vals, sum_duplicates=True):
+    """Collapse duplicate (already lex-sorted) (row, col) pairs, summing values.
 
-    Returns (unique_rows, unique_cols, unique_vals, nunique). Host-syncs once for
-    the unique count (the reference equally blocks on nnz futures, csr.py:996).
+    Returns (unique_rows, unique_cols, unique_vals, nunique). Host-syncs once
+    for the unique count (the reference equally blocks on nnz futures,
+    csr.py:996). Pair comparison — no fused key, no dtype escalation.
     """
-    nnz = keys.shape[0]
+    nnz = rows.shape[0]
     if nnz == 0:
-        return keys, keys, vals, 0
+        return rows, cols, vals, 0
     is_new = jnp.concatenate(
-        [jnp.ones((1,), dtype=bool), keys[1:] != keys[:-1]]
+        [
+            jnp.ones((1,), dtype=bool),
+            (rows[1:] != rows[:-1]) | (cols[1:] != cols[:-1]),
+        ]
     )
     nunique = host_int(is_new.sum())
     if nunique == nnz:
-        n = int(shape[1])
-        rows = (keys // n).astype(jnp.int32)
-        cols = (keys % n).astype(jnp.int32)
         return rows, cols, vals, nnz
     seg = jnp.cumsum(is_new) - 1
     if sum_duplicates:
@@ -115,8 +148,29 @@ def dedup_sorted(keys, vals, shape, sum_duplicates=True):
         # keep last occurrence (scipy setdiag-style semantics)
         uvals = jnp.zeros((nunique,), dtype=vals.dtype).at[seg].set(vals)
     first_idx = jnp.nonzero(is_new, size=nunique)[0]
-    ukeys = keys[first_idx]
-    n = int(shape[1])
-    rows = (ukeys // n).astype(jnp.int32)
-    cols = (ukeys % n).astype(jnp.int32)
-    return rows, cols, uvals, nunique
+    return rows[first_idx], cols[first_idx], uvals, nunique
+
+
+def segment_searchsorted(sorted_vals, starts, ends, queries):
+    """Per-query lower_bound of ``queries[i]`` in ``sorted_vals[starts[i]:ends[i]]``.
+
+    Vectorized binary search with a fixed trip count (log2 of the longest
+    possible segment) — the building block for sorted-row intersections
+    (elementwise mult) without fused (row, col) keys. Returns the absolute
+    insertion index in ``sorted_vals`` (== ends[i] when not found past the
+    segment end).
+    """
+    nb = int(sorted_vals.shape[0])
+    if nb == 0:
+        return jnp.zeros_like(starts)
+    lo = starts
+    hi = ends
+    # an interval of length L needs floor(log2 L)+1 = L.bit_length()
+    # halvings to collapse to lo == hi; segments are at most nb long
+    for _ in range(nb.bit_length()):
+        mid = (lo + hi) // 2
+        mv = sorted_vals[jnp.clip(mid, 0, nb - 1)]
+        go_right = (mv < queries) & (mid < hi)
+        lo = jnp.where(go_right, mid + 1, lo)
+        hi = jnp.where(go_right | (mid >= hi), hi, mid)
+    return lo

@@ -14,11 +14,17 @@ import jax
 import jax.numpy as jnp
 
 from ..types import index_dtype_for
-from .coords import dedup_sorted, expand_rows, linearize, rows_to_indptr
+from .coords import (
+    dedup_sorted,
+    expand_rows,
+    lexsort_rc,
+    rows_to_indptr,
+    segment_searchsorted,
+)
 
 
 def csr_add_csr(indptr_a, indices_a, data_a, indptr_b, indices_b, data_b, shape):
-    """Union add: concatenate COO triples, fused sort, collapse duplicates."""
+    """Union add: concatenate COO triples, lex sort, collapse duplicates."""
     m = int(shape[0])
     rows_a = expand_rows(indptr_a, data_a.shape[0])
     rows_b = expand_rows(indptr_b, data_b.shape[0])
@@ -26,26 +32,39 @@ def csr_add_csr(indptr_a, indices_a, data_a, indptr_b, indices_b, data_b, shape)
     cols = jnp.concatenate([indices_a.astype(jnp.int32), indices_b.astype(jnp.int32)])
     dt = jnp.result_type(data_a.dtype, data_b.dtype)
     vals = jnp.concatenate([data_a.astype(dt), data_b.astype(dt)])
-    keys = linearize(rows, cols, shape)
-    order = jnp.argsort(keys, stable=True)
-    urows, ucols, uvals, nunique = dedup_sorted(keys[order], vals[order], shape)
+    order = lexsort_rc(rows, cols, shape)
+    urows, ucols, uvals, nunique = dedup_sorted(
+        rows[order], cols[order], vals[order]
+    )
     idt = index_dtype_for(shape, nunique)
     indptr = rows_to_indptr(urows, m, dtype=idt)
     return indptr, ucols.astype(idt), uvals
 
 
 def csr_mult_csr(indptr_a, indices_a, data_a, indptr_b, indices_b, data_b, shape):
-    """Intersection multiply: binary-search A's keys in B's sorted keys."""
+    """Intersection multiply: search each A-nnz's column inside B's own row.
+
+    Per-row bounded binary search (``segment_searchsorted``) on B's sorted
+    column ids — no fused (row, col) keys, so no index-width escalation for
+    any shape whose dimensions fit int32.
+    """
     from ..utils import host_int
 
     m = int(shape[0])
     rows_a = expand_rows(indptr_a, data_a.shape[0])
-    rows_b = expand_rows(indptr_b, data_b.shape[0])
-    keys_a = linearize(rows_a, indices_a, shape)
-    keys_b = linearize(rows_b, indices_b, shape)
-    idx = jnp.searchsorted(keys_b, keys_a)
-    idx_c = jnp.clip(idx, 0, max(keys_b.shape[0] - 1, 0))
-    match = (keys_b[idx_c] == keys_a) if keys_b.shape[0] else jnp.zeros_like(keys_a, dtype=bool)
+    nnz_b = data_b.shape[0]
+    if nnz_b == 0 or data_a.shape[0] == 0:
+        idt = index_dtype_for(shape, 0)
+        return (
+            jnp.zeros((m + 1,), dtype=idt),
+            jnp.zeros((0,), dtype=idt),
+            jnp.zeros((0,), dtype=jnp.result_type(data_a.dtype, data_b.dtype)),
+        )
+    starts = indptr_b[rows_a]
+    ends = indptr_b[rows_a + 1]
+    idx = segment_searchsorted(indices_b, starts, ends, indices_a)
+    idx_c = jnp.clip(idx, 0, nnz_b - 1)
+    match = (idx < ends) & (indices_b[idx_c] == indices_a)
     n_match = host_int(match.sum())
     take = jnp.nonzero(match, size=n_match)[0]
     dt = jnp.result_type(data_a.dtype, data_b.dtype)

@@ -34,8 +34,7 @@ from ..utils import host_int
 from .coords import (
     counts_to_indptr,
     expand_rows,
-    linearize,
-    require_x64_keys,
+    lexsort_rc,
     rows_to_indptr,
 )
 
@@ -46,18 +45,19 @@ def _next_pow2(v: int) -> int:
 
 def esc_expand_sort_compress(
     indptr_a, indices_a, data_a, indptr_b, indices_b, data_b,
-    n: int, T: int, U: int, kdt, dt, m_real: int,
+    n: int, T: int, U: int, dt, m_real: int,
 ):
     """The fully-traced ESC body shared by the single-device product and the
     shard_map tile of ``parallel.spgemm`` (one compile per bucket shape).
 
     ``T``/``U`` are static pow-2 buckets for the expansion/unique sizes;
-    padding slots carry value 0 and the sentinel key ``m_real * n``
+    padding slots carry value 0 and the sentinel pair (``m_real``, 0)
     (``m_real`` = largest REAL local row count — padded tile rows are empty,
-    so keys never reach them and the int32/int64 threshold is set by real
-    work, not by the pow-2 padded tile shape). Returns
-    (ukeys [U], uvals [U], nunique scalar); entries past nunique are
-    sentinel-keyed with value 0.
+    so real pairs never reach it). The expanded triples sort as (row, col)
+    PAIRS via :func:`lexsort_rc` — int32 indices for any dims that fit
+    int32, never a fused int64 key. Returns (urows [U], ucols [U],
+    uvals [U], nunique scalar); entries past nunique are sentinel-rowed
+    with value 0.
     """
     nnz_a = indices_a.shape[0]
     rows_a = expand_rows(indptr_a, nnz_a)
@@ -67,7 +67,6 @@ def esc_expand_sort_compress(
     counts = jnp.where(jnp.arange(nnz_a) < indptr_a[-1], counts, 0)
     offsets = counts_to_indptr(counts, dtype=jnp.int64)
     total = offsets[-1]
-    sentinel = jnp.asarray(m_real, kdt) * n
     t = jnp.arange(T, dtype=jnp.int64)
     tvalid = t < total
     src = jnp.clip(
@@ -81,25 +80,28 @@ def esc_expand_sort_compress(
     out_vals = jnp.where(
         tvalid, data_a[src].astype(dt) * data_b[p].astype(dt), 0
     )
-    keys = jnp.where(
-        tvalid,
-        rows_a[src].astype(kdt) * n + indices_b[p].astype(kdt),
-        sentinel,
+    out_rows = jnp.where(
+        tvalid, rows_a[src].astype(jnp.int32), jnp.int32(m_real)
     )
-    order = jnp.argsort(keys, stable=True)
-    skeys = keys[order]
+    out_cols = jnp.where(tvalid, indices_b[p].astype(jnp.int32), 0)
+    order = lexsort_rc(out_rows, out_cols, (m_real + 1, n))
+    srows = out_rows[order]
+    scols = out_cols[order]
     svals = out_vals[order]
-    # compress: collapse duplicate keys; sentinels are never "new" so they
+    # compress: collapse duplicate pairs; sentinels are never "new" so they
     # fold (with value 0) into the last real segment
     is_new = jnp.concatenate(
-        [jnp.ones((1,), dtype=bool), skeys[1:] != skeys[:-1]]
-    ) & (skeys < sentinel)
+        [
+            jnp.ones((1,), dtype=bool),
+            (srows[1:] != srows[:-1]) | (scols[1:] != scols[:-1]),
+        ]
+    ) & (srows < m_real)
     seg = jnp.clip(jnp.cumsum(is_new) - 1, 0, U - 1)
     uvals = jax.ops.segment_sum(svals, seg, num_segments=U)
     # fill_value T-1 is always a sentinel slot (T > total), so padded
-    # unique entries stay sentinel-keyed and are trimmed by the caller
+    # unique entries stay sentinel-rowed and are trimmed by the caller
     first_idx = jnp.nonzero(is_new, size=U, fill_value=T - 1)[0]
-    return skeys[first_idx], uvals, is_new.sum()
+    return srows[first_idx], scols[first_idx], uvals, is_new.sum()
 
 
 def spgemm_csr_csr(
@@ -143,21 +145,18 @@ def spgemm_csr_csr(
     # Bucket the expansion to the next power of two (always > total so the
     # sentinel block is nonempty).
     T = _next_pow2(total + 1)
-    kdt = jnp.int64 if require_x64_keys((int(m_real), n)) else jnp.int32
-    ukeys_all, uvals_all, nunique_dev = esc_expand_sort_compress(
+    urows_all, ucols_all, uvals_all, nunique_dev = esc_expand_sort_compress(
         indptr_a, indices_a, data_a, indptr_b, indices_b, data_b,
-        n=n, T=T, U=T, kdt=kdt, dt=dt, m_real=int(m_real),
+        n=n, T=T, U=T, dt=dt, m_real=int(m_real),
     )
     nunique = host_int(nunique_dev)
     P = _next_pow2(nunique)
-    ukeys = ukeys_all[:P]
+    urows = urows_all[:P]
     uvals = uvals_all[:P]
-    urows = (ukeys // n).astype(kdt)
-    # padded tail entries carry the sentinel key (row m_real, which may be
+    # padded tail entries carry the sentinel row (m_real, which may be
     # < m for padded tile shapes): push them past row m so indptr never
     # counts them — keeps indptr[-1] == len(data) for every caller
-    urows = jnp.where(jnp.arange(P) < nunique, urows, jnp.asarray(m, kdt))
-    ucols = (ukeys % n).astype(kdt)
+    urows = jnp.where(jnp.arange(P) < nunique, urows, jnp.int32(m))
     idt = index_dtype_for(out_shape, nunique)
     indptr = rows_to_indptr(urows, m, dtype=idt)
-    return indptr, ucols[:nunique].astype(idt), uvals[:nunique]
+    return indptr, ucols_all[:nunique].astype(idt), uvals[:nunique]

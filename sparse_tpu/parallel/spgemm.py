@@ -59,10 +59,10 @@ def _pad_block(ip, ix, dv, rows_pad: int, nnz_pad: int):
 
 
 @partial(
-    jax.jit, static_argnames=("mesh", "axis", "n", "T", "kdt", "dt", "m_real")
+    jax.jit, static_argnames=("mesh", "axis", "n", "T", "dt", "m_real")
 )
 def _esc_sharded(
-    ipA, ixA, dvA, ip_b, ix_b, dv_b, mesh, axis, n, T, kdt, dt, m_real
+    ipA, ixA, dvA, ip_b, ix_b, dv_b, mesh, axis, n, T, dt, m_real
 ):
     """All S tiles in ONE compiled shard_map program: A tiles sharded on
     the mesh, B replicated — so the grid runs concurrently and the compile
@@ -73,17 +73,17 @@ def _esc_sharded(
     from ..ops.spgemm import esc_expand_sort_compress
 
     def shard_fn(ipA_l, ixA_l, dvA_l, ip_b, ix_b, dv_b):
-        uk, uv, nu = esc_expand_sort_compress(
+        ur, uc, uv, nu = esc_expand_sort_compress(
             ipA_l.squeeze(0), ixA_l.squeeze(0), dvA_l.squeeze(0),
-            ip_b, ix_b, dv_b, n=n, T=T, U=T, kdt=kdt, dt=dt, m_real=m_real,
+            ip_b, ix_b, dv_b, n=n, T=T, U=T, dt=dt, m_real=m_real,
         )
-        return uk[None], uv[None], nu.astype(jnp.int64)[None]
+        return ur[None], uc[None], uv[None], nu.astype(jnp.int64)[None]
 
     return shard_map(
         shard_fn,
         mesh=mesh,
         in_specs=(P(axis, None), P(axis, None), P(axis, None), P(), P(), P()),
-        out_specs=(P(axis, None), P(axis, None), P(axis)),
+        out_specs=(P(axis, None), P(axis, None), P(axis, None), P(axis)),
         check_vma=False,
     )(ipA, ixA, dvA, ip_b, ix_b, dv_b)
 
@@ -141,13 +141,6 @@ def dist_spgemm(A, B, mesh=None, balanced: bool = True):
         for s in range(S)
     ]
     T = _next_pow2(max(totals) + 1)
-    # key width from REAL per-tile work, not the pow-2 padded tile shape
-    kdt = jnp.int64 if rows_real * n > np.iinfo(np.int32).max else jnp.int32
-    if kdt == jnp.int64 and not jax.config.jax_enable_x64:
-        raise ValueError(
-            f"distributed spgemm tile keys need int64 (max_tile_rows*n = "
-            f"{rows_real * n}); enable jax_enable_x64"
-        )
 
     # indices stay in their native width (int32 when the inputs fit) — the
     # replicated B index gathers dominate the tile's memory traffic
@@ -165,19 +158,20 @@ def dist_spgemm(A, B, mesh=None, balanced: bool = True):
 
     sh = NamedSharding(mesh, P(axis, None))
     rep = NamedSharding(mesh, P())
-    ukeys, uvals, nuniques = _esc_sharded(
+    urows, ucols, uvals, nuniques = _esc_sharded(
         jax.device_put(ipA, sh),
         jax.device_put(ixA, sh),
         jax.device_put(dvA, sh),
         jax.device_put(b_indptr.astype(idx_dt), rep),
         jax.device_put(np.asarray(B.indices, dtype=idx_dt), rep),
         jax.device_put(np.asarray(B.data), rep),
-        mesh=mesh, axis=axis, n=int(n), T=T, kdt=kdt, dt=jnp.dtype(dt),
+        mesh=mesh, axis=axis, n=int(n), T=T, dt=jnp.dtype(dt),
         m_real=rows_real,
     )
 
     # Host pos-scan stitch (scan_local_results_and_scale_pos analog).
-    ukeys = np.asarray(ukeys)
+    urows = np.asarray(urows)
+    ucols = np.asarray(ucols)
     uvals = np.asarray(uvals)
     nuniques = np.asarray(nuniques)
     out_indptr = np.zeros(m + 1, dtype=np.int64)
@@ -186,8 +180,8 @@ def dist_spgemm(A, B, mesh=None, balanced: bool = True):
     for s in range(S):
         r0, r1 = int(splits[s]), int(splits[s + 1])
         nu = int(nuniques[s])
-        lrows = ukeys[s, :nu] // n
-        lcols = ukeys[s, :nu] % n
+        lrows = urows[s, :nu]
+        lcols = ucols[s, :nu]
         counts = np.bincount(lrows, minlength=rows_pad)[: r1 - r0]
         out_indptr[r0 + 1 : r1 + 1] = np.cumsum(counts) + offset
         offset += nu

@@ -116,3 +116,143 @@ def test_wide_shape_requires_x64_message():
     else:
         with pytest.raises(ValueError, match="x64"):
             require_x64_keys((60000, 60000))
+
+
+# ---------------------------------------------------------------------------
+# Big-shape (m*n > 2**31) paths must work WITHOUT x64: every single-device
+# sort/dedup works on (row, col) pairs (ops.coords.lexsort_rc), so only a
+# single dimension overflowing int32 ever requires int64 indices. This is
+# what lets examples/gmg.py build 4500^2-grid hierarchies in pure int32.
+# ---------------------------------------------------------------------------
+
+BIG = 60_000  # BIG*BIG = 3.6e9 > 2**31
+
+
+def _big_coo(seed=0, nnz=200):
+    rng = np.random.default_rng(seed)
+    rows = rng.integers(0, BIG, nnz)
+    cols = rng.integers(0, BIG, nnz)
+    vals = rng.random(nnz)
+    return rows, cols, vals
+
+
+def test_big_shape_coo_tocsr_matches_scipy():
+    rows, cols, vals = _big_coo()
+    ours = sparse_tpu.coo_array((vals, (rows, cols)), shape=(BIG, BIG)).tocsr()
+    ref = sp.coo_matrix((vals, (rows, cols)), shape=(BIG, BIG)).tocsr()
+    got = ours.tocoo()
+    want = ref.tocoo()
+    want.sum_duplicates()
+    np.testing.assert_array_equal(np.asarray(got.row), want.row)
+    np.testing.assert_array_equal(np.asarray(got.col), want.col)
+    np.testing.assert_allclose(np.asarray(got.data), want.data, rtol=1e-12)
+
+
+def test_big_shape_transpose_roundtrip():
+    rows, cols, vals = _big_coo(seed=1)
+    A = sparse_tpu.coo_array((vals, (rows, cols)), shape=(BIG, BIG)).tocsr()
+    At = A.T.tocsr()  # CSR -> (zero-copy CSC) -> sort-based CSR
+    ref = sp.coo_matrix((vals, (rows, cols)), shape=(BIG, BIG)).tocsr().T.tocsr()
+    got = At.tocoo()
+    want = ref.tocoo()
+    want.sum_duplicates()
+    np.testing.assert_array_equal(np.asarray(got.row), want.row)
+    np.testing.assert_array_equal(np.asarray(got.col), want.col)
+    np.testing.assert_allclose(np.asarray(got.data), want.data, rtol=1e-12)
+
+
+def test_big_shape_add_and_mult_match_scipy():
+    ra, ca, va = _big_coo(seed=2)
+    rb, cb, vb = _big_coo(seed=3)
+    # force some structural overlap so mult has nonempty intersection
+    rb[:50], cb[:50] = ra[:50], ca[:50]
+    A = sparse_tpu.coo_array((va, (ra, ca)), shape=(BIG, BIG)).tocsr()
+    B = sparse_tpu.coo_array((vb, (rb, cb)), shape=(BIG, BIG)).tocsr()
+    As = sp.coo_matrix((va, (ra, ca)), shape=(BIG, BIG)).tocsr()
+    Bs = sp.coo_matrix((vb, (rb, cb)), shape=(BIG, BIG)).tocsr()
+    for got, want in (((A + B), (As + Bs)), ((A * B), (As.multiply(Bs)))):
+        g = got.tocoo()
+        w = sp.coo_matrix(want)
+        w.sum_duplicates()
+        np.testing.assert_array_equal(np.asarray(g.row), w.row)
+        np.testing.assert_array_equal(np.asarray(g.col), w.col)
+        np.testing.assert_allclose(np.asarray(g.data), w.data, rtol=1e-12)
+
+
+def test_big_shape_spgemm_matches_scipy():
+    ra, ca, va = _big_coo(seed=4)
+    rb, cb, vb = _big_coo(seed=5)
+    rb[:100] = ca[:100]  # make A's columns hit B's rows
+    A = sparse_tpu.coo_array((va, (ra, ca)), shape=(BIG, BIG)).tocsr()
+    B = sparse_tpu.coo_array((vb, (rb, cb)), shape=(BIG, BIG)).tocsr()
+    C = (A @ B).tocoo()
+    Cs = (
+        sp.coo_matrix((va, (ra, ca)), shape=(BIG, BIG)).tocsr()
+        @ sp.coo_matrix((vb, (rb, cb)), shape=(BIG, BIG)).tocsr()
+    ).tocoo()
+    Cs.sum_duplicates()
+    np.testing.assert_array_equal(np.asarray(C.row), Cs.row)
+    np.testing.assert_array_equal(np.asarray(C.col), Cs.col)
+    np.testing.assert_allclose(np.asarray(C.data), Cs.data, rtol=1e-10)
+
+
+def test_big_shape_diags_spmv():
+    # diags at a >2**31-key shape, then SpMV — the gmg.py WeightedJacobi path
+    d = np.arange(BIG, dtype=np.float64) + 1.0
+    D = sparse_tpu.diags([d], [0], shape=(BIG, BIG), format="csr")
+    x = np.ones(BIG)
+    y = np.asarray(D @ x)
+    np.testing.assert_allclose(y, d, rtol=1e-12)
+
+
+def test_big_shape_kron_small_factors():
+    # kron whose OUTPUT shape crosses 2**31 keys but whose dims fit int32
+    A = sp.random(300, 300, density=0.001, random_state=6, format="coo")
+    B = sp.random(200, 200, density=0.001, random_state=7, format="coo")
+    got = sparse_tpu.kron(
+        sparse_tpu.coo_array((A.data, (A.row, A.col)), shape=A.shape),
+        sparse_tpu.coo_array((B.data, (B.row, B.col)), shape=B.shape),
+        format="csr",
+    ).tocoo()
+    want = sp.kron(A, B, format="csr").tocoo()
+    want.sum_duplicates()
+    np.testing.assert_array_equal(np.asarray(got.row), want.row)
+    np.testing.assert_array_equal(np.asarray(got.col), want.col)
+    np.testing.assert_allclose(np.asarray(got.data), want.data, rtol=1e-12)
+
+
+def test_segment_searchsorted_pow2_segments():
+    # regression: the binary-search trip count was one short for power-of-
+    # two data lengths, returning lo below the true lower bound (dropped
+    # intersection entries in A.multiply(B) with 2^k-nnz operands)
+    import jax.numpy as jnp
+
+    from sparse_tpu.ops.coords import segment_searchsorted
+
+    rng = np.random.default_rng(0)
+    for nb in [1, 2, 4, 8, 16, 32, 3, 7, 33]:
+        vals = np.sort(rng.integers(0, 50, nb))
+        starts = rng.integers(0, nb + 1, 64)
+        ends = np.array([rng.integers(s, nb + 1) for s in starts])
+        qs = rng.integers(-1, 51, 64)
+        want = np.array(
+            [s + np.searchsorted(vals[s:e], q) for s, e, q in zip(starts, ends, qs)]
+        )
+        got = np.asarray(
+            segment_searchsorted(
+                jnp.asarray(vals), jnp.asarray(starts), jnp.asarray(ends), jnp.asarray(qs)
+            )
+        )
+        np.testing.assert_array_equal(got, want)
+
+
+def test_mult_two_nnz_single_row():
+    # the exact power-of-two scenario from the off-by-one: 1x2 operands
+    A = sparse_tpu.coo_array(
+        (np.array([1.0, 2.0]), (np.array([0, 0]), np.array([0, 1]))), shape=(1, 2)
+    ).tocsr()
+    B = sparse_tpu.coo_array(
+        (np.array([3.0, 4.0]), (np.array([0, 0]), np.array([0, 1]))), shape=(1, 2)
+    ).tocsr()
+    got = np.asarray((A * B).todense())
+    np.testing.assert_allclose(got, np.array([[3.0, 8.0]]))
