@@ -167,6 +167,284 @@ class SparseArray:
             return s / m
         return s / n
 
+    # ---- whole-array / axis reductions (scipy semantics: implicit zeros
+    # participate). axis reductions return DENSE 1-D arrays — a documented
+    # deviation from scipy's sparse-1-row-matrix return.
+    def max(self, axis=None, out=None):
+        """Maximum over all entries / per axis (``ops.reduce.min_or_max``)."""
+        import numpy as _np
+
+        from .ops.reduce import min_or_max
+
+        return min_or_max(self, _np.maximum, axis=axis)
+
+    def min(self, axis=None, out=None):
+        import numpy as _np
+
+        from .ops.reduce import min_or_max
+
+        return min_or_max(self, _np.minimum, axis=axis)
+
+    def nanmax(self, axis=None, out=None):
+        import numpy as _np
+
+        from .ops.reduce import min_or_max
+
+        return min_or_max(self, _np.maximum, axis=axis, nan=True)
+
+    def nanmin(self, axis=None, out=None):
+        import numpy as _np
+
+        from .ops.reduce import min_or_max
+
+        return min_or_max(self, _np.minimum, axis=axis, nan=True)
+
+    def argmax(self, axis=None, out=None):
+        """First row-major position attaining the max (implicit zeros count)."""
+        import numpy as _np
+
+        from .ops.reduce import arg_min_or_max
+
+        return arg_min_or_max(self, _np.maximum, axis=axis)
+
+    def argmin(self, axis=None, out=None):
+        import numpy as _np
+
+        from .ops.reduce import arg_min_or_max
+
+        return arg_min_or_max(self, _np.minimum, axis=axis)
+
+    def trace(self, offset=0):
+        """Sum of the ``offset`` diagonal (scipy spmatrix.trace)."""
+        return self.diagonal(k=offset).sum()
+
+    def nonzero(self):
+        """(row, col) coordinate arrays of explicitly nonzero values,
+        row-major sorted (scipy nonzero drops stored zeros)."""
+        import numpy as _np
+
+        coo = self.tocoo()
+        rows = _np.asarray(coo.row)
+        cols = _np.asarray(coo.col)
+        vals = _np.asarray(coo.data)
+        keep = vals != 0
+        rows, cols = rows[keep], cols[keep]
+        order = _np.lexsort((cols, rows))
+        return rows[order], cols[order]
+
+    def maximum(self, other):
+        """Elementwise max vs a sparse operand or non-positive scalar
+        (positive scalars would densify — scipy emits a dense matrix there;
+        we raise instead, documented deviation)."""
+        return self._minmax_binary(other, is_max=True)
+
+    def minimum(self, other):
+        return self._minmax_binary(other, is_max=False)
+
+    def _minmax_binary(self, other, is_max: bool):
+        import numpy as _np
+
+        from .ops.elementwise import csr_minmax_csr
+
+        opname = "maximum" if is_max else "minimum"
+        if _np.isscalar(other):
+            bad = other > 0 if is_max else other < 0
+            if bad:
+                raise NotImplementedError(
+                    f"{opname} with a {'positive' if is_max else 'negative'} "
+                    "scalar produces a dense result; densify explicitly"
+                )
+            op = jnp.maximum if is_max else jnp.minimum
+            A = self.tocsr()
+            return A._with_data(op(A.data, jnp.asarray(other, A.data.dtype)))
+        if not isinstance(other, SparseArray):
+            raise TypeError(f"{opname} expects a sparse operand or scalar")
+        if self.shape != other.shape:
+            raise ValueError(
+                f"inconsistent shapes: {self.shape} vs {other.shape}"
+            )
+        A, B = self.tocsr(), other.tocsr()
+        from .csr import csr_array
+
+        op = jnp.maximum if is_max else jnp.minimum
+        indptr, indices, data = csr_minmax_csr(
+            A.indptr, A.indices, A.data, B.indptr, B.indices, B.data,
+            self.shape, op,
+        )
+        return csr_array.from_parts(data, indices, indptr, self.shape)
+
+    # ---- canonicalization (our arrays are built canonical: sorted unique
+    # indices, no structural gaps) ----------------------------------------
+    has_sorted_indices = True
+    has_canonical_format = True
+
+    def sum_duplicates(self):
+        """No-op for CSR/CSC (always canonical); COO overrides."""
+
+    def sort_indices(self):
+        """No-op: construction sorts indices (scipy csr.sort_indices)."""
+
+    def sorted_indices(self):
+        return self.copy()
+
+    def prune(self):
+        """No-op: index/data buffers are always exactly nnz-sized."""
+
+    def setdiag(self, values, k=0):
+        """Set the ``k``-th diagonal IN PLACE (scipy setdiag): scalar
+        broadcast or per-slot array (extra entries ignored, short arrays
+        set a prefix). Explicit zeros are stored, as in scipy."""
+        import numpy as _np
+
+        m, n = self.shape
+        dlen = min(m + min(k, 0), n - max(k, 0))
+        if dlen <= 0:
+            raise ValueError("k exceeds matrix dimensions")
+        vals = _np.asarray(values)
+        if vals.ndim == 0:
+            vals = _np.full(dlen, vals)
+        else:
+            vals = vals[:dlen]
+            dlen = vals.shape[0]
+        i = _np.arange(dlen) + max(-k, 0)
+        j = _np.arange(dlen) + max(k, 0)
+        coo = self.tocoo()
+        rows = _np.concatenate([_np.asarray(coo.row), i])
+        cols = _np.concatenate([_np.asarray(coo.col), j])
+        data = _np.concatenate(
+            [_np.asarray(coo.data), vals.astype(self.dtype, copy=False)]
+        )
+        from .ops.coords import dedup_sorted, sort_coo
+
+        # stable sort + keep-LAST dedup: the appended diagonal wins
+        order = _np.lexsort((cols, rows))  # host: stable, no x64 gating
+        srows, scols, sdata = rows[order], cols[order], data[order]
+        from .coo import coo_array
+
+        tmp = coo_array((sdata, (srows, scols)), shape=self.shape)
+        urows, ucols, uvals, _ = dedup_sorted(
+            tmp.row, tmp.col, tmp.data, sum_duplicates=False
+        )
+        rebuilt = coo_array((uvals, (urows, ucols)), shape=self.shape)
+        rebuilt.has_sorted_indices = True
+        rebuilt.has_canonical_format = True
+        if self.format != "coo":
+            rebuilt = rebuilt.asformat(self.format)
+        self.__dict__.clear()  # drop stale lazy caches (_ell_width_cache, ...)
+        self.__dict__.update(rebuilt.__dict__)
+
+    def reshape(self, *shape, order="C"):
+        """Reshape to another 2-D shape (same total size). Host-side flat
+        index arithmetic (int64 numpy), scipy coo.reshape semantics."""
+        import numpy as _np
+
+        if len(shape) == 1 and isinstance(shape[0], (tuple, list)):
+            shape = tuple(shape[0])
+        if len(shape) != 2:
+            raise ValueError("sparse arrays are 2-D; reshape takes (m, n)")
+        m, n = self.shape
+        m2, n2 = int(shape[0]), int(shape[1])
+        if m2 * n2 != m * n:
+            raise ValueError(
+                f"cannot reshape array of size {m * n} into shape {shape}"
+            )
+        coo = self.tocoo()
+        rows = _np.asarray(coo.row, dtype=_np.int64)
+        cols = _np.asarray(coo.col, dtype=_np.int64)
+        flat = rows * n + cols if order == "C" else cols * m + rows
+        if order == "C":
+            r2, c2 = flat // n2, flat % n2
+        else:
+            r2, c2 = flat % m2, flat // m2
+        from .coo import coo_array
+
+        out = coo_array(
+            (_np.asarray(coo.data), (r2, c2)), shape=(m2, n2)
+        )
+        return out.asformat(self.format)
+
+    def resize(self, *shape):
+        """Change shape IN PLACE, dropping out-of-range entries (scipy)."""
+        import numpy as _np
+
+        if len(shape) == 1 and isinstance(shape[0], (tuple, list)):
+            shape = tuple(shape[0])
+        m2, n2 = int(shape[0]), int(shape[1])
+        coo = self.tocoo()
+        rows = _np.asarray(coo.row)
+        cols = _np.asarray(coo.col)
+        data = _np.asarray(coo.data)
+        keep = (rows < m2) & (cols < n2)
+        from .coo import coo_array
+
+        rebuilt = coo_array(
+            (data[keep], (rows[keep], cols[keep])), shape=(m2, n2)
+        )
+        if self.format != "coo":
+            rebuilt = rebuilt.asformat(self.format)
+        self.__dict__.clear()  # drop stale lazy caches (_ell_width_cache, ...)
+        self.__dict__.update(rebuilt.__dict__)
+        self._shape = (m2, n2)
+
+    def check_format(self, full_check: bool = True):
+        """Validate the stored-format invariants (scipy check_format):
+        indptr length/monotonicity, index bounds, sorted in-row indices.
+        Applies to compressed formats; others pass trivially."""
+        import numpy as _np
+
+        indptr = getattr(self, "indptr", None)
+        if indptr is None:
+            return
+        indptr = _np.asarray(indptr)
+        major = (
+            self.shape[0] if self.format == "csr" else self.shape[1]
+        )
+        minor = (
+            self.shape[1] if self.format == "csr" else self.shape[0]
+        )
+        if indptr.shape[0] != major + 1:
+            raise ValueError(
+                f"index pointer size {indptr.shape[0]} != {major + 1}"
+            )
+        if indptr[0] != 0:
+            raise ValueError("index pointer should start with 0")
+        if (_np.diff(indptr) < 0).any():
+            raise ValueError("index pointer values must not decrease")
+        indices = _np.asarray(self.indices)
+        if indptr[-1] > indices.shape[0]:
+            raise ValueError("Last value of index pointer exceeds nnz")
+        if full_check and indices.size:
+            if indices.min() < 0 or indices.max() >= minor:
+                raise ValueError(
+                    f"indices out of bounds for axis of size {minor}"
+                )
+            rows = _np.repeat(_np.arange(major), _np.diff(indptr))
+            within = _np.diff(indices) >= 0
+            same_row = rows[1:] == rows[:-1] if rows.size else _np.array([], bool)
+            if (same_row & ~within[: same_row.shape[0]]).any():
+                raise ValueError("indices must be sorted within each row")
+
+    def eliminate_zeros(self):
+        """Drop explicitly stored zeros IN PLACE (scipy semantics)."""
+        import numpy as _np
+
+        coo = self.tocoo()
+        vals = _np.asarray(coo.data)
+        if not (vals == 0).any():
+            return
+        keep = vals != 0
+        from .coo import coo_array
+
+        rebuilt = coo_array(
+            (
+                vals[keep],
+                (_np.asarray(coo.row)[keep], _np.asarray(coo.col)[keep]),
+            ),
+            shape=self.shape,
+        ).asformat(self.format)
+        self.__dict__.clear()  # drop stale lazy caches (_ell_width_cache, ...)
+        self.__dict__.update(rebuilt.__dict__)
+
 
 def _resolve_shape(shape, rows, cols):
     if shape is not None:

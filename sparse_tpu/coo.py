@@ -87,6 +87,23 @@ class coo_array(SparseArray):
     def tocoo(self):
         return self
 
+    # raw COO may hold unsorted/duplicate triples until converted
+    has_sorted_indices = False
+    has_canonical_format = False
+
+    def sum_duplicates(self):
+        """Canonicalize IN PLACE: lex-sort triples, sum duplicate (row, col)
+        pairs (scipy coo.sum_duplicates)."""
+        from .ops.coords import dedup_sorted, sort_coo
+
+        srows, scols, svals = sort_coo(
+            self.row, self.col, self.data, self.shape, by="row"
+        )
+        urows, ucols, uvals, _ = dedup_sorted(srows, scols, svals)
+        self.row, self.col, self.data = urows, ucols, uvals
+        self.has_sorted_indices = True
+        self.has_canonical_format = True
+
     def tocsr(self):
         from .csr import csr_array
 

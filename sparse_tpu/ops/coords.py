@@ -101,8 +101,13 @@ def lexsort_rc(primary, secondary, shape):
             jnp.int32
         )
         return jnp.argsort(keys, stable=True)
-    o1 = jnp.argsort(secondary.astype(jnp.int32), stable=True)
-    o2 = jnp.argsort(primary.astype(jnp.int32)[o1], stable=True)
+    # a DIMENSION beyond int32 (kron of huge factors under x64) must keep
+    # int64 coordinates — downcasting would wrap negative and mis-sort
+    idt = (
+        jnp.int64 if max(p, s) > np.iinfo(np.int32).max else jnp.int32
+    )
+    o1 = jnp.argsort(secondary.astype(idt), stable=True)
+    o2 = jnp.argsort(primary.astype(idt)[o1], stable=True)
     return o1[o2]
 
 
@@ -142,12 +147,16 @@ def dedup_sorted(rows, cols, vals, sum_duplicates=True):
     if nunique == nnz:
         return rows, cols, vals, nnz
     seg = jnp.cumsum(is_new) - 1
+    first_idx = jnp.nonzero(is_new, size=nunique)[0]
     if sum_duplicates:
         uvals = jax.ops.segment_sum(vals, seg, num_segments=nunique)
     else:
-        # keep last occurrence (scipy setdiag-style semantics)
-        uvals = jnp.zeros((nunique,), dtype=vals.dtype).at[seg].set(vals)
-    first_idx = jnp.nonzero(is_new, size=nunique)[0]
+        # keep last occurrence (scipy setdiag-style semantics) — pick each
+        # group's last index explicitly; .at[seg].set with duplicate
+        # indices has implementation-defined write order in JAX
+        last = jnp.concatenate([is_new[1:], jnp.ones((1,), dtype=bool)])
+        last_idx = jnp.nonzero(last, size=nunique)[0]
+        uvals = vals[last_idx]
     return rows[first_idx], cols[first_idx], uvals, nunique
 
 

@@ -110,3 +110,58 @@ def csr_sum(indptr, indices, data, shape, axis=None):
             data, rows, num_segments=m, indices_are_sorted=True
         )
     raise ValueError(f"invalid axis {axis}")
+
+
+def csr_minmax_csr(
+    indptr_a, indices_a, data_a, indptr_b, indices_b, data_b, shape, op
+):
+    """Elementwise maximum/minimum of two CSRs (scipy's binopt analog).
+
+    ``op`` is jnp.maximum or jnp.minimum. Union merge like add; positions
+    stored in only ONE operand compare against the other's implicit zero
+    (max(v, 0) / min(v, 0)), positions in both take op(a, b). Explicit
+    zeros in the result are dropped (canonical output).
+    """
+    import jax
+
+    from ..utils import host_int
+
+    m = int(shape[0])
+    rows_a = expand_rows(indptr_a, data_a.shape[0])
+    rows_b = expand_rows(indptr_b, data_b.shape[0])
+    rows = jnp.concatenate([rows_a.astype(jnp.int32), rows_b.astype(jnp.int32)])
+    cols = jnp.concatenate([indices_a.astype(jnp.int32), indices_b.astype(jnp.int32)])
+    dt = jnp.result_type(data_a.dtype, data_b.dtype)
+    vals = jnp.concatenate([data_a.astype(dt), data_b.astype(dt)])
+    order = lexsort_rc(rows, cols, shape)
+    srows, scols, svals = rows[order], cols[order], vals[order]
+    nnz = srows.shape[0]
+    if nnz == 0:
+        idt = index_dtype_for(shape, 0)
+        return (
+            jnp.zeros((m + 1,), dtype=idt),
+            jnp.zeros((0,), dtype=idt),
+            jnp.zeros((0,), dtype=dt),
+        )
+    is_new = jnp.concatenate(
+        [
+            jnp.ones((1,), dtype=bool),
+            (srows[1:] != srows[:-1]) | (scols[1:] != scols[:-1]),
+        ]
+    )
+    nunique = host_int(is_new.sum())
+    seg = jnp.cumsum(is_new) - 1
+    segop = jax.ops.segment_max if op is jnp.maximum else jax.ops.segment_min
+    uvals = segop(svals, seg, num_segments=nunique)
+    counts = jax.ops.segment_sum(jnp.ones_like(svals, dtype=jnp.int32), seg, num_segments=nunique)
+    # singly-present entries compare against the other operand's implicit 0
+    uvals = jnp.where(counts == 1, op(uvals, jnp.zeros((), dt)), uvals)
+    first_idx = jnp.nonzero(is_new, size=nunique)[0]
+    urows, ucols = srows[first_idx], scols[first_idx]
+    # canonical output: drop exact zeros
+    keep = uvals != 0
+    nkeep = host_int(keep.sum())
+    sel = jnp.nonzero(keep, size=nkeep)[0]
+    idt = index_dtype_for(shape, nkeep)
+    indptr = rows_to_indptr(urows[sel], m, dtype=idt)
+    return indptr, ucols[sel].astype(idt), uvals[sel]

@@ -1,0 +1,170 @@
+"""Whole-array and axis reductions with scipy.sparse semantics.
+
+Implicit zeros participate: ``A.max()`` of a matrix whose stored values are
+all negative is 0 whenever any position is unstored (scipy `_data.py`
+`_min_or_max`), and ``argmax`` resolves to scipy's two-step rule (stored
+extreme by numpy argmax — NaN wins — then the first zero position when
+implicit zeros exist and the extreme is not strictly positive/negative).
+Reference analog: the reference inherits these from scipy's surface and
+implements none as tasks — host O(nnz) passes are the honest cost model,
+and host numpy always has int64 for the flat-index arithmetic.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def _coo_parts(A):
+    coo = A.tocoo()
+    return (
+        np.asarray(coo.row),
+        np.asarray(coo.col),
+        np.asarray(coo.data),
+    )
+
+
+def min_or_max(A, op, axis=None, nan: bool = False):
+    """``op`` is np.maximum or np.minimum. axis None -> scalar;
+    axis 0/1 -> dense 1-D ndarray (deviation: scipy returns a sparse
+    1-row matrix; documented in the method docstrings).
+
+    ``nan=True`` ignores stored NaNs for the reduction, but they still
+    count as STORED positions (a fully-stored line with NaNs has no
+    implicit zero to clamp with — scipy nanmax([[-5, nan]]) == -5).
+    """
+    m, n = A.shape
+    if m * n == 0:
+        raise ValueError("zero-size array to reduction operation")
+    rows, cols, vals = _coo_parts(A)
+    dt = vals.dtype
+    isnan = np.isnan(vals) if np.issubdtype(dt, np.floating) else np.zeros(vals.shape, bool)
+    red_vals = vals[~isnan] if nan else vals
+    if axis is None:
+        has_implicit = vals.size < m * n
+        if red_vals.size == 0:
+            return dt.type(0) if has_implicit else dt.type(np.nan)
+        stored = op.reduce(red_vals)
+        if has_implicit:
+            stored = op(stored, dt.type(0))
+        return dt.type(stored)
+    if axis not in (0, 1):
+        raise ValueError(f"invalid axis {axis}")
+    ids = rows if axis == 1 else cols
+    length = m if axis == 1 else n
+    other = n if axis == 1 else m
+    # stored-position counts use PRE-NaN-drop ids; the value reduction
+    # uses the post-drop set
+    counts_stored = np.bincount(ids, minlength=length)
+    red_ids = ids[~isnan] if nan else ids
+    counts_red = np.bincount(red_ids, minlength=length)
+    fill = -np.inf if op is np.maximum else np.inf
+    seg = np.full(length, fill)
+    if red_vals.size:
+        op.at(seg, red_ids, red_vals)
+    has_implicit = counts_stored < other
+    out = np.where(counts_red > 0, seg, np.where(has_implicit, 0.0, np.nan))
+    out = np.where(has_implicit, op(out, 0.0), out)
+    return out.astype(dt)
+
+
+def arg_min_or_max(A, op, axis=None):
+    """np.argmax/np.argmin analog, scipy's exact two-step rule per line:
+
+    1. extreme over STORED values by numpy argmax/argmin (NaN wins both;
+       first occurrence among ties, row-major);
+    2. when the line has implicit zeros and the stored extreme is not
+       strictly positive (argmax) / strictly negative (argmin) — NaN
+       counts as "not" — the answer is the FIRST ZERO position: the
+       earlier of the first stored zero and the first unstored slot.
+    Lines with no stored entries resolve to 0.
+    """
+    m, n = A.shape
+    if m * n == 0:
+        raise ValueError("cannot compute argmax/argmin of an empty matrix")
+    rows, cols, vals = _coo_parts(A)
+    is_max = op is np.maximum
+    if axis is None:
+        flats = rows.astype(np.int64) * n + cols.astype(np.int64)
+        has_implicit = vals.size < m * n
+        if vals.size == 0:
+            return 0
+        isnan = np.isnan(vals) if np.issubdtype(vals.dtype, np.floating) else np.zeros(vals.shape, bool)
+        if isnan.any():
+            v = np.nan
+            p = int(flats[isnan].min())
+        else:
+            v = op.reduce(vals)
+            p = int(flats[vals == v].min())
+        positive = v > 0 if is_max else v < 0  # False for NaN
+        if has_implicit and not positive:
+            cands = [_first_missing_flat(flats, m * n)]
+            z = vals == 0
+            if z.any():
+                cands.append(int(flats[z].min()))
+            return min(cands)
+        return p
+    if axis not in (0, 1):
+        raise ValueError(f"invalid axis {axis}")
+    if axis == 0:  # reduce over rows: transpose the coordinate roles
+        rows, cols = cols, rows
+        length, other = n, m
+    else:
+        length, other = m, n
+    out = np.zeros(length, dtype=np.int64)
+    counts = np.bincount(rows, minlength=length) if vals.size else np.zeros(length, dtype=np.int64)
+    stored_val = np.full(length, np.nan)
+    stored_arg = np.zeros(length, dtype=np.int64)
+    if vals.size:
+        # order (line, key, -col): the last entry of each line block is the
+        # extreme with the SMALLEST col among ties; NaN keyed above all
+        # (numpy argmax/argmin both resolve to the first NaN)
+        isnan = np.isnan(vals) if np.issubdtype(vals.dtype, np.floating) else np.zeros(vals.shape, bool)
+        key_val = np.where(isnan, np.inf, vals if is_max else -vals)
+        order = np.lexsort((-cols, key_val, rows))
+        r_s, c_s, v_s = rows[order], cols[order], vals[order]
+        last = np.concatenate([r_s[1:] != r_s[:-1], [True]])
+        stored_arg[r_s[last]] = c_s[last]
+        stored_val[r_s[last]] = v_s[last]
+    out[counts > 0] = stored_arg[counts > 0]
+    positive = stored_val > 0 if is_max else stored_val < 0  # False for NaN/empty
+    need_zero = (counts < other) & ~positive
+    if need_zero.any():
+        first_missing = _first_missing_per_line(rows, cols, length, other)
+        zero_col = np.full(length, np.iinfo(np.int64).max)
+        if vals.size:
+            z = vals == 0
+            if z.any():
+                np.minimum.at(zero_col, rows[z], cols[z])
+        cand = np.minimum(first_missing, zero_col)
+        out[need_zero] = cand[need_zero]
+    return out
+
+
+def _first_missing_flat(flats, full: int) -> int:
+    """Smallest flat index in [0, full) absent from ``flats``."""
+    s = np.unique(flats)  # sorted, deduped
+    k = min(s.size, full)
+    head = np.nonzero(s[:k] != np.arange(k, dtype=np.int64))[0]
+    # a perfect stored prefix 0..k-1 leaves k as the first gap (< full,
+    # guaranteed by the caller's vals.size < m*n check)
+    return int(head[0]) if head.size else int(k)
+
+
+def _first_missing_per_line(rows, cols, length: int, other: int):
+    """For each line id in [0, length): the smallest column not stored.
+    Lines storing a full prefix 0..k-1 get k (== ``other`` when full)."""
+    if rows.size == 0:
+        return np.zeros(length, dtype=np.int64)
+    order = np.lexsort((cols, rows))
+    r_s, c_s = rows[order], cols[order]
+    starts = np.searchsorted(r_s, np.arange(length))
+    pos_in_line = np.arange(r_s.size, dtype=np.int64) - starts[r_s]
+    in_prefix = c_s == pos_in_line
+    bad = ~in_prefix
+    first_bad = np.full(length, np.iinfo(np.int64).max)
+    if bad.any():
+        np.minimum.at(first_bad, r_s[bad], pos_in_line[bad])
+    counts = np.bincount(r_s, minlength=length)
+    prefix_len = np.minimum(first_bad, counts)
+    return np.minimum(prefix_len, other)

@@ -1,0 +1,227 @@
+"""Whole-array/axis reductions + canonicalization surface vs scipy.
+
+Mirrors scipy's `_minmax_mixin` semantics (implicit zeros participate in
+max/min/argmax/argmin; first occurrence wins ties) — the reference inherits
+this surface from scipy via its coverage layer (coverage.py:226-276).
+"""
+
+import numpy as np
+import pytest
+import scipy.sparse as sp
+
+import sparse_tpu
+
+
+def _pair(m, n, density, seed, fmt="csr"):
+    As = sp.random(m, n, density=density, random_state=seed, format="csr")
+    As.data = np.round(As.data * 10 - 5)  # negatives + explicit zeros
+    A = sparse_tpu.csr_array.from_parts(
+        As.data.copy(), As.indices.copy(), As.indptr.copy(), (m, n)
+    )
+    return A.asformat(fmt), As
+
+
+CASES = [(1, 1, 0.0, 0), (3, 5, 0.2, 1), (7, 4, 0.5, 2), (6, 6, 0.9, 3),
+         (8, 3, 1.0, 4), (2, 9, 0.1, 5)]
+
+
+@pytest.mark.parametrize("m,n,density,seed", CASES)
+@pytest.mark.parametrize("name", ["max", "min"])
+@pytest.mark.parametrize("axis", [None, 0, 1])
+def test_min_max(m, n, density, seed, name, axis):
+    A, As = _pair(m, n, density, seed)
+    want = getattr(As, name)(axis=axis)
+    got = getattr(A, name)(axis=axis)
+    if axis is None:
+        assert np.isclose(got, want)
+    else:
+        w = want.toarray().ravel() if sp.issparse(want) else np.asarray(want).ravel()
+        np.testing.assert_allclose(np.asarray(got).ravel(), w)
+
+
+@pytest.mark.parametrize("m,n,density,seed", CASES)
+@pytest.mark.parametrize("name", ["argmax", "argmin"])
+@pytest.mark.parametrize("axis", [None, 0, 1])
+def test_argmin_argmax(m, n, density, seed, name, axis):
+    A, As = _pair(m, n, density, seed)
+    want = np.asarray(getattr(As, name)(axis=axis)).ravel()
+    got = np.asarray(getattr(A, name)(axis=axis)).ravel()
+    np.testing.assert_array_equal(got, want)
+
+
+def test_nan_variants():
+    data = np.array([[np.nan, -2.0, 0.0], [0.0, 5.0, np.nan]])
+    As = sp.csr_matrix(data)
+    A = sparse_tpu.csr_array.from_parts(
+        As.data.copy(), As.indices.copy(), As.indptr.copy(), As.shape
+    )
+    assert np.isclose(A.nanmax(), np.nanmax(data))
+    assert np.isclose(A.nanmin(), np.nanmin(data))
+    np.testing.assert_allclose(np.asarray(A.nanmax(axis=1)), np.nanmax(data, axis=1))
+    np.testing.assert_allclose(np.asarray(A.nanmin(axis=0)), np.nanmin(data, axis=0))
+
+
+def _from_scipy(As):
+    return sparse_tpu.csr_array.from_parts(
+        As.data.copy(), As.indices.copy(), As.indptr.copy(), As.shape
+    )
+
+
+def _nan_cases():
+    # stored NaNs with/without implicit zeros — the cases where stored-vs-
+    # implicit bookkeeping diverges (review r2 findings)
+    yield sp.csr_matrix(np.array([[-5.0, np.nan]]))  # fully stored
+    yield sp.csr_matrix(
+        (np.array([-5.0, np.nan]), np.array([0, 1]), np.array([0, 2])),
+        shape=(1, 3),
+    )  # + implicit
+    yield sp.csr_matrix(np.array([[np.nan, np.nan]]))  # all-NaN full
+    yield sp.csr_matrix(
+        (np.array([np.nan]), np.array([0]), np.array([0, 1])), shape=(1, 2)
+    )  # all-NaN + implicit
+    yield sp.csr_matrix(
+        (np.array([0.0, -3.0]), np.array([0, 1]), np.array([0, 2])),
+        shape=(1, 3),
+    )  # explicit zero before implicit
+    yield sp.csr_matrix(
+        (np.array([-3.0, 0.0]), np.array([1, 2]), np.array([0, 2])),
+        shape=(1, 3),
+    )  # implicit zero before explicit
+
+
+@pytest.mark.parametrize("case", range(6))
+def test_nan_and_zero_edge_semantics(case):
+    import warnings as _w
+
+    As = list(_nan_cases())[case]
+    A = _from_scipy(As)
+    with _w.catch_warnings():
+        _w.simplefilter("ignore")  # scipy warns on all-NaN slices
+        for name in ["nanmax", "nanmin", "argmax", "argmin", "max", "min"]:
+            want = getattr(As, name)()
+            got = getattr(A, name)()
+            np.testing.assert_equal(float(got), float(want), err_msg=name)
+        for name in ["nanmax", "argmax", "argmin"]:
+            for ax in (0, 1):
+                want = getattr(As, name)(axis=ax)
+                w = (
+                    want.toarray().ravel()
+                    if sp.issparse(want)
+                    else np.asarray(want).ravel()
+                )
+                got = np.asarray(getattr(A, name)(axis=ax)).ravel()
+                np.testing.assert_equal(
+                    got.astype(float), w.astype(float),
+                    err_msg=f"{name} axis={ax}",
+                )
+
+
+@pytest.mark.parametrize("offset", [-2, -1, 0, 1, 3])
+def test_trace(offset):
+    A, As = _pair(6, 7, 0.5, 11)
+    assert np.isclose(A.trace(offset=offset), As.toarray().trace(offset=offset))
+
+
+@pytest.mark.parametrize("fmt", ["csr", "csc", "coo"])
+def test_nonzero(fmt):
+    A, As = _pair(5, 6, 0.4, 12, fmt=fmt)
+    gr, gc = A.nonzero()
+    wr, wc = As.nonzero()
+    np.testing.assert_array_equal(gr, wr)
+    np.testing.assert_array_equal(gc, wc)
+
+
+@pytest.mark.parametrize("m,n,density,seed", CASES[1:4])
+def test_maximum_minimum_sparse(m, n, density, seed):
+    A, As = _pair(m, n, density, seed)
+    B, Bs = _pair(m, n, 0.3, seed + 100)
+    np.testing.assert_allclose(
+        np.asarray(A.maximum(B).todense()), As.maximum(Bs).toarray()
+    )
+    np.testing.assert_allclose(
+        np.asarray(A.minimum(B).todense()), As.minimum(Bs).toarray()
+    )
+
+
+def test_maximum_minimum_scalar():
+    A, As = _pair(4, 4, 0.5, 20)
+    np.testing.assert_allclose(
+        np.asarray(A.maximum(-2.0).todense()), As.maximum(-2.0).toarray()
+    )
+    np.testing.assert_allclose(
+        np.asarray(A.minimum(3.0).todense()), As.minimum(3.0).toarray()
+    )
+    with pytest.raises(NotImplementedError):
+        A.maximum(1.0)  # densifying case: loud, not silent
+
+
+def test_sum_duplicates_coo_inplace():
+    r = np.array([2, 0, 2, 0]); c = np.array([1, 3, 1, 3])
+    v = np.array([1.0, 2.0, 4.0, 8.0])
+    A = sparse_tpu.coo_array((v, (r, c)), shape=(3, 4))
+    assert not A.has_canonical_format
+    A.sum_duplicates()
+    assert A.has_canonical_format and A.nnz == 2
+    np.testing.assert_array_equal(np.asarray(A.row), [0, 2])
+    np.testing.assert_array_equal(np.asarray(A.col), [3, 1])
+    np.testing.assert_allclose(np.asarray(A.data), [10.0, 5.0])
+
+
+def test_eliminate_zeros_inplace():
+    A, As = _pair(5, 5, 0.8, 30)
+    As.eliminate_zeros()
+    A.eliminate_zeros()
+    assert A.nnz == As.nnz
+    np.testing.assert_allclose(np.asarray(A.todense()), As.toarray())
+
+
+def test_check_format():
+    A, _ = _pair(4, 5, 0.5, 40)
+    A.check_format()  # canonical arrays pass
+    bad = sparse_tpu.csr_array.from_parts(
+        np.ones(2), np.array([4, 1]), np.array([0, 2, 2, 2, 2]), (4, 5)
+    )
+    with pytest.raises(ValueError):
+        bad.check_format()
+
+
+def test_canonicalization_noops():
+    A, _ = _pair(4, 5, 0.5, 41)
+    assert A.has_sorted_indices and A.has_canonical_format
+    A.sort_indices(); A.prune(); A.sum_duplicates()  # all no-ops, no error
+    B = A.sorted_indices()
+    np.testing.assert_allclose(np.asarray(B.todense()), np.asarray(A.todense()))
+
+
+@pytest.mark.parametrize("k", [-2, 0, 1])
+@pytest.mark.parametrize("fmt", ["csr", "csc", "coo"])
+def test_setdiag(k, fmt):
+    A, As = _pair(5, 6, 0.4, 50, fmt=fmt)
+    As = As.tolil()  # scipy warns on csr setdiag; lil is its canonical path
+    A.setdiag(7.5, k=k)
+    As.setdiag(7.5, k=k)
+    np.testing.assert_allclose(np.asarray(A.todense()), As.toarray())
+    vals = np.arange(3, dtype=float) + 1
+    A.setdiag(vals, k=k)
+    As.setdiag(vals, k=k)
+    np.testing.assert_allclose(np.asarray(A.todense()), As.toarray())
+    assert A.format == fmt
+
+
+@pytest.mark.parametrize("order", ["C", "F"])
+def test_reshape(order):
+    A, As = _pair(6, 4, 0.5, 60)
+    got = A.reshape((8, 3), order=order)
+    want = As.reshape((8, 3), order=order)
+    np.testing.assert_allclose(np.asarray(got.todense()), want.toarray())
+    assert got.format == "csr"
+
+
+def test_resize():
+    A, As = _pair(6, 6, 0.5, 70)
+    dense = As.toarray()
+    A.resize((4, 9))
+    np.testing.assert_allclose(
+        np.asarray(A.todense()), np.pad(dense[:4, :], ((0, 0), (0, 3)))
+    )
+    assert A.shape == (4, 9)
