@@ -25,6 +25,7 @@ from .module import (  # noqa: F401
     bmat,
     diags,
     diags_array,
+    expand_dims,
     eye,
     eye_array,
     find,
@@ -43,9 +44,12 @@ from .module import (  # noqa: F401
     load_npz,
     rand,
     random,
+    permute_dims,
     random_array,
+    safely_cast_index_arrays,
     save_npz,
     spdiags,
+    swapaxes,
     tril,
     triu,
     vstack,

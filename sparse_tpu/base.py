@@ -218,12 +218,20 @@ class SparseArray:
         """Sum of the ``offset`` diagonal (scipy spmatrix.trace)."""
         return self.diagonal(k=offset).sum()
 
+    def _canonical_coo(self):
+        """COO view with duplicates summed (raw coo_array may hold them)."""
+        coo = self.tocoo()
+        if not getattr(coo, "has_canonical_format", True):
+            coo = coo.copy()
+            coo.sum_duplicates()
+        return coo
+
     def nonzero(self):
         """(row, col) coordinate arrays of explicitly nonzero values,
         row-major sorted (scipy nonzero drops stored zeros)."""
         import numpy as _np
 
-        coo = self.tocoo()
+        coo = self._canonical_coo()
         rows = _np.asarray(coo.row)
         cols = _np.asarray(coo.col)
         vals = _np.asarray(coo.data)
@@ -248,10 +256,13 @@ class SparseArray:
 
         opname = "maximum" if is_max else "minimum"
         if _np.isscalar(other):
-            bad = other > 0 if is_max else other < 0
+            # `not (<= 0)` (rather than `> 0`) also catches NaN, whose
+            # result at every implicit-zero position would be NaN => dense
+            bad = not (other <= 0) if is_max else not (other >= 0)
             if bad:
                 raise NotImplementedError(
-                    f"{opname} with a {'positive' if is_max else 'negative'} "
+                    f"{opname} with a "
+                    f"{'positive/NaN' if is_max else 'negative/NaN'} "
                     "scalar produces a dense result; densify explicitly"
                 )
             op = jnp.maximum if is_max else jnp.minimum
@@ -308,7 +319,7 @@ class SparseArray:
             dlen = vals.shape[0]
         i = _np.arange(dlen) + max(-k, 0)
         j = _np.arange(dlen) + max(k, 0)
-        coo = self.tocoo()
+        coo = self._canonical_coo()
         rows = _np.concatenate([_np.asarray(coo.row), i])
         cols = _np.concatenate([_np.asarray(coo.col), j])
         data = _np.concatenate(
@@ -428,7 +439,7 @@ class SparseArray:
         """Drop explicitly stored zeros IN PLACE (scipy semantics)."""
         import numpy as _np
 
-        coo = self.tocoo()
+        coo = self._canonical_coo()
         vals = _np.asarray(coo.data)
         if not (vals == 0).any():
             return

@@ -399,3 +399,59 @@ def random_array(shape, *, density=0.01, format="coo", dtype=None,
     # sampler a positional count
     rvs = None if data_sampler is None else (lambda k: data_sampler(size=k))
     return random(m, n, density, format, dtype, state, data_rvs=rvs)
+
+
+def _check_axis(a) -> int:
+    if a not in (-2, -1, 0, 1):
+        raise ValueError(f"axis {a} out of bounds for a 2-D sparse array")
+    return a % 2
+
+
+def swapaxes(A, axis1, axis2):
+    """2-D sparse swapaxes: identity for (0,0)/(1,1), transpose for (0,1).
+
+    scipy.sparse.swapaxes analog (the n-D generalization collapses to the
+    transpose in the 2-D world both we and the reference live in).
+    Out-of-range axes raise, as in numpy/scipy."""
+    ax = {_check_axis(axis1), _check_axis(axis2)}
+    if ax == {0} or ax == {1}:
+        return A.copy()
+    return A.T
+
+
+def permute_dims(A, axes=None):
+    """scipy.sparse.permute_dims for 2-D: (0, 1) identity, (1, 0) transpose."""
+    if axes is None:
+        axes = (1, 0)
+    axes = tuple(_check_axis(a) for a in axes)
+    if axes == (0, 1):
+        return A.copy()
+    if axes == (1, 0):
+        return A.T
+    raise ValueError(f"invalid axes permutation {axes}")
+
+
+def expand_dims(A, axis):
+    """Unsupported: sparse arrays here are 2-D only (as in the reference).
+    Raises rather than silently mis-shaping."""
+    raise NotImplementedError(
+        "expand_dims needs n-D sparse arrays; sparse_tpu (like the "
+        "reference) is 2-D only"
+    )
+
+
+def safely_cast_index_arrays(A, idx_dtype=np.int32, msg=""):
+    """scipy.sparse.safely_cast_index_arrays analog: return (indices,
+    indptr)-style index arrays cast to ``idx_dtype``, raising when values
+    don't fit."""
+    info = np.iinfo(idx_dtype)
+
+    def cast(arr):
+        a = np.asarray(arr)
+        if a.size and (a.max() > info.max or a.min() < info.min):
+            raise ValueError(f"index values too large for {idx_dtype} {msg}")
+        return a.astype(idx_dtype)
+
+    if hasattr(A, "indptr"):
+        return cast(A.indices), cast(A.indptr)
+    return cast(A.row), cast(A.col)

@@ -23,19 +23,34 @@ from .coords import (
 )
 
 
-def csr_add_csr(indptr_a, indices_a, data_a, indptr_b, indices_b, data_b, shape):
-    """Union add: concatenate COO triples, lex sort, collapse duplicates."""
-    m = int(shape[0])
+def _union_merge(indptr_a, indices_a, data_a, indptr_b, indices_b, data_b, shape):
+    """Shared union prologue: concat COO triples of both operands and
+    lex-sort. Index width follows the DIMENSIONS (int64 only when a dim
+    exceeds int32 — matching lexsort_rc's contract)."""
+    import numpy as np
+
+    cdt = (
+        jnp.int64
+        if max(int(shape[0]), int(shape[1])) > np.iinfo(np.int32).max
+        else jnp.int32
+    )
     rows_a = expand_rows(indptr_a, data_a.shape[0])
     rows_b = expand_rows(indptr_b, data_b.shape[0])
-    rows = jnp.concatenate([rows_a.astype(jnp.int32), rows_b.astype(jnp.int32)])
-    cols = jnp.concatenate([indices_a.astype(jnp.int32), indices_b.astype(jnp.int32)])
+    rows = jnp.concatenate([rows_a.astype(cdt), rows_b.astype(cdt)])
+    cols = jnp.concatenate([indices_a.astype(cdt), indices_b.astype(cdt)])
     dt = jnp.result_type(data_a.dtype, data_b.dtype)
     vals = jnp.concatenate([data_a.astype(dt), data_b.astype(dt)])
     order = lexsort_rc(rows, cols, shape)
-    urows, ucols, uvals, nunique = dedup_sorted(
-        rows[order], cols[order], vals[order]
+    return rows[order], cols[order], vals[order], dt
+
+
+def csr_add_csr(indptr_a, indices_a, data_a, indptr_b, indices_b, data_b, shape):
+    """Union add: concatenate COO triples, lex sort, collapse duplicates."""
+    m = int(shape[0])
+    srows, scols, svals, _ = _union_merge(
+        indptr_a, indices_a, data_a, indptr_b, indices_b, data_b, shape
     )
+    urows, ucols, uvals, nunique = dedup_sorted(srows, scols, svals)
     idt = index_dtype_for(shape, nunique)
     indptr = rows_to_indptr(urows, m, dtype=idt)
     return indptr, ucols.astype(idt), uvals
@@ -127,14 +142,9 @@ def csr_minmax_csr(
     from ..utils import host_int
 
     m = int(shape[0])
-    rows_a = expand_rows(indptr_a, data_a.shape[0])
-    rows_b = expand_rows(indptr_b, data_b.shape[0])
-    rows = jnp.concatenate([rows_a.astype(jnp.int32), rows_b.astype(jnp.int32)])
-    cols = jnp.concatenate([indices_a.astype(jnp.int32), indices_b.astype(jnp.int32)])
-    dt = jnp.result_type(data_a.dtype, data_b.dtype)
-    vals = jnp.concatenate([data_a.astype(dt), data_b.astype(dt)])
-    order = lexsort_rc(rows, cols, shape)
-    srows, scols, svals = rows[order], cols[order], vals[order]
+    srows, scols, svals, dt = _union_merge(
+        indptr_a, indices_a, data_a, indptr_b, indices_b, data_b, shape
+    )
     nnz = srows.shape[0]
     if nnz == 0:
         idt = index_dtype_for(shape, 0)

@@ -16,7 +16,10 @@ import numpy as np
 
 
 def _coo_parts(A):
-    coo = A.tocoo()
+    # raw COO may hold duplicate/unsorted triples; scipy canonicalizes
+    # before every reduction (duplicates must SUM, and the stored-
+    # position count must not double-count)
+    coo = A._canonical_coo()
     return (
         np.asarray(coo.row),
         np.asarray(coo.col),
@@ -98,10 +101,13 @@ def arg_min_or_max(A, op, axis=None):
             p = int(flats[vals == v].min())
         positive = v > 0 if is_max else v < 0  # False for NaN
         if has_implicit and not positive:
+            # NaN extreme: scipy falls back to the first IMPLICIT position
+            # only; a zero extreme also competes with stored zeros (probed)
             cands = [_first_missing_flat(flats, m * n)]
-            z = vals == 0
-            if z.any():
-                cands.append(int(flats[z].min()))
+            if not np.isnan(v):
+                z = vals == 0
+                if z.any():
+                    cands.append(int(flats[z].min()))
             return min(cands)
         return p
     if axis not in (0, 1):
@@ -136,7 +142,11 @@ def arg_min_or_max(A, op, axis=None):
             z = vals == 0
             if z.any():
                 np.minimum.at(zero_col, rows[z], cols[z])
-        cand = np.minimum(first_missing, zero_col)
+        # lines whose stored extreme is NaN ignore stored zeros (scipy)
+        nan_extreme = np.isnan(stored_val) & (counts > 0)
+        cand = np.where(
+            nan_extreme, first_missing, np.minimum(first_missing, zero_col)
+        )
         out[need_zero] = cand[need_zero]
     return out
 

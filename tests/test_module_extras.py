@@ -120,3 +120,39 @@ def test_random_array_keyword_sampler():
     dense = np.asarray(a.todense())
     assert set(np.unique(dense)) <= {0.0, 1.0}
     assert np.count_nonzero(dense) == 18
+
+
+def test_swapaxes_permute_dims():
+    import numpy as np
+
+    import sparse_tpu
+
+    A = sparse_tpu.random(5, 6, 0.4, random_state=0, format="csr")
+    d = np.asarray(A.todense())
+    np.testing.assert_allclose(
+        np.asarray(sparse_tpu.swapaxes(A, 0, 1).todense()), d.T
+    )
+    np.testing.assert_allclose(
+        np.asarray(sparse_tpu.swapaxes(A, 0, 0).todense()), d
+    )
+    np.testing.assert_allclose(
+        np.asarray(sparse_tpu.permute_dims(A).todense()), d.T
+    )
+    np.testing.assert_allclose(
+        np.asarray(sparse_tpu.permute_dims(A, (0, 1)).todense()), d
+    )
+
+
+def test_safely_cast_index_arrays():
+    import numpy as np
+    import pytest
+
+    import sparse_tpu
+
+    A = sparse_tpu.random(5, 6, 0.4, random_state=0, format="csr")
+    ix, ip = sparse_tpu.safely_cast_index_arrays(A, np.int32)
+    assert ix.dtype == np.int32 and ip.dtype == np.int32
+    ix8, _ = sparse_tpu.safely_cast_index_arrays(A, np.int8)
+    assert ix8.dtype == np.int8
+    with pytest.raises(NotImplementedError):
+        sparse_tpu.expand_dims(A, 0)

@@ -225,3 +225,48 @@ def test_resize():
         np.asarray(A.todense()), np.pad(dense[:4, :], ((0, 0), (0, 3)))
     )
     assert A.shape == (4, 9)
+
+
+def test_argmax_nan_extreme_ignores_stored_zero():
+    # probed scipy rule: NaN extreme + implicit zeros -> FIRST IMPLICIT
+    # position, even when a stored zero sits earlier
+    As = sp.csr_matrix(
+        (np.array([0.0, np.nan]), np.array([0, 1]), np.array([0, 2])),
+        shape=(1, 3),
+    )
+    A = _from_scipy(As)
+    assert A.argmax() == As.argmax() == 2
+    assert A.argmin() == As.argmin() == 2
+    np.testing.assert_array_equal(
+        np.asarray(A.argmax(axis=1)).ravel(), np.asarray(As.argmax(axis=1)).ravel()
+    )
+
+
+def test_reductions_on_noncanonical_coo():
+    # duplicates must SUM before any reduction (scipy canonicalizes first)
+    A = sparse_tpu.coo_array(
+        (np.array([1.0, 2.0]), (np.array([0, 0]), np.array([0, 0]))),
+        shape=(1, 2),
+    )
+    assert A.max() == 3.0  # not 2.0
+    assert A.min() == 0.0  # implicit zero at (0, 1) still visible
+    assert A.argmax() == 0
+    r, c = A.nonzero()
+    np.testing.assert_array_equal(r, [0])
+    np.testing.assert_array_equal(c, [0])
+
+
+def test_maximum_nan_scalar_raises():
+    A, _ = _pair(3, 3, 0.5, 80)
+    with pytest.raises(NotImplementedError):
+        A.maximum(np.nan)
+    with pytest.raises(NotImplementedError):
+        A.minimum(np.nan)
+
+
+def test_swapaxes_out_of_bounds():
+    A = sparse_tpu.random(3, 4, 0.5, random_state=0, format="csr")
+    with pytest.raises(ValueError):
+        sparse_tpu.swapaxes(A, 0, 2)
+    with pytest.raises(ValueError):
+        sparse_tpu.permute_dims(A, (0, 2))
