@@ -436,12 +436,17 @@ class SparseArray:
                 raise ValueError("indices must be sorted within each row")
 
     def eliminate_zeros(self):
-        """Drop explicitly stored zeros IN PLACE (scipy semantics)."""
+        """Drop explicitly stored zeros IN PLACE (scipy semantics; also
+        canonicalizes a duplicate-holding COO first, as scipy does)."""
         import numpy as _np
 
         coo = self._canonical_coo()
         vals = _np.asarray(coo.data)
         if not (vals == 0).any():
+            if self.format == "coo" and coo is not self:
+                # duplicates were summed: persist the canonical form
+                self.__dict__.clear()
+                self.__dict__.update(coo.__dict__)
             return
         keep = vals != 0
         from .coo import coo_array

@@ -186,9 +186,13 @@ class csc_array(SparseArray):
         from .ops.coords import expand_rows
 
         cols = expand_rows(self.indptr, self.nnz)
-        return coo_array(
+        out = coo_array(
             (self.data, (self.indices, cols)), shape=self.shape
         )
+        # column-major order, not row-major: sorted-flag stays False, but
+        # the triples are duplicate-free — canonical enough for reductions
+        out.has_canonical_format = True
+        return out
 
     def todia(self):
         from .dia import dia_array

@@ -373,7 +373,12 @@ class csr_array(SparseArray):
         rows, cols, data = conv.csr_to_coo(
             self.indptr, self.indices, self.data, self.shape
         )
-        return coo_array((data, (rows, cols)), shape=self.shape)
+        out = coo_array((data, (rows, cols)), shape=self.shape)
+        # CSR expands to row-major-sorted, duplicate-free triples — mark
+        # canonical so reductions skip the re-canonicalization pass
+        out.has_sorted_indices = True
+        out.has_canonical_format = True
+        return out
 
     def tocsc(self):
         from .csc import csc_array

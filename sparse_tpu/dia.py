@@ -92,13 +92,16 @@ class dia_array(SparseArray):
         valid = (rows >= 0) & (rows < m) & (cols < n) & (self.data != 0)
         cnt = host_int(valid.sum())
         take = jnp.nonzero(valid.ravel(), size=cnt)[0]
-        return coo_array(
+        out = coo_array(
             (
                 self.data.ravel()[take],
                 (rows.ravel()[take], cols.ravel()[take]),
             ),
             shape=self.shape,
         )
+        # one slot per (diagonal, column): duplicate-free by construction
+        out.has_canonical_format = True
+        return out
 
     def tocsr(self):
         return self.tocoo().tocsr()

@@ -454,4 +454,6 @@ def safely_cast_index_arrays(A, idx_dtype=np.int32, msg=""):
 
     if hasattr(A, "indptr"):
         return cast(A.indices), cast(A.indptr)
+    if hasattr(A, "offsets"):  # DIA carries only the offsets vector
+        return cast(A.offsets)
     return cast(A.row), cast(A.col)

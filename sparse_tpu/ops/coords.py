@@ -48,32 +48,12 @@ def rows_to_indptr(sorted_rows, m: int, dtype=None):
     return jnp.searchsorted(sorted_rows, targets, side="left").astype(dtype)
 
 
-def require_x64_keys(shape) -> bool:
-    """True when FUSED row*n+col keys for ``shape`` need int64.
-
-    Only the distributed samplesort (``parallel.sort``) still fuses keys —
-    every single-device path sorts (row, col) pairs via :func:`lexsort_rc`
-    and never needs more than per-dimension int32. Raises loudly when int64
-    is needed but x64 is disabled: jnp silently truncates int64->int32 in
-    that configuration, which would corrupt the sort with no error.
-    """
-    m, n = int(shape[0]), int(shape[1])
-    if m * n <= np.iinfo(np.int32).max:
-        return False
-    if not jax.config.jax_enable_x64:
-        raise ValueError(
-            f"matrix shape {shape} needs int64 sort keys (m*n > 2**31); "
-            "enable them with jax.config.update('jax_enable_x64', True)"
-        )
-    return True
-
-
 def require_x64_index(dim: int) -> bool:
     """True when a single coordinate dimension exceeds int32 range.
 
-    The loud-raise analog of :func:`require_x64_keys` for per-dimension
-    indices (e.g. ``kron`` output rows = ra*mb + rb): >2**31 rows/cols need
-    int64 index arrays, which need x64 enabled.
+    Raises loudly when int64 indices are needed (e.g. ``kron`` output rows
+    = ra*mb + rb past 2**31) but x64 is disabled — jnp silently truncates
+    int64->int32 in that configuration, which would corrupt every sort.
     """
     if int(dim) <= np.iinfo(np.int32).max:
         return False

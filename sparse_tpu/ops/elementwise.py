@@ -27,11 +27,13 @@ def _union_merge(indptr_a, indices_a, data_a, indptr_b, indices_b, data_b, shape
     """Shared union prologue: concat COO triples of both operands and
     lex-sort. Index width follows the DIMENSIONS (int64 only when a dim
     exceeds int32 — matching lexsort_rc's contract)."""
-    import numpy as np
+    from .coords import require_x64_index
 
+    # require_x64_index raises loudly when a dim needs int64 but x64 is
+    # off (astype(int64) would silently wrap to int32 otherwise)
     cdt = (
         jnp.int64
-        if max(int(shape[0]), int(shape[1])) > np.iinfo(np.int32).max
+        if require_x64_index(max(int(shape[0]), int(shape[1])))
         else jnp.int32
     )
     rows_a = expand_rows(indptr_a, data_a.shape[0])

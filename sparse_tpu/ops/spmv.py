@@ -111,7 +111,7 @@ def csr_spmv_colsplit(indptr, indices, data, x, m: int, nblocks: int):
     if max(n, m) * nblocks > np.iinfo(np.int32).max:
         # int32 would wrap in `indices * nblocks` / `block * m + rows` and
         # silently misroute segments (jnp truncates int64 under x32) — fail
-        # loudly like ops.coords.require_x64_keys.
+        # loudly like ops.coords.require_x64_index.
         if not jax.config.jax_enable_x64:
             raise ValueError(
                 f"column-split SpMV on shape ({m}, {n}) with {nblocks} "

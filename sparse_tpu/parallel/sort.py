@@ -361,26 +361,50 @@ def dist_sort_host(keys, payloads=(), num_shards: int | None = None):
 def coo_to_csr_distributed(rows, cols, vals, shape, num_shards: int | None = None):
     """Distributed COO->CSR conversion (the coo.tocsr path of coo.py:233).
 
-    Sorts (row, col) keys across the mesh with ``dist_sort``, then performs
-    the dedup + indptr build. Returns a ``csr_array``. The sharded sort is
-    the scale-out stage; the final assembly mirrors the reference's
+    Lexicographically sorts the (row, col) pairs across the mesh, then
+    performs the dedup + indptr build. Returns a ``csr_array``. The sharded
+    sort is the scale-out stage; the final assembly mirrors the reference's
     SORTED_COORDS_TO_COUNTS + nnz_to_pos scan.
+
+    Small shapes fuse row*n+col into one int32 key (single sort pass); past
+    the int32 key range the pair sorts as TWO stable distributed passes
+    (by col, then by row — LSD radix composition; both ``dist_sort`` and
+    ``dist_sort_sample`` are stable: canonical-order merges, rank-ordered
+    exchanges), so no int64 keys and no x64 requirement anywhere.
     """
     import sparse_tpu
-    from ..ops.coords import require_x64_keys
+
+    from ..ops.coords import require_x64_index
 
     m, n = int(shape[0]), int(shape[1])
-    rows = np.asarray(rows, dtype=np.int64)
-    cols = np.asarray(cols, dtype=np.int64)
     vals = np.asarray(vals)
-    require_x64_keys(shape) if m * n > np.iinfo(np.int32).max else None
-    keys = rows * n + cols
-    skeys, (svals,) = dist_sort_host(keys, (vals,), num_shards)
-    srows = (skeys // n).astype(np.int64)
-    scols = (skeys % n).astype(np.int64)
-    # collapse duplicates (sum) — sorted, so one segment pass
-    if skeys.shape[0]:
-        is_new = np.concatenate([[True], skeys[1:] != skeys[:-1]])
+    if m * n <= np.iinfo(np.int32).max:
+        keys = np.asarray(rows, np.int32) * np.int32(n) + np.asarray(
+            cols, np.int32
+        )
+        skeys, (svals,) = dist_sort_host(keys, (vals,), num_shards)
+        srows = skeys // n
+        scols = skeys % n
+    else:
+        # a DIMENSION past int32 still needs int64 coordinates (and x64 —
+        # require_x64_index raises loudly when it's off); coordinates for
+        # dims <= int32max stay clear of the int32 sentinel (dim-1 < max)
+        cdt = (
+            np.int64
+            if require_x64_index(max(m, n))
+            else np.int32
+        )
+        c1, (r1, v1) = dist_sort_host(
+            np.asarray(cols, cdt),
+            (np.asarray(rows, cdt), vals),
+            num_shards,
+        )
+        srows, (scols, svals) = dist_sort_host(r1, (c1, v1), num_shards)
+    # collapse duplicate pairs (sum) — lex-sorted, so one segment pass
+    if srows.shape[0]:
+        is_new = np.concatenate(
+            [[True], (srows[1:] != srows[:-1]) | (scols[1:] != scols[:-1])]
+        )
         seg = np.cumsum(is_new) - 1
         uvals = np.zeros(int(seg[-1]) + 1, dtype=vals.dtype)
         np.add.at(uvals, seg, svals)

@@ -103,3 +103,28 @@ def test_dist_sort_sample_duplicate_fallback(num_shards):
     )
     np.testing.assert_array_equal(np.asarray(sk), keys)
     assert sorted(np.asarray(sp_).tolist()) == payload.tolist()
+
+
+@pytest.mark.parametrize("num_shards", [2, 8])
+def test_coo_to_csr_distributed_big_shape(num_shards):
+    """m*n > 2**31: the pair path (two stable distributed passes, int32
+    keys) must match scipy without x64 — same guarantee as the
+    single-device lexsort_rc big-shape path."""
+    import scipy.sparse as sp
+
+    BIG = 60_000
+    rng = np.random.default_rng(3)
+    nnz = 300
+    rows = rng.integers(0, BIG, nnz)
+    cols = rng.integers(0, BIG, nnz)
+    rows[:40] = rows[40:80]  # duplicates (must sum)
+    cols[:40] = cols[40:80]
+    vals = rng.random(nnz)
+    A = coo_to_csr_distributed(rows, cols, vals, (BIG, BIG), num_shards)
+    want = sp.coo_matrix((vals, (rows, cols)), shape=(BIG, BIG)).tocsr()
+    want.sum_duplicates()
+    got = A.tocoo()
+    w = want.tocoo()
+    np.testing.assert_array_equal(np.asarray(got.row), w.row)
+    np.testing.assert_array_equal(np.asarray(got.col), w.col)
+    np.testing.assert_allclose(np.asarray(got.data), w.data, rtol=1e-12)

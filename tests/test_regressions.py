@@ -106,16 +106,19 @@ def test_random_large_path_covers_high_rows():
     assert np.asarray(A.row).max() > 5000
 
 
-def test_wide_shape_requires_x64_message():
+def test_wide_dim_requires_x64_message():
+    # fused m*n keys are gone everywhere (pair sorts); only a single
+    # DIMENSION beyond int32 still needs x64 (kron of huge factors)
     import jax
 
-    from sparse_tpu.ops.coords import require_x64_keys
+    from sparse_tpu.ops.coords import require_x64_index
 
+    assert not require_x64_index(60000)
     if jax.config.jax_enable_x64:
-        assert require_x64_keys((60000, 60000))
+        assert require_x64_index(2**31 + 1)
     else:
         with pytest.raises(ValueError, match="x64"):
-            require_x64_keys((60000, 60000))
+            require_x64_index(2**31 + 1)
 
 
 # ---------------------------------------------------------------------------
