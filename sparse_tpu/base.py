@@ -219,9 +219,16 @@ class SparseArray:
         return self.diagonal(k=offset).sum()
 
     def _canonical_coo(self):
-        """COO view with duplicates summed (raw coo_array may hold them)."""
+        """COO view with duplicates summed (raw coo_array may hold them).
+
+        Accepts either scipy-canonical COO (lex-sorted + deduped) or the
+        merely duplicate-free outputs of csc/dia.tocoo (order-agnostic
+        consumers only need uniqueness)."""
         coo = self.tocoo()
-        if not getattr(coo, "has_canonical_format", True):
+        if not (
+            getattr(coo, "has_canonical_format", True)
+            or getattr(coo, "_duplicate_free", False)
+        ):
             coo = coo.copy()
             coo.sum_duplicates()
         return coo

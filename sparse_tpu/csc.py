@@ -189,9 +189,10 @@ class csc_array(SparseArray):
         out = coo_array(
             (self.data, (self.indices, cols)), shape=self.shape
         )
-        # column-major order, not row-major: sorted-flag stays False, but
-        # the triples are duplicate-free — canonical enough for reductions
-        out.has_canonical_format = True
+        # column-major order, not row-major: scipy's canonical flag would
+        # overclaim (it means lex-sorted + deduped), so mark only the
+        # duplicate-freeness that reductions need
+        out._duplicate_free = True
         return out
 
     def todia(self):

@@ -100,7 +100,8 @@ class dia_array(SparseArray):
             shape=self.shape,
         )
         # one slot per (diagonal, column): duplicate-free by construction
-        out.has_canonical_format = True
+        # (diagonal-major order though — not scipy-canonical)
+        out._duplicate_free = True
         return out
 
     def tocsr(self):

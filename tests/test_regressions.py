@@ -259,3 +259,59 @@ def test_mult_two_nnz_single_row():
     ).tocsr()
     got = np.asarray((A * B).todense())
     np.testing.assert_allclose(got, np.array([[3.0, 8.0]]))
+
+
+def test_big_shape_paths_without_x64_subprocess():
+    """The no-x64 contract the suite itself cannot test (conftest enables
+    x64 globally): big-shape conversion + distributed conversion must work
+    with jax_enable_x64 = False — int32 pair sorts end to end."""
+    import os
+    import subprocess
+    import sys
+
+    script = r"""
+import os
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+).strip()
+import jax
+jax.config.update("jax_platforms", "cpu")
+assert not jax.config.jax_enable_x64
+import numpy as np, scipy.sparse as sp
+import sparse_tpu
+from sparse_tpu.parallel.sort import coo_to_csr_distributed
+
+BIG = 60_000
+rng = np.random.default_rng(0)
+nnz = 200
+rows = rng.integers(0, BIG, nnz)
+cols = rng.integers(0, BIG, nnz)
+rows[:30] = rows[30:60]; cols[:30] = cols[30:60]  # duplicates
+vals = rng.integers(1, 100, nnz).astype(np.float32)  # f32-exact values
+
+want = sp.coo_matrix((vals, (rows, cols)), shape=(BIG, BIG)).tocsr()
+want.sum_duplicates()
+w = want.tocoo()
+
+for A in (
+    sparse_tpu.coo_array((vals, (rows, cols)), shape=(BIG, BIG)).tocsr(),
+    coo_to_csr_distributed(rows, cols, vals, (BIG, BIG), 8),
+):
+    got = A.tocoo()
+    np.testing.assert_array_equal(np.asarray(got.row), w.row)
+    np.testing.assert_array_equal(np.asarray(got.col), w.col)
+    np.testing.assert_allclose(np.asarray(got.data), w.data)
+print("NO_X64_OK")
+"""
+    env = dict(os.environ)
+    proc = subprocess.run(
+        [sys.executable, "-c", script],
+        capture_output=True,
+        text=True,
+        timeout=420,
+        env=env,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert "NO_X64_OK" in proc.stdout
