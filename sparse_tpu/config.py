@@ -52,6 +52,19 @@ class Settings:
     # applies under spmv_mode == 'pallas'; wider bands exceed the VMEM
     # window budget and take the XLA gather path.
     pallas_max_band: int = 8192
+    # linalg.cg fast path: unpreconditioned solves on banded (DIA-shaped)
+    # f32 operators run the fused two-pass Pallas iteration
+    # (kernels/cg_dia.py) in conv-test-sized chunks on real TPUs —
+    # identical iterates, ~2x the step-loop throughput. Values: True /
+    # False / "force" ("force" also runs off-TPU in interpret mode — the
+    # test hook; SPARSE_TPU_FUSED_CG=force selects it from the env).
+    fused_cg: bool | str = field(
+        default_factory=lambda: (
+            "force"
+            if os.environ.get("SPARSE_TPU_FUSED_CG", "").lower() == "force"
+            else _env_bool("SPARSE_TPU_FUSED_CG", True)
+        )
+    )
 
 
 settings = Settings()

@@ -430,11 +430,15 @@ def cg_dia_fused_onepass(
 
 @partial(
     jax.jit,
-    static_argnames=("offsets", "m", "iters", "tile", "plane_dtype", "interpret"),
+    static_argnames=(
+        "offsets", "m", "iters", "tile", "plane_dtype", "interpret",
+        "return_state",
+    ),
 )
 def cg_dia_fused(
     data, offsets: tuple, b, x0, m: int, iters: int = 300, tile: int = 16384,
-    plane_dtype=None, interpret: bool = False
+    plane_dtype=None, interpret: bool = False, state=None,
+    return_state: bool = False,
 ):
     """``iters`` fixed CG iterations on the DIA matrix (throughput mode).
 
@@ -442,6 +446,12 @@ def cg_dia_fused(
     recurrence exactly (same beta/alpha guards) — two fused passes per
     iteration instead of an SpMV plus a train of elementwise kernels.
     ``x0=None`` starts from zero and skips the setup SpMV (r0 = b).
+
+    ``state``/``return_state`` thread the FULL padded CG state
+    (xp, rp, pp, rho_prev, rho) across calls, so a tolerance-driven caller
+    (``linalg.cg``'s fused fast path) can run in conv-test-sized chunks
+    with one host rho fetch per chunk — identical iterates to one long
+    run, no CG restart between chunks.
     """
     dt = jnp.result_type(data.dtype, b.dtype)
     TM, B, G = _plan(m, offsets, tile=tile)
@@ -517,17 +527,19 @@ def cg_dia_fused(
         interpret=interpret,
     )
 
-    if x0 is None:
-        rp0 = bp  # r = b - A @ 0
-    else:
-        from ..ops.dia_spmv import dia_spmv_xla
+    if state is None:
+        if x0 is None:
+            rp0 = bp  # r = b - A @ 0
+        else:
+            from ..ops.dia_spmv import dia_spmv_xla
 
-        r0 = b.astype(dt) - dia_spmv_xla(
-            data.astype(dt), offsets, x0.astype(dt), (m, m)
-        )
-        rp0 = _pad_vec(r0, TM, G)
-    rho0 = jnp.vdot(rp0, rp0).real.astype(dt)
-    pp0 = jnp.zeros_like(bp)
+            r0 = b.astype(dt) - dia_spmv_xla(
+                data.astype(dt), offsets, x0.astype(dt), (m, m)
+            )
+            rp0 = _pad_vec(r0, TM, G)
+        rho0 = jnp.vdot(rp0, rp0).real.astype(dt)
+        pp0 = jnp.zeros_like(bp)
+        state = (xp, rp0, pp0, jnp.zeros((), dt), rho0)
 
     def body(_, state):
         xp, rp, pp, rho_prev, rho = state
@@ -537,6 +549,10 @@ def cg_dia_fused(
         xp2, rp2, rr = kB(alpha.reshape(1, 1).astype(dt), xp, pnew, rp, q)
         return xp2, rp2, pnew, rho, rr[0, 0]
 
-    state = (xp, rp0, pp0, jnp.zeros((), dt), rho0)
-    xp, rp, _, _, rho = jax.lax.fori_loop(0, iters, body, state)
-    return _unpad_vec(xp, m, TM), _unpad_vec(rp, m, TM), rho
+    out_state = jax.lax.fori_loop(0, iters, body, state)
+    xp, rp, _, _, rho = out_state
+    x_out = _unpad_vec(xp, m, TM)
+    r_out = _unpad_vec(rp, m, TM)
+    if return_state:
+        return x_out, r_out, rho, out_state
+    return x_out, r_out, rho

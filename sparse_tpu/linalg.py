@@ -180,6 +180,10 @@ def cg(
     n = b.shape[0]
     if maxiter is None:
         maxiter = n * 10
+    if M is None and callback is None:
+        fused = _try_fused_cg(A, b, x0, tol, maxiter, conv_test_iters)
+        if fused is not None:
+            return fused
     A = make_linear_operator(A)
     M = IdentityOperator(A.shape, dtype=A.dtype) if M is None else make_linear_operator(M)
     x = jnp.zeros_like(b) if x0 is None else asjnp(x0)
@@ -198,6 +202,76 @@ def cg(
         # A or M is a host-side Python operator (e.g. a numpy-based
         # preconditioner): run the reference-style host loop instead
         return _cg_host_loop(A, b, x, tol, maxiter, M, None, conv_test_iters)
+
+
+def _try_fused_cg(A, b, x0, tol, maxiter, conv_test_iters):
+    """Fused-iteration fast path for unpreconditioned CG on banded f32
+    operators (the PDE/GMG shape): runs ``kernels.cg_dia.cg_dia_fused``
+    in conv-test-sized chunks with one host rho fetch per chunk — the
+    same iterates and stopping rule as ``_cg_device_loop`` (absolute
+    ||r|| < tol every conv_test_iters), at ~2x the step-loop throughput
+    on real TPUs (BENCH_NOTES.md). Returns (x, iters) or None when the
+    path doesn't apply.
+    """
+    import jax
+
+    from .config import settings
+
+    mode = settings.fused_cg
+    if not mode:
+        return None
+    interpret = False
+    if jax.default_backend() != "tpu":
+        if mode != "force":  # tests: run the chunk logic in interpret mode
+            return None
+        interpret = True
+    from .csr import csr_array
+    from .dia import dia_array
+
+    planes = offsets = None
+    if isinstance(A, dia_array):
+        planes, offsets = A.data, tuple(int(o) for o in A.offsets)
+    elif isinstance(A, csr_array):
+        dia = A._maybe_dia()  # cached banded auto-detection
+        if dia is not None:
+            planes, offsets = dia
+    if planes is None:
+        return None
+    m, n_ = A.shape
+    if m != n_ or b.ndim != 1 or b.shape[0] != m or maxiter < 1:
+        return None
+    band = max((abs(int(o)) for o in offsets), default=0)
+    if band > settings.pallas_max_band:
+        return None
+    dt = jnp.result_type(planes.dtype, b.dtype)
+    if dt != jnp.float32:  # Mosaic kernel is f32; f64/complex take the loop
+        return None
+    if x0 is not None:
+        x0 = asjnp(x0)
+
+    from .kernels.cg_dia import cg_dia_fused
+
+    tol2 = float(tol) ** 2
+    chunk = max(int(conv_test_iters), 1)
+    state = None
+    iters = 0
+    x = None
+    while iters < maxiter:
+        # mirror _cg_device_loop's test points exactly: every conv_test
+        # iterations AND at iters == maxiter - 1 (so a solve converging at
+        # the last test reports maxiter-1, not maxiter). The off-size last
+        # chunks add at most two extra trace shapes, only for solves that
+        # actually reach maxiter.
+        k = min(chunk, max(maxiter - 1 - iters, 1))
+        k = min(k, maxiter - iters)
+        x, _r, rho, state = cg_dia_fused(
+            planes, offsets, b, x0, m, iters=k,
+            state=state, return_state=True, interpret=interpret,
+        )
+        iters += k
+        if float(rho) < tol2 or not np.isfinite(float(rho)):
+            break
+    return x, iters
 
 
 def _cg_device_loop(A, b, x, r, tol, maxiter, M, conv_test_iters):

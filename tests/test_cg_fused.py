@@ -168,3 +168,75 @@ def test_plane_stream_dtype_alignment_guard():
     assert plane_stream_dtype(None, jnp.float32, 1024) == f32
     assert plane_stream_dtype(jnp.bfloat16, jnp.float32, 1024) == f32  # odd-1024
     assert plane_stream_dtype(jnp.bfloat16, jnp.float32, 4096) == jnp.dtype(jnp.bfloat16)
+
+
+def test_linalg_cg_fused_fast_path_matches_loop():
+    """linalg.cg's fused fast path (forced into interpret mode off-TPU)
+    must produce the same solution and iteration count as the plain
+    device loop — identical iterates, same absolute-||r|| stopping rule."""
+    import numpy as np
+
+    import sparse_tpu
+    from sparse_tpu import linalg
+    from sparse_tpu.config import settings
+
+    n = 24
+    diag_a = np.full(n * n - 1, -1.0, np.float32)
+    diag_a[n - 1 :: n] = 0.0
+    diag_g = np.full(n * (n - 1), -1.0, np.float32)
+    diag_c = np.full(n * n, 4.0, np.float32)
+    A = sparse_tpu.diags(
+        [diag_g, diag_a, diag_c, diag_a, diag_g], [-n, -1, 0, 1, n],
+        dtype=np.float32,
+    )
+    b = np.random.default_rng(0).random(n * n).astype(np.float32)
+
+    old = settings.fused_cg
+    try:
+        settings.fused_cg = False
+        x_loop, it_loop = linalg.cg(A, b, tol=1e-4, maxiter=400)
+        settings.fused_cg = "force"
+        x_fused, it_fused = linalg.cg(A, b, tol=1e-4, maxiter=400)
+    finally:
+        settings.fused_cg = old
+    assert it_fused == it_loop
+    np.testing.assert_allclose(
+        np.asarray(x_fused), np.asarray(x_loop), rtol=2e-4, atol=2e-4
+    )
+    # and the answer actually solves the system
+    resid = np.linalg.norm(np.asarray(A @ x_fused) - b)
+    assert resid < 1e-3
+
+
+def test_linalg_cg_fused_respects_x0_and_maxiter():
+    import numpy as np
+
+    import sparse_tpu
+    from sparse_tpu import linalg
+    from sparse_tpu.config import settings
+
+    n = 16
+    diag_a = np.full(n * n - 1, -1.0, np.float32)
+    diag_a[n - 1 :: n] = 0.0
+    diag_g = np.full(n * (n - 1), -1.0, np.float32)
+    diag_c = np.full(n * n, 4.0, np.float32)
+    A = sparse_tpu.diags(
+        [diag_g, diag_a, diag_c, diag_a, diag_g], [-n, -1, 0, 1, n],
+        dtype=np.float32,
+    )
+    rng = np.random.default_rng(1)
+    xtrue = rng.random(n * n).astype(np.float32)
+    b = np.asarray(A @ xtrue)
+    old = settings.fused_cg
+    try:
+        settings.fused_cg = "force"
+        # warm start very close to the solution: should converge immediately
+        x, iters = linalg.cg(
+            A, b, x0=xtrue + 1e-6, tol=1e-3, maxiter=400, conv_test_iters=5
+        )
+        assert iters <= 5
+        # maxiter cap respected
+        x2, iters2 = linalg.cg(A, b, tol=1e-30, maxiter=7)
+        assert iters2 == 7
+    finally:
+        settings.fused_cg = old
