@@ -17,8 +17,6 @@ the reference's periodic-sync behavior.
 
 from __future__ import annotations
 
-from functools import partial
-
 import jax
 import jax.numpy as jnp
 import numpy as np

@@ -26,7 +26,7 @@ Two TPU-native algorithms:
 
 from __future__ import annotations
 
-from functools import lru_cache, partial
+from functools import lru_cache
 
 import jax
 import jax.numpy as jnp
