@@ -462,6 +462,13 @@ def _try_quantum(timeout_s: int = 420):
 def _try_platform(platform_arg: str, timeout_s: int):
     """Run a worker subprocess; return its parsed JSON line or None."""
     stdout, stderr, rc = "", "", None
+    env = dict(os.environ)
+    if platform_arg == "cpu":
+        # the axon sitecustomize hook dials the TPU tunnel from every
+        # process whose env carries this var — on a wedged tunnel that
+        # registration blocks for minutes before giving up, defeating the
+        # point of the cpu FALLBACK (same trick as tests/conftest.py)
+        env.pop("PALLAS_AXON_POOL_IPS", None)
     try:
         proc = subprocess.run(
             [sys.executable, os.path.abspath(__file__), "--worker", platform_arg],
@@ -469,6 +476,7 @@ def _try_platform(platform_arg: str, timeout_s: int):
             text=True,
             timeout=timeout_s,
             cwd=os.path.dirname(os.path.abspath(__file__)),
+            env=env,
         )
         stdout, stderr, rc = proc.stdout, proc.stderr, proc.returncode
     except subprocess.TimeoutExpired as e:
