@@ -170,12 +170,20 @@ class SparseArray:
     # ---- whole-array / axis reductions (scipy semantics: implicit zeros
     # participate). axis reductions return DENSE 1-D arrays — a documented
     # deviation from scipy's sparse-1-row-matrix return.
+    @staticmethod
+    def _reject_out(out):
+        # scipy raises for out= on sparse reductions; silently ignoring it
+        # would hand callers wrong-but-quiet behavior
+        if out is not None:
+            raise ValueError("Sparse arrays do not support an 'out' parameter.")
+
     def max(self, axis=None, out=None):
         """Maximum over all entries / per axis (``ops.reduce.min_or_max``)."""
         import numpy as _np
 
         from .ops.reduce import min_or_max
 
+        self._reject_out(out)
         return min_or_max(self, _np.maximum, axis=axis)
 
     def min(self, axis=None, out=None):
@@ -183,6 +191,7 @@ class SparseArray:
 
         from .ops.reduce import min_or_max
 
+        self._reject_out(out)
         return min_or_max(self, _np.minimum, axis=axis)
 
     def nanmax(self, axis=None, out=None):
@@ -190,6 +199,7 @@ class SparseArray:
 
         from .ops.reduce import min_or_max
 
+        self._reject_out(out)
         return min_or_max(self, _np.maximum, axis=axis, nan=True)
 
     def nanmin(self, axis=None, out=None):
@@ -197,6 +207,7 @@ class SparseArray:
 
         from .ops.reduce import min_or_max
 
+        self._reject_out(out)
         return min_or_max(self, _np.minimum, axis=axis, nan=True)
 
     def argmax(self, axis=None, out=None):
@@ -205,6 +216,7 @@ class SparseArray:
 
         from .ops.reduce import arg_min_or_max
 
+        self._reject_out(out)
         return arg_min_or_max(self, _np.maximum, axis=axis)
 
     def argmin(self, axis=None, out=None):
@@ -212,6 +224,7 @@ class SparseArray:
 
         from .ops.reduce import arg_min_or_max
 
+        self._reject_out(out)
         return arg_min_or_max(self, _np.minimum, axis=axis)
 
     def trace(self, offset=0):
@@ -332,7 +345,7 @@ class SparseArray:
         data = _np.concatenate(
             [_np.asarray(coo.data), vals.astype(self.dtype, copy=False)]
         )
-        from .ops.coords import dedup_sorted, sort_coo
+        from .ops.coords import dedup_sorted
 
         # stable sort + keep-LAST dedup: the appended diagonal wins
         order = _np.lexsort((cols, rows))  # host: stable, no x64 gating

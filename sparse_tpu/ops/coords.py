@@ -157,7 +157,7 @@ def segment_searchsorted(sorted_vals, starts, ends, queries):
     # an interval of length L needs floor(log2 L)+1 = L.bit_length()
     # halvings to collapse to lo == hi; segments are at most nb long
     for _ in range(nb.bit_length()):
-        mid = (lo + hi) // 2
+        mid = lo + (hi - lo) // 2  # overflow-safe: lo+hi wraps int32 past 2**30
         mv = sorted_vals[jnp.clip(mid, 0, nb - 1)]
         go_right = (mv < queries) & (mid < hi)
         lo = jnp.where(go_right, mid + 1, lo)

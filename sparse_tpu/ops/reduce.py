@@ -122,16 +122,28 @@ def arg_min_or_max(A, op, axis=None):
     stored_val = np.full(length, np.nan)
     stored_arg = np.zeros(length, dtype=np.int64)
     if vals.size:
-        # order (line, key, -col): the last entry of each line block is the
-        # extreme with the SMALLEST col among ties; NaN keyed above all
-        # (numpy argmax/argmin both resolve to the first NaN)
         isnan = np.isnan(vals) if np.issubdtype(vals.dtype, np.floating) else np.zeros(vals.shape, bool)
-        key_val = np.where(isnan, np.inf, vals if is_max else -vals)
-        order = np.lexsort((-cols, key_val, rows))
-        r_s, c_s, v_s = rows[order], cols[order], vals[order]
-        last = np.concatenate([r_s[1:] != r_s[:-1], [True]])
-        stored_arg[r_s[last]] = c_s[last]
-        stored_val[r_s[last]] = v_s[last]
+        # NaN wins both argmax and argmin (numpy resolves to the FIRST NaN),
+        # so it gets its OWN lexsort key — folding it into the value key as
+        # np.inf would collide with stored infinities. The value key stays in
+        # the native dtype: negation wraps unsigned dtypes / the signed
+        # minimum, and a float64 cast loses int64 exactness past 2**53.
+        keyv = np.where(isnan, vals.dtype.type(0), vals)
+        if is_max:
+            # ascending (line, isnan, val, -col): the LAST entry of each
+            # line block is NaN if any, else the max val, smallest col tie
+            order = np.lexsort((-cols, keyv, isnan, rows))
+            r_s = rows[order]
+            take = np.concatenate([r_s[1:] != r_s[:-1], [True]])
+        else:
+            # ascending (line, ~isnan, val, col): the FIRST entry of each
+            # line block is NaN if any, else the min val, smallest col tie
+            order = np.lexsort((cols, keyv, ~isnan, rows))
+            r_s = rows[order]
+            take = np.concatenate([[True], r_s[1:] != r_s[:-1]])
+        c_s, v_s = cols[order], vals[order]
+        stored_arg[r_s[take]] = c_s[take]
+        stored_val[r_s[take]] = v_s[take]
     out[counts > 0] = stored_arg[counts > 0]
     positive = stored_val > 0 if is_max else stored_val < 0  # False for NaN/empty
     need_zero = (counts < other) & ~positive

@@ -59,6 +59,13 @@ def esc_expand_sort_compress(
     uvals [U], nunique scalar); entries past nunique are sentinel-rowed
     with value 0.
     """
+    # int32 pair indices throughout — a dimension past 2**31 would silently
+    # wrap, so raise loudly like _union_merge/kron/lexsort_rc do
+    if max(int(m_real) + 1, int(n)) > 2**31 - 1:
+        raise ValueError(
+            f"esc_expand_sort_compress uses int32 pair indices; dimension "
+            f"max(m_real+1={m_real + 1}, n={n}) exceeds int32 range"
+        )
     nnz_a = indices_a.shape[0]
     rows_a = expand_rows(indptr_a, nnz_a)
     # expansion counts: |B row| at each A column id; caller-padded nnz

@@ -270,3 +270,74 @@ def test_swapaxes_out_of_bounds():
         sparse_tpu.swapaxes(A, 0, 2)
     with pytest.raises(ValueError):
         sparse_tpu.permute_dims(A, (0, 2))
+
+
+@pytest.mark.parametrize(
+    "dtype,dense",
+    [
+        (np.uint32, [[5, 0]]),          # stored 0: -0 wraps to key 0 in uint
+        (np.int8, [[-128, -1]]),        # int8 min negates to itself
+        (np.uint8, [[200, 3, 0]]),
+    ],
+)
+def test_argmin_extreme_dtypes(dtype, dense):
+    """ADVICE r2: the argmin/argmax sort key stays in the NATIVE dtype with
+    no negation — negating wraps unsigned values and the signed minimum
+    (and a float64 key would lose int64 exactness past 2**53)."""
+    As = sp.csr_array(np.asarray(dense, dtype=dtype))
+    A = sparse_tpu.csr_array.from_parts(
+        As.data.copy(), As.indices.copy(), As.indptr.copy(), As.shape
+    )
+    for axis in (None, 0, 1):
+        want = np.asarray(As.argmin(axis=axis)).ravel()
+        got = np.asarray(A.argmin(axis=axis)).ravel()
+        np.testing.assert_array_equal(got, want)
+        want = np.asarray(As.argmax(axis=axis)).ravel()
+        got = np.asarray(A.argmax(axis=axis)).ravel()
+        np.testing.assert_array_equal(got, want)
+
+
+def test_reduction_out_param_raises():
+    """scipy raises ValueError for out= on sparse reductions; so do we."""
+    A, _ = _pair(3, 3, 0.5, 7)
+    buf = np.zeros(3)
+    for name in ("max", "min", "nanmax", "nanmin", "argmax", "argmin"):
+        with pytest.raises(ValueError):
+            getattr(A, name)(axis=1, out=buf)
+
+
+def test_argminmax_inf_nan_collision():
+    """NaN must beat a stored inf for argmax (and -inf for argmin) — the
+    NaN key is separate from the value key, never folded in as np.inf."""
+    As = sp.csr_array(np.array([[np.inf, np.nan], [-np.inf, np.nan]]))
+    A = sparse_tpu.csr_array.from_parts(
+        As.data.copy(), As.indices.copy(), As.indptr.copy(), As.shape
+    )
+    np.testing.assert_array_equal(
+        np.asarray(A.argmax(axis=1)).ravel(),
+        np.asarray(As.argmax(axis=1)).ravel(),
+    )
+    np.testing.assert_array_equal(
+        np.asarray(A.argmin(axis=1)).ravel(),
+        np.asarray(As.argmin(axis=1)).ravel(),
+    )
+
+
+def test_argminmax_int64_exact_past_2_53():
+    """The value key stays in the native dtype: 2**53 and 2**53+1 collide in
+    float64 but must still argsort exactly."""
+    big = 2**53
+    dense = np.array([[big, big + 1], [-big - 1, -big]], dtype=np.int64)
+    As = sp.csr_array(dense)
+    A = sparse_tpu.csr_array.from_parts(
+        As.data.copy(), As.indices.copy(), As.indptr.copy(), As.shape
+    )
+    for axis in (0, 1):
+        np.testing.assert_array_equal(
+            np.asarray(A.argmax(axis=axis)).ravel(),
+            np.asarray(dense.argmax(axis=axis)).ravel(),
+        )
+        np.testing.assert_array_equal(
+            np.asarray(A.argmin(axis=axis)).ravel(),
+            np.asarray(dense.argmin(axis=axis)).ravel(),
+        )
