@@ -17,6 +17,15 @@ ALWAYS printed to stdout — the LAST metric line is authoritative (the
 worker checkpoints a record before slow optional sweeps, then prints an
 updated one):
   {"metric": ..., "value": N, "unit": "iters/s", "vs_baseline": N}
+
+Budget management (VERDICT r2 #1): a ~120s PROBE subprocess decides
+whether the TPU backend is alive BEFORE any full-size attempt can burn
+the budget hanging in backend init. On a dead/wedged backend the CPU
+fallback line is captured immediately (~minutes into the run, not at the
+end), then the probe keeps retrying so a late tunnel recovery still
+yields a TPU line within the budget. The TPU worker leads with the
+fused-CG headline (the best number) and checkpoints after every stage.
+Total budget via BENCH_BUDGET_S (default 870s).
 """
 
 import json
@@ -286,11 +295,57 @@ def run_fused(n: int, iters: int, tiles=(65536, 16384)):
     return best, label
 
 
+def run_fused_headline(n: int, iters: int, tile: int = 65536):
+    """ONE fused-CG variant — the known-best twopass/tile geometry from the
+    r2 hardware sweep — measured first so the headline exists within ~2
+    compiles of worker start. Gated on a finite residual. Returns
+    iters/s or None."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from sparse_tpu.kernels.cg_dia import cg_dia_fused
+    from sparse_tpu.models.poisson import laplacian_2d_dia
+    from sparse_tpu.ops.dia_spmv import dia_spmv_xla
+
+    N = n * n
+    planes, offsets = laplacian_2d_dia(n)
+    xtrue = jax.random.normal(jax.random.PRNGKey(0), (N,), jnp.float32)
+    b = dia_spmv_xla(planes, offsets, xtrue, (N, N))
+    out = cg_dia_fused(planes, offsets, b, None, N, iters=iters, tile=tile)
+    rho = float(out[2])  # compile + warm + convergence proxy
+    if not np.isfinite(rho):
+        print(f"bench: fused headline rho={rho} not finite", file=sys.stderr)
+        return None
+    best = 0.0
+    for _ in range(3):
+        t0 = time.perf_counter()
+        out = cg_dia_fused(planes, offsets, b, None, N, iters=iters, tile=tile)
+        float(out[2])
+        best = max(best, iters / (time.perf_counter() - t0))
+    return best
+
+
+def _vs_pde(v: float, n: int) -> float:
+    return round(
+        (v * n * n) / (BASELINE_ITERS_PER_S * BASELINE_N * BASELINE_N), 3
+    )
+
+
 def worker(platform_arg: str) -> None:
     """Run the measurement on one platform; print the JSON line on success.
 
     platform_arg: 'default' (whatever the environment provides, e.g. the
     TPU tunnel) or 'cpu' (forced before the jax import).
+
+    TPU stage order (best number first, checkpoint after every stage —
+    the parent parses the LAST metric line, so a timeout/fault in a later
+    stage can never lose an earlier measurement):
+      1. fused CG @6000^2, the single known-best variant   -> headline
+      2. step-loop CG (fallback headline + comparison row)
+      3. 11-diag SpMV microbenchmark (f32 + bf16)
+      4. kernel GFLOPS sweep
+      5. full fused variant sweep (refines the headline if better)
     """
     if platform_arg == "cpu":
         # the axon plugin overrides the env var; set the config knob too
@@ -306,70 +361,129 @@ def worker(platform_arg: str) -> None:
     enable_compilation_cache()  # reruns skip the 20-40 s tunnel compiles
 
     platform = jax.devices()[0].platform
-    sizes = [6000, 4000, 2000, 512] if platform != "cpu" else [512]
-    for n in sizes:
-        try:
-            best = run_size(n, ITERS)
+    if platform != "cpu":
+        rec = None
+        n = 6000
+        for n_try in (6000, 4000, 2000):
+            try:  # stage 1: fused headline
+                fused = run_fused_headline(n_try, ITERS)
+            except Exception:
+                traceback.print_exc(file=sys.stderr)
+                fused = None
+            if fused:
+                n = n_try
+                rec = {
+                    "metric": f"cg_iters_per_s_pde{n}_{platform}_fused",
+                    "value": round(fused, 2),
+                    "unit": "iters/s",
+                    "vs_baseline": _vs_pde(fused, n),
+                    "fused_cg_iters_per_s": round(fused, 2),
+                    "fused_cg_variant": "twopass_t65536",
+                }
+                print(json.dumps(rec))
+                sys.stdout.flush()
+                break
+        for n_try in ((n,) if rec else (6000, 4000, 2000, 512)):
+            try:  # stage 2: step-loop CG
+                best = run_size(n_try, ITERS)
+            except Exception:
+                traceback.print_exc(file=sys.stderr)
+                print(f"bench worker: size {n_try} failed", file=sys.stderr)
+                continue
+            if rec is None:
+                n = n_try
+                rec = {
+                    "metric": f"cg_iters_per_s_pde{n}_{platform}",
+                    "value": round(best, 2),
+                    "unit": "iters/s",
+                    "vs_baseline": _vs_pde(best, n),
+                }
+            rec["step_loop_iters_per_s"] = round(best, 2)
+            print(json.dumps(rec))
+            sys.stdout.flush()
+            break
+        if rec is None:
+            sys.exit(3)  # every size failed on both paths
+        try:  # stage 3: the reference's SpMV microbenchmark row (347.7)
+            v = run_spmv_11diag()
+            rec["spmv_11diag_iters_per_s"] = round(v, 1)
+            rec["spmv_11diag_vs_baseline"] = round(
+                v / SPMV_BASELINE_ITERS_PER_S, 2
+            )
+            import jax.numpy as jnp
+
+            rec["spmv_11diag_bf16_iters_per_s"] = round(
+                run_spmv_11diag(plane_dtype=jnp.bfloat16), 1
+            )
         except Exception:
             traceback.print_exc(file=sys.stderr)
-            print(f"bench worker: size {n} failed; trying next", file=sys.stderr)
-            continue
-        vs = (best * n * n) / (BASELINE_ITERS_PER_S * BASELINE_N * BASELINE_N)
-        rec = {
-            "metric": f"cg_iters_per_s_pde{n}_{platform}",
-            "value": round(best, 2),
-            "unit": "iters/s",
-            "vs_baseline": round(vs, 3),
-        }
-        try:  # per-kernel GFLOPS/roofline diagnostics (never fatal)
-            sweep_n = min(n, 2000) if platform == "tpu" else 256
+        print(json.dumps(rec))
+        sys.stdout.flush()
+        try:  # stage 4: per-kernel GFLOPS/roofline diagnostics
+            sweep_n = min(n, 2000)
             rec["kernels"] = kernel_sweep(sweep_n, platform)
             rec["kernels_n"] = sweep_n
         except Exception:
             traceback.print_exc(file=sys.stderr)
-        if platform == "tpu":
-            try:  # the reference's SpMV microbenchmark row (347.7 iters/s)
-                v = run_spmv_11diag()
-                rec["spmv_11diag_iters_per_s"] = round(v, 1)
-                rec["spmv_11diag_vs_baseline"] = round(
-                    v / SPMV_BASELINE_ITERS_PER_S, 2
-                )
-            except Exception:
-                traceback.print_exc(file=sys.stderr)
-            try:  # bf16 plane stream (exact here; separate key)
-                import jax.numpy as jnp
-
-                rec["spmv_11diag_bf16_iters_per_s"] = round(
-                    run_spmv_11diag(plane_dtype=jnp.bfloat16), 1
-                )
-            except Exception:
-                traceback.print_exc(file=sys.stderr)
-            # checkpoint the record BEFORE the long fused sweep: the parent
-            # parses the LAST metric line, so a timeout/fault during the
-            # sweep cannot lose the headline measurements above
-            print(json.dumps(rec))
-            sys.stdout.flush()
-            # fused CG variants (kernels/cg_dia.py): attempted LAST
-            try:
-                fused_result = run_fused(n, ITERS)
-                if fused_result:
-                    fused, fused_label = fused_result
+        print(json.dumps(rec))
+        sys.stdout.flush()
+        try:  # stage 5: full fused sweep — refines the headline if better
+            fused_result = run_fused(n, ITERS)
+            if fused_result:
+                fused, fused_label = fused_result
+                if fused > rec.get("fused_cg_iters_per_s", 0.0):
                     rec["fused_cg_iters_per_s"] = round(fused, 2)
                     rec["fused_cg_variant"] = fused_label
-                    if fused > rec["value"]:
-                        rec["value"] = round(fused, 2)
-                        rec["vs_baseline"] = round(
-                            (fused * n * n)
-                            / (BASELINE_ITERS_PER_S * BASELINE_N * BASELINE_N),
-                            3,
-                        )
-                        rec["metric"] = f"cg_iters_per_s_pde{n}_{platform}_fused"
-            except Exception:
-                traceback.print_exc(file=sys.stderr)
+                if fused > rec["value"]:
+                    rec["value"] = round(fused, 2)
+                    rec["vs_baseline"] = _vs_pde(fused, n)
+                    rec["metric"] = f"cg_iters_per_s_pde{n}_{platform}_fused"
+        except Exception:
+            traceback.print_exc(file=sys.stderr)
+        print(json.dumps(rec))
+        sys.stdout.flush()
+        return
+
+    # cpu fallback: small, fast, zero-compile-risk salvage line
+    for n in (512,):
+        try:
+            best = run_size(n, ITERS)
+        except Exception:
+            traceback.print_exc(file=sys.stderr)
+            print(f"bench worker: size {n} failed", file=sys.stderr)
+            continue
+        rec = {
+            "metric": f"cg_iters_per_s_pde{n}_{platform}",
+            "value": round(best, 2),
+            "unit": "iters/s",
+            "vs_baseline": _vs_pde(best, n),
+        }
+        try:
+            rec["kernels"] = kernel_sweep(256, platform)
+            rec["kernels_n"] = 256
+        except Exception:
+            traceback.print_exc(file=sys.stderr)
         print(json.dumps(rec))
         sys.stdout.flush()
         return
     sys.exit(3)  # every size failed
+
+
+def probe() -> None:
+    """--probe mode: report whether the default backend is a live TPU.
+
+    Runs in a subprocess under a hard watchdog — a wedged tunnel hangs in
+    backend init and the PARENT decides it's dead by timeout. Prints one
+    JSON line {"platform": ..., "alive": true} on success."""
+    import jax
+    import jax.numpy as jnp
+
+    d = jax.devices()[0]
+    # one tiny op end-to-end: backend that enumerates devices but cannot
+    # execute (half-wedged tunnel) must fail the probe too
+    v = float(jnp.sum(jnp.ones((8, 8)) * 2.0))
+    assert v == 128.0
+    print(json.dumps({"platform": d.platform, "alive": True}))
 
 
 GMG_BASELINE_ITERS_PER_S = 37.2  # reference: 4500^2/GPU V-cycle CG, 1x V100
@@ -507,37 +621,103 @@ def _try_platform(platform_arg: str, timeout_s: int):
     return None
 
 
+def _probe_tpu(timeout_s: float) -> str:
+    """Run the --probe subprocess. Returns one of:
+    'tpu'  — a live non-cpu backend answered within the watchdog;
+    'cpu'  — the backend healthily reports CPU (no tunnel configured:
+             re-probing cannot conjure a TPU, don't burn budget on it);
+    'dead' — timeout/crash (the wedged-tunnel signature: worth re-probing,
+             tunnels have been observed to recover mid-run)."""
+    timeout_s = max(10.0, timeout_s)
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--probe"],
+            capture_output=True,
+            text=True,
+            timeout=timeout_s,
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+        )
+    except subprocess.TimeoutExpired:
+        print(f"bench: probe timed out after {timeout_s:.0f}s", file=sys.stderr)
+        return "dead"
+    for line in reversed(proc.stdout.strip().splitlines()):
+        try:
+            rec = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        if rec.get("alive"):
+            print(f"bench: probe sees {rec['platform']}", file=sys.stderr)
+            return "tpu" if rec["platform"] != "cpu" else "cpu"
+    sys.stderr.write(proc.stderr[-1500:])
+    print(f"bench: probe rc={proc.returncode}, backend dead", file=sys.stderr)
+    return "dead"
+
+
+PROBE_TIMEOUT_S = 120.0
+# a late TPU attempt needs ~2 compiles (~40s each through the tunnel,
+# near-zero with a warm .jax_cache) + 3 timed reps + headroom
+MIN_TPU_ATTEMPT_S = 240.0
+
+
 def main():
+    t_start = time.monotonic()
+    budget_s = float(os.environ.get("BENCH_BUDGET_S", "870"))
+
+    def remaining():
+        return budget_s - (time.monotonic() - t_start)
+
     rec = None
     try:
-        # ALWAYS keep the forced-cpu fallback: the axon plugin overrides a
-        # JAX_PLATFORMS=cpu env var, so "the environment says cpu" does not
-        # mean the default attempt will actually run on cpu (observed: a
-        # wedged tunnel hanging the default attempt for its full timeout)
-        attempts = [("default", 900), ("cpu", 600)]
-        for platform_arg, timeout_s in attempts:
-            rec = _try_platform(platform_arg, timeout_s)
+        # the probe (~120s watchdog) decides whether the TPU attempt may
+        # run at all — a wedged backend init can no longer burn the whole
+        # budget before the CPU fallback gets a chance (VERDICT r2 #1)
+        status = _probe_tpu(min(PROBE_TIMEOUT_S, remaining() - 60))
+        if status == "tpu":
+            rec = _try_platform("default", max(60, remaining() - 90))
+        if rec is None:
+            # dead/wedged tunnel (or TPU worker failure): salvage the CPU
+            # line NOW. Then — only for the wedged-tunnel signature
+            # ('dead', not a healthy cpu-only answer) — keep probing, so a
+            # late tunnel recovery still yields a TPU line within budget.
+            rec = _try_platform("cpu", min(420, max(60, remaining() - 30)))
             if rec is not None:
-                break
+                print(json.dumps(rec))
+                sys.stdout.flush()
+            while (
+                status == "dead"
+                and remaining() > PROBE_TIMEOUT_S + MIN_TPU_ATTEMPT_S
+            ):
+                time.sleep(min(30, max(0, remaining() - MIN_TPU_ATTEMPT_S)))
+                status = _probe_tpu(PROBE_TIMEOUT_S)
+                if status == "tpu":
+                    trec = _try_platform("default", remaining() - 30)
+                    if trec is not None and "_tpu" in trec.get("metric", ""):
+                        rec = trec
+                        break
         if rec is not None:
             # checkpoint BEFORE the slow example phases: a hard kill during
             # GMG/quantum must not lose the headline (finally does not
             # survive SIGKILL; the driver reads the LAST metric line)
             print(json.dumps(rec))
             sys.stdout.flush()
-        if rec is not None and "_tpu" in rec.get("metric", ""):
+        if (
+            rec is not None
+            and "_tpu" in rec.get("metric", "")
+            and remaining() > 180
+        ):
             try:  # second headline (GMG) — best-effort, never fatal
-                gmg = _try_gmg()
+                gmg = _try_gmg(timeout_s=int(max(120, remaining() - 60)))
                 if gmg:
                     rec.update(gmg)
             except Exception:
                 traceback.print_exc(file=sys.stderr)
-            try:  # quantum evolution row — best-effort, never fatal
-                q = _try_quantum()
-                if q:
-                    rec.update(q)
-            except Exception:
-                traceback.print_exc(file=sys.stderr)
+            if remaining() > 150:
+                try:  # quantum evolution row — best-effort, never fatal
+                    q = _try_quantum(timeout_s=int(max(90, remaining() - 30)))
+                    if q:
+                        rec.update(q)
+                except Exception:
+                    traceback.print_exc(file=sys.stderr)
     except Exception:
         traceback.print_exc(file=sys.stderr)
     finally:
@@ -555,5 +735,7 @@ def main():
 if __name__ == "__main__":
     if len(sys.argv) > 1 and sys.argv[1] == "--worker":
         worker(sys.argv[2])
+    elif len(sys.argv) > 1 and sys.argv[1] == "--probe":
+        probe()
     else:
         main()

@@ -38,6 +38,23 @@ except ImportError:  # pragma: no cover
     from jax.experimental.shard_map import shard_map
 
 
+# Diagnostic record of the last dist_spgemm's per-shard memory footprint
+# (entries, not bytes). Tests assert the image gather keeps per-device B
+# size ~nnz(B)/S instead of nnz(B); benchmarks report it.
+LAST_STATS: dict = {}
+
+
+def _bucket(v: int, bits: int = 3) -> int:
+    """Shape bucket: pow2 envelope quantized to 2**bits steps per octave
+    (≤ 1/2**bits padding) — compile-shape reuse without pow2's up-to-2x
+    memory overshoot."""
+    from ..ops.spgemm import _next_pow2
+
+    v = max(int(v), 1)
+    step = max(_next_pow2(v) >> bits, 1)
+    return -(-v // step) * step
+
+
 def _row_block(indptr, indices, data, r0: int, r1: int):
     """Host-side zero-copy row slice of a CSR triple."""
     lo, hi = int(indptr[r0]), int(indptr[r1])
@@ -62,40 +79,49 @@ def _pad_block(ip, ix, dv, rows_pad: int, nnz_pad: int):
     jax.jit, static_argnames=("mesh", "axis", "n", "T", "dt", "m_real")
 )
 def _esc_sharded(
-    ipA, ixA, dvA, ip_b, ix_b, dv_b, mesh, axis, n, T, dt, m_real
+    ipA, ixA, dvA, ipB, ixB, dvB, mesh, axis, n, T, dt, m_real
 ):
-    """All S tiles in ONE compiled shard_map program: A tiles sharded on
-    the mesh, B replicated — so the grid runs concurrently and the compile
-    is shared across shards AND across calls with the same bucket shapes
-    (successive AMG levels, repeated Galerkin products). The per-shard body
-    is the shared traced ESC core (``ops.spgemm.esc_expand_sort_compress``,
-    the row-gather SpGEMM tile of reference csr.py:1390-1490)."""
+    """All S tiles in ONE compiled shard_map program: A tiles AND each
+    shard's image-gathered B tile sharded on the mesh — so the grid runs
+    concurrently and the compile is shared across shards AND across calls
+    with the same bucket shapes (successive AMG levels, repeated Galerkin
+    products). The per-shard body is the shared traced ESC core
+    (``ops.spgemm.esc_expand_sort_compress``, the row-gather SpGEMM tile of
+    reference csr.py:1390-1490); A's column ids arrive pre-remapped into
+    the local B row space."""
     from ..ops.spgemm import esc_expand_sort_compress
 
-    def shard_fn(ipA_l, ixA_l, dvA_l, ip_b, ix_b, dv_b):
+    def shard_fn(ipA_l, ixA_l, dvA_l, ipB_l, ixB_l, dvB_l):
         ur, uc, uv, nu = esc_expand_sort_compress(
             ipA_l.squeeze(0), ixA_l.squeeze(0), dvA_l.squeeze(0),
-            ip_b, ix_b, dv_b, n=n, T=T, U=T, dt=dt, m_real=m_real,
+            ipB_l.squeeze(0), ixB_l.squeeze(0), dvB_l.squeeze(0),
+            n=n, T=T, U=T, dt=dt, m_real=m_real,
         )
         return ur[None], uc[None], uv[None], nu.astype(jnp.int64)[None]
 
     return shard_map(
         shard_fn,
         mesh=mesh,
-        in_specs=(P(axis, None), P(axis, None), P(axis, None), P(), P(), P()),
+        in_specs=(
+            P(axis, None), P(axis, None), P(axis, None),
+            P(axis, None), P(axis, None), P(axis, None),
+        ),
         out_specs=(P(axis, None), P(axis, None), P(axis, None), P(axis)),
         check_vma=False,
-    )(ipA, ixA, dvA, ip_b, ix_b, dv_b)
+    )(ipA, ixA, dvA, ipB, ixB, dvB)
 
 
 def dist_spgemm(A, B, mesh=None, balanced: bool = True):
     """C = A @ B (both ``csr_array``) with A row-split over the mesh.
 
     The row-gather algorithm (csr.py:1390-1490): shard s computes
-    ``A[rows_s] @ B`` as a local tile (B replicated, like the reference's
-    gathered-C) — all S tiles padded to one bucket shape and launched as a
-    single shard_map program — then the host stitches tiles with one pos
-    scan. Returns a ``csr_array``.
+    ``A[rows_s] @ B_image_s`` as a local tile, where ``B_image_s`` holds
+    ONLY the B rows reachable from shard s's A columns (the image
+    partition of reference csr.py:1447-1465) — per-shard B memory scales
+    as nnz(B)/S for banded operators, never as nnz(B). All S tiles are
+    padded to one bucket shape and launched as a single shard_map
+    program, then the host stitches tiles with one pos scan. Returns a
+    ``csr_array``.
     """
     import sparse_tpu
 
@@ -112,6 +138,8 @@ def dist_spgemm(A, B, mesh=None, balanced: bool = True):
     indices = np.asarray(A.indices)
     data = np.asarray(A.data)
     b_indptr = np.asarray(B.indptr)
+    b_indices = np.asarray(B.indices)
+    b_data = np.asarray(B.data)
     dt = np.result_type(A.dtype, B.dtype)
     splits = (
         balanced_row_splits(indptr, S) if balanced else equal_row_splits(m, S)
@@ -133,38 +161,84 @@ def dist_spgemm(A, B, mesh=None, balanced: bool = True):
     nnz_pad = _next_pow2(
         max(int(indptr[splits[s + 1]] - indptr[splits[s]]) for s in range(S))
     )
-    # expansion bucket from a cheap host pass (the reference's NNZ phase)
     bcounts = np.diff(b_indptr).astype(np.int64)
-    exp_per_nnz = bcounts[indices]
-    totals = [
-        int(exp_per_nnz[indptr[splits[s]] : indptr[splits[s + 1]]].sum())
-        for s in range(S)
-    ]
+
+    # Image of B per shard: the sorted unique B rows this shard's A columns
+    # touch. One host pass (the expansion bucket below reuses its slices) —
+    # the reference computes the same set as a Legion image partition.
+    kb_rows = []
+    totals = []
+    for s in range(S):
+        lo, hi = int(indptr[splits[s]]), int(indptr[splits[s + 1]])
+        cols_s = np.unique(indices[lo:hi])
+        kb_rows.append(cols_s)
+        # expansion bucket from the same pass (the reference's NNZ phase)
+        totals.append(int(bcounts[indices[lo:hi]].sum()))
     T = _next_pow2(max(totals) + 1)
+    kb_real = max((r.size for r in kb_rows), default=1)
+    # B image tiles use a FINER shape bucket than pow2 (pow2 envelope, 1/8
+    # steps): a banded operator's image is ~nnz(B)/S + halo, and rounding
+    # that up to a full power of two could double per-device B memory —
+    # exactly what the image gather exists to avoid. ≤12.5% padding keeps
+    # the per-device footprint ∝ nnz(B)/S while still bucketing shapes.
+    kb_pad = _bucket(kb_real)
+    bnnz_pad = _bucket(
+        max(
+            (int(bcounts[r].sum()) for r in kb_rows if r.size),
+            default=1,
+        )
+    )
 
     # indices stay in their native width (int32 when the inputs fit) — the
-    # replicated B index gathers dominate the tile's memory traffic
+    # B index gathers dominate the tile's memory traffic
     idx_dt = np.int32 if max(n, k, int(indptr[-1]), int(b_indptr[-1])) < 2**31 else np.int64
     ipA = np.zeros((S, rows_pad + 1), dtype=idx_dt)
     ixA = np.zeros((S, nnz_pad), dtype=idx_dt)
     dvA = np.zeros((S, nnz_pad), dtype=data.dtype)
+    ipB = np.zeros((S, kb_pad + 1), dtype=idx_dt)
+    ixB = np.zeros((S, bnnz_pad), dtype=idx_dt)
+    dvB = np.zeros((S, bnnz_pad), dtype=b_data.dtype)
     for s in range(S):
-        ip, ix, dv = _pad_block(
-            *_row_block(indptr, indices, data, int(splits[s]), int(splits[s + 1])),
-            rows_pad,
-            nnz_pad,
+        r0, r1 = int(splits[s]), int(splits[s + 1])
+        ip, ix, dv = _row_block(indptr, indices, data, r0, r1)
+        # remap A's column ids into the local (gathered) B row space
+        ix = np.searchsorted(kb_rows[s], ix).astype(idx_dt)
+        ipA[s], ixA[s], dvA[s] = _pad_block(ip, ix, dv, rows_pad, nnz_pad)
+        rws = kb_rows[s]
+        cnts = bcounts[rws]
+        local_ip = np.zeros(rws.size + 1, dtype=np.int64)
+        np.cumsum(cnts, out=local_ip[1:])
+        nb = int(local_ip[-1])
+        # vectorized nnz gather of the image rows
+        src = (
+            np.arange(nb, dtype=np.int64)
+            - np.repeat(local_ip[:-1], cnts)
+            + np.repeat(b_indptr[rws].astype(np.int64), cnts)
         )
-        ipA[s], ixA[s], dvA[s] = ip, ix, dv
+        ipB[s, : rws.size + 1] = local_ip
+        ipB[s, rws.size + 1 :] = nb
+        ixB[s, :nb] = b_indices[src]
+        dvB[s, :nb] = b_data[src]
+
+    LAST_STATS.clear()
+    LAST_STATS.update(
+        S=S,
+        nnz_B=int(b_indptr[-1]),
+        kb_pad=kb_pad,
+        bnnz_pad=bnnz_pad,
+        rows_pad=rows_pad,
+        nnz_pad=nnz_pad,
+        T=T,
+    )
 
     sh = NamedSharding(mesh, P(axis, None))
-    rep = NamedSharding(mesh, P())
     urows, ucols, uvals, nuniques = _esc_sharded(
         jax.device_put(ipA, sh),
         jax.device_put(ixA, sh),
         jax.device_put(dvA, sh),
-        jax.device_put(b_indptr.astype(idx_dt), rep),
-        jax.device_put(np.asarray(B.indices, dtype=idx_dt), rep),
-        jax.device_put(np.asarray(B.data), rep),
+        jax.device_put(ipB, sh),
+        jax.device_put(ixB, sh),
+        jax.device_put(dvB, sh),
         mesh=mesh, axis=axis, n=int(n), T=T, dt=jnp.dtype(dt),
         m_real=rows_real,
     )
