@@ -493,6 +493,36 @@ def _build_spmv_col(A: DistCSRCol):
     return jax.jit(smapped)
 
 
+def windows_to_halo(windows, C: int, S: int, halo_max_ratio: float = 1.0):
+    """Per-shard [lo, hi) padded-column windows -> (HL, HR, mode).
+
+    The single window-to-halo policy shared by ``shard_csr`` and the 2-D
+    SpGEMM's DistCSR output. ``settings.precise_windows`` keeps the
+    left/right overhangs separate (tighter slabs on asymmetric bands — the
+    LEGATE_SPARSE_PRECISE_IMAGES analog); the default collapses them to one
+    symmetric width. Overhang beyond ``halo_max_ratio * C`` total flips to
+    the all_gather fallback ('gather').
+    """
+    from ..config import settings
+
+    HL = HR = 0
+    mode = "halo"
+    for s in range(S):
+        lo, hi = windows[s]
+        if hi <= lo:
+            continue
+        HL = max(HL, int(s * C - lo))
+        HR = max(HR, int(hi - (s + 1) * C))
+    if not settings.precise_windows:
+        HL = HR = max(HL, HR)
+    if S == 1:
+        HL = HR = 0
+    if HL + HR > 2 * halo_max_ratio * C:
+        mode = "gather"
+        HL = HR = 0
+    return HL, HR, mode
+
+
 def shard_csr_cols(
     A,
     mesh: Mesh | None = None,
@@ -621,26 +651,9 @@ def shard_csr(
     )
 
     # Per-shard column windows -> halo widths (MinMaxImage analog,
-    # partition.py:139-214). settings.precise_windows keeps the left/right
-    # overhangs separate (tighter slabs on asymmetric bands, at the cost of
-    # the exact per-side analysis — the LEGATE_SPARSE_PRECISE_IMAGES analog);
-    # the default collapses them to one symmetric width.
+    # partition.py:139-214).
     windows = column_windows(indptr, pad_cols, row_splits)
-    HL = HR = 0
-    mode = "halo"
-    for s in range(S):
-        lo, hi = windows[s]
-        if hi <= lo:
-            continue
-        HL = max(HL, int(s * C - lo))
-        HR = max(HR, int(hi - (s + 1) * C))
-    if not settings.precise_windows:
-        HL = HR = max(HL, HR)
-    if S == 1:
-        HL = HR = 0
-    if HL + HR > 2 * halo_max_ratio * C:
-        mode = "gather"
-        HL = HR = 0
+    HL, HR, mode = windows_to_halo(windows, C, S, halo_max_ratio)
 
     # Row degree stats for layout choice.
     counts = np.diff(indptr)
@@ -808,3 +821,33 @@ def dist_cg(
     )
     xp, iters, converged = run(bp, xp)
     return xp, int(iters), bool(converged)
+
+
+def comm_stats(A: DistCSR, conv_test_iters: int = 25) -> dict:
+    """Per-CG-iteration collective cost model (VERDICT r2 #4).
+
+    Derived from the compiled program's structure, not measured: one SpMV
+    per iteration moves the halo (two ``ppermute`` payloads of HL/HR x
+    entries per shard, ``_build_spmv.gather_x``) or, in gather mode, an
+    ``all_gather`` of every other shard's x block; the CG recurrence
+    ``psum``s 2 scalars per iteration (rho, p.q) plus one norm every
+    ``conv_test_iters``. Weak-scaling regressions (halo width growing with
+    n/S instead of the matrix band) show up here without hardware.
+    """
+    it = np.dtype(A.dtype).itemsize
+    if A.mode == "halo":
+        halo_entries = A.HL + A.HR
+        spmv_bytes = halo_entries * it
+    else:
+        halo_entries = 0
+        spmv_bytes = (A.S - 1) * A.C * it  # all_gather receives per shard
+    psum_scalars = 2 + 1.0 / max(conv_test_iters, 1)
+    return {
+        "mode": A.mode,
+        "S": A.S,
+        "halo_entries_per_spmv": halo_entries,
+        "spmv_collective_bytes_per_shard": spmv_bytes,
+        "psum_scalars_per_iter": psum_scalars,
+        "cg_iter_collective_bytes_per_shard": spmv_bytes
+        + int(psum_scalars * it),
+    }

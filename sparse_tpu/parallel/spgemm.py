@@ -27,7 +27,7 @@ from functools import partial
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax.sharding import NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from .mesh import get_mesh, get_mesh_2d
 from .partition import balanced_row_splits, equal_row_splits
@@ -272,18 +272,201 @@ def dist_spgemm(A, B, mesh=None, balanced: bool = True):
     )
 
 
-def dist_spgemm_2d(A, B, mesh2d=None):
+@partial(
+    jax.jit,
+    static_argnames=("mesh", "k_dim", "cols_pad", "T", "dt", "rows_real"),
+)
+def _spgemm2d_tiles(
+    aip, aix, adv, bip, bix, bdv, col_starts, subsplits,
+    mesh, k_dim, cols_pad, T, dt, rows_real,
+):
+    """Phase 1 (reference LOCAL_TILES, csr.py:1513-1571) as ONE compiled
+    shard_map program over the whole (gx, gy) grid: A row blocks sharded on
+    gx (replicated over gy), B column blocks sharded on gy (replicated over
+    gx). Each device converts its B column block to row-major form and runs
+    the shared ESC tile. Returns per-device sorted COO triples (rows local
+    to the A row block, GLOBAL columns, values) padded to T with sentinel
+    rows == rows_real."""
+    from ..ops.conv import csr_to_csc
+    from ..ops.spgemm import esc_expand_sort_compress
+
+    ax_x, ax_y = mesh.axis_names
+
+    def body(aip_l, aix_l, adv_l, bip_l, bix_l, bdv_l, cst, sub):
+        # the CSC triple of B[:, c0:c1] is the CSR of its transpose
+        # [c, k]; csr_to_csc of that transpose is the CSR of the block
+        tb_ip, tb_ix, tb_dv = csr_to_csc(
+            bip_l.squeeze(0), bix_l.squeeze(0), bdv_l.squeeze(0),
+            (cols_pad, k_dim),
+        )
+        ur, uc, uv, _nu = esc_expand_sort_compress(
+            aip_l.squeeze(0), aix_l.squeeze(0), adv_l.squeeze(0),
+            tb_ip, tb_ix, tb_dv,
+            n=cols_pad, T=T, U=T, dt=dt, m_real=rows_real,
+        )
+        ucg = uc + cst.reshape(()).astype(uc.dtype)  # block-local -> global
+        # send bounds for the shuffle: entries of sub-block j' are rows in
+        # [sub[j'], sub[j'+1]); sentinels (row == rows_real) fall past the
+        # last boundary and are never sent
+        bounds = jnp.searchsorted(ur, sub.reshape(-1), side="left").astype(
+            jnp.int32
+        )
+        return (
+            ur[None, None],
+            ucg[None, None],
+            uv[None, None],
+            bounds[None, None],
+        )
+
+    return shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(
+            P(ax_x, None), P(ax_x, None), P(ax_x, None),
+            P(ax_y, None), P(ax_y, None), P(ax_y, None),
+            P(ax_y), P(ax_x, None),
+        ),
+        out_specs=(
+            P(ax_x, ax_y, None), P(ax_x, ax_y, None), P(ax_x, ax_y, None),
+            P(ax_x, ax_y, None),
+        ),
+        check_vma=False,
+    )(aip, aix, adv, bip, bix, bdv, col_starts, subsplits)
+
+
+@partial(
+    jax.jit,
+    static_argnames=(
+        "mesh", "cap", "U", "gy", "rows_real", "R_out", "S_out", "C_out",
+        "native",
+    ),
+)
+def _spgemm2d_shuffle(
+    r, c, v, subsplits, row_off, col_splits_out,
+    mesh, cap, U, gy, rows_real, R_out, S_out, C_out, native,
+):
+    """Phase 2+3 (reference COMM_COMPUTE + SHUFFLE, csr.py:1592-1728) on
+    device: each device slices its tile by destination row sub-block and a
+    ``ragged_all_to_all`` along the gy axis lands every row block's tiles
+    on its owner device — tile (i, j') sends the rows of sub-block (i, j)
+    to device (i, j). The received chunks (one per source j', col-disjoint
+    and ordered) merge with ONE stable row sort. Output: per-device local
+    COO in the DistCSR padded coordinate space ([S_out*C_out] columns) plus
+    per-device valid counts and column-window stats."""
+    from .sort import _ragged_a2a
+
+    ax_x, ax_y = mesh.axis_names
+
+    def body(r_l, c_l, v_l, sub, roff, csp):
+        r1 = r_l.reshape(-1)
+        c1 = c_l.reshape(-1)
+        v1 = v_l.reshape(-1)
+        bounds = jnp.searchsorted(r1, sub.reshape(-1), side="left").astype(
+            jnp.int32
+        )
+        starts, send = bounds[:-1], bounds[1:] - bounds[:-1]
+        recv = jax.lax.all_to_all(send[:, None], ax_y, 0, 0).reshape(-1)
+        out_off = jnp.concatenate(
+            [jnp.zeros((1,), jnp.int32), jnp.cumsum(recv)[:-1].astype(jnp.int32)]
+        )
+        sent_row = jnp.asarray(rows_real, r1.dtype)  # > any real local row
+        r2 = _ragged_a2a(
+            r1, jnp.full((cap,), sent_row), starts, send, out_off, recv,
+            ax_y, gy, U, native,
+        )
+        c2 = _ragged_a2a(
+            c1, jnp.zeros((cap,), c1.dtype), starts, send, out_off, recv,
+            ax_y, gy, U, native,
+        )
+        v2 = _ragged_a2a(
+            v1, jnp.zeros((cap,), v1.dtype), starts, send, out_off, recv,
+            ax_y, gy, U, native,
+        )
+        # chunks arrive in source order (out_off is cumsum over j') with
+        # disjoint ascending column ranges, and each chunk is (row, col)
+        # sorted — ONE stable sort by row is a full (row, col) merge
+        order = jnp.argsort(r2, stable=True)
+        r2, c2, v2 = r2[order], c2[order], v2[order]
+        nvalid = jnp.sum(recv).astype(jnp.int32)
+        slot = jnp.arange(cap, dtype=jnp.int32)
+        valid = slot < nvalid
+        rloc = jnp.where(
+            valid,
+            jnp.clip(r2 - roff.reshape(()).astype(r2.dtype), 0, R_out - 1),
+            R_out - 1,
+        ).astype(jnp.int32)
+        # global column -> DistCSR padded coordinate space
+        csp = csp.reshape(-1)
+        cshard = jnp.clip(
+            jnp.searchsorted(csp, c2, side="right") - 1, 0, S_out - 1
+        )
+        pcol = cshard.astype(jnp.int64) * C_out + (
+            c2.astype(jnp.int64) - csp[cshard].astype(jnp.int64)
+        )
+        pcol = jnp.where(valid, pcol, 0)
+        v2 = jnp.where(valid, v2, 0)
+        big = jnp.asarray(S_out * C_out, pcol.dtype)
+        cmin = jnp.min(jnp.where(valid, pcol, big))
+        cmax = jnp.max(jnp.where(valid, pcol, -1))
+        return (
+            rloc[None, None],
+            pcol[None, None],
+            v2[None, None],
+            nvalid[None, None],
+            cmin[None, None],
+            cmax[None, None],
+        )
+
+    return shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(
+            P(ax_x, ax_y, None), P(ax_x, ax_y, None), P(ax_x, ax_y, None),
+            P(ax_x, None), P(ax_x, ax_y), P(None),
+        ),
+        out_specs=(
+            P(ax_x, ax_y, None), P(ax_x, ax_y, None), P(ax_x, ax_y, None),
+            P(ax_x, ax_y), P(ax_x, ax_y), P(ax_x, ax_y),
+        ),
+        check_vma=False,
+    )(r, c, v, subsplits, row_off, col_splits_out)
+
+
+@partial(jax.jit, static_argnames=("S_out", "cap", "W", "lidt", "sh1"))
+def _flatten_adjust(r3, c3, v3, offs, S_out, cap, W, lidt, sh1):
+    """[gx, gy, cap] 2-D-mesh tiles -> [S, cap] row-sharded on the 1-D mesh
+    (device-to-device resharding) with columns shifted into the DistCSR
+    window space. Module-level so repeated products with one bucket shape
+    share the compile."""
+    r2 = jax.lax.with_sharding_constraint(r3.reshape(S_out, cap), sh1)
+    c2 = jax.lax.with_sharding_constraint(
+        jnp.clip(c3.reshape(S_out, cap) - offs, 0, W - 1).astype(lidt), sh1
+    )
+    v2 = jax.lax.with_sharding_constraint(v3.reshape(S_out, cap), sh1)
+    return r2, c2, v2
+
+
+def dist_spgemm_2d(A, B, mesh2d=None, as_dist: bool = False):
     """C = A @ B on a 2-D (gx, gy) processor grid — the CSR x CSC analog.
 
     Tile (i, j) = ``A[rowblock_i] @ B[:, colblock_j]`` computed on device
-    (i, j): A's row blocks are replicated along grid-j and B's column blocks
-    along grid-i, matching the reference's 2-D replicated layout
-    (csr.py:1495-1571). B may be ``csc_array`` (column slicing is an indptr
-    slice) or ``csr_array`` (converted once). The shuffle phase
-    (csr.py:1592-1728) collapses into the host stitch: tiles of one row
-    block concatenate in grid-j order, already column-sorted.
+    (i, j): A's row blocks are replicated along grid-j and B's column
+    blocks along grid-i, matching the reference's 2-D replicated layout
+    (csr.py:1495-1571). The shuffle phase (csr.py:1592-1728) runs ON
+    DEVICE: a ``ragged_all_to_all`` along the gy axis lands each row
+    sub-block's entries on its owner device, where one stable row sort
+    merges them — the host only ever sees the O(S * gy) send-count matrix
+    (to size the exchange buffer) and O(S) window scalars, never the nnz.
+
+    ``as_dist=True`` returns the result as a row-sharded ``DistCSR``
+    (sub-block (i, j) of the row space owned by device (i, j), flattened
+    row-major); the default materializes a host ``csr_array`` by
+    concatenating the per-shard already-sorted blocks (no global lexsort).
     """
     import sparse_tpu
+
+    from ..ops.spgemm import _next_pow2
+    from .dist import DistCSR, windows_to_halo
 
     if mesh2d is None:
         mesh2d = get_mesh_2d()
@@ -293,6 +476,9 @@ def dist_spgemm_2d(A, B, mesh2d=None):
     k2, n = B.shape
     if k != k2:
         raise ValueError(f"dimension mismatch: {A.shape} @ {B.shape}")
+    if max(m, n, k) >= 2**31:
+        raise ValueError("dist_spgemm_2d uses int32/int64-mixed indices; "
+                         f"dimensions {A.shape} @ {B.shape} exceed int32")
 
     Bcsc = B.tocsc()
     b_indptr = np.asarray(Bcsc.indptr)
@@ -302,17 +488,14 @@ def dist_spgemm_2d(A, B, mesh2d=None):
     a_indptr = np.asarray(A.indptr)
     a_indices = np.asarray(A.indices)
     a_data = np.asarray(A.data)
+    dt = np.result_type(A.dtype, B.dtype)
     row_splits = balanced_row_splits(a_indptr, gx)
     col_splits = equal_row_splits(n, gy)
 
-    from ..ops.conv import csr_to_csc
-    from ..ops.spgemm import spgemm_csr_csr
-
-    from ..ops.spgemm import _next_pow2
-
-    # Uniform padded tile shapes -> one csr_to_csc + one ESC compile for
-    # the whole (gx, gy) grid.
-    rows_real = max(int(row_splits[i + 1] - row_splits[i]) for i in range(gx))
+    # Uniform padded tile shapes -> one compile for the whole grid.
+    rows_real = max(
+        max(int(row_splits[i + 1] - row_splits[i]) for i in range(gx)), 1
+    )
     rows_pad = _next_pow2(rows_real)
     annz_pad = _next_pow2(
         max(
@@ -329,65 +512,167 @@ def dist_spgemm_2d(A, B, mesh2d=None):
             for j in range(gy)
         )
     )
-    tiles = {}
-    real_rows = {}
-    for i in range(gx):
-        r0, r1 = int(row_splits[i]), int(row_splits[i + 1])
-        if r1 <= r0:
-            continue
-        aip, aix, adv = _pad_block(
-            *_row_block(a_indptr, a_indices, a_data, r0, r1), rows_pad, annz_pad
-        )
-        for j in range(gy):
-            c0, c1 = int(col_splits[j]), int(col_splits[j + 1])
-            if c1 <= c0:
-                continue
-            dev = grid[i, j]
-            # column block of B as a CSC triple, then to CSR on-device
-            bip, bix, bdv = _pad_block(
-                *_row_block(b_indptr, b_indices, b_data, c0, c1),
-                cols_pad,
-                bnnz_pad,
-            )
-            dev_put = lambda a: jax.device_put(np.ascontiguousarray(a), dev)
-            # the CSC triple of B[:, c0:c1] is the CSR of its transpose
-            # [c, k]; csr_to_csc of that transpose is the CSR of the block
-            tb_ip, tb_ix, tb_dv = csr_to_csc(
-                dev_put(bip), dev_put(bix), dev_put(bdv), (cols_pad, k)
-            )
-            tiles[(i, j)] = spgemm_csr_csr(
-                dev_put(aip), dev_put(aix), dev_put(adv),
-                tb_ip, tb_ix, tb_dv,
-                (rows_pad, k), (k, cols_pad),
-                m_real=rows_real,
-            )
-            real_rows[(i, j)] = r1 - r0
+    # expansion bucket: per column block j, the B row-length histogram over
+    # k, then per tile the sum at A's column ids (the reference's NNZ phase)
+    T = 1
+    for j in range(gy):
+        c0, c1 = int(col_splits[j]), int(col_splits[j + 1])
+        cnt_j = np.bincount(b_indices[b_indptr[c0] : b_indptr[c1]], minlength=k)
+        for i in range(gx):
+            lo, hi = int(a_indptr[row_splits[i]]), int(a_indptr[row_splits[i + 1]])
+            T = max(T, int(cnt_j[a_indices[lo:hi]].sum()))
+    T = _next_pow2(T + 1)
 
-    # Stitch: per row block, merge grid-j tiles row-by-row (vectorized
-    # lexsort assembly — the host-side analog of the 3-phase shuffle).
-    # Padded tile rows are empty; slice to the real row count.
-    rows_all, cols_all, vals_all = [], [], []
-    for (i, j), (tip, tix, tdv) in tiles.items():
-        nr = real_rows[(i, j)]
-        tip = np.asarray(tip).astype(np.int64)[: nr + 1]
-        nreal = int(tip[-1])
-        tix = np.asarray(tix).astype(np.int64)[:nreal]
-        tdv = np.asarray(tdv)[:nreal]
-        cnt = np.diff(tip)
-        trows = np.repeat(np.arange(len(cnt), dtype=np.int64), cnt)
-        rows_all.append(trows + int(row_splits[i]))
-        cols_all.append(tix + int(col_splits[j]))
-        vals_all.append(tdv)
-    if rows_all:
-        rows = np.concatenate(rows_all)
-        cols = np.concatenate(cols_all)
-        vals = np.concatenate(vals_all)
-        order = np.lexsort((cols, rows))
-        rows, cols, vals = rows[order], cols[order], vals[order]
-    else:
-        rows = cols = np.zeros(0, dtype=np.int64)
-        vals = np.zeros(0, dtype=np.result_type(A.dtype, B.dtype))
+    idx_dt = np.int32  # guarded above: every dimension fits int32
+    aipA = np.zeros((gx, rows_pad + 1), dtype=idx_dt)
+    aixA = np.zeros((gx, annz_pad), dtype=idx_dt)
+    advA = np.zeros((gx, annz_pad), dtype=a_data.dtype)
+    for i in range(gx):
+        aipA[i], aixA[i], advA[i] = _pad_block(
+            *_row_block(a_indptr, a_indices, a_data, int(row_splits[i]),
+                        int(row_splits[i + 1])),
+            rows_pad, annz_pad,
+        )
+    bipB = np.zeros((gy, cols_pad + 1), dtype=idx_dt)
+    bixB = np.zeros((gy, bnnz_pad), dtype=idx_dt)
+    bdvB = np.zeros((gy, bnnz_pad), dtype=b_data.dtype)
+    for j in range(gy):
+        bipB[j], bixB[j], bdvB[j] = _pad_block(
+            *_row_block(b_indptr, b_indices, b_data, int(col_splits[j]),
+                        int(col_splits[j + 1])),
+            cols_pad, bnnz_pad,
+        )
+    # row sub-splits: block i's rows split into gy owner sub-blocks
+    subsplits = np.zeros((gx, gy + 1), dtype=idx_dt)
+    for i in range(gx):
+        h = int(row_splits[i + 1] - row_splits[i])
+        subsplits[i] = equal_row_splits(h, gy)
+
+    ax_x, ax_y = mesh2d.axis_names
+    shx = NamedSharding(mesh2d, P(ax_x, None))
+    shy = NamedSharding(mesh2d, P(ax_y, None))
+    ur, uc, uv, bounds = _spgemm2d_tiles(
+        jax.device_put(aipA, shx),
+        jax.device_put(aixA, shx),
+        jax.device_put(advA, shx),
+        jax.device_put(bipB, shy),
+        jax.device_put(bixB, shy),
+        jax.device_put(bdvB, shy),
+        jax.device_put(
+            col_splits[:-1].astype(idx_dt), NamedSharding(mesh2d, P(ax_y))
+        ),
+        jax.device_put(subsplits, shx),
+        mesh=mesh2d, k_dim=int(k), cols_pad=cols_pad, T=T,
+        dt=jnp.dtype(dt), rows_real=rows_real,
+    )
+
+    # Host sees ONLY the O(gx*gy*gy) send-count matrix: size the exchange
+    # buffer to the tightest bucket over actual per-device receive totals.
+    bnds = np.asarray(bounds)  # [gx, gy, gy+1]
+    sends = bnds[:, :, 1:] - bnds[:, :, :-1]  # [gx, src j', dest j]
+    recv_tot = sends.sum(axis=1)  # [gx, dest j]
+    cap = _bucket(max(int(recv_tot.max()), 1))
+
+    S_out = gx * gy
+    R_out = max(
+        max(
+            int(subsplits[i, j + 1] - subsplits[i, j])
+            for i in range(gx)
+            for j in range(gy)
+        ),
+        1,
+    )
+    col_splits_out = equal_row_splits(n, S_out)
+    C_out = max(int(np.max(np.diff(col_splits_out))), 1)
+    native = jax.default_backend() == "tpu"
+    row_off = subsplits[:, :-1].astype(idx_dt)  # [gx, gy]
+    rloc, pcol, vals, nvalid, cmin, cmax = _spgemm2d_shuffle(
+        ur, uc, uv,
+        jax.device_put(subsplits, shx),
+        jax.device_put(row_off, NamedSharding(mesh2d, P(ax_x, ax_y))),
+        jax.device_put(
+            col_splits_out.astype(np.int64), NamedSharding(mesh2d, P(None))
+        ),
+        mesh=mesh2d, cap=cap, U=T, gy=gy, rows_real=rows_real, R_out=R_out,
+        S_out=S_out, C_out=C_out, native=native,
+    )
+
+    # O(S) window stats -> halo widths via the policy shared with shard_csr
+    cmin_h = np.asarray(cmin).reshape(-1)
+    cmax_h = np.asarray(cmax).reshape(-1)
+    nvalid_h = np.asarray(nvalid).reshape(-1).astype(np.int64)
+    windows = [(int(cmin_h[s]), int(cmax_h[s]) + 1) for s in range(S_out)]
+    HL, HR, mode = windows_to_halo(windows, C_out, S_out)
+
+    # flatten (i, j) row-major onto the 1-D mesh: sub-block (i, j) covers
+    # monotonically increasing global row ranges, so this IS row-sharding
+    mesh1d = Mesh(grid.reshape(-1), ("shards",))
+    sh1 = NamedSharding(mesh1d, P("shards", None))
+    W = C_out + HL + HR if mode == "halo" else S_out * C_out
+    offs = (
+        (np.arange(S_out, dtype=np.int64) * C_out - HL)[:, None]
+        if mode == "halo"
+        else np.zeros((S_out, 1), dtype=np.int64)
+    )
+    lidt = np.int32 if S_out * C_out < 2**31 else np.int64
+    nz_rows, nz_cols, nz_vals = _flatten_adjust(
+        rloc, pcol, vals, jax.device_put(offs, NamedSharding(mesh1d, P("shards", None))),
+        S_out=S_out, cap=cap, W=W, lidt=jnp.dtype(lidt), sh1=sh1,
+    )
+
+    row_splits_out = np.zeros(S_out + 1, dtype=np.int64)
+    for i in range(gx):
+        for j in range(gy):
+            row_splits_out[i * gy + j + 1] = (
+                int(row_splits[i]) + int(subsplits[i, j + 1])
+            )
+
+    dist = DistCSR(
+        mesh=mesh1d,
+        axis="shards",
+        shape=(int(m), int(n)),
+        row_splits=row_splits_out,
+        col_splits=col_splits_out,
+        R=R_out,
+        C=C_out,
+        HL=HL,
+        HR=HR,
+        mode=mode,
+        layout="csr",
+        dtype=np.dtype(dt),
+        nz_rows=nz_rows,
+        nz_cols=nz_cols,
+        nz_vals=nz_vals,
+    )
+    LAST_STATS.clear()
+    LAST_STATS.update(
+        S=S_out, cap=cap, T=T, R=R_out, C=C_out, HL=HL, HR=HR, mode=mode,
+        host_counts=int(sends.size),
+    )
+    if as_dist:
+        return dist
+
+    # host materialization: per-shard blocks are already (row, col) sorted —
+    # concatenate and count, NO global lexsort
+    nzr = np.asarray(nz_rows)
+    nzc = np.asarray(nz_cols)
+    nzv = np.asarray(nz_vals)
+    row_counts = np.zeros(m, dtype=np.int64)
+    parts_ix, parts_dv = [], []
+    for s in range(S_out):
+        nv = int(nvalid_h[s])
+        r0 = int(row_splits_out[s])
+        r1 = int(row_splits_out[s + 1])
+        row_counts[r0:r1] = np.bincount(nzr[s, :nv], minlength=R_out)[: r1 - r0]
+        # local/window col -> padded space -> global column id
+        pc = nzc[s, :nv].astype(np.int64) + int(offs[s, 0])
+        cshard = pc // C_out
+        parts_ix.append(pc - cshard * C_out + col_splits_out[cshard])
+        parts_dv.append(nzv[s, :nv])
     indptr = np.zeros(m + 1, dtype=np.int64)
-    np.add.at(indptr, rows + 1, 1)
-    indptr = np.cumsum(indptr)
-    return sparse_tpu.csr_array.from_parts(vals, cols, indptr, (m, n))
+    np.cumsum(row_counts, out=indptr[1:])
+    out_indices = (
+        np.concatenate(parts_ix) if parts_ix else np.zeros(0, dtype=np.int64)
+    )
+    out_data = np.concatenate(parts_dv) if parts_dv else np.zeros(0, dtype=dt)
+    return sparse_tpu.csr_array.from_parts(out_data, out_indices, indptr, (m, n))
