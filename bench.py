@@ -174,7 +174,6 @@ def kernel_sweep(n: int, platform: str) -> dict:
 
     if platform == "tpu":
         from sparse_tpu.kernels.dia_spmv import PreparedDia, dia_spmv_pallas
-        from sparse_tpu.kernels.ell_spmv import ell_spmv_pallas
 
         attempt(
             "dia_pallas",
@@ -185,14 +184,9 @@ def kernel_sweep(n: int, platform: str) -> dict:
         # kernel plus x pad / y trim (the honest drop-in form)
         prep = PreparedDia(planes, offsets, (N, N))
         attempt("dia_pallas_packed", prep, dia_bytes)
-        # ell_spmv_pallas delegates to the XLA gather path on real TPUs
-        # (Mosaic lacks the windowed-gather lowering, see kernels/ell_spmv)
-        # — label the entry so it cannot be read as an independent kernel
-        attempt(
-            "ell_pallas(->xla)",
-            lambda xx: ell_spmv_pallas(ell_idx, ell_val, xx, band=n),
-            ell_bytes,
-        )
+        # no ell_pallas row: general (non-banded) gather SpMV has no
+        # Mosaic-lowering-compatible kernel yet; its measured path IS
+        # ell_xla above (the dead delegating kernel was removed, r3)
     return out
 
 
@@ -561,16 +555,19 @@ def _try_quantum(timeout_s: int = 420):
     (scripts/summit/run_legate_quantum.sh) whose problem shape we don't
     replicate; the metric documents our absolute throughput on the
     ER-graph analog (examples/quantum_evolution.py)."""
-    nodes_list = (20, 16)
-    got = _run_example(
-        "quantum_evolution.py",
-        [["-nodes", str(nodes), "-t", "1.0"] for nodes in nodes_list],
-        timeout_s,
+    attempts = (
+        # the >=1e5-state scale shape first (cycle_graph(25): 167,761
+        # independent sets, VERDICT r2 #10), then the ER fallbacks
+        ["-graph", "cycle", "-nodes", "25", "-t", "0.05"],
+        ["-nodes", "20", "-t", "1.0"],
+        ["-nodes", "16", "-t", "1.0"],
     )
+    labels = ("cycle25", "nodes20", "nodes16")
+    got = _run_example("quantum_evolution.py", list(attempts), timeout_s)
     if got is None:
         return None
     v, i = got
-    return {f"quantum_iters_per_s_nodes{nodes_list[i]}": v}
+    return {f"quantum_iters_per_s_{labels[i]}": v}
 
 
 def _try_platform(platform_arg: str, timeout_s: int):

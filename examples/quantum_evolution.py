@@ -22,15 +22,31 @@ parser.add_argument("-nodes", type=int, default=14)
 parser.add_argument("-prob", type=float, default=0.35)
 parser.add_argument("-t", type=float, default=1.0)
 parser.add_argument("-seed", type=int, default=0)
+parser.add_argument(
+    "-graph", choices=("er", "cycle"), default="er",
+    help="cycle: C_n ring (L_n independent sets — '-graph cycle -nodes 25' "
+    "is the >=1e5-state scale shape of VERDICT r2 #10)",
+)
+parser.add_argument(
+    "-dist_shards", type=int, default=0,
+    help="route the build's group sorts + COO->CSR through the mesh "
+    "samplesort with this many shards (0 = single-host build)",
+)
 args, _ = parser.parse_known_args()
 common, timer, _np, sparse, linalg, use_tpu = parse_common_args()
 
 from sparse_tpu import integrate, quantum  # noqa: E402
 
-graph = nx.erdos_renyi_graph(args.nodes, args.prob, seed=args.seed)
+if args.graph == "cycle":
+    graph = nx.cycle_graph(args.nodes)
+else:
+    graph = nx.erdos_renyi_graph(args.nodes, args.prob, seed=args.seed)
 
 timer.start()
-driver = quantum.HamiltonianDriver(graph=graph, dtype=np.complex128)
+driver = quantum.HamiltonianDriver(
+    graph=graph, dtype=np.complex128,
+    dist_shards=args.dist_shards or None,
+)
 mis = quantum.HamiltonianMIS(graph=graph, poly=driver.ip, dtype=np.complex128)
 H_driver = driver.hamiltonian
 H_cost = mis.hamiltonian

@@ -48,9 +48,11 @@ class Settings:
     # zero-gather DIA kernel.
     dia_max_diags: int = 32
     dia_max_fill: float = 4.0
-    # Max |col - row| band at which the Pallas ELL SpMV (windowed x DMA)
-    # applies under spmv_mode == 'pallas'; wider bands exceed the VMEM
-    # window budget and take the XLA gather path.
+    # Max |col - row| band at which the fused Pallas CG iteration
+    # (kernels/cg_dia.py) applies — wider bands exceed the per-tile VMEM
+    # window budget. (spmv_mode == 'pallas' accelerates DIA-profiled
+    # matrices only; general ELL matrices always take the XLA gather —
+    # Mosaic has no windowed-gather lowering, VERDICT r2 #8.)
     pallas_max_band: int = 8192
     # linalg.cg fast path: unpreconditioned solves on banded (DIA-shaped)
     # f32 operators run the fused two-pass Pallas iteration

@@ -213,7 +213,10 @@ class dia_array(SparseArray):
 def _coo_to_dia(c):
     """COO -> (data, offsets, shape). Host-syncs the distinct-offset set."""
     m, n = c.shape
-    offs_dev = c.col.astype(jnp.int64) - c.row.astype(jnp.int64)
+    # offsets lie in [-m, n]: int32-exact for any dims that fit int32
+    # (an int64 request under no-x64 warns and truncates anyway)
+    odt = jnp.int64 if max(m, n) > 2**31 - 1 else jnp.int32
+    offs_dev = c.col.astype(odt) - c.row.astype(odt)
     offsets = np.unique(np.asarray(offs_dev))
     L = n
     nd = int(offsets.shape[0])

@@ -517,12 +517,16 @@ def gmres(
     n = b.shape[0]
     A = make_linear_operator(A)
     M = IdentityOperator(A.shape, dtype=A.dtype) if M is None else make_linear_operator(M)
+    # promote b to the operator's result dtype BEFORE sizing the Krylov
+    # basis: a real b with a complex A must build a complex basis (the
+    # jitted cycle would otherwise cast every Arnoldi vector to real)
+    b = b.astype(jnp.result_type(b.dtype, A.dtype))
     if restart is None:
         restart = min(20, n)
     restart = min(restart, n)
     if maxiter is None:
         maxiter = max(n // restart, 1) * 10
-    x = jnp.zeros_like(b) if x0 is None else asjnp(x0)
+    x = jnp.zeros_like(b) if x0 is None else asjnp(x0).astype(b.dtype)
     bnorm = jnp.linalg.norm(b)
     target = jnp.maximum(tol * bnorm, atol if atol is not None else 0.0)
     target = jnp.maximum(target, 1e-30)
@@ -764,6 +768,10 @@ def lsqr(
     """
     b = asjnp(b)
     A = make_linear_operator(A)
+    # promote to the operator's result dtype: the device while_loop carry
+    # must be dtype-stable (a real b with complex A would otherwise mix
+    # real x/u with complex v/w and fail to trace)
+    b = b.astype(jnp.result_type(b.dtype, A.dtype))
     m, n = A.shape
     if iter_lim is None:
         iter_lim = 2 * n
@@ -1214,11 +1222,17 @@ def eigsh(A, k=6, which="LM", v0=None, maxiter=None, tol=0.0, return_eigenvector
     if maxiter is None:
         maxiter = 10 * n
     rng = np.random.default_rng(0)
-    dt = jnp.float64 if jax.config.jax_enable_x64 else jnp.float32
+    # basis dtype follows the operator (and any user v0): a Hermitian
+    # complex A needs a complex Lanczos basis — a real one would silently
+    # project onto Re(A)'s action
+    base = jnp.float64 if jax.config.jax_enable_x64 else jnp.float32
+    dt = jnp.result_type(base, A.dtype)
+    if v0 is not None:
+        dt = jnp.result_type(dt, asjnp(v0).dtype)
     if v0 is None:
         v = jnp.asarray(rng.standard_normal(n), dtype=dt)
     else:
-        v = asjnp(v0)
+        v = asjnp(v0).astype(dt)
     v = v / jnp.linalg.norm(v)
     eff_tol = tol if tol > 0 else float(np.finfo(np.dtype(dt)).eps) * 10
     matvecs = 0

@@ -66,21 +66,32 @@ def esc_expand_sort_compress(
             f"esc_expand_sort_compress uses int32 pair indices; dimension "
             f"max(m_real+1={m_real + 1}, n={n}) exceeds int32 range"
         )
+    # expansion arithmetic dtype: values are bounded by T (the static
+    # expansion bucket) AND by nnz(B) (the indptr_b gather bases); int32
+    # covers every realistic tile, and requesting int64 under no-x64 (the
+    # real-TPU config) would emit a truncation warning and silently
+    # downcast anyway
+    ebound = max(int(T), int(data_b.shape[0]) + 1)
+    if ebound > 2**31 - 1 and not jax.config.jax_enable_x64:
+        raise ValueError(
+            f"expansion bound {ebound} needs int64 offsets; enable x64"
+        )
+    edt = jnp.int64 if ebound > 2**31 - 1 else jnp.int32
     nnz_a = indices_a.shape[0]
     rows_a = expand_rows(indptr_a, nnz_a)
     # expansion counts: |B row| at each A column id; caller-padded nnz
     # slots (beyond indptr_a[-1]) expand to nothing
     counts = indptr_b[indices_a + 1] - indptr_b[indices_a]
     counts = jnp.where(jnp.arange(nnz_a) < indptr_a[-1], counts, 0)
-    offsets = counts_to_indptr(counts, dtype=jnp.int64)
+    offsets = counts_to_indptr(counts, dtype=edt)
     total = offsets[-1]
-    t = jnp.arange(T, dtype=jnp.int64)
+    t = jnp.arange(T, dtype=edt)
     tvalid = t < total
     src = jnp.clip(
         jnp.searchsorted(offsets, t, side="right") - 1, 0, nnz_a - 1
     )
     p = jnp.clip(
-        indptr_b[indices_a[src]].astype(jnp.int64) + (t - offsets[src]),
+        indptr_b[indices_a[src]].astype(edt) + (t - offsets[src]),
         0,
         data_b.shape[0] - 1,
     )
@@ -138,10 +149,14 @@ def spgemm_csr_csr(
             jnp.zeros((0,), dtype=idt),
             jnp.zeros((0,), dtype=dt),
         )
-    # expansion size: one cheap host sync (the reference's NNZ phase)
+    # expansion size: one cheap host sync (the reference's NNZ phase).
+    # int32 accumulation under no-x64 is safe: a >2**31 expansion would
+    # exceed device memory long before the counter wraps (the x64 config
+    # keeps the exact int64 sum)
+    sdt = jnp.int64 if jax.config.jax_enable_x64 else jnp.int32
     counts = indptr_b[indices_a + 1] - indptr_b[indices_a]
     counts = jnp.where(jnp.arange(nnz_a) < indptr_a[-1], counts, 0)
-    total = host_int(jnp.sum(counts.astype(jnp.int64)))
+    total = host_int(jnp.sum(counts.astype(sdt)))
     if total == 0:
         idt = index_dtype_for(out_shape, 0)
         return (

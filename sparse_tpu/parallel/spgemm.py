@@ -97,7 +97,7 @@ def _esc_sharded(
             ipB_l.squeeze(0), ixB_l.squeeze(0), dvB_l.squeeze(0),
             n=n, T=T, U=T, dt=dt, m_real=m_real,
         )
-        return ur[None], uc[None], uv[None], nu.astype(jnp.int64)[None]
+        return ur[None], uc[None], uv[None], nu.astype(jnp.int32)[None]
 
     return shard_map(
         shard_fn,
@@ -395,13 +395,15 @@ def _spgemm2d_shuffle(
             jnp.clip(r2 - roff.reshape(()).astype(r2.dtype), 0, R_out - 1),
             R_out - 1,
         ).astype(jnp.int32)
-        # global column -> DistCSR padded coordinate space
+        # global column -> DistCSR padded coordinate space (int32 when the
+        # padded space fits — int64 under no-x64 would silently truncate)
+        pdt = jnp.int64 if S_out * C_out > 2**31 - 1 else jnp.int32
         csp = csp.reshape(-1)
         cshard = jnp.clip(
             jnp.searchsorted(csp, c2, side="right") - 1, 0, S_out - 1
         )
-        pcol = cshard.astype(jnp.int64) * C_out + (
-            c2.astype(jnp.int64) - csp[cshard].astype(jnp.int64)
+        pcol = cshard.astype(pdt) * C_out + (
+            c2.astype(pdt) - csp[cshard].astype(pdt)
         )
         pcol = jnp.where(valid, pcol, 0)
         v2 = jnp.where(valid, v2, 0)
@@ -584,6 +586,12 @@ def dist_spgemm_2d(A, B, mesh2d=None, as_dist: bool = False):
     )
     col_splits_out = equal_row_splits(n, S_out)
     C_out = max(int(np.max(np.diff(col_splits_out))), 1)
+    lidt = np.int32 if S_out * C_out < 2**31 else np.int64
+    if lidt is np.int64 and not jax.config.jax_enable_x64:
+        raise ValueError(
+            f"padded column space S*C = {S_out * C_out} needs int64; "
+            "enable x64 with jax.config.update('jax_enable_x64', True)"
+        )
     native = jax.default_backend() == "tpu"
     row_off = subsplits[:, :-1].astype(idx_dt)  # [gx, gy]
     rloc, pcol, vals, nvalid, cmin, cmax = _spgemm2d_shuffle(
@@ -591,7 +599,7 @@ def dist_spgemm_2d(A, B, mesh2d=None, as_dist: bool = False):
         jax.device_put(subsplits, shx),
         jax.device_put(row_off, NamedSharding(mesh2d, P(ax_x, ax_y))),
         jax.device_put(
-            col_splits_out.astype(np.int64), NamedSharding(mesh2d, P(None))
+            col_splits_out.astype(lidt), NamedSharding(mesh2d, P(None))
         ),
         mesh=mesh2d, cap=cap, U=T, gy=gy, rows_real=rows_real, R_out=R_out,
         S_out=S_out, C_out=C_out, native=native,
@@ -610,11 +618,10 @@ def dist_spgemm_2d(A, B, mesh2d=None, as_dist: bool = False):
     sh1 = NamedSharding(mesh1d, P("shards", None))
     W = C_out + HL + HR if mode == "halo" else S_out * C_out
     offs = (
-        (np.arange(S_out, dtype=np.int64) * C_out - HL)[:, None]
+        (np.arange(S_out, dtype=lidt) * C_out - HL)[:, None]
         if mode == "halo"
-        else np.zeros((S_out, 1), dtype=np.int64)
+        else np.zeros((S_out, 1), dtype=lidt)
     )
-    lidt = np.int32 if S_out * C_out < 2**31 else np.int64
     nz_rows, nz_cols, nz_vals = _flatten_adjust(
         rloc, pcol, vals, jax.device_put(offs, NamedSharding(mesh1d, P("shards", None))),
         S_out=S_out, cap=cap, W=W, lidt=jnp.dtype(lidt), sh1=sh1,

@@ -104,3 +104,23 @@ def test_eigsh_matvec_parity_with_scipy(mtx):
     assert np.allclose(np.sort(np.asarray(w_us)), np.sort(w_sp), rtol=1e-6,
                        atol=1e-9)
     assert counts["ours"] <= 2 * max(counts["scipy"], 1), counts
+
+
+def test_eigsh_complex_hermitian():
+    """Review r3: a complex Hermitian operator needs a complex Lanczos
+    basis (real-basis projection onto Re(A) returns wrong eigenvalues)."""
+    n = 50
+    rng = np.random.default_rng(54)
+    M = rng.normal(size=(n, n)) + 1j * rng.normal(size=(n, n))
+    H = (M + M.conj().T) / 2
+    Hs = sp.csr_array(np.where(np.abs(H) > 1.2, H, 0))
+    Hs = ((Hs + Hs.conj().T) / 2).tocsr()
+    dense_w = np.linalg.eigvalsh(Hs.toarray())
+    w, V = linalg.eigsh(sparse.csr_array(Hs), k=4, which="LA", tol=1e-9)
+    np.testing.assert_allclose(np.sort(np.asarray(w)), dense_w[-4:],
+                               rtol=1e-6, atol=1e-8)
+    # residual check confirms the eigenVECTORS are complex and correct
+    Vr = np.asarray(V)
+    for i in range(4):
+        r = Hs @ Vr[:, i] - np.asarray(w)[i] * Vr[:, i]
+        assert np.linalg.norm(r) < 1e-5

@@ -144,3 +144,32 @@ def test_lanczos_one_sync_per_cycle():
     assert 0 < linalg.HOST_SYNCS <= 25
     ref = np.sort(sla.eigsh(s, k=4, which="LM")[0])
     assert np.allclose(np.sort(np.asarray(w)), ref, rtol=1e-5, atol=1e-8)
+
+
+def test_gmres_complex_operator_real_rhs():
+    """Review r3: a real b with a complex A must promote the Krylov basis —
+    a real basis would silently solve against Re(A) only."""
+    n = 40
+    rng = np.random.default_rng(50)
+    s = sample_csr(n, n, density=0.15, seed=51).astype(np.complex128)
+    s.data = s.data * np.exp(1j * rng.uniform(0, 2 * np.pi, s.nnz))
+    s = (s + n * sp.identity(n)).tocsr()
+    A = sparse.csr_array(s)
+    b = np.ones(n)  # REAL rhs
+    x, iters = linalg.gmres(A, b, tol=1e-10)
+    assert iters > 0
+    assert np.iscomplexobj(np.asarray(x))
+    assert np.linalg.norm(np.asarray(A @ x) - b) < 1e-6
+
+
+def test_lsqr_complex_operator_real_rhs():
+    n = 30
+    rng = np.random.default_rng(52)
+    s = sample_csr(n, n, density=0.2, seed=53).astype(np.complex128)
+    s.data = s.data * np.exp(1j * rng.uniform(0, 2 * np.pi, s.nnz))
+    s = (s + n * sp.identity(n)).tocsr()
+    A = sparse.csr_array(s)
+    b = np.ones(n)  # REAL rhs
+    x, istop, itn = linalg.lsqr(A, b, atol=1e-10, btol=1e-10)[:3]
+    assert itn > 0
+    assert np.linalg.norm(np.asarray(A @ x) - b) < 1e-5
