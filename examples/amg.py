@@ -298,67 +298,54 @@ def cycle(levels, lvl, b):
     return level.postsmoother(x, b)
 
 
-def build_dist_cycle(levels, mesh):
+def build_dist_cycle(levels, mesh, replicate_below: int = 2048):
     """Wrap the hierarchy in mesh-sharded operators and return (A0_dist, M).
 
-    Every level's R/A/P becomes a ``DistCSR`` with PINNED equal row splits so
-    the padded vector spaces line up across levels (no repacking between
-    restriction and prolongation), and the V-cycle becomes one traceable
-    function on padded vectors — usable as the dist_cg preconditioner. The
-    coarse dense solve runs replicated (the reference's coarse-level
-    serialization, SURVEY §6, without the collapse: it's one tiny dense solve
-    inside the compiled program).
+    Levels ABOVE ``replicate_below`` rows become ``DistCSR`` shards with
+    PINNED equal row splits (padded vector spaces line up across levels, no
+    repacking between restriction and prolongation); levels at or below it
+    — where the reference's weak scaling collapses because per-level
+    collectives dwarf the compute (SURVEY §6: GMG at 4% on 192 GPUs) — run
+    as a dense REPLICATED tail (``make_replicated_tail``): one gather in,
+    one scatter out, zero collectives for the whole coarse cascade, dense
+    MXU matvecs + an LU-factored bottom solve inside the compiled program.
     """
-    from sparse_tpu.parallel.dist import shard_csr
-    from sparse_tpu.parallel.multigrid import make_dist_vcycle, shard_hierarchy
-    from sparse_tpu.parallel.partition import equal_row_splits
+    from sparse_tpu.parallel.multigrid import (
+        make_dist_vcycle,
+        make_replicated_tail,
+        shard_hierarchy,
+        tail_crossover,
+    )
 
-    S = int(mesh.devices.size)
     omega = 4.0 / 3.0
-    if len(levels) == 1:
-        # Hierarchy never coarsened (n <= max_coarse): the "V-cycle" is the
-        # replicated dense solve itself.
-        A0 = levels[0].A
-        spl0 = equal_row_splits(A0.shape[0], S)
-        Ad = shard_csr(A0, mesh=mesh, row_splits=spl0, col_splits=spl0)
-        n0 = A0.shape[0]
-        g = np.arange(n0, dtype=np.int64)
-        shard = np.clip(np.searchsorted(spl0, g, side="right") - 1, 0, S - 1)
-        imap = jnp.asarray(shard * Ad.R + (g - spl0[shard]))
-        dense = jnp.asarray(np.asarray(A0.toarray()))
-
-        def direct(rp):
-            x = jnp.linalg.solve(dense, rp[imap])
-            return jnp.zeros((Ad.m_pad,), x.dtype).at[imap].set(x)
-
-        return Ad, direct
-    # shared mesh-hierarchy machinery (parallel.multigrid); the Jacobi
-    # multiplier is W = (omega / rho(D^-1 A)) / diag(A) in padded layout
-    As = [lv.A for lv in levels]
-    RPs = [(lv.R, lv.P) for lv in levels[:-1]]
+    L = len(levels)
+    # crossover: first level small enough to replicate; the bottom level is
+    # ALWAYS replicated (it was already a replicated dense solve, and AMG
+    # coarsening bounds it by max_coarse)
+    c = tail_crossover(
+        [lv.A.shape[0] for lv in levels], replicate_below, bottom_always=True
+    )
+    As = [lv.A for lv in levels[: c + 1]]
+    RPs = [(lv.R, lv.P) for lv in levels[:c]]
     ops, spl_list = shard_hierarchy(As, RPs, mesh)
     weights = []
-    for i, lv in enumerate(levels[:-1]):
+    for i, lv in enumerate(levels[:c]):
         Ad = ops[i][0]
         Dp = Ad.pad_out_vector(np.asarray(lv.D) - 1.0) + 1.0
         weights.append((omega / lv.rho_DinvA) / Dp)
-    weights.append(None)  # bottom level uses the dense solve below
+    weights.append(None)  # level c enters the replicated tail
 
-    # bottom level: replicated dense solve with static unpad/repad maps
-    bottom = levels[-1]
-    nc = bottom.A.shape[0]
-    spl = spl_list[-1]
-    Rc = ops[-1][0].R
-    g = np.arange(nc, dtype=np.int64)
-    shard = np.clip(np.searchsorted(spl, g, side="right") - 1, 0, S - 1)
-    idx_map = jnp.asarray(shard * Rc + (g - spl[shard]))
-    dense_A = jnp.asarray(bottom.dense_A)
-    m_pad_bottom = S * Rc
-
-    def coarse_apply(coarse_b):
-        cx = jnp.linalg.solve(dense_A, coarse_b[idx_map])
-        return jnp.zeros((m_pad_bottom,), cx.dtype).at[idx_map].set(cx)
-
+    coarse_apply = make_replicated_tail(
+        [lv.A for lv in levels[c:]],
+        [(lv.R, lv.P) for lv in levels[c:-1]],
+        [
+            (omega / lv.rho_DinvA) / np.asarray(lv.D)
+            for lv in levels[c:-1]
+        ],
+        spl_list[-1],
+        ops[-1][0].R,
+        bottom="solve",
+    )
     return ops[0][0], make_dist_vcycle(ops, weights, coarse_apply)
 
 

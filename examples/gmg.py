@@ -212,26 +212,63 @@ class GMG:
         )
 
 
-def build_dist_cycle(mg, mesh):
+def build_dist_cycle(mg, mesh, replicate_below: int = 2048):
     """Mesh-sharded weighted-Jacobi V-cycle over the geometric hierarchy
     (shared machinery: ``sparse_tpu.parallel.multigrid``). The coarsest
     level applies the smoother, as in GMG._cycle — no dense solve.
+
+    Levels at or below ``replicate_below`` rows run as a dense REPLICATED
+    tail (one gather in, one scatter out, zero per-level collectives) —
+    the fix for the reference's coarse-level weak-scaling collapse
+    (SURVEY §6: 4% efficiency at 192 GPUs).
     """
-    from sparse_tpu.parallel.multigrid import make_dist_vcycle, shard_hierarchy
+    from sparse_tpu.parallel.multigrid import (
+        make_dist_vcycle,
+        make_replicated_tail,
+        shard_hierarchy,
+        tail_crossover,
+    )
 
     As = [mg.A] + [op[1] for op in mg.operators]
     RPs = [(op[0], op[2]) for op in mg.operators]
-    ops, _ = shard_hierarchy(As, RPs, mesh)
-    weights = []
-    for i, (Ad, _, _) in enumerate(ops):
+    L = len(As)
+    # no bottom_always: a smoother bottom never NEEDS replication, so a
+    # hierarchy whose coarsest level is still large stays fully sharded
+    # (densifying it would be an O(n^2) replicated allocation)
+    c = tail_crossover([A.shape[0] for A in As], replicate_below)
+
+    def pad_w(i, Ad):
         omega, D_inv = mg.smoother.level_params[i]
         # pad slots get omega*1.0 — inert (padded inputs are exactly zero)
-        weights.append(
-            float(omega) * (Ad.pad_out_vector(np.asarray(D_inv) - 1.0) + 1.0)
+        return float(omega) * (
+            Ad.pad_out_vector(np.asarray(D_inv) - 1.0) + 1.0
         )
-    return ops[0][0], make_dist_vcycle(
-        ops, weights, coarse_apply=lambda rp: weights[-1] * rp
+
+    if c >= L:  # fully sharded, smoother bottom
+        ops, _ = shard_hierarchy(As, RPs, mesh)
+        weights = [pad_w(i, ops[i][0]) for i in range(L)]
+        return ops[0][0], make_dist_vcycle(
+            ops, weights, coarse_apply=lambda rp: weights[-1] * rp
+        )
+
+    ops, spl_list = shard_hierarchy(As[: c + 1], RPs[:c], mesh)
+    weights = [pad_w(i, ops[i][0]) for i in range(c)]
+    weights.append(None)  # level c enters the replicated tail
+
+    def host_w(i):
+        omega, D_inv = mg.smoother.level_params[i]
+        return float(omega) * np.asarray(D_inv)
+
+    coarse_apply = make_replicated_tail(
+        As[c:],
+        RPs[c:],
+        [host_w(i) for i in range(c, L - 1)],
+        spl_list[-1],
+        ops[-1][0].R,
+        bottom="smooth",
+        bottom_weight=host_w(L - 1),
     )
+    return ops[0][0], make_dist_vcycle(ops, weights, coarse_apply)
 
 
 def main():

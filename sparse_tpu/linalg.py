@@ -517,16 +517,21 @@ def gmres(
     n = b.shape[0]
     A = make_linear_operator(A)
     M = IdentityOperator(A.shape, dtype=A.dtype) if M is None else make_linear_operator(M)
-    # promote b to the operator's result dtype BEFORE sizing the Krylov
-    # basis: a real b with a complex A must build a complex basis (the
-    # jitted cycle would otherwise cast every Arnoldi vector to real)
-    b = b.astype(jnp.result_type(b.dtype, A.dtype))
+    # promote b to the result dtype of A AND x0 BEFORE sizing the Krylov
+    # basis: a real b with a complex A (or a complex warm-start x0) must
+    # build a complex basis — the jitted cycle would otherwise cast every
+    # Arnoldi vector to real
+    dt = jnp.result_type(b.dtype, A.dtype)
+    if x0 is not None:
+        x0 = asjnp(x0)
+        dt = jnp.result_type(dt, x0.dtype)
+    b = b.astype(dt)
     if restart is None:
         restart = min(20, n)
     restart = min(restart, n)
     if maxiter is None:
         maxiter = max(n // restart, 1) * 10
-    x = jnp.zeros_like(b) if x0 is None else asjnp(x0).astype(b.dtype)
+    x = jnp.zeros_like(b) if x0 is None else x0.astype(dt)
     bnorm = jnp.linalg.norm(b)
     target = jnp.maximum(tol * bnorm, atol if atol is not None else 0.0)
     target = jnp.maximum(target, 1e-30)

@@ -23,7 +23,29 @@ import numpy as np
 from .dist import shard_csr
 from .partition import equal_row_splits
 
-__all__ = ["shard_hierarchy", "make_dist_vcycle"]
+__all__ = [
+    "shard_hierarchy",
+    "make_dist_vcycle",
+    "make_replicated_tail",
+    "tail_crossover",
+]
+
+
+def tail_crossover(sizes, replicate_below: int, bottom_always: bool = False):
+    """Single-sourced crossover policy for the replicated coarse tail.
+
+    Returns the first level index (>= 1, keeping the finest level sharded)
+    whose row count is <= ``replicate_below``; returns ``len(sizes)`` when
+    NO level qualifies (callers keep the fully-sharded cycle — never
+    densify a large coarsest level). ``bottom_always=True`` clamps to the
+    bottom level for hierarchies whose bottom is replicated regardless of
+    size (e.g. a dense direct solve that was always replicated).
+    """
+    L = len(sizes)
+    for i in range(1, L):
+        if sizes[i] <= replicate_below:
+            return i
+    return L - 1 if bottom_always else L
 
 
 def shard_hierarchy(As, RPs, mesh):
@@ -79,3 +101,82 @@ def make_dist_vcycle(ops, weights, coarse_apply):
         return xc + W * (rp - Ad.spmv_padded(xc))
 
     return lambda rp: cycle(0, rp)
+
+
+def make_replicated_tail(
+    As, RPs, weights, row_splits, R_pad, bottom="solve", bottom_weight=None
+):
+    """Dense REPLICATED V-cycle over the coarse tail of a hierarchy.
+
+    The reference's weak scaling collapses on the coarse levels (GMG at 4%
+    efficiency on 192 GPUs, SURVEY §6): below a few thousand rows the
+    per-level halo/gather collectives cost more than the level's whole
+    compute. The TPU-native fix is NOT a subset mesh (a second mesh inside
+    one SPMD program) but REPLICATION: every device runs the identical tiny
+    dense tail — one gather into the replicated space on entry, one scatter
+    back on exit, and ZERO collectives for any number of tail levels. Dense
+    [n, n] matvecs on the MXU beat sparse gathers at these sizes anyway.
+
+    ``As``: tail-level matrices (host/scipy-convertible, finest-of-tail
+    first — As[0] is the level the sharded cycle restricts INTO).
+    ``RPs``: (R, P) pairs WITHIN the tail (len == len(As) - 1).
+    ``weights``: per-level host Jacobi multiplier vectors [n_i] for the
+    smoothed levels (len == len(As) - 1; the bottom uses ``bottom``).
+    ``row_splits`` / ``R_pad``: the padded mesh layout of As[0]'s level
+    (from ``shard_hierarchy``).
+    ``bottom``: 'solve' (dense direct solve) or 'smooth' (one weighted-
+    Jacobi application with ``bottom_weight``).
+
+    Returns ``coarse_apply``: padded sharded [S*R_pad] -> same, traceable —
+    plug it straight into ``make_dist_vcycle``.
+    """
+    import jax.numpy as jnp
+
+    def _dense(M):
+        M = M.tocsr() if hasattr(M, "tocsr") else M
+        return jnp.asarray(np.asarray(M.toarray() if hasattr(M, "toarray") else M))
+
+    A_d = [_dense(A) for A in As]
+    R_d = [_dense(R) for R, _ in RPs]
+    P_d = [_dense(P) for _, P in RPs]
+    W_d = [jnp.asarray(np.asarray(w)) for w in weights]
+    if bottom == "solve":
+        # factor once at build time; lu_solve inside the cycle
+        import jax.scipy.linalg as jsl
+
+        lu, piv = jsl.lu_factor(A_d[-1])
+    elif bottom == "smooth":
+        if bottom_weight is None:
+            raise ValueError("bottom='smooth' needs bottom_weight")
+        Wb = jnp.asarray(np.asarray(bottom_weight))
+    else:
+        raise ValueError(f"unknown bottom={bottom!r}")
+
+    # padded-space <-> replicated-space index map for As[0]'s level
+    n0 = A_d[0].shape[0]
+    S = len(row_splits) - 1
+    g = np.arange(n0, dtype=np.int64)
+    shard = np.clip(np.searchsorted(row_splits, g, side="right") - 1, 0, S - 1)
+    imap = jnp.asarray(shard * R_pad + (g - row_splits[shard]))
+    m_pad = S * R_pad
+
+    def tail_cycle(lvl, r):
+        if lvl == len(A_d) - 1:
+            if bottom == "solve":
+                import jax.scipy.linalg as jsl
+
+                return jsl.lu_solve((lu, piv), r)
+            return Wb * r
+        W = W_d[lvl]
+        x = W * r
+        fine_r = r - A_d[lvl] @ x
+        coarse_x = tail_cycle(lvl + 1, R_d[lvl] @ fine_r)
+        xc = x + P_d[lvl] @ coarse_x
+        return xc + W * (r - A_d[lvl] @ xc)
+
+    def coarse_apply(rp):
+        r = rp[imap]  # padded sharded -> replicated [n0]: ONE gather
+        x = tail_cycle(0, r)
+        return jnp.zeros((m_pad,), x.dtype).at[imap].set(x)
+
+    return coarse_apply

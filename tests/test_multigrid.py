@@ -104,3 +104,74 @@ def test_vcycle_padded_slots_stay_zero():
     # zero out the real slots; anything left is pad contamination
     mask = np.asarray(A0d.pad_out_vector(np.ones(nf)))
     assert np.allclose(out * (1 - mask), 0.0)
+
+
+def test_replicated_tail_matches_sharded_cycle():
+    """The dense replicated coarse tail (zero per-level collectives — the
+    fix for the reference's coarse-level weak-scaling collapse, SURVEY §6)
+    computes the same V-cycle as the fully-sharded construction."""
+    from sparse_tpu.parallel.multigrid import make_replicated_tail
+
+    mesh = get_mesh(8)
+    nf = 256
+    A0 = _poisson1d(nf)
+    R0, P0 = _linear_rp(nf)
+    A1 = R0 @ A0 @ P0
+    R1, P1 = _linear_rp(nf // 2)
+    A2 = R1 @ A1 @ P1
+    As, RPs = [A0, A1, A2], [(R0, P0), (R1, P1)]
+
+    def w_host(A):
+        return (2.0 / 3.0) / np.asarray(A.diagonal())
+
+    # fully sharded: all 3 levels DistCSR, bottom = smoother application
+    ops_f, _ = shard_hierarchy(As, RPs, mesh)
+    wf = [
+        (2.0 / 3.0) / (ops_f[i][0].pad_out_vector(np.asarray(As[i].diagonal()) - 1.0) + 1.0)
+        for i in range(3)
+    ]
+    M_full = make_dist_vcycle(ops_f, wf, coarse_apply=lambda rp: wf[-1] * rp)
+
+    # replicated tail from level 1 down, same math
+    ops_t, spl_t = shard_hierarchy(As[:2], RPs[:1], mesh)
+    tail = make_replicated_tail(
+        As[1:], RPs[1:], [w_host(A1)], spl_t[-1], ops_t[-1][0].R,
+        bottom="smooth", bottom_weight=w_host(A2),
+    )
+    M_tail = make_dist_vcycle(ops_t, [wf[0], None], tail)
+
+    rp = ops_f[0][0].pad_out_vector(
+        np.sin(np.arange(nf) * 0.1).astype(np.float64)
+    )
+    out_full = ops_f[0][0].unpad_vector(np.asarray(M_full(rp)))
+    out_tail = ops_t[0][0].unpad_vector(np.asarray(M_tail(rp)))
+    np.testing.assert_allclose(out_tail, out_full, rtol=1e-10, atol=1e-12)
+
+
+def test_replicated_tail_solve_bottom():
+    """bottom='solve' (LU direct) tail preconditions dist_cg to fewer
+    iterations than the plain solve."""
+    from sparse_tpu.parallel.multigrid import make_replicated_tail
+
+    mesh = get_mesh(8)
+    nf = 128
+    A0 = _poisson1d(nf)
+    R, P = _linear_rp(nf)
+    A1 = R @ A0 @ P
+    ops, spl = shard_hierarchy([A0, A1], [(R, P)], mesh)
+    w0 = (2.0 / 3.0) / (
+        ops[0][0].pad_out_vector(np.asarray(A0.diagonal()) - 1.0) + 1.0
+    )
+    tail = make_replicated_tail(
+        [A1], [], [], spl[-1], ops[-1][0].R, bottom="solve"
+    )
+    M = make_dist_vcycle(ops, [w0, None], tail)
+    b = np.ones(nf)
+    _, it_plain, _ = dist_cg(ops[0][0], b, tol=1e-8, maxiter=400,
+                             conv_test_iters=5)
+    xp, it_pre, conv = dist_cg(ops[0][0], b, tol=1e-8, maxiter=400,
+                               conv_test_iters=5, M=M)
+    assert conv
+    x = ops[0][0].unpad_vector(xp)
+    assert np.linalg.norm(np.asarray(A0 @ x) - b) < 1e-5
+    assert it_pre < it_plain
