@@ -1,0 +1,132 @@
+"""norm / expm_multiply / svds vs the scipy oracle.
+
+Beyond-reference surface (docs/PARITY.md): the reference exposes none of
+these; scipy.sparse.linalg users expect them.
+"""
+
+import numpy as np
+import pytest
+import scipy.sparse as sp
+import scipy.sparse.linalg as sla
+
+import sparse_tpu as sparse
+import sparse_tpu.linalg as linalg
+from .utils.sample import sample_csr
+
+
+@pytest.mark.parametrize("ord_", [None, "fro", 1, -1, np.inf, -np.inf])
+def test_norm_matrix(ord_):
+    s = sample_csr(23, 17, density=0.3, seed=60)
+    s.data -= 0.5
+    A = sparse.csr_array(s)
+    got = float(np.asarray(linalg.norm(A, ord=ord_)))
+    want = sla.norm(s, ord=ord_)
+    assert np.isclose(got, want, rtol=1e-12)
+
+
+@pytest.mark.parametrize("axis", [0, 1])
+@pytest.mark.parametrize("ord_", [None, 1, np.inf])
+def test_norm_axis(axis, ord_):
+    s = sample_csr(12, 9, density=0.4, seed=61)
+    s.data -= 0.5
+    A = sparse.csr_array(s)
+    got = np.asarray(linalg.norm(A, ord=ord_, axis=axis))
+    want = sla.norm(s, ord=ord_ if ord_ is not None else 2, axis=axis)
+    np.testing.assert_allclose(got, want, rtol=1e-12, atol=1e-14)
+
+
+@pytest.mark.parametrize("t", [1.0, 0.3, -0.7])
+def test_expm_multiply_vector(t):
+    s = sample_csr(40, 40, density=0.1, seed=62)
+    s.data -= 0.5
+    A = sparse.csr_array(s)
+    v = np.linspace(-1, 1, 40)
+    got = np.asarray(linalg.expm_multiply(A, v, t=t))
+    want = sla.expm_multiply(t * s.tocsc(), v)
+    np.testing.assert_allclose(got, want, rtol=1e-8, atol=1e-10)
+
+
+def test_expm_multiply_complex_evolution():
+    """The quantum primitive: e^{-iHt} psi stays unit-norm and matches
+    scipy for a Hermitian H."""
+    s = sample_csr(30, 30, density=0.2, seed=63)
+    H = ((s + s.T) / 2).tocsr().astype(np.complex128)
+    A = sparse.csr_array(H)
+    psi0 = np.zeros(30, dtype=np.complex128)
+    psi0[0] = 1.0
+    got = np.asarray(linalg.expm_multiply(A, psi0, t=-0.5j))
+    want = sla.expm_multiply(-0.5j * H.tocsc(), psi0)
+    np.testing.assert_allclose(got, want, rtol=1e-8, atol=1e-10)
+    assert abs(np.linalg.norm(got) - 1.0) < 1e-8
+
+
+def test_expm_multiply_matrix_rhs():
+    s = sample_csr(25, 25, density=0.15, seed=64)
+    A = sparse.csr_array(s)
+    B = np.linspace(0, 1, 25 * 3).reshape(25, 3)
+    got = np.asarray(linalg.expm_multiply(A, B))
+    want = sla.expm_multiply(s.tocsc(), B)
+    np.testing.assert_allclose(got, want, rtol=1e-8, atol=1e-10)
+
+
+@pytest.mark.parametrize("shape", [(40, 25), (25, 40), (30, 30)])
+def test_svds_matches_scipy(shape):
+    m, n = shape
+    s = sample_csr(m, n, density=0.3, seed=65)
+    s.data -= 0.25
+    A = sparse.csr_array(s)
+    k = 4
+    U, sig, Vh = linalg.svds(A, k=k)
+    sv_ref = np.sort(sla.svds(s, k=k, return_singular_vectors=False))[::-1]
+    np.testing.assert_allclose(sig, sv_ref, rtol=1e-7, atol=1e-9)
+    # triplet consistency: A ~ U diag(s) Vh on the recovered subspace
+    Un, Vhn = np.asarray(U), np.asarray(Vh)
+    recon = Un @ np.diag(sig) @ Vhn
+    proj = Un @ (Un.T @ s.toarray())  # A restricted to span(U)
+    np.testing.assert_allclose(recon, proj, atol=1e-6)
+
+
+def test_svds_values_only():
+    s = sample_csr(20, 15, density=0.4, seed=66)
+    A = sparse.csr_array(s)
+    sig = linalg.svds(A, k=3, return_singular_vectors=False)
+    sv_ref = np.sort(sla.svds(s, k=3, return_singular_vectors=False))[::-1]
+    np.testing.assert_allclose(sig, sv_ref, rtol=1e-7, atol=1e-9)
+
+
+def test_norm_inf_axis_empty_line():
+    """Review r3: an empty column/row must report 0 (implicit zeros), not
+    segment_max's -inf fill."""
+    s = sp.csr_array(np.array([[1.0, 0.0, -3.0], [2.0, 0.0, 0.0]]))
+    A = sparse.csr_array(s)
+    np.testing.assert_allclose(
+        np.asarray(linalg.norm(A, ord=np.inf, axis=0)), [2.0, 0.0, 3.0]
+    )
+    s2 = sp.csr_array(np.array([[0.0, 0.0], [5.0, -1.0]]))
+    A2 = sparse.csr_array(s2)
+    np.testing.assert_allclose(
+        np.asarray(linalg.norm(A2, ord=np.inf, axis=1)), [0.0, 5.0]
+    )
+
+
+def test_svds_invalid_k_raises():
+    A = sparse.csr_array(sample_csr(5, 1, density=1.0, seed=67))
+    with pytest.raises(ValueError):
+        linalg.svds(A, k=6)
+    with pytest.raises(ValueError):
+        linalg.svds(sparse.csr_array(sample_csr(5, 5, 0.5, seed=68)), k=0)
+
+
+def test_expm_multiply_linear_operator_sign_cancellation():
+    """Review r3: the operator-input norm estimate must survive sign
+    cancellation (A @ ones == 0 for [[2,-2],[-2,2]])."""
+    M = np.array([[2.0, -2.0], [-2.0, 2.0]])
+    op = linalg.LinearOperator(
+        (2, 2), matvec=lambda x: M @ x, rmatvec=lambda x: M.T @ x,
+        dtype=np.float64,
+    )
+    got = np.asarray(linalg.expm_multiply(op, np.array([1.0, 0.0])))
+    import scipy.linalg as sl
+
+    want = sl.expm(M) @ np.array([1.0, 0.0])
+    np.testing.assert_allclose(got, want, rtol=1e-8)
