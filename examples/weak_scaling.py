@@ -61,13 +61,21 @@ def main():
         if base_rate is None:
             base_rate = best
         eff = best / base_rate
+        from sparse_tpu.parallel.dist import comm_stats
+
+        st = comm_stats(D, conv_test_iters=args.iters)
         results.append(
             {"shards": S, "rows": A.shape[0], "iters_per_s": round(best, 2),
-             "efficiency": round(eff, 3)}
+             "efficiency": round(eff, 3),
+             "halo_entries": st["halo_entries_per_spmv"],
+             "collective_bytes_per_iter":
+                 st["cg_iter_collective_bytes_per_shard"],
+             "mode": st["mode"]}
         )
         print(
             f"S={S:3d}  rows={A.shape[0]:>10,}  {best:8.2f} iters/s  "
-            f"efficiency {eff:6.1%}"
+            f"efficiency {eff:6.1%}  halo {st['halo_entries_per_spmv']}  "
+            f"{st['cg_iter_collective_bytes_per_shard']} B/iter"
         )
     print(json.dumps({"weak_scaling": results}))
 

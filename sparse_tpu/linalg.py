@@ -1347,14 +1347,20 @@ def norm(A, ord=None, axis=None):
     raise ValueError(f"invalid norm order {ord!r} along an axis")
 
 
-def _onenorm_est(A_op, dt, iters: int = 4) -> float:
+def _onenorm_est(A_op, dt, iters: int = 4):
     """Higham/Hager 1-norm power estimator for a LinearOperator (the core
     of onenormest, without the parallel-column refinement): alternate
     x -> y = A x, xi = sign(y), z = A^H xi, move x to the unit vector at
-    argmax |z|. A lower bound that is almost always tight in practice."""
+    argmax |z|. A lower bound that is almost always tight in practice.
+    Returns (est, j): the estimate and the COLUMN achieving the best
+    unit-vector probe (the certificate column — ||A e_j||_1 == est
+    whenever the best probe was a unit vector; the uniform warm-up probe
+    never exceeds the best column by convexity)."""
     n = A_op.shape[1]
     x = jnp.full((n,), 1.0 / n, dtype=dt)
     est = 0.0
+    best_j = 0
+    cur_j = None  # which unit column x currently is (None: uniform start)
     for it in range(iters):
         y = A_op.matvec(x)
         est_new = float(jnp.sum(jnp.abs(y)))
@@ -1363,14 +1369,54 @@ def _onenorm_est(A_op, dt, iters: int = 4) -> float:
         # before probing a unit vector would report ~0 for ||A||_1 = 4
         if it > 0 and est_new <= est:
             break
+        if est_new >= est and cur_j is not None:
+            best_j = cur_j
         est = max(est, est_new)
         xi = jnp.where(
             y == 0, 1.0, y / jnp.where(jnp.abs(y) == 0, 1.0, jnp.abs(y))
         ).conj()
         z = A_op.rmatvec(xi.astype(dt))
-        j = int(jnp.argmax(jnp.abs(z)))
-        x = jnp.zeros((n,), dtype=dt).at[j].set(1.0)
-    return max(est, 1e-300)
+        cur_j = int(jnp.argmax(jnp.abs(z)))
+        x = jnp.zeros((n,), dtype=dt).at[cur_j].set(1.0)
+        if it == 0:
+            best_j = cur_j  # first candidate even if the uniform est wins
+    return max(est, 1e-300), best_j
+
+
+@track_provenance
+def onenormest(A, t: int = 2, itmax: int = 5, compute_v: bool = False, compute_w: bool = False):
+    """Estimate the 1-norm of A (scipy.sparse.linalg.onenormest subset).
+
+    Sparse inputs get the EXACT 1-norm (one column-sum reduction — cheaper
+    than any estimate); LinearOperator inputs run the Higham/Hager power
+    estimation. ``compute_v``/``compute_w`` return scipy's certificate:
+    v a unit vector with w = A v and est == ||w||_1.
+    """
+    from .base import SparseArray
+
+    A_op = make_linear_operator(A)
+    n = A_op.shape[1]
+    if isinstance(A, SparseArray):
+        C = A.tocsr()
+        sums = jax.ops.segment_sum(
+            jnp.abs(asjnp(C.data)), asjnp(C.indices).astype(jnp.int32),
+            num_segments=n,
+        )
+        j = int(jnp.argmax(sums))
+        est = float(jnp.max(sums))
+    else:
+        dt = jnp.dtype(A_op.dtype)
+        est, j = _onenorm_est(A_op, dt, iters=itmax)
+    if not (compute_v or compute_w):
+        return est
+    v = jnp.zeros((n,), dtype=A_op.dtype).at[j].set(1.0)
+    w = A_op.matvec(v)
+    out = [float(jnp.sum(jnp.abs(w)))]  # certified: est == ||A v||_1
+    if compute_v:
+        out.append(v)
+    if compute_w:
+        out.append(w)
+    return tuple(out)
 
 
 # Al-Mohy & Higham (2011) theta values for the truncated Taylor degrees
@@ -1393,17 +1439,19 @@ def expm_multiply(A, B, t: float = 1.0):
     (one column-sum reduction); each of the s stages runs m SpMV steps on
     device. Handles complex t*A; B may be a vector or a matrix.
     """
+    from .base import SparseArray
+
     A_op = make_linear_operator(A)
     B = asjnp(B)
     dt = jnp.result_type(B.dtype, A_op.dtype, type(t))
     B = B.astype(dt)
-    try:
+    if isinstance(A, SparseArray):
         a_norm = float(np.asarray(jnp.real(norm(A, ord=1)))) * abs(t)
-    except TypeError:
+    else:
         # LinearOperator input: Higham-style 1-norm power estimation on
         # |.|-structure (matvec of ones would cancel signs and can
         # underestimate arbitrarily — e.g. [[2,-2],[-2,2]] @ ones == 0)
-        a_norm = _onenorm_est(A_op, dt) * abs(t)
+        a_norm = _onenorm_est(A_op, dt)[0] * abs(t)
     if a_norm == 0 or B.size == 0:
         return B
     # pick (m, s): smallest cost s*m with ||tA||_1 / s <= theta_m
@@ -1417,6 +1465,46 @@ def expm_multiply(A, B, t: float = 1.0):
     scale = jnp.asarray(t / s, dtype=dt)
     tol = float(np.finfo(np.dtype(jnp.zeros((), dt).real.dtype)).eps) / 2
 
+    try:
+        # device-resident stage: the m-term Taylor loop (with the AH
+        # two-consecutive-term stopping test) runs as one lax.while_loop
+        # per stage — zero mid-series host syncs; stages chain on device
+        apply = A_op.matvec if B.ndim == 1 else A_op.matmat
+        A_op.matvec(jnp.zeros((A_op.shape[1],), dtype=dt))  # warm dispatch
+
+        @jax.jit
+        def stage(F):
+            def cond(st):
+                _term, _out, _c_prev, j, done = st
+                return (j <= mdeg) & ~done
+
+            def body(st):
+                term, out, c_prev, j, done = st
+                term = apply(term) * (scale / j.astype(scale.dtype))
+                out = out + term
+                # Al-Mohy & Higham's TWO-consecutive-term test (as in
+                # scipy): a single dipping term must not truncate early
+                c = jnp.max(jnp.abs(term))
+                done = (c_prev + c) <= tol * jnp.max(jnp.abs(out))
+                return term, out, c, j + 1, done
+
+            big = jnp.asarray(np.inf, jnp.zeros((), dt).real.dtype)
+            _t, out, _c, _j, _d = jax.lax.while_loop(
+                cond, body, (F, F, big, jnp.int32(1), jnp.bool_(False))
+            )
+            return out
+
+        F = B
+        for _ in range(s):
+            F = stage(F)
+        return F
+    except (
+        jax.errors.TracerArrayConversionError,
+        jax.errors.TracerBoolConversionError,
+        jax.errors.ConcretizationTypeError,
+    ):
+        pass
+    # host-driven fallback for untraceable operators
     F = B
     for _ in range(s):
         term = F
@@ -1426,8 +1514,6 @@ def expm_multiply(A, B, t: float = 1.0):
             term = A_op.matvec(term) if term.ndim == 1 else A_op.matmat(term)
             term = term * (scale / j)
             out = out + term
-            # Al-Mohy & Higham's TWO-consecutive-term test (as in scipy):
-            # a single dipping term must not truncate the series early
             c = float(jnp.max(jnp.abs(term)))
             if c_prev + c <= tol * float(jnp.max(jnp.abs(out))):
                 break
@@ -1442,8 +1528,14 @@ def svds(A, k: int = 6, which: str = "LM", return_singular_vectors: bool = True)
     operator (beyond the reference's surface; scipy.sparse.linalg.svds
     API subset — which='LM' only, the well-conditioned direction).
 
-    Runs eigsh on C = A^H A (n x n, matvec = two sparse products), takes
-    sigma = sqrt(max(eig, 0)) and recovers U = A V / sigma.
+    Tall matrices run eigsh on C = A^H A (n x n, matvec = two sparse
+    products), take sigma = sqrt(max(eig, 0)) and recover U = A V / sigma;
+    wide matrices delegate to the adjoint. Two hygiene rules an iterative
+    normal-operator approach needs: (a) when min(m, n) is no bigger than
+    the Lanczos basis would be anyway, a DENSE SVD is exact and cheaper —
+    and avoids accepting unconverged Ritz junk when k exceeds rank(A);
+    (b) singular values below the numpy rank cutoff are reported as
+    exactly 0 with zeroed vector columns.
     """
     if which != "LM":
         raise NotImplementedError("svds supports which='LM'")
@@ -1453,8 +1545,26 @@ def svds(A, k: int = 6, which: str = "LM", return_singular_vectors: bool = True)
         raise ValueError(
             f"k={k} must satisfy 1 <= k <= min(M, N) - 1 = {min(m, n) - 1}"
         )
+    if m < n:
+        # wide: svds of the adjoint, mapped back (A = (U' s Vh')^H of A^H)
+        adj = LinearOperator(
+            (n, m), matvec=A_op.rmatvec, rmatvec=A_op.matvec,
+            dtype=A_op.dtype,
+        )
+        out = svds(adj, k=k, return_singular_vectors=return_singular_vectors)
+        if not return_singular_vectors:
+            return out
+        Ua, s, Vha = out
+        return Vha.conj().T, s, Ua.conj().T
 
-    if m >= n:
+    ncv_would_be = min(max(2 * k + 1, 20), n)
+    if n <= ncv_would_be:
+        # the Lanczos basis would span the whole space: dense SVD is exact
+        eye = jnp.eye(n, dtype=A_op.dtype)
+        dense = A_op.matmat(eye)
+        U, s, Vh = jnp.linalg.svd(dense, full_matrices=False)
+        U, s, Vh = U[:, :k], np.asarray(s[:k]), Vh[:k]
+    else:
         C = LinearOperator(
             (n, n),
             matvec=lambda x: A_op.rmatvec(A_op.matvec(x)),
@@ -1465,31 +1575,19 @@ def svds(A, k: int = 6, which: str = "LM", return_singular_vectors: bool = True)
         order = np.argsort(w)[::-1]
         s = np.sqrt(w[order])
         V = jnp.asarray(np.asarray(V)[:, order])
-        if not return_singular_vectors:
-            return s
-        safe = jnp.asarray(np.where(s > 0, s, 1.0))
-        U = jnp.stack(
-            [A_op.matvec(V[:, i]) / safe[i] for i in range(k)], axis=1
-        )
-        return U, s, V.conj().T
-    # wide matrix: work on A A^H instead
-    C = LinearOperator(
-        (m, m),
-        matvec=lambda x: A_op.matvec(A_op.rmatvec(x)),
-        dtype=A_op.dtype,
-    )
-    w, U = eigsh(C, k=k, which="LA")
-    w = np.maximum(np.asarray(w), 0.0)
-    order = np.argsort(w)[::-1]
-    s = np.sqrt(w[order])
-    U = jnp.asarray(np.asarray(U)[:, order])
+        safe = jnp.asarray(np.where(s > 0, s, 1.0), dtype=A_op.dtype)
+        U = A_op.matmat(V) / safe[None, :]
+        Vh = V.conj().T
+    # rank cutoff: values below max(m,n) * eps * smax are zeros, and their
+    # recovered vectors are meaningless — zero them rather than return junk
+    rdt = np.dtype(jnp.zeros((), A_op.dtype).real.dtype)
+    cutoff = max(m, n) * np.finfo(rdt).eps * (float(s[0]) if len(s) else 0.0)
+    live = s > cutoff
+    s = np.where(live, s, 0.0)
     if not return_singular_vectors:
         return s
-    safe = jnp.asarray(np.where(s > 0, s, 1.0))
-    Vh = jnp.stack(
-        [A_op.rmatvec(U[:, i]).conj() / safe[i] for i in range(k)], axis=0
-    )
-    return U, s, Vh
+    keep = jnp.asarray(live.astype(rdt))
+    return U * keep[None, :], s, Vh * keep[:, None]
 
 
 __all__ = [
@@ -1509,4 +1607,5 @@ __all__ = [
     "norm",
     "expm_multiply",
     "svds",
+    "onenormest",
 ]

@@ -130,3 +130,56 @@ def test_expm_multiply_linear_operator_sign_cancellation():
 
     want = sl.expm(M) @ np.array([1.0, 0.0])
     np.testing.assert_allclose(got, want, rtol=1e-8)
+
+
+def test_onenormest():
+    s = sample_csr(30, 30, density=0.2, seed=70)
+    s.data -= 0.5
+    A = sparse.csr_array(s)
+    exact = sla.norm(s, ord=1)
+    assert np.isclose(linalg.onenormest(A), exact, rtol=1e-12)
+    est, v, w = linalg.onenormest(A, compute_v=True, compute_w=True)
+    assert np.isclose(est, exact, rtol=1e-12)
+    assert np.isclose(np.abs(np.asarray(w)).sum(), exact, rtol=1e-12)
+    # operator input: estimate is a lower bound within 3x on random mats
+    op = linalg.LinearOperator(
+        (30, 30), matvec=lambda x: s @ np.asarray(x),
+        rmatvec=lambda x: s.T @ np.asarray(x), dtype=np.float64,
+    )
+    est_op = linalg.onenormest(op)
+    assert est_op <= exact * (1 + 1e-9) and est_op >= exact / 3
+
+
+def test_svds_rank_deficient():
+    """Review r3: k past rank(A) must report exact zeros (rank cutoff +
+    dense fallback when the basis would span the space), never
+    unconverged Ritz junk; U stays orthonormal on the live columns."""
+    rng = np.random.default_rng(71)
+    L = rng.normal(size=(20, 3))
+    Rm = rng.normal(size=(3, 8))
+    dense = L @ Rm  # rank 3
+    A = sparse.csr_array(sp.csr_array(dense))
+    U, s, Vh = linalg.svds(A, k=5)
+    sv_ref = np.linalg.svd(dense, compute_uv=False)[:5]
+    np.testing.assert_allclose(s, sv_ref, rtol=1e-9, atol=1e-9)
+    assert np.all(s[3:] == 0.0)
+    Un = np.asarray(U)[:, :3]
+    np.testing.assert_allclose(Un.T @ Un, np.eye(3), atol=1e-9)
+    # wide orientation of the same matrix
+    A2 = sparse.csr_array(sp.csr_array(dense.T))
+    _, s2, _ = linalg.svds(A2, k=5)
+    np.testing.assert_allclose(s2, sv_ref, rtol=1e-9, atol=1e-9)
+
+
+def test_onenormest_certificate_operator():
+    """Review r3: the (v, w) certificate must satisfy est == ||A v||_1
+    even for operator inputs whose heaviest column is not column 0."""
+    M = np.diag([1.0, 100.0, 3.0])
+    op = linalg.LinearOperator(
+        (3, 3), matvec=lambda x: M @ x, rmatvec=lambda x: M.T @ x,
+        dtype=np.float64,
+    )
+    est, v, w = linalg.onenormest(op, compute_v=True, compute_w=True)
+    assert np.isclose(est, np.abs(np.asarray(w)).sum())
+    assert np.isclose(est, 100.0)
+    np.testing.assert_allclose(np.asarray(M @ np.asarray(v)), np.asarray(w))
