@@ -46,9 +46,10 @@ class SparseArray:
 
     # ---- format dispatch -------------------------------------------------
     def asformat(self, format: str):
-        """Convert to the named format ('csr', 'csc', 'coo', 'dia', 'dense').
+        """Convert to the named format ('csr', 'csc', 'coo', 'dia', 'dok',
+        'lil', 'dense').
 
-        Reference: base.py:150-170.
+        Reference: base.py:150-170 (dok/lil go beyond its surface).
         """
         if format is None or format == self.format:
             return self
@@ -59,6 +60,18 @@ class SparseArray:
 
     def todense(self):
         return self.toarray()
+
+    def todok(self):
+        """Host dictionary-of-keys staging copy (``dok.dok_array``)."""
+        from .dok import dok_array
+
+        return dok_array(self)
+
+    def tolil(self):
+        """Host list-of-lists staging copy (``lil.lil_array``)."""
+        from .lil import lil_array
+
+        return lil_array(self)
 
     # ---- generic arithmetic wired through format-specific primitives -----
     def __neg__(self):

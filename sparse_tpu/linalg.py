@@ -1470,7 +1470,8 @@ def expm_multiply(A, B, t: float = 1.0):
         # two-consecutive-term stopping test) runs as one lax.while_loop
         # per stage — zero mid-series host syncs; stages chain on device
         apply = A_op.matvec if B.ndim == 1 else A_op.matmat
-        A_op.matvec(jnp.zeros((A_op.shape[1],), dtype=dt))  # warm dispatch
+        apply(jnp.zeros_like(B))  # warm dispatch with the operand shape
+        # (probing matvec on a matmat-only operator would raise)
 
         @jax.jit
         def stage(F):
@@ -1557,6 +1558,7 @@ def svds(A, k: int = 6, which: str = "LM", return_singular_vectors: bool = True)
         Ua, s, Vha = out
         return Vha.conj().T, s, Ua.conj().T
 
+    rdt = np.dtype(jnp.zeros((), A_op.dtype).real.dtype)
     ncv_would_be = min(max(2 * k + 1, 20), n)
     if n <= ncv_would_be:
         # the Lanczos basis would span the whole space: dense SVD is exact
@@ -1564,6 +1566,11 @@ def svds(A, k: int = 6, which: str = "LM", return_singular_vectors: bool = True)
         dense = A_op.matmat(eye)
         U, s, Vh = jnp.linalg.svd(dense, full_matrices=False)
         U, s, Vh = U[:, :k], np.asarray(s[:k]), Vh[:k]
+        cutoff = max(m, n) * np.finfo(rdt).eps * (float(s[0]) if len(s) else 0.0)
+        live = s > cutoff
+        s = np.where(live, s, 0.0)
+        if not return_singular_vectors:
+            return s
     else:
         C = LinearOperator(
             (n, n),
@@ -1574,18 +1581,17 @@ def svds(A, k: int = 6, which: str = "LM", return_singular_vectors: bool = True)
         w = np.maximum(np.asarray(w), 0.0)
         order = np.argsort(w)[::-1]
         s = np.sqrt(w[order])
+        # rank cutoff BEFORE the vector recovery: sub-cutoff values are
+        # zeros and their vectors meaningless junk
+        cutoff = max(m, n) * np.finfo(rdt).eps * (float(s[0]) if len(s) else 0.0)
+        live = s > cutoff
+        s = np.where(live, s, 0.0)
+        if not return_singular_vectors:
+            return s
         V = jnp.asarray(np.asarray(V)[:, order])
-        safe = jnp.asarray(np.where(s > 0, s, 1.0), dtype=A_op.dtype)
+        safe = jnp.asarray(np.where(live, np.where(s > 0, s, 1.0), 1.0), dtype=A_op.dtype)
         U = A_op.matmat(V) / safe[None, :]
         Vh = V.conj().T
-    # rank cutoff: values below max(m,n) * eps * smax are zeros, and their
-    # recovered vectors are meaningless — zero them rather than return junk
-    rdt = np.dtype(jnp.zeros((), A_op.dtype).real.dtype)
-    cutoff = max(m, n) * np.finfo(rdt).eps * (float(s[0]) if len(s) else 0.0)
-    live = s > cutoff
-    s = np.where(live, s, 0.0)
-    if not return_singular_vectors:
-        return s
     keep = jnp.asarray(live.astype(rdt))
     return U * keep[None, :], s, Vh * keep[:, None]
 

@@ -1,0 +1,149 @@
+"""DOK and LIL host staging formats vs the scipy oracle.
+
+Beyond the reference's class surface (its coverage layer lists
+todok/tolil as gaps): incremental construction formats converted once for
+device compute.
+"""
+
+import numpy as np
+import pytest
+import scipy.sparse as sp
+
+import sparse_tpu as sparse
+from .utils.sample import sample_csr
+
+
+def _pair(m=7, n=5, density=0.3, seed=80):
+    s = sample_csr(m, n, density=density, seed=seed)
+    s.data -= 0.4
+    return sparse.csr_array(s), s
+
+
+def test_dok_roundtrip_and_indexing():
+    A, s = _pair()
+    D = A.todok()
+    Ds = s.todok()
+    assert D.nnz == Ds.nnz
+    np.testing.assert_allclose(D.toarray(), s.toarray())
+    # scalar reads incl. implicit zeros and negative indices
+    for i in range(s.shape[0]):
+        for j in range(s.shape[1]):
+            assert np.isclose(D[i, j], s.toarray()[i, j])
+    assert np.isclose(D[-1, -1], s.toarray()[-1, -1])
+    # mutation: set, overwrite, delete-via-zero
+    D[0, 0] = 3.5
+    D[0, 1] = 0.0
+    ref = s.toarray()
+    ref[0, 0] = 3.5
+    ref[0, 1] = 0.0
+    np.testing.assert_allclose(D.toarray(), ref)
+    np.testing.assert_allclose(np.asarray(D.tocsr().toarray()), ref)
+    with pytest.raises(IndexError):
+        D[99, 0]
+
+
+def test_dok_incremental_build():
+    D = sparse.dok_array((4, 6), dtype=np.float64)
+    ref = np.zeros((4, 6))
+    rng = np.random.default_rng(81)
+    for _ in range(30):
+        i, j = rng.integers(0, 4), rng.integers(0, 6)
+        v = float(rng.normal())
+        D[i, j] = v
+        ref[i, j] = v
+    np.testing.assert_allclose(D.toarray(), ref)
+    C = D.tocsr()
+    np.testing.assert_allclose(np.asarray(C.toarray()), ref)
+    # dict surface
+    assert set(D.keys()) == {tuple(map(int, k)) for k in zip(*np.nonzero(ref))}
+    assert (0, 0) in D or ref[0, 0] == 0
+
+
+def test_lil_roundtrip_and_rows():
+    A, s = _pair(seed=82)
+    L = A.tolil()
+    Ls = s.tolil()
+    assert L.nnz == Ls.nnz
+    np.testing.assert_allclose(L.toarray(), s.toarray())
+    # row read/write
+    np.testing.assert_allclose(L[2], s.toarray()[2])
+    newrow = np.zeros(s.shape[1])
+    newrow[::2] = 2.0
+    L[2] = newrow
+    ref = s.toarray()
+    ref[2] = newrow
+    np.testing.assert_allclose(L.toarray(), ref)
+    np.testing.assert_allclose(np.asarray(L.tocsr().toarray()), ref)
+    # scalar set keeps columns sorted
+    L[0, 4] = 9.0
+    L[0, 1] = 9.0
+    ref[0, 4] = 9.0
+    ref[0, 1] = 9.0
+    np.testing.assert_allclose(L.toarray(), ref)
+    assert L.rows[0] == sorted(L.rows[0])
+
+
+def test_dok_lil_math_delegates():
+    A, s = _pair(m=6, n=6, seed=83)
+    x = np.arange(6, dtype=np.float64)
+    for fmt in ("todok", "tolil"):
+        F = getattr(A, fmt)()
+        np.testing.assert_allclose(np.asarray(F @ x), s @ x)
+        np.testing.assert_allclose(
+            np.asarray((F + A).toarray()), (s + s).toarray()
+        )
+        np.testing.assert_allclose(
+            np.asarray(F.multiply(F).toarray()), s.multiply(s).toarray()
+        )
+        assert np.isclose(float(np.asarray(F.sum())), s.sum())
+        assert sparse.issparse(F)
+
+
+def test_asformat_dok_lil():
+    A, s = _pair(seed=84)
+    assert A.asformat("dok").format == "dok"
+    assert A.asformat("lil").format == "lil"
+    np.testing.assert_allclose(
+        np.asarray(A.asformat("dok").tocsc().toarray()), s.toarray()
+    )
+    # transpose round trips
+    np.testing.assert_allclose(A.todok().T.toarray(), s.toarray().T)
+    np.testing.assert_allclose(A.tolil().T.toarray(), s.toarray().T)
+
+
+def test_dok_sums_duplicate_coo():
+    """Review r3: a duplicate-holding COO must SUM into DOK like tocsr."""
+    C = sparse.coo_array(
+        (np.array([2.0, 3.0]), (np.array([1, 1]), np.array([1, 1]))),
+        shape=(3, 3),
+    )
+    D = C.todok()
+    assert np.isclose(D[1, 1], 5.0)
+
+
+def test_shape_override_validation():
+    dense = np.arange(9.0).reshape(3, 3)
+    with pytest.raises(ValueError):
+        sparse.dok_array(dense, shape=(2, 2))
+    with pytest.raises(ValueError):
+        sparse.lil_array(dense, shape=(2, 2))
+    # growing is fine
+    L = sparse.lil_array(dense, shape=(5, 3))
+    assert L.shape == (5, 3) and L.nnz == 8
+    D = sparse.dok_array(dense, shape=(5, 4))
+    assert D.shape == (5, 4) and D.nnz == 8
+
+
+def test_dok_lil_generic_unary_ops():
+    """Review r3: neg/abs/conj/astype run through the SparseArray hooks."""
+    s = sp.csr_array(np.array([[1.0, -2.0], [0.0, 3.0]]))
+    A = sparse.csr_array(s)
+    for fmt in ("todok", "tolil"):
+        F = getattr(A, fmt)()
+        np.testing.assert_allclose((-F).toarray(), -s.toarray())
+        np.testing.assert_allclose(abs(F).toarray(), np.abs(s.toarray()))
+        assert F.astype(np.float32).dtype == np.float32
+        np.testing.assert_allclose(
+            F.astype(np.float32).toarray(), s.toarray().astype(np.float32)
+        )
+        np.testing.assert_allclose((F - F).toarray() if hasattr(F - F, "toarray") else np.asarray((F - F).toarray()), np.zeros((2, 2)))
