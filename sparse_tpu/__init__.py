@@ -17,6 +17,7 @@ from .coo import coo_array  # noqa: F401
 from .csc import csc_array  # noqa: F401
 from .csr import csr_array  # noqa: F401
 from .dia import dia_array  # noqa: F401
+from .bsr import bsr_array  # noqa: F401
 from .dok import dok_array  # noqa: F401
 from .lil import lil_array  # noqa: F401
 from .module import (  # noqa: F401
@@ -65,6 +66,7 @@ csc_matrix = csc_array
 coo_matrix = coo_array
 dia_matrix = dia_array
 dok_matrix = dok_array
+bsr_matrix = bsr_array
 lil_matrix = lil_array
 
 from . import integrate, io, linalg, quantum, spatial  # noqa: F401,E402

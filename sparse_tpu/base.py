@@ -47,7 +47,7 @@ class SparseArray:
     # ---- format dispatch -------------------------------------------------
     def asformat(self, format: str):
         """Convert to the named format ('csr', 'csc', 'coo', 'dia', 'dok',
-        'lil', 'dense').
+        'lil', 'bsr', 'dense').
 
         Reference: base.py:150-170 (dok/lil go beyond its surface).
         """
@@ -72,6 +72,47 @@ class SparseArray:
         from .lil import lil_array
 
         return lil_array(self)
+
+    def tobsr(self, blocksize=None):
+        """Block sparse row copy (``bsr.bsr_array``) — [R, C] dense blocks
+        whose SpMV runs as a batched MXU matmul. ``blocksize=None``
+        estimates the block structure like scipy (largest candidate block
+        whose fill efficiency clears a threshold; (1, 1) when the matrix
+        has none); the matrix dims must divide by the chosen size."""
+        import numpy as _np
+
+        from .bsr import bsr_array
+
+        C = self.tocsr()
+        m, n = C.shape
+        if blocksize is None:
+            blocksize = _estimate_blocksize(
+                _np.asarray(C.indptr), _np.asarray(C.indices), (m, n)
+            )
+        R, Cb = tuple(map(int, blocksize))
+        if R < 1 or Cb < 1 or m % R or n % Cb:
+            raise ValueError(
+                f"blocksize {(R, Cb)} does not divide shape {(m, n)}"
+            )
+        rows_arr = _np.repeat(
+            _np.arange(m, dtype=_np.int64), _np.diff(_np.asarray(C.indptr))
+        )
+        cols_arr = _np.asarray(C.indices, dtype=_np.int64)
+        vals = _np.asarray(C.data)
+        brow = rows_arr // R
+        bcol = cols_arr // Cb
+        Nb = n // Cb
+        key = brow * Nb + bcol
+        ublocks, binv = _np.unique(key, return_inverse=True)
+        nnzb = int(ublocks.shape[0])
+        data = _np.zeros((max(nnzb, 0), R, Cb), dtype=vals.dtype)
+        data[binv, rows_arr % R, cols_arr % Cb] = vals
+        indptr = _np.zeros(m // R + 1, dtype=_np.int64)
+        _np.add.at(indptr, (ublocks // Nb) + 1, 1)
+        indptr = _np.cumsum(indptr)
+        return bsr_array(
+            (data, (ublocks % Nb).astype(_np.int64), indptr), shape=(m, n)
+        )
 
     # ---- generic arithmetic wired through format-specific primitives -----
     def __neg__(self):
@@ -504,3 +545,23 @@ def _resolve_shape(shape, rows, cols):
         host_int(rows.max()) + 1,
         host_int(cols.max()) + 1,
     )
+
+
+def _estimate_blocksize(indptr, indices, shape, efficiency: float = 0.7):
+    """scipy-style block-structure estimation: the largest candidate (r, c)
+    dividing the shape whose dense-block fill efficiency
+    nnz / (nnzb * r * c) clears the threshold. Returns (1, 1) when the
+    matrix has no block structure."""
+    m, n = shape
+    nnz = int(indptr[-1])
+    if nnz == 0:
+        return (1, 1)
+    rows = np.repeat(np.arange(m, dtype=np.int64), np.diff(indptr))
+    cols = np.asarray(indices, dtype=np.int64)
+    for r, c in ((6, 6), (4, 4), (3, 3), (2, 2)):
+        if m % r or n % c:
+            continue
+        nnzb = np.unique((rows // r) * (n // c) + cols // c).shape[0]
+        if nnz / (nnzb * r * c) >= efficiency:
+            return (r, c)
+    return (1, 1)
