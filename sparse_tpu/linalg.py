@@ -1429,7 +1429,42 @@ _EXPM_THETA = {
 
 
 @track_provenance
-def expm_multiply(A, B, t: float = 1.0):
+def matrix_power(A, power: int):
+    """A**power for sparse A (scipy.sparse.linalg.matrix_power subset:
+    nonnegative integer powers), via binary exponentiation over the
+    device SpGEMM — log2(power) sparse products."""
+    from .base import SparseArray
+    from .module import identity
+
+    import operator
+
+    if not isinstance(A, SparseArray):
+        raise TypeError("matrix_power expects a sparse array")
+    m, n = A.shape
+    if m != n:
+        raise ValueError("matrix_power expects a square matrix")
+    power = operator.index(power)  # rejects 2.5 etc. like scipy
+    if power < 0:
+        raise ValueError("negative powers are not supported (no sparse inv)")
+    if power == 0:
+        return identity(n, dtype=A.dtype, format="csr")
+    result = None
+    base = A.tocsr()
+    while power:
+        if power & 1:
+            result = base if result is None else (result @ base).tocsr()
+        power >>= 1
+        if power:
+            base = (base @ base).tocsr()
+    # power == 1 aliases the input (csr.tocsr() returns self): copy so
+    # callers mutating the result cannot corrupt A
+    if result is A or result is A.tocsr():
+        result = result.copy()
+    return result
+
+
+@track_provenance
+def expm_multiply(A, B, t: float = 1.0, start=None, stop=None, num=None, endpoint=True, _a1=None):
     """``e^(tA) @ B`` without forming the matrix exponential.
 
     Beyond the reference: the action of the exponential is THE quantum
@@ -1438,14 +1473,45 @@ def expm_multiply(A, B, t: float = 1.0):
     Al-Mohy & Higham (m*, s) selection driven by the exact sparse 1-norm
     (one column-sum reduction); each of the s stages runs m SpMV steps on
     device. Handles complex t*A; B may be a vector or a matrix.
+
+    scipy's time-grid form: with ``start``/``stop``/``num`` the result is
+    stacked over ``numpy.linspace(start, stop, num, endpoint=endpoint)``
+    — each interval advances the previous state, so a whole evolution
+    trajectory costs one pass.
     """
     from .base import SparseArray
+
+    if start is not None or stop is not None or num is not None:
+        if num is None or stop is None:
+            raise ValueError("the time-grid form needs stop= and num=")
+        if t != 1.0:
+            raise ValueError(
+                "t= cannot be combined with the start/stop/num grid form"
+            )
+        start = 0.0 if start is None else start
+        ts = np.linspace(start, stop, int(num), endpoint=endpoint)
+        # one 1-norm evaluation serves every interval (uniform linspace:
+        # all the chained dt's are identical)
+        A_op0 = make_linear_operator(A)
+        dt0 = jnp.result_type(asjnp(B).dtype, A_op0.dtype, type(float(np.real(ts[-1]))))
+        if isinstance(A, SparseArray):
+            a1 = float(np.asarray(jnp.real(norm(A, ord=1))))
+        else:
+            a1 = _onenorm_est(A_op0, dt0)[0]
+        out = [expm_multiply(A, B, t=float(ts[0]), _a1=a1)]
+        for i in range(1, len(ts)):
+            out.append(
+                expm_multiply(A, out[-1], t=float(ts[i] - ts[i - 1]), _a1=a1)
+            )
+        return jnp.stack(out)
 
     A_op = make_linear_operator(A)
     B = asjnp(B)
     dt = jnp.result_type(B.dtype, A_op.dtype, type(t))
     B = B.astype(dt)
-    if isinstance(A, SparseArray):
+    if _a1 is not None:
+        a_norm = _a1 * abs(t)
+    elif isinstance(A, SparseArray):
         a_norm = float(np.asarray(jnp.real(norm(A, ord=1)))) * abs(t)
     else:
         # LinearOperator input: Higham-style 1-norm power estimation on
@@ -1612,6 +1678,7 @@ __all__ = [
     "cg_axpby",
     "norm",
     "expm_multiply",
+    "matrix_power",
     "svds",
     "onenormest",
 ]

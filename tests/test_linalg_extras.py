@@ -183,3 +183,44 @@ def test_onenormest_certificate_operator():
     assert np.isclose(est, np.abs(np.asarray(w)).sum())
     assert np.isclose(est, 100.0)
     np.testing.assert_allclose(np.asarray(M @ np.asarray(v)), np.asarray(w))
+
+
+def test_matrix_power():
+    s = sample_csr(15, 15, density=0.2, seed=72)
+    A = sparse.csr_array(s)
+    for p in (0, 1, 2, 5):
+        want = np.linalg.matrix_power(s.toarray(), p)
+        got = np.asarray(linalg.matrix_power(A, p).toarray())
+        np.testing.assert_allclose(got, want, rtol=1e-9, atol=1e-9)
+    with pytest.raises(ValueError):
+        linalg.matrix_power(A, -1)
+    with pytest.raises(ValueError):
+        linalg.matrix_power(sparse.csr_array(sample_csr(3, 4, 0.5, seed=73)), 2)
+
+
+def test_expm_multiply_time_grid():
+    """scipy's linspace form: one pass yields the whole trajectory."""
+    s = sample_csr(20, 20, density=0.15, seed=74)
+    s.data -= 0.5
+    A = sparse.csr_array(s)
+    v = np.linspace(-1, 1, 20)
+    got = np.asarray(linalg.expm_multiply(A, v, start=0.0, stop=1.0, num=5))
+    want = sla.expm_multiply(s.tocsc(), v, start=0.0, stop=1.0, num=5)
+    np.testing.assert_allclose(got, want, rtol=1e-7, atol=1e-9)
+
+
+def test_matrix_power_edges():
+    """Review r3: non-integer powers raise; power 1 returns a copy."""
+    s = sample_csr(8, 8, density=0.3, seed=75)
+    A = sparse.csr_array(s)
+    with pytest.raises(TypeError):
+        linalg.matrix_power(A, 2.5)
+    P1 = linalg.matrix_power(A, 1)
+    assert P1 is not A
+    np.testing.assert_allclose(np.asarray(P1.toarray()), s.toarray())
+
+
+def test_expm_grid_rejects_t():
+    A = sparse.csr_array(sample_csr(5, 5, 0.4, seed=76))
+    with pytest.raises(ValueError):
+        linalg.expm_multiply(A, np.ones(5), t=2.0, start=0.0, stop=1.0, num=3)
