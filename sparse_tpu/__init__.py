@@ -38,10 +38,13 @@ from .module import (  # noqa: F401
     is_sparse_matrix,
     issparse,
     isspmatrix,
+    isspmatrix_bsr,
     isspmatrix_coo,
     isspmatrix_csc,
     isspmatrix_csr,
     isspmatrix_dia,
+    isspmatrix_dok,
+    isspmatrix_lil,
     kron,
     kronsum,
     load_npz,

@@ -141,6 +141,62 @@ class coo_array(SparseArray):
     def dot(self, other):
         return self.tocsr().dot(other)
 
+    def tensordot(self, other, axes=2):
+        """np.tensordot semantics restricted to 2-D operands.
+
+        scipy.sparse's n-D coo_array grew ``tensordot``; this package is
+        2-D-only (like the reference), so the supported contractions are
+        the 2-D ones: one shared axis (a transposed matmul) or both axes
+        (a full contraction to a scalar).
+        """
+        ndim_b = getattr(other, "ndim", np.ndim(other))
+        if isinstance(axes, (int, np.integer)):
+            k = int(axes)
+            a_axes = tuple(range(self.ndim - k, self.ndim))
+            b_axes = tuple(range(k))
+        else:
+            a_axes, b_axes = axes
+            if isinstance(a_axes, (int, np.integer)):
+                a_axes = (int(a_axes),)
+            if isinstance(b_axes, (int, np.integer)):
+                b_axes = (int(b_axes),)
+            for ax, nd, side in (
+                *((ax, self.ndim, "a") for ax in a_axes),
+                *((ax, ndim_b, "b") for ax in b_axes),
+            ):
+                if not -nd <= int(ax) < nd:
+                    raise ValueError(
+                        f"axes value {ax} out of range for {side} "
+                        f"(ndim {nd})"
+                    )
+            a_axes = tuple(int(ax) % self.ndim for ax in a_axes)
+            b_axes = tuple(int(ax) % ndim_b for ax in b_axes)
+        if len(a_axes) != len(b_axes):
+            raise ValueError("axes lists must have the same length")
+        if len(a_axes) == 1:
+            a = self if a_axes[0] == self.ndim - 1 else self.transpose()
+            b = other
+            if ndim_b == 2 and b_axes[0] == 1:
+                b = other.transpose() if isinstance(other, SparseArray) else np.asarray(other).T
+            return a.dot(b)
+        if len(a_axes) == 2 and ndim_b == 2:
+            # full contraction: sum_ij A[i,j] * B'[i,j]
+            b = other
+            if a_axes[0] != b_axes[0]:  # pairing crosses: align via transpose
+                b = other.transpose() if isinstance(other, SparseArray) else np.asarray(other).T
+            if isinstance(b, SparseArray):
+                b = b.toarray()
+            b = np.asarray(b)
+            if tuple(b.shape) != tuple(self.shape):
+                # multiply() broadcasts; tensordot must not (numpy raises)
+                raise ValueError(
+                    f"shape mismatch in tensordot: {self.shape} vs {b.shape}"
+                )
+            return self.multiply(b).sum()
+        raise NotImplementedError(
+            "tensordot on 2-D sparse arrays supports 1- or 2-axis contractions"
+        )
+
     def _rdot(self, other):
         return self.tocsr()._rdot(other)
 

@@ -200,6 +200,24 @@ def isspmatrix_dia(o) -> bool:
     return isinstance(o, dia_array)
 
 
+def isspmatrix_bsr(o) -> bool:
+    from .bsr import bsr_array
+
+    return isinstance(o, bsr_array)
+
+
+def isspmatrix_dok(o) -> bool:
+    from .dok import dok_array
+
+    return isinstance(o, dok_array)
+
+
+def isspmatrix_lil(o) -> bool:
+    from .lil import lil_array
+
+    return isinstance(o, lil_array)
+
+
 # ---------------------------------------------------------------------------
 # Block assembly / triangles / nonzero surface (coverage.py parity layer) —
 # the scipy.sparse construction helpers the reference's drop-in story

@@ -1,0 +1,179 @@
+"""Direct-solver surface tests: spsolve_triangular, splu/spilu/factorized,
+inv, expm, is_sptriangular, spbandwidth — scipy oracles.
+
+Beyond the reference (its spsolve is CG, linalg.py:88); scipy.sparse.linalg
+drop-in completeness.
+"""
+
+import numpy as np
+import pytest
+import scipy.linalg
+import scipy.sparse as sp
+import scipy.sparse.linalg as sla
+
+import sparse_tpu as sparse
+import sparse_tpu.linalg as linalg
+from .utils.sample import sample_vec
+
+
+def _tri(n, lower=True, seed=0, unit=False):
+    rng = np.random.default_rng(seed)
+    M = sp.random(n, n, 0.15, random_state=rng).toarray()
+    M = np.tril(M, -1) if lower else np.triu(M, 1)
+    d = np.ones(n) if unit else rng.uniform(1.0, 2.0, n)
+    return sp.csr_matrix(M + np.diag(d))
+
+
+def test_spbandwidth_and_is_sptriangular():
+    n = 20
+    L = _tri(n, lower=True)
+    U = _tri(n, lower=False)
+    A = sparse.csr_array(L)
+    B = sparse.csr_array(U)
+    lo, hi = linalg.spbandwidth(A)
+    assert hi == 0 and lo > 0
+    assert linalg.is_sptriangular(A) == (True, False)
+    assert linalg.is_sptriangular(B) == (False, True)
+    D = sparse.eye(5)
+    assert linalg.is_sptriangular(D) == (True, True)
+    assert linalg.spbandwidth(D) == (0, 0)
+
+
+@pytest.mark.parametrize("lower", [True, False])
+@pytest.mark.parametrize("nrhs", [0, 3])
+def test_spsolve_triangular(lower, nrhs):
+    n = 300  # > one block: exercises the scan chain
+    T = _tri(n, lower=lower, seed=1)
+    A = sparse.csr_array(T)
+    b = (
+        sample_vec(n, seed=2)
+        if nrhs == 0
+        else np.stack([sample_vec(n, seed=2 + i) for i in range(nrhs)], axis=1)
+    )
+    x = np.asarray(linalg.spsolve_triangular(A, b, lower=lower, block=64))
+    x_sci = sla.spsolve_triangular(T.tocsr(), b, lower=lower)
+    np.testing.assert_allclose(x, x_sci, rtol=2e-4, atol=2e-5)
+
+
+def test_spsolve_triangular_unit_diagonal():
+    n = 120
+    T = _tri(n, lower=True, seed=3, unit=True)
+    A = sparse.csr_array(T)
+    b = sample_vec(n, seed=4)
+    x = np.asarray(
+        linalg.spsolve_triangular(A, b, lower=True, unit_diagonal=True, block=50)
+    )
+    x_sci = sla.spsolve_triangular(T.tocsr(), b, lower=True, unit_diagonal=True)
+    np.testing.assert_allclose(x, x_sci, rtol=2e-4, atol=2e-5)
+
+
+def test_spsolve_triangular_rejects_wrong_shape_and_singular():
+    n = 10
+    T = _tri(n, lower=True, seed=5).toarray()
+    T[3, 3] = 0.0
+    A = sparse.csr_array(sp.csr_matrix(T))
+    with pytest.raises(np.linalg.LinAlgError):
+        linalg.spsolve_triangular(A, np.ones(n), lower=True)
+    full = sparse.csr_array(sp.csr_matrix(np.ones((4, 4))))
+    with pytest.raises(ValueError):
+        linalg.spsolve_triangular(full, np.ones(4), lower=True)
+
+
+def _gen(n, seed=6):
+    rng = np.random.default_rng(seed)
+    return (sp.random(n, n, 0.2, random_state=rng) + n * sp.identity(n)).tocsr()
+
+
+def test_splu_solve_and_factors():
+    n = 60
+    S = _gen(n)
+    A = sparse.csr_array(S)
+    lu = linalg.splu(A)
+    assert lu.shape == (n, n) and lu.nnz == S.nnz
+    b = sample_vec(n, seed=7)
+    x = np.asarray(lu.solve(b))
+    np.testing.assert_allclose(x, sla.spsolve(S.tocsc(), b), rtol=1e-4, atol=1e-5)
+    # transpose solve
+    xt = np.asarray(lu.solve(b, trans="T"))
+    np.testing.assert_allclose(
+        xt, sla.spsolve(S.T.tocsc(), b), rtol=1e-4, atol=1e-5
+    )
+    # scipy SuperLU convention: Pr @ A @ Pc == L @ U with
+    # Pr[perm_r[i], i] = 1, i.e. (L @ U)[perm_r] == A
+    L = np.asarray(lu.L.todense())
+    U = np.asarray(lu.U.todense())
+    np.testing.assert_allclose(
+        (L @ U)[lu.perm_r], S.toarray(), rtol=1e-4, atol=1e-4
+    )
+    Pr = sp.csc_matrix(
+        (np.ones(n), (lu.perm_r, np.arange(n))), shape=(n, n)
+    )
+    np.testing.assert_allclose(
+        (Pr @ S).toarray(), L @ U, rtol=1e-4, atol=1e-4
+    )
+
+
+def test_spilu_preconditions_cg():
+    n = 80
+    rng = np.random.default_rng(8)
+    S = sp.random(n, n, 0.1, random_state=rng)
+    S = (S + S.T) * 0.5 + sp.diags(np.linspace(1, 3, n))
+    S = S.tocsr()
+    A = sparse.csr_array(S)
+    ilu = linalg.spilu(A)
+    b = sample_vec(n, seed=9)
+    # the exact-LU "incomplete" factorization solves in one apply
+    x = np.asarray(ilu.solve(b))
+    np.testing.assert_allclose(
+        x, sla.spsolve(S.tocsc(), b), rtol=1e-4, atol=1e-5
+    )
+
+
+def test_factorized_closure():
+    n = 40
+    S = _gen(n, seed=10)
+    solve = linalg.factorized(sparse.csr_array(S))
+    b = sample_vec(n, seed=11)
+    np.testing.assert_allclose(
+        np.asarray(solve(b)), sla.spsolve(S.tocsc(), b), rtol=1e-4, atol=1e-5
+    )
+
+
+def test_inv():
+    n = 30
+    S = _gen(n, seed=12)
+    Ainv = linalg.inv(sparse.csr_array(S))
+    assert Ainv.format == "csr"
+    np.testing.assert_allclose(
+        np.asarray(Ainv.todense()), np.linalg.inv(S.toarray()),
+        rtol=1e-3, atol=1e-4,
+    )
+
+
+def test_expm():
+    n = 25
+    rng = np.random.default_rng(13)
+    S = sp.random(n, n, 0.2, random_state=rng).tocsr() * 0.5
+    E = linalg.expm(sparse.csr_array(S))
+    assert E.format == "csr"
+    np.testing.assert_allclose(
+        np.asarray(E.todense()), scipy.linalg.expm(S.toarray()),
+        rtol=1e-4, atol=1e-5,
+    )
+
+
+def test_splu_size_ceiling_raises():
+    big = sparse.eye(9000)
+    with pytest.raises(ValueError):
+        linalg.splu(big)
+
+
+def test_splu_complex_rhs_on_real_factor():
+    n = 30
+    S = _gen(n, seed=30)
+    lu = linalg.splu(sparse.csr_array(S))
+    rng = np.random.default_rng(31)
+    b = rng.standard_normal(n) + 1j * rng.standard_normal(n)
+    x = np.asarray(lu.solve(b))
+    x_sci = sla.spsolve(S.tocsc().astype(np.complex128), b)
+    np.testing.assert_allclose(x, x_sci, rtol=1e-4, atol=1e-5)
