@@ -1,0 +1,191 @@
+"""LaplacianNd — the N-D grid Laplacian LinearOperator with analytic
+eigenpairs (scipy.sparse.linalg.LaplacianNd drop-in; beyond the
+reference's surface).
+
+TPU design: ``matvec`` applies the stencil as shifted adds on the
+reshaped grid (pure XLA slice/pad fusion — no sparse gather at all), so
+the operator is usable directly inside the device-resident solvers
+(cg/minres/lobpcg) at full fusion. ``tosparse`` assembles the matrix via
+``kronsum`` of 1-D stencils, the same identity the reference's PDE
+examples build on.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from .linalg import LinearOperator
+from .utils import asjnp
+
+__all__ = ["LaplacianNd"]
+
+_BCS = ("dirichlet", "neumann", "periodic")
+
+
+def _eigvals_1d(n: int, bc: str) -> np.ndarray:
+    i = np.arange(n)
+    if bc == "dirichlet":
+        return -4.0 * np.sin(np.pi * (i + 1) / (2 * (n + 1))) ** 2
+    if bc == "neumann":
+        return -4.0 * np.sin(np.pi * i / (2 * n)) ** 2
+    return -4.0 * np.sin(np.pi * i / n) ** 2  # periodic
+
+
+def _eigvecs_1d(n: int, bc: str) -> np.ndarray:
+    """[n, n] columns = eigenvectors matching _eigvals_1d order."""
+    j = np.arange(n)[:, None]
+    i = np.arange(n)[None, :]
+    if bc == "dirichlet":
+        V = np.sin(np.pi * (j + 1) * (i + 1) / (n + 1))
+    elif bc == "neumann":
+        V = np.cos(np.pi * i * (j + 0.5) / n)
+    else:  # periodic: real cos/sin combinations per frequency k
+        V = np.zeros((n, n))
+        for k in range(n):
+            if k == 0:
+                V[:, k] = 1.0
+            elif 2 * k == n:  # Nyquist
+                V[:, k] = np.cos(np.pi * j[:, 0])
+            elif k <= n // 2:
+                V[:, k] = np.cos(2 * np.pi * k * j[:, 0] / n)
+            else:  # sin partner of frequency n-k (same eigenvalue)
+                V[:, k] = np.sin(2 * np.pi * (n - k) * j[:, 0] / n)
+    V /= np.linalg.norm(V, axis=0, keepdims=True)
+    return V
+
+
+class LaplacianNd(LinearOperator):
+    """N-D grid Laplacian with ``dirichlet``/``neumann``/``periodic``
+    boundary conditions (scipy.sparse.linalg.LaplacianNd surface:
+    ``toarray``, ``tosparse``, ``eigenvalues(m)``, ``eigenvectors(m)``).
+
+    Documented deviation: for a SIZE-1 axis under neumann/periodic,
+    scipy's ``toarray`` adds -1 to the diagonal while its own
+    ``eigenvalues`` formula says the axis contributes 0 (scipy's matrix
+    and eigenvalues disagree there). Here all three views — ``matvec``,
+    ``tosparse`` and the analytic eigenpairs — agree on the correct
+    convention: a single cell has no neighbors, contribution 0.
+    """
+
+    def __init__(self, grid_shape, *, boundary_conditions="neumann",
+                 dtype=np.int8):
+        if boundary_conditions not in _BCS:
+            raise ValueError(
+                f"boundary_conditions must be one of {_BCS}, got "
+                f"{boundary_conditions!r}"
+            )
+        self.grid_shape = tuple(int(g) for g in grid_shape)
+        self.boundary_conditions = boundary_conditions
+        n = int(np.prod(self.grid_shape))
+        super().__init__((n, n), dtype=dtype)
+
+    # -- operator application (pure shifted adds; fuses under jit) --------
+    def matvec(self, x, out=None):
+        x = asjnp(x)
+        squeeze = x.ndim == 1
+        cols = 1 if squeeze else x.shape[1]
+        g = self.grid_shape
+        bc = self.boundary_conditions
+        v = x.reshape(g + (cols,))
+        y = jnp.zeros_like(v)
+        for ax in range(len(g)):
+            n = g[ax]
+            up = jnp.roll(v, -1, axis=ax)     # neighbor at i+1
+            dn = jnp.roll(v, 1, axis=ax)      # neighbor at i-1
+            if bc != "periodic":
+                # zero the wrapped entries
+                idx_last = [slice(None)] * v.ndim
+                idx_last[ax] = n - 1
+                up = up.at[tuple(idx_last)].set(0)
+                idx_first = [slice(None)] * v.ndim
+                idx_first[ax] = 0
+                dn = dn.at[tuple(idx_first)].set(0)
+            diag = jnp.full_like(v, -2.0)
+            if bc == "neumann":
+                # missing neighbor contributes its own cell: -1 on faces
+                idx_last = [slice(None)] * v.ndim
+                idx_last[ax] = n - 1
+                diag = diag.at[tuple(idx_last)].set(-1.0)
+                idx_first = [slice(None)] * v.ndim
+                idx_first[ax] = 0
+                diag = diag.at[tuple(idx_first)].add(1.0)
+            y = y + up + dn + diag * v
+        y = y.reshape((self.shape[0], cols))
+        return y[:, 0] if squeeze else y
+
+    rmatvec = matvec  # symmetric
+
+    def matmat(self, X, out=None):
+        return self.matvec(X)
+
+    # -- assembly ---------------------------------------------------------
+    def tosparse(self):
+        from .module import diags, kronsum
+
+        parts = []
+        for n in self.grid_shape:
+            o = np.full(n - 1, 1.0) if n > 1 else np.zeros(0)
+            d = np.full(n, -2.0)
+            if self.boundary_conditions == "neumann":
+                # += (not =): a size-1 axis has BOTH faces on one cell,
+                # whose diagonal must cancel to 0 (matvec agrees)
+                d[0] += 1.0
+                d[-1] += 1.0
+            bands = [o, d, o]
+            offs = [-1, 0, 1]
+            if self.boundary_conditions == "periodic" and n == 1:
+                # a single periodic cell is its own both neighbors: 0
+                bands = [np.zeros(1)]
+                offs = [0]
+            elif self.boundary_conditions == "periodic" and n == 2:
+                # wrap and direct neighbor coincide: coupling 2
+                bands = [np.full(1, 2.0), d, np.full(1, 2.0)]
+            elif self.boundary_conditions == "periodic" and n > 2:
+                bands = [np.ones(1), o, d, o, np.ones(1)]
+                offs = [-(n - 1), -1, 0, 1, n - 1]
+            parts.append(diags(bands, offs, shape=(n, n)))
+        L = parts[0]
+        for p in parts[1:]:
+            L = kronsum(p, L)  # kron(I, L) + kron(p, I): row-major order
+        return L.tocsr()
+
+    def toarray(self):
+        return np.asarray(self.tosparse().todense()).astype(self.dtype)
+
+    # -- analytic eigenpairs ---------------------------------------------
+    def _all_eigvals(self):
+        lams = [_eigvals_1d(n, self.boundary_conditions)
+                for n in self.grid_shape]
+        total = np.zeros(self.grid_shape)
+        for ax, lam in enumerate(lams):
+            shape = [1] * len(self.grid_shape)
+            shape[ax] = len(lam)
+            total = total + lam.reshape(shape)
+        return total
+
+    def eigenvalues(self, m=None):
+        """All (or the ``m`` largest) eigenvalues, ascending (scipy)."""
+        w = np.sort(self._all_eigvals().ravel())
+        if m is None:
+            return w
+        return w[len(w) - int(m):]  # NOT w[-m:]: m=0 must give empty
+
+    def eigenvectors(self, m=None):
+        """Eigenvectors matching ``eigenvalues(m)``'s order, [N, m]."""
+        total = self._all_eigvals().ravel()
+        order = np.argsort(total)
+        if m is not None:
+            order = order[len(order) - int(m):]
+        Vs = [_eigvecs_1d(n, self.boundary_conditions)
+              for n in self.grid_shape]
+        if len(order) == 0:
+            return np.zeros((self.shape[0], 0))
+        cols = []
+        for flat in order:
+            idx = np.unravel_index(flat, self.grid_shape)
+            v = np.ones(1)
+            for ax, i in enumerate(idx):
+                v = np.kron(v, Vs[ax][:, i])
+            cols.append(v / np.linalg.norm(v))
+        return np.stack(cols, axis=1)

@@ -38,6 +38,30 @@ from ._direct import (  # noqa: F401  (re-exported scipy.sparse.linalg surface)
 from ._eigen import eigs, lobpcg  # noqa: F401
 
 
+class ArpackError(RuntimeError):
+    """scipy.sparse.linalg.ArpackError alias (raised by eigs/eigsh on
+    irrecoverable iteration failures)."""
+
+
+class ArpackNoConvergence(ArpackError):
+    """scipy alias: no convergence within maxiter; carries any converged
+    partial results."""
+
+    def __init__(self, msg, eigenvalues=None, eigenvectors=None):
+        super().__init__(msg)
+        self.eigenvalues = eigenvalues if eigenvalues is not None else []
+        self.eigenvectors = eigenvectors if eigenvectors is not None else []
+
+
+class MatrixRankWarning(UserWarning):
+    """scipy.sparse.linalg.MatrixRankWarning alias."""
+
+
+def use_solver(**kwargs):
+    """scipy API no-op: there is no UMFPACK toggle here — the direct path
+    is always the device dense LU (see ``splu``)."""
+
+
 # ---------------------------------------------------------------------------
 # LinearOperator protocol (linalg.py:128-459)
 # ---------------------------------------------------------------------------
@@ -1581,6 +1605,155 @@ def qmr(A, b, x0=None, tol=1e-8, maxiter=None, M1=None, M2=None,
 
 
 # ---------------------------------------------------------------------------
+# LGMRES / GCROT(m,k): augmented-subspace Krylov (scipy drop-in surface
+# beyond the reference). Both share one skeleton: per outer cycle, build a
+# Krylov basis, augment it with recycled directions, and solve ONE
+# minimal-residual least-squares over the whole augmented block — a tall
+# [n, m+k] QR, which is exactly the MXU-shaped formulation (the classical
+# per-vector Givens update is scalar-serial; the block least squares is a
+# matmul). Recycled directions carry their A-images so augmentation costs
+# no extra matvecs.
+# ---------------------------------------------------------------------------
+def _augmented_cycle(A, Mop, r, inner_m, aug):
+    """One cycle: Krylov directions from r (right-preconditioned) plus
+    ``aug`` = list of (z, Az) pairs. Returns (dx, Adx) minimizing
+    ||r - A dx|| over the augmented subspace."""
+    n = r.shape[0]
+    inner_m = max(1, min(int(inner_m), n - len(aug)))  # subspace <= n
+    rnorm = jnp.linalg.norm(r)
+    v = r / jnp.where(rnorm == 0, 1, rnorm)
+    vs = [v]
+    Zs, AZs = [], []
+    for _ in range(inner_m):
+        z = Mop.matvec(vs[-1]) if Mop is not None else vs[-1]
+        w = A.matvec(z)
+        Zs.append(z)
+        AZs.append(w)
+        # two-pass MGS against the Krylov basis (masked-matmul shape)
+        Vstack = jnp.stack(vs, axis=1)
+        for _ in range(2):
+            w = w - Vstack @ (Vstack.conj().T @ w)
+        wn = jnp.linalg.norm(w)
+        if float(wn) <= 1e-12 * float(rnorm):
+            break  # breakdown: subspace is invariant
+        vs.append(w / wn)
+    for z, az in aug:
+        Zs.append(z)
+        AZs.append(az)
+    Z = jnp.stack(Zs, axis=1)
+    AZ = jnp.stack(AZs, axis=1)
+    # least squares min ||r - AZ y||: lstsq, not QR+solve — the augmented
+    # block can be numerically rank-deficient (converged directions)
+    y = jnp.linalg.lstsq(AZ, r)[0]
+    dx = Z @ y
+    return dx, AZ @ y
+
+
+@track_provenance
+def lgmres(A, b, x0=None, tol=1e-5, atol=0.0, maxiter=1000, M=None,
+           callback=None, inner_m=30, outer_k=3):
+    """LGMRES (Baker/Jessup/Manteuffel; scipy.sparse.linalg.lgmres
+    semantics): restarted GMRES whose restart space is augmented with the
+    last ``outer_k`` correction directions, curing restart stagnation.
+    Returns (x, info) — info=0 on convergence, else the iteration count
+    (scipy's >0 convention)."""
+    b = asjnp(b)
+    A = make_linear_operator(A)
+    if x0 is not None:
+        x0 = asjnp(x0)
+    b = b.astype(jnp.result_type(
+        b.dtype, A.dtype, *(() if x0 is None else (x0.dtype,))
+    ))
+    Mop = None if M is None else make_linear_operator(M)
+    x = jnp.zeros_like(b) if x0 is None else x0.astype(b.dtype)
+    bnorm = float(jnp.linalg.norm(b))
+    target = max(float(atol), float(tol) * (bnorm if bnorm > 0 else 1.0))
+    aug = []  # (z, Az) correction pairs, newest first
+    for it in range(int(maxiter)):
+        r = b - A.matvec(x)
+        if float(jnp.linalg.norm(r)) <= target:
+            return x, 0
+        dx, adx = _augmented_cycle(A, Mop, r, int(inner_m), aug)
+        x = x + dx
+        if callback is not None:
+            callback(x)
+        dn = jnp.linalg.norm(dx)
+        if float(dn) > 0 and int(outer_k) > 0:
+            # adx IS A dx from the cycle's own images: no extra matvec
+            aug = [(dx / dn, adx / dn)] + aug[: int(outer_k) - 1]
+    r = b - A.matvec(x)
+    return x, (0 if float(jnp.linalg.norm(r)) <= target else int(maxiter))
+
+
+@track_provenance
+def gcrotmk(A, b, x0=None, tol=1e-5, atol=0.0, maxiter=1000, M=None,
+            callback=None, m=20, k=None, truncate="oldest"):
+    """GCROT(m, k) (Hicken & Zingg / de Sturler; scipy.sparse.linalg
+    .gcrotmk semantics): GMRES(m) with a recycled outer subspace U whose
+    images C = A U are kept orthonormal; each cycle first projects the
+    residual onto C, then runs the inner cycle on the complement.
+    Returns (x, info) like scipy (0 = converged)."""
+    if k is None:
+        k = m
+    if truncate not in ("oldest", "smallest"):
+        raise ValueError("truncate must be 'oldest' or 'smallest'")
+    b = asjnp(b)
+    A = make_linear_operator(A)
+    if x0 is not None:
+        x0 = asjnp(x0)
+    b = b.astype(jnp.result_type(
+        b.dtype, A.dtype, *(() if x0 is None else (x0.dtype,))
+    ))
+    Mop = None if M is None else make_linear_operator(M)
+    x = jnp.zeros_like(b) if x0 is None else x0.astype(b.dtype)
+    bnorm = float(jnp.linalg.norm(b))
+    target = max(float(atol), float(tol) * (bnorm if bnorm > 0 else 1.0))
+    recycled = []  # (u, c) with c = A u / ||A u||, newest LAST
+    for it in range(int(maxiter)):
+        r = b - A.matvec(x)
+        # oblique projection onto the recycled image space
+        for u, c in recycled:
+            alpha = jnp.vdot(c, r)
+            x = x + alpha * u
+            r = r - alpha * c
+        if float(jnp.linalg.norm(r)) <= target:
+            return x, 0
+        dx, adx = _augmented_cycle(
+            A, Mop, r, int(m), [(u, c) for u, c in recycled]
+        )
+        x = x + dx
+        if callback is not None:
+            callback(x)
+        # maintain C orthonormal: Gram-Schmidt the new image against the
+        # kept ones, applying the same combination to u so c == A u holds
+        unew, cnew = dx, adx
+        for u, c in recycled:
+            beta = jnp.vdot(c, cnew)
+            cnew = cnew - beta * c
+            unew = unew - beta * u
+        an = jnp.linalg.norm(cnew)
+        if float(an) > 1e-12:
+            recycled.append((unew / an, cnew / an))
+            if len(recycled) > int(k):
+                if truncate == "oldest":
+                    recycled = recycled[1:]
+                else:  # 'smallest': drop the image direction least
+                    # aligned with the current correction (heuristic form
+                    # of de Sturler's smallest-coefficient truncation;
+                    # the newest pair is always kept)
+                    scores = [
+                        abs(float(jnp.vdot(c, adx))) for _, c in
+                        recycled[:-1]
+                    ]
+                    drop = int(np.argmin(scores))
+                    recycled = (
+                        recycled[:drop] + recycled[drop + 1:]
+                    )
+    r = b - A.matvec(x)
+    return x, (0 if float(jnp.linalg.norm(r)) <= target else int(maxiter))
+
+
+# ---------------------------------------------------------------------------
 # eigsh (linalg.py:1450) — Lanczos with full reorthogonalization
 # ---------------------------------------------------------------------------
 def _lanczos_factorization(A, V0, start, ncv, rng, cache):
@@ -2211,4 +2384,13 @@ __all__ = [
     "spbandwidth",
     "eigs",
     "lobpcg",
+    "LaplacianNd",
+    "ArpackError",
+    "ArpackNoConvergence",
+    "MatrixRankWarning",
+    "use_solver",
+    "lgmres",
+    "gcrotmk",
 ]
+
+from ._laplacian import LaplacianNd  # noqa: F401,E402
