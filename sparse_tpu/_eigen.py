@@ -24,7 +24,99 @@ import jax.numpy as jnp
 from .coverage import track_provenance
 from .utils import asjnp
 
-__all__ = ["lobpcg", "eigs"]
+__all__ = ["lobpcg", "eigs", "funm_multiply_krylov", "ArpackError",
+           "ArpackNoConvergence"]
+
+
+class ArpackError(RuntimeError):
+    """scipy.sparse.linalg.ArpackError alias (raised by eigs/eigsh on
+    irrecoverable iteration failures, e.g. Arnoldi breakdown below k)."""
+
+
+class ArpackNoConvergence(ArpackError):
+    """scipy alias: no convergence within maxiter; carries any converged
+    partial results in ``eigenvalues``/``eigenvectors``."""
+
+    def __init__(self, msg, eigenvalues=None, eigenvectors=None):
+        super().__init__(msg)
+        self.eigenvalues = eigenvalues if eigenvalues is not None else []
+        self.eigenvectors = eigenvectors if eigenvectors is not None else []
+
+
+def funm_multiply_krylov(f, A, b, *, assume_a="general", t=1.0, atol=0.0,
+                         rtol=1e-6, restart_every_m=None, max_restarts=20):
+    """Restarted Krylov evaluation of ``y = f(t A) b``
+    (scipy.sparse.linalg.funm_multiply_krylov semantics).
+
+    Arnoldi with full two-pass reorthogonalization (valid for both
+    ``assume_a`` modes; the hermitian case simply enjoys a numerically
+    tridiagonal projection) — device matvecs, MXU-shaped projections.
+    ``f`` is applied on host to the accumulated block-Hessenberg of all
+    cycles (the Eiermann-Ernst restart: f of the enlarged matrix makes
+    each cycle's correction exact for the subspace so far), and this
+    cycle's block of ``beta * f(tH) e1`` is lifted back through V.
+    """
+    from .linalg import make_linear_operator
+
+    if assume_a not in ("general", "gen", "hermitian", "her"):
+        raise ValueError(f"assume_a={assume_a!r} not in general/hermitian")
+    A = make_linear_operator(A)
+    n = A.shape[0]
+    b = asjnp(b)
+    dt = jnp.result_type(A.dtype, b.dtype, jnp.float32)
+    b = b.astype(dt)
+    m = int(restart_every_m) if restart_every_m else min(n, 20)
+    m = max(1, min(m, n))
+    beta = float(jnp.linalg.norm(b))
+    if beta == 0:
+        return jnp.zeros_like(b)
+
+    y = jnp.zeros_like(b)
+    H_full = np.zeros((0, 0), dtype=np.complex128)
+    last_beta = 0.0
+    v = b / beta
+    for _ in range(int(max_restarts)):
+        V = jnp.zeros((m + 1, n), dtype=dt).at[0].set(v)
+        H = np.zeros((m + 1, m), dtype=np.complex128)
+        # shared Arnoldi kernel (same code path as eigs); breakdown is
+        # relative to each H column's own norm — NOT ||b||, which would
+        # falsely trigger for large-norm b
+        V, H, mdone = _arnoldi_extend(
+            A.matvec, V, H, 0, m, breakdown_tol=1e-12
+        )
+        colnorm = float(np.linalg.norm(H[: mdone + 1, mdone - 1]))
+        breakdown = float(abs(H[mdone, mdone - 1])) <= 1e-12 * colnorm
+        # append this cycle's block to the accumulated Hessenberg
+        k0 = H_full.shape[0]
+        Hnew = np.zeros((k0 + mdone, k0 + mdone), dtype=np.complex128)
+        Hnew[:k0, :k0] = H_full
+        Hnew[k0:, k0:] = H[:mdone, :mdone]
+        if k0 > 0:
+            Hnew[k0, k0 - 1] = last_beta
+        H_full = Hnew
+        last_beta = H[mdone, mdone - 1]
+        # f on the accumulated projection; lift this cycle's coefficients
+        F = np.asarray(f(t * H_full), dtype=np.complex128)
+        coeff = beta * F[k0: k0 + mdone, 0]
+        real_out = not jnp.iscomplexobj(b)
+        if real_out and np.abs(coeff.imag).max(initial=0.0) <= 1e-12 * max(
+            np.abs(coeff).max(initial=0.0), 1e-300
+        ):
+            dy = V[:mdone].T @ jnp.asarray(coeff.real, dtype=dt)
+        else:
+            cdt = jnp.result_type(dt, jnp.complex64)
+            dy = (V[:mdone].T.astype(cdt)
+                  @ jnp.asarray(coeff, dtype=cdt))
+            y = y.astype(cdt)
+        y = y + dy
+        dnorm = float(jnp.linalg.norm(dy))
+        ynorm = float(jnp.linalg.norm(y))
+        if dnorm <= max(float(atol), float(rtol) * max(ynorm, 1e-30)):
+            return y
+        if breakdown:
+            return y  # invariant subspace: the evaluation is exact
+        v = V[mdone]
+    return y
 
 
 def _ortho_cols(M):
@@ -124,12 +216,14 @@ def lobpcg(A, X, B=None, M=None, Y=None, tol=None, maxiter=20,
 # ---------------------------------------------------------------------------
 # eigs: Krylov-Schur restarted Arnoldi
 # ---------------------------------------------------------------------------
-def _arnoldi_extend(matvec, V, H, start, ncv):
+def _arnoldi_extend(matvec, V, H, start, ncv, breakdown_tol=0.0):
     """Extend an Arnoldi-like decomposition A V[:j] = V[:j+1] H[:j+1, :j]
     from column ``start`` to ``ncv``. V is [ncv+1, n] (rows are basis
     vectors), H is [ncv+1, ncv] (host numpy). Full reorthogonalization
-    (two-pass MGS as masked matmuls — MXU-shaped like the GMRES cycle)."""
-    n = V.shape[1]
+    (two-pass MGS as masked matmuls — MXU-shaped like the GMRES cycle).
+    Breakdown is declared when the residual norm falls below
+    ``breakdown_tol`` RELATIVE to the new H column's norm — the natural
+    per-column scale (never some unrelated vector's norm)."""
     for j in range(start, ncv):
         w = matvec(V[j])
         # two-pass projection against all current basis rows
@@ -139,7 +233,8 @@ def _arnoldi_extend(matvec, V, H, start, ncv):
             H[: j + 1, j] += np.asarray(coeffs)
         beta = float(jnp.linalg.norm(w))
         H[j + 1, j] = beta
-        if beta == 0:  # invariant subspace found
+        colscale = float(np.linalg.norm(H[: j + 2, j]))
+        if beta <= breakdown_tol * colscale:  # invariant subspace found
             return V, H, j + 1
         V = V.at[j + 1].set(w / beta)
     return V, H, ncv
@@ -215,13 +310,14 @@ def eigs(A, k=6, which="LM", v0=None, ncv=None, maxiter=None, tol=0.0,
     # an f64-eps-derived target)
     ceps = float(np.finfo(np.dtype(jnp.zeros((), cdt).real.dtype)).eps)
     tol_eff = tol if tol > 0 else ceps ** (2 / 3)
+    partial_evals = np.array([])
 
     for _ in range(int(maxiter)):
         m = mdone
         if m < kk:
             # Arnoldi breakdown: an exact invariant subspace smaller than
             # the request — no k-dimensional Krylov space exists from v0
-            raise RuntimeError(
+            raise ArpackError(
                 f"eigs: Arnoldi breakdown at subspace dimension {m} < "
                 f"k={kk}; the operator has an invariant subspace "
                 "containing v0 — try a different v0 or smaller k"
@@ -252,6 +348,10 @@ def eigs(A, k=6, which="LM", v0=None, ncv=None, maxiter=None, tol=0.0,
         order = _select(evals_all, which, min(k, sdim))
         coup = np.abs(bs @ Sv[:, order])  # |A y - lam y| per Ritz vector
         scale = np.maximum(np.abs(evals_all[order]), 1e-30)
+        # best Ritz values so far, with their residual couplings — the
+        # partial results ArpackNoConvergence carries on failure
+        part_mask = coup <= tol_eff * scale
+        partial_evals = evals_all[order][part_mask]
         if sdim >= k and np.all(coup <= tol_eff * scale):
             evals = evals_all[order]
             vecs = np.asarray(V[:m].T @ jnp.asarray(
@@ -280,6 +380,7 @@ def eigs(A, k=6, which="LM", v0=None, ncv=None, maxiter=None, tol=0.0,
         V = jnp.zeros_like(V).at[:keep].set(Vnew).at[keep].set(V[m])
         H = Hnew
         V, H, mdone = _arnoldi_extend(matvec, V, H, keep, ncv)
-    raise RuntimeError(
-        f"eigs: no convergence to tol={tol_eff} within {maxiter} restarts"
+    raise ArpackNoConvergence(
+        f"eigs: no convergence to tol={tol_eff} within {maxiter} restarts",
+        eigenvalues=partial_evals,
     )

@@ -35,22 +35,13 @@ from ._direct import (  # noqa: F401  (re-exported scipy.sparse.linalg surface)
     splu,
     spsolve_triangular,
 )
-from ._eigen import eigs, lobpcg  # noqa: F401
-
-
-class ArpackError(RuntimeError):
-    """scipy.sparse.linalg.ArpackError alias (raised by eigs/eigsh on
-    irrecoverable iteration failures)."""
-
-
-class ArpackNoConvergence(ArpackError):
-    """scipy alias: no convergence within maxiter; carries any converged
-    partial results."""
-
-    def __init__(self, msg, eigenvalues=None, eigenvectors=None):
-        super().__init__(msg)
-        self.eigenvalues = eigenvalues if eigenvalues is not None else []
-        self.eigenvectors = eigenvectors if eigenvectors is not None else []
+from ._eigen import (  # noqa: F401
+    ArpackError,
+    ArpackNoConvergence,
+    eigs,
+    funm_multiply_krylov,
+    lobpcg,
+)
 
 
 class MatrixRankWarning(UserWarning):
@@ -91,10 +82,62 @@ class LinearOperator:
         return jnp.stack(cols, axis=1)
 
     def __matmul__(self, x):
+        if isinstance(x, LinearOperator):
+            return _ProductOperator(self, x)
         x = asjnp(x)
+        if x.ndim == 0:
+            raise ValueError(
+                "Scalar operands are not allowed, use '*' instead"
+            )
         if x.ndim == 1:
             return self.matvec(x)
         return self.matmat(x)
+
+    # -- operator algebra (scipy's _SumLinearOperator family) -------------
+    def __add__(self, other):
+        if isinstance(other, LinearOperator):
+            return _SumOperator(self, other)
+        return NotImplemented
+
+    def __sub__(self, other):
+        if isinstance(other, LinearOperator):
+            return _SumOperator(self, _ScaledOperator(other, -1.0))
+        return NotImplemented
+
+    def __mul__(self, alpha):
+        if isinstance(alpha, LinearOperator):
+            return _ProductOperator(self, alpha)  # scipy: * composes
+        if np.isscalar(alpha) or getattr(alpha, "ndim", 1) == 0:
+            return _ScaledOperator(self, alpha)
+        return NotImplemented
+
+    def __rmul__(self, alpha):
+        if np.isscalar(alpha) or getattr(alpha, "ndim", 1) == 0:
+            return _ScaledOperator(self, alpha)
+        return NotImplemented
+
+    def dot(self, x):
+        """scipy LinearOperator.dot: vector, matrix, or operator."""
+        if isinstance(x, LinearOperator):
+            return _ProductOperator(self, x)
+        x = asjnp(x)
+        if x.ndim == 0:
+            raise ValueError(
+                "Scalar operands are not allowed, use '*' instead"
+            )
+        return self.matvec(x) if x.ndim == 1 else self.matmat(x)
+
+    def __neg__(self):
+        return _ScaledOperator(self, -1.0)
+
+    def __pow__(self, p):
+        if not isinstance(p, (int, np.integer)) or p < 0:
+            raise ValueError("operator power requires a non-negative int")
+        if self.shape[0] != self.shape[1]:
+            raise ValueError("operator power requires a square operator")
+        if p == 0:
+            return IdentityOperator(self.shape, dtype=self.dtype)
+        return _PowerOperator(self, int(p))  # flat loop, O(1) stack
 
     @property
     def H(self):
@@ -128,6 +171,83 @@ class IdentityOperator(LinearOperator):
 
     def rmatvec(self, x, out=None):
         return x
+
+
+class _SumOperator(LinearOperator):
+    def __init__(self, a, b):
+        if a.shape != b.shape:
+            raise ValueError(f"operator shape mismatch: {a.shape} + {b.shape}")
+        super().__init__(a.shape, dtype=np.result_type(a.dtype, b.dtype))
+        self._a, self._b = a, b
+
+    def matvec(self, x, out=None):
+        return self._a.matvec(x) + self._b.matvec(x)
+
+    def rmatvec(self, x, out=None):
+        return self._a.rmatvec(x) + self._b.rmatvec(x)
+
+    def matmat(self, X, out=None):
+        return self._a.matmat(X) + self._b.matmat(X)
+
+
+class _ScaledOperator(LinearOperator):
+    def __init__(self, a, alpha):
+        super().__init__(
+            a.shape, dtype=np.result_type(a.dtype, np.asarray(alpha).dtype)
+        )
+        self._a, self._alpha = a, alpha
+
+    def matvec(self, x, out=None):
+        return self._alpha * self._a.matvec(x)
+
+    def rmatvec(self, x, out=None):
+        return np.conj(self._alpha) * self._a.rmatvec(x)
+
+    def matmat(self, X, out=None):
+        return self._alpha * self._a.matmat(X)
+
+
+class _ProductOperator(LinearOperator):
+    def __init__(self, a, b):
+        if a.shape[1] != b.shape[0]:
+            raise ValueError(f"operator shape mismatch: {a.shape} @ {b.shape}")
+        super().__init__(
+            (a.shape[0], b.shape[1]), dtype=np.result_type(a.dtype, b.dtype)
+        )
+        self._a, self._b = a, b
+
+    def matvec(self, x, out=None):
+        return self._a.matvec(self._b.matvec(x))
+
+    def rmatvec(self, x, out=None):
+        return self._b.rmatvec(self._a.rmatvec(x))
+
+    def matmat(self, X, out=None):
+        return self._a.matmat(self._b.matmat(X))
+
+
+class _PowerOperator(LinearOperator):
+    """A ** p via a flat application loop (scipy's _PowerLinearOperator;
+    nesting _ProductOperator p-deep would recurse O(p) frames)."""
+
+    def __init__(self, a, p):
+        super().__init__(a.shape, dtype=a.dtype)
+        self._a, self._p = a, p
+
+    def matvec(self, x, out=None):
+        for _ in range(self._p):
+            x = self._a.matvec(x)
+        return x
+
+    def rmatvec(self, x, out=None):
+        for _ in range(self._p):
+            x = self._a.rmatvec(x)
+        return x
+
+    def matmat(self, X, out=None):
+        for _ in range(self._p):
+            X = self._a.matmat(X)
+        return X
 
 
 class _SparseMatrixLinearOperator(LinearOperator):
@@ -2391,6 +2511,7 @@ __all__ = [
     "use_solver",
     "lgmres",
     "gcrotmk",
+    "funm_multiply_krylov",
 ]
 
 from ._laplacian import LaplacianNd  # noqa: F401,E402

@@ -59,3 +59,27 @@ def test_operator_requires_square():
     D = shard_csr(rect, mesh=get_mesh(2))
     with pytest.raises(ValueError):
         D.as_operator()
+
+
+@pytest.mark.parametrize("num_shards", [2, 8])
+@pytest.mark.parametrize("solver", ["minres", "tfqmr", "lgmres", "gcrotmk"])
+def test_round3_solvers_on_mesh_operator(num_shards, solver):
+    """The round-3 solver additions inherit the same "every solver is
+    distributed" property: they only see a LinearOperator, so the mesh
+    SpMV + GSPMD psums carry them unchanged."""
+    A, D, x_true, b = _setup(num_shards)
+    op = D.as_operator()
+    bp = D.pad_out_vector(b)
+    xp = np.asarray(getattr(linalg, solver)(op, bp, tol=1e-10)[0])
+    assert np.allclose(D.unpad_vector(xp), x_true, atol=1e-4)
+
+
+@pytest.mark.parametrize("num_shards", [2])
+def test_qmr_lsmr_on_mesh_operator(num_shards):
+    A, D, x_true, b = _setup(num_shards)
+    op = D.as_operator(with_rmatvec=True, source=A)
+    bp = D.pad_out_vector(b)
+    xq = np.asarray(linalg.qmr(op, bp, tol=1e-10)[0])
+    assert np.allclose(D.unpad_vector(xq), x_true, atol=1e-4)
+    xl = np.asarray(linalg.lsmr(op, bp, atol=1e-12, btol=1e-12)[0])
+    assert np.allclose(D.unpad_vector(xl), x_true, atol=1e-4)

@@ -1,0 +1,176 @@
+"""LinearOperator algebra (+, -, scalar *, @ composition, **) and
+funm_multiply_krylov oracle tests (scipy.sparse.linalg drop-in)."""
+
+import numpy as np
+import pytest
+import scipy.linalg
+import scipy.sparse as sp
+import scipy.sparse.linalg as sla
+
+import sparse_tpu as sparse
+import sparse_tpu.linalg as linalg
+from .utils.sample import sample_vec
+
+
+def _ops(n=30, seed=0):
+    rng = np.random.default_rng(seed)
+    Ad = rng.standard_normal((n, n))
+    Bd = rng.standard_normal((n, n))
+    return (linalg.aslinearoperator(Ad), linalg.aslinearoperator(Bd),
+            Ad, Bd)
+
+
+def test_operator_sum_scale_compose():
+    A, B, Ad, Bd = _ops()
+    v = sample_vec(30, seed=1)
+    np.testing.assert_allclose(
+        np.asarray((A + B).matvec(v)), (Ad + Bd) @ v, rtol=1e-5
+    )
+    np.testing.assert_allclose(
+        np.asarray((A - B).matvec(v)), (Ad - Bd) @ v, rtol=1e-5
+    )
+    np.testing.assert_allclose(
+        np.asarray((2.5 * A).matvec(v)), 2.5 * (Ad @ v), rtol=1e-5
+    )
+    np.testing.assert_allclose(
+        np.asarray((-A).matvec(v)), -(Ad @ v), rtol=1e-5
+    )
+    np.testing.assert_allclose(
+        np.asarray((A @ B).matvec(v)), Ad @ (Bd @ v), rtol=1e-5
+    )
+    np.testing.assert_allclose(
+        np.asarray((A * B).matvec(v)), Ad @ (Bd @ v), rtol=1e-5
+    )
+    np.testing.assert_allclose(
+        np.asarray((A ** 2).matvec(v)), Ad @ (Ad @ v), rtol=1e-4
+    )
+    np.testing.assert_allclose(
+        np.asarray((A ** 0).matvec(v)), v, rtol=1e-6
+    )
+    # rmatvec of compositions (adjoint order flips)
+    np.testing.assert_allclose(
+        np.asarray((A @ B).rmatvec(v)), Bd.T @ (Ad.T @ v), rtol=1e-5
+    )
+    np.testing.assert_allclose(
+        np.asarray((A + B).rmatvec(v)), (Ad + Bd).T @ v, rtol=1e-5
+    )
+    # matmat block path
+    X = np.stack([sample_vec(30, seed=s) for s in (2, 3)], axis=1)
+    np.testing.assert_allclose(
+        np.asarray((A + 2.0 * B).matmat(X)), (Ad + 2 * Bd) @ X, rtol=1e-5
+    )
+
+
+def test_operator_algebra_shape_validation():
+    A = linalg.aslinearoperator(np.ones((3, 4)))
+    B = linalg.aslinearoperator(np.ones((4, 4)))
+    with pytest.raises(ValueError):
+        A + B
+    with pytest.raises(ValueError):
+        B @ A  # (4,4) @ (3,4) mismatch
+    with pytest.raises(ValueError):
+        A ** 2  # non-square
+    with pytest.raises(ValueError):
+        B ** -1
+
+
+def test_operator_algebra_in_solver():
+    """Composed operators must flow through the device solvers."""
+    n = 50
+    rng = np.random.default_rng(4)
+    S = (sp.random(n, n, 0.2, random_state=rng) + n * sp.identity(n)).tocsr()
+    A = linalg.aslinearoperator(sparse.csr_array(S))
+    shifted = A + (-2.0) * linalg.IdentityOperator((n, n))
+    b = sample_vec(n, seed=5)
+    x, _ = linalg.gmres(shifted, b, tol=1e-9)
+    ref = sla.spsolve((S - 2.0 * sp.identity(n)).tocsc(), b)
+    np.testing.assert_allclose(np.asarray(x), ref, atol=1e-4)
+
+
+@pytest.mark.parametrize("assume_a", ["general", "hermitian"])
+def test_funm_multiply_krylov_expm(assume_a):
+    n = 60
+    rng = np.random.default_rng(6)
+    S = sp.random(n, n, 0.1, random_state=rng) * 0.5
+    if assume_a == "hermitian":
+        S = (S + S.T) * 0.5
+    S = (S - sp.identity(n)).tocsr()
+    A = sparse.csr_array(S)
+    b = sample_vec(n, seed=7)
+    y = np.asarray(linalg.funm_multiply_krylov(
+        scipy.linalg.expm, A, b, assume_a=assume_a, t=0.7,
+        restart_every_m=12,
+    ))
+    ref = scipy.linalg.expm(0.7 * S.toarray()) @ b
+    np.testing.assert_allclose(y, ref, rtol=5e-4, atol=5e-5)
+
+
+def test_funm_multiply_krylov_inv_sqrt():
+    """A genuinely non-exponential f: A^{-1/2} b on an SPD matrix."""
+    n = 50
+    rng = np.random.default_rng(8)
+    Q = sp.random(n, n, 0.2, random_state=rng)
+    S = (Q @ Q.T + n * sp.identity(n)).tocsr()
+    A = sparse.csr_array(S)
+    b = sample_vec(n, seed=9)
+
+    def inv_sqrt(M):
+        return np.linalg.inv(scipy.linalg.sqrtm(M))
+
+    y = np.asarray(linalg.funm_multiply_krylov(
+        inv_sqrt, A, b, assume_a="her", restart_every_m=25,
+        max_restarts=8,
+    ))
+    w, V = np.linalg.eigh(S.toarray())
+    ref = V @ ((V.T @ b) / np.sqrt(w))
+    np.testing.assert_allclose(y, ref, rtol=1e-3, atol=1e-4)
+
+
+def test_funm_multiply_krylov_validates_and_zero_b():
+    A = sparse.csr_array(sp.identity(4).tocsr())
+    with pytest.raises(ValueError):
+        linalg.funm_multiply_krylov(scipy.linalg.expm, A, np.ones(4),
+                                    assume_a="banana")
+    y = linalg.funm_multiply_krylov(scipy.linalg.expm, A, np.zeros(4))
+    assert np.allclose(np.asarray(y), 0)
+
+
+def test_pow_large_exponent_no_recursion():
+    A = linalg.aslinearoperator(np.eye(8) * 0.999)
+    v = np.ones(8)
+    out = np.asarray((A ** 2000).matvec(v))
+    np.testing.assert_allclose(out, 0.999 ** 2000 * v, rtol=1e-3)
+
+
+def test_matmul_scalar_raises():
+    A = linalg.aslinearoperator(np.eye(3))
+    with pytest.raises(ValueError, match="Scalar operands"):
+        A @ 2.0
+    with pytest.raises(ValueError, match="Scalar operands"):
+        A.dot(2.0)
+
+
+def test_funm_multiply_krylov_large_norm_b():
+    """The breakdown test must scale with the H column, not ||b|| (r3
+    review: b with huge norm falsely declared an invariant subspace)."""
+    n = 40
+    rng = np.random.default_rng(10)
+    S = (sp.random(n, n, 0.2, random_state=rng) * 0.4 - sp.identity(n)).tocsr()
+    A = sparse.csr_array(S)
+    b = (rng.standard_normal(n) * 1e16).astype(np.float32)
+    y = np.asarray(linalg.funm_multiply_krylov(
+        scipy.linalg.expm, A, b, restart_every_m=15
+    ))
+    ref = scipy.linalg.expm(S.toarray()) @ b
+    np.testing.assert_allclose(y, ref, rtol=1e-3)
+
+
+def test_eigs_raises_arpack_no_convergence_with_partials():
+    n = 60
+    rng = np.random.default_rng(11)
+    S = sp.random(n, n, 0.15, random_state=rng).tocsr()
+    A = sparse.csr_array(S)
+    with pytest.raises(linalg.ArpackNoConvergence) as ei:
+        linalg.eigs(A, k=5, which="SM", maxiter=1, tol=1e-14)
+    assert hasattr(ei.value, "eigenvalues")
+    assert isinstance(ei.value, linalg.ArpackError)
