@@ -311,6 +311,7 @@ def eigs(A, k=6, which="LM", v0=None, ncv=None, maxiter=None, tol=0.0,
     ceps = float(np.finfo(np.dtype(jnp.zeros((), cdt).real.dtype)).eps)
     tol_eff = tol if tol > 0 else ceps ** (2 / 3)
     partial_evals = np.array([])
+    partial_vecs = np.zeros((n, 0), dtype=np.complex128)
 
     for _ in range(int(maxiter)):
         m = mdone
@@ -348,10 +349,17 @@ def eigs(A, k=6, which="LM", v0=None, ncv=None, maxiter=None, tol=0.0,
         order = _select(evals_all, which, min(k, sdim))
         coup = np.abs(bs @ Sv[:, order])  # |A y - lam y| per Ritz vector
         scale = np.maximum(np.abs(evals_all[order]), 1e-30)
-        # best Ritz values so far, with their residual couplings — the
+        # best Ritz pairs so far, with their residual couplings — the
         # partial results ArpackNoConvergence carries on failure
         part_mask = coup <= tol_eff * scale
         partial_evals = evals_all[order][part_mask]
+        if np.any(part_mask):
+            pv = np.asarray(V[:m].T @ jnp.asarray(
+                Z[:, :sdim] @ Sv[:, order][:, part_mask], dtype=cdt
+            ))
+            partial_vecs = pv / np.linalg.norm(pv, axis=0, keepdims=True)
+        else:
+            partial_vecs = np.zeros((n, 0), dtype=np.complex128)
         if sdim >= k and np.all(coup <= tol_eff * scale):
             evals = evals_all[order]
             vecs = np.asarray(V[:m].T @ jnp.asarray(
@@ -383,4 +391,5 @@ def eigs(A, k=6, which="LM", v0=None, ncv=None, maxiter=None, tol=0.0,
     raise ArpackNoConvergence(
         f"eigs: no convergence to tol={tol_eff} within {maxiter} restarts",
         eigenvalues=partial_evals,
+        eigenvectors=partial_vecs,
     )

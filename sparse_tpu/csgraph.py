@@ -1,0 +1,684 @@
+"""scipy.sparse.csgraph drop-in surface (beyond the reference, which has
+no graph module at all — but its AMG example builds MIS aggregation on a
+tropical-semiring SpMV, ``examples/amg.py``; this module generalizes that
+design).
+
+TPU-first formulation: the classic queue/heap graph algorithms are
+data-dependent and serial — hostile to XLA. Every distance/label routine
+here is instead a **semiring relaxation**: a fixed-shape scatter-min
+(min,+ edge relaxation) iterated inside ``lax.while_loop`` until a
+fixpoint. One iteration is one vectorized pass over all edges (the same
+shape as the library's SpMV), convergence is a single ``jnp.any`` — no
+frontier bookkeeping, no host round-trips per step. Inherently
+sequential orderings (DFS, RCM) run on host numpy, exactly where the
+reference puts its control-plane scans.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from .coverage import track_provenance
+from .utils import asjnp
+
+__all__ = [
+    "NegativeCycleError",
+    "bellman_ford",
+    "breadth_first_order",
+    "breadth_first_tree",
+    "connected_components",
+    "construct_dist_matrix",
+    "csgraph_from_dense",
+    "csgraph_from_masked",
+    "csgraph_masked_from_dense",
+    "csgraph_to_dense",
+    "csgraph_to_masked",
+    "maximum_bipartite_matching",
+    "depth_first_order",
+    "depth_first_tree",
+    "dijkstra",
+    "floyd_warshall",
+    "johnson",
+    "laplacian",
+    "minimum_spanning_tree",
+    "reconstruct_path",
+    "reverse_cuthill_mckee",
+    "shortest_path",
+    "structural_rank",
+]
+
+
+class NegativeCycleError(Exception):
+    """scipy.sparse.csgraph.NegativeCycleError alias."""
+
+
+def _graph_coo(csgraph, directed=True, unweighted=False):
+    """(row, col, w, n) host arrays; undirected graphs get both edge
+    directions materialized (min weight wins on duplicates downstream)."""
+    from .coo import coo_array
+    from .base import SparseArray
+
+    if isinstance(csgraph, SparseArray):
+        G = csgraph.tocoo()
+        row = np.asarray(G.row, dtype=np.int64)
+        col = np.asarray(G.col, dtype=np.int64)
+        w = np.asarray(G.data, dtype=np.float64)
+        n = G.shape[0]
+    elif hasattr(csgraph, "tocoo"):  # scipy sparse
+        G = csgraph.tocoo()
+        row = np.asarray(G.row, dtype=np.int64)
+        col = np.asarray(G.col, dtype=np.int64)
+        w = np.asarray(G.data, dtype=np.float64)
+        n = G.shape[0]
+    else:
+        D = np.asarray(csgraph, dtype=np.float64)
+        n = D.shape[0]
+        row, col = np.nonzero(D)
+        w = D[row, col]
+    if unweighted:
+        w = np.ones_like(w)
+    if not directed:
+        row, col = np.concatenate([row, col]), np.concatenate([col, row])
+        w = np.concatenate([w, w])
+    return row, col, w, int(n)
+
+
+@track_provenance
+def laplacian(csgraph, normed=False, return_diag=False, use_out_degree=False,
+              *, copy=True, form="array", dtype=None, symmetrized=False):
+    """Graph Laplacian L = D - A (scipy.sparse.csgraph.laplacian).
+    ``copy`` is accepted and ignored (jax arrays are immutable); only
+    ``form='array'`` is implemented."""
+    if form != "array":
+        raise NotImplementedError(
+            f"laplacian: form={form!r} not implemented (only 'array'); "
+            "wrap the result with aslinearoperator for the operator form"
+        )
+    from .csr import csr_array
+    from .module import diags
+
+    A = csgraph if hasattr(csgraph, "tocsr") else csr_array(
+        np.asarray(csgraph)
+    )
+    A = A.tocsr() if not isinstance(A, csr_array) else A
+    if symmetrized:
+        A = (A + A.T.tocsr()).tocsr()
+    axis = 1 if use_out_degree else 0
+    deg = np.asarray(A.sum(axis=axis)).ravel()
+    n = A.shape[0]
+    if normed:
+        isq = np.where(deg > 0, 1.0 / np.sqrt(np.where(deg > 0, deg, 1)), 0)
+        Dhalf = diags([isq], [0], shape=(n, n))
+        L = (diags([np.where(deg > 0, 1.0, 0.0)], [0], shape=(n, n))
+             - (Dhalf @ A @ Dhalf).tocsr()).tocsr()
+        d_out = np.sqrt(deg)
+    else:
+        L = (diags([deg], [0], shape=(n, n)) - A).tocsr()
+        d_out = deg
+    if dtype is not None:
+        L = L.astype(dtype)
+    if return_diag:
+        return L, d_out.astype(dtype) if dtype is not None else d_out
+    return L
+
+
+def _relax_scatter_min(row_d, col_d, w_d, n, dist0, maxiter):
+    """Iterated (min,+) edge relaxation with predecessor tracking.
+
+    One step: cand[v] = min over edges (u,v) of dist[u] + w(u,v), taken
+    simultaneously for every source column; a whole Bellman-Ford pass is
+    one scatter-min — the fixed-shape, all-edges-at-once form of the
+    frontier algorithms. dist0 is [k, n] (k sources).
+    Returns (dist, pred, changed_last) after at most maxiter sweeps.
+    """
+    inf = jnp.asarray(np.inf, dist0.dtype)
+    eidx = jnp.arange(row_d.shape[0], dtype=jnp.int32)
+
+    def step(state):
+        dist, pred, it, _ = state
+        cand = dist[:, row_d] + w_d[None, :]          # [k, E]
+        best = jnp.full_like(dist, inf).at[:, col_d].min(cand)
+        improved = best < dist
+        new_dist = jnp.where(improved, best, dist)
+        # winning edge per (source, vertex): an edge wins if its cand
+        # equals the new distance at its head; scatter-max over winners
+        # picks one of them (any optimal edge is a valid predecessor).
+        # Improved vertices' stale preds are RESET first — a stale larger
+        # index would otherwise survive the max.
+        wins = cand <= new_dist[:, col_d]
+        base = jnp.where(improved, jnp.int32(-9999), pred)
+        scat = base.at[:, col_d].max(
+            jnp.where(wins, row_d[None, :].astype(pred.dtype), -9999)
+        )
+        pred = jnp.where(improved, scat, pred)
+        return new_dist, pred, it + 1, jnp.any(improved)
+
+    def cond(state):
+        _, _, it, changed = state
+        return changed & (it < maxiter)
+
+    pred0 = jnp.full(dist0.shape, -9999, dtype=jnp.int32)
+    state = (dist0, pred0, jnp.int32(0),
+             jnp.asarray(True))
+    dist, pred, it, changed = jax.lax.while_loop(cond, step, state)
+    return dist, pred, changed
+
+
+def _prepare_indices(indices, n):
+    if indices is None:
+        return np.arange(n), True
+    idx = np.atleast_1d(np.asarray(indices, dtype=np.int64))
+    return idx, False
+
+
+@track_provenance
+def bellman_ford(csgraph, directed=True, indices=None,
+                 return_predecessors=False, unweighted=False):
+    """Bellman-Ford shortest paths (scipy semantics; raises
+    NegativeCycleError on a reachable negative cycle). The whole
+    algorithm is one ``lax.while_loop`` of scatter-min relaxations."""
+    row, col, w, n = _graph_coo(csgraph, directed, unweighted)
+    idx, squeeze_all = _prepare_indices(indices, n)
+    row_d = jnp.asarray(row, dtype=jnp.int32)
+    col_d = jnp.asarray(col, dtype=jnp.int32)
+    w_d = jnp.asarray(w, dtype=jnp.float64 if jax.config.jax_enable_x64
+                      else jnp.float32)
+    dist0 = jnp.full((len(idx), n), np.inf, dtype=w_d.dtype)
+    dist0 = dist0.at[jnp.arange(len(idx)), jnp.asarray(idx)].set(0.0)
+    # n relaxation sweeps reach any shortest path; one extra detects
+    # negative cycles
+    dist, pred, changed = _relax_scatter_min(
+        row_d, col_d, w_d, n, dist0, maxiter=n
+    )
+    if bool(changed):
+        # converged flag false means the n-th sweep still improved:
+        # re-run one sweep to confirm a negative cycle
+        d2 = jnp.array(dist)
+        cand = d2[:, row_d] + w_d[None, :]
+        best = jnp.full_like(d2, jnp.inf).at[:, col_d].min(cand)
+        if bool(jnp.any(best < d2)):
+            raise NegativeCycleError("negative cycle detected")
+    dist_np = np.asarray(dist, dtype=np.float64)
+    pred_np = np.asarray(pred, dtype=np.int32)
+    if indices is not None and np.ndim(indices) == 0:
+        dist_np, pred_np = dist_np[0], pred_np[0]
+    if return_predecessors:
+        return dist_np, pred_np
+    return dist_np
+
+
+@track_provenance
+def dijkstra(csgraph, directed=True, indices=None,
+             return_predecessors=False, unweighted=False, limit=np.inf,
+             min_only=False):
+    """Shortest paths for non-negative weights (scipy.sparse.csgraph
+    .dijkstra surface). TPU-first note: a binary heap is the wrong shape
+    for this machine; the same distances come from the fixed-shape
+    Bellman-Ford relaxation, which converges in (longest shortest-path
+    hop count) sweeps — so this delegates to :func:`bellman_ford` and
+    applies ``limit``/``min_only`` on the result."""
+    # light-weight negativity check (no duplicate edge extraction:
+    # bellman_ford immediately redoes _graph_coo)
+    if hasattr(csgraph, "data"):
+        wchk = np.asarray(csgraph.data)
+    else:
+        wchk = np.asarray(csgraph)
+    if wchk.size and float(np.min(wchk)) < 0:
+        raise ValueError(
+            "dijkstra requires non-negative weights; use bellman_ford"
+        )
+    n = csgraph.shape[0]
+    # min_only semantics need the [k, n] form — never the squeezed one
+    idx_arr = (np.arange(n) if indices is None
+               else np.atleast_1d(np.asarray(indices, dtype=np.int64)))
+    out = bellman_ford(csgraph, directed=directed, indices=idx_arr,
+                       return_predecessors=True, unweighted=unweighted)
+    dist, pred = out
+    if np.isfinite(limit):
+        dist = np.where(dist > limit, np.inf, dist)
+    if min_only:
+        win = np.argmin(dist, axis=0)
+        verts = np.arange(n)
+        dmin = dist[win, verts]
+        if return_predecessors:
+            # scipy's 3-tuple: (dist, predecessors, sources)
+            predm = pred[win, verts]
+            sources = np.where(np.isfinite(dmin), idx_arr[win], -9999)
+            return dmin, predm, sources
+        return dmin
+    if indices is not None and np.ndim(indices) == 0:
+        dist, pred = dist[0], pred[0]
+    if return_predecessors:
+        return dist, pred
+    return dist
+
+
+@track_provenance
+def floyd_warshall(csgraph, directed=True, return_predecessors=False,
+                   unweighted=False, overwrite=False):
+    """All-pairs shortest paths on the dense distance matrix: n pivot
+    steps inside ``lax.fori_loop``, each a fully vectorized [n, n]
+    min-plus rank-1 update."""
+    row, col, w, n = _graph_coo(csgraph, directed, unweighted)
+    dt = jnp.float64 if jax.config.jax_enable_x64 else jnp.float32
+    D0 = np.full((n, n), np.inf)
+    # scipy keeps the MINIMUM parallel edge
+    np.minimum.at(D0, (row, col), w)
+    np.fill_diagonal(D0, 0.0)
+    P0 = np.full((n, n), -9999, dtype=np.int32)
+    P0[row, col] = row
+    D0_d, P0_d = jnp.asarray(D0, dt), jnp.asarray(P0)
+
+    def pivot(k, state):
+        D, P = state
+        through = D[:, k][:, None] + D[k, :][None, :]
+        better = through < D
+        P = jnp.where(better, jnp.broadcast_to(P[k, :][None, :], P.shape), P)
+        D = jnp.where(better, through, D)
+        return D, P
+
+    D, P = jax.lax.fori_loop(0, n, pivot, (D0_d, P0_d))
+    if bool(jnp.any(jnp.diagonal(D) < 0)):
+        raise NegativeCycleError("negative cycle detected")
+    D_np = np.asarray(D, dtype=np.float64)
+    if return_predecessors:
+        return D_np, np.asarray(P)
+    return D_np
+
+
+@track_provenance
+def johnson(csgraph, directed=True, indices=None,
+            return_predecessors=False, unweighted=False):
+    """All-pairs shortest paths with negative edges (scipy surface).
+    The relaxation form handles negative edges directly, so this shares
+    :func:`bellman_ford` (no reweighting pass needed)."""
+    return bellman_ford(csgraph, directed=directed, indices=indices,
+                        return_predecessors=return_predecessors,
+                        unweighted=unweighted)
+
+
+@track_provenance
+def shortest_path(csgraph, method="auto", directed=True,
+                  return_predecessors=False, unweighted=False,
+                  overwrite=False, indices=None):
+    """scipy.sparse.csgraph.shortest_path dispatcher."""
+    if method == "auto":
+        n = (csgraph.shape[0] if hasattr(csgraph, "shape")
+             else np.asarray(csgraph).shape[0])
+        method = "FW" if indices is None and n <= 1024 else "BF"
+    if method == "FW":
+        if indices is not None:
+            D = floyd_warshall(csgraph, directed, return_predecessors,
+                               unweighted)
+            idx = np.atleast_1d(indices)
+            if return_predecessors:
+                out = (D[0][idx], D[1][idx])
+                if np.ndim(indices) == 0:
+                    return out[0][0], out[1][0]
+                return out
+            return D[idx][0] if np.ndim(indices) == 0 else D[idx]
+        return floyd_warshall(csgraph, directed, return_predecessors,
+                              unweighted)
+    if method in ("D", "BF", "J"):
+        fn = {"D": dijkstra, "BF": bellman_ford, "J": johnson}[method]
+        return fn(csgraph, directed=directed, indices=indices,
+                  return_predecessors=return_predecessors,
+                  unweighted=unweighted)
+    raise ValueError(f"unrecognized method {method!r}")
+
+
+@track_provenance
+def connected_components(csgraph, directed=True, connection="weak",
+                         return_labels=True):
+    """Connected components via min-label propagation: each sweep is one
+    scatter-min over all edges; converges in O(diameter) sweeps inside a
+    single ``lax.while_loop``."""
+    if directed and connection == "strong":
+        raise NotImplementedError(
+            "connection='strong' is not implemented; the weak form and "
+            "undirected graphs are supported"
+        )
+    row, col, w, n = _graph_coo(csgraph, directed=False)  # weak: both dirs
+    row_d = jnp.asarray(row, dtype=jnp.int32)
+    col_d = jnp.asarray(col, dtype=jnp.int32)
+
+    def step(state):
+        lab, _ = state
+        cand = lab[row_d]
+        new = lab.at[col_d].min(cand)
+        return new, jnp.any(new < lab)
+
+    def cond(state):
+        return state[1]
+
+    lab0 = jnp.arange(n, dtype=jnp.int32)
+    lab, _ = jax.lax.while_loop(
+        cond, step, (lab0, jnp.asarray(True))
+    )
+    lab_np = np.asarray(lab)
+    roots, labels = np.unique(lab_np, return_inverse=True)
+    if return_labels:
+        return len(roots), labels.astype(np.int32)
+    return len(roots)
+
+
+@track_provenance
+def breadth_first_order(csgraph, i_start, directed=True,
+                        return_predecessors=True):
+    """BFS order via level-synchronous relaxation: hop distances come
+    from the unweighted scatter-min; the order is (level, node) — a valid
+    BFS ordering (scipy's intra-level order may differ)."""
+    dist, pred = bellman_ford(csgraph, directed=directed, indices=i_start,
+                              return_predecessors=True, unweighted=True)
+    reach = np.isfinite(dist)
+    nodes = np.nonzero(reach)[0]
+    order = nodes[np.lexsort((nodes, dist[nodes]))]
+    node_array = order.astype(np.int32)
+    if return_predecessors:
+        pred = pred.astype(np.int32)
+        pred[~reach] = -9999
+        pred[int(np.atleast_1d(i_start)[0])] = -9999
+        return node_array, pred
+    return node_array
+
+
+def _tree_from_pred(pred, csgraph, n):
+    """CSR tree of the predecessor array with original edge weights."""
+    from .coo import coo_array
+
+    row, col, w, _ = _graph_coo(csgraph, directed=True)
+    wmap = {}
+    for r, c, ww in zip(row, col, w):
+        key = (int(r), int(c))
+        if key not in wmap or ww < wmap[key]:
+            wmap[key] = ww
+    tr, tc, tw = [], [], []
+    for v in range(n):
+        p = int(pred[v])
+        if p >= 0:
+            tr.append(p)
+            tc.append(v)
+            tw.append(wmap.get((p, v), wmap.get((v, p), 1.0)))
+    return coo_array(
+        (np.asarray(tw), (np.asarray(tr, dtype=np.int64),
+                          np.asarray(tc, dtype=np.int64))),
+        shape=(n, n),
+    ).tocsr()
+
+
+@track_provenance
+def breadth_first_tree(csgraph, i_start, directed=True):
+    n = csgraph.shape[0]
+    _, pred = breadth_first_order(csgraph, i_start, directed=directed,
+                                  return_predecessors=True)
+    return _tree_from_pred(pred, csgraph, n)
+
+
+@track_provenance
+def depth_first_order(csgraph, i_start, directed=True,
+                      return_predecessors=True):
+    """DFS is inherently sequential — host control-plane implementation
+    (numpy stack), like the reference's host-side scans."""
+    row, col, w, n = _graph_coo(csgraph, directed)
+    order_csr = np.argsort(row, kind="stable")
+    srow, scol = row[order_csr], col[order_csr]
+    starts = np.searchsorted(srow, np.arange(n + 1))
+    visited = np.zeros(n, dtype=bool)
+    pred = np.full(n, -9999, dtype=np.int32)
+    node_array = []
+    stack = [int(i_start)]
+    visited[int(i_start)] = True
+    while stack:
+        u = stack.pop()
+        node_array.append(u)
+        nbrs = scol[starts[u]:starts[u + 1]]
+        # push in REVERSE index order so the smallest neighbor pops first
+        for v in np.unique(nbrs)[::-1]:
+            if not visited[v]:
+                visited[v] = True
+                pred[v] = u
+                stack.append(int(v))
+    node_array = np.asarray(node_array, dtype=np.int32)
+    if return_predecessors:
+        return node_array, pred
+    return node_array
+
+
+@track_provenance
+def depth_first_tree(csgraph, i_start, directed=True):
+    n = csgraph.shape[0]
+    _, pred = depth_first_order(csgraph, i_start, directed=directed,
+                                return_predecessors=True)
+    return _tree_from_pred(pred, csgraph, n)
+
+
+@track_provenance
+def minimum_spanning_tree(csgraph, overwrite=False):
+    """Kruskal on host (sort + union-find: O(E log E) control-plane
+    work; the edge sort is the only heavy step and runs on numpy)."""
+    from .coo import coo_array
+
+    row, col, w, n = _graph_coo(csgraph, directed=True)
+    # undirected: canonicalize and keep min parallel edge
+    lo, hi = np.minimum(row, col), np.maximum(row, col)
+    keep = lo != hi
+    lo, hi, w = lo[keep], hi[keep], w[keep]
+    order = np.lexsort((hi, lo))
+    lo, hi, w = lo[order], hi[order], w[order]
+    same = np.flatnonzero(
+        (np.diff(lo) == 0) & (np.diff(hi) == 0)
+    )
+    # min weight among duplicates
+    wmin = w.copy()
+    for i in same[::-1]:
+        wmin[i] = min(wmin[i], wmin[i + 1])
+    first = np.ones(len(lo), dtype=bool)
+    first[same + 1] = False
+    lo, hi, w = lo[first], hi[first], wmin[first]
+    parent = np.arange(n)
+
+    def find(a):
+        while parent[a] != a:
+            parent[a] = parent[parent[a]]
+            a = parent[a]
+        return a
+
+    tr, tc, tw = [], [], []
+    for e in np.argsort(w, kind="stable"):
+        ra, rb = find(lo[e]), find(hi[e])
+        if ra != rb:
+            parent[ra] = rb
+            tr.append(lo[e])
+            tc.append(hi[e])
+            tw.append(w[e])
+    return coo_array(
+        (np.asarray(tw), (np.asarray(tr, dtype=np.int64),
+                          np.asarray(tc, dtype=np.int64))),
+        shape=(n, n),
+    ).tocsr()
+
+
+@track_provenance
+def reverse_cuthill_mckee(csgraph, symmetric_mode=False):
+    """Bandwidth-reducing RCM ordering (host BFS; feeds this library's
+    banded DIA fast path — reorder, then convert to DIA)."""
+    row, col, w, n = _graph_coo(csgraph, directed=True)
+    # the ordering always works on the symmetrized pattern
+    row, col = np.concatenate([row, col]), np.concatenate([col, row])
+    deg = np.bincount(row, minlength=n)
+    order_csr = np.argsort(row, kind="stable")
+    srow, scol = row[order_csr], col[order_csr]
+    starts = np.searchsorted(srow, np.arange(n + 1))
+    visited = np.zeros(n, dtype=bool)
+    out = []
+    for seed in np.argsort(deg, kind="stable"):
+        if visited[seed]:
+            continue
+        visited[seed] = True
+        queue = [int(seed)]
+        qi = 0
+        while qi < len(queue):
+            u = queue[qi]
+            qi += 1
+            out.append(u)
+            nbrs = np.unique(scol[starts[u]:starts[u + 1]])
+            nbrs = nbrs[~visited[nbrs]]
+            visited[nbrs] = True
+            queue.extend(nbrs[np.argsort(deg[nbrs], kind="stable")].tolist())
+    return np.asarray(out[::-1], dtype=np.int32)
+
+
+def _bipartite_matching(csgraph):
+    """Augmenting-path maximum matching on the bipartite row/col graph
+    (host control-plane). Returns (rank, match_col) with match_col[c] =
+    matched row or -1."""
+    row, col, w, n = _graph_coo(csgraph, directed=True)
+    m = csgraph.shape[0]
+    ncols = csgraph.shape[1]
+    adj = [[] for _ in range(m)]
+    for r, c in zip(row, col):
+        adj[int(r)].append(int(c))
+    match_col = np.full(ncols, -1, dtype=np.int64)
+
+    def augment(u, seen):
+        for v in adj[u]:
+            if not seen[v]:
+                seen[v] = True
+                if match_col[v] < 0 or augment(int(match_col[v]), seen):
+                    match_col[v] = u
+                    return True
+        return False
+
+    import sys
+    old = sys.getrecursionlimit()
+    sys.setrecursionlimit(max(old, m * 2 + 100))
+    try:
+        rank = 0
+        for u in range(m):
+            if augment(u, np.zeros(ncols, dtype=bool)):
+                rank += 1
+    finally:
+        sys.setrecursionlimit(old)
+    return rank, match_col
+
+
+@track_provenance
+def structural_rank(csgraph):
+    """Maximum-matching structural rank (host augmenting paths on the
+    bipartite row/col graph)."""
+    return _bipartite_matching(csgraph)[0]
+
+
+@track_provenance
+def maximum_bipartite_matching(graph, perm_type="row"):
+    """scipy.sparse.csgraph.maximum_bipartite_matching: perm_type='row'
+    returns, per column, the matched row (-1 if unmatched);
+    'column' returns, per row, the matched column."""
+    rank, match_col = _bipartite_matching(graph)
+    if perm_type == "row":
+        return match_col.astype(np.int32)
+    if perm_type == "column":
+        m = graph.shape[0]
+        match_row = np.full(m, -1, dtype=np.int32)
+        matched = match_col >= 0
+        match_row[match_col[matched]] = np.nonzero(matched)[0]
+        return match_row
+    raise ValueError("perm_type must be 'row' or 'column'")
+
+
+@track_provenance
+def construct_dist_matrix(graph, predecessors, directed=True,
+                          null_value=np.inf):
+    """Rebuild the all-pairs distance matrix from an [n, n] predecessor
+    matrix + edge weights (scipy.sparse.csgraph.construct_dist_matrix;
+    row i's source is vertex i)."""
+    row, col, w, n = _graph_coo(graph, directed)
+    pred = np.asarray(predecessors)
+    if pred.shape != (n, n):
+        raise ValueError("predecessors must be [n, n] (all-pairs form)")
+    W = np.full((n, n), np.inf)
+    np.minimum.at(W, (row, col), w)
+    out = np.full((n, n), float(null_value))
+    for s in range(n):
+        out[s, s] = 0.0
+        for v in range(n):
+            if v == s:
+                continue
+            total, cur, hops = 0.0, v, 0
+            while pred[s, cur] >= 0 and hops <= n:
+                p = int(pred[s, cur])
+                total += W[p, cur]
+                cur = p
+                hops += 1
+            if cur == s and hops <= n:
+                out[s, v] = total
+    return out
+
+
+@track_provenance
+def csgraph_masked_from_dense(graph, null_value=0, nan_null=True,
+                              infinity_null=True):
+    D = np.asarray(graph, dtype=np.float64)
+    mask = np.zeros_like(D, dtype=bool)
+    if null_value is not None:
+        mask |= D == null_value
+    if nan_null:
+        mask |= np.isnan(D)
+    if infinity_null:
+        mask |= np.isinf(D)
+    return np.ma.masked_array(np.where(mask, 0.0, D), mask)
+
+
+@track_provenance
+def csgraph_from_masked(graph):
+    from .csr import csr_array
+
+    D = np.ma.asarray(graph)
+    filled = np.where(np.ma.getmaskarray(D), 0.0, np.ma.filled(D, 0.0))
+    return csr_array(np.asarray(filled, dtype=np.float64))
+
+
+@track_provenance
+def csgraph_to_masked(csgraph):
+    G = csgraph.tocoo()
+    n, m = csgraph.shape
+    data = np.zeros((n, m))
+    mask = np.ones((n, m), dtype=bool)
+    data[np.asarray(G.row), np.asarray(G.col)] = np.asarray(G.data)
+    mask[np.asarray(G.row), np.asarray(G.col)] = False
+    return np.ma.masked_array(data, mask)
+
+
+@track_provenance
+def csgraph_from_dense(graph, null_value=0, nan_null=True,
+                       infinity_null=True):
+    from .csr import csr_array
+
+    D = np.array(graph, dtype=np.float64, copy=True)
+    mask = np.ones_like(D, dtype=bool)
+    if null_value is not None:
+        mask &= D != null_value
+    if nan_null:
+        mask &= ~np.isnan(D)
+    if infinity_null:
+        mask &= ~np.isinf(D)
+    D = np.where(mask, D, 0.0)
+    out = csr_array(D)
+    return out
+
+
+@track_provenance
+def csgraph_to_dense(csgraph, null_value=0):
+    G = csgraph.tocoo()
+    out = np.full(csgraph.shape, float(null_value))
+    out[np.asarray(G.row), np.asarray(G.col)] = np.asarray(G.data)
+    return out
+
+
+@track_provenance
+def reconstruct_path(csgraph, predecessors, directed=True):
+    """Tree of the predecessor array (scipy surface)."""
+    n = csgraph.shape[0]
+    return _tree_from_pred(np.asarray(predecessors), csgraph, n)

@@ -104,12 +104,15 @@ class LinearOperator:
             return _SumOperator(self, _ScaledOperator(other, -1.0))
         return NotImplemented
 
-    def __mul__(self, alpha):
-        if isinstance(alpha, LinearOperator):
-            return _ProductOperator(self, alpha)  # scipy: * composes
-        if np.isscalar(alpha) or getattr(alpha, "ndim", 1) == 0:
-            return _ScaledOperator(self, alpha)
-        return NotImplemented
+    def __mul__(self, x):
+        # scipy semantics: operator -> composition, scalar -> scaling,
+        # array -> application (A * v == A.matvec(v))
+        if isinstance(x, LinearOperator):
+            return _ProductOperator(self, x)
+        if np.isscalar(x) or getattr(x, "ndim", 1) == 0:
+            return _ScaledOperator(self, x)
+        x = asjnp(x)
+        return self.matvec(x) if x.ndim == 1 else self.matmat(x)
 
     def __rmul__(self, alpha):
         if np.isscalar(alpha) or getattr(alpha, "ndim", 1) == 0:
@@ -117,14 +120,13 @@ class LinearOperator:
         return NotImplemented
 
     def dot(self, x):
-        """scipy LinearOperator.dot: vector, matrix, or operator."""
+        """scipy LinearOperator.dot: scalar scales, operator composes,
+        vector/matrix applies."""
         if isinstance(x, LinearOperator):
             return _ProductOperator(self, x)
+        if np.isscalar(x) or getattr(x, "ndim", 1) == 0:
+            return _ScaledOperator(self, x)
         x = asjnp(x)
-        if x.ndim == 0:
-            raise ValueError(
-                "Scalar operands are not allowed, use '*' instead"
-            )
         return self.matvec(x) if x.ndim == 1 else self.matmat(x)
 
     def __neg__(self):

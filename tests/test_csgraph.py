@@ -1,0 +1,238 @@
+"""csgraph oracle tests vs scipy.sparse.csgraph (beyond the reference —
+it has no graph module; this generalizes its tropical-SpMV MIS design
+into the full scipy.sparse.csgraph relaxation surface)."""
+
+import numpy as np
+import pytest
+import scipy.sparse as sp
+import scipy.sparse.csgraph as scs
+
+import sparse_tpu as sparse
+from sparse_tpu import csgraph as cg
+
+
+def _rand_graph(n=25, density=0.2, seed=0, directed=True, negative=False):
+    rng = np.random.default_rng(seed)
+    G = sp.random(n, n, density, random_state=rng, format="csr")
+    G.setdiag(0)
+    G.eliminate_zeros()
+    G.data = rng.uniform(0.5, 2.0, G.nnz)
+    if negative:
+        G.data[rng.random(G.nnz) < 0.2] *= -0.2
+    if not directed:
+        G = G.maximum(G.T)
+    return G
+
+
+def _validate_pred(dist, pred, G, src, directed):
+    """Predecessor arrays need not match scipy's tie choice; check they
+    encode genuine shortest paths."""
+    D = G.toarray()
+    if not directed:
+        D = np.where((D > 0) & ((D < D.T) | (D.T == 0)), D, D.T)
+    n = D.shape[0]
+    for v in range(n):
+        p = pred[v]
+        if v == src:
+            assert p == -9999
+        elif np.isfinite(dist[v]):
+            assert p >= 0
+            w = D[p, v]
+            assert w != 0
+            assert np.isclose(dist[p] + w, dist[v], atol=1e-5)
+
+
+@pytest.mark.parametrize("directed", [True, False])
+def test_bellman_ford_matches_scipy(directed):
+    G = _rand_graph(directed=directed)
+    A = sparse.csr_array(G)
+    d = cg.bellman_ford(A, directed=directed)
+    d_sci = scs.bellman_ford(G, directed=directed)
+    np.testing.assert_allclose(d, d_sci, atol=1e-5)
+
+
+def test_bellman_ford_negative_edges_and_cycle():
+    # seed 7: negative edges present but no negative cycle (scipy-checked)
+    G = _rand_graph(seed=7, negative=True)
+    d = cg.bellman_ford(sparse.csr_array(G), directed=True)
+    d_sci = scs.bellman_ford(G, directed=True)
+    np.testing.assert_allclose(d, d_sci, atol=1e-5)
+    # a genuine negative cycle raises
+    C = sp.csr_matrix(np.array([[0, 1.0, 0], [0, 0, 1.0], [-3.0, 0, 0]]))
+    with pytest.raises(cg.NegativeCycleError):
+        cg.bellman_ford(sparse.csr_array(C), directed=True)
+
+
+def test_dijkstra_and_predecessors():
+    G = _rand_graph(seed=2)
+    A = sparse.csr_array(G)
+    d, p = cg.dijkstra(A, indices=0, return_predecessors=True)
+    d_sci = scs.dijkstra(G, indices=0)
+    np.testing.assert_allclose(d, d_sci, atol=1e-5)
+    _validate_pred(d, p, G, 0, directed=True)
+    with pytest.raises(ValueError):
+        cg.dijkstra(sparse.csr_array(
+            sp.csr_matrix(np.array([[0, -1.0], [0, 0]]))
+        ))
+
+
+def test_floyd_warshall_matches_scipy():
+    G = _rand_graph(n=18, seed=3)
+    D = cg.floyd_warshall(sparse.csr_array(G))
+    D_sci = scs.floyd_warshall(G.toarray())
+    np.testing.assert_allclose(D, D_sci, atol=1e-5)
+
+
+def test_shortest_path_dispatch():
+    G = _rand_graph(n=15, seed=4)
+    A = sparse.csr_array(G)
+    for method in ("auto", "FW", "BF", "D", "J"):
+        D = cg.shortest_path(A, method=method)
+        D_sci = scs.shortest_path(G, method="FW")
+        np.testing.assert_allclose(D, D_sci, atol=1e-5)
+    d0 = cg.shortest_path(A, indices=0)
+    np.testing.assert_allclose(d0, scs.shortest_path(G, indices=0)[0]
+                               if scs.shortest_path(G, indices=0).ndim == 2
+                               else scs.shortest_path(G, indices=0),
+                               atol=1e-5)
+
+
+@pytest.mark.parametrize("directed", [True, False])
+def test_connected_components(directed):
+    rng = np.random.default_rng(5)
+    blocks = [sp.random(6, 6, 0.6, random_state=rng) + sp.identity(6)
+              for _ in range(3)]
+    G = sp.block_diag(blocks, format="csr")
+    n, labels = cg.connected_components(
+        sparse.csr_array(G), directed=directed, connection="weak"
+    )
+    n_sci, lab_sci = scs.connected_components(G, directed=directed,
+                                              connection="weak")
+    assert n == n_sci
+    # same partition up to relabeling
+    for a in range(n):
+        members = labels == a
+        assert len(np.unique(lab_sci[members])) == 1
+
+
+def test_breadth_first_order_levels_and_tree():
+    G = _rand_graph(n=20, seed=6, directed=False)
+    A = sparse.csr_array(G)
+    nodes, pred = cg.breadth_first_order(A, 0, directed=False)
+    nodes_sci = scs.breadth_first_order(G, 0, directed=False,
+                                        return_predecessors=False)
+    assert set(np.asarray(nodes).tolist()) == set(nodes_sci.tolist())
+    # hop distance of each node's predecessor is one less
+    d = cg.bellman_ford(A, directed=False, indices=0, unweighted=True)
+    for v in nodes[1:]:
+        assert d[pred[v]] == d[v] - 1
+    T = cg.breadth_first_tree(A, 0, directed=False)
+    assert T.nnz == len(nodes) - 1
+
+
+def test_depth_first_order_matches_scipy():
+    G = _rand_graph(n=15, seed=7, directed=False)
+    nodes, pred = cg.depth_first_order(sparse.csr_array(G), 0,
+                                       directed=False)
+    nodes_sci = scs.depth_first_order(G, 0, directed=False,
+                                      return_predecessors=False)
+    assert set(nodes.tolist()) == set(nodes_sci.tolist())
+    assert nodes[0] == 0
+
+
+def test_minimum_spanning_tree_weight_matches_scipy():
+    G = _rand_graph(n=20, seed=8, directed=False)
+    T = cg.minimum_spanning_tree(sparse.csr_array(G))
+    T_sci = scs.minimum_spanning_tree(G)
+    assert np.isclose(np.asarray(T.todense()).sum(), T_sci.toarray().sum(),
+                      atol=1e-6)
+
+
+def test_reverse_cuthill_mckee_reduces_bandwidth():
+    rng = np.random.default_rng(9)
+    P = rng.permutation(30)
+    band = sp.diags([np.ones(29), np.ones(30), np.ones(29)], [-1, 0, 1],
+                    format="csr")
+    scrambled = band[P][:, P].tocsr()
+    perm = cg.reverse_cuthill_mckee(sparse.csr_array(scrambled))
+    R = scrambled[perm][:, perm].tocoo()
+    bw = np.abs(R.row - R.col).max()
+    orig = np.abs(scrambled.tocoo().row - scrambled.tocoo().col).max()
+    assert bw <= 2 and bw < orig
+
+
+def test_structural_rank_and_laplacian():
+    G = _rand_graph(n=12, seed=10)
+    assert cg.structural_rank(sparse.csr_array(G)) == scs.structural_rank(G)
+    A = sparse.csr_array(_rand_graph(n=10, seed=11, directed=False))
+    L = cg.laplacian(A)
+    L_sci = scs.laplacian(_rand_graph(n=10, seed=11, directed=False))
+    np.testing.assert_allclose(np.asarray(L.todense()), L_sci.toarray(),
+                               atol=1e-6)
+    Ln, d = cg.laplacian(A, normed=True, return_diag=True)
+    Ln_sci, d_sci = scs.laplacian(
+        _rand_graph(n=10, seed=11, directed=False), normed=True,
+        return_diag=True,
+    )
+    np.testing.assert_allclose(np.asarray(Ln.todense()), Ln_sci.toarray(),
+                               atol=1e-6)
+    np.testing.assert_allclose(d, d_sci, atol=1e-6)
+
+
+def test_dense_round_trip():
+    D = np.array([[0, 1.5, 0], [0, 0, 2.0], [np.nan, 0, 0]])
+    A = cg.csgraph_from_dense(D)
+    assert A.nnz == 2
+    out = cg.csgraph_to_dense(A, null_value=-1)
+    assert out[0, 1] == 1.5 and out[1, 2] == 2.0 and out[0, 0] == -1
+
+
+def test_maximum_bipartite_matching():
+    G = _rand_graph(n=15, seed=12)
+    ours = cg.maximum_bipartite_matching(sparse.csr_array(G), perm_type="row")
+    sci = scs.maximum_bipartite_matching(G.astype(bool).astype(float),
+                                         perm_type="row")
+    # matchings may differ; cardinality must agree
+    assert (ours >= 0).sum() == (sci >= 0).sum()
+    colm = cg.maximum_bipartite_matching(sparse.csr_array(G),
+                                         perm_type="column")
+    assert (colm >= 0).sum() == (ours >= 0).sum()
+
+
+def test_construct_dist_matrix_round_trip():
+    G = _rand_graph(n=12, seed=13)
+    A = sparse.csr_array(G)
+    D, P = cg.floyd_warshall(A, return_predecessors=True)
+    D2 = cg.construct_dist_matrix(A, P)
+    np.testing.assert_allclose(D2, D, atol=1e-5)
+
+
+def test_masked_round_trip():
+    D = np.array([[0, 2.0], [np.inf, 0]])
+    M = cg.csgraph_masked_from_dense(D)
+    assert M.mask[0, 0] and M.mask[1, 0] and not M.mask[0, 1]
+    A = cg.csgraph_from_masked(M)
+    assert A.nnz == 1
+    back = cg.csgraph_to_masked(A)
+    assert back[0, 1] == 2.0 and back.mask[0, 0]
+
+
+def test_dijkstra_min_only_scalar_and_sources():
+    G = _rand_graph(n=14, seed=14)
+    A = sparse.csr_array(G)
+    # scalar index + min_only must still return length-n arrays
+    d = cg.dijkstra(A, indices=0, min_only=True)
+    assert d.shape == (14,)
+    d, p, s = cg.dijkstra(A, indices=[0, 3], min_only=True,
+                          return_predecessors=True)
+    d_sci, p_sci, s_sci = scs.dijkstra(G, indices=[0, 3], min_only=True,
+                                       return_predecessors=True)
+    np.testing.assert_allclose(d, d_sci, atol=1e-5)
+    np.testing.assert_array_equal(np.isin(s, [0, 3, -9999]),
+                                  np.isin(s_sci, [0, 3, -9999]))
+
+
+def test_laplacian_form_not_implemented():
+    A = sparse.csr_array(_rand_graph(n=6, seed=15, directed=False))
+    with pytest.raises(NotImplementedError):
+        cg.laplacian(A, form="lo")

@@ -142,12 +142,15 @@ def test_pow_large_exponent_no_recursion():
     np.testing.assert_allclose(out, 0.999 ** 2000 * v, rtol=1e-3)
 
 
-def test_matmul_scalar_raises():
-    A = linalg.aslinearoperator(np.eye(3))
+def test_matmul_scalar_raises_but_dot_and_mul_follow_scipy():
+    A = linalg.aslinearoperator(np.eye(3) * 3.0)
     with pytest.raises(ValueError, match="Scalar operands"):
         A @ 2.0
-    with pytest.raises(ValueError, match="Scalar operands"):
-        A.dot(2.0)
+    # scipy: dot(scalar) scales; A * v applies
+    scaled = A.dot(2.0)
+    v = np.ones(3)
+    np.testing.assert_allclose(np.asarray(scaled.matvec(v)), 6.0 * v)
+    np.testing.assert_allclose(np.asarray(A * v), 3.0 * v)
 
 
 def test_funm_multiply_krylov_large_norm_b():
