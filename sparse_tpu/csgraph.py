@@ -21,8 +21,6 @@ import jax
 import jax.numpy as jnp
 
 from .coverage import track_provenance
-from .utils import asjnp
-
 __all__ = [
     "NegativeCycleError",
     "bellman_ford",
@@ -54,19 +52,15 @@ class NegativeCycleError(Exception):
     """scipy.sparse.csgraph.NegativeCycleError alias."""
 
 
+def _nverts(csgraph):
+    return (csgraph.shape[0] if hasattr(csgraph, "shape")
+            else np.asarray(csgraph).shape[0])
+
+
 def _graph_coo(csgraph, directed=True, unweighted=False):
     """(row, col, w, n) host arrays; undirected graphs get both edge
     directions materialized (min weight wins on duplicates downstream)."""
-    from .coo import coo_array
-    from .base import SparseArray
-
-    if isinstance(csgraph, SparseArray):
-        G = csgraph.tocoo()
-        row = np.asarray(G.row, dtype=np.int64)
-        col = np.asarray(G.col, dtype=np.int64)
-        w = np.asarray(G.data, dtype=np.float64)
-        n = G.shape[0]
-    elif hasattr(csgraph, "tocoo"):  # scipy sparse
+    if hasattr(csgraph, "tocoo"):  # sparse_tpu or scipy sparse
         G = csgraph.tocoo()
         row = np.asarray(G.row, dtype=np.int64)
         col = np.asarray(G.col, dtype=np.int64)
@@ -99,10 +93,14 @@ def laplacian(csgraph, normed=False, return_diag=False, use_out_degree=False,
     from .csr import csr_array
     from .module import diags
 
-    A = csgraph if hasattr(csgraph, "tocsr") else csr_array(
-        np.asarray(csgraph)
-    )
-    A = A.tocsr() if not isinstance(A, csr_array) else A
+    from .base import SparseArray
+
+    if isinstance(csgraph, SparseArray):
+        A = csgraph.tocsr()
+    elif hasattr(csgraph, "tocsr"):  # scipy sparse: convert into ours
+        A = csr_array(csgraph.tocsr())
+    else:
+        A = csr_array(np.asarray(csgraph))
     if symmetrized:
         A = (A + A.T.tocsr()).tocsr()
     axis = 1 if use_out_degree else 0
@@ -134,7 +132,6 @@ def _relax_scatter_min(row_d, col_d, w_d, n, dist0, maxiter):
     Returns (dist, pred, changed_last) after at most maxiter sweeps.
     """
     inf = jnp.asarray(np.inf, dist0.dtype)
-    eidx = jnp.arange(row_d.shape[0], dtype=jnp.int32)
 
     def step(state):
         dist, pred, it, _ = state
@@ -168,9 +165,8 @@ def _relax_scatter_min(row_d, col_d, w_d, n, dist0, maxiter):
 
 def _prepare_indices(indices, n):
     if indices is None:
-        return np.arange(n), True
-    idx = np.atleast_1d(np.asarray(indices, dtype=np.int64))
-    return idx, False
+        return np.arange(n)
+    return np.atleast_1d(np.asarray(indices, dtype=np.int64))
 
 
 @track_provenance
@@ -180,7 +176,7 @@ def bellman_ford(csgraph, directed=True, indices=None,
     NegativeCycleError on a reachable negative cycle). The whole
     algorithm is one ``lax.while_loop`` of scatter-min relaxations."""
     row, col, w, n = _graph_coo(csgraph, directed, unweighted)
-    idx, squeeze_all = _prepare_indices(indices, n)
+    idx = _prepare_indices(indices, n)
     row_d = jnp.asarray(row, dtype=jnp.int32)
     col_d = jnp.asarray(col, dtype=jnp.int32)
     w_d = jnp.asarray(w, dtype=jnp.float64 if jax.config.jax_enable_x64
@@ -220,16 +216,18 @@ def dijkstra(csgraph, directed=True, indices=None,
     hop count) sweeps — so this delegates to :func:`bellman_ford` and
     applies ``limit``/``min_only`` on the result."""
     # light-weight negativity check (no duplicate edge extraction:
-    # bellman_ford immediately redoes _graph_coo)
-    if hasattr(csgraph, "data"):
-        wchk = np.asarray(csgraph.data)
-    else:
-        wchk = np.asarray(csgraph)
-    if wchk.size and float(np.min(wchk)) < 0:
-        raise ValueError(
-            "dijkstra requires non-negative weights; use bellman_ford"
-        )
-    n = csgraph.shape[0]
+    # bellman_ford immediately redoes _graph_coo). Skipped in unweighted
+    # mode, where stored weights are never consulted (scipy behavior).
+    if not unweighted:
+        if hasattr(csgraph, "data"):
+            wchk = np.asarray(csgraph.data)
+        else:
+            wchk = np.asarray(csgraph)
+        if wchk.size and float(np.min(wchk)) < 0:
+            raise ValueError(
+                "dijkstra requires non-negative weights; use bellman_ford"
+            )
+    n = _nverts(csgraph)
     # min_only semantics need the [k, n] form — never the squeezed one
     idx_arr = (np.arange(n) if indices is None
                else np.atleast_1d(np.asarray(indices, dtype=np.int64)))
@@ -237,7 +235,9 @@ def dijkstra(csgraph, directed=True, indices=None,
                        return_predecessors=True, unweighted=unweighted)
     dist, pred = out
     if np.isfinite(limit):
-        dist = np.where(dist > limit, np.inf, dist)
+        pruned = dist > limit
+        dist = np.where(pruned, np.inf, dist)
+        pred = np.where(pruned, np.int32(-9999), pred)  # no stale paths
     if min_only:
         win = np.argmin(dist, axis=0)
         verts = np.arange(n)
@@ -410,7 +410,7 @@ def _tree_from_pred(pred, csgraph, n):
 
 @track_provenance
 def breadth_first_tree(csgraph, i_start, directed=True):
-    n = csgraph.shape[0]
+    n = _nverts(csgraph)
     _, pred = breadth_first_order(csgraph, i_start, directed=directed,
                                   return_predecessors=True)
     return _tree_from_pred(pred, csgraph, n)
@@ -448,7 +448,7 @@ def depth_first_order(csgraph, i_start, directed=True,
 
 @track_provenance
 def depth_first_tree(csgraph, i_start, directed=True):
-    n = csgraph.shape[0]
+    n = _nverts(csgraph)
     _, pred = depth_first_order(csgraph, i_start, directed=directed,
                                 return_predecessors=True)
     return _tree_from_pred(pred, csgraph, n)
@@ -535,8 +535,9 @@ def _bipartite_matching(csgraph):
     (host control-plane). Returns (rank, match_col) with match_col[c] =
     matched row or -1."""
     row, col, w, n = _graph_coo(csgraph, directed=True)
-    m = csgraph.shape[0]
-    ncols = csgraph.shape[1]
+    shp = (csgraph.shape if hasattr(csgraph, "shape")
+           else np.asarray(csgraph).shape)
+    m, ncols = int(shp[0]), int(shp[1])
     adj = [[] for _ in range(m)]
     for r, c in zip(row, col):
         adj[int(r)].append(int(c))
@@ -680,5 +681,5 @@ def csgraph_to_dense(csgraph, null_value=0):
 @track_provenance
 def reconstruct_path(csgraph, predecessors, directed=True):
     """Tree of the predecessor array (scipy surface)."""
-    n = csgraph.shape[0]
+    n = _nverts(csgraph)
     return _tree_from_pred(np.asarray(predecessors), csgraph, n)

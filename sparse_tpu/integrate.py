@@ -598,7 +598,15 @@ def handle_events(sol, events, active_events, is_terminal, t_old, t):
 # ---------------------------------------------------------------------------
 # solve_ivp driver (reference integrate.py:1303)
 # ---------------------------------------------------------------------------
-METHODS = {"RK23": RK23, "RK45": RK45, "DOP853": DOP853}
+from ._bdf import BDF as _BDFImpl  # noqa: E402
+
+
+class BDF(_BDFImpl, OdeSolver):
+    """Stiff variable-order BDF/NDF method (scipy.integrate.BDF; beyond
+    the reference's explicit-RK-only menu). See sparse_tpu/_bdf.py."""
+
+
+METHODS = {"RK23": RK23, "RK45": RK45, "DOP853": DOP853, "BDF": BDF}
 
 MESSAGES = {
     0: "The solver successfully reached the end of the integration interval.",

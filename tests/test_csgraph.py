@@ -236,3 +236,25 @@ def test_laplacian_form_not_implemented():
     A = sparse.csr_array(_rand_graph(n=6, seed=15, directed=False))
     with pytest.raises(NotImplementedError):
         cg.laplacian(A, form="lo")
+
+
+def test_dijkstra_unweighted_ignores_negative_and_limit_preds():
+    C = sp.csr_matrix(np.array([[0, -1.0, 0], [0, 0, 2.0], [0, 0, 0]]))
+    A = sparse.csr_array(C)
+    d = cg.dijkstra(A, indices=0, unweighted=True)
+    np.testing.assert_allclose(d, [0, 1, 2])
+    G = _rand_graph(n=12, seed=16)
+    d, p = cg.dijkstra(sparse.csr_array(G), indices=0, limit=2.0,
+                       return_predecessors=True)
+    assert np.all(p[~np.isfinite(d)] == -9999)  # no stale pruned paths
+
+
+def test_csgraph_accepts_array_like():
+    D = [[0, 1.0, 0], [0, 0, 1.0], [0, 0, 0]]
+    d = cg.dijkstra(D, indices=0)
+    np.testing.assert_allclose(d, [0, 1, 2])
+    T = cg.breadth_first_tree(D, 0)
+    assert T.nnz == 2
+    L = cg.laplacian(sp.csr_matrix(np.array([[0, 1.0], [1.0, 0]])))
+    np.testing.assert_allclose(np.asarray(L.todense()),
+                               [[1, -1], [-1, 1]])
