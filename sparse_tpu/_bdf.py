@@ -61,8 +61,6 @@ class BDF:
             OdeSolver, select_initial_step, validate_max_step, validate_tol,
         )
 
-        # cooperative init through the shared OdeSolver protocol
-        self._base = OdeSolver.__init__
         OdeSolver.__init__(self, fun, t0, y0, t_bound, vectorized,
                            support_complex=True)
         self.max_step = validate_max_step(max_step)
@@ -290,6 +288,11 @@ class BDF:
         self.h_abs = h_abs
         self.h_abs_old = h_abs
         self.error_norm_old = error_norm
+        # the Jacobian is now stale at the advanced (t, y): a Newton
+        # failure on the NEXT step must refresh it before conceding a
+        # step halving. Constant Jacobians never go stale.
+        if self._jac_callable is not None or self._jac_arg is None:
+            self.current_jac = False
 
         # update differences
         D[order + 2] = d - D[order + 1]

@@ -311,7 +311,9 @@ def eigs(A, k=6, which="LM", v0=None, ncv=None, maxiter=None, tol=0.0,
     ceps = float(np.finfo(np.dtype(jnp.zeros((), cdt).real.dtype)).eps)
     tol_eff = tol if tol > 0 else ceps ** (2 / 3)
     partial_evals = np.array([])
-    _partial_basis = None
+    _partial_count = 0
+    _partial_vecs = np.zeros((n, 0), dtype=np.complex128)
+    _partial_evals_best = np.array([])
 
     for _ in range(int(maxiter)):
         m = mdone
@@ -350,12 +352,19 @@ def eigs(A, k=6, which="LM", v0=None, ncv=None, maxiter=None, tol=0.0,
         coup = np.abs(bs @ Sv[:, order])  # |A y - lam y| per Ritz vector
         scale = np.maximum(np.abs(evals_all[order]), 1e-30)
         # best Ritz pairs so far, with their residual couplings — the
-        # partial results ArpackNoConvergence carries on failure. Only
-        # the SMALL combination matrix is stored per cycle; the [n, p]
-        # vectors are materialized once, in the raise path.
+        # partial results ArpackNoConvergence carries on failure. The
+        # [n, p] host vectors are rebuilt only when the converged count
+        # GROWS (at most k times total) — no per-cycle device matmul,
+        # and no pinned reference to the old [ncv+1, n] basis.
         part_mask = coup <= tol_eff * scale
         partial_evals = evals_all[order][part_mask]
-        _partial_basis = (V, m, Z[:, :sdim] @ Sv[:, order][:, part_mask])
+        if partial_evals.size > _partial_count:
+            _partial_count = partial_evals.size
+            small = Z[:, :sdim] @ Sv[:, order][:, part_mask]
+            pv = np.asarray(V[:m].T @ jnp.asarray(small, dtype=cdt))
+            nrm = np.linalg.norm(pv, axis=0, keepdims=True)
+            _partial_vecs = pv / np.where(nrm == 0, 1.0, nrm)
+            _partial_evals_best = partial_evals
         if sdim >= k and np.all(coup <= tol_eff * scale):
             evals = evals_all[order]
             vecs = np.asarray(V[:m].T @ jnp.asarray(
@@ -384,15 +393,8 @@ def eigs(A, k=6, which="LM", v0=None, ncv=None, maxiter=None, tol=0.0,
         V = jnp.zeros_like(V).at[:keep].set(Vnew).at[keep].set(V[m])
         H = Hnew
         V, H, mdone = _arnoldi_extend(matvec, V, H, keep, ncv)
-    if _partial_basis is not None and partial_evals.size:
-        Vb, mb, small = _partial_basis
-        pv = np.asarray(Vb[:mb].T @ jnp.asarray(small, dtype=cdt))
-        nrm = np.linalg.norm(pv, axis=0, keepdims=True)
-        partial_vecs = pv / np.where(nrm == 0, 1.0, nrm)
-    else:
-        partial_vecs = np.zeros((n, 0), dtype=np.complex128)
     raise ArpackNoConvergence(
         f"eigs: no convergence to tol={tol_eff} within {maxiter} restarts",
-        eigenvalues=partial_evals,
-        eigenvectors=partial_vecs,
+        eigenvalues=_partial_evals_best,
+        eigenvectors=_partial_vecs,
     )

@@ -634,6 +634,7 @@ def solve_ivp(
     events=None,
     vectorized=False,
     args=None,
+    _step_callback=None,
     **options,
 ):
     """Integrate dy/dt = fun(t, y), scipy-compatible subset (RK methods)."""
@@ -691,6 +692,8 @@ def solve_ivp(
         t_old = solver.t_old
         t = solver.t
         y = solver.y
+        if _step_callback is not None:  # checkpoint.py hook
+            _step_callback(t, y)
 
         if dense_output or t_eval is not None or events is not None:
             sol = solver.dense_output()

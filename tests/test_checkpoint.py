@@ -1,0 +1,93 @@
+"""Checkpoint/resume subsystem (SURVEY §5 aux category; absent in the
+reference — a failed long solve there restarts from zero)."""
+
+import numpy as np
+import pytest
+import scipy.sparse as sp
+
+import sparse_tpu as sparse
+from sparse_tpu.checkpoint import (
+    CheckpointManager, checkpointed_cg, checkpointed_solve_ivp,
+)
+from .utils.sample import sample_vec
+
+
+def _spd(n, seed=0):
+    rng = np.random.default_rng(seed)
+    S = sp.random(n, n, 0.05, random_state=rng)
+    return ((S + S.T) * 0.5 + sp.diags(np.linspace(2, 5, n))).tocsr()
+
+
+def test_manager_atomic_roundtrip(tmp_path):
+    p = tmp_path / "ck.npz"
+    m = CheckpointManager(p)
+    assert m.load() == (None, None)
+    m.save(7, x=np.arange(4.0), rho=np.float64(0.5))
+    step, state = m.load()
+    assert step == 7
+    np.testing.assert_array_equal(state["x"], np.arange(4.0))
+    m.save(9, x=np.ones(4))  # overwrite is atomic
+    step, state = m.load()
+    assert step == 9 and state["x"].sum() == 4
+    m.delete()
+    assert m.load() == (None, None)
+
+
+def test_checkpointed_cg_resumes_exactly(tmp_path):
+    n = 400
+    S = _spd(n)
+    A = sparse.csr_array(S)
+    b = np.asarray(S @ sample_vec(n, seed=1))
+    # uninterrupted reference
+    x_ref, it_ref = checkpointed_cg(A, b, tmp_path / "ref.npz", tol=1e-10,
+                                    chunk=40)
+    r = np.linalg.norm(S @ np.asarray(x_ref) - b) / np.linalg.norm(b)
+    assert r <= 1e-8
+    # interrupted run: small maxiter leaves a checkpoint behind
+    p = tmp_path / "ck.npz"
+    x_part, it_part = checkpointed_cg(A, b, p, tol=1e-10, chunk=40,
+                                      maxiter=80)
+    assert p.exists() and it_part <= 80 < it_ref
+    # resume completes and the checkpoint is consumed
+    x_res, it_res = checkpointed_cg(A, b, p, tol=1e-10, chunk=40)
+    assert not p.exists()
+    r = np.linalg.norm(S @ np.asarray(x_res) - b) / np.linalg.norm(b)
+    assert r <= 1e-8
+    # resumed trajectory is the SAME recurrence: the reported total
+    # (checkpointed + resumed sweeps) matches the uninterrupted count
+    assert abs(it_res - it_ref) <= 40  # within one chunk boundary
+    assert it_res >= it_part
+
+
+def test_checkpointed_cg_keep_on_success(tmp_path):
+    n = 120
+    S = _spd(n, seed=2)
+    A = sparse.csr_array(S)
+    b = np.asarray(S @ sample_vec(n, seed=3))
+    p = tmp_path / "keep.npz"
+    checkpointed_cg(A, b, p, tol=1e-10, chunk=500, keep_on_success=True)
+    assert p.exists()
+
+
+def test_checkpointed_solve_ivp_resume(tmp_path):
+    import jax.numpy as jnp
+
+    def decay(t, y):
+        return -0.7 * y
+
+    p = tmp_path / "ivp.npz"
+    y0 = np.array([1.0, 2.0])
+    # run with a tiny max_step so many steps occur, checkpointing often
+    sol = checkpointed_solve_ivp(decay, (0, 2.0), y0, p, method="RK45",
+                                 checkpoint_every=5, max_step=0.01)
+    assert sol.status == 0 and sol.resumed_from is None
+    assert not p.exists()  # consumed on success
+    # simulate a crash: pre-seed a checkpoint mid-interval, then resume
+    CheckpointManager(p).save(123, t=np.float64(1.0),
+                              y=y0 * np.exp(-0.7 * 1.0))
+    sol2 = checkpointed_solve_ivp(decay, (0, 2.0), y0, p, method="RK45",
+                                  checkpoint_every=10)
+    assert sol2.resumed_from == 1.0
+    np.testing.assert_allclose(
+        np.asarray(sol2.y)[:, -1], y0 * np.exp(-0.7 * 2.0), rtol=1e-4
+    )

@@ -90,3 +90,16 @@ def test_gmg_dist_example():
     m = re.search(r"Iterations: (\d+)\s+residual: ([0-9.e+-]+)", out)
     assert m, out
     assert float(m.group(2)) < 1e-6
+
+
+def test_heat_implicit_example():
+    out = _run("heat_implicit.py", "-n", "12", "-t", "0.2", "-explicit",
+               devices=1)
+    m = re.search(r"BDF:\s+status=0", out)
+    assert m, out
+    m = re.search(r"measured ([0-9.e+-]+) vs exp\(-lam1\*t\) ([0-9.e+-]+)",
+                  out)
+    assert m, out
+    assert abs(float(m.group(1)) - float(m.group(2))) < 0.05
+    m = re.search(r"stiffness ratio nfev: ([0-9.]+)x", out)
+    assert m and float(m.group(1)) > 1.5, out
