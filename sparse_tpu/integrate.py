@@ -599,6 +599,7 @@ def handle_events(sol, events, active_events, is_terminal, t_old, t):
 # solve_ivp driver (reference integrate.py:1303)
 # ---------------------------------------------------------------------------
 from ._bdf import BDF as _BDFImpl  # noqa: E402
+from ._radau import Radau as _RadauImpl  # noqa: E402
 
 
 class BDF(_BDFImpl, OdeSolver):
@@ -606,7 +607,13 @@ class BDF(_BDFImpl, OdeSolver):
     the reference's explicit-RK-only menu). See sparse_tpu/_bdf.py."""
 
 
-METHODS = {"RK23": RK23, "RK45": RK45, "DOP853": DOP853, "BDF": BDF}
+class Radau(_RadauImpl, OdeSolver):
+    """Stiff L-stable Radau IIA(5) implicit RK (scipy.integrate.Radau;
+    beyond the reference). See sparse_tpu/_radau.py."""
+
+
+METHODS = {"RK23": RK23, "RK45": RK45, "DOP853": DOP853, "BDF": BDF,
+           "Radau": Radau}
 
 MESSAGES = {
     0: "The solver successfully reached the end of the integration interval.",

@@ -120,3 +120,46 @@ def test_bdf_complex_linear():
     assert sol.status == 0
     np.testing.assert_allclose(np.asarray(sol.y)[:, -1], ref.y[:, -1],
                                rtol=1e-5, atol=1e-7)
+
+
+# ---------------------------------------------------------------------------
+# Radau IIA(5)
+# ---------------------------------------------------------------------------
+def test_radau_robertson_matches_scipy():
+    sol = solve_ivp(_rober, (0, 100.0), np.array([1.0, 0, 0]),
+                    method="Radau", rtol=1e-6, atol=1e-9)
+    ref = si.solve_ivp(_rober_np, (0, 100.0), [1.0, 0, 0], method="Radau",
+                       rtol=1e-6, atol=1e-9)
+    assert sol.status == 0
+    np.testing.assert_allclose(np.asarray(sol.y)[:, -1], ref.y[:, -1],
+                               rtol=1e-5)
+
+
+def test_radau_sparse_jacobian_and_dense_output():
+    n = 40
+    A = sp.diags([np.full(n - 1, 40.0), np.full(n, -80.0),
+                  np.full(n - 1, 40.0)], [-1, 0, 1]).tocsr()
+    As = sparse.csr_array(A)
+    y0 = np.sin(np.linspace(0, np.pi, n))
+    sol = solve_ivp(lambda t, y: As @ y, (0, 1.0), y0, method="Radau",
+                    jac=As, rtol=1e-8, atol=1e-10, dense_output=True)
+    ref = si.solve_ivp(lambda t, y: A @ y, (0, 1.0), y0, method="Radau",
+                       jac=A, rtol=1e-8, atol=1e-10, dense_output=True)
+    assert sol.status == 0
+    ts = np.linspace(0.1, 0.9, 5)
+    np.testing.assert_allclose(np.asarray(sol.sol(ts)), ref.sol(ts),
+                               rtol=1e-5, atol=1e-8)
+
+
+def test_radau_events():
+    def decay(t, y):
+        return -y
+
+    def hit_half(t, y):
+        return float(y[0]) - 0.5
+
+    hit_half.terminal = True
+    sol = solve_ivp(decay, (0, 10.0), np.array([1.0]), method="Radau",
+                    events=hit_half, rtol=1e-8, atol=1e-10)
+    assert sol.status == 1
+    np.testing.assert_allclose(sol.t_events[0][0], np.log(2), rtol=1e-5)
