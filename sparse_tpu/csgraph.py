@@ -34,6 +34,10 @@ __all__ = [
     "csgraph_to_dense",
     "csgraph_to_masked",
     "maximum_bipartite_matching",
+    "maximum_flow",
+    "MaximumFlowResult",
+    "min_weight_full_bipartite_matching",
+    "yen",
     "depth_first_order",
     "depth_first_tree",
     "dijkstra",
@@ -683,3 +687,320 @@ def reconstruct_path(csgraph, predecessors, directed=True):
     """Tree of the predecessor array (scipy surface)."""
     n = _nverts(csgraph)
     return _tree_from_pred(np.asarray(predecessors), csgraph, n)
+
+
+def _masked_sssp(row, col, w, n, src, edge_ok, node_ok):
+    """Single-source shortest path by vectorized (min,+) sweeps over a
+    masked edge list (host numpy — yen's spur searches mutate the edge
+    mask every call, so this stays on the control plane like the other
+    inherently sequential orderings). Returns (dist, pred)."""
+    dist = np.full(n, np.inf)
+    pred = np.full(n, -9999, dtype=np.int64)
+    if not node_ok[src]:
+        return dist, pred
+    dist[src] = 0.0
+    ok = edge_ok & node_ok[row] & node_ok[col]
+    r, c, ww = row[ok], col[ok], w[ok]
+    for _ in range(n):
+        cand = dist[r] + ww
+        best = np.full(n, np.inf)
+        np.minimum.at(best, c, cand)
+        improved = best < dist
+        if not improved.any():
+            break
+        dist = np.where(improved, best, dist)
+        win = cand <= dist[c]
+        p = np.full(n, -9999, dtype=np.int64)
+        np.maximum.at(p, c[win], r[win])
+        pred = np.where(improved, p, pred)
+    return dist, pred
+
+
+def _walk_pred(pred, src, dst):
+    """Vertex list src..dst from a predecessor array (None if no path)."""
+    path = [int(dst)]
+    cur = int(dst)
+    for _ in range(len(pred) + 1):
+        if cur == src:
+            return path[::-1]
+        cur = int(pred[cur])
+        if cur < 0:
+            return None
+        path.append(cur)
+    return None
+
+
+@track_provenance
+def yen(csgraph, source, sink, K, *, directed=True,
+        return_predecessors=False, unweighted=False):
+    """K-shortest loopless paths (scipy.sparse.csgraph.yen).
+
+    Yen's algorithm: the candidate spur searches run on a masked edge
+    list via :func:`_masked_sssp` (each spur masks the root-path edges
+    of previously accepted paths), so no graph copies are built per
+    candidate. Beyond the reference (which has no graph module)."""
+    row, col, w, n = _graph_coo(csgraph, directed, unweighted)
+    source, sink = int(source), int(sink)
+    if w.size and float(np.min(w)) < 0:
+        raise ValueError("yen requires non-negative weights")
+    edge_ok = np.ones(len(row), dtype=bool)
+    node_ok = np.ones(n, dtype=bool)
+
+    def mask_edge(u, v):
+        sel = (row == u) & (col == v)
+        if not directed:
+            sel |= (row == v) & (col == u)
+        edge_ok[sel] = False
+
+    # weight lookup for root-path costs (min over parallel edges,
+    # matching the relaxation's choice)
+    def edge_w(u, v):
+        sel = (row == u) & (col == v)
+        return float(np.min(w[sel]))
+
+    dist, pred = _masked_sssp(row, col, w, n, source, edge_ok, node_ok)
+    first = _walk_pred(pred, source, sink)
+    A, A_cost = [], []
+    if first is not None and np.isfinite(dist[sink]):
+        A.append(first)
+        A_cost.append(float(dist[sink]))
+    B = {}  # path tuple -> cost
+    while first is not None and len(A) < int(K):
+        prev = A[-1]
+        for i in range(len(prev) - 1):
+            spur = prev[i]
+            root = prev[: i + 1]
+            edge_ok[:] = True
+            node_ok[:] = True
+            for p in A:
+                if len(p) > i + 1 and p[: i + 1] == root:
+                    mask_edge(p[i], p[i + 1])
+            node_ok[root[:-1]] = False
+            sd, sp = _masked_sssp(row, col, w, n, spur, edge_ok, node_ok)
+            tail = _walk_pred(sp, spur, sink)
+            if tail is None or not np.isfinite(sd[sink]):
+                continue
+            cand = root[:-1] + tail
+            key = tuple(cand)
+            if key in B or cand in A:
+                continue
+            root_cost = sum(edge_w(root[j], root[j + 1])
+                            for j in range(len(root) - 1))
+            B[key] = root_cost + float(sd[sink])
+        if not B:
+            break
+        key = min(B, key=lambda t: (B[t], t))
+        A.append(list(key))
+        A_cost.append(B.pop(key))
+    costs = np.asarray(A_cost, dtype=np.float64)
+    if not return_predecessors:
+        return costs
+    preds = np.full((len(A), n), -9999, dtype=np.int32)
+    for k, p in enumerate(A):
+        for j in range(len(p) - 1):
+            preds[k, p[j + 1]] = p[j]
+    return costs, preds
+
+
+class MaximumFlowResult:
+    """Result of :func:`maximum_flow` (scipy.sparse.csgraph surface):
+    ``flow_value`` plus the per-edge net ``flow`` matrix."""
+
+    def __init__(self, flow_value, flow):
+        self.flow_value = flow_value
+        self.flow = flow
+
+    def __repr__(self):
+        return f"MaximumFlowResult with value of {self.flow_value}"
+
+
+@track_provenance
+def maximum_flow(csgraph, source, sink, *, method="dinic"):
+    """Maximum s-t flow (scipy.sparse.csgraph.maximum_flow semantics:
+    integer capacities; returns net flows on the pattern of
+    ``csgraph + csgraph.T``). Dinic's blocking-flow algorithm on the
+    host control plane — level BFS and augmentation are inherently
+    sequential; capacities stay in compact numpy edge arrays."""
+    if method not in ("dinic", "edmonds_karp"):
+        raise ValueError(f"method expected 'dinic' or 'edmonds_karp', got {method!r}")
+    if hasattr(csgraph, "tocoo"):
+        G = csgraph.tocoo()
+        data = np.asarray(G.data)
+        urow = np.asarray(G.row, dtype=np.int64)
+        ucol = np.asarray(G.col, dtype=np.int64)
+        n = int(G.shape[0])
+        if G.shape[0] != G.shape[1]:
+            raise ValueError("csgraph must be square")
+    else:
+        D = np.asarray(csgraph)
+        if D.ndim != 2 or D.shape[0] != D.shape[1]:
+            raise ValueError("csgraph must be square")
+        n = D.shape[0]
+        urow, ucol = np.nonzero(D)
+        data = D[urow, ucol]
+    if not np.issubdtype(data.dtype, np.integer):
+        raise ValueError("csgraph must have an integer dtype")
+    source, sink = int(source), int(sink)
+    if not (0 <= source < n and 0 <= sink < n):
+        raise ValueError("source/sink out of range")
+    if source == sink:
+        raise ValueError("source and sink must differ")
+
+    # residual edge arrays: stored edge 2e = forward(cap), 2e+1 = reverse(0)
+    E = len(urow)
+    head = np.empty(2 * E, dtype=np.int64)
+    cap = np.zeros(2 * E, dtype=np.int64)
+    head[0::2], head[1::2] = ucol, urow
+    cap[0::2] = data.astype(np.int64)
+    tail = np.empty(2 * E, dtype=np.int64)
+    tail[0::2], tail[1::2] = urow, ucol
+    order = np.argsort(tail, kind="stable")
+    adj_start = np.searchsorted(tail[order], np.arange(n + 1))
+
+    total = 0
+    INF = np.iinfo(np.int64).max
+    while True:
+        # BFS level graph on residual capacities
+        level = np.full(n, -1, dtype=np.int64)
+        level[source] = 0
+        frontier = [source]
+        while frontier:
+            nxt = []
+            for u in frontier:
+                for t in order[adj_start[u]:adj_start[u + 1]]:
+                    v = head[t]
+                    if cap[t] > 0 and level[v] < 0:
+                        level[v] = level[u] + 1
+                        nxt.append(int(v))
+            frontier = nxt
+        if level[sink] < 0:
+            break
+        # blocking flow: iterative DFS with per-vertex edge cursors
+        it = adj_start[:-1].copy()
+        while True:
+            # find one augmenting path in the level graph
+            path = []
+            u = source
+            while u != sink:
+                advanced = False
+                while it[u] < adj_start[u + 1]:
+                    t = order[it[u]]
+                    v = head[t]
+                    if cap[t] > 0 and level[v] == level[u] + 1:
+                        path.append(t)
+                        u = int(v)
+                        advanced = True
+                        break
+                    it[u] += 1
+                if not advanced:
+                    if not path:
+                        u = None
+                        break
+                    # dead end: retreat, exhaust the edge that led here
+                    dead = path.pop()
+                    u = int(tail[dead])
+                    it[u] += 1
+            if u is None:
+                break
+            pushed = int(min(INF, min(cap[t] for t in path)))
+            for t in path:
+                cap[t] -= pushed
+                cap[t ^ 1] += pushed
+            total += pushed
+    fwd_flow = data.astype(np.int64) - cap[0::2]  # flow on each stored edge
+
+    # net flow matrix on pattern(csgraph) ∪ pattern(csgraph.T)
+    from .coo import coo_array
+
+    rows = np.concatenate([urow, ucol])
+    cols = np.concatenate([ucol, urow])
+    vals = np.concatenate([fwd_flow, -fwd_flow])
+    flow = coo_array((vals, (rows, cols)), shape=(n, n))
+    flow.sum_duplicates()
+    return MaximumFlowResult(int(total), flow.tocsr())
+
+
+@track_provenance
+def min_weight_full_bipartite_matching(biadjacency, maximize=False):
+    """Sparse assignment problem (scipy.sparse.csgraph
+    .min_weight_full_bipartite_matching): full matching of the smaller
+    side minimizing total weight; explicit zeros count as edges.
+    Successive shortest augmenting paths with dual potentials (the
+    LAPJVsp recurrence) on the host control plane."""
+    import heapq
+
+    if not hasattr(biadjacency, "tocsr"):
+        raise TypeError("biadjacency must be a sparse array")
+    B = biadjacency.tocsr()
+    m, n = (int(s) for s in B.shape)
+    transposed = m > n
+    if transposed:
+        B = B.T.tocsr()
+        m, n = n, m
+    indptr = np.asarray(B.indptr, dtype=np.int64)
+    indices = np.asarray(B.indices, dtype=np.int64)
+    data = np.asarray(B.data, dtype=np.float64)
+    if maximize:
+        data = -data
+    # a constant shift moves every full matching's cost equally: safe way
+    # to make reduced-cost Dijkstra's nonnegativity invariant hold
+    shift = float(np.min(data)) if data.size else 0.0
+    if shift < 0:
+        data = data - shift
+    u = np.zeros(m)
+    v = np.zeros(n)
+    row4col = np.full(n, -1, dtype=np.int64)
+    col4row = np.full(m, -1, dtype=np.int64)
+    for cur in range(m):
+        dist = np.full(n, np.inf)
+        prev_row = np.full(n, -1, dtype=np.int64)
+        seen = np.zeros(n, dtype=bool)
+        heap = []
+
+        def relax(i, d0):
+            for t in range(indptr[i], indptr[i + 1]):
+                j = int(indices[t])
+                if seen[j]:
+                    continue
+                nd = d0 + data[t] - u[i] - v[j]
+                if nd < dist[j]:
+                    dist[j] = nd
+                    prev_row[j] = i
+                    heapq.heappush(heap, (nd, j))
+
+        relax(cur, 0.0)
+        sink = -1
+        while heap:
+            d, j = heapq.heappop(heap)
+            if seen[j]:
+                continue
+            seen[j] = True
+            if row4col[j] < 0:
+                sink = j
+                break
+            relax(int(row4col[j]), d)
+        if sink < 0:
+            raise ValueError("no full matching exists")
+        # dual update keeps all reduced costs nonnegative
+        minv = dist[sink]
+        u[cur] += minv
+        scanned = np.nonzero(seen)[0]
+        for j in scanned:
+            if j == sink:
+                continue
+            v[j] += dist[j] - minv
+            u[int(row4col[j])] += minv - dist[j]
+        # augment along the alternating path
+        j = sink
+        while True:
+            i = int(prev_row[j])
+            row4col[j] = i
+            col4row[i], j = j, col4row[i]
+            if i == cur:
+                break
+    row_ind = np.arange(m, dtype=np.int64)
+    col_ind = col4row
+    if transposed:
+        order = np.argsort(col_ind)
+        row_ind, col_ind = col_ind[order], row_ind[order]
+    return row_ind, col_ind

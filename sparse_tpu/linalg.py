@@ -2517,3 +2517,44 @@ __all__ = [
 ]
 
 from ._laplacian import LaplacianNd  # noqa: F401,E402
+
+
+def _legacy_namespace(name, symbols):
+    """scipy.sparse.linalg keeps deprecated submodule namespaces
+    (``linalg.isolve.cg`` etc.); mirror them as module objects so
+    drop-in callers that still import through them keep working."""
+    import sys
+    import types
+
+    mod = types.ModuleType(f"{__name__}.{name}")
+    g = globals()
+    for s in symbols:
+        if s in g:
+            setattr(mod, s, g[s])
+    # register so `from sparse_tpu.linalg.isolve import cg` resolves even
+    # though linalg is a plain module, not a package
+    sys.modules[mod.__name__] = mod
+    return mod
+
+
+isolve = _legacy_namespace(
+    "isolve",
+    ["cg", "cgs", "bicg", "bicgstab", "gmres", "lgmres", "gcrotmk",
+     "minres", "qmr", "tfqmr", "lsqr", "lsmr"],
+)
+dsolve = _legacy_namespace(
+    "dsolve",
+    ["spsolve", "splu", "spilu", "factorized", "spsolve_triangular",
+     "MatrixRankWarning", "use_solver"],
+)
+eigen = _legacy_namespace(
+    "eigen",
+    ["eigs", "eigsh", "lobpcg", "svds", "ArpackError",
+     "ArpackNoConvergence"],
+)
+interface = _legacy_namespace(
+    "interface", ["LinearOperator", "aslinearoperator"]
+)
+matfuncs = _legacy_namespace(
+    "matfuncs", ["expm", "inv", "expm_multiply", "matrix_power"]
+)

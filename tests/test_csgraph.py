@@ -258,3 +258,128 @@ def test_csgraph_accepts_array_like():
     L = cg.laplacian(sp.csr_matrix(np.array([[0, 1.0], [1.0, 0]])))
     np.testing.assert_allclose(np.asarray(L.todense()),
                                [[1, -1], [-1, 1]])
+
+
+@pytest.mark.parametrize("directed", [True, False])
+@pytest.mark.parametrize("K", [1, 3, 8])
+def test_yen_matches_scipy(directed, K):
+    G = _rand_graph(n=14, density=0.3, seed=3, directed=directed)
+    want = scs.yen(G, 0, 9, K, directed=directed)
+    got = cg.yen(sparse.csr_array(G), 0, 9, K, directed=directed)
+    assert got.shape == want.shape
+    np.testing.assert_allclose(np.sort(got), np.sort(want), atol=1e-10)
+
+
+def test_yen_predecessors_encode_real_paths():
+    G = _rand_graph(n=12, density=0.35, seed=5)
+    D = G.toarray()
+    costs, preds = cg.yen(sparse.csr_array(G), 0, 7, 4,
+                          return_predecessors=True)
+    assert preds.shape[0] == costs.shape[0]
+    seen = set()
+    for k in range(len(costs)):
+        # walk each path back from the sink; its edge-weight sum must
+        # equal the reported cost and the path must be loopless+unique
+        path, cur = [7], 7
+        while cur != 0:
+            cur = int(preds[k, cur])
+            assert cur >= 0
+            path.append(cur)
+        path = path[::-1]
+        assert len(set(path)) == len(path)
+        assert tuple(path) not in seen
+        seen.add(tuple(path))
+        total = sum(D[path[j], path[j + 1]] for j in range(len(path) - 1))
+        np.testing.assert_allclose(total, costs[k], atol=1e-10)
+
+
+def test_yen_no_path_and_negative():
+    G = sp.csr_matrix(np.array([[0.0, 1, 0], [0, 0, 0], [0, 0, 0]]))
+    assert cg.yen(sparse.csr_array(G), 2, 0, 3).shape == (0,)
+    Gn = sp.csr_matrix(np.array([[0.0, -1], [0, 0]]))
+    with pytest.raises(ValueError):
+        cg.yen(sparse.csr_array(Gn), 0, 1, 1)
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2, 3])
+def test_maximum_flow_matches_scipy(seed):
+    rng = np.random.default_rng(seed)
+    n = 12
+    G = sp.random(n, n, 0.3, random_state=rng, format="csr")
+    G.setdiag(0)
+    G.eliminate_zeros()
+    G.data = rng.integers(1, 20, G.nnz).astype(np.int32)
+    G = sp.csr_matrix(G)
+    want = scs.maximum_flow(G, 0, n - 1)
+    got = cg.maximum_flow(sparse.csr_array(np.asarray(G.toarray())), 0, n - 1)
+    assert got.flow_value == want.flow_value
+    F = got.flow.toarray().astype(np.int64)
+    # antisymmetric net flows, capacity-feasible, conservation at
+    # interior vertices, and the source's net outflow equals the value
+    assert np.array_equal(F, -F.T)
+    assert np.all(F <= G.toarray())
+    net = F.sum(axis=1)
+    assert got.flow_value == net[0] == -net[n - 1]
+    assert np.all(net[1:-1] == 0)
+
+
+def test_maximum_flow_validation():
+    G = sparse.csr_array(np.array([[0.0, 2.5], [0, 0]]))
+    with pytest.raises(ValueError):
+        cg.maximum_flow(G, 0, 1)  # non-integer dtype
+    Gi = sparse.csr_array(np.array([[0, 2], [0, 0]], dtype=np.int32))
+    with pytest.raises(ValueError):
+        cg.maximum_flow(Gi, 0, 0)  # source == sink
+    r = cg.maximum_flow(Gi, 0, 1)
+    assert r.flow_value == 2 and "2" in repr(r)
+
+
+@pytest.mark.parametrize("maximize", [False, True])
+@pytest.mark.parametrize("shape", [(8, 8), (6, 10), (10, 6)])
+def test_min_weight_full_bipartite_matching(shape, maximize):
+    rng = np.random.default_rng(hash(shape) % 2**32)
+    m, n = shape
+    # dense enough that a full matching almost surely exists
+    B = sp.random(m, n, 0.7, random_state=rng, format="csr")
+    B.data = rng.uniform(-3.0, 5.0, B.nnz)
+    try:
+        wr, wc = scs.min_weight_full_bipartite_matching(B, maximize=maximize)
+    except ValueError:
+        with pytest.raises(ValueError):
+            cg.min_weight_full_bipartite_matching(
+                sparse.csr_array(B), maximize=maximize)
+        return
+    gr, gc = cg.min_weight_full_bipartite_matching(
+        sparse.csr_array(B), maximize=maximize)
+    D = B.toarray()
+    np.testing.assert_allclose(D[gr, gc].sum(), D[wr, wc].sum(), atol=1e-9)
+    assert len(set(gr.tolist())) == len(gr)
+    assert len(set(gc.tolist())) == len(gc)
+
+
+def test_min_weight_matching_infeasible_and_types():
+    with pytest.raises(TypeError):
+        cg.min_weight_full_bipartite_matching(np.ones((3, 3)))
+    # an isolated row can never be matched
+    B = sp.csr_matrix(np.array([[1.0, 0], [0, 0]]))
+    B.eliminate_zeros()
+    with pytest.raises(ValueError):
+        cg.min_weight_full_bipartite_matching(sparse.csr_array(B))
+
+
+def test_linalg_legacy_namespaces():
+    from sparse_tpu import linalg as tl
+
+    assert tl.isolve.cg is tl.cg
+    assert tl.dsolve.spsolve is tl.spsolve
+    assert tl.eigen.eigsh is tl.eigsh
+    assert tl.interface.LinearOperator is tl.LinearOperator
+    assert tl.matfuncs.expm is tl.expm
+
+
+def test_linalg_legacy_from_import():
+    # the scipy-style from-import form must resolve too
+    from sparse_tpu.linalg.isolve import cg as cg_fn
+    from sparse_tpu import linalg as tl
+
+    assert cg_fn is tl.cg
