@@ -252,6 +252,11 @@ def run_fused(n: int, iters: int, tiles=(65536, 131072, 16384)):
         ]
     for fn, name, pdt in variants:
         for tile in tiles:
+            if pdt is not None and tile > 65536:
+                # measured on v5e: bf16 plane scratch at the 128k tile
+                # exceeds the 16M scoped-vmem limit (18.02M) — a
+                # deterministic compile failure, skip the budget burn
+                continue
             try:
                 out = fn(
                     planes, offsets, b, None, N, iters=iters, tile=tile,
@@ -494,17 +499,30 @@ def _run_example(script: str, attempts, timeout_s: int):
     """Run an example script as a subprocess for each arg-list in
     ``attempts`` until one yields an "Iterations / sec" line; returns
     (value, attempt_index) or None. Shared scaffold for the GMG and
-    quantum bench rows."""
+    quantum bench rows.
+
+    ``timeout_s`` is a TOTAL deadline across all attempts, not per
+    attempt — two sequential timed-out attempts must not overshoot the
+    caller's remaining budget (observed: GMG 4500 then 2000, each given
+    the full window, blew ~190s past BENCH_BUDGET_S)."""
     import re
 
+    deadline = time.monotonic() + timeout_s
     here = os.path.dirname(os.path.abspath(__file__))
     for i, args in enumerate(attempts):
+        left = deadline - time.monotonic()
+        if left < 60:
+            print(f"bench: {script} out of budget before {args}", file=sys.stderr)
+            break
+        # fair-share so a hung large-size attempt can't starve the
+        # fallback sizes of their chance at a completed row
+        share = max(90.0, left / (len(attempts) - i))
         try:
             proc = subprocess.run(
                 [sys.executable, os.path.join(here, "examples", script), *args],
                 capture_output=True,
                 text=True,
-                timeout=timeout_s,
+                timeout=min(left, share),
                 cwd=here,
             )
         except subprocess.TimeoutExpired:
@@ -524,7 +542,10 @@ def _try_gmg(timeout_s: int = 600):
     AFTER the headline worker exits (sequential TPU clients — the tunnel
     serves one process at a time). Falls back to a smaller grid; baseline
     comparison is row-normalized like run_size."""
-    sizes = ((4500, 6), (2000, 5))
+    # n=4500 is infeasible in-budget: the (CPU) hierarchy init alone
+    # scales past 20 min. 2000 fits when the window is generous, 1000
+    # (~2 min end-to-end warm) banks a row otherwise.
+    sizes = ((2000, 5), (1000, 4))
     if os.environ.get("BENCH_GMG_SIZES"):  # test hook: "n:levels,n:levels"
         sizes = tuple(
             (int(a), int(b))

@@ -99,9 +99,15 @@ def get_phase_procs(use_tpu: bool):
         return contextlib.nullcontext(), contextlib.nullcontext()
     import jax
 
-    cpus = jax.devices("cpu") if any(
-        d.platform == "cpu" for d in jax.devices()
-    ) else None
+    # jax.devices() lists only the DEFAULT platform — under a TPU plugin
+    # the CPU backend never appears there, which silently routed the whole
+    # build phase through the accelerator (every constructor op a tunnel
+    # round trip; GMG init at n=2000 alone blew the bench window). Ask for
+    # the cpu backend explicitly; it coexists with the accelerator client.
+    try:
+        cpus = jax.devices("cpu")
+    except RuntimeError:
+        cpus = None
     accel = jax.devices()[0]
     build = jax.default_device(cpus[0]) if cpus and accel.platform != "cpu" else contextlib.nullcontext()
     solve = jax.default_device(accel)

@@ -840,6 +840,8 @@ def maximum_flow(csgraph, source, sink, *, method="dinic"):
         data = D[urow, ucol]
     if not np.issubdtype(data.dtype, np.integer):
         raise ValueError("csgraph must have an integer dtype")
+    if data.size and int(data.min()) < 0:
+        raise ValueError("capacities must be non-negative")
     source, sink = int(source), int(sink)
     if not (0 <= source < n and 0 <= sink < n):
         raise ValueError("source/sink out of range")

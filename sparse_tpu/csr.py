@@ -23,7 +23,7 @@ from .coverage import track_provenance
 from .config import settings
 from .ops import conv, elementwise, sddmm as sddmm_ops, spgemm as spgemm_ops, spmv as spmv_ops
 from .ops.coords import expand_rows
-from .utils import asjnp, host_int, user_warning
+from .utils import asjnp, host_int, in_trace, user_warning
 
 
 @jax.tree_util.register_pytree_node_class
@@ -127,6 +127,12 @@ class csr_array(SparseArray):
         m = self.shape[0]
         if m == 0 or self.nnz == 0:
             return None
+        if self._ell is None and in_trace():
+            # in-trace first use: no host sync, and no cache write — a
+            # width cache may already exist (eager call under a different
+            # spmv_mode), but building ELL here would store TRACER arrays
+            # on self._ell and poison every later eager matvec
+            return None
         k = self._ell_width()
         mean = max(self.nnz / m, 1.0)
         if mode in ("ell", "pallas") or k <= settings.ell_max_ratio * mean:
@@ -207,6 +213,13 @@ class csr_array(SparseArray):
         """
         if self._dia is not False:
             return self._dia
+        if in_trace():
+            # first use is INSIDE a trace (e.g. a multigrid prolongator
+            # applied only in the compiled V-cycle): detection needs a
+            # host sync, which would raise and silently demote the whole
+            # solver to its host loop. Skip WITHOUT caching — an eager
+            # warm call (linalg.cg does one) can still detect later.
+            return None
         self._dia = None
         m, n = self.shape
         nnz = self.nnz
@@ -344,7 +357,23 @@ class csr_array(SparseArray):
             )
             return csr_array.from_parts(data, indices, indptr, self.shape)
         d = asjnp(other)
-        d = jnp.broadcast_to(d, self.shape)
+        m, n = self.shape
+        if d.ndim == 1:
+            d = d[None, :]
+        if d.ndim != 2 or d.shape[0] not in (1, m) or d.shape[1] not in (1, n):
+            raise ValueError(
+                f"inconsistent shapes: {self.shape} and {np.shape(other)}"
+            )
+        # broadcast operands stay per-nnz: materializing the [m, n]
+        # broadcast of a column vector is O(m*n) memory (512 GB at the
+        # AMG example's 512^2 grid); scale rows/columns directly instead
+        if d.shape == (1, 1):
+            return self._with_data(self.data * d[0, 0])
+        if d.shape[1] == 1:  # column vector: scale rows
+            rows = expand_rows(self.indptr, int(self.data.shape[0]))
+            return self._with_data(self.data * d[rows, 0])
+        if d.shape[0] == 1:  # row vector: scale columns
+            return self._with_data(self.data * d[0, self.indices])
         vals = elementwise.csr_mult_dense(
             self.indptr, self.indices, self.data, d, self.shape
         )

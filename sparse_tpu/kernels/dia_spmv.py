@@ -256,6 +256,9 @@ class PreparedDia:
         return y[: self.plan.m]
 
 
+_PALLAS_UNAVAILABLE = object()  # per-object marker: no Mosaic lowering here
+
+
 def cached_prepared_spmv(obj, attr: str, data, offsets, shape, x):
     """Shared band-gated PreparedDia dispatch for the format classes.
 
@@ -271,10 +274,22 @@ def cached_prepared_spmv(obj, attr: str, data, offsets, shape, x):
     if band > settings.pallas_max_band:
         return None
     prepared = getattr(obj, attr, None)
+    if prepared is _PALLAS_UNAVAILABLE:
+        return None
     if prepared is None:
         prepared = PreparedDia(data, offsets, shape)
         setattr(obj, attr, prepared)
-    return prepared(x)
+    try:
+        return prepared(x)
+    except ValueError as e:
+        # Pallas has no lowering on this backend (e.g. the examples'
+        # CPU-scoped build phase running with spmv_mode=pallas): fail
+        # over to the XLA formulation ONCE and remember. Any other
+        # ValueError (bad shape/dtype) is a real caller error.
+        if "interpret mode" not in str(e):
+            raise
+        setattr(obj, attr, _PALLAS_UNAVAILABLE)
+        return None
 
 
 def dia_spmv_pallas(data, offsets, x, shape, tile=16384, interpret=None):

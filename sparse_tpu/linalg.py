@@ -355,6 +355,13 @@ def cg(
 
     r = b - A.matvec(x)
     try:
+        # warm the preconditioner EAGERLY once: layout detection
+        # (_maybe_dia/_maybe_ell) host-syncs on first use and is skipped
+        # inside a trace, so an M first applied inside the compiled loop
+        # (multigrid R/P operators) would silently run on its slowest
+        # kernel path for the whole solve
+        if not isinstance(M, IdentityOperator):
+            M.matvec(r)
         return _cg_device_loop(A, b, x, r, tol, maxiter, M, conv_test_iters)
     except (
         jax.errors.TracerArrayConversionError,

@@ -101,3 +101,17 @@ def enable_compilation_cache(path: str | None = None) -> None:
         jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
     except Exception as e:  # unknown flags on exotic jax versions
         user_warning(f"compilation cache unavailable: {e}")
+
+
+def in_trace() -> bool:
+    """True when called under an active jax trace (jit/scan/vmap body).
+
+    Inside a trace, ops on even CONCRETE arrays return tracers, so code
+    that needs a host sync (layout detection, shape materialization)
+    must skip rather than raise TracerArrayConversionError. A scalar
+    sentinel op is the version-stable way to ask.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    return isinstance(jnp.zeros((), jnp.int32) + 0, jax.core.Tracer)

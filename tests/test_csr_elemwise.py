@@ -117,3 +117,35 @@ def test_zero_preserving_ufuncs():
     assert np.allclose(np.asarray(arr.sqrt().todense()), np.sqrt(sa.todense()))
     assert np.allclose(np.asarray(arr.sin().todense()), np.sin(np.asarray(sa.todense())))
     assert np.allclose(np.asarray(arr.expm1().todense()), np.expm1(np.asarray(sa.todense())))
+
+
+def test_multiply_broadcast_vectors_stay_sparse():
+    """Column/row-vector multiply must not materialize the [m, n]
+    broadcast (the AMG smoothed prolongator scales rows of a 262k^2
+    operator; a dense broadcast there is 512 GB)."""
+    import numpy as np
+    import scipy.sparse as sp
+
+    import sparse_tpu as sparse
+
+    rng = np.random.default_rng(0)
+    S = sp.random(40, 23, 0.3, random_state=rng, format="csr")
+    A = sparse.csr_array(S)
+    col = rng.standard_normal((40, 1))
+    row = rng.standard_normal((1, 23))
+    vec = rng.standard_normal(23)
+    for other in (col, row, vec, np.full((1, 1), 2.5)):
+        want = S.multiply(other).toarray()
+        got = A.multiply(other).toarray()
+        np.testing.assert_allclose(np.asarray(got), want, rtol=1e-12)
+    # full dense operand still works, wrong shapes still raise
+    D = rng.standard_normal((40, 23))
+    np.testing.assert_allclose(
+        np.asarray(A.multiply(D).toarray()), S.multiply(D).toarray(), rtol=1e-12
+    )
+    try:
+        A.multiply(np.ones((3, 2)))
+    except ValueError:
+        pass
+    else:
+        raise AssertionError("expected ValueError for inconsistent shapes")

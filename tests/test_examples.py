@@ -104,3 +104,46 @@ def test_heat_implicit_example():
     assert abs(a - b) <= 0.02 * max(abs(b), 1e-3)  # relative
     m = re.search(r"stiffness ratio nfev: ([0-9.]+)x", out)
     assert m and float(m.group(1)) > 1.5, out
+
+
+def test_gmg_stencil_transfer_operators_match_matrices():
+    """The TPU-first conv forms of R (stride-2 conv) and P = R.T
+    (input-dilated conv) must be exactly the linear maps of the
+    assembled matrices, on even and odd grids, for both gridops."""
+    import importlib.util
+    import sys as _sys
+
+    import jax.numpy as jnp
+    import numpy as np
+
+    here = os.path.join(os.path.dirname(os.path.dirname(__file__)), "examples")
+    _sys.path.insert(0, here)
+    old_argv = _sys.argv
+    _sys.argv = ["gmg.py", "-n", "8", "--precision", "f32"]
+    try:
+        spec = importlib.util.spec_from_file_location(
+            "gmg_stencil_mod", os.path.join(here, "gmg.py")
+        )
+        m = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(m)
+    finally:
+        _sys.argv = old_argv
+        _sys.path.remove(here)
+    rng = np.random.default_rng(0)
+    for fine_n in (8, 9, 13):
+        dim = fine_n * fine_n
+        for gridop, op in (
+            ("injection", m.injection_operator), ("linear", m.linear_operator)
+        ):
+            R, cdim = op(dim)
+            cn = int(np.sqrt(cdim))
+            r = rng.standard_normal(dim).astype(np.float32)
+            xc = rng.standard_normal(cdim).astype(np.float32)
+            np.testing.assert_allclose(
+                np.asarray(m._restrict_stencil(jnp.asarray(r), fine_n, cn, gridop)),
+                np.asarray(R @ r), atol=1e-5,
+            )
+            np.testing.assert_allclose(
+                np.asarray(m._prolong_stencil(jnp.asarray(xc), fine_n, cn, gridop)),
+                np.asarray(R.T.tocsr() @ xc), atol=1e-5,
+            )

@@ -315,3 +315,56 @@ print("NO_X64_OK")
     )
     assert proc.returncode == 0, proc.stderr[-2000:]
     assert "NO_X64_OK" in proc.stdout
+
+
+def test_layout_detection_inside_trace_falls_back_not_raises():
+    """A csr first applied INSIDE a jit trace (multigrid transfer
+    operators) must not host-sync in _maybe_dia/_maybe_ell — the
+    resulting TracerArrayConversionError silently demoted CG to its
+    host loop (tunnel-fatal). The guard skips detection without
+    poisoning the cache, so a later eager call still detects."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import scipy.sparse as sp
+
+    import sparse_tpu as sparse
+
+    S = sp.random(64, 64, 0.1, random_state=np.random.default_rng(0), format="csr")
+    S.setdiag(3.0)
+    A = sparse.csr_array(S)
+    x = jnp.ones(64, dtype=jnp.float32)
+    y = jax.jit(lambda v: A @ v)(x)  # must trace cleanly, no fallback
+    np.testing.assert_allclose(np.asarray(y), S @ np.ones(64), rtol=1e-5)
+    assert A._dia is False or A._dia is None  # cache not poisoned by the trace
+    A @ np.ones(64)  # eager use afterwards still allowed to detect+cache
+
+
+def test_cg_with_traceable_preconditioner_stays_on_device_loop(monkeypatch):
+    """Preconditioned CG whose M is first seen inside the loop must run
+    the compiled device loop (the eager warm call primes layout
+    caches), not the host fallback."""
+    import numpy as np
+    import scipy.sparse as sp
+
+    import sparse_tpu as sparse
+    from sparse_tpu import linalg
+
+    rng = np.random.default_rng(1)
+    n = 128
+    S = sp.diags([np.full(n - 1, -1.0), np.full(n, 2.0), np.full(n - 1, -1.0)],
+                 [-1, 0, 1]).tocsr()
+    A = sparse.csr_array(S)
+    Mmat = sparse.csr_array(sp.diags([1.0 / S.diagonal()], [0]).tocsr())
+    M = linalg.LinearOperator((n, n), matvec=lambda r: Mmat @ r, dtype=np.float64)
+    b = rng.standard_normal(n)
+    called = {"host": 0}
+    orig = linalg._cg_host_loop
+    monkeypatch.setattr(
+        linalg, "_cg_host_loop",
+        lambda *a, **k: called.__setitem__("host", called["host"] + 1) or orig(*a, **k),
+    )
+    x, iters = linalg.cg(A, b, tol=1e-6, maxiter=200, M=M)
+    assert called["host"] == 0, "preconditioned CG fell back to the host loop"
+    resid = np.linalg.norm(np.asarray(A @ x) - b)
+    assert resid < 1e-4
