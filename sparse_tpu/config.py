@@ -37,6 +37,12 @@ class Settings:
         default_factory=lambda: _env_bool("SPARSE_TPU_PRECISE_WINDOWS", False)
     )
     spmv_mode: str = field(default_factory=lambda: _env_str("SPARSE_TPU_SPMV_MODE", "auto"))
+    # Native (C++) Gustavson for EAGER host-resident SpGEMMs (construction
+    # phases: multigrid Galerkin products). Device/traced calls always use
+    # the XLA ESC formulation.
+    native_spgemm: bool = field(
+        default_factory=lambda: _env_bool("SPARSE_TPU_NATIVE_SPGEMM", True)
+    )
     force_serial: bool = field(
         default_factory=lambda: _env_bool("SPARSE_TPU_FORCE_SERIAL", False)
     )

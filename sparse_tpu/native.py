@@ -58,7 +58,10 @@ def lib():
                 cdll = ctypes.CDLL(path)
                 _declare(cdll)
                 _lib = cdll
-            except OSError:
+            except (OSError, AttributeError):
+                # AttributeError: an older library (e.g. via
+                # SPARSE_TPU_NATIVE_LIB) missing newer symbols — keep the
+                # documented None fallback instead of crashing callers
                 _lib = None
         _tried = True
     return _lib
@@ -79,6 +82,12 @@ def _declare(cdll) -> None:
     ]
     cdll.mtx_parse_dense.restype = i64
     cdll.mtx_parse_dense.argtypes = [ctypes.c_char_p, i64, i64, f64p]
+    cdll.spgemm_count.restype = i64
+    cdll.spgemm_count.argtypes = [i64, i64, i64p, i64p, i64p, i64p, i64p]
+    cdll.spgemm_fill.restype = None
+    cdll.spgemm_fill.argtypes = [
+        i64, i64, i64p, i64p, f64p, i64p, i64p, f64p, i64p, i64p, f64p,
+    ]
 
 
 def _as_u64p(a):
@@ -151,3 +160,42 @@ def parse_mtx_dense(body: bytes, count: int):
     if got != count:
         return None
     return out
+
+
+def _as_i64p(a):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_int64))
+
+
+def _as_f64p(a):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_double))
+
+
+def spgemm_host(Ap, Aj, Ax, Bp, Bj, Bx, m: int, n: int):
+    """Native 2-pass Gustavson C = A @ B on host arrays (the reference's
+    CPU SpGEMM task pair, src/sparse/array/csr/spgemm_csr_csr_csr.cc).
+
+    Inputs are numpy-coercible CSR parts; values are computed in f64 and
+    the caller casts back. Returns (indptr, indices, data) as numpy
+    int64/int64/float64, canonical (sorted, deduplicated) — or None when
+    the native library is unavailable.
+    """
+    import numpy as np
+
+    L = lib()
+    if L is None:
+        return None
+    Ap = np.ascontiguousarray(Ap, dtype=np.int64)
+    Aj = np.ascontiguousarray(Aj, dtype=np.int64)
+    Ax = np.ascontiguousarray(Ax, dtype=np.float64)
+    Bp = np.ascontiguousarray(Bp, dtype=np.int64)
+    Bj = np.ascontiguousarray(Bj, dtype=np.int64)
+    Bx = np.ascontiguousarray(Bx, dtype=np.float64)
+    Cp = np.empty(m + 1, dtype=np.int64)
+    nnz = L.spgemm_count(m, n, _as_i64p(Ap), _as_i64p(Aj),
+                         _as_i64p(Bp), _as_i64p(Bj), _as_i64p(Cp))
+    Cj = np.empty(nnz, dtype=np.int64)
+    Cx = np.empty(nnz, dtype=np.float64)
+    L.spgemm_fill(m, n, _as_i64p(Ap), _as_i64p(Aj), _as_f64p(Ax),
+                  _as_i64p(Bp), _as_i64p(Bj), _as_f64p(Bx),
+                  _as_i64p(Cp), _as_i64p(Cj), _as_f64p(Cx))
+    return Cp, Cj, Cx

@@ -30,13 +30,67 @@ import jax
 import jax.numpy as jnp
 
 from ..types import index_dtype_for
-from ..utils import host_int
+from ..utils import host_int, in_trace
 from .coords import (
     counts_to_indptr,
     expand_rows,
     lexsort_rc,
     rows_to_indptr,
 )
+
+
+def _all_on_host(*arrs) -> bool:
+    """True when every array is numpy or a CPU-committed jax array."""
+    for a in arrs:
+        sh = getattr(a, "sharding", None)
+        if sh is None:
+            continue  # numpy
+        try:
+            if any(d.platform != "cpu" for d in sh.device_set):
+                return False
+        except Exception:
+            return False
+    return True
+
+
+def _native_spgemm(indptr_a, indices_a, data_a, indptr_b, indices_b, data_b,
+                   m, n, dt):
+    """Eager host fast path: the C++ Gustavson kernel (native.spgemm_host).
+
+    Returns (indptr, indices, data) as jnp arrays under the library's
+    index-dtype policy, or None when the path doesn't apply. Values run
+    in f64 internally (>= the accuracy of every eligible dtype).
+    """
+    from ..config import settings
+
+    if not settings.native_spgemm or in_trace():
+        return None
+    if dt not in (jnp.float32, jnp.float64):
+        return None  # complex/int keep the exact-dtype ESC path
+    if not _all_on_host(indptr_a, indices_a, data_a,
+                        indptr_b, indices_b, data_b):
+        return None
+    from .. import native
+
+    import numpy as np
+
+    Ap = np.asarray(indptr_a)
+    # callers may pad trailing nnz (parallel tile shapes): slice them off
+    nnz_a, nnz_b = int(Ap[-1]), int(np.asarray(indptr_b)[-1])
+    got = native.spgemm_host(
+        Ap, np.asarray(indices_a)[:nnz_a], np.asarray(data_a)[:nnz_a],
+        np.asarray(indptr_b), np.asarray(indices_b)[:nnz_b],
+        np.asarray(data_b)[:nnz_b], int(m), int(n),
+    )
+    if got is None:
+        return None
+    Cp, Cj, Cx = got
+    idt = index_dtype_for((m, n), int(Cp[-1]))
+    return (
+        jnp.asarray(Cp.astype(idt)),
+        jnp.asarray(Cj.astype(idt)),
+        jnp.asarray(Cx.astype(dt)),
+    )
 
 
 def _next_pow2(v: int) -> int:
@@ -141,6 +195,11 @@ def spgemm_csr_csr(
     if m_real is None:
         m_real = m
     dt = jnp.result_type(data_a.dtype, data_b.dtype)
+    native_out = _native_spgemm(
+        indptr_a, indices_a, data_a, indptr_b, indices_b, data_b, m, n, dt
+    )
+    if native_out is not None:
+        return native_out
     nnz_a = data_a.shape[0]
     if nnz_a == 0 or data_b.shape[0] == 0:
         idt = index_dtype_for(out_shape, 0)

@@ -13,12 +13,95 @@
 //
 // Build: see sparse_tpu/native.py (auto-compiled with g++ -O3 on first use).
 
+#include <algorithm>
 #include <cstdint>
 #include <cstdio>
 #include <cstdlib>
 #include <cstring>
+#include <vector>
 
 extern "C" {
+
+// ---------------------------------------------------------------------------
+// Host Gustavson SpGEMM (construction-phase C = A @ B, CSR x CSR -> CSR)
+//
+// Reference analog: the CPU/OMP SpGEMM task pair
+// src/sparse/array/csr/spgemm_csr_csr_csr.cc (2-pass: NNZ count then fill).
+// The TPU build keeps its device-side ESC formulation for sharded/compiled
+// paths; this native kernel serves EAGER host-resident calls — multigrid
+// hierarchy Galerkin products and other setup-phase SpGEMMs, where the
+// XLA sort-based form pays ~2 orders of magnitude in constant factors.
+// ---------------------------------------------------------------------------
+
+// Pass 1: per-row nnz of C via a row-stamped dense mask. Returns total nnz.
+int64_t spgemm_count(int64_t m, int64_t n,
+                     const int64_t* Ap, const int64_t* Aj,
+                     const int64_t* Bp, const int64_t* Bj,
+                     int64_t* Cp) {
+  std::vector<int64_t> mask(static_cast<size_t>(n), -1);
+  Cp[0] = 0;
+  int64_t nnz = 0;
+  for (int64_t i = 0; i < m; ++i) {
+    int64_t row_nnz = 0;
+    for (int64_t jj = Ap[i]; jj < Ap[i + 1]; ++jj) {
+      const int64_t j = Aj[jj];
+      for (int64_t kk = Bp[j]; kk < Bp[j + 1]; ++kk) {
+        const int64_t k = Bj[kk];
+        if (mask[static_cast<size_t>(k)] != i) {
+          mask[static_cast<size_t>(k)] = i;
+          ++row_nnz;
+        }
+      }
+    }
+    nnz += row_nnz;
+    Cp[i + 1] = nnz;
+  }
+  return nnz;
+}
+
+// Pass 2: fill values with a linked-list accumulator, then sort each row's
+// (column, value) pairs so the output is canonical CSR.
+void spgemm_fill(int64_t m, int64_t n,
+                 const int64_t* Ap, const int64_t* Aj, const double* Ax,
+                 const int64_t* Bp, const int64_t* Bj, const double* Bx,
+                 const int64_t* Cp, int64_t* Cj, double* Cx) {
+  std::vector<int64_t> next(static_cast<size_t>(n), -1);
+  std::vector<double> sums(static_cast<size_t>(n), 0.0);
+  std::vector<std::pair<int64_t, double>> row;
+  for (int64_t i = 0; i < m; ++i) {
+    int64_t head = -2;
+    int64_t length = 0;
+    for (int64_t jj = Ap[i]; jj < Ap[i + 1]; ++jj) {
+      const int64_t j = Aj[jj];
+      const double v = Ax[jj];
+      for (int64_t kk = Bp[j]; kk < Bp[j + 1]; ++kk) {
+        const int64_t k = Bj[kk];
+        sums[static_cast<size_t>(k)] += v * Bx[kk];
+        if (next[static_cast<size_t>(k)] == -1) {
+          next[static_cast<size_t>(k)] = head;
+          head = k;
+          ++length;
+        }
+      }
+    }
+    row.clear();
+    row.reserve(static_cast<size_t>(length));
+    for (int64_t cnt = 0; cnt < length; ++cnt) {
+      row.emplace_back(head, sums[static_cast<size_t>(head)]);
+      const int64_t tmp = head;
+      head = next[static_cast<size_t>(head)];
+      next[static_cast<size_t>(tmp)] = -1;
+      sums[static_cast<size_t>(tmp)] = 0.0;
+    }
+    std::sort(row.begin(), row.end());
+    int64_t out = Cp[i];
+    for (const auto& cv : row) {
+      Cj[out] = cv.first;
+      Cx[out] = cv.second;
+      ++out;
+    }
+  }
+}
 
 // ---------------------------------------------------------------------------
 // Independent-set BFS expansion
