@@ -15,7 +15,7 @@ import time
 import networkx as nx
 import numpy as np
 
-from benchmark import parse_common_args
+from benchmark import get_phase_procs, parse_common_args
 
 parser = argparse.ArgumentParser()
 parser.add_argument("-nodes", type=int, default=14)
@@ -42,34 +42,89 @@ if args.graph == "cycle":
 else:
     graph = nx.erdos_renyi_graph(args.nodes, args.prob, seed=args.seed)
 
+build_scope, solve_scope = get_phase_procs(use_tpu)
+
+# --precision f32 (TPU-native) evolves in complex64 with f32-scaled
+# tolerances; f64/complex128 matches the reference's dtype (emulated,
+# slow on TPU — documented deviation, same stance as the PDE/GMG rows)
+if use_tpu and common.precision == "f32":
+    cdtype = np.complex64
+    rtol, atol = 1e-5, 1e-7
+else:
+    cdtype = np.complex128
+    rtol, atol = 1e-8, 1e-10
+
 timer.start()
-driver = quantum.HamiltonianDriver(
-    graph=graph, dtype=np.complex128,
-    dist_shards=args.dist_shards or None,
-)
-mis = quantum.HamiltonianMIS(graph=graph, poly=driver.ip, dtype=np.complex128)
-H_driver = driver.hamiltonian
-H_cost = mis.hamiltonian
+with build_scope:
+    # construction stays on the host CPU backend (the reference's
+    # build-on-CPU/solve-on-GPU machine scoping): eagerly dispatching
+    # the build's sorts through a remote accelerator is round-trip-bound
+    driver = quantum.HamiltonianDriver(
+        graph=graph, dtype=cdtype,
+        dist_shards=args.dist_shards or None,
+    )
+    mis = quantum.HamiltonianMIS(graph=graph, poly=driver.ip, dtype=cdtype)
+    H_driver = driver.hamiltonian
+    H_cost = mis.hamiltonian
 print(f"Hamiltonian build: {timer.stop():.1f} ms  "
       f"(nstates={driver.nstates}, nnz={H_driver.nnz})")
 
 T = args.t
 
 
-def rhs(t, y):
-    a = t / T          # ramp the cost Hamiltonian up
-    b = 1.0 - t / T    # ...and the driver down
-    return -1j * (a * (H_cost @ y) + b * (H_driver @ y))
+nst = driver.nstates
 
+if cdtype == np.complex64:
+    # TPU-native form: both Hamiltonians are REAL (bit-flip couplings and
+    # diagonal costs), so i dy/dt = H y splits into the stacked real
+    # system (dyr, dyi) = (H yi, -H yr) — f32 end to end, no complex
+    # arrays on the device (the tunnel backend cannot transfer them),
+    # and the SpMVs ride the real f32 fast path.
+    import jax.numpy as jnp
 
-y0 = np.zeros(driver.nstates, dtype=np.complex128)
-y0[-1] = 1.0  # start in the empty-set state
+    with build_scope:
+        Hc = H_cost.astype(np.float32).tocsr()
+        Hd = H_driver.astype(np.float32).tocsr()
 
-t0 = time.perf_counter()
-out = integrate.solve_ivp(rhs, (0, T), y0, method="DOP853", rtol=1e-8, atol=1e-10)
-wall = time.perf_counter() - t0
+    def rhs(t, y):
+        a = t / T
+        b = 1.0 - t / T
+        yr, yi = y[:nst], y[nst:]
+        Hyr = a * (Hc @ yr) + b * (Hd @ yr)
+        Hyi = a * (Hc @ yi) + b * (Hd @ yi)
+        return jnp.concatenate([Hyi, -Hyr])
+
+    y0 = np.zeros(2 * nst, dtype=np.float32)
+    y0[nst - 1] = 1.0  # start in the empty-set state (real part)
+else:
+    def rhs(t, y):
+        a = t / T          # ramp the cost Hamiltonian up
+        b = 1.0 - t / T    # ...and the driver down
+        return -1j * (a * (H_cost @ y) + b * (H_driver @ y))
+
+    y0 = np.zeros(nst, dtype=cdtype)
+    y0[-1] = 1.0  # start in the empty-set state
+
+with build_scope:
+    # one eager RHS call primes the operators' layout caches ON THE CPU
+    # backend — experimental accelerator backends (the axon tunnel) only
+    # reliably run COMPILED programs, so every eager op belongs here
+    np.asarray(rhs(0.0, y0))
+with solve_scope:
+    # compile outside the clock (the reference's CUDA tasks are prebuilt;
+    # a tunnel compile inside the clock would swamp the 13-step run)
+    integrate.solve_ivp(
+        rhs, (0, T * 1e-6), y0, method="DOP853", rtol=rtol, atol=atol
+    )
+    t0 = time.perf_counter()
+    out = integrate.solve_ivp(
+        rhs, (0, T), y0, method="DOP853", rtol=rtol, atol=atol
+    )
+    wall = time.perf_counter() - t0
 
 final = np.asarray(out.y)[:, -1]
+if cdtype == np.complex64:
+    final = final[:nst] + 1j * final[nst:]
 print(f"steps: {len(out.t) - 1}  nfev: {out.nfev}  wall: {wall:.2f} s")
 print(f"norm drift: {abs(np.linalg.norm(final) - 1.0):.2e}")
 print(f"MIS size: {int(mis.optimum)}  "

@@ -112,11 +112,23 @@ class csr_array(SparseArray):
         return out
 
     # -- ELL fast path -----------------------------------------------------
-    def _ell_width(self) -> int:
-        """Max row length; host-synced once and cached."""
+    def _ell_width(self) -> int | None:
+        """Max row length; host-synced once and cached (None: unknowable)."""
         if not hasattr(self, "_ell_width_cache") or self._ell_width_cache is None:
             counts = self.indptr[1:] - self.indptr[:-1]
-            self._ell_width_cache = host_int(counts.max()) if self.shape[0] else 0
+            try:
+                self._ell_width_cache = (
+                    host_int(counts.max()) if self.shape[0] else 0
+                )
+            except jax.errors.JaxRuntimeError:
+                # backend can't execute/fetch (see _maybe_dia): fall back
+                # to a host-side count from the (plain-buffer) indptr; if
+                # even that transfer fails, report width-unknown
+                try:
+                    p = np.asarray(self.indptr)
+                except jax.errors.JaxRuntimeError:
+                    return None
+                self._ell_width_cache = int((p[1:] - p[:-1]).max()) if len(p) > 1 else 0
         return self._ell_width_cache
 
     def _maybe_ell(self):
@@ -134,6 +146,8 @@ class csr_array(SparseArray):
             # on self._ell and poison every later eager matvec
             return None
         k = self._ell_width()
+        if k is None:  # width unknowable on this backend: no ELL layout
+            return None
         mean = max(self.nnz / m, 1.0)
         if mode in ("ell", "pallas") or k <= settings.ell_max_ratio * mean:
             if self._ell is None:
@@ -234,7 +248,13 @@ class csr_array(SparseArray):
         offs_dev = jnp.unique(self.indices.astype(jnp.int32) - rows.astype(jnp.int32),
                               size=min(settings.dia_max_diags + 1, nnz),
                               fill_value=jnp.iinfo(jnp.int32).max)
-        offs = np.unique(np.asarray(offs_dev))
+        try:
+            offs = np.unique(np.asarray(offs_dev))
+        except jax.errors.JaxRuntimeError:
+            # experimental backends (the axon tunnel) can fail to execute
+            # or transfer the bounded-unique — treat as not banded rather
+            # than crash the matvec; the SpMV still runs on ELL/segment
+            return None
         offs = offs[offs != np.iinfo(np.int32).max]
         D = len(offs)
         if D > settings.dia_max_diags or D * n > settings.dia_max_fill * nnz:

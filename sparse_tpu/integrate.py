@@ -23,7 +23,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from . import dop853_coefficients
-from .utils import asjnp
+from .utils import asjnp, in_trace
 
 SAFETY = 0.9
 MIN_FACTOR = 0.2
@@ -58,12 +58,48 @@ def _jit_with_eager_fallback(core):
 
 
 def _wrap_fun(fun, args):
+    """Bind args and route standalone RHS calls through jit.
+
+    The solver's hot loop compiles the whole RK step (``_build_step_core``),
+    but the setup path (initial f, first-step selection) and any eager
+    fallback call ``fun`` directly. Experimental accelerator backends (the
+    axon TPU tunnel) only reliably execute COMPILED programs — eager
+    elementwise arithmetic in a user RHS can fail with backend
+    Unimplemented errors — so the standalone calls are jitted too, with
+    ``t`` passed as a 0-d array so changing times never retrace. Inside an
+    active trace (the step core) the raw callable is used directly, and a
+    non-traceable (numpy-based) RHS falls back to eager per-call.
+    """
     if args:
-        def wrapped(t, y):
+        def raw(t, y):
             return asjnp(fun(t, y, *args))
     else:
-        def wrapped(t, y):
+        def raw(t, y):
             return asjnp(fun(t, y))
+
+    jraw = jax.jit(raw)
+    state = {"use_jit": True}
+    tdt = np.float64 if jax.config.jax_enable_x64 else np.float32
+
+    def wrapped(t, y):
+        if in_trace():
+            return raw(t, y)
+        if state["use_jit"]:
+            try:
+                return jraw(np.asarray(t, dtype=tdt), y)
+            except (
+                jax.errors.TracerArrayConversionError,
+                jax.errors.TracerBoolConversionError,
+                jax.errors.TracerIntegerConversionError,
+                jax.errors.ConcretizationTypeError,
+            ):
+                state["use_jit"] = False
+        return raw(t, y)
+
+    # identity anchor for the step-core cache: repeated solves over the
+    # SAME user RHS (warm-up solve then timed solve) must reuse the same
+    # compiled core even though each solve_ivp builds a fresh wrapper
+    wrapped._cache_key = (fun, tuple(args))
     return wrapped
 
 
@@ -84,18 +120,33 @@ def validate_tol(rtol, atol, n):
     return rtol, atol
 
 
+def _axpy_jit(y, a, f):
+    return y + a * f
+
+
+_axpy = jax.jit(_axpy_jit)
+
+
 def select_initial_step(fun, t0, y0, f0, direction, order, rtol, atol):
-    """Empirical first-step selection (Hairer et al., as in scipy)."""
+    """Empirical first-step selection (Hairer et al., as in scipy).
+
+    The y1 probe runs through a jitted axpy: experimental accelerator
+    backends (the axon tunnel) only reliably execute COMPILED programs,
+    and this is the one eager device op in the solver setup path. The
+    step scalar is passed as a numpy value so h0 changes don't retrace.
+    """
     if y0.shape[0] == 0:
         return np.inf
-    scale = atol + np.abs(np.asarray(y0)) * rtol
-    d0 = float(np.linalg.norm(np.asarray(y0) / scale) / np.sqrt(y0.shape[0]))
-    d1 = float(np.linalg.norm(np.asarray(f0) / scale) / np.sqrt(y0.shape[0]))
+    y0_h = np.asarray(y0)
+    f0_h = np.asarray(f0)
+    scale = atol + np.abs(y0_h) * rtol
+    d0 = float(np.linalg.norm(y0_h / scale) / np.sqrt(y0.shape[0]))
+    d1 = float(np.linalg.norm(f0_h / scale) / np.sqrt(y0.shape[0]))
     h0 = 1e-6 if d0 < 1e-5 or d1 < 1e-5 else 0.01 * d0 / d1
-    y1 = y0 + h0 * direction * f0
+    y1 = _axpy(y0, np.asarray(h0 * direction, dtype=f0_h.real.dtype), f0)
     f1 = fun(t0 + h0 * direction, y1)
     d2 = (
-        float(np.linalg.norm(np.asarray(f1 - f0) / scale) / np.sqrt(y0.shape[0]))
+        float(np.linalg.norm((np.asarray(f1) - f0_h) / scale) / np.sqrt(y0.shape[0]))
         / h0
     )
     if d1 <= 1e-15 and d2 <= 1e-15:
@@ -219,7 +270,32 @@ class RungeKutta(OdeSolver):
         self._step_core = self._build_step_core()
 
     # -- the fused, jitted step attempt (RK_CALC_DY analog) ----------------
+    _STEP_CORE_CACHE: dict = {}
+
     def _build_step_core(self):
+        # reuse the compiled core across solver instances for the same
+        # (user fun, shapes, dtype, tolerances): a warm-up solve then
+        # pays the trace/compile ONCE even without a persistent disk
+        # cache — fresh jax.jit instances never share compilations
+        ukey = getattr(self.fun, "_cache_key", None)
+        ckey = None
+        if ukey is not None:
+            ckey = (
+                type(self), ukey, self.y.shape, str(self.y.dtype),
+                float(self.rtol), np.asarray(self.atol).tobytes(),
+            )
+            cached = RungeKutta._STEP_CORE_CACHE.get(ckey)
+            if cached is not None:
+                return cached
+        core = self._build_step_core_uncached()
+        if ckey is not None:
+            cache = RungeKutta._STEP_CORE_CACHE
+            if len(cache) > 32:  # bound: long test sessions, many RHSs
+                cache.pop(next(iter(cache)))
+            cache[ckey] = core
+        return core
+
+    def _build_step_core_uncached(self):
         A = self.A
         B = jnp.asarray(self.B)
         C = self.C
@@ -365,7 +441,7 @@ class DOP853(RungeKutta):
     C_EXTRA = dop853_coefficients.C[n_stages + 1 :]
     E = None  # error handled by the 5-3 pair below
 
-    def _build_step_core(self):
+    def _build_step_core_uncached(self):
         A = self.A
         B = jnp.asarray(self.B)
         C = self.C

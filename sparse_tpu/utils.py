@@ -108,10 +108,18 @@ def in_trace() -> bool:
 
     Inside a trace, ops on even CONCRETE arrays return tracers, so code
     that needs a host sync (layout detection, shape materialization)
-    must skip rather than raise TracerArrayConversionError. A scalar
-    sentinel op is the version-stable way to ask.
+    must skip rather than raise TracerArrayConversionError. MUST NOT
+    execute a device op itself: it is called eagerly on hot paths, and
+    experimental backends (the axon tunnel) reject some tiny eager ops.
     """
     import jax
-    import jax.numpy as jnp
 
-    return isinstance(jnp.zeros((), jnp.int32) + 0, jax.core.Tracer)
+    try:
+        from jax._src.core import trace_state_clean
+
+        return not trace_state_clean()
+    except ImportError:  # future jax: fall back to a CPU-pinned sentinel
+        import jax.numpy as jnp
+
+        with jax.default_device(jax.local_devices(backend="cpu")[0]):
+            return isinstance(jnp.zeros((), jnp.int32) + 0, jax.core.Tracer)
