@@ -495,7 +495,7 @@ GMG_BASELINE_ITERS_PER_S = 37.2  # reference: 4500^2/GPU V-cycle CG, 1x V100
 GMG_BASELINE_N = 4500
 
 
-def _run_example(script: str, attempts, timeout_s: int):
+def _run_example(script: str, attempts, timeout_s: int, keep_trying=False):
     """Run an example script as a subprocess for each arg-list in
     ``attempts`` until one yields an "Iterations / sec" line; returns
     (value, attempt_index) or None. Shared scaffold for the GMG and
@@ -504,11 +504,17 @@ def _run_example(script: str, attempts, timeout_s: int):
     ``timeout_s`` is a TOTAL deadline across all attempts, not per
     attempt — two sequential timed-out attempts must not overshoot the
     caller's remaining budget (observed: GMG 4500 then 2000, each given
-    the full window, blew ~190s past BENCH_BUDGET_S)."""
+    the full window, blew ~190s past BENCH_BUDGET_S).
+
+    ``keep_trying``: attempts are ordered cheap -> impressive; bank the
+    first success and keep upgrading while budget remains (the quantum
+    row's 1e5-state shape repeatedly starved its own fallbacks when
+    tried first)."""
     import re
 
     deadline = time.monotonic() + timeout_s
     here = os.path.dirname(os.path.abspath(__file__))
+    got = None
     for i, args in enumerate(attempts):
         left = deadline - time.monotonic()
         if left < 60:
@@ -533,8 +539,10 @@ def _run_example(script: str, attempts, timeout_s: int):
             continue
         m = re.search(r"Iterations / sec: ([0-9.]+)", proc.stdout)
         if m:
-            return float(m.group(1)), i
-    return None
+            got = (float(m.group(1)), i)
+            if not keep_trying:
+                return got
+    return got
 
 
 def _try_gmg(timeout_s: int = 600):
@@ -542,11 +550,12 @@ def _try_gmg(timeout_s: int = 600):
     AFTER the headline worker exits (sequential TPU clients — the tunnel
     serves one process at a time). Falls back to a smaller grid; baseline
     comparison is row-normalized like run_size."""
-    # 4000 fits a generous window (native-SpGEMM init ~210 s + warm
-    # solve); 2000 (~110 s end-to-end) banks a row otherwise. The
-    # reference's 4500 shape needs an oddly-sized hierarchy the init
-    # cost doesn't justify in-budget; vs_baseline is row-normalized.
-    sizes = ((4000, 6), (2000, 5))
+    # cheap -> impressive with keep_trying: bank 2000 (~110 s end-to-end
+    # warm), upgrade to 4000 (native-SpGEMM init ~210 s + warm solve)
+    # when the window allows. The reference's 4500 shape needs an
+    # oddly-sized hierarchy the init cost doesn't justify in-budget;
+    # vs_baseline is row-normalized.
+    sizes = ((2000, 5), (4000, 6))
     if os.environ.get("BENCH_GMG_SIZES"):  # test hook: "n:levels,n:levels"
         sizes = tuple(
             (int(a), int(b))
@@ -562,6 +571,7 @@ def _try_gmg(timeout_s: int = 600):
             for n, lv in sizes
         ],
         timeout_s,
+        keep_trying=True,
     )
     if got is None:
         return None
@@ -584,14 +594,16 @@ def _try_quantum(timeout_s: int = 420):
     replicate; the metric documents our absolute throughput on the
     ER-graph analog (examples/quantum_evolution.py)."""
     attempts = (
-        # the >=1e5-state scale shape first (cycle_graph(25): 167,761
-        # independent sets, VERDICT r2 #10), then the ER fallbacks
-        ["-graph", "cycle", "-nodes", "25", "-t", "0.05"],
-        ["-nodes", "20", "-t", "1.0"],
-        ["-nodes", "16", "-t", "1.0"],
+        # cheap -> impressive with keep_trying: bank the ER-16 row
+        # (~60 s warm), then upgrade to the >=1e5-state scale shape
+        # (cycle_graph(25): 167,761 independent sets, VERDICT r2 #10)
+        ["-nodes", "16", "-t", "1.0", "--precision", "f32"],
+        ["-graph", "cycle", "-nodes", "25", "-t", "0.05", "--precision", "f32"],
     )
-    labels = ("cycle25", "nodes20", "nodes16")
-    got = _run_example("quantum_evolution.py", list(attempts), timeout_s)
+    labels = ("nodes16", "cycle25")
+    got = _run_example(
+        "quantum_evolution.py", list(attempts), timeout_s, keep_trying=True
+    )
     if got is None:
         return None
     v, i = got

@@ -23,7 +23,9 @@ from .coverage import track_provenance
 from .config import settings
 from .ops import conv, elementwise, sddmm as sddmm_ops, spgemm as spgemm_ops, spmv as spmv_ops
 from .ops.coords import expand_rows
-from .utils import asjnp, host_int, in_trace, user_warning
+from .utils import (
+    asjnp, commit_to_exec_device, host_int, host_scope, in_trace, user_warning,
+)
 
 
 @jax.tree_util.register_pytree_node_class
@@ -115,11 +117,12 @@ class csr_array(SparseArray):
     def _ell_width(self) -> int | None:
         """Max row length; host-synced once and cached (None: unknowable)."""
         if not hasattr(self, "_ell_width_cache") or self._ell_width_cache is None:
-            counts = self.indptr[1:] - self.indptr[:-1]
             try:
-                self._ell_width_cache = (
-                    host_int(counts.max()) if self.shape[0] else 0
-                )
+                with host_scope():  # never eager-dispatch via a tunnel
+                    counts = self.indptr[1:] - self.indptr[:-1]
+                    self._ell_width_cache = (
+                        host_int(counts.max()) if self.shape[0] else 0
+                    )
             except jax.errors.JaxRuntimeError:
                 # backend can't execute/fetch (see _maybe_dia): fall back
                 # to a host-side count from the (plain-buffer) indptr; if
@@ -151,9 +154,10 @@ class csr_array(SparseArray):
         mean = max(self.nnz / m, 1.0)
         if mode in ("ell", "pallas") or k <= settings.ell_max_ratio * mean:
             if self._ell is None:
-                self._ell = conv.csr_to_ell(
-                    self.indptr, self.indices, self.data, m, max(k, 1)
-                )
+                with host_scope():  # one-time layout build, not via tunnel
+                    self._ell = conv.csr_to_ell(
+                        self.indptr, self.indices, self.data, m, max(k, 1)
+                    )
             return self._ell
         return None
 
@@ -239,6 +243,10 @@ class csr_array(SparseArray):
         nnz = self.nnz
         if nnz == 0:
             return None
+        with host_scope():  # one-time eager analysis: never via a tunnel
+            return self._maybe_dia_detect(m, n, nnz)
+
+    def _maybe_dia_detect(self, m, n, nnz):
         rows = expand_rows(self.indptr, nnz)
         # bounded-size unique: >max_diags distinct offsets still yields
         # max_diags+1 values, which the gate below rejects
@@ -270,6 +278,15 @@ class csr_array(SparseArray):
         if mode in ("auto", "pallas"):
             dia = self._maybe_dia()
             if dia is not None:
+                if not in_trace():
+                    # layouts are BUILT under host_scope; on accelerator
+                    # hot paths commit them to the execution device once
+                    # (they are jit arguments — CPU-resident planes would
+                    # re-transfer per matvec) and re-cache
+                    planes = commit_to_exec_device((dia[0],))[0]
+                    if planes is not dia[0]:
+                        dia = (planes, dia[1])
+                        self._dia = dia
                 if mode == "pallas":
                     from .kernels.dia_spmv import cached_prepared_spmv
 
@@ -283,6 +300,10 @@ class csr_array(SparseArray):
                 return dia_spmv_xla(dia[0], dia[1], x, self.shape)
         ell = self._maybe_ell()
         if ell is not None:
+            if not in_trace():
+                ell2 = commit_to_exec_device(ell)
+                if ell2[0] is not ell[0]:
+                    ell = self._ell = ell2
             # spmv_mode='pallas' accelerates DIA-profiled matrices only
             # (kernels/dia_spmv above). A Pallas ELL kernel needs a
             # windowed in-VMEM gather, which Mosaic cannot lower yet

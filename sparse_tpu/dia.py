@@ -104,12 +104,74 @@ class dia_array(SparseArray):
         out._duplicate_free = True
         return out
 
+    def _direct_parts(self, by_row: bool):
+        """Sort-FREE host conversion to CSR (by_row) or CSC parts.
+
+        DIA is already ordered: within a row, entries at ascending
+        offsets have ascending columns (col = row + offset); within a
+        column, entries at DESCENDING offsets have ascending rows
+        (row = col - offset). So both compressed forms fall out of a
+        masked transpose — no 20M-entry sort (the COO route cost 35 s
+        at 2000^2 on the CPU backend; this is milliseconds). Matches
+        the reference's vectorized conversion (dia.py:222-249) in
+        spirit, minus its sort. Returns (indptr, indices, data) numpy.
+        """
+        from .types import index_dtype_for
+
+        m, n = self.shape
+        data = np.asarray(self.data)
+        offsets = np.asarray(self.offsets)
+        nd, L = data.shape
+        if by_row:
+            order = np.argsort(offsets, kind="stable")
+            d = offsets[order][:, None]                  # [D, 1]
+            i = np.arange(m)[None, :]                    # [1, m]
+            pos = i + d                                  # columns; also the
+            lines = m                                    # data column index
+        else:
+            order = np.argsort(-offsets, kind="stable")
+            d = offsets[order][:, None]
+            j = np.arange(n)[None, :]
+            pos = j - d                                  # rows
+            lines = n
+        # value source: data[k, column]; column is pos (by_row) or j (csc)
+        src = pos if by_row else np.broadcast_to(
+            np.arange(n)[None, :], pos.shape
+        )
+        valid = (pos >= 0) & (pos < (n if by_row else m)) & (src < L)
+        gathered = np.take_along_axis(
+            data[order], np.clip(src, 0, max(L - 1, 0)), axis=1
+        )
+        valid &= gathered != 0
+        validT = valid.T                                 # [lines, D]
+        indices = pos.T[validT]
+        vals = gathered.T[validT]
+        idt = index_dtype_for(self.shape, len(vals))
+        counts = valid.sum(axis=0)  # one count per line (row/column)
+        indptr = np.zeros(lines + 1, dtype=idt)
+        indptr[1:] = np.cumsum(counts).astype(idt)
+        return indptr, indices.astype(idt), vals
+
     def tocsr(self):
-        return self.tocoo().tocsr()
+        from .utils import in_trace
+
+        if in_trace():
+            return self.tocoo().tocsr()
+        from .csr import csr_array
+
+        indptr, indices, vals = self._direct_parts(by_row=True)
+        return csr_array.from_parts(vals, indices, indptr, self.shape)
 
     def tocsc(self):
-        """Reference fast path dia.py:222-249; one fused sort here."""
-        return self.tocoo().tocsc()
+        """Reference fast path dia.py:222-249 — here fully sort-free."""
+        from .utils import in_trace
+
+        if in_trace():
+            return self.tocoo().tocsc()
+        from .csc import csc_array
+
+        indptr, indices, vals = self._direct_parts(by_row=False)
+        return csc_array.from_parts(vals, indices, indptr, self.shape)
 
     def todia(self):
         return self

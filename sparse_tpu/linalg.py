@@ -420,6 +420,22 @@ def _try_fused_cg(A, b, x0, tol, maxiter, conv_test_iters):
 
     from .kernels.cg_dia import cg_dia_fused
 
+    # RESIDENCY: the planes are jit ARGUMENTS of the fused kernel, so a
+    # host-resident layout (matrices built in a CPU-scoped construction
+    # phase) would re-transfer the whole matrix through the accelerator
+    # link on EVERY chunk (~720 MB at 6000^2 — measured as a 10x
+    # slowdown through the tunnel). Commit once; cache back on the csr
+    # so later solves skip even that. device_put is a no-op when the
+    # array is already resident.
+    dev = jax.devices()[0]
+    if dev.platform != "cpu":
+        planes = jax.device_put(planes, dev)
+        if getattr(A, "_dia", None):
+            A._dia = (planes, offsets)
+        elif isinstance(A, dia_array):
+            A.data = planes  # dia storage IS the planes: commit in place
+        b = jax.device_put(b, dev)
+
     tol2 = float(tol) ** 2
     chunk = max(int(conv_test_iters), 1)
     state = None

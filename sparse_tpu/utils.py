@@ -123,3 +123,43 @@ def in_trace() -> bool:
 
         with jax.default_device(jax.local_devices(backend="cpu")[0]):
             return isinstance(jnp.zeros((), jnp.int32) + 0, jax.core.Tracer)
+
+
+def host_scope():
+    """Context manager: run eager array work on the CPU backend.
+
+    Layout detection and other one-time eager analyses must not be
+    dispatched op-by-op through a remote accelerator backend (the axon
+    tunnel crashes its worker on large eager slices). Under this scope
+    UNCOMMITTED arrays (host-built constructions) compute on the local
+    CPU; arrays already committed to an accelerator keep their device,
+    so no silent device->host bulk transfers are introduced.
+    """
+    import contextlib
+
+    import jax
+
+    try:
+        return jax.default_device(jax.local_devices(backend="cpu")[0])
+    except RuntimeError:  # no cpu backend (never expected, but degrade)
+        return contextlib.nullcontext()
+
+
+def commit_to_exec_device(arrs):
+    """Commit a tuple of arrays to the ACTIVE execution device.
+
+    Layout caches (DIA planes, ELL index/data planes) are built under
+    :func:`host_scope`; if the hot path then passes them as jit
+    ARGUMENTS on an accelerator, every call re-ships them through the
+    device link (~720 MB per matvec at 6000^2 over the tunnel). The
+    active device is the current ``jax.default_device`` scope if set
+    (so CPU-scoped build phases keep their arrays local), else the
+    backend's first device. On a CPU target this is a no-op; so is
+    re-committing already-resident arrays.
+    """
+    import jax
+
+    target = jax.config.jax_default_device or jax.devices()[0]
+    if getattr(target, "platform", "cpu") == "cpu":
+        return arrs
+    return tuple(jax.device_put(a, target) for a in arrs)
