@@ -111,6 +111,42 @@ def _esc_sharded(
     )(ipA, ixA, dvA, ipB, ixB, dvB)
 
 
+@partial(jax.jit, static_argnames=("m", "Tout"))
+def _stitch_tiles(urows, ucols, uvals, nuniques, splits_dev, *, m, Tout):
+    """Pack S padded ESC tiles into one canonical CSR, on device.
+
+    Tile s's first ``nuniques[s]`` slots are valid, already sorted by
+    (local row, col); shard-major flattening therefore preserves global
+    (row, col) order because shards own disjoint ascending row blocks.
+    Scatter positions come from one exclusive scan of the valid mask;
+    indptr from a segment count over global rows.
+    """
+    S, Pp = urows.shape
+    cdt = splits_dev.dtype  # caller-chosen index width (no-x64 safe)
+    valid = jnp.arange(Pp, dtype=jnp.int32)[None, :] < nuniques[:, None]
+    grows = urows.astype(cdt) + splits_dev[:S, None]
+    flat_valid = valid.reshape(-1)
+    # scatter target: pos-scan slot for valid entries; invalid slots all
+    # land on the sacrificial Tout slot, trimmed below
+    pos = jnp.cumsum(flat_valid.astype(cdt)) - 1
+    tgt = jnp.where(flat_valid, pos, Tout)
+    out_ix = jnp.zeros(Tout + 1, dtype=ucols.dtype).at[tgt].set(
+        ucols.reshape(-1)
+    )[:Tout]
+    out_dv = jnp.zeros(Tout + 1, dtype=uvals.dtype).at[tgt].set(
+        uvals.reshape(-1)
+    )[:Tout]
+    row_counts = jax.ops.segment_sum(
+        flat_valid.astype(cdt),
+        jnp.where(flat_valid, grows.reshape(-1), m).astype(cdt),
+        num_segments=m + 1,
+    )[:m]
+    out_ip = jnp.concatenate(
+        [jnp.zeros((1,), cdt), jnp.cumsum(row_counts)]
+    )
+    return out_ip, out_ix, out_dv
+
+
 def dist_spgemm(A, B, mesh=None, balanced: bool = True):
     """C = A @ B (both ``csr_array``) with A row-split over the mesh.
 
@@ -243,32 +279,37 @@ def dist_spgemm(A, B, mesh=None, balanced: bool = True):
         m_real=rows_real,
     )
 
-    # Host pos-scan stitch (scan_local_results_and_scale_pos analog).
-    urows = np.asarray(urows)
-    ucols = np.asarray(ucols)
-    uvals = np.asarray(uvals)
-    nuniques = np.asarray(nuniques)
-    out_indptr = np.zeros(m + 1, dtype=np.int64)
-    parts_ix, parts_dv = [], []
-    offset = 0
-    for s in range(S):
-        r0, r1 = int(splits[s]), int(splits[s + 1])
-        nu = int(nuniques[s])
-        lrows = urows[s, :nu]
-        lcols = ucols[s, :nu]
-        counts = np.bincount(lrows, minlength=rows_pad)[: r1 - r0]
-        out_indptr[r0 + 1 : r1 + 1] = np.cumsum(counts) + offset
-        offset += nu
-        parts_ix.append(lcols)
-        parts_dv.append(uvals[s, :nu])
-    out_indices = (
-        np.concatenate(parts_ix) if parts_ix else np.zeros(0, dtype=np.int64)
-    )
-    out_data = (
-        np.concatenate(parts_dv) if parts_dv else np.zeros(0, dtype=dt)
+    # DEVICE-side stitch (scan_local_results_and_scale_pos analog): the
+    # host reads only the S tile counts — the reference's O(S) future
+    # scan — while the O(nnz) compaction (masked scatter into pos-scan
+    # slots + per-row counts) runs as one compiled program. The packed
+    # output stays device-resident for downstream mesh ops.
+    counts_host = np.asarray(nuniques)          # O(S) host fetch
+    total = int(counts_host.sum())
+    if total == 0:
+        return sparse_tpu.csr_array.from_parts(
+            np.zeros(0, dtype=dt), np.zeros(0, dtype=np.int32),
+            np.zeros(m + 1, dtype=np.int32), (m, n),
+        )
+    Tout = _next_pow2(total)  # pow-2 bucket: bounded retrace count
+    # index width for the scans: int32 unless the problem genuinely needs
+    # more (raise-loudly per-dimension policy; int64 requires x64). The
+    # scatter bound is Tout (pow-2 >= total) and the sentinel segment id
+    # is m, so BOTH must fit the chosen width.
+    if max(Tout, m + 1) < 2**31:
+        sdt = np.int32
+    elif jax.config.jax_enable_x64:
+        sdt = np.int64
+    else:
+        raise ValueError(
+            "dist_spgemm output exceeds int32 indexing; enable x64"
+        )
+    splits_dev = jnp.asarray(np.asarray(splits, dtype=sdt))
+    out_ip, out_ix, out_dv = _stitch_tiles(
+        urows, ucols, uvals, nuniques, splits_dev, m=m, Tout=Tout
     )
     return sparse_tpu.csr_array.from_parts(
-        out_data, out_indices, out_indptr, (m, n)
+        out_dv[:total], out_ix[:total], out_ip, (m, n)
     )
 
 
