@@ -610,6 +610,23 @@ def _try_quantum(timeout_s: int = 420):
     return {f"quantum_iters_per_s_{labels[i]}": v}
 
 
+def _try_amg(timeout_s: int = 420):
+    """Run the AMG example (the reference's north-star workload; no
+    single-chip baseline row exists in BASELINE.md, so the metric is
+    absolute like the quantum row). cheap -> impressive with
+    keep_trying; hierarchy setup is CPU-phase (native Gustavson)."""
+    attempts = (
+        ["-n", "256", "-maxiter", "100", "--precision", "f32"],
+        ["-n", "512", "-maxiter", "100", "--precision", "f32"],
+    )
+    labels = ("n256", "n512")
+    got = _run_example("amg.py", list(attempts), timeout_s, keep_trying=True)
+    if got is None:
+        return None
+    v, i = got
+    return {f"amg_iters_per_s_{labels[i]}": v}
+
+
 def _try_platform(platform_arg: str, timeout_s: int):
     """Run a worker subprocess; return its parsed JSON line or None."""
     stdout, stderr, rc = "", "", None
@@ -753,6 +770,13 @@ def main():
                     q = _try_quantum(timeout_s=int(max(90, remaining() - 30)))
                     if q:
                         rec.update(q)
+                except Exception:
+                    traceback.print_exc(file=sys.stderr)
+            if remaining() > 150:
+                try:  # AMG north-star row — best-effort, never fatal
+                    amg = _try_amg(timeout_s=int(max(90, remaining() - 30)))
+                    if amg:
+                        rec.update(amg)
                 except Exception:
                     traceback.print_exc(file=sys.stderr)
     except Exception:

@@ -185,7 +185,7 @@ def dist_spgemm(A, B, mesh=None, balanced: bool = True):
         return sparse_tpu.csr_array.from_parts(
             np.zeros(0, dtype=dt),
             np.zeros(0, dtype=np.int32),
-            np.zeros(m + 1, dtype=np.int64),
+            np.zeros(m + 1, dtype=np.int32),
             (m, n),
         )
 
@@ -305,8 +305,17 @@ def dist_spgemm(A, B, mesh=None, balanced: bool = True):
             "dist_spgemm output exceeds int32 indexing; enable x64"
         )
     splits_dev = jnp.asarray(np.asarray(splits, dtype=sdt))
+    # land the sharded tiles on ONE device first: jitting directly over
+    # the mesh-sharded inputs makes GSPMD distribute the pos-scan as
+    # cross-device cumsum collectives — 64-participant rendezvous chains
+    # that abort under load on virtual CPU meshes (and buy nothing: the
+    # packed CSR is a single logical array either way). An explicit
+    # device_put is a plain device-to-device copy, no collectives.
+    d0 = mesh.devices.flat[0]
     out_ip, out_ix, out_dv = _stitch_tiles(
-        urows, ucols, uvals, nuniques, splits_dev, m=m, Tout=Tout
+        jax.device_put(urows, d0), jax.device_put(ucols, d0),
+        jax.device_put(uvals, d0), jax.device_put(nuniques, d0),
+        splits_dev, m=m, Tout=Tout,
     )
     return sparse_tpu.csr_array.from_parts(
         out_dv[:total], out_ix[:total], out_ip, (m, n)
