@@ -14,8 +14,9 @@ setup-phase op here exactly as in the reference (which blocks on nnz futures
 at csr.py:996 and scans pos on the control thread). Each tile is computed by
 the single-device ESC kernel (``ops.spgemm``) ON ITS OWN DEVICE of the mesh
 — per-shard inputs are committed to device s, so XLA dispatches the tile
-programs concurrently across the mesh — and the host performs the pos-scan
-stitch. The solver-facing hot path stays in ``parallel.dist`` (static-shape
+programs concurrently across the mesh — and one compiled compaction performs
+the pos-scan stitch (host reads only the S tile counts).
+The solver-facing hot path stays in ``parallel.dist`` (static-shape
 SPMD); this module is how distributed hierarchies (AMG's Galerkin R@A@P)
 get BUILT.
 """
@@ -156,8 +157,9 @@ def dist_spgemm(A, B, mesh=None, balanced: bool = True):
     partition of reference csr.py:1447-1465) — per-shard B memory scales
     as nnz(B)/S for banded operators, never as nnz(B). All S tiles are
     padded to one bucket shape and launched as a single shard_map
-    program, then the host stitches tiles with one pos scan. Returns a
-    ``csr_array``.
+    program, then ONE compiled compaction packs the tiles into canonical
+    CSR (the host reads only the S tile counts — the reference's O(S)
+    future scan, csr.py:827-859). Returns a ``csr_array``.
     """
     import sparse_tpu
 
