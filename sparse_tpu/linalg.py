@@ -435,6 +435,8 @@ def _try_fused_cg(A, b, x0, tol, maxiter, conv_test_iters):
         elif isinstance(A, dia_array):
             A.data = planes  # dia storage IS the planes: commit in place
         b = jax.device_put(b, dev)
+        if x0 is not None:
+            x0 = jax.device_put(x0, dev)
 
     tol2 = float(tol) ** 2
     chunk = max(int(conv_test_iters), 1)
