@@ -368,3 +368,23 @@ def test_cg_with_traceable_preconditioner_stays_on_device_loop(monkeypatch):
     assert called["host"] == 0, "preconditioned CG fell back to the host loop"
     resid = np.linalg.norm(np.asarray(A @ x) - b)
     assert resid < 1e-4
+
+
+def test_host_scope_and_commit_helpers():
+    """host_scope keeps eager analysis on the CPU backend; on a CPU
+    target commit_to_exec_device is an identity (no copies)."""
+    import jax
+    import jax.numpy as jnp
+
+    from sparse_tpu.utils import commit_to_exec_device, host_scope, in_trace
+
+    with host_scope():
+        a = jnp.arange(8) * 2
+    assert next(iter(a.sharding.device_set)).platform == "cpu"
+    arrs = (jnp.arange(4), jnp.ones(3))
+    out = commit_to_exec_device(arrs)
+    assert out[0] is arrs[0] and out[1] is arrs[1]  # cpu target: no-op
+    assert not in_trace()
+    flags = []
+    jax.jit(lambda x: (flags.append(in_trace()), x)[1])(1.0)
+    assert flags == [True]
