@@ -39,6 +39,65 @@ BASELINE_ITERS_PER_S = 75.9  # reference: 1x V100, 6000^2, f64 (BASELINE.md)
 BASELINE_N = 6000
 ITERS = 300
 
+# -- committed hardware-evidence log (VERDICT r3 #4) ------------------------
+# Mirrors the reference's results/summit/*.out verbatim-output convention:
+# every successful hardware measurement appends a JSON record (and, for
+# example scripts, the verbatim stdout) under results/axon/. When the tunnel
+# is wedged at capture time, main() emits the freshest logged TPU record
+# clearly labeled {"source": "session-log", "age_s": N} so the round
+# artifact carries a hardware-derived number without misrepresenting
+# liveness.
+HERE = os.path.dirname(os.path.abspath(__file__))
+RESULTS_DIR = os.path.join(HERE, "results", "axon")
+RECORDS_PATH = os.path.join(RESULTS_DIR, "records.jsonl")
+
+
+def _log_hw_record(rec: dict) -> None:
+    """Append one hardware measurement record to the committed session log."""
+    try:
+        os.makedirs(RESULTS_DIR, exist_ok=True)
+        entry = dict(rec)
+        entry["ts"] = time.time()
+        entry["iso"] = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+        with open(RECORDS_PATH, "a") as f:
+            f.write(json.dumps(entry) + "\n")
+    except Exception:
+        traceback.print_exc(file=sys.stderr)
+
+
+def _log_hw_text(name: str, text: str) -> None:
+    """Save an example script's verbatim stdout (the reference's .out style)."""
+    try:
+        os.makedirs(RESULTS_DIR, exist_ok=True)
+        stamp = time.strftime("%Y%m%dT%H%M%SZ", time.gmtime())
+        with open(os.path.join(RESULTS_DIR, f"{stamp}_{name}.out"), "w") as f:
+            f.write(text)
+    except Exception:
+        traceback.print_exc(file=sys.stderr)
+
+
+def _freshest_session_record():
+    """Newest logged TPU record from records.jsonl, or None."""
+    try:
+        with open(RECORDS_PATH) as f:
+            lines = f.read().splitlines()
+    except OSError:
+        return None
+    best = None
+    for line in lines:
+        try:
+            r = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        if (
+            isinstance(r.get("metric"), str)
+            and "_tpu" in r["metric"]
+            and isinstance(r.get("ts"), (int, float))
+        ):
+            if best is None or r["ts"] > best["ts"]:
+                best = r
+    return best
+
 
 def _sync(out):
     """Force real completion: fetch a scalar from the result.
@@ -495,10 +554,13 @@ GMG_BASELINE_ITERS_PER_S = 37.2  # reference: 4500^2/GPU V-cycle CG, 1x V100
 GMG_BASELINE_N = 4500
 
 
-def _run_example(script: str, attempts, timeout_s: int, keep_trying=False):
+def _run_example(script: str, attempts, timeout_s: int, keep_trying=False,
+                 log_name=None):
     """Run an example script as a subprocess for each arg-list in
     ``attempts`` until one yields an "Iterations / sec" line; returns
-    (value, attempt_index) or None. Shared scaffold for the GMG and
+    (value, attempt_index, mean_value_or_None) or None — the third slot
+    carries the "Iterations / sec (mean):" line when the script prints
+    one (the GMG dual-estimator row). Shared scaffold for the GMG and
     quantum bench rows.
 
     ``timeout_s`` is a TOTAL deadline across all attempts, not per
@@ -539,7 +601,13 @@ def _run_example(script: str, attempts, timeout_s: int, keep_trying=False):
             continue
         m = re.search(r"Iterations / sec: ([0-9.]+)", proc.stdout)
         if m:
-            got = (float(m.group(1)), i)
+            if log_name is not None:  # verbatim evidence (results/axon/*.out)
+                _log_hw_text(
+                    f"{log_name}_{'_'.join(a.lstrip('-') for a in args[:4])}",
+                    proc.stdout,
+                )
+            mm = re.search(r"Iterations / sec \(mean\): ([0-9.]+)", proc.stdout)
+            got = (float(m.group(1)), i, float(mm.group(1)) if mm else None)
             if not keep_trying:
                 return got
     return got
@@ -572,18 +640,29 @@ def _try_gmg(timeout_s: int = 600):
         ],
         timeout_s,
         keep_trying=True,
+        log_name="gmg",
     )
     if got is None:
         return None
-    v, i = got
+    v, i, v_mean = got
     n = sizes[i][0]
     vs = (v * n * n) / (
         GMG_BASELINE_ITERS_PER_S * GMG_BASELINE_N * GMG_BASELINE_N
     )
-    return {
+    out = {
         f"gmg_iters_per_s_n{n}": round(v, 2),
         "gmg_vs_baseline": round(vs, 3),
     }
+    if v_mean is not None:
+        # same-estimator comparison (the reference baseline is a mean):
+        # recorded alongside the min-of-2 machine-capability headline
+        out[f"gmg_iters_per_s_n{n}_mean"] = round(v_mean, 2)
+        out["gmg_vs_baseline_mean"] = round(
+            (v_mean * n * n)
+            / (GMG_BASELINE_ITERS_PER_S * GMG_BASELINE_N * GMG_BASELINE_N),
+            3,
+        )
+    return out
 
 
 def _try_quantum(timeout_s: int = 420):
@@ -602,11 +681,12 @@ def _try_quantum(timeout_s: int = 420):
     )
     labels = ("nodes16", "cycle25")
     got = _run_example(
-        "quantum_evolution.py", list(attempts), timeout_s, keep_trying=True
+        "quantum_evolution.py", list(attempts), timeout_s, keep_trying=True,
+        log_name="quantum",
     )
     if got is None:
         return None
-    v, i = got
+    v, i, _ = got
     return {f"quantum_iters_per_s_{labels[i]}": v}
 
 
@@ -620,10 +700,12 @@ def _try_amg(timeout_s: int = 420):
         ["-n", "512", "-maxiter", "100", "--precision", "f32"],
     )
     labels = ("n256", "n512")
-    got = _run_example("amg.py", list(attempts), timeout_s, keep_trying=True)
+    got = _run_example(
+        "amg.py", list(attempts), timeout_s, keep_trying=True, log_name="amg"
+    )
     if got is None:
         return None
-    v, i = got
+    v, i, _ = got
     return {f"amg_iters_per_s_{labels[i]}": v}
 
 
@@ -721,6 +803,7 @@ def main():
         return budget_s - (time.monotonic() - t_start)
 
     rec = None
+    status = "dead"
     try:
         # the probe (~120s watchdog) decides whether the TPU attempt may
         # run at all — a wedged backend init can no longer burn the whole
@@ -782,6 +865,55 @@ def main():
     except Exception:
         traceback.print_exc(file=sys.stderr)
     finally:
+        if rec is not None and "_tpu" in rec.get("metric", ""):
+            # live hardware measurement: append to the committed evidence
+            # log so later wedged-tunnel runs can still surface it
+            rec["source"] = "live"
+            _log_hw_record(rec)
+        else:
+            # tunnel wedged at capture time: surface the freshest LOGGED
+            # hardware record, clearly labeled as such, with the live
+            # fallback preserved alongside (VERDICT r3 #4). Stale numbers
+            # substitute ONLY for a wedged tunnel: a healthy cpu-only
+            # probe means this machine has no TPU, and a live tunnel with
+            # a failed worker means a code regression — both keep the
+            # live line. A passed-then-failed probe re-checks once to
+            # distinguish a mid-run wedge from a worker crash.
+            if status == "tpu":
+                # no budget to confirm a mid-run wedge -> don't substitute
+                status = _probe_tpu(min(60, remaining())) if remaining() > 20 else "tpu"
+            logged = (
+                _freshest_session_record()
+                if status == "dead" and "PALLAS_AXON_POOL_IPS" in os.environ
+                else None  # no tunnel configured / broken env: live line stands
+            )
+            try:
+                max_age = float(
+                    os.environ.get("BENCH_SESSION_LOG_MAX_AGE_S", "")
+                )
+            except ValueError:
+                max_age = 48 * 3600.0
+            if logged is not None:
+                age_s = time.time() - logged["ts"]
+                if age_s > max_age:
+                    print(
+                        f"bench: session-log record is {age_s:.0f}s old "
+                        "(> max age); keeping the live line",
+                        file=sys.stderr,
+                    )
+                    logged = None
+            if logged is not None:
+                live = rec
+                rec = {k: v for k, v in logged.items() if k != "iso"}
+                rec.pop("ts")
+                rec["source"] = "session-log"
+                rec["age_s"] = round(age_s)
+                if live is not None:
+                    rec["live_fallback"] = {
+                        "metric": live.get("metric"),
+                        "value": live.get("value"),
+                        "vs_baseline": live.get("vs_baseline"),
+                    }
         if rec is None:
             rec = {
                 "metric": "cg_iters_per_s_pde_none",

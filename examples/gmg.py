@@ -405,11 +405,19 @@ def main():
             iters = it[0]
         total_ms = timer.stop(fence=x)
         if use_tpu and callback is None:
+            mean_ms = (total_ms + first_ms) / 2.0
             total_ms = min(total_ms, first_ms)
-            # disclose the estimator: tunnel throughput swings up to 4x
-            # run-to-run; min-of-2 estimates machine capability (the
-            # reference baseline is a mean over 12 DEDICATED-node runs)
-            print("Timing: best of 2 timed solves")
+            # disclose BOTH estimators: tunnel throughput swings up to 4x
+            # run-to-run, so min-of-2 estimates machine capability while
+            # mean-of-2 is the comparable-estimator number (the reference
+            # baseline is a mean over 12 DEDICATED-node runs)
+            print(
+                f"Timing: 2 timed solves, min {total_ms:.1f} ms / "
+                f"mean {mean_ms:.1f} ms"
+            )
+            # stable parseable form — bench.py records this alongside the
+            # min-of-2 headline so the artifact carries both estimators
+            print(f"Iterations / sec (mean): {iters / (mean_ms / 1000.0):.3f}")
 
     resid = float(np.linalg.norm(np.asarray(A @ x) - b))
     print(f"Iterations: {iters}  residual: {resid:.3e}")

@@ -18,6 +18,8 @@ same control/device boundary the reference blocks on. Complex-valued systems
 
 from __future__ import annotations
 
+import numbers
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -99,7 +101,22 @@ def _wrap_fun(fun, args):
     # identity anchor for the step-core cache: repeated solves over the
     # SAME user RHS (warm-up solve then timed solve) must reuse the same
     # compiled core even though each solve_ivp builds a fresh wrapper
-    wrapped._cache_key = (fun, tuple(args))
+    # Only VALUE-typed args may key the cache. Anything with a mutable
+    # numeric payload (ndarray, jax array, sparse matrix — the common
+    # solve_ivp(f, span, y0, args=(A,)) pattern) must NOT: hashability is
+    # no safeguard (sparse matrices hash by identity), and an identity-
+    # keyed hit would silently serve a core with the OLD values baked in
+    # as trace constants after an in-place `A.data *= 2` between solves.
+    # Such solves retrace instead (scipy-parity cost, correctness first).
+    def value_typed(a):
+        if isinstance(a, (numbers.Number, str, bytes, type(None))):
+            return True
+        if isinstance(a, (tuple, frozenset)):
+            return all(value_typed(x) for x in a)
+        return False
+
+    if all(value_typed(a) for a in args):
+        wrapped._cache_key = (fun, tuple(args))
     return wrapped
 
 

@@ -128,3 +128,46 @@ def test_args_passing():
         lambda t, y, k: -k * y, (0, 1), [1.0], args=(2.0,), rtol=1e-9, atol=1e-11
     )
     np.testing.assert_allclose(np.asarray(out.y)[0, -1], np.exp(-2.0), rtol=1e-6)
+
+
+def test_args_unhashable():
+    """args containing ndarrays / sparse matrices (the common
+    solve_ivp(f, span, y0, args=(A,)) pattern) must not break the
+    step-core cache — identity-keyed fallback, not TypeError."""
+    import sparse_tpu
+
+    K = np.array([[0.0, 1.0], [-1.0, 0.0]])
+    out = integrate.solve_ivp(
+        lambda t, y, M: M @ y, (0, 1), [1.0, 0.0], args=(K,), rtol=1e-9, atol=1e-11
+    )
+    np.testing.assert_allclose(
+        np.asarray(out.y)[:, -1], [np.cos(1.0), -np.sin(1.0)], rtol=1e-6
+    )
+    # unhashable args are NOT step-core cached: in-place mutation of the
+    # arg between solves must be honored, not served from a stale trace
+    K *= 2.0  # rotation at double rate
+    out2 = integrate.solve_ivp(
+        lambda t, y, M: M @ y, (0, 1), [1.0, 0.0], args=(K,), rtol=1e-9, atol=1e-11
+    )
+    np.testing.assert_allclose(
+        np.asarray(out2.y)[:, -1], [np.cos(2.0), -np.sin(2.0)], rtol=1e-6
+    )
+    # sparse-matrix arg (hashes by identity, so it must be excluded from
+    # the cache by TYPE, not by hashability; list-args variant)
+    A = sparse_tpu.diags([[-1.0, -1.0]], [0]).tocsr()
+    rhs = lambda t, y, M: M @ y  # noqa: E731 — shared fn, distinct args
+    out3 = integrate.solve_ivp(
+        rhs, (0, 1), [1.0, 1.0], args=[A], rtol=1e-9, atol=1e-11
+    )
+    np.testing.assert_allclose(
+        np.asarray(out3.y)[:, -1], [np.exp(-1.0)] * 2, rtol=1e-6
+    )
+    # mutate the SAME matrix object in place: the solve must see the new
+    # values, not a cached trace with the old ones baked in
+    A.data = A.data * 2.0
+    out4 = integrate.solve_ivp(
+        rhs, (0, 1), [1.0, 1.0], args=[A], rtol=1e-9, atol=1e-11
+    )
+    np.testing.assert_allclose(
+        np.asarray(out4.y)[:, -1], [np.exp(-2.0)] * 2, rtol=1e-6
+    )
