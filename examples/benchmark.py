@@ -114,6 +114,33 @@ def get_phase_procs(use_tpu: bool):
     return build, solve
 
 
+def solve_timed_best_of_2(solve, timer):
+    """Shared estimator block for the single-device benchmark examples:
+    one warm-up solve outside the clock (the reference's CUDA tasks are
+    prebuilt), two timed solves, and BOTH estimators disclosed — min-of-2
+    approximates machine capability under shared-tunnel throughput swings
+    (up to 4x run-to-run), mean-of-2 is the comparable-estimator number
+    (the reference baselines are means over dedicated-node runs).
+
+    ``solve`` is a zero-arg callable returning (x, iters) with identical
+    arguments each call, so the timed calls reuse the compiled while_loop.
+    Prints the disclosure lines (bench.py parses "Iterations / sec
+    (mean)") and returns (x, iters, min_ms).
+    """
+    _ = solve()
+    timer.start()
+    x, iters = solve()
+    first_ms = timer.stop(fence=x)
+    timer.start()
+    x, iters = solve()
+    second_ms = timer.stop(fence=x)
+    mean_ms = (first_ms + second_ms) / 2.0
+    min_ms = min(first_ms, second_ms)
+    print(f"Timing: 2 timed solves, min {min_ms:.1f} ms / mean {mean_ms:.1f} ms")
+    print(f"Iterations / sec (mean): {iters / (mean_ms / 1000.0):.3f}")
+    return x, iters, min_ms
+
+
 def solve_dist_cg_timed(A0d, cycle, b, timer, tol, maxiter, conv_test_iters=5):
     """Shared -dist solve block for the multigrid examples: compile the
     distributed preconditioned CG outside the timing, fence on a host

@@ -35,6 +35,12 @@ parser.add_argument(
     help="build Galerkin coarse operators with mesh-distributed SpGEMM and "
     "solve with a distributed V-cycle-preconditioned CG over the mesh",
 )
+parser.add_argument(
+    "--no-grid",
+    action="store_true",
+    help="disable the structured-grid stencil pipeline (models/gmg_grid.py) "
+    "and use the generic sparse-matrix hierarchy on TPU too",
+)
 args, _ = parser.parse_known_args()
 common, timer, _np, sparse, linalg, use_tpu = parse_common_args()
 
@@ -332,6 +338,71 @@ def build_dist_cycle(mg, mesh, replicate_below: int = 2048):
     return ops[0][0], make_dist_vcycle(ops, weights, coarse_apply)
 
 
+def main_grid():
+    """Structured-grid pipeline (sparse_tpu/models/gmg_grid.py): stencil
+    hierarchy via comb-probed Galerkin products, grid-space V-cycle, the
+    whole PCG one compiled while_loop. Numerically the same hierarchy as
+    the generic path (oracle-pinned in tests/test_gmg_grid.py); replaces
+    its two dominant costs — host COO sorts + eager power iteration in
+    init (~52 s at n=4000 measured r3) and CSR/gather ops in the cycle."""
+    import jax
+    import jax.numpy as jnp
+
+    from sparse_tpu.models import gmg_grid as gg
+
+    N = args.n
+    dtype = jnp.float64 if common.precision == "f64" else jnp.float32
+    build, solve = get_phase_procs(use_tpu)
+    timer.start()
+    with build:
+        rng = np.random.default_rng(0)
+        b = jnp.asarray(rng.random(N * N), dtype=dtype)
+    print(f"Data creation time: {timer.stop():.1f} ms")
+
+    timer.start()
+    with build:
+        hier = gg.build_hierarchy(N, args.levels, args.gridop, dtype=dtype)
+    print(f"GMG init time: {timer.stop():.1f} ms")
+
+    with solve:
+        # commit the stencil planes (built CPU-side) to the accelerator:
+        # jit ARGUMENTS that stay host-resident would re-cross the device
+        # link every call (see kernels/cg_dia.py residency note). Arrays
+        # only — the per-level grid size n is a PYTHON int feeding
+        # static_argnums and must not become a jax Array.
+        from sparse_tpu.utils import commit_to_exec_device
+
+        hier = [
+            (
+                dict(zip(st.keys(), commit_to_exec_device(tuple(st.values())))),
+                commit_to_exec_device((w,))[0],
+                n,
+            )
+            for (st, w, n) in hier
+        ]
+        b = commit_to_exec_device((b,))[0]
+        st0 = hier[0][0]
+        vc = gg.make_vcycle(hier, args.gridop)
+        mv = jax.jit(
+            lambda v: gg.stencil_apply(st0, v.reshape(N, N)).reshape(-1)
+        )
+        npdt = np.float64 if common.precision == "f64" else np.float32
+        A_op = linalg.LinearOperator((N * N, N * N), dtype=npdt, matvec=mv)
+        M = linalg.LinearOperator((N * N, N * N), dtype=npdt, matvec=vc)
+
+        from benchmark import solve_timed_best_of_2
+
+        x, iters, total_ms = solve_timed_best_of_2(
+            lambda: linalg.cg(A_op, b, tol=args.tol, maxiter=args.maxiter, M=M),
+            timer,
+        )
+
+    resid = float(np.linalg.norm(np.asarray(mv(x)) - np.asarray(b)))
+    print(f"Iterations: {iters}  residual: {resid:.3e}")
+    print(f"Solve time: {total_ms:.1f} ms")
+    print(f"Iterations / sec: {iters / (total_ms / 1000.0):.3f}")
+
+
 def main():
     N = args.n
     build, solve = get_phase_procs(use_tpu)
@@ -378,46 +449,31 @@ def main():
                 from sparse_tpu.config import settings
 
                 settings.spmv_mode = "pallas"
-            # compile outside the clock (matches solve_dist_cg_timed and
-            # the reference, whose CUDA tasks are prebuilt); same args ->
-            # the timed call below reuses the compiled while_loop
-            _ = linalg.cg(A, b, tol=args.tol, maxiter=args.maxiter, M=M)
-            # best-of-2: shared-tunnel throughput swings up to 4x between
-            # runs of the same compiled solve; a single sample under-
-            # reports the device's real band
-            timer.start()
-            x, iters = linalg.cg(
-                A, b, tol=args.tol, maxiter=args.maxiter, M=M
-            )
-            first_ms = timer.stop(fence=x)
-        timer.start()
-        if use_tpu:
-            x, iters = linalg.cg(
-                A, b, tol=args.tol, maxiter=args.maxiter, M=M, callback=callback
+            from benchmark import solve_timed_best_of_2
+
+            x, iters, total_ms = solve_timed_best_of_2(
+                lambda: linalg.cg(A, b, tol=args.tol, maxiter=args.maxiter, M=M),
+                timer,
             )
         else:
-            it = [0]
+            timer.start()
+            if use_tpu:
+                x, iters = linalg.cg(
+                    A, b, tol=args.tol, maxiter=args.maxiter, M=M,
+                    callback=callback,
+                )
+            else:
+                it = [0]
 
-            def count(xk):
-                it[0] += 1
+                def count(xk):
+                    it[0] += 1
 
-            x, _ = linalg.cg(A, b, rtol=args.tol, maxiter=args.maxiter, M=M, callback=count)
-            iters = it[0]
-        total_ms = timer.stop(fence=x)
-        if use_tpu and callback is None:
-            mean_ms = (total_ms + first_ms) / 2.0
-            total_ms = min(total_ms, first_ms)
-            # disclose BOTH estimators: tunnel throughput swings up to 4x
-            # run-to-run, so min-of-2 estimates machine capability while
-            # mean-of-2 is the comparable-estimator number (the reference
-            # baseline is a mean over 12 DEDICATED-node runs)
-            print(
-                f"Timing: 2 timed solves, min {total_ms:.1f} ms / "
-                f"mean {mean_ms:.1f} ms"
-            )
-            # stable parseable form — bench.py records this alongside the
-            # min-of-2 headline so the artifact carries both estimators
-            print(f"Iterations / sec (mean): {iters / (mean_ms / 1000.0):.3f}")
+                x, _ = linalg.cg(
+                    A, b, rtol=args.tol, maxiter=args.maxiter, M=M,
+                    callback=count,
+                )
+                iters = it[0]
+            total_ms = timer.stop(fence=x)
 
     resid = float(np.linalg.norm(np.asarray(A @ x) - b))
     print(f"Iterations: {iters}  residual: {resid:.3e}")
@@ -426,4 +482,7 @@ def main():
 
 
 if __name__ == "__main__":
-    main()
+    if use_tpu and not args.dist and not args.no_grid:
+        main_grid()
+    else:
+        main()

@@ -361,6 +361,36 @@ class csr_array(SparseArray):
             ell_idx=ell[0] if ell is not None else None,
         )
 
+    @track_provenance
+    def mis_tropical(self, k=1, invalid=None, seed=0):
+        """Maximal independent set MIS(k) flags, one compiled tournament.
+
+        Device-side analog of the AMG aggregation driver (reference
+        amg.py:199-257): the whole round loop is a ``lax.while_loop``
+        over tropical SpMV hops — no host fetch per round. Returns the
+        [m] int32 flag vector (2 = MIS, 0 = dominated, -1 = invalid).
+        """
+        from .ops import tropical
+
+        ell = self._maybe_ell()
+        return tropical.mis_flags(
+            self.indptr, self.indices, self.data, self.shape[0], k=k,
+            invalid=invalid, seed=seed,
+            ell_idx=ell[0] if ell is not None else None,
+        )
+
+    @track_provenance
+    def mis_aggregate_cols(self, flags):
+        """(aggregate column per node, n_coarse) from MIS flags — the
+        nearest-root routing (reference amg.py:259-283), on device."""
+        from .ops import tropical
+
+        ell = self._maybe_ell()
+        return tropical.mis_aggregate_cols(
+            self.indptr, self.indices, self.data, self.shape[0], flags,
+            ell_idx=ell[0] if ell is not None else None,
+        )
+
     # -- elementwise -------------------------------------------------------
     @track_provenance
     def __add__(self, other):

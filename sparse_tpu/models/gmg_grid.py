@@ -1,0 +1,245 @@
+"""Structured-grid geometric multigrid, entirely in 2-D grid space.
+
+Reference analog: ``examples/gmg.py`` (the BASELINE.md "GMG" row — V-cycle
+weighted-Jacobi preconditioned CG, Galerkin coarse operators A_c = R A P
+computed with general SpGEMM tasks, gmg.py:289-381).
+
+TPU-first redesign: on a structured grid every operator in the hierarchy is
+a <=9-point stencil, so nothing needs a general sparse format at all —
+
+* each level operator is a dict ``{(di, dj): [n, n] coefficient plane}``;
+  applying it is pad + 9 shifted multiply-adds, pure VPU work that XLA
+  fuses into one pass (no gather, no CSR indices, no Pallas pad/trim);
+* the Galerkin product R A P is computed EXACTLY by probing the composed
+  operator with period-3 comb vectors — 9 grid applies per level instead
+  of two SpGEMMs + sorts (the r3-measured init was 52 s at n=4000, almost
+  all COO sorts and eager power iteration);
+* restriction/prolongation are separable strided stencils; prolongation
+  uses interleave-reshape (stack + reshape) rather than scatter-add —
+  TPU has no fast scatter;
+* the weighted-Jacobi omega power iteration is one jitted ``fori_loop``.
+
+The whole V-cycle is traceable, so ``linalg.cg(A, b, M=vcycle)`` inlines
+hierarchy application into the compiled while_loop — one XLA program per
+solve, one host sync per convergence test, zero host round-trips per
+iteration.
+
+Exactness: ``galerkin_stencil`` equals the explicit R @ A @ P product and
+``prolong_grid``/``restrict_grid`` equal the explicit P/R SpMVs
+(oracle-tested against scipy in tests/test_gmg_grid.py).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = [
+    "poisson_stencil",
+    "stencil_apply",
+    "restrict_grid",
+    "prolong_grid",
+    "galerkin_stencil",
+    "build_hierarchy",
+    "make_vcycle",
+]
+
+
+def poisson_stencil(n: int, dtype=jnp.float32) -> dict:
+    """5-point Poisson stencil planes on an n x n grid.
+
+    Matches examples/gmg.py:poisson2D (4 on the diagonal, -1 to the four
+    neighbors; couplings across the grid edge are absent — here simply by
+    zero-padding at apply time, no masked plane needed for the uniform
+    interior coefficients).
+    """
+    one = jnp.ones((n, n), dtype=dtype)
+    return {
+        (0, 0): 4.0 * one,
+        (-1, 0): -one,
+        (1, 0): -one,
+        (0, -1): -one,
+        (0, 1): -one,
+    }
+
+
+@jax.jit
+def stencil_apply(planes: dict, X):
+    """y = A @ x with A in stencil form: (A x)[i,j] = sum_d C_d[i,j] *
+    x[i+di, j+dj], x zero-padded at the boundary.
+
+    Jitted (as are all public entry points here): the module's op mix
+    triggers an XLA CPU *eager-mode* heap corruption on jax 0.9.0 at odd
+    grid sizes; compiled execution is correct, and under an outer trace
+    (the CG while_loop) the inner jit simply inlines."""
+    n = X.shape[0]
+    Xp = jnp.pad(X, 1)
+    out = None
+    for (di, dj), C in planes.items():
+        term = C * jax.lax.slice(Xp, (1 + di, 1 + dj), (1 + di + n, 1 + dj + n))
+        out = term if out is None else out + term
+    return out
+
+
+@partial(jax.jit, static_argnums=(1, 2))
+def restrict_grid(X, cn: int, gridop: str):
+    """R @ r on the grid: full-weighting [1,2,1]/4 per axis at stride 2
+    (or even-point injection). Equal to the explicit restriction matrix
+    of examples/gmg.py:linear_operator / injection_operator."""
+    if gridop == "injection":
+        return X[0 : 2 * cn : 2, 0 : 2 * cn : 2]
+
+    def r1(Y):
+        return (
+            Y[0 : 2 * cn : 2, :]
+            + 2.0 * Y[1 : 2 * cn + 1 : 2, :]
+            + Y[2 : 2 * cn + 2 : 2, :]
+        ) * jnp.asarray(0.25, Y.dtype)
+
+    Xp = jnp.pad(X, 1)
+    return r1(r1(Xp).T).T
+
+
+def _p1_interleave(Y, fn: int, cn: int):
+    """1-D transposed full-weighting along axis 0, scatter-free.
+
+    Fine row 2c gets 0.5*Y[c]; fine row 2c+1 gets 0.25*(Y[c] + Y[c+1])
+    (Y[cn] treated as 0) — assembled by interleaving the even/odd row
+    planes with stack+reshape instead of at[...].add scatters.
+    """
+    half = jnp.asarray(0.5, Y.dtype)
+    quarter = jnp.asarray(0.25, Y.dtype)
+    evens = half * Y
+    odds = quarter * (Y + jnp.pad(Y[1:, :], ((0, 1), (0, 0))))
+    inter = jnp.stack([evens, odds], axis=1).reshape(2 * cn, Y.shape[1])
+    return jnp.pad(inter, ((0, fn - 2 * cn), (0, 0)))
+
+
+@partial(jax.jit, static_argnums=(1, 2, 3))
+def prolong_grid(Z, fn: int, cn: int, gridop: str):
+    """P @ xc = R.T @ xc on the grid (transposed separable stencil)."""
+    if gridop == "injection":
+        out = jnp.zeros((fn, fn), dtype=Z.dtype)
+        return out.at[0 : 2 * cn : 2, 0 : 2 * cn : 2].set(Z)
+    return _p1_interleave(_p1_interleave(Z, fn, cn).T, fn, cn).T
+
+
+@partial(jax.jit, static_argnums=(1, 2, 3))
+def galerkin_stencil(planes: dict, fn: int, cn: int, gridop: str) -> dict:
+    """Coarse Galerkin stencil A_c = R A P by comb probing.
+
+    A_c has reach <= 1 in coarse units for both grid operators, so probing
+    the composed map T = R \\circ A \\circ P with the 9 period-3 comb
+    vectors separates every coefficient exactly:
+        A_c[d][i, j] = (T comb_{a,b})[i, j]  where (a, b) = (i+di, j+dj) mod 3.
+    Equal to the explicit R @ A @ P SpGEMM product (oracle-tested); costs
+    9 grid applies instead of two unstructured SpGEMMs + sorts.
+    """
+    ii, jj = np.meshgrid(np.arange(cn), np.arange(cn), indexing="ij")
+    dtype = next(iter(planes.values())).dtype
+
+    def T(comb):
+        return restrict_grid(
+            stencil_apply(planes, prolong_grid(comb, fn, cn, gridop)), cn, gridop
+        )
+
+    probes = {}
+    for a in range(3):
+        for b in range(3):
+            comb = ((ii % 3 == a) & (jj % 3 == b)).astype(dtype)
+            probes[(a, b)] = T(jnp.asarray(comb))
+
+    out = {}
+    for di in (-1, 0, 1):
+        for dj in (-1, 0, 1):
+            # plane[i,j] = probes[(i+di)%3, (j+dj)%3][i,j]
+            sel = jnp.stack(
+                [probes[(a, b)] for a in range(3) for b in range(3)]
+            ).reshape(3, 3, cn, cn)
+            plane = sel[(ii + di) % 3, (jj + dj) % 3, ii, jj]
+            if gridop == "injection" and (di, dj) != (0, 0):
+                # injection Galerkin on a <=1-reach fine stencil couples
+                # only even fine points two apart — identically zero
+                # off-diagonal; drop the planes rather than carry zeros
+                continue
+            out[(di, dj)] = plane
+    return out
+
+
+@partial(jax.jit, static_argnames=("offsets", "iters"))
+def _power_rho(planes_tuple, offsets, D_inv, x0, iters: int):
+    """rho(D^-1 A) by power iteration + Rayleigh quotient, one compiled
+    fori_loop (the r3 host-loop form was ~38 s at n=2000 on CPU)."""
+    planes = dict(zip(offsets, planes_tuple))
+
+    def mv(v):
+        return D_inv * stencil_apply(planes, v)
+
+    def body(_, v):
+        w = mv(v)
+        return w / jnp.linalg.norm(w)
+
+    v = jax.lax.fori_loop(0, iters, body, x0)
+    return jnp.vdot(v, mv(v))
+
+
+def _rho(planes: dict, D_inv, seed=0, iters=15):
+    n = D_inv.shape[0]
+    rng = np.random.default_rng(seed)
+    x0 = jnp.asarray(rng.random((n, n)), dtype=D_inv.dtype)
+    offsets = tuple(planes.keys())
+    return float(
+        _power_rho(tuple(planes.values()), offsets, D_inv, x0, iters)
+    )
+
+
+def build_hierarchy(
+    n: int, levels: int, gridop: str = "linear", omega: float = 4.0 / 3.0,
+    dtype=jnp.float32, planes: dict | None = None,
+):
+    """[(stencil planes, omega*D^-1 plane, grid size)] per level.
+
+    The smoother weight follows the pyamg formula omega / rho(D^-1 A)
+    (examples/gmg.py:WeightedJacobi), with rho from the jitted power
+    iteration. ``planes`` overrides the level-0 operator (default:
+    5-point Poisson).
+    """
+    st = poisson_stencil(n, dtype) if planes is None else planes
+    out = []
+    for lvl in range(levels):
+        D_inv = 1.0 / st[(0, 0)]
+        w = jnp.asarray(omega / _rho(st, D_inv), dtype) * D_inv
+        out.append((st, w, n))
+        if lvl < levels - 1:
+            cn = n // 2
+            st = galerkin_stencil(st, n, cn, gridop)
+            n = cn
+    return out
+
+
+def make_vcycle(hierarchy, gridop: str = "linear"):
+    """One V-cycle as a traceable [N] -> [N] map (flat vectors, the
+    LinearOperator/M contract of ``linalg.cg``): pre-smooth, restrict the
+    residual, recurse, prolong-correct, post-smooth; the coarsest level
+    applies the smoother once (examples/gmg.py:GMG._cycle)."""
+
+    def cycle_2d(r, lvl):
+        st, w, n = hierarchy[lvl]
+        if lvl == len(hierarchy) - 1:
+            return w * r
+        x = w * r
+        fine_r = r - stencil_apply(st, x)
+        cn = hierarchy[lvl + 1][2]
+        coarse_x = cycle_2d(restrict_grid(fine_r, cn, gridop), lvl + 1)
+        x = x + prolong_grid(coarse_x, n, cn, gridop)
+        return x + w * (r - stencil_apply(st, x))
+
+    n0 = hierarchy[0][2]
+
+    def cycle(r_flat):
+        return cycle_2d(r_flat.reshape(n0, n0), 0).reshape(-1)
+
+    return cycle

@@ -12,8 +12,11 @@ lexicographic tournament reduction over k (log-depth, no scalar loops).
 
 from __future__ import annotations
 
+from functools import partial
+
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from .conv import csr_to_ell
 from ..utils import host_int, in_trace
@@ -77,3 +80,111 @@ def tropical_spmv(indptr, indices, data, x, m: int, ell_idx=None):
         ell_idx, _ = csr_to_ell(indptr, indices, data, m, max(k, 1))
     fn = _tournament if in_trace() else _tournament_jit
     return fn(ell_idx, lens, jnp.asarray(x))
+
+
+@partial(jax.jit, static_argnames=("k",))
+def _mis_loop(ell_idx, lens, x0, k: int):
+    """The whole MIS tournament as ONE lax.while_loop.
+
+    The r3 form ran the per-round update on the host with a device->host
+    fetch per tropical hop (examples/amg.py:209-215) — the AMG hierarchy
+    build's main latency. Here the flag updates are vectorized device ops
+    and the loop carries (x, changed): a round that changes no flag exits
+    IMMEDIATELY (the analog of the host loop's one-round progress
+    assert), so a stalled tournament fails fast in the caller instead of
+    spinning to an iteration bound."""
+    N = x0.shape[0]
+    idx = jnp.arange(N, dtype=x0.dtype)
+
+    def hops(x):
+        z = _tournament(ell_idx, lens, x)
+        for _ in range(1, k):
+            z = _tournament(ell_idx, lens, z)
+        return z
+
+    def cond(state):
+        x, changed = state
+        return jnp.logical_and(jnp.any(x[:, 0] == 1), changed)
+
+    def body(state):
+        x, _ = state
+        z = hops(x)
+        flag = x[:, 0]
+        mis = (flag == 1) & (z[:, 2] == idx)
+        non = (flag == 1) & (z[:, 0] == 2)
+        new_flag = jnp.where(mis, 2, jnp.where(non, 0, flag))
+        return x.at[:, 0].set(new_flag), jnp.any(new_flag != flag)
+
+    x, _ = jax.lax.while_loop(cond, body, (x0, jnp.bool_(True)))
+    return x[:, 0]
+
+
+def mis_flags(indptr, indices, data, m: int, k=1, invalid=None, seed=0,
+              ell_idx=None):
+    """MIS(k) by tropical tournament, entirely on device.
+
+    Reference analog: the host tournament loop of ``examples/amg.py:199``
+    (reference amg.py:199-257). Returns the final [m] int32 flag vector:
+    2 = MIS member, 0 = dominated, -1 = invalid. Same seed discipline as
+    the host form (int32 random priorities + index tie-break), so the
+    selected set is identical.
+    """
+    lens = indptr[1:] - indptr[:-1]
+    if ell_idx is None:
+        kk = host_int(lens.max()) if m else 0
+        ell_idx, _ = csr_to_ell(indptr, indices, data, m, max(kk, 1))
+    rng = np.random.default_rng(seed)
+    rv = rng.integers(0, np.iinfo(np.int32).max, size=m, dtype=np.int32)
+    flag0 = np.ones(m, np.int32)
+    if invalid is not None:
+        flag0[np.asarray(invalid)] = -1
+    x0 = jnp.stack(
+        [
+            jnp.asarray(flag0),
+            jnp.asarray(rv),
+            jnp.arange(m, dtype=jnp.int32),
+        ],
+        axis=1,
+    )
+    flags = _mis_loop(ell_idx, lens, x0, k)
+    if bool(jnp.any(flags == 1)):
+        # the loop exited on a no-progress round with nodes still active
+        # — a stalled tournament (e.g. a strength graph without diagonal
+        # entries, where z[:,2]==i can never fire). Loud failure, like
+        # the host loop's progress assert, not a silently partial MIS.
+        raise RuntimeError(
+            "tropical MIS tournament made no progress within the round "
+            "bound; does the strength graph include self-loops?"
+        )
+    return flags
+
+
+@jax.jit
+def _aggregate_cols(ell_idx, lens, flags):
+    """Nearest-root aggregation columns from MIS flags, on device.
+
+    Coarse indices are assigned in node order (cumsum over the MIS mask —
+    the same numbering as np.nonzero), then two tropical hops route every
+    fine node to its nearest root (examples/amg.py:225-243)."""
+    mask = flags == 2
+    coarse_idx = jnp.cumsum(mask.astype(jnp.int32)) - 1
+    x = jnp.stack(
+        [
+            jnp.where(mask, 2, 0).astype(jnp.int32),
+            jnp.where(mask, coarse_idx, 0).astype(jnp.int32),
+        ],
+        axis=1,
+    )
+    y = _tournament(ell_idx, lens, x)
+    y = y.at[:, 0].add(x[:, 0])
+    z = _tournament(ell_idx, lens, y)
+    return z[:, 1], jnp.sum(mask.astype(jnp.int32))
+
+
+def mis_aggregate_cols(indptr, indices, data, m: int, flags, ell_idx=None):
+    """(aggregate column per fine node [m], n_coarse) from MIS flags."""
+    lens = indptr[1:] - indptr[:-1]
+    if ell_idx is None:
+        kk = host_int(lens.max()) if m else 0
+        ell_idx, _ = csr_to_ell(indptr, indices, data, m, max(kk, 1))
+    return _aggregate_cols(ell_idx, lens, jnp.asarray(flags))

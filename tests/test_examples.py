@@ -41,7 +41,17 @@ def test_pde_example():
 
 
 def test_gmg_example():
+    # default dispatch = the structured-grid pipeline (models/gmg_grid.py)
     out = _run("gmg.py", "-n", "16", "-levels", "2", "-maxiter", "40")
+    m = re.search(r"Iterations: (\d+)\s+residual: ([0-9.e+-]+)", out)
+    assert m, out
+    assert float(m.group(2)) < 1e-5
+
+
+def test_gmg_example_generic_path():
+    # --no-grid keeps the generic sparse-matrix hierarchy (GMG class,
+    # SpGEMM Galerkin products) exercised end-to-end
+    out = _run("gmg.py", "-n", "16", "-levels", "2", "-maxiter", "40", "--no-grid")
     m = re.search(r"Iterations: (\d+)\s+residual: ([0-9.e+-]+)", out)
     assert m, out
     assert float(m.group(2)) < 1e-5
@@ -147,3 +157,12 @@ def test_gmg_stencil_transfer_operators_match_matrices():
                 np.asarray(m._prolong_stencil(jnp.asarray(xc), fine_n, cn, gridop)),
                 np.asarray(R.T.tocsr() @ xc), atol=1e-5,
             )
+
+
+def test_amg_example_single_device():
+    # single-device AMG path: device-MIS aggregation hierarchy + the
+    # best-of-2 timed solve block
+    out = _run("amg.py", "-n", "32", "-maxiter", "60")
+    m = re.search(r"Iterations: (\d+)\s+residual: ([0-9.e+-]+)", out)
+    assert m, out
+    assert float(m.group(2)) < 1e-6

@@ -1,0 +1,191 @@
+"""Structured-grid GMG (sparse_tpu/models/gmg_grid.py) oracle tests.
+
+Every grid-space op is pinned EXACTLY (f64 atol 1e-12) to the explicit
+sparse-matrix formulation it replaces — the restriction/prolongation
+matrices and Galerkin SpGEMM products of examples/gmg.py — so the stencil
+pipeline is provably the same linear algebra, just without general sparse
+formats. Reference analog: examples/gmg.py:287-381 (gmg.py:303-380 in the
+reference repo).
+"""
+
+import numpy as np
+import pytest
+import scipy.sparse as sp
+
+import jax
+import jax.numpy as jnp
+
+from sparse_tpu.models import gmg_grid as gg
+
+
+def poisson_sp(N):
+    diag_a = np.full(N * N - 1, -1.0)
+    diag_a[N - 1 :: N] = 0.0
+    diag_g = -np.ones(N * (N - 1))
+    diag_c = 4.0 * np.ones(N * N)
+    return sp.diags(
+        [diag_g, diag_a, diag_c, diag_a, diag_g], [-N, -1, 0, 1, N]
+    ).tocsr()
+
+
+def R_mat(fine_n, gridop):
+    """Explicit restriction matrix (examples/gmg.py:injection_operator /
+    linear_operator, scipy form)."""
+    coarse_n = fine_n // 2
+    coarse_dim = coarse_n * coarse_n
+    fine_dim = fine_n * fine_n
+    ij = np.arange(coarse_dim)
+    ci, cj = ij // coarse_n, ij % coarse_n
+    if gridop == "injection":
+        cols = 2 * ci * fine_n + 2 * cj
+        return sp.csr_matrix(
+            (np.ones(coarse_dim), cols, np.arange(coarse_dim + 1)),
+            shape=(coarse_dim, fine_dim),
+        )
+    rows_l, cols_l, vals_l = [], [], []
+    weights = {(-1, -1): 1, (-1, 0): 2, (-1, 1): 1,
+               (0, -1): 2, (0, 0): 4, (0, 1): 2,
+               (1, -1): 1, (1, 0): 2, (1, 1): 1}
+    for (di, dj), w in weights.items():
+        fi = 2 * ci + di
+        fj = 2 * cj + dj
+        ok = (fi >= 0) & (fi < fine_n) & (fj >= 0) & (fj < fine_n)
+        rows_l.append(ij[ok])
+        cols_l.append((fi * fine_n + fj)[ok])
+        vals_l.append(np.full(int(ok.sum()), w / 16.0))
+    return sp.coo_matrix(
+        (np.concatenate(vals_l), (np.concatenate(rows_l), np.concatenate(cols_l))),
+        shape=(coarse_dim, fine_dim),
+    ).tocsr()
+
+
+def stencil_to_dense(stc, cn):
+    out = np.zeros((cn * cn, cn * cn))
+    for (di, dj), C in stc.items():
+        C = np.asarray(C)
+        for i in range(cn):
+            for j in range(cn):
+                ii, jj = i + di, j + dj
+                if 0 <= ii < cn and 0 <= jj < cn:
+                    out[i * cn + j, ii * cn + jj] += C[i, j]
+    return out
+
+
+@pytest.mark.parametrize("n", [8, 9, 13])
+@pytest.mark.parametrize("gridop", ["linear", "injection"])
+def test_grid_ops_match_matrices(n, gridop):
+    cn = n // 2
+    A = poisson_sp(n)
+    R = R_mat(n, gridop)
+    P = R.T.tocsr()
+    st = gg.poisson_stencil(n, jnp.float64)
+    x = np.random.default_rng(1).random((n, n))
+    z = np.random.default_rng(2).random((cn, cn))
+
+    np.testing.assert_allclose(
+        np.asarray(gg.stencil_apply(st, jnp.asarray(x))),
+        (A @ x.reshape(-1)).reshape(n, n), atol=1e-12,
+    )
+    np.testing.assert_allclose(
+        np.asarray(gg.restrict_grid(jnp.asarray(x), cn, gridop)),
+        (R @ x.reshape(-1)).reshape(cn, cn), atol=1e-12,
+    )
+    np.testing.assert_allclose(
+        np.asarray(gg.prolong_grid(jnp.asarray(z), n, cn, gridop)),
+        (P @ z.reshape(-1)).reshape(n, n), atol=1e-12,
+    )
+    stc = gg.galerkin_stencil(st, n, cn, gridop)
+    np.testing.assert_allclose(
+        stencil_to_dense(stc, cn), (R @ A @ P).toarray(), atol=1e-12
+    )
+
+
+def test_galerkin_recursion_matches_spgemm_chain():
+    """Three coarsening steps: the probed stencils equal the R A P chain."""
+    n = 33
+    A = poisson_sp(n)
+    st = gg.poisson_stencil(n, jnp.float64)
+    for _ in range(3):
+        cn = n // 2
+        R = R_mat(n, "linear")
+        Ac = (R @ A @ R.T).tocsr()
+        st = gg.galerkin_stencil(st, n, cn, "linear")
+        np.testing.assert_allclose(
+            stencil_to_dense(st, cn), Ac.toarray(), atol=1e-12
+        )
+        A, n = Ac, cn
+
+
+def test_omega_matches_host_power_iteration():
+    """The jitted fori_loop rho equals the examples/gmg.py host loop
+    (same seed, same iteration count, same Rayleigh quotient)."""
+    n = 16
+    A = poisson_sp(n)
+    D_inv = 1.0 / A.diagonal()
+    rng = np.random.default_rng(0)
+    x1 = rng.random(n * n)
+    for _ in range(15):
+        x1 = D_inv * (A @ x1)
+        x1 = x1 / np.linalg.norm(x1)
+    rho_host = float(np.dot(x1, D_inv * (A @ x1)))
+
+    st = gg.poisson_stencil(n, jnp.float64)
+    rho_grid = gg._rho(st, 1.0 / st[(0, 0)], seed=0, iters=15)
+    np.testing.assert_allclose(rho_grid, rho_host, rtol=1e-10)
+
+
+def test_vcycle_equals_matrix_form():
+    """One V-cycle output == the same recursion done with explicit
+    scipy matrices and the same smoother weights."""
+    n, levels, gridop = 13, 3, "linear"
+    hier = gg.build_hierarchy(n, levels, gridop, dtype=jnp.float64)
+
+    mats = []
+    A = poisson_sp(n)
+    fn = n
+    for lvl in range(levels):
+        w = np.asarray(hier[lvl][1]).reshape(-1)  # omega * D^-1, flat
+        mats.append((A, w, fn))
+        if lvl < levels - 1:
+            R = R_mat(fn, gridop)
+            A = (R @ A @ R.T).tocsr()
+            fn = fn // 2
+
+    def cycle_ref(r, lvl):
+        A, w, fn = mats[lvl]
+        if lvl == levels - 1:
+            return w * r
+        x = w * r
+        fine_r = r - A @ x
+        R = R_mat(fn, gridop)
+        coarse_x = cycle_ref(R @ fine_r, lvl + 1)
+        x = x + R.T @ coarse_x
+        return x + w * (r - A @ x)
+
+    r = np.random.default_rng(3).random(n * n)
+    got = np.asarray(jax.jit(gg.make_vcycle(hier, gridop))(jnp.asarray(r)))
+    np.testing.assert_allclose(got, cycle_ref(r, 0), atol=1e-10)
+
+
+def test_pcg_with_grid_vcycle_converges():
+    """linalg.cg + the grid V-cycle preconditioner solves the Poisson
+    problem in far fewer iterations than plain CG (the GMG benchmark
+    composition, examples/gmg.py:main)."""
+    from sparse_tpu import linalg
+
+    n = 64
+    hier = gg.build_hierarchy(n, 4, "linear", dtype=jnp.float64)
+    vc = gg.make_vcycle(hier, "linear")
+    st = hier[0][0]
+
+    A_op = linalg.LinearOperator(
+        (n * n, n * n), dtype=np.float64,
+        matvec=lambda v: gg.stencil_apply(st, v.reshape(n, n)).reshape(-1),
+    )
+    M = linalg.LinearOperator((n * n, n * n), dtype=np.float64, matvec=vc)
+    b = np.random.default_rng(0).random(n * n)
+    x, iters = linalg.cg(A_op, b, tol=1e-8, maxiter=300, M=M)
+    A = poisson_sp(n)
+    assert np.linalg.norm(A @ np.asarray(x) - b) < 1e-6
+    _, iters_plain = linalg.cg(A_op, b, tol=1e-8, maxiter=2000)
+    assert iters < iters_plain / 3, (iters, iters_plain)

@@ -163,3 +163,51 @@ def test_s64_halo_tracks_band_not_rows():
     assert st["halo_entries_per_spmv"] <= 3 * band
     assert st["halo_entries_per_spmv"] < rec["rows_per_shard"]
     assert st["cg_iter_collective_bytes_per_shard"] < 4 * 3 * band + 64
+
+
+@pytest.mark.slow
+def test_s64_amg_full_hierarchy():
+    """The FULL AMG pipeline at S=64 (VERDICT r3 #2): device-MIS
+    aggregation hierarchy with >=4 levels, sharded fine levels, replicated
+    tail crossover, V-cycle-preconditioned dist CG — converges, and the
+    fine level keeps halo-bounded per-iteration collectives (comm
+    accounting parsed from the example's disclosure lines)."""
+    import re
+
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=64"
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "examples", "amg.py"),
+         "-n", "128", "-dist", "-maxiter", "60"],
+        capture_output=True,
+        text=True,
+        timeout=1500,
+        cwd=REPO,
+        env=env,
+    )
+    assert proc.returncode == 0, proc.stderr[-4000:]
+    out = proc.stdout
+    m = re.search(r"levels: (\d+)\s+sizes: \[([0-9, ]+)\]", out)
+    assert m, out
+    sizes = [int(v) for v in m.group(2).split(",")]
+    assert len(sizes) >= 4 and sizes[0] == 128 * 128
+    m = re.search(r"dist tail crossover: level (\d+) of (\d+)", out)
+    assert m, out
+    c, L = int(m.group(1)), int(m.group(2))
+    assert 0 < c < L, "hierarchy must split into sharded levels + tail"
+    m = re.search(r"dist comm stats: (\{.*\})", out)
+    assert m, out
+    st = json.loads(m.group(1))
+    assert st["S"] == 64
+    # per-iteration collective volume bounded by the (unstructured) fine
+    # operator's halo, far below the all-gather footprint n/S * (S-1)
+    n_over_s = sizes[0] // 64
+    if st["mode"] == "halo":
+        assert st["halo_entries_per_spmv"] < 4 * n_over_s
+    m = re.search(r"Iterations: (\d+)\s+residual: ([0-9.e+-]+)", out)
+    assert m, out
+    iters, resid = int(m.group(1)), float(m.group(2))
+    assert resid < 1e-6
+    assert 0 < iters < 60

@@ -53,3 +53,101 @@ def test_tropical_spmv_empty_rows():
     got = np.asarray(sparse.csr_array(s).tropical_spmv(x))
     exp = _oracle(s, x)
     assert np.allclose(got, exp)
+
+
+def _host_mis(C, k=1, invalid=None, seed=0):
+    """The examples/amg.py host tournament loop — oracle for the device
+    while_loop form."""
+    N = C.shape[0]
+    rng = np.random.default_rng(seed)
+    rv = rng.integers(0, np.iinfo(np.int32).max, size=N, dtype=np.int32)
+    x = np.stack([np.ones(N, np.int32), rv, np.arange(N, dtype=np.int32)], axis=1)
+    if invalid is not None:
+        x[invalid, 0] = -1
+    C = C.tocsr()
+    while np.any(x[:, 0] == 1):
+        z = np.array(C.tropical_spmv(x))
+        for _ in range(1, k):
+            z = np.array(C.tropical_spmv(z))
+        mis_node = (x[:, 0] == 1) & (z[:, 2] == np.arange(N))
+        x[mis_node, 0] = 2
+        non_mis = (x[:, 0] == 1) & (z[:, 0] == 2)
+        x[non_mis, 0] = 0
+    return x[:, 0]
+
+
+def _sym_graph(n, density, seed):
+    """Symmetric pattern with self-loops — the MIS strength-graph shape."""
+    s = sample_csr(n, n, density=density, seed=seed).tocsr()
+    import scipy.sparse as sp
+
+    a = sp.csr_matrix(
+        (np.asarray(s.data), np.asarray(s.indices), np.asarray(s.indptr)),
+        shape=s.shape,
+    )
+    a = a + a.T + sp.identity(n)
+    a.data[:] = 1.0
+    return sparse.csr_matrix(
+        (a.tocsr().data, a.tocsr().indices, a.tocsr().indptr), shape=a.shape
+    )
+
+
+def test_mis_tropical_matches_host_loop():
+    for seed in (0, 3):
+        for k in (1, 2):
+            C = _sym_graph(40, 0.1, 200 + seed)
+            flags_dev = np.asarray(C.mis_tropical(k=k, seed=seed))
+            flags_host = _host_mis(C, k=k, seed=seed)
+            np.testing.assert_array_equal(flags_dev, flags_host)
+            # it IS an independent set (k=1): no two MIS nodes adjacent
+            if k == 1:
+                import scipy.sparse as sp
+
+                mis = np.nonzero(flags_dev == 2)[0]
+                a = sp.csr_matrix(
+                    (np.asarray(C.data), np.asarray(C.indices), np.asarray(C.indptr)),
+                    shape=C.shape,
+                )
+                sub = a[np.ix_(mis, mis)].toarray()
+                np.fill_diagonal(sub, 0)
+                assert not sub.any()
+
+
+def test_mis_tropical_invalid_nodes():
+    C = _sym_graph(30, 0.12, 7)
+    invalid = np.zeros(30, bool)
+    invalid[:10] = True
+    flags = np.asarray(C.mis_tropical(k=1, invalid=invalid))
+    assert (flags[:10] == -1).all()
+    np.testing.assert_array_equal(flags, _host_mis(C, k=1, invalid=invalid))
+
+
+def test_mis_aggregate_cols_matches_host():
+    C = _sym_graph(50, 0.08, 11)
+    flags = C.mis_tropical(k=2)
+    col_dev, n_coarse = C.mis_aggregate_cols(flags)
+    # host form (examples/amg.py:mis_aggregate fallback)
+    flags_np = np.asarray(flags)
+    mis = np.nonzero(flags_np == 2)[0]
+    x = np.zeros((50, 2), dtype=np.int32)
+    x[mis, 0] = 2
+    x[mis, 1] = np.arange(mis.size, dtype=np.int32)
+    y = np.array(C.tropical_spmv(x))
+    y[:, 0] += x[:, 0]
+    z = np.array(C.tropical_spmv(y))
+    np.testing.assert_array_equal(np.asarray(col_dev), z[:, 1])
+    assert int(n_coarse) == mis.size
+
+
+def test_mis_tropical_stall_fails_fast():
+    """A strength graph without self-loops can never elect a winner
+    (z[:,2]==i needs i in its own neighborhood): the device loop must
+    exit on the first no-progress round and raise, not spin."""
+    import pytest
+
+    # 2-cycle without diagonal: each node's only neighbor is the other
+    C = sparse.csr_matrix(
+        (np.ones(2), np.array([1, 0]), np.array([0, 1, 2])), shape=(2, 2)
+    )
+    with pytest.raises(RuntimeError, match="no progress"):
+        C.mis_tropical(k=1)
