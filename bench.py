@@ -249,6 +249,26 @@ def kernel_sweep(n: int, platform: str) -> dict:
     return out
 
 
+def run_spmm(n: int = 2000, width: int = 128):
+    """SpMM row (VERDICT r3 #7): CSR x dense WIDE B — the MXU-shaped op
+    the reference implements as a first-class task family
+    (src/sparse/array/csr/spmm.cu, 648 LoC) but this bench never
+    measured. Returns GFLOP/s on the n^2-row 5-point Laplacian at the
+    given B width (f32)."""
+    import jax.numpy as jnp
+
+    from sparse_tpu.models.poisson import laplacian_2d_ell
+    from sparse_tpu.ops.spmv import csr_spmm_ell
+
+    N = n * n
+    ell_idx, ell_val = laplacian_2d_ell(n)
+    nnz = int(jnp.sum(ell_val != 0))
+    B = jnp.ones((N, width), dtype=jnp.float32)
+    flops = 2.0 * nnz * width
+    sec = _time_kernel(lambda BB: csr_spmm_ell(ell_idx, ell_val, BB), B)
+    return flops / sec / 1e9
+
+
 SPMV_BASELINE_ITERS_PER_S = 347.7  # reference: 10M rows, 11-diag banded, f64, 1x V100
 
 
@@ -479,6 +499,12 @@ def worker(platform_arg: str) -> None:
             rec["spmv_11diag_bf16_iters_per_s"] = round(
                 run_spmv_11diag(plane_dtype=jnp.bfloat16), 1
             )
+        except Exception:
+            traceback.print_exc(file=sys.stderr)
+        try:  # stage 3.5: SpMM (CSR x wide dense, MXU-shaped) row
+            sw = min(n, 2000)
+            rec["spmm_gflops"] = round(run_spmm(sw, 128), 1)
+            rec["spmm_shape"] = f"laplacian{sw}x{sw}_B128_f32"
         except Exception:
             traceback.print_exc(file=sys.stderr)
         print(json.dumps(rec))

@@ -1,0 +1,79 @@
+"""On-device complex64 lane: stacked-real transfers, native c64 compute.
+
+Runs on the DEFAULT backend (the axon TPU tunnel under the harness env;
+CPU elsewhere) and exercises the public API end to end:
+
+  * complex CSR construction + SpMV (``csr_array @ x``),
+  * complex CG on a Hermitian positive-definite system,
+  * ``solve_ivp`` Schrodinger-style evolution (the quantum workload's
+    composition, reference dispatch.h:53-75 c64 lane).
+
+All complex host<->device movement goes through the stacked-real shims
+(``utils.asjnp`` / ``utils.tohost``) — on the tunnel, complex arrays can
+never cross the transfer boundary, so every input is shipped as two real
+planes and recombined compiled, and every output is split compiled and
+fetched real. Prints one JSON line: {"ok": true, ...}.
+"""
+
+import json
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+if os.environ.get("JAX_PLATFORMS", "").lower() == "cpu":
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+import sparse_tpu as sparse
+import sparse_tpu.linalg as linalg
+from sparse_tpu import integrate
+from sparse_tpu.utils import asjnp, tohost, transfer_restricted
+
+n = 256
+rng = np.random.default_rng(0)
+
+# Hermitian tridiagonal H (a 1-D hopping Hamiltonian)
+hop = (rng.random(n - 1) + 1j * rng.random(n - 1)).astype(np.complex64)
+diag = np.full(n, 2.0, dtype=np.complex64)
+H = sparse.diags([np.conj(hop), diag, hop], [-1, 0, 1]).tocsr()
+
+x = (rng.random(n) + 1j * rng.random(n)).astype(np.complex64)
+y = tohost(H @ asjnp(x))
+# host oracle
+import scipy.sparse as sp
+
+Hs = sp.diags([np.conj(hop), diag, hop], [-1, 0, 1]).tocsr()
+spmv_err = float(np.linalg.norm(y - Hs @ x) / np.linalg.norm(Hs @ x))
+
+# Hermitian positive definite: H + 4I
+A = sparse.diags([np.conj(hop), diag + 4.0, hop], [-1, 0, 1]).tocsr()
+b = x
+xs, iters = linalg.cg(A, b, tol=1e-5, maxiter=500)
+As = sp.diags([np.conj(hop), diag + 4.0, hop], [-1, 0, 1]).tocsr()
+cg_resid = float(np.linalg.norm(As @ tohost(xs) - b) / np.linalg.norm(b))
+
+# Schrodinger evolution: i dpsi/dt = H psi
+psi0 = np.zeros(n, dtype=np.complex64)
+psi0[n // 2] = 1.0
+out = integrate.solve_ivp(
+    lambda t, psi: -1j * (H @ psi), (0.0, 0.5), psi0,
+    method="RK45", rtol=1e-6, atol=1e-8,
+)
+psiT = tohost(out.y)[:, -1]
+norm_drift = float(abs(np.linalg.norm(psiT) - 1.0))
+
+rec = {
+    "ok": bool(spmv_err < 1e-5 and cg_resid < 1e-4 and norm_drift < 1e-3),
+    "platform": __import__("jax").devices()[0].platform,
+    "transfer_restricted": transfer_restricted(),
+    "spmv_rel_err": spmv_err,
+    "cg_resid": cg_resid,
+    "cg_iters": int(iters),
+    "norm_drift": norm_drift,
+}
+print(json.dumps(rec))
+sys.exit(0 if rec["ok"] else 1)

@@ -29,8 +29,11 @@ __all__ = [
     "is_sptriangular",
     "spsolve_triangular",
     "SuperLU",
+    "SpILU",
     "splu",
     "spilu",
+    "ilu0",
+    "ic0",
     "factorized",
     "inv",
     "expm",
@@ -106,12 +109,6 @@ def spsolve_triangular(
     if np.any(data[bad] != 0):
         side = "lower" if lower else "upper"
         raise ValueError(f"A is not {side} triangular")
-
-    dt = jnp.result_type(A.dtype, bmat.dtype, jnp.float32)
-    nb = int(min(max(block, 8), n))
-    K = (n + nb - 1) // nb
-    n_pad = K * nb
-
     if not unit_diagonal:
         diag = np.zeros(n, dtype=np.asarray(data).dtype)
         on_d = row == col
@@ -120,63 +117,109 @@ def spsolve_triangular(
             raise np.linalg.LinAlgError(
                 "A is singular: zero entry on diagonal."
             )
-
-    # per-block dense diagonal tiles + padded off-diagonal COO slices
-    blk = row // nb
-    in_diag = (col // nb) == blk
-    Dh = np.zeros((K, nb, nb), dtype=np.asarray(data).dtype)
-    dr, dc, dv = row[in_diag], col[in_diag], data[in_diag]
-    Dh[dr // nb, dr % nb, dc - (dr // nb) * nb] = dv
-    if unit_diagonal:
-        Dh[:, np.arange(nb), np.arange(nb)] = 1.0
-    # identity rows for the padding tail: a zero diagonal there would NaN
-    # the whole final tile's dense solve (and, on the backward/upper scan,
-    # poison every earlier block)
-    pad_rows = np.arange(n, n_pad)
-    Dh[pad_rows // nb, pad_rows % nb, pad_rows % nb] = 1.0
-    orow, ocol, oval = row[~in_diag], col[~in_diag], data[~in_diag]
-    oblk = orow // nb
-    counts = np.bincount(oblk, minlength=K)
-    E = max(int(counts.max()) if counts.size else 0, 1)
-    offc = np.zeros((K, E), dtype=np.int32)
-    offv = np.zeros((K, E), dtype=np.asarray(data).dtype)
-    offr = np.zeros((K, E), dtype=np.int32)
-    order = np.argsort(oblk, kind="stable")
-    pos = np.concatenate([[0], np.cumsum(counts)])
-    for k in range(K):
-        sl = order[pos[k]:pos[k + 1]]
-        e = sl.size
-        offc[k, :e] = ocol[sl]
-        offv[k, :e] = oval[sl]
-        offr[k, :e] = orow[sl] - k * nb
-
-    D_d = jnp.asarray(Dh, dtype=dt)
-    offc_d = jnp.asarray(offc)
-    offv_d = jnp.asarray(offv, dtype=dt)
-    offr_d = jnp.asarray(offr)
-    b_pad = jnp.zeros((n_pad, bmat.shape[1]), dtype=dt)
-    b_pad = b_pad.at[:n].set(bmat.astype(dt))
-    ks = jnp.arange(K, dtype=jnp.int32)
-    if not lower:
-        ks = ks[::-1]
-
-    from jax.scipy.linalg import solve_triangular as dense_tri
-
-    def step(x, k):
-        Dk = D_d[k]
-        contrib = jax.ops.segment_sum(
-            offv_d[k][:, None] * x[offc_d[k]], offr_d[k],
-            num_segments=nb,
-        )
-        y = jax.lax.dynamic_slice_in_dim(b_pad, k * nb, nb) - contrib
-        xk = dense_tri(Dk, y, lower=lower, unit_diagonal=unit_diagonal)
-        x = jax.lax.dynamic_update_slice_in_dim(x, xk, k * nb, axis=0)
-        return x, None
-
-    x0 = jnp.zeros((n_pad, bmat.shape[1]), dtype=dt)
-    x, _ = jax.lax.scan(step, x0, ks)
-    x = x[:n]
+    dt = jnp.result_type(A.dtype, bmat.dtype, jnp.float32)
+    prep = _PreparedTriangular(
+        n, row, col, data, lower=lower, unit_diagonal=unit_diagonal,
+        block=block, dtype=dt,
+    )
+    x = prep.apply(bmat)
     return x[:, 0] if squeeze else x
+
+
+class _PreparedTriangular:
+    """Blocked triangular-solve plan: host preprocessing done ONCE, each
+    ``apply`` is a single compiled ``lax.scan``.
+
+    The diagonal tiles are stored dense ([K, nb, nb] — one MXU
+    ``solve_triangular`` per step); the off-diagonal entries stay sparse
+    COO slices consumed by a segment-sum gather. ``block`` adapts
+    downward for huge n so the tile storage stays bounded (~256 MB),
+    keeping total memory O(nnz + n*nb) — the property that makes a
+    1e6-row ILU preconditioner feasible where a dense factor is 8 TB.
+    """
+
+    def __init__(self, n, row, col, data, lower, unit_diagonal,
+                 block=256, dtype=None):
+        data = np.asarray(data)
+        dt = dtype if dtype is not None else jnp.result_type(
+            data.dtype, jnp.float32
+        )
+        itemsize = np.dtype(dt).itemsize
+        cap = max(32, (1 << 28) // (max(n, 1) * itemsize))
+        nb = int(min(max(block, 8), max(n, 1), cap))
+        K = (n + nb - 1) // nb
+        n_pad = K * nb
+        self.n, self.nb, self.K, self.n_pad = n, nb, K, n_pad
+        self.lower, self.unit_diagonal, self.dt = lower, unit_diagonal, dt
+
+        blk = row // nb
+        in_diag = (col // nb) == blk
+        Dh = np.zeros((K, nb, nb), dtype=data.dtype)
+        dr, dc, dv = row[in_diag], col[in_diag], data[in_diag]
+        Dh[dr // nb, dr % nb, dc - (dr // nb) * nb] = dv
+        if unit_diagonal:
+            Dh[:, np.arange(nb), np.arange(nb)] = 1.0
+        # identity rows for the padding tail: a zero diagonal there would
+        # NaN the final tile's dense solve (and, on the backward/upper
+        # scan, poison every earlier block)
+        pad_rows = np.arange(n, n_pad)
+        Dh[pad_rows // nb, pad_rows % nb, pad_rows % nb] = 1.0
+        orow, ocol, oval = row[~in_diag], col[~in_diag], data[~in_diag]
+        oblk = orow // nb
+        counts = np.bincount(oblk, minlength=K)
+        E = max(int(counts.max()) if counts.size else 0, 1)
+        offc = np.zeros((K, E), dtype=np.int32)
+        offv = np.zeros((K, E), dtype=data.dtype)
+        offr = np.zeros((K, E), dtype=np.int32)
+        order = np.argsort(oblk, kind="stable")
+        pos = np.concatenate([[0], np.cumsum(counts)])
+        for k in range(K):
+            sl = order[pos[k]:pos[k + 1]]
+            e = sl.size
+            offc[k, :e] = ocol[sl]
+            offv[k, :e] = oval[sl]
+            offr[k, :e] = orow[sl] - k * nb
+
+        self._D = jnp.asarray(Dh, dtype=dt)
+        self._offc = jnp.asarray(offc)
+        self._offv = jnp.asarray(offv, dtype=dt)
+        self._offr = jnp.asarray(offr)
+
+        from jax.scipy.linalg import solve_triangular as dense_tri
+
+        ks = jnp.arange(K, dtype=jnp.int32)
+        if not lower:
+            ks = ks[::-1]
+
+        def solve_padded(D, offc_, offv_, offr_, b_pad):
+            def step(x, k):
+                contrib = jax.ops.segment_sum(
+                    offv_[k][:, None] * x[offc_[k]], offr_[k],
+                    num_segments=nb,
+                )
+                y = jax.lax.dynamic_slice_in_dim(b_pad, k * nb, nb) - contrib
+                xk = dense_tri(
+                    D[k], y, lower=lower, unit_diagonal=unit_diagonal
+                )
+                return (
+                    jax.lax.dynamic_update_slice_in_dim(x, xk, k * nb, axis=0),
+                    None,
+                )
+
+            x0 = jnp.zeros_like(b_pad)
+            x, _ = jax.lax.scan(step, x0, ks)
+            return x
+
+        self._solve = jax.jit(solve_padded)
+
+    def apply(self, bmat):
+        """[n, r] -> [n, r] (traceable; jitted scan inside)."""
+        bmat = jnp.asarray(bmat, dtype=self.dt)
+        b_pad = jnp.zeros((self.n_pad, bmat.shape[1]), dtype=self.dt)
+        b_pad = b_pad.at[: self.n].set(bmat)
+        return self._solve(
+            self._D, self._offc, self._offv, self._offr, b_pad
+        )[: self.n]
 
 
 class SuperLU:
@@ -253,6 +296,184 @@ class SuperLU:
         return x[:, 0] if squeeze else x
 
 
+class SpILU:
+    """Incomplete LU (ILU(0), optional threshold drop) with the scipy
+    ``SuperLU`` object surface (shape, nnz, perm_r, perm_c, L, U, solve).
+
+    TPU phase split: the row-sequential numeric factorization runs as a
+    host setup kernel (``native.ilu0_host`` — C++ with a numpy fallback,
+    like the Gustavson SpGEMM); the per-iteration triangular SOLVES are
+    two blocked ``lax.scan`` programs on the device
+    (:class:`_PreparedTriangular`), so using the object as a CG/GMRES
+    preconditioner keeps the whole solve compiled. Memory is O(nnz)
+    throughout — the 1e6-row regime where a dense factor is 8 TB.
+
+    ``drop_tol`` drops computed factor off-diagonals with
+    |v| < drop_tol * ||A_row||_2 (the scipy/ILUT row rule) AFTER the
+    ILU(0)-pattern factorization — it thins the factors (cheaper solves),
+    never adds fill.
+    """
+
+    def __init__(self, A, drop_tol=None, block=256):
+        from .csr import csr_array
+
+        A = A.tocsr()
+        m, n = A.shape
+        if m != n:
+            raise ValueError("matrix must be square")
+        self.shape = (m, n)
+        self.perm_r = np.arange(n)
+        self.perm_c = np.arange(n)
+        row, col, data = _coo_host(A)
+        if np.iscomplexobj(data):
+            # the native ILU(0) kernels are real f64; silently casting
+            # would factor a wrong matrix — route complex users to the
+            # exact (dense) factorization instead
+            raise NotImplementedError(
+                "SpILU/ilu0 are real-valued; use splu for complex matrices"
+            )
+        order = np.lexsort((col, row))  # canonical CSR ordering
+        row, col, data = row[order], col[order], data[order].astype(np.float64)
+        indptr = np.zeros(n + 1, dtype=np.int64)
+        np.add.at(indptr, row + 1, 1)
+        indptr = np.cumsum(indptr)
+
+        from . import native
+
+        fdata = native.ilu0_host(indptr, col, data, n)
+
+        keep = np.ones(fdata.size, dtype=bool)
+        if drop_tol is not None and drop_tol > 0:
+            sq = np.zeros(n)
+            np.add.at(sq, row, data * data)
+            thresh = drop_tol * np.sqrt(sq)[row]
+            keep = (np.abs(fdata) >= thresh) | (row == col)
+
+        lmask = (col < row) & keep
+        umask = (col >= row) & keep
+        # scipy SuperLU convention: nnz counts the FACTORS (L incl. its
+        # explicit unit diagonal + U), after any drop_tol thinning
+        self.nnz = int(lmask.sum()) + int(umask.sum()) + n
+        self._dtype = jnp.result_type(A.dtype, jnp.float32)
+        self._Lsolve = _PreparedTriangular(
+            n, row[lmask], col[lmask], fdata[lmask],
+            lower=True, unit_diagonal=True, block=block, dtype=self._dtype,
+        )
+        self._Usolve = _PreparedTriangular(
+            n, row[umask], col[umask], fdata[umask],
+            lower=False, unit_diagonal=False, block=block, dtype=self._dtype,
+        )
+        # factor parts for .L/.U (host, scipy convention: L carries an
+        # explicit unit diagonal)
+        self._parts = (row, col, fdata, lmask, umask)
+        self._csr = csr_array
+
+    def _factor_csr(self, mask, unit_diag):
+        row, col, fdata, _, _ = self._parts
+        n = self.shape[0]
+        r, c, v = row[mask], col[mask], fdata[mask]
+        if unit_diag:
+            r = np.concatenate([r, np.arange(n)])
+            c = np.concatenate([c, np.arange(n)])
+            v = np.concatenate([v, np.ones(n)])
+            order = np.lexsort((c, r))
+            r, c, v = r[order], c[order], v[order]
+        indptr = np.zeros(n + 1, dtype=np.int64)
+        np.add.at(indptr, r + 1, 1)
+        return self._csr.from_parts(
+            v, c.astype(np.int64), np.cumsum(indptr), self.shape
+        )
+
+    @property
+    def L(self):
+        row, col, fdata, lmask, _ = self._parts
+        return self._factor_csr(lmask, unit_diag=True)
+
+    @property
+    def U(self):
+        _, _, _, _, umask = self._parts
+        return self._factor_csr(umask, unit_diag=False)
+
+    def solve(self, rhs, trans="N"):
+        if trans != "N":
+            # transpose solves need CSC-ordered plans; not part of the
+            # preconditioner hot path — raise honestly
+            raise NotImplementedError(
+                "SpILU.solve supports trans='N' only"
+            )
+        bmat, squeeze = _as_2d(rhs)
+        if jnp.iscomplexobj(bmat):
+            xr = self._Usolve.apply(self._Lsolve.apply(jnp.real(bmat)))
+            xi = self._Usolve.apply(self._Lsolve.apply(jnp.imag(bmat)))
+            x = xr + 1j * xi
+        else:
+            x = self._Usolve.apply(self._Lsolve.apply(bmat))
+        return x[:, 0] if squeeze else x
+
+
+@track_provenance
+def ilu0(A, block=256):
+    """ILU(0) factorization (beyond-scipy convenience; the object is the
+    same as ``spilu(A)`` without dropping)."""
+    return SpILU(A, drop_tol=None, block=block)
+
+
+@track_provenance
+def ic0(A, block=256):
+    """Incomplete Cholesky IC(0) of an SPD matrix: A ~= L @ L.T on the
+    lower-triangular pattern. Returns an object with ``.L`` and a
+    ``.solve`` applying (L L^T)^-1 via two blocked device scans — the
+    classic SPD preconditioner family for :func:`cg`."""
+    from .csr import csr_array
+
+    A = A.tocsr()
+    m, n = A.shape
+    if m != n:
+        raise ValueError("matrix must be square")
+    row, col, data = _coo_host(A)
+    if np.iscomplexobj(data):
+        raise NotImplementedError("ic0 is real-valued (SPD matrices)")
+    lm = col <= row
+    row, col, data = row[lm], col[lm], data[lm].astype(np.float64)
+    order = np.lexsort((col, row))
+    row, col, data = row[order], col[order], data[order]
+    indptr = np.zeros(n + 1, dtype=np.int64)
+    np.add.at(indptr, row + 1, 1)
+    indptr = np.cumsum(indptr)
+
+    from . import native
+
+    fdata = native.ic0_host(indptr, col, data, n)
+    dt = jnp.result_type(A.dtype, jnp.float32)
+
+    class _IC0:
+        shape = (m, n)
+        nnz = fdata.size
+
+        def __init__(self):
+            self._Lsolve = _PreparedTriangular(
+                n, row, col, fdata, lower=True, unit_diagonal=False,
+                dtype=dt,
+            )
+            # L^T solve: same entries, transposed coordinates
+            self._Ltsolve = _PreparedTriangular(
+                n, col, row, fdata, lower=False, unit_diagonal=False,
+                dtype=dt,
+            )
+            ip = np.zeros(n + 1, dtype=np.int64)
+            np.add.at(ip, row + 1, 1)
+            self.L = csr_array.from_parts(
+                fdata, col.astype(np.int64), np.cumsum(ip), (m, n)
+            )
+
+        def solve(self, rhs):
+            bmat, squeeze = _as_2d(rhs)
+            x = self._Ltsolve.apply(self._Lsolve.apply(bmat))
+            return x[:, 0] if squeeze else x
+
+    return _IC0()
+
+
 @track_provenance
 def splu(A, permc_spec=None, diag_pivot_thresh=None, relax=None,
          panel_size=None, options=None):
@@ -264,12 +485,19 @@ def splu(A, permc_spec=None, diag_pivot_thresh=None, relax=None,
 
 @track_provenance
 def spilu(A, drop_tol=None, fill_factor=None, drop_rule=None, **kw):
-    """Incomplete-LU preconditioner factory (scipy.sparse.linalg.spilu
-    surface). Returns an EXACT factorization: a stronger preconditioner
-    with the identical object interface; the drop parameters are accepted
-    and ignored (documented deviation — on TPU the dense LU is one MXU
-    kernel, so there is nothing to save by dropping fill)."""
-    return SuperLU(A)
+    """Incomplete-LU preconditioner factory (scipy.sparse.linalg.spilu).
+
+    Returns a real sparse ILU(0) factorization (:class:`SpILU`): O(nnz)
+    memory with no size ceiling, honoring ``drop_tol`` as a post-
+    factorization row-norm threshold. ``fill_factor``/``drop_rule`` are
+    accepted and ignored — ILU(0) never ADDS fill, so the fill cap is
+    vacuously satisfied (documented deviation from scipy's ILUT).
+    Complex matrices keep the exact dense factorization (the native
+    ILU(0) kernels are real; the pre-r4 behavior, size ceiling applies).
+    """
+    if np.iscomplexobj(np.asarray(A.tocsr().data)):
+        return SuperLU(A)
+    return SpILU(A, drop_tol=drop_tol)
 
 
 @track_provenance

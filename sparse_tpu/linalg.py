@@ -25,9 +25,12 @@ from .base import SparseArray
 from .coverage import track_provenance
 from .utils import asjnp, host_int
 from ._direct import (  # noqa: F401  (re-exported scipy.sparse.linalg surface)
+    SpILU,
     SuperLU,
     expm,
     factorized,
+    ic0,
+    ilu0,
     inv,
     is_sptriangular,
     spbandwidth,
@@ -2523,6 +2526,9 @@ __all__ = [
     "SuperLU",
     "splu",
     "spilu",
+    "SpILU",
+    "ilu0",
+    "ic0",
     "factorized",
     "inv",
     "expm",

@@ -88,6 +88,10 @@ def _declare(cdll) -> None:
     cdll.spgemm_fill.argtypes = [
         i64, i64, i64p, i64p, f64p, i64p, i64p, f64p, i64p, i64p, f64p,
     ]
+    cdll.ilu0_csr.restype = i64
+    cdll.ilu0_csr.argtypes = [i64, i64p, i64p, f64p]
+    cdll.ic0_csr.restype = i64
+    cdll.ic0_csr.argtypes = [i64, i64p, i64p, f64p]
 
 
 def _as_u64p(a):
@@ -199,3 +203,110 @@ def spgemm_host(Ap, Aj, Ax, Bp, Bj, Bx, m: int, n: int):
                   _as_i64p(Bp), _as_i64p(Bj), _as_f64p(Bx),
                   _as_i64p(Cp), _as_i64p(Cj), _as_f64p(Cx))
     return Cp, Cj, Cx
+
+
+def ilu0_host(indptr, indices, data, n: int):
+    """In-place-style ILU(0) on canonical CSR host arrays (f64).
+
+    Returns the factored data array (L strict-lower with implicit unit
+    diagonal + U upper, on A's pattern), falling back to a pure-numpy
+    row loop when the native library is unavailable. Raises
+    ``RuntimeError`` on a missing structural diagonal or zero pivot.
+    """
+    import numpy as np
+
+    indptr = np.ascontiguousarray(indptr, dtype=np.int64)
+    indices = np.ascontiguousarray(indices, dtype=np.int64)
+    out = np.array(data, dtype=np.float64, copy=True)
+    L = lib()
+    if L is not None:
+        rc = L.ilu0_csr(n, _as_i64p(indptr), _as_i64p(indices), _as_f64p(out))
+        if rc != 0:
+            raise RuntimeError(
+                f"ILU(0): zero/missing pivot at row {-rc - 1}"
+            )
+        return out
+    # numpy fallback: same IKJ recurrence, python row loop (setup-phase
+    # only; fine to ~1e5 rows — the native path covers the big cases)
+    diag = np.full(n, -1, dtype=np.int64)
+    for i in range(n):
+        seg = indices[indptr[i]:indptr[i + 1]]
+        d = np.nonzero(seg == i)[0]
+        if d.size == 0:
+            raise RuntimeError(f"ILU(0): zero/missing pivot at row {i}")
+        diag[i] = indptr[i] + d[0]
+    pos = np.full(n, -1, dtype=np.int64)
+    for i in range(n):
+        p0, p1 = indptr[i], indptr[i + 1]
+        pos[indices[p0:p1]] = np.arange(p0, p1)
+        for p in range(p0, p1):
+            k = indices[p]
+            if k >= i:
+                break
+            ukk = out[diag[k]]
+            if ukk == 0.0:
+                raise RuntimeError(f"ILU(0): zero/missing pivot at row {k}")
+            lik = out[p] / ukk
+            out[p] = lik
+            q0, q1 = diag[k] + 1, indptr[k + 1]
+            pj = pos[indices[q0:q1]]
+            ok = pj >= 0
+            out[pj[ok]] -= lik * out[q0:q1][ok]
+        pos[indices[p0:p1]] = -1
+        if out[diag[i]] == 0.0:
+            raise RuntimeError(f"ILU(0): zero/missing pivot at row {i}")
+    return out
+
+
+def ic0_host(indptr, indices, data, n: int):
+    """IC(0) on the lower-triangular CSR of an SPD matrix (diagonal last
+    per row). Returns L's data with A ~= L @ L.T on the lower pattern;
+    numpy fallback mirrors the native kernel. Raises ``RuntimeError`` on
+    a non-positive pivot (not SPD enough for IC(0))."""
+    import numpy as np
+
+    indptr = np.ascontiguousarray(indptr, dtype=np.int64)
+    indices = np.ascontiguousarray(indices, dtype=np.int64)
+    out = np.array(data, dtype=np.float64, copy=True)
+    L = lib()
+    if L is not None:
+        rc = L.ic0_csr(n, _as_i64p(indptr), _as_i64p(indices), _as_f64p(out))
+        if rc != 0:
+            raise RuntimeError(
+                f"IC(0): non-positive/missing pivot at row {-rc - 1}"
+            )
+        return out
+    for i in range(n):
+        p0, p1 = indptr[i], indptr[i + 1]
+        if p1 <= p0 or indices[p1 - 1] != i:
+            raise RuntimeError(f"IC(0): non-positive/missing pivot at row {i}")
+        for p in range(p0, p1):
+            j = indices[p]
+            a, b = p0, indptr[j]
+            b1 = indptr[j + 1] - 1
+            s = 0.0
+            while a < p and b < b1:
+                ca, cb = indices[a], indices[b]
+                if ca == cb:
+                    s += out[a] * out[b]
+                    a += 1
+                    b += 1
+                elif ca < cb:
+                    a += 1
+                else:
+                    b += 1
+            if j < i:
+                ljj = out[indptr[j + 1] - 1]
+                if ljj == 0.0:
+                    raise RuntimeError(
+                        f"IC(0): non-positive/missing pivot at row {j}"
+                    )
+                out[p] = (out[p] - s) / ljj
+            else:
+                v = out[p] - s
+                if v <= 0.0:
+                    raise RuntimeError(
+                        f"IC(0): non-positive/missing pivot at row {i}"
+                    )
+                out[p] = v ** 0.5
+    return out

@@ -14,6 +14,7 @@
 // Build: see sparse_tpu/native.py (auto-compiled with g++ -O3 on first use).
 
 #include <algorithm>
+#include <cmath>
 #include <cstdint>
 #include <cstdio>
 #include <cstdlib>
@@ -221,6 +222,95 @@ int64_t mtx_parse_dense(const char* body, int64_t body_len, int64_t count,
     out[i++] = v;
   }
   return i;
+}
+
+// ---------------------------------------------------------------------------
+// ILU(0) / IC(0) numeric factorizations (construction-phase, in place)
+//
+// The reference has no direct/incomplete solvers (its linalg.py spsolve IS
+// cg); these back the beyond-reference scipy.sparse.linalg spilu surface.
+// Factorization is inherently row-sequential, so it runs here on the host
+// as a setup-phase kernel (like the Gustavson SpGEMM above); the per-
+// iteration triangular SOLVES run on device via the blocked lax.scan in
+// sparse_tpu/_direct.py.
+// ---------------------------------------------------------------------------
+
+// In-place ILU(0), IKJ form, on a canonical (sorted, deduplicated) CSR.
+// After return data holds L (strict lower, unit diagonal implicit) and U
+// (upper incl. diagonal) on A's sparsity pattern. Returns 0, or -(i+1) if
+// row i has no structural diagonal / a zero pivot.
+int64_t ilu0_csr(int64_t n, const int64_t* indptr, const int64_t* indices,
+                 double* data) {
+  std::vector<int64_t> pos(n, -1);
+  std::vector<int64_t> diag(n, -1);
+  for (int64_t i = 0; i < n; ++i) {
+    for (int64_t p = indptr[i]; p < indptr[i + 1]; ++p) {
+      if (indices[p] == i) {
+        diag[i] = p;
+        break;
+      }
+    }
+    if (diag[i] < 0) return -(i + 1);
+  }
+  for (int64_t i = 0; i < n; ++i) {
+    for (int64_t p = indptr[i]; p < indptr[i + 1]; ++p) pos[indices[p]] = p;
+    for (int64_t p = indptr[i]; p < indptr[i + 1]; ++p) {
+      int64_t k = indices[p];
+      if (k >= i) break;
+      double ukk = data[diag[k]];
+      if (ukk == 0.0) return -(k + 1);
+      double lik = data[p] / ukk;
+      data[p] = lik;
+      for (int64_t q = diag[k] + 1; q < indptr[k + 1]; ++q) {
+        int64_t pj = pos[indices[q]];
+        if (pj >= 0) data[pj] -= lik * data[q];
+      }
+    }
+    for (int64_t p = indptr[i]; p < indptr[i + 1]; ++p) pos[indices[p]] = -1;
+    if (data[diag[i]] == 0.0) return -(i + 1);
+  }
+  return 0;
+}
+
+// In-place IC(0) on the LOWER-triangular part of an SPD matrix in canonical
+// CSR (each row's diagonal entry is its last). After return data holds L
+// with A ~= L L^T on the lower pattern. Returns 0, or -(i+1) on a missing
+// diagonal / non-positive pivot (matrix not SPD enough for IC(0)).
+int64_t ic0_csr(int64_t n, const int64_t* indptr, const int64_t* indices,
+                double* data) {
+  for (int64_t i = 0; i < n; ++i) {
+    int64_t pi0 = indptr[i], pi1 = indptr[i + 1];
+    if (pi1 <= pi0 || indices[pi1 - 1] != i) return -(i + 1);
+    for (int64_t p = pi0; p < pi1; ++p) {
+      int64_t j = indices[p];
+      // dot of L rows i and j over columns < j (two-pointer, sorted CSR)
+      double s = 0.0;
+      int64_t a = pi0;
+      int64_t b = indptr[j], b1 = indptr[j + 1] - 1;  // exclude row j's diag
+      while (a < p && b < b1) {
+        int64_t ca = indices[a], cb = indices[b];
+        if (ca == cb) {
+          s += data[a] * data[b];
+          ++a;
+          ++b;
+        } else if (ca < cb) {
+          ++a;
+        } else {
+          ++b;
+        }
+      }
+      if (j < i) {
+        double ljj = data[indptr[j + 1] - 1];
+        if (ljj == 0.0) return -(j + 1);
+        data[p] = (data[p] - s) / ljj;
+      } else {
+        double v = data[p] - s;
+        if (v <= 0.0) return -(i + 1);
+        data[p] = std::sqrt(v);
+      }
+    }
+  }
+  return 0;
 }
 
 }  // extern "C"

@@ -10,6 +10,7 @@ from __future__ import annotations
 import math
 import warnings
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -58,12 +59,91 @@ def factor_int(n: int) -> tuple[int, int]:
     return (max(x, y), min(x, y))
 
 
+_TRANSFER_RESTRICTED: bool | None = None
+
+
+def transfer_restricted() -> bool:
+    """True on accelerator backends that cannot TRANSFER complex arrays
+    (the axon TPU tunnel raises UNIMPLEMENTED on any complex host<->device
+    movement — while REPORTING platform 'tpu', so the restriction cannot
+    be inferred from the platform string). Compiled complex COMPUTE is
+    fine — XLA:TPU supports c64 natively — so the fix is to move complex
+    data as stacked real planes and (re)combine inside compiled programs
+    (:func:`asjnp` / :func:`tohost`).
+
+    Detected EMPIRICALLY: one tiny complex round-trip on first use (the
+    restriction raises immediately, it does not hang). Memoized — the
+    backend is fixed at init and asjnp is hot. CPU short-circuits False.
+    """
+    global _TRANSFER_RESTRICTED
+    if _TRANSFER_RESTRICTED is None:
+        try:
+            d = jax.devices()[0]
+        except RuntimeError:
+            return False  # backend not initialized yet: don't memoize
+        if d.platform == "cpu":
+            _TRANSFER_RESTRICTED = False
+        else:
+            try:
+                z = jax.device_put(np.ones(2, dtype=np.complex64), d)
+                np.asarray(z)  # the fetch direction must work too
+                _TRANSFER_RESTRICTED = False
+            except Exception:
+                _TRANSFER_RESTRICTED = True
+    return _TRANSFER_RESTRICTED
+
+
+@jax.jit
+def _combine_stacked(s):
+    """[2, ...] real -> complex, on device (compiled, never a transfer)."""
+    return jax.lax.complex(s[0], s[1])
+
+
+@jax.jit
+def _split_complex(z):
+    """complex -> [2, ...] real, on device (compiled, never a transfer)."""
+    return jnp.stack([jnp.real(z), jnp.imag(z)])
+
+
 def asjnp(a, dtype=None):
-    """Convert to a jax array, passing device arrays through untouched."""
+    """Convert to a jax array, passing device arrays through untouched.
+
+    Complex HOST data bound for a transfer-restricted backend (see
+    :func:`transfer_restricted`) is moved as two stacked real planes and
+    recombined in a compiled program — the generalized form of the
+    quantum example's stacked-real evolution (VERDICT r3 #5), making
+    c64 SpMV/solves work through the public API on such backends.
+    """
+    if (
+        not isinstance(a, jax.Array)
+        and np.iscomplexobj(np.asarray(a) if not hasattr(a, "dtype") else a)
+        and transfer_restricted()
+    ):
+        ah = np.asarray(a)
+        ct = np.dtype(dtype) if dtype is not None else (
+            np.dtype(np.complex128)
+            if jax.config.jax_enable_x64 and ah.dtype == np.complex128
+            else np.dtype(np.complex64)
+        )
+        rt = np.float64 if ct == np.complex128 else np.float32
+        stacked = jnp.asarray(
+            np.stack([ah.real, ah.imag]).astype(rt)
+        )
+        return _combine_stacked(stacked)
     out = jnp.asarray(a)
     if dtype is not None and out.dtype != np.dtype(dtype):
         out = out.astype(dtype)
     return out
+
+
+def tohost(x) -> np.ndarray:
+    """Fetch a device array to host numpy; complex arrays on a
+    transfer-restricted backend come back as compiled real/imag planes
+    (the inverse of :func:`asjnp`'s stacked-real inbound path)."""
+    if isinstance(x, jax.Array) and jnp.iscomplexobj(x) and transfer_restricted():
+        s = np.asarray(_split_complex(x))
+        return s[0] + 1j * s[1]
+    return np.asarray(x)
 
 
 def host_int(x) -> int:

@@ -17,8 +17,12 @@ os.environ["JAX_PLATFORMS"] = "cpu"
 # whose env carries PALLAS_AXON_POOL_IPS — including the subprocesses that
 # example smoke tests spawn. When the tunnel is wedged that registration
 # blocks for minutes before giving up, so drop the trigger for this process
-# tree; CPU-mesh tests never need the tunnel.
-os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+# tree; CPU-mesh tests never need the tunnel. The value is parked under a
+# saved key so the opt-in hardware lane (RUN_TPU_HW=1) can restore it for
+# its subprocess.
+_tunnel = os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+if _tunnel is not None:
+    os.environ.setdefault("_SAVED_PALLAS_AXON_POOL_IPS", _tunnel)
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (

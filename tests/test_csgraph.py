@@ -383,3 +383,38 @@ def test_linalg_legacy_from_import():
     from sparse_tpu import linalg as tl
 
     assert cg_fn is tl.cg
+
+
+def test_dijkstra_high_diameter_fallback():
+    """Path graph (hop diameter = n): must complete fast via the host
+    heap fallback, matching scipy (VERDICT r3 #8)."""
+    import time
+
+    import scipy.sparse as sp
+    from scipy.sparse.csgraph import dijkstra as scipy_dijkstra
+
+    n = 20_000
+    G = sp.diags([np.ones(n - 1)], [1], format="csr")
+    A = sparse.csr_array(G)
+    t0 = time.perf_counter()
+    with pytest.warns(UserWarning, match="host binary-heap"):
+        d = cg.dijkstra(A, indices=0, directed=True)
+    assert time.perf_counter() - t0 < 30
+    np.testing.assert_allclose(d, scipy_dijkstra(G, indices=0))
+
+
+def test_dijkstra_low_diameter_stays_on_device():
+    """Mesh-like graph: converges within the sweep bound, no fallback
+    warning, distances match scipy."""
+    import warnings
+
+    import scipy.sparse as sp
+    from scipy.sparse.csgraph import dijkstra as scipy_dijkstra
+
+    g = sp.diags([np.ones(19), np.ones(19)], [1, -1])
+    G = (sp.kronsum(g, g) * 0.5).tocsr()
+    A = sparse.csr_array(G)
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        d = cg.dijkstra(A, indices=3)
+    np.testing.assert_allclose(d, scipy_dijkstra(G, indices=3))

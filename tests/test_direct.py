@@ -120,13 +120,23 @@ def test_spilu_preconditions_cg():
     S = (S + S.T) * 0.5 + sp.diags(np.linspace(1, 3, n))
     S = S.tocsr()
     A = sparse.csr_array(S)
-    ilu = linalg.spilu(A)
+    ilu = linalg.spilu(A)  # real ILU(0) now (r4) — approximate by design
     b = sample_vec(n, seed=9)
-    # the exact-LU "incomplete" factorization solves in one apply
     x = np.asarray(ilu.solve(b))
-    np.testing.assert_allclose(
-        x, sla.spsolve(S.tocsc(), b), rtol=1e-4, atol=1e-5
+    exact = sla.spsolve(S.tocsc(), b)
+    # one apply contracts the residual (random-pattern ILU(0) is a weak
+    # but real preconditioner; the Poisson iteration-count test below is
+    # the strength assertion)
+    assert np.linalg.norm(np.asarray(S @ x) - b) < np.linalg.norm(b)
+    assert np.all(np.isfinite(x))
+    # and it is exactly U^-1 L^-1 b for its OWN factors
+    ref = sla.spsolve_triangular(
+        sp.csr_matrix(ilu.U.toarray()),
+        sla.spsolve_triangular(sp.csr_matrix(ilu.L.toarray()), b, lower=True),
+        lower=False,
     )
+    np.testing.assert_allclose(x, ref, rtol=1e-6, atol=1e-8)
+    del exact
 
 
 def test_factorized_closure():
@@ -177,3 +187,122 @@ def test_splu_complex_rhs_on_real_factor():
     x = np.asarray(lu.solve(b))
     x_sci = sla.spsolve(S.tocsc().astype(np.complex128), b)
     np.testing.assert_allclose(x, x_sci, rtol=1e-4, atol=1e-5)
+
+
+# -- real sparse ILU(0) / IC(0) (VERDICT r3 #6) ------------------------------
+
+def _dense_ilu0(S):
+    """Pattern-restricted Gaussian elimination — the ILU(0) definition."""
+    A = S.toarray().copy()
+    pattern = S.toarray() != 0
+    n = A.shape[0]
+    for i in range(1, n):
+        for k in range(i):
+            if pattern[i, k]:
+                A[i, k] /= A[k, k]
+                for j in range(k + 1, n):
+                    if pattern[i, j]:
+                        A[i, j] -= A[i, k] * A[k, j]
+    L = np.tril(A, -1) * pattern + np.eye(n)
+    U = np.triu(A) * pattern
+    return L, U
+
+
+def test_ilu0_matches_dense_reference():
+    n = 60
+    S = _gen(n, seed=21)
+    ilu = linalg.spilu(sparse.csr_array(S))
+    Lref, Uref = _dense_ilu0(S)
+    np.testing.assert_allclose(ilu.L.toarray(), Lref, rtol=1e-10, atol=1e-12)
+    np.testing.assert_allclose(ilu.U.toarray(), Uref, rtol=1e-10, atol=1e-12)
+    # the ILU(0) residual property: (L@U)[i,j] == A[i,j] on A's pattern
+    prod = Lref @ Uref
+    pat = S.toarray() != 0
+    np.testing.assert_allclose(prod[pat], S.toarray()[pat], rtol=1e-9, atol=1e-11)
+
+
+def test_ilu0_solve_is_two_triangular_solves():
+    n = 50
+    S = _gen(n, seed=22)
+    ilu = linalg.spilu(sparse.csr_array(S))
+    b = sample_vec(n, seed=23)
+    x = np.asarray(ilu.solve(b))
+    Lref, Uref = _dense_ilu0(S)
+    ref = np.linalg.solve(Uref, np.linalg.solve(Lref, b))
+    np.testing.assert_allclose(x, ref, rtol=1e-6, atol=1e-8)
+
+
+def test_spilu_preconditions_cg_fewer_iterations():
+    """ILU(0) as M must cut CG iteration counts vs unpreconditioned on a
+    2-D Poisson — the preconditioner-family behavior the dense shim
+    could not provide at scale."""
+    import scipy.sparse as sp
+
+    n = 48
+    g = sp.eye(n) * 0 + sp.diags([np.full(n - 1, -1.0), np.full(n, 2.0),
+                                  np.full(n - 1, -1.0)], [-1, 0, 1])
+    S = (sp.kron(sp.identity(n), g) + sp.kron(g, sp.identity(n))).tocsr()
+    A = sparse.csr_array(S)
+    b = sample_vec(n * n, seed=5)
+    _, iters_plain = linalg.cg(A, b, tol=1e-8, maxiter=2000)
+    ilu = linalg.spilu(A)
+    M = linalg.LinearOperator(A.shape, matvec=ilu.solve, dtype=np.float64)
+    x, iters_pre = linalg.cg(A, b, tol=1e-8, maxiter=2000, M=M)
+    assert iters_pre < iters_plain / 2, (iters_pre, iters_plain)
+    np.testing.assert_allclose(
+        np.asarray(A @ x), b, rtol=1e-5, atol=1e-6
+    )
+
+
+def test_spilu_drop_tol_thins_factors():
+    n = 80
+    S = _gen(n, seed=25)
+    full = linalg.spilu(sparse.csr_array(S))
+    dropped = linalg.spilu(sparse.csr_array(S), drop_tol=0.2)
+    assert dropped.L.nnz + dropped.U.nnz < full.L.nnz + full.U.nnz
+    # still a usable preconditioner apply
+    b = sample_vec(n, seed=26)
+    assert np.all(np.isfinite(np.asarray(dropped.solve(b))))
+
+
+def test_ic0_matches_dense_reference():
+    import scipy.sparse as sp
+
+    n = 40
+    S = _gen(n, seed=27)  # _gen returns SPD-ish; symmetrize hard
+    S = ((S + S.T) * 0.5 + sp.identity(n) * 5).tocsr()
+    icf = linalg.ic0(sparse.csr_array(S))
+    # dense pattern-restricted Cholesky
+    A = S.toarray()
+    pat = np.tril(A != 0)
+    n_ = n
+    L = np.zeros_like(A)
+    for i in range(n_):
+        for j in range(i + 1):
+            if not pat[i, j]:
+                continue
+            s = A[i, j] - L[i, :j] @ L[j, :j]
+            L[i, j] = np.sqrt(s) if i == j else s / L[j, j]
+    np.testing.assert_allclose(icf.L.toarray(), L, rtol=1e-8, atol=1e-10)
+    b = sample_vec(n, seed=28)
+    ref = np.linalg.solve(L @ L.T, b)
+    np.testing.assert_allclose(np.asarray(icf.solve(b)), ref, rtol=1e-6, atol=1e-8)
+
+
+@pytest.mark.slow
+def test_spilu_million_row_laplacian_onnz_memory():
+    """The VERDICT r3 #6 acceptance: spilu on a 1e6-row matrix must
+    factor and solve in O(nnz) memory (the dense shim implied 8 TB)."""
+    import scipy.sparse as sp
+
+    n = 1_000_000
+    S = sp.diags([np.full(n - 1, -1.0), np.full(n, 4.0),
+                  np.full(n - 1, -1.0)], [-1, 0, 1], format="csr")
+    ilu = linalg.spilu(sparse.csr_array(S))
+    b = np.ones(n)
+    x = np.asarray(ilu.solve(b))
+    assert x.shape == (n,) and np.all(np.isfinite(x))
+    # tridiagonal ILU(0) == exact LU: the solve IS the solution
+    np.testing.assert_allclose(
+        np.asarray(S @ x), b, rtol=1e-4, atol=1e-4
+    )
