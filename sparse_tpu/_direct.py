@@ -54,6 +54,17 @@ def _coo_host(A):
     )
 
 
+def _coo_to_csr_host(row, col, data, n):
+    """Canonical host CSR build from COO triples: lexsort by (row, col),
+    count, cumsum. Shared by the ILU/IC factor paths and csgraph's host
+    fallback — keep the idiom in ONE place."""
+    order = np.lexsort((col, row))
+    row, col, data = row[order], col[order], data[order]
+    indptr = np.zeros(n + 1, dtype=np.int64)
+    np.add.at(indptr, row + 1, 1)
+    return np.cumsum(indptr), col, data
+
+
 @track_provenance
 def spbandwidth(A):
     """(below, above) bandwidth of a sparse array (scipy.sparse.spbandwidth)."""
@@ -324,19 +335,17 @@ class SpILU:
         self.shape = (m, n)
         self.perm_r = np.arange(n)
         self.perm_c = np.arange(n)
-        row, col, data = _coo_host(A)
-        if np.iscomplexobj(data):
-            # the native ILU(0) kernels are real f64; silently casting
-            # would factor a wrong matrix — route complex users to the
-            # exact (dense) factorization instead
+        if np.issubdtype(np.dtype(A.dtype), np.complexfloating):
+            # dtype check BEFORE touching the values: the native ILU(0)
+            # kernels are real f64, and fetching complex data would
+            # itself fail on transfer-restricted backends
             raise NotImplementedError(
                 "SpILU/ilu0 are real-valued; use splu for complex matrices"
             )
-        order = np.lexsort((col, row))  # canonical CSR ordering
-        row, col, data = row[order], col[order], data[order].astype(np.float64)
-        indptr = np.zeros(n + 1, dtype=np.int64)
-        np.add.at(indptr, row + 1, 1)
-        indptr = np.cumsum(indptr)
+        row, col, data = _coo_host(A)
+        indptr, col, data = _coo_to_csr_host(row, col, data, n)
+        row = np.repeat(np.arange(n), np.diff(indptr))
+        data = data.astype(np.float64)
 
         from . import native
 
@@ -366,6 +375,8 @@ class SpILU:
         # factor parts for .L/.U (host, scipy convention: L carries an
         # explicit unit diagonal)
         self._parts = (row, col, fdata, lmask, umask)
+        self._L_cache = None
+        self._U_cache = None
         self._csr = csr_array
 
     def _factor_csr(self, mask, unit_diag):
@@ -376,23 +387,22 @@ class SpILU:
             r = np.concatenate([r, np.arange(n)])
             c = np.concatenate([c, np.arange(n)])
             v = np.concatenate([v, np.ones(n)])
-            order = np.lexsort((c, r))
-            r, c, v = r[order], c[order], v[order]
-        indptr = np.zeros(n + 1, dtype=np.int64)
-        np.add.at(indptr, r + 1, 1)
-        return self._csr.from_parts(
-            v, c.astype(np.int64), np.cumsum(indptr), self.shape
-        )
+        indptr, c, v = _coo_to_csr_host(r, c, v, n)
+        return self._csr.from_parts(v, c.astype(np.int64), indptr, self.shape)
 
     @property
     def L(self):
-        row, col, fdata, lmask, _ = self._parts
-        return self._factor_csr(lmask, unit_diag=True)
+        if self._L_cache is None:  # sort+upload once, not per access
+            _, _, _, lmask, _ = self._parts
+            self._L_cache = self._factor_csr(lmask, unit_diag=True)
+        return self._L_cache
 
     @property
     def U(self):
-        _, _, _, _, umask = self._parts
-        return self._factor_csr(umask, unit_diag=False)
+        if self._U_cache is None:
+            _, _, _, _, umask = self._parts
+            self._U_cache = self._factor_csr(umask, unit_diag=False)
+        return self._U_cache
 
     def solve(self, rhs, trans="N"):
         if trans != "N":
@@ -430,16 +440,14 @@ def ic0(A, block=256):
     m, n = A.shape
     if m != n:
         raise ValueError("matrix must be square")
-    row, col, data = _coo_host(A)
-    if np.iscomplexobj(data):
+    if np.issubdtype(np.dtype(A.dtype), np.complexfloating):
         raise NotImplementedError("ic0 is real-valued (SPD matrices)")
+    row, col, data = _coo_host(A)
     lm = col <= row
-    row, col, data = row[lm], col[lm], data[lm].astype(np.float64)
-    order = np.lexsort((col, row))
-    row, col, data = row[order], col[order], data[order]
-    indptr = np.zeros(n + 1, dtype=np.int64)
-    np.add.at(indptr, row + 1, 1)
-    indptr = np.cumsum(indptr)
+    indptr, col, data = _coo_to_csr_host(
+        row[lm], col[lm], data[lm].astype(np.float64), n
+    )
+    row = np.repeat(np.arange(n), np.diff(indptr))
 
     from . import native
 
@@ -495,7 +503,7 @@ def spilu(A, drop_tol=None, fill_factor=None, drop_rule=None, **kw):
     Complex matrices keep the exact dense factorization (the native
     ILU(0) kernels are real; the pre-r4 behavior, size ceiling applies).
     """
-    if np.iscomplexobj(np.asarray(A.tocsr().data)):
+    if np.issubdtype(np.dtype(A.dtype), np.complexfloating):
         return SuperLU(A)
     return SpILU(A, drop_tol=drop_tol)
 

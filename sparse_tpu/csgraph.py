@@ -216,11 +216,12 @@ def _host_dijkstra(row, col, w, n, sources):
     may pick a different, equally optimal predecessor)."""
     import heapq
 
-    order = np.argsort(row, kind="stable")
-    r, c, wv = row[order], col[order], w[order]
-    indptr = np.zeros(n + 1, dtype=np.int64)
-    np.add.at(indptr, r + 1, 1)
-    indptr = np.cumsum(indptr)
+    from ._direct import _coo_to_csr_host
+
+    indptr, c, wv = _coo_to_csr_host(
+        np.asarray(row, dtype=np.int64), np.asarray(col, dtype=np.int64),
+        np.asarray(w), n,
+    )
     dist = np.full((len(sources), n), np.inf)
     pred = np.full((len(sources), n), -9999, dtype=np.int32)
     for si, s in enumerate(sources):

@@ -120,6 +120,14 @@ def asjnp(a, dtype=None):
         and transfer_restricted()
     ):
         ah = np.asarray(a)
+        if dtype is not None and not np.issubdtype(
+            np.dtype(dtype), np.complexfloating
+        ):
+            # explicit REAL dtype requested: cast on the host (same
+            # imag-dropping semantics as the unrestricted astype path)
+            # and transfer real — no stacked shim needed
+            ah = ah.astype(dtype)
+            return jnp.asarray(ah)
         ct = np.dtype(dtype) if dtype is not None else (
             np.dtype(np.complex128)
             if jax.config.jax_enable_x64 and ah.dtype == np.complex128

@@ -123,7 +123,6 @@ def test_spilu_preconditions_cg():
     ilu = linalg.spilu(A)  # real ILU(0) now (r4) — approximate by design
     b = sample_vec(n, seed=9)
     x = np.asarray(ilu.solve(b))
-    exact = sla.spsolve(S.tocsc(), b)
     # one apply contracts the residual (random-pattern ILU(0) is a weak
     # but real preconditioner; the Poisson iteration-count test below is
     # the strength assertion)
@@ -136,7 +135,6 @@ def test_spilu_preconditions_cg():
         lower=False,
     )
     np.testing.assert_allclose(x, ref, rtol=1e-6, atol=1e-8)
-    del exact
 
 
 def test_factorized_closure():
