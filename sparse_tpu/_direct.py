@@ -57,12 +57,13 @@ def _coo_host(A):
 def _coo_to_csr_host(row, col, data, n):
     """Canonical host CSR build from COO triples: lexsort by (row, col),
     count, cumsum. Shared by the ILU/IC factor paths and csgraph's host
-    fallback — keep the idiom in ONE place."""
+    fallback — keep the idiom in ONE place. Returns
+    (indptr, sorted_row, sorted_col, sorted_data)."""
     order = np.lexsort((col, row))
     row, col, data = row[order], col[order], data[order]
     indptr = np.zeros(n + 1, dtype=np.int64)
     np.add.at(indptr, row + 1, 1)
-    return np.cumsum(indptr), col, data
+    return np.cumsum(indptr), row, col, data
 
 
 @track_provenance
@@ -343,8 +344,7 @@ class SpILU:
                 "SpILU/ilu0 are real-valued; use splu for complex matrices"
             )
         row, col, data = _coo_host(A)
-        indptr, col, data = _coo_to_csr_host(row, col, data, n)
-        row = np.repeat(np.arange(n), np.diff(indptr))
+        indptr, row, col, data = _coo_to_csr_host(row, col, data, n)
         data = data.astype(np.float64)
 
         from . import native
@@ -387,7 +387,7 @@ class SpILU:
             r = np.concatenate([r, np.arange(n)])
             c = np.concatenate([c, np.arange(n)])
             v = np.concatenate([v, np.ones(n)])
-        indptr, c, v = _coo_to_csr_host(r, c, v, n)
+        indptr, _, c, v = _coo_to_csr_host(r, c, v, n)
         return self._csr.from_parts(v, c.astype(np.int64), indptr, self.shape)
 
     @property
@@ -444,10 +444,9 @@ def ic0(A, block=256):
         raise NotImplementedError("ic0 is real-valued (SPD matrices)")
     row, col, data = _coo_host(A)
     lm = col <= row
-    indptr, col, data = _coo_to_csr_host(
+    indptr, row, col, data = _coo_to_csr_host(
         row[lm], col[lm], data[lm].astype(np.float64), n
     )
-    row = np.repeat(np.arange(n), np.diff(indptr))
 
     from . import native
 

@@ -218,7 +218,7 @@ def _host_dijkstra(row, col, w, n, sources):
 
     from ._direct import _coo_to_csr_host
 
-    indptr, c, wv = _coo_to_csr_host(
+    indptr, _, c, wv = _coo_to_csr_host(
         np.asarray(row, dtype=np.int64), np.asarray(col, dtype=np.int64),
         np.asarray(w), n,
     )

@@ -49,20 +49,22 @@ __all__ = [
 
 
 def poisson_stencil(n: int, dtype=jnp.float32) -> dict:
-    """5-point Poisson stencil planes on an n x n grid.
+    """5-point Poisson stencil on an n x n grid, as SCALAR coefficients.
 
     Matches examples/gmg.py:poisson2D (4 on the diagonal, -1 to the four
-    neighbors; couplings across the grid edge are absent — here simply by
-    zero-padding at apply time, no masked plane needed for the uniform
-    interior coefficients).
+    neighbors; couplings across the grid edge vanish via zero-padding at
+    apply time). Scalars, not [n, n] planes: the coefficients are
+    uniform, and the fine level dominates the V-cycle's HBM traffic — a
+    plane-form apply reads 5 extra N-sized arrays per application.
+    ``stencil_apply`` broadcasts either form.
     """
-    one = jnp.ones((n, n), dtype=dtype)
+    del n  # the stencil is resolution-independent; kept for the API shape
     return {
-        (0, 0): 4.0 * one,
-        (-1, 0): -one,
-        (1, 0): -one,
-        (0, -1): -one,
-        (0, 1): -one,
+        (0, 0): jnp.asarray(4.0, dtype),
+        (-1, 0): jnp.asarray(-1.0, dtype),
+        (1, 0): jnp.asarray(-1.0, dtype),
+        (0, -1): jnp.asarray(-1.0, dtype),
+        (0, 1): jnp.asarray(-1.0, dtype),
     }
 
 
@@ -186,10 +188,9 @@ def _power_rho(planes_tuple, offsets, D_inv, x0, iters: int):
     return jnp.vdot(v, mv(v))
 
 
-def _rho(planes: dict, D_inv, seed=0, iters=15):
-    n = D_inv.shape[0]
+def _rho(planes: dict, D_inv, n: int, seed=0, iters=15):
     rng = np.random.default_rng(seed)
-    x0 = jnp.asarray(rng.random((n, n)), dtype=D_inv.dtype)
+    x0 = jnp.asarray(rng.random((n, n)), dtype=jnp.asarray(D_inv).dtype)
     offsets = tuple(planes.keys())
     return float(
         _power_rho(tuple(planes.values()), offsets, D_inv, x0, iters)
@@ -211,7 +212,7 @@ def build_hierarchy(
     out = []
     for lvl in range(levels):
         D_inv = 1.0 / st[(0, 0)]
-        w = jnp.asarray(omega / _rho(st, D_inv), dtype) * D_inv
+        w = jnp.asarray(omega / _rho(st, D_inv, n), dtype) * D_inv
         out.append((st, w, n))
         if lvl < levels - 1:
             cn = n // 2

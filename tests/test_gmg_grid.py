@@ -62,7 +62,7 @@ def R_mat(fine_n, gridop):
 def stencil_to_dense(stc, cn):
     out = np.zeros((cn * cn, cn * cn))
     for (di, dj), C in stc.items():
-        C = np.asarray(C)
+        C = np.broadcast_to(np.asarray(C), (cn, cn))  # scalar or plane form
         for i in range(cn):
             for j in range(cn):
                 ii, jj = i + di, j + dj
@@ -130,7 +130,7 @@ def test_omega_matches_host_power_iteration():
     rho_host = float(np.dot(x1, D_inv * (A @ x1)))
 
     st = gg.poisson_stencil(n, jnp.float64)
-    rho_grid = gg._rho(st, 1.0 / st[(0, 0)], seed=0, iters=15)
+    rho_grid = gg._rho(st, 1.0 / st[(0, 0)], n, seed=0, iters=15)
     np.testing.assert_allclose(rho_grid, rho_host, rtol=1e-10)
 
 
