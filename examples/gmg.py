@@ -365,22 +365,35 @@ def main_grid():
     print(f"GMG init time: {timer.stop():.1f} ms")
 
     with solve:
-        # commit the stencil planes (built CPU-side) to the accelerator:
-        # jit ARGUMENTS that stay host-resident would re-cross the device
-        # link every call (see kernels/cg_dia.py residency note). Arrays
-        # only — the per-level grid size n is a PYTHON int feeding
-        # static_argnums and must not become a jax Array.
-        from sparse_tpu.utils import commit_to_exec_device
+        if args.dist:
+            # GSPMD distribution: row-shard every level's planes and the
+            # vectors; the SAME vcycle/cg code below then compiles into a
+            # multi-device program with XLA-inserted halo collectives
+            # (oracle-pinned vs single-device in tests/test_gmg_grid.py)
+            from sparse_tpu.parallel.mesh import get_mesh
 
-        hier = [
-            (
-                dict(zip(st.keys(), commit_to_exec_device(tuple(st.values())))),
-                commit_to_exec_device((w,))[0],
-                n,
-            )
-            for (st, w, n) in hier
-        ]
-        b = commit_to_exec_device((b,))[0]
+            hier, vec_sharding = gg.shard_hierarchy_grid(hier, get_mesh())
+            b = jax.device_put(b, vec_sharding)
+        else:
+            # commit the stencil planes (built CPU-side) to the
+            # accelerator: jit ARGUMENTS that stay host-resident would
+            # re-cross the device link every call (kernels/cg_dia.py
+            # residency note). Arrays only — the per-level grid size n is
+            # a PYTHON int feeding static_argnums and must not become a
+            # jax Array.
+            from sparse_tpu.utils import commit_to_exec_device
+
+            hier = [
+                (
+                    dict(
+                        zip(st.keys(), commit_to_exec_device(tuple(st.values())))
+                    ),
+                    commit_to_exec_device((w,))[0],
+                    n,
+                )
+                for (st, w, n) in hier
+            ]
+            b = commit_to_exec_device((b,))[0]
         st0 = hier[0][0]
         vc = gg.make_vcycle(hier, args.gridop)
         mv = jax.jit(
@@ -482,7 +495,11 @@ def main():
 
 
 if __name__ == "__main__":
-    if use_tpu and not args.dist and not args.no_grid:
+    # grid pipeline is the default on the sparse_tpu package (single-
+    # device AND -dist, where it distributes via sharding annotations);
+    # --no-grid keeps the generic sparse-matrix machinery exercised,
+    # including the explicit DistCSR/replicated-tail -dist path.
+    if use_tpu and not args.no_grid:
         main_grid()
     else:
         main()

@@ -45,6 +45,7 @@ __all__ = [
     "galerkin_stencil",
     "build_hierarchy",
     "make_vcycle",
+    "shard_hierarchy_grid",
 ]
 
 
@@ -219,6 +220,54 @@ def build_hierarchy(
             st = galerkin_stencil(st, n, cn, gridop)
             n = cn
     return out
+
+
+def shard_hierarchy_grid(hierarchy, mesh, axis: str = "shards",
+                         replicate_below: int = 1024):
+    """Lay a grid hierarchy out over a device mesh, GSPMD style.
+
+    The TPU-first distributed form of this multigrid is NOT hand-written
+    collectives: every level's [n, n] planes (and the solve vectors) get
+    a row sharding ``P(axis, None)``, and XLA/GSPMD inserts the stencil
+    halo exchanges (collective-permutes for the pad/slice patterns) and
+    transfer-operator communication itself — the scaling-book recipe
+    (annotate shardings, let the compiler place collectives). Levels
+    with fewer than ``replicate_below`` total rows are fully REPLICATED:
+    the same zero-collective coarse tail that fixes the reference's
+    weak-scaling collapse (SURVEY §6, parallel/multigrid.py), expressed
+    as a sharding annotation instead of a gather/scatter pair.
+
+    Returns ``(hierarchy, vec_sharding)``: a new hierarchy with
+    identically-shaped, device-committed arrays, plus the sharding to
+    apply to flat [n0*n0] solve vectors (row-block layout matching level
+    0 — replicated when level 0 itself could not shard). Use with
+    :func:`make_vcycle` / ``linalg.cg`` unchanged — computation follows
+    data placement.
+
+    A level row-shards only when its n divides the mesh size (GSPMD
+    device_put rejects ragged dimension splits); everything else is
+    replicated, which is also the intended coarse-tail layout.
+    """
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    S = int(mesh.devices.size)
+    row_sharded = NamedSharding(mesh, P(axis, None))
+    replicated = NamedSharding(mesh, P())
+
+    out = []
+    vec_sharding = NamedSharding(mesh, P())
+    for lvl, (st, w, n) in enumerate(hierarchy):
+        shardable = n % S == 0 and n * n >= replicate_below
+        sh = row_sharded if shardable else replicated
+        if lvl == 0 and shardable:
+            vec_sharding = NamedSharding(mesh, P(axis))
+        st_s = {
+            d: jax.device_put(p, sh if getattr(p, "ndim", 0) == 2 else replicated)
+            for d, p in st.items()
+        }
+        w_s = jax.device_put(w, sh if getattr(w, "ndim", 0) == 2 else replicated)
+        out.append((st_s, w_s, n))
+    return out, vec_sharding
 
 
 def make_vcycle(hierarchy, gridop: str = "linear"):

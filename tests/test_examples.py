@@ -94,9 +94,10 @@ def test_pyamg_adapter_example():
 
 
 def test_gmg_dist_example():
-    """Distributed GMG: Galerkin products via mesh SpGEMM, V-cycle CG on
-    the 8-device mesh, converging like the single-device solver."""
-    out = _run("gmg.py", "-n", "32", "-levels", "3", "-maxiter", "60", "-dist")
+    """Distributed GMG, generic machinery (--no-grid): Galerkin products
+    via mesh SpGEMM, DistCSR V-cycle CG on the 8-device mesh."""
+    out = _run("gmg.py", "-n", "32", "-levels", "3", "-maxiter", "60", "-dist",
+               "--no-grid")
     m = re.search(r"Iterations: (\d+)\s+residual: ([0-9.e+-]+)", out)
     assert m, out
     assert float(m.group(2)) < 1e-6
@@ -163,6 +164,15 @@ def test_amg_example_single_device():
     # single-device AMG path: device-MIS aggregation hierarchy + the
     # best-of-2 timed solve block
     out = _run("amg.py", "-n", "32", "-maxiter", "60")
+    m = re.search(r"Iterations: (\d+)\s+residual: ([0-9.e+-]+)", out)
+    assert m, out
+    assert float(m.group(2)) < 1e-6
+
+
+def test_gmg_dist_grid_example():
+    """Distributed GMG, grid pipeline: the -dist default — row-sharded
+    stencil hierarchy, XLA-inserted halo collectives."""
+    out = _run("gmg.py", "-n", "32", "-levels", "3", "-maxiter", "60", "-dist")
     m = re.search(r"Iterations: (\d+)\s+residual: ([0-9.e+-]+)", out)
     assert m, out
     assert float(m.group(2)) < 1e-6

@@ -189,3 +189,69 @@ def test_pcg_with_grid_vcycle_converges():
     assert np.linalg.norm(A @ np.asarray(x) - b) < 1e-6
     _, iters_plain = linalg.cg(A_op, b, tol=1e-8, maxiter=2000)
     assert iters < iters_plain / 3, (iters, iters_plain)
+
+
+def test_sharded_grid_hierarchy_matches_single_device():
+    """GSPMD-distributed form (VERDICT: distributed is first-class): the
+    SAME vcycle/cg code over a row-sharded hierarchy must produce the
+    single-device iterates — XLA inserts the stencil halo collectives
+    from the sharding annotations alone."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from sparse_tpu import linalg
+    from sparse_tpu.parallel.mesh import get_mesh
+
+    n = 64
+    mesh = get_mesh(8)
+    hier = gg.build_hierarchy(n, 3, "linear", dtype=jnp.float64)
+    vc = gg.make_vcycle(hier, "linear")
+    r = np.random.default_rng(7).random(n * n)
+    want = np.asarray(jax.jit(vc)(jnp.asarray(r)))
+
+    hs, vec_sharding = gg.shard_hierarchy_grid(hier, mesh, replicate_below=1024)
+    vc_s = jax.jit(gg.make_vcycle(hs, "linear"))
+    rs = jax.device_put(jnp.asarray(r), vec_sharding)
+    assert vec_sharding.spec == P("shards"), vec_sharding
+    got = vc_s(rs)
+    np.testing.assert_allclose(np.asarray(got), want, atol=1e-11)
+    # the compiled program must be genuinely distributed: some
+    # collective moves the stencil halos / transfer rows
+    txt = vc_s.lower(rs).compile().as_text()
+    assert ("collective-permute" in txt) or ("all-gather" in txt), (
+        "no collective in the sharded V-cycle program"
+    )
+
+    # end-to-end: the full PCG over the sharded hierarchy converges to
+    # the same answer as the single-device run
+    st_s = hs[0][0]
+    mv = jax.jit(
+        lambda v: gg.stencil_apply(st_s, v.reshape(n, n)).reshape(-1)
+    )
+    A_op = linalg.LinearOperator((n * n, n * n), dtype=np.float64, matvec=mv)
+    M = linalg.LinearOperator(
+        (n * n, n * n), dtype=np.float64, matvec=gg.make_vcycle(hs, "linear")
+    )
+    b = np.random.default_rng(8).random(n * n)
+    bs = jax.device_put(jnp.asarray(b), vec_sharding)
+    x, iters = linalg.cg(A_op, bs, tol=1e-9, maxiter=200, M=M)
+    A = poisson_sp(n)
+    assert np.linalg.norm(A @ np.asarray(x) - b) < 1e-6
+    assert iters < 60
+
+
+def test_sharded_grid_hierarchy_odd_sizes_replicate():
+    """Non-divisible levels must REPLICATE, not crash: n=33 hierarchy on
+    8 devices (33 % 8 != 0 at every level) runs end to end."""
+    from jax.sharding import PartitionSpec as P
+
+    from sparse_tpu.parallel.mesh import get_mesh
+
+    mesh = get_mesh(8)
+    hier = gg.build_hierarchy(33, 3, "linear", dtype=jnp.float64)
+    hs, vec_sharding = gg.shard_hierarchy_grid(hier, mesh)
+    assert vec_sharding.spec == P(), "unshardable level 0 must replicate"
+    r = np.random.default_rng(9).random(33 * 33)
+    rs = jax.device_put(jnp.asarray(r), vec_sharding)
+    got = jax.jit(gg.make_vcycle(hs, "linear"))(rs)
+    want = jax.jit(gg.make_vcycle(hier, "linear"))(jnp.asarray(r))
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-11)
