@@ -858,6 +858,11 @@ MIN_TPU_ATTEMPT_S = 240.0
 def main():
     t_start = time.monotonic()
     budget_s = float(os.environ.get("BENCH_BUDGET_S", "870"))
+    # parse eagerly so a malformed value fails fast HERE, not inside the
+    # finally block that guarantees the driver its final metric line
+    session_log_max_age_s = float(
+        os.environ.get("BENCH_SESSION_LOG_MAX_AGE_S", "172800")
+    )
 
     def remaining():
         return budget_s - (time.monotonic() - t_start)
@@ -953,12 +958,7 @@ def main():
                 if status == "dead" and "PALLAS_AXON_POOL_IPS" in os.environ
                 else None  # no tunnel configured / broken env: live line stands
             )
-            try:
-                max_age = float(
-                    os.environ.get("BENCH_SESSION_LOG_MAX_AGE_S", "")
-                )
-            except ValueError:
-                max_age = 48 * 3600.0
+            max_age = session_log_max_age_s  # parsed at main() entry
             if logged is not None:
                 age_s = time.time() - logged["ts"]
                 if age_s > max_age:
@@ -974,6 +974,10 @@ def main():
                 rec.pop("ts")
                 rec["source"] = "session-log"
                 rec["age_s"] = round(age_s)
+                # distinct name so a naive last-line parser can never
+                # mistake a logged record for a live one (ADVICE r4)
+                if not rec.get("metric", "").endswith("_logged"):
+                    rec["metric"] = rec.get("metric", "") + "_logged"
                 if live is not None:
                     rec["live_fallback"] = {
                         "metric": live.get("metric"),

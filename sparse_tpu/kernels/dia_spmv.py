@@ -312,6 +312,23 @@ def cached_prepared_spmv(obj, attr: str, data, offsets, shape, x):
             )
         if not unavailable:
             raise
+        # Under pytest the broad off-TPU match could mask a genuine kernel
+        # regression behind the XLA fallback — re-raise there so CI sees
+        # it. Scope: only ValueError pattern-matches are re-raised (the
+        # likely kernel-bug shape: Mosaic/lowering errors wrap as
+        # ValueError); a bare NotImplementedError is the canonical
+        # lowering-genuinely-absent signal on minimal jax builds and keeps
+        # the production failover even under pytest. Set
+        # SPARSE_TPU_ALLOW_PALLAS_FALLBACK=1 to opt a test back into the
+        # full failover behavior.
+        import os
+
+        if (
+            "PYTEST_CURRENT_TEST" in os.environ
+            and not isinstance(e, NotImplementedError)
+            and not os.environ.get("SPARSE_TPU_ALLOW_PALLAS_FALLBACK")
+        ):
+            raise
         # never swallow silently: if this was a genuine kernel bug whose
         # message merely pattern-matched, the warning is the breadcrumb
         from ..utils import user_warning

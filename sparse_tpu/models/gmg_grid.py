@@ -232,7 +232,9 @@ def shard_hierarchy_grid(hierarchy, mesh, axis: str = "shards",
     halo exchanges (collective-permutes for the pad/slice patterns) and
     transfer-operator communication itself — the scaling-book recipe
     (annotate shardings, let the compiler place collectives). Levels
-    with fewer than ``replicate_below`` total rows are fully REPLICATED:
+    with fewer than ``replicate_below`` total grid points (``n * n``,
+    the flat vector length — so the default 1024 still shards a 64x64
+    level) are fully REPLICATED:
     the same zero-collective coarse tail that fixes the reference's
     weak-scaling collapse (SURVEY §6, parallel/multigrid.py), expressed
     as a sharding annotation instead of a gather/scatter pair.

@@ -60,6 +60,7 @@ def factor_int(n: int) -> tuple[int, int]:
 
 
 _TRANSFER_RESTRICTED: bool | None = None
+_TRANSFER_PROBE_FAILS: int = 0
 
 
 def transfer_restricted() -> bool:
@@ -88,8 +89,25 @@ def transfer_restricted() -> bool:
                 z = jax.device_put(np.ones(2, dtype=np.complex64), d)
                 np.asarray(z)  # the fetch direction must work too
                 _TRANSFER_RESTRICTED = False
-            except Exception:
-                _TRANSFER_RESTRICTED = True
+            except Exception as e:  # noqa: BLE001 — classified below
+                # Memoize True for the restriction's own signature
+                # (UNIMPLEMENTED / unsupported-type transfer errors). A
+                # transient failure (momentary OOM, a dropped connection)
+                # must NOT permanently route complex transfers through
+                # the stacked-real shim — but neither may it re-run a
+                # possibly-slow failing probe on every hot asjnp() call,
+                # so unrecognized wordings also memoize after a few
+                # consecutive failures.
+                global _TRANSFER_PROBE_FAILS
+                msg = str(e).lower()
+                _TRANSFER_PROBE_FAILS += 1
+                if _TRANSFER_PROBE_FAILS >= 3 or any(
+                    s in msg
+                    for s in ("unimplemented", "not implemented", "unsupported")
+                ):
+                    _TRANSFER_RESTRICTED = True
+                return True
+            _TRANSFER_PROBE_FAILS = 0
     return _TRANSFER_RESTRICTED
 
 
