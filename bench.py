@@ -494,6 +494,18 @@ def worker(platform_arg: str) -> None:
             rec["spmv_11diag_vs_baseline"] = round(
                 v / SPMV_BASELINE_ITERS_PER_S, 2
             )
+            # autotune trace (kernels/dia_spmv.autotune_dia_tile): the tile
+            # the session picked plus the full per-tile band, so a round
+            # artifact shows WHERE in the 24-147 GFLOP/s range this session
+            # sits and whether the choice is stable across sessions
+            from sparse_tpu.kernels.dia_spmv import _TILE_CACHE
+
+            for (offs, shp, dt), (tile, band) in _TILE_CACHE.items():
+                if band and shp[0] == 10_000_000 and dt == "float32":
+                    rec["spmv_11diag_tile"] = tile
+                    rec["spmv_11diag_tile_band_us"] = {
+                        str(t): round(s * 1e6, 1) for t, s in band.items()
+                    }
             import jax.numpy as jnp
 
             rec["spmv_11diag_bf16_iters_per_s"] = round(
@@ -858,11 +870,21 @@ MIN_TPU_ATTEMPT_S = 240.0
 def main():
     t_start = time.monotonic()
     budget_s = float(os.environ.get("BENCH_BUDGET_S", "870"))
-    # parse eagerly so a malformed value fails fast HERE, not inside the
-    # finally block that guarantees the driver its final metric line
-    session_log_max_age_s = float(
-        os.environ.get("BENCH_SESSION_LOG_MAX_AGE_S", "172800")
-    )
+    # parse eagerly so a malformed value fails fast HERE, before hours of
+    # benchmarking — but the module contract (a metric line is ALWAYS
+    # printed) holds even then: emit an explicit error record, then raise
+    try:
+        session_log_max_age_s = float(
+            os.environ.get("BENCH_SESSION_LOG_MAX_AGE_S", "172800")
+        )
+    except ValueError:
+        print(json.dumps({
+            "metric": "bench_config_error", "value": 0.0, "unit": "none",
+            "vs_baseline": 0.0,
+            "error": "malformed BENCH_SESSION_LOG_MAX_AGE_S",
+        }))
+        sys.stdout.flush()
+        raise
 
     def remaining():
         return budget_s - (time.monotonic() - t_start)

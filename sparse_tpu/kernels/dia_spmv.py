@@ -228,6 +228,93 @@ def dia_spmv_pallas_v2(data, offsets, x, shape, tile=65536, interpret=None):
     return y[: plan.m]
 
 
+@partial(jax.jit, static_argnames=("plan", "iters", "interpret"))
+def _spmv_chain(planes_flat, x_padded, plan: DiaPlan, iters: int,
+                interpret: bool = False):
+    """``iters`` dependent SpMVs compiled as ONE dispatch (y feeds the next
+    x window), for wall-clock timing that a shared-tunnel's per-dispatch
+    latency cannot contaminate — the best-of-chain measurement discipline
+    behind the autotuner and the bench's packed-DIA row."""
+
+    def body(_, xp):
+        y = dia_spmv_packed(planes_flat, xp, plan, interpret=interpret)
+        return jax.lax.dynamic_update_slice(xp, y.astype(xp.dtype), (plan.B,))
+
+    return jax.lax.fori_loop(0, iters, body, x_padded)
+
+
+_TILE_CACHE: dict = {}
+
+
+def autotune_dia_tile(
+    data,
+    offsets,
+    shape,
+    candidates=(65536, 131072),
+    chain: int = 16,
+    reps: int = 3,
+    budget_s: float = 30.0,
+):
+    """Pick the fastest row-tile for this geometry on the CURRENT backend.
+
+    Times a ``chain``-long compiled SpMV chain per candidate (best of
+    ``reps``) and memoizes the winner per (offsets, shape, dtype) for the
+    session — the runtime analog of the reference's one-time partition
+    analysis, sized so the probe costs ~1 s of device time once compiles
+    are cached. Returns ``(best_tile, {tile: seconds_per_spmv})``.
+    Off-TPU (interpret mode) timings are meaningless: returns the default
+    without probing.
+
+    Cold-compile guard: each candidate can cost a fresh Mosaic compile
+    (~20-40 s through a remote tunnel), so the default candidate list is
+    just the two tiles that have ever won a session sweep, the first
+    candidate is the always-safe 65536 default, and probing stops once
+    ``budget_s`` of wall clock is spent — best-so-far wins, later
+    sessions with a warm compile cache probe the full list.
+    """
+    import time
+
+    offsets = tuple(int(o) for o in offsets)
+    shape = tuple(int(s) for s in shape)
+    key = (offsets, shape, str(np.dtype(data.dtype)))
+    if key in _TILE_CACHE:
+        return _TILE_CACHE[key]
+    if jax.default_backend() != "tpu":
+        result = (65536, {})
+        _TILE_CACHE[key] = result
+        return result
+
+    t_begin = time.perf_counter()
+    timings: dict[int, float] = {}
+    for tile in candidates:
+        if timings and time.perf_counter() - t_begin > budget_s:
+            break  # out of probe budget: best-so-far wins
+        plan = dia_plan(offsets, shape, tile=tile)
+        if plan.G == 1 and timings:
+            continue  # a single-grid-step plan is tile-size invariant
+        try:
+            pf = dia_pack(data, plan)
+            xp = dia_pad_x(
+                jnp.ones((shape[1],), dtype=jnp.result_type(data.dtype, jnp.float32)),
+                plan,
+            )
+            _spmv_chain(pf, xp, plan, chain).block_until_ready()  # compile+warm
+            best = float("inf")
+            for _ in range(reps):
+                t0 = time.perf_counter()
+                _spmv_chain(pf, xp, plan, chain).block_until_ready()
+                best = min(best, (time.perf_counter() - t0) / chain)
+            timings[tile] = best
+        except Exception:  # pragma: no cover - backend-dependent lowering
+            continue  # an unlowerable candidate just drops out of the race
+    if not timings:
+        result = (65536, {})
+    else:
+        result = (min(timings, key=timings.get), timings)
+    _TILE_CACHE[key] = result
+    return result
+
+
 class PreparedDia:
     """A DIA operator packed once into the kernel-native layout.
 
@@ -236,11 +323,22 @@ class PreparedDia:
     result. Format classes cache one of these per matrix so solver loops
     never repack (the reference likewise keeps its CSR stores resident
     across task launches rather than re-materializing per SpMV).
+
+    ``tile=None`` autotunes on real TPUs when ``settings.pallas_autotune``
+    is on (one ~1 s chained probe per geometry per session) and otherwise
+    uses the 65536 default.
     """
 
     __slots__ = ("plan", "planes")
 
-    def __init__(self, data, offsets, shape, tile: int = 65536):
+    def __init__(self, data, offsets, shape, tile: int | None = None):
+        if tile is None:
+            from ..config import settings
+
+            if settings.pallas_autotune and jax.default_backend() == "tpu":
+                tile, _ = autotune_dia_tile(data, offsets, shape)
+            else:
+                tile = 65536
         self.plan = dia_plan(tuple(int(o) for o in offsets), tuple(shape), tile=tile)
         sdt = plane_stream_dtype(data.dtype, jnp.float32, self.plan.TM)
         if sdt != jnp.dtype(data.dtype):
@@ -312,21 +410,19 @@ def cached_prepared_spmv(obj, attr: str, data, offsets, shape, x):
             )
         if not unavailable:
             raise
-        # Under pytest the broad off-TPU match could mask a genuine kernel
-        # regression behind the XLA fallback — re-raise there so CI sees
-        # it. Scope: only ValueError pattern-matches are re-raised (the
-        # likely kernel-bug shape: Mosaic/lowering errors wrap as
-        # ValueError); a bare NotImplementedError is the canonical
-        # lowering-genuinely-absent signal on minimal jax builds and keeps
-        # the production failover even under pytest. Set
-        # SPARSE_TPU_ALLOW_PALLAS_FALLBACK=1 to opt a test back into the
-        # full failover behavior.
+        # Strict mode (opt-in; THIS repo's tests/conftest.py sets it): the
+        # broad off-TPU match could mask a genuine kernel regression
+        # behind the XLA fallback, so re-raise pattern-matched
+        # ValueErrors (the likely kernel-bug shape: Mosaic/lowering
+        # errors wrap as ValueError). A bare NotImplementedError is the
+        # canonical lowering-genuinely-absent signal on minimal jax
+        # builds and keeps the failover even in strict mode. Downstream
+        # test suites that never set SPARSE_TPU_STRICT_PALLAS keep the
+        # documented production failover unconditionally.
         import os
 
-        if (
-            "PYTEST_CURRENT_TEST" in os.environ
-            and not isinstance(e, NotImplementedError)
-            and not os.environ.get("SPARSE_TPU_ALLOW_PALLAS_FALLBACK")
+        if os.environ.get("SPARSE_TPU_STRICT_PALLAS") and not isinstance(
+            e, NotImplementedError
         ):
             raise
         # never swallow silently: if this was a genuine kernel bug whose

@@ -23,6 +23,12 @@ os.environ["JAX_PLATFORMS"] = "cpu"
 _tunnel = os.environ.pop("PALLAS_AXON_POOL_IPS", None)
 if _tunnel is not None:
     os.environ.setdefault("_SAVED_PALLAS_AXON_POOL_IPS", _tunnel)
+
+# THIS repo's CI runs the Pallas failover strict: a pattern-matched
+# ValueError from the DIA kernel re-raises instead of silently degrading
+# to the XLA path (kernels/dia_spmv.py). Repo-scoped by design — downstream
+# suites that don't set the flag keep the production failover.
+os.environ.setdefault("SPARSE_TPU_STRICT_PALLAS", "1")
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (

@@ -189,3 +189,43 @@ def test_spmv_mode_pallas_prepared_cache():
         np.testing.assert_allclose(np.asarray(C2 @ x), 2 * (s @ x), rtol=1e-4, atol=1e-5)
     finally:
         settings.spmv_mode = old
+
+
+def test_spmv_chain_matches_repeated_apply():
+    """_spmv_chain (the autotuner/bench timing primitive) must be an HONEST
+    dependency chain: k compiled iterations == k explicit SpMV+update steps."""
+    import jax.numpy as jnp
+
+    from sparse_tpu.kernels.dia_spmv import (
+        _spmv_chain, dia_pack, dia_pad_x, dia_plan, dia_spmv_packed,
+    )
+
+    offs = (-2, 0, 1)
+    m = 40
+    rng = np.random.default_rng(3)
+    data = (0.1 * rng.standard_normal((3, m))).astype(np.float32)
+    plan = dia_plan(offs, (m, m), tile=1024)
+    pf = dia_pack(jnp.asarray(data), plan)
+    xp0 = dia_pad_x(jnp.asarray(rng.standard_normal(m).astype(np.float32)), plan)
+    got = np.asarray(_spmv_chain(pf, xp0, plan, 3, interpret=True))
+
+    xp = xp0
+    import jax
+
+    for _ in range(3):
+        y = dia_spmv_packed(pf, xp, plan, interpret=True)
+        xp = jax.lax.dynamic_update_slice(xp, y.astype(xp.dtype), (plan.B,))
+    np.testing.assert_allclose(got, np.asarray(xp), rtol=1e-5, atol=1e-6)
+
+
+def test_autotune_off_tpu_returns_default_and_caches():
+    from sparse_tpu.kernels import dia_spmv as K
+
+    data = np.ones((3, 64), dtype=np.float32)
+    K._TILE_CACHE.clear()
+    tile, band = K.autotune_dia_tile(data, (-1, 0, 1), (64, 64))
+    assert tile == 65536 and band == {}  # no probing off-TPU
+    assert ((-1, 0, 1), (64, 64), "float32") in K._TILE_CACHE
+    # PreparedDia with tile=None resolves through the same default off-TPU
+    p = K.PreparedDia(data, (-1, 0, 1), (64, 64))
+    assert p.plan.TM >= 1024
