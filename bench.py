@@ -272,24 +272,30 @@ def run_spmm(n: int = 2000, width: int = 128):
 SPMV_BASELINE_ITERS_PER_S = 347.7  # reference: 10M rows, 11-diag banded, f64, 1x V100
 
 
-def run_spmv_11diag(rows: int = 10_000_000, plane_dtype=None):
+def run_spmv_11diag(rows: int = 10_000_000, plane_dtype=None, tile=None):
     """The reference's CSR SpMV microbenchmark shape (BASELINE.md row 1):
     banded 11 nnz/row at 10M rows — here in the prepared DIA layout
     (planes packed once, like the reference's resident CSR stores).
     ``plane_dtype=jnp.bfloat16`` streams the planes at half width (exact
     here: the values are ones); the f32 row stays the headline. Returns
-    iterations/second."""
+    ``(iters_per_s, tile_used, band)`` where ``band`` maps probed tiles to
+    best-of-chain seconds/SpMV (empty when ``tile`` was given or autotune
+    was inert)."""
     import jax.numpy as jnp
 
-    from sparse_tpu.kernels.dia_spmv import PreparedDia
+    from sparse_tpu.kernels.dia_spmv import PreparedDia, autotune_dia_tile
 
     offsets = tuple(range(-5, 6))
     planes = jnp.ones((11, rows), dtype=plane_dtype or jnp.float32)
     x = jnp.ones((rows,), dtype=jnp.float32)
+    band = {}
+    if tile is None:
+        tile, band = autotune_dia_tile(planes, offsets, (rows, rows))
+    prep = PreparedDia(planes, offsets, (rows, rows), tile=tile)
     # reps=8: the shared-tunnel backend shows multi-second throughput swings
     # (measured 405-972 iters/s across runs of this row); a sub-ms kernel
     # needs the extra best-of samples to land in the device's real band.
-    return 1.0 / _time_kernel(PreparedDia(planes, offsets, (rows, rows)), x, reps=8)
+    return 1.0 / _time_kernel(prep, x, reps=8), tile, band
 
 
 def run_fused(n: int, iters: int, tiles=(65536, 131072, 16384)):
@@ -489,28 +495,26 @@ def worker(platform_arg: str) -> None:
         if rec is None:
             sys.exit(3)  # every size failed on both paths
         try:  # stage 3: the reference's SpMV microbenchmark row (347.7)
-            v = run_spmv_11diag()
+            v, tile, band = run_spmv_11diag()
             rec["spmv_11diag_iters_per_s"] = round(v, 1)
             rec["spmv_11diag_vs_baseline"] = round(
                 v / SPMV_BASELINE_ITERS_PER_S, 2
             )
-            # autotune trace (kernels/dia_spmv.autotune_dia_tile): the tile
-            # the session picked plus the full per-tile band, so a round
-            # artifact shows WHERE in the 24-147 GFLOP/s range this session
-            # sits and whether the choice is stable across sessions
-            from sparse_tpu.kernels.dia_spmv import _TILE_CACHE
-
-            for (offs, shp, dt), (tile, band) in _TILE_CACHE.items():
-                if band and shp[0] == 10_000_000 and dt == "float32":
-                    rec["spmv_11diag_tile"] = tile
-                    rec["spmv_11diag_tile_band_us"] = {
-                        str(t): round(s * 1e6, 1) for t, s in band.items()
-                    }
+            # autotune trace: the tile this session picked plus the probed
+            # band, so round artifacts show WHERE in the 24-147 GFLOP/s
+            # range the session sits and whether the choice is stable
+            rec["spmv_11diag_tile"] = tile
+            if band:
+                rec["spmv_11diag_tile_band_us"] = {
+                    str(t): round(s * 1e6, 1) for t, s in band.items()
+                }
             import jax.numpy as jnp
 
-            rec["spmv_11diag_bf16_iters_per_s"] = round(
-                run_spmv_11diag(plane_dtype=jnp.bfloat16), 1
-            )
+            # bf16 row reuses the f32 winner: its timing comes from
+            # _time_kernel anyway, a second autotune probe (fresh cache
+            # key, up to two cold Mosaic compiles) buys nothing
+            vb, _, _ = run_spmv_11diag(plane_dtype=jnp.bfloat16, tile=tile)
+            rec["spmv_11diag_bf16_iters_per_s"] = round(vb, 1)
         except Exception:
             traceback.print_exc(file=sys.stderr)
         try:  # stage 3.5: SpMM (CSR x wide dense, MXU-shaped) row

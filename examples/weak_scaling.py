@@ -80,5 +80,71 @@ def main():
     print(json.dumps({"weak_scaling": results}))
 
 
+def comm_models(args):
+    """Predicted alltoallv traffic vs S for the shuffle-shaped components
+    (no devices needed — the models are exact and structural): samplesort
+    at constant L keys/shard, and the 2-D SpGEMM on a growing grid with a
+    constant per-device Laplacian block. The signal mirrors the CG
+    harness's comm columns: per-shard exchange bytes must track the
+    per-shard WORKLOAD, never the mesh size."""
+    # this path truly needs no devices: pin CPU unconditionally (the
+    # harness presets JAX_PLATFORMS=axon and the plugin overrides the env
+    # var, so the host SpGEMM inside the model would otherwise wedge in
+    # remote backend init)
+    os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    import numpy as np
+
+    from sparse_tpu.models.poisson import laplacian_2d_csr_host
+    from sparse_tpu.parallel.sort import sort_comm_stats
+    from sparse_tpu.parallel.spgemm import spgemm2d_comm_stats
+    from sparse_tpu.utils import factor_int
+
+    rng = np.random.default_rng(0)
+    shards = [int(s) for s in args.shards.split(",")]
+    sort_rows, spg_rows = [], []
+    for S in shards:
+        keys = rng.integers(0, 1 << 24, args.n * S).astype(np.int64)
+        st = sort_comm_stats(keys, S, payloads=(np.ones(args.n * S, np.float32),))
+        sort_rows.append(
+            {"shards": S, "keys": args.n * S,
+             "exchange_bytes_per_shard": st["exchange_bytes_per_shard_max"],
+             "sample_bytes_per_shard": st["sample_allgather_bytes_per_shard"],
+             "fallback": st["fallback_odd_even"]}
+        )
+        side = int(round(math.sqrt(args.n * S)))
+        import sparse_tpu
+
+        A = sparse_tpu.csr_array(laplacian_2d_csr_host(side, dtype=np.float32))
+        gx, gy = factor_int(S)
+        sg = spgemm2d_comm_stats(A, A, (gx, gy))
+        spg_rows.append(
+            {"shards": S, "grid": sg["grid"], "c_nnz": sg["c_nnz"],
+             "replicate_bytes_per_device":
+                 int(sg["replicate_bytes_per_device_mean"]),
+             "shuffle_bytes_per_device": sg["shuffle_bytes_per_device_max"]}
+        )
+        print(f"S={S:3d}  sort {st['exchange_bytes_per_shard_max']:>9,} B/shard"
+              f"  spgemm2d grid={gx}x{gy} repl"
+              f" {int(sg['replicate_bytes_per_device_mean']):>10,} B"
+              f" shuffle {sg['shuffle_bytes_per_device_max']:>9,} B")
+    print(json.dumps({"sort_model": sort_rows, "spgemm2d_model": spg_rows}))
+
+
 if __name__ == "__main__":
-    main()
+    import argparse as _ap
+
+    _p = _ap.ArgumentParser(add_help=False)
+    _p.add_argument("-models", action="store_true",
+                    help="print predicted comm bytes vs S (no devices)")
+    _p.add_argument("-n", type=int, default=512)
+    _p.add_argument("-shards", default="1,2,4,8")
+    _args, _ = _p.parse_known_args()
+    if _args.models:
+        comm_models(_args)
+    else:
+        main()

@@ -87,11 +87,13 @@ def step_dia_compare(n):
 def step_11diag(rows=10_000_000):
     from bench import SPMV_BASELINE_ITERS_PER_S, run_spmv_11diag
 
-    v = run_spmv_11diag(rows)
+    v, tile, band = run_spmv_11diag(rows)
     return {
         "rows": rows,
         "iters_per_s": round(v, 1),
         "vs_v100": round(v / SPMV_BASELINE_ITERS_PER_S, 2),
+        "tile": tile,
+        "tile_band_us": {str(t): round(s * 1e6, 1) for t, s in band.items()},
     }
 
 

@@ -416,3 +416,69 @@ def coo_to_csr_distributed(rows, cols, vals, shape, num_shards: int | None = Non
     np.add.at(indptr, urows + 1, 1)
     indptr = np.cumsum(indptr)
     return sparse_tpu.csr_array.from_parts(uvals, ucols, indptr, (m, n))
+
+
+def sort_comm_stats(keys, S: int, payloads=()) -> dict:
+    """Structural collective cost model for :func:`dist_sort_sample` at
+    mesh size S — derived from the algorithm (the same sampling, splitter
+    and bucketing arithmetic phase 1 runs on device), never measured, so
+    weak-scaling regressions show up without hardware (the comm_stats
+    discipline of ``parallel/dist.py``).
+
+    Phases modeled, per shard: the [S, S] sample ``all_gather``; the
+    bucket ``ragged_all_to_all`` (entries leaving the shard); the
+    rank-restore ``ragged_all_to_all`` (bucket layout -> exact
+    [s*L, (s+1)*L) rank layout); and the one [S, S] host count fetch that
+    sizes the exchange. ``fallback_odd_even`` reports whether THIS key
+    distribution would blow the 2L capacity bound and reroute to the
+    odd-even sort (heavy duplicates around a splitter).
+
+    Reference analog: the alltoallv volume accounting implicit in
+    ``src/sparse/sort/sort_template.inl`` (size_send/size_recv arrays).
+    """
+    keys = np.asarray(keys)
+    n = keys.shape[0]
+    if S <= 0 or n % S:
+        raise ValueError(f"{n} keys do not split over {S} shards")
+    L = n // S
+    kit = keys.dtype.itemsize
+    pit = sum(np.asarray(p).dtype.itemsize for p in payloads)
+    entry_bytes = kit + pit
+
+    ks = np.sort(keys.reshape(S, L), axis=1, kind="stable")
+    pos = np.clip([(j + 1) * L // (S + 1) for j in range(S)], 0, L - 1)
+    all_samples = np.sort(ks[:, pos].reshape(-1), kind="stable")
+    splitters = all_samples[np.arange(1, S) * S]
+
+    send = np.empty((S, S), dtype=np.int64)  # [src, dest]
+    for s in range(S):
+        b = np.searchsorted(ks[s], splitters, side="left")
+        send[s] = np.diff(np.concatenate([[0], b, [L]]))
+    recv = send.sum(axis=0)
+    cap = 2 * L
+    bucket_off = send.sum(axis=1) - np.diag(send)
+
+    # restore exchange: overlap of the bucket prefix layout with the
+    # uniform rank layout (phase 2's second ragged exchange)
+    bb = np.concatenate([[0], np.cumsum(recv)])
+    restore = np.zeros((S, S), dtype=np.int64)
+    for s in range(S):
+        lo = np.maximum(bb[s], np.arange(S) * L)
+        hi = np.minimum(bb[s + 1], (np.arange(S) + 1) * L)
+        restore[s] = np.maximum(hi - lo, 0)
+    restore_off = restore.sum(axis=1) - np.diag(restore)
+
+    return {
+        "S": S,
+        "L": L,
+        "cap": cap,
+        "fallback_odd_even": bool(recv.max() > cap),
+        "sample_allgather_bytes_per_shard": int(S * S * kit),
+        "bucket_entries_sent_max": int(bucket_off.max()),
+        "bucket_entries_sent_mean": float(bucket_off.mean()),
+        "restore_entries_sent_max": int(restore_off.max()),
+        "exchange_bytes_per_shard_max": int(
+            (bucket_off.max() + restore_off.max()) * entry_bytes
+        ),
+        "host_sync_bytes": int(S * S * 4),
+    }

@@ -735,3 +735,88 @@ def dist_spgemm_2d(A, B, mesh2d=None, as_dist: bool = False):
     )
     out_data = np.concatenate(parts_dv) if parts_dv else np.zeros(0, dtype=dt)
     return sparse_tpu.csr_array.from_parts(out_data, out_indices, indptr, (m, n))
+
+
+def spgemm2d_comm_stats(A, B, grid: tuple) -> dict:
+    """Structural collective cost model for :func:`dist_spgemm_2d` on a
+    (gx, gy) grid — exact, derived from the algorithm, never measured
+    (the ``comm_stats`` discipline), so 2-D weak-scaling regressions show
+    up without hardware.
+
+    Exactness without simulating the tiles: tile (i, j) computes
+    ``A[rowblock_i] @ B[:, colblock_j]``, which IS the (rowblock_i x
+    colblock_j) sub-block of C — so ONE host product (native Gustavson)
+    plus 2-D histograms yields every tile's nnz and every shuffle
+    send/recv count the device-side ``_spgemm2d_shuffle`` would produce.
+
+    Modeled, per device: the A row-block / B col-block replication
+    broadcasts (CSR bytes landing on each device), the gy-axis
+    ``ragged_all_to_all`` shuffle (entries leaving each device, and the
+    capacity bucket actually used to size the exchange buffer), and the
+    O(gx*gy*gy) host count fetch.
+
+    Reference analog: the 2-D replicated layout + shuffle volumes of
+    ``sparse/csr.py:1495-1728``.
+    """
+    import sparse_tpu
+
+    gx, gy = (int(g) for g in grid)
+    m, k = A.shape
+    _, n = B.shape
+    a_indptr = np.asarray(A.indptr)
+    row_splits = np.asarray(balanced_row_splits(a_indptr, gx))
+    col_splits = np.asarray(equal_row_splits(n, gy))
+    b_csc_indptr = np.asarray(B.tocsc().indptr)
+    iw = 4 if max(m, n, k) < 2**31 else 8
+    vw = np.result_type(A.dtype, B.dtype).itemsize
+
+    a_nnz = a_indptr[row_splits[1:]] - a_indptr[row_splits[:-1]]  # [gx]
+    b_nnz = b_csc_indptr[col_splits[1:]] - b_csc_indptr[col_splits[:-1]]
+    a_rows = np.diff(row_splits)
+    b_cols = np.diff(col_splits)
+    # each input replicates in its OWN dtype (the device streams
+    # advA/bdvB as a_data.dtype / b_data.dtype, not the result type)
+    avw = np.dtype(A.dtype).itemsize
+    bvw = np.dtype(B.dtype).itemsize
+    a_block_bytes = a_nnz * (iw + avw) + (a_rows + 1) * iw
+    b_block_bytes = b_nnz * (iw + bvw) + (b_cols + 1) * iw
+    repl_bytes = a_block_bytes[:, None] + b_block_bytes[None, :]  # [gx, gy]
+
+    C = (sparse_tpu.csr_array(A) @ sparse_tpu.csr_array(B)).tocsr()
+    c_indptr = np.asarray(C.indptr)
+    c_indices = np.asarray(C.indices)
+    rows = np.repeat(np.arange(m), np.diff(c_indptr))
+    iblk = np.searchsorted(row_splits, rows, side="right") - 1
+    jsrc = np.searchsorted(col_splits, c_indices, side="right") - 1
+    # destination owner: local row bucketed by block i's equal sub-splits
+    local = rows - row_splits[iblk]
+    jdst = np.zeros_like(local)
+    for i in range(gx):
+        sub = np.asarray(equal_row_splits(int(a_rows[i]), gy))
+        sel = iblk == i
+        jdst[sel] = np.searchsorted(sub, local[sel], side="right") - 1
+    sends = np.zeros((gx, gy, gy), dtype=np.int64)  # [i, src j, dest j]
+    np.add.at(sends, (iblk, jsrc, jdst), 1)
+    tile_nnz = sends.sum(axis=2)  # [gx, gy]
+    recv_tot = sends.sum(axis=1)  # [gx, dest j]
+    crossing = tile_nnz - np.einsum("ijj->ij", sends)  # leaves device (i, j)
+    cap = _bucket(max(int(recv_tot.max()), 1))
+    # padded-column width mirrors dist_spgemm_2d's lidt selection exactly:
+    # int32 iff S_out * C_out fits, with the UN-bucketed window width
+    S_out = gx * gy
+    C_out = max(int(np.max(np.diff(equal_row_splits(n, S_out)))), 1)
+    pcol_w = 4 if S_out * C_out < 2**31 else 8
+    entry_bytes = iw + pcol_w + vw  # r, padded col, value streams
+
+    return {
+        "grid": [gx, gy],
+        "c_nnz": int(c_indices.shape[0]),
+        "tile_nnz_max": int(tile_nnz.max()),
+        "replicate_bytes_per_device_max": int(repl_bytes.max()),
+        "replicate_bytes_per_device_mean": float(repl_bytes.mean()),
+        "shuffle_entries_sent_max": int(crossing.max()),
+        "shuffle_entries_sent_mean": float(crossing.mean()),
+        "shuffle_bytes_per_device_max": int(crossing.max() * entry_bytes),
+        "exchange_cap_entries": int(cap),
+        "host_sync_bytes": int(gx * gy * gy * 4 + 2 * gx * gy * 8),
+    }
