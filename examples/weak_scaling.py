@@ -124,13 +124,12 @@ def comm_models(args):
         sg = spgemm2d_comm_stats(A, A, (gx, gy))
         spg_rows.append(
             {"shards": S, "grid": sg["grid"], "c_nnz": sg["c_nnz"],
-             "replicate_bytes_per_device":
-                 int(sg["replicate_bytes_per_device_mean"]),
+             "replicate_bytes_per_device": sg["replicate_bytes_per_device"],
              "shuffle_bytes_per_device": sg["shuffle_bytes_per_device_max"]}
         )
         print(f"S={S:3d}  sort {st['exchange_bytes_per_shard_max']:>9,} B/shard"
               f"  spgemm2d grid={gx}x{gy} repl"
-              f" {int(sg['replicate_bytes_per_device_mean']):>10,} B"
+              f" {sg['replicate_bytes_per_device']:>10,} B"
               f" shuffle {sg['shuffle_bytes_per_device_max']:>9,} B")
     print(json.dumps({"sort_model": sort_rows, "spgemm2d_model": spg_rows}))
 

@@ -1,0 +1,144 @@
+"""One-command hardware-evidence capture for a live tunnel window.
+
+Four rounds produced zero driver-visible TPU lines because every live
+window was spent choosing what to run. This script IS the choice: the
+full queued evidence list (BENCH_NOTES r4 items 1-7, VERDICT r4 next-round
+1-4), serialized through ONE client, probe-gated between steps, each
+step's verbatim stdout banked to ``results/axon/`` the moment it exists
+(the reference's results/summit/*.out discipline).
+
+Order is cheap -> impressive so a short window still banks something:
+  1. bench.py full flow (headline fused-CG 6000^2, SpMV+tile autotune,
+     SpMM, GMG grid-pipeline, AMG, quantum rows; logs its own records)
+  2. public-API PDE 6000^2 throughput (examples/pde.py)
+  3. GMG grid pipeline n=2000 -> 4000 -> 4500 (the reference's exact shape)
+  4. AMG n=512 example run
+  5. c64 hardware lane (RUN_TPU_HW pytest + tpu_complex_check)
+  6. SpGEMM microbenchmark
+  7. quantum evolution >=1e5 states
+
+A step timeout or failed probe STOPS the run (a wedged tunnel must not be
+hammered; memory: probes every ~15-20 min, one client only).
+
+Run:  python scripts/hw_window.py [--budget 7200] [--from N]
+"""
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from bench import _log_hw_text, _probe_tpu  # noqa: E402
+
+STEPS = [
+    # (name, timeout_s, argv, extra_env)
+    ("bench_full", 2700, [sys.executable, "bench.py"],
+     {"BENCH_BUDGET_S": "2400"}),
+    ("pde_public_6000", 900,
+     [sys.executable, "examples/pde.py", "-throughput", "-max_iter", "300",
+      "-nx", "6000", "-ny", "6000", "--precision", "f32"], {}),
+    ("gmg_grid_2000", 900,
+     [sys.executable, "examples/gmg.py", "-n", "2000", "-maxiter", "300",
+      "--precision", "f32"], {}),
+    ("gmg_grid_4000", 1200,
+     [sys.executable, "examples/gmg.py", "-n", "4000", "-maxiter", "300",
+      "--precision", "f32"], {}),
+    ("gmg_grid_4500", 1500,
+     [sys.executable, "examples/gmg.py", "-n", "4500", "-maxiter", "300",
+      "--precision", "f32"], {}),
+    ("amg_512", 1200,
+     [sys.executable, "examples/amg.py", "-n", "512", "--precision", "f32"],
+     {}),
+    ("c64_lane", 900,
+     [sys.executable, "-m", "pytest", "tests/test_complex_stacked.py", "-q"],
+     {"RUN_TPU_HW": "1"}),
+    ("c64_check", 600,
+     [sys.executable, "scripts/tpu_complex_check.py"], {}),
+    ("spgemm_micro", 900,
+     [sys.executable, "examples/spgemm_microbenchmark.py"], {}),
+    ("quantum_cycle25", 1200,
+     [sys.executable, "examples/quantum_evolution.py", "-graph", "cycle",
+      "-nodes", "25", "-t", "0.05", "--precision", "f32"], {}),
+]
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--budget", type=float, default=7200.0)
+    ap.add_argument("--from", dest="start", type=int, default=0,
+                    help="resume from step index N")
+    args = ap.parse_args()
+    t0 = time.monotonic()
+
+    def remaining():
+        return args.budget - (time.monotonic() - t0)
+
+    results = []
+    for idx, (name, step_to, argv, extra) in enumerate(STEPS):
+        if idx < args.start:
+            continue
+        if remaining() < 180:
+            print(f"hw_window: out of budget before {name}", flush=True)
+            break
+        status = _probe_tpu(min(150, remaining() - 30))
+        if status != "tpu":
+            print(f"hw_window: probe says '{status}' before {name}; STOP "
+                  f"(resume later with --from {idx})", flush=True)
+            break
+        env = dict(os.environ)
+        env.update(extra)
+        eff_to = min(step_to, max(remaining() - 30, 60))
+        budget_truncated = eff_to < step_to
+        print(f"hw_window: [{idx}] {name} (timeout {eff_to:.0f}s"
+              f"{', budget-truncated' if budget_truncated else ''})",
+              flush=True)
+        t1 = time.perf_counter()
+        try:
+            proc = subprocess.run(
+                argv, capture_output=True, text=True, cwd=REPO,
+                timeout=eff_to, env=env,
+            )
+            wall = time.perf_counter() - t1
+            _log_hw_text(name, proc.stdout + "\n--- stderr ---\n"
+                         + proc.stderr[-4000:])
+            row = {"step": name, "rc": proc.returncode,
+                   "wall_s": round(wall, 1)}
+            print(json.dumps(row), flush=True)
+            tail = [ln for ln in proc.stdout.strip().splitlines()[-8:]]
+            for ln in tail:
+                print(f"    {ln}", flush=True)
+            results.append(row)
+        except subprocess.TimeoutExpired as e:
+            # bank whatever the step printed before dying — a partial GMG
+            # log still carries init/iteration evidence
+            partial = (e.stdout or "") if isinstance(e.stdout, str) else ""
+            perr = (e.stderr or "") if isinstance(e.stderr, str) else ""
+            _log_hw_text(
+                name,
+                f"{partial}\n--- stderr ---\n{perr[-4000:]}\n"
+                f"--- TIMEOUT after {eff_to:.0f}s"
+                f"{' (budget-truncated, NOT a wedge verdict)' if budget_truncated else ''} ---",
+            )
+            if budget_truncated:
+                # killed by OUR budget, not the tunnel: the step is
+                # unfinished, resume must re-run it
+                print(f"hw_window: {name} hit the remaining-budget clamp "
+                      f"({eff_to:.0f}s < {step_to}s); resume with "
+                      f"--from {idx}", flush=True)
+            else:
+                print(f"hw_window: {name} TIMED OUT at its full {step_to}s "
+                      f"— wedge signature, STOP (resume later with "
+                      f"--from {idx})", flush=True)
+            results.append({"step": name, "rc": None, "timeout": True,
+                            "budget_truncated": budget_truncated})
+            break
+    print(json.dumps({"hw_window": results}), flush=True)
+
+
+if __name__ == "__main__":
+    main()

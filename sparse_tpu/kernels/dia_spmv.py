@@ -274,12 +274,17 @@ def autotune_dia_tile(
     """
     import time
 
+    from ..config import settings
+
     offsets = tuple(int(o) for o in offsets)
     shape = tuple(int(s) for s in shape)
     key = (offsets, shape, str(np.dtype(data.dtype)))
     if key in _TILE_CACHE:
         return _TILE_CACHE[key]
-    if jax.default_backend() != "tpu":
+    # the off-switch (SPARSE_TPU_PALLAS_AUTOTUNE=0) gates EVERY probe
+    # path, incl. bench's direct calls — it exists so an operator can
+    # forbid the extra cold Mosaic compiles on a fragile tunnel
+    if not settings.pallas_autotune or jax.default_backend() != "tpu":
         result = (65536, {})
         _TILE_CACHE[key] = result
         return result
@@ -333,12 +338,9 @@ class PreparedDia:
 
     def __init__(self, data, offsets, shape, tile: int | None = None):
         if tile is None:
-            from ..config import settings
-
-            if settings.pallas_autotune and jax.default_backend() == "tpu":
-                tile, _ = autotune_dia_tile(data, offsets, shape)
-            else:
-                tile = 65536
+            # autotune_dia_tile itself gates on settings.pallas_autotune
+            # and the backend; off / off-TPU it returns the 65536 default
+            tile, _ = autotune_dia_tile(data, offsets, shape)
         self.plan = dia_plan(tuple(int(o) for o in offsets), tuple(shape), tile=tile)
         sdt = plane_stream_dtype(data.dtype, jnp.float32, self.plan.TM)
         if sdt != jnp.dtype(data.dtype):

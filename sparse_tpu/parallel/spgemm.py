@@ -770,17 +770,26 @@ def spgemm2d_comm_stats(A, B, grid: tuple) -> dict:
     iw = 4 if max(m, n, k) < 2**31 else 8
     vw = np.result_type(A.dtype, B.dtype).itemsize
 
+    from ..ops.spgemm import _next_pow2
+
     a_nnz = a_indptr[row_splits[1:]] - a_indptr[row_splits[:-1]]  # [gx]
     b_nnz = b_csc_indptr[col_splits[1:]] - b_csc_indptr[col_splits[:-1]]
     a_rows = np.diff(row_splits)
     b_cols = np.diff(col_splits)
-    # each input replicates in its OWN dtype (the device streams
-    # advA/bdvB as a_data.dtype / b_data.dtype, not the result type)
+    # what MOVES is the pow2-padded uniform tile buffers (dist_spgemm_2d
+    # pads every block to the max block's envelope for one compile), each
+    # input in its OWN dtype (advA/bdvB stream as a_data/b_data dtypes) —
+    # identical bytes on every device by construction
+    rows_pad = _next_pow2(max(int(a_rows.max()), 1))
+    annz_pad = _next_pow2(max(int(a_nnz.max()), 1))
+    cols_pad = _next_pow2(max(int(b_cols.max()), 1))
+    bnnz_pad = _next_pow2(max(int(b_nnz.max()), 1))
     avw = np.dtype(A.dtype).itemsize
     bvw = np.dtype(B.dtype).itemsize
-    a_block_bytes = a_nnz * (iw + avw) + (a_rows + 1) * iw
-    b_block_bytes = b_nnz * (iw + bvw) + (b_cols + 1) * iw
-    repl_bytes = a_block_bytes[:, None] + b_block_bytes[None, :]  # [gx, gy]
+    repl_device_bytes = (
+        annz_pad * (iw + avw) + (rows_pad + 1) * iw
+        + bnnz_pad * (iw + bvw) + (cols_pad + 1) * iw
+    )
 
     C = (sparse_tpu.csr_array(A) @ sparse_tpu.csr_array(B)).tocsr()
     c_indptr = np.asarray(C.indptr)
@@ -812,8 +821,7 @@ def spgemm2d_comm_stats(A, B, grid: tuple) -> dict:
         "grid": [gx, gy],
         "c_nnz": int(c_indices.shape[0]),
         "tile_nnz_max": int(tile_nnz.max()),
-        "replicate_bytes_per_device_max": int(repl_bytes.max()),
-        "replicate_bytes_per_device_mean": float(repl_bytes.mean()),
+        "replicate_bytes_per_device": int(repl_device_bytes),
         "shuffle_entries_sent_max": int(crossing.max()),
         "shuffle_entries_sent_mean": float(crossing.mean()),
         "shuffle_bytes_per_device_max": int(crossing.max() * entry_bytes),

@@ -119,9 +119,9 @@ def test_spgemm2d_model_weak_scaling_shape():
     """Replication bytes per device shrink as the grid grows (each device
     holds 1/gx of A + 1/gy of B) — the 2-D layout's defining property."""
     A = _random_csr(128, 128, 0.08, 3)
-    r11 = spgemm2d_comm_stats(A, A, (1, 1))["replicate_bytes_per_device_mean"]
-    r22 = spgemm2d_comm_stats(A, A, (2, 2))["replicate_bytes_per_device_mean"]
-    r42 = spgemm2d_comm_stats(A, A, (4, 2))["replicate_bytes_per_device_mean"]
+    r11 = spgemm2d_comm_stats(A, A, (1, 1))["replicate_bytes_per_device"]
+    r22 = spgemm2d_comm_stats(A, A, (2, 2))["replicate_bytes_per_device"]
+    r42 = spgemm2d_comm_stats(A, A, (4, 2))["replicate_bytes_per_device"]
     assert r22 < r11 and r42 < r22
     # a (1,1) grid shuffles nothing
     assert spgemm2d_comm_stats(A, A, (1, 1))["shuffle_entries_sent_max"] == 0
