@@ -120,9 +120,13 @@ def main():
             f"  init x{row['init_speedup']:<6}  solve x{row['solve_speedup']}"
         )
 
-    artifact = {"parity_ok": ok, "rows": rows}
+    artifact = {"parity_ok": ok, "quick": bool(args.quick),
+                "configs": [list(c) for c in configs], "rows": rows}
     os.makedirs(os.path.join(REPO, "results"), exist_ok=True)
-    path = os.path.join(REPO, "results", "gmg_parity_matrix.json")
+    # quick smoke runs must not clobber the committed full-matrix evidence
+    name = ("gmg_parity_matrix_quick.json" if args.quick
+            else "gmg_parity_matrix.json")
+    path = os.path.join(REPO, "results", name)
     with open(path, "w") as f:
         json.dump(artifact, f, indent=1)
     print(f"parity_ok={ok}  -> {path}")

@@ -115,9 +115,15 @@ def main():
             results.append(row)
         except subprocess.TimeoutExpired as e:
             # bank whatever the step printed before dying — a partial GMG
-            # log still carries init/iteration evidence
-            partial = (e.stdout or "") if isinstance(e.stdout, str) else ""
-            perr = (e.stderr or "") if isinstance(e.stderr, str) else ""
+            # log still carries init/iteration evidence. TimeoutExpired
+            # delivers BYTES even under text=True (CPython behavior).
+            def _txt(v):
+                if isinstance(v, bytes):
+                    return v.decode(errors="replace")
+                return v or ""
+
+            partial = _txt(e.stdout)
+            perr = _txt(e.stderr)
             _log_hw_text(
                 name,
                 f"{partial}\n--- stderr ---\n{perr[-4000:]}\n"

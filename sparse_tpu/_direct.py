@@ -236,9 +236,22 @@ class _PreparedTriangular:
 
 class SuperLU:
     """LU factorization with the scipy ``SuperLU`` object surface
-    (shape, nnz, perm_r, perm_c, L, U, solve). Device-dense under the
-    hood: ``lu_factor`` runs on the accelerator (XLA-tiled LAPACK), and
-    ``solve`` is two MXU triangular solves."""
+    (shape, nnz, perm_r, perm_c, L, U, solve).
+
+    TPU phase split, two regimes:
+
+    * n <= ``DENSE_DIRECT_MAX_N``: device-dense — ``lu_factor`` on the
+      accelerator (XLA-tiled LAPACK), ``solve`` two MXU triangular solves.
+    * larger real matrices: TRUE sparse LU — the native Gilbert-Peierls
+      factorization with partial pivoting (``native.splu_host``, a host
+      setup kernel like the Gustavson SpGEMM), solves as two blocked
+      ``lax.scan`` triangular programs on device
+      (:class:`_PreparedTriangular`), O(nnz(L)+nnz(U)) memory throughout.
+      Natural column order (no COLAMD): fill is geometry-dependent;
+      pathological fill cases should use cg/gmres instead.
+
+    Complex matrices keep the dense path (the native factorization is
+    real f64), so complex n > ceiling still raises."""
 
     def __init__(self, A):
         from .csr import csr_array
@@ -247,14 +260,21 @@ class SuperLU:
         m, n = A.shape
         if m != n:
             raise ValueError("matrix must be square")
-        if n > DENSE_DIRECT_MAX_N:
-            raise ValueError(
-                f"splu: n={n} exceeds the dense-factorization ceiling "
-                f"({DENSE_DIRECT_MAX_N}); use cg/gmres/bicgstab for "
-                "large systems"
-            )
         self.shape = (m, n)
         self.nnz = A.nnz
+        self._csr = csr_array
+        is_complex = np.issubdtype(np.dtype(A.dtype), np.complexfloating)
+        if n > DENSE_DIRECT_MAX_N:
+            if not is_complex and self._init_sparse(A):
+                return
+            raise ValueError(
+                f"splu: n={n} exceeds the dense-factorization ceiling "
+                f"({DENSE_DIRECT_MAX_N}) and the native sparse-LU library "
+                "is " + ("unavailable" if not is_complex else
+                         "real-only (complex input)")
+                + "; use cg/gmres/bicgstab for large systems"
+            )
+        self._mode = "dense"
         dt = jnp.result_type(A.dtype, jnp.float32)
         dense = asjnp(A.toarray(), dt)
         from jax.scipy.linalg import lu_factor
@@ -273,16 +293,90 @@ class SuperLU:
             perm[i], perm[p] = perm[p], perm[i]
         self.perm_r = np.argsort(perm)
         self.perm_c = np.arange(n)
-        self._csr = csr_array
+
+    def _init_sparse(self, A):
+        """Native Gilbert-Peierls factorization -> device triangular-solve
+        plans. Returns False when the native library is unavailable
+        (caller falls back to the dense path / ceiling error)."""
+        from . import native
+
+        n = self.shape[0]
+        Ac = A.tocsc()
+        out = native.splu_host(
+            np.asarray(Ac.indptr, dtype=np.int64),
+            np.asarray(Ac.indices, dtype=np.int64),
+            np.asarray(Ac.data, dtype=np.float64),
+            n,
+        )
+        if out is None:
+            return False
+        Lp, Li, Lx, Up, Ui, Ux, perm = out
+        self._mode = "sparse"
+        self._perm = perm
+        self.perm_r = np.argsort(perm)  # scipy convention (see dense path)
+        self.perm_c = np.arange(n)
+        self._Lcsc = (Lp, Li, Lx)
+        self._Ucsc = (Up, Ui, Ux)
+        dt = jnp.result_type(A.dtype, jnp.float32)
+        self._dt = dt
+        Lcols = np.repeat(np.arange(n, dtype=np.int64), np.diff(Lp))
+        Ucols = np.repeat(np.arange(n, dtype=np.int64), np.diff(Up))
+        self._Lprep = _PreparedTriangular(
+            n, Li, Lcols, Lx, lower=True, unit_diagonal=True, dtype=dt
+        )
+        self._Uprep = _PreparedTriangular(
+            n, Ui, Ucols, Ux, lower=False, unit_diagonal=False, dtype=dt
+        )
+        self._LTprep = self._UTprep = None
+        return True
+
+    def _solve_sparse_real(self, bmat, trans):
+        """PA = LU:  N: x = U\\(L\\(Pb));  T/H (real factors): A^T =
+        U^T L^T P, so solve U^T then L^T and un-permute."""
+        n = self.shape[0]
+        if trans == "N":
+            y = bmat[jnp.asarray(self._perm)]
+            return self._Uprep.apply(self._Lprep.apply(y))
+        if self._UTprep is None:
+            Lp, Li, Lx = self._Lcsc
+            Up, Ui, Ux = self._Ucsc
+            Lcols = np.repeat(np.arange(n, dtype=np.int64), np.diff(Lp))
+            Ucols = np.repeat(np.arange(n, dtype=np.int64), np.diff(Up))
+            # transposes: swap (row, col); U^T is lower non-unit, L^T
+            # upper unit
+            self._UTprep = _PreparedTriangular(
+                n, Ucols, Ui, Ux, lower=True, unit_diagonal=False,
+                dtype=self._dt,
+            )
+            self._LTprep = _PreparedTriangular(
+                n, Lcols, Li, Lx, lower=False, unit_diagonal=True,
+                dtype=self._dt,
+            )
+        y = self._LTprep.apply(self._UTprep.apply(bmat))
+        return y[jnp.asarray(self.perm_r)]
 
     @property
     def L(self):
         n = self.shape[0]
+        if getattr(self, "_mode", "dense") == "sparse":
+            Lp, Li, Lx = self._Lcsc
+            cols = np.repeat(np.arange(n, dtype=np.int64), np.diff(Lp))
+            row = np.concatenate([Li, np.arange(n, dtype=np.int64)])
+            col = np.concatenate([cols, np.arange(n, dtype=np.int64)])
+            val = np.concatenate([Lx, np.ones(n)])  # explicit unit diagonal
+            indptr, row, col, val = _coo_to_csr_host(row, col, val, n)
+            return self._csr.from_parts(val, col, indptr, (n, n))
         Ld = jnp.tril(self._lu, -1) + jnp.eye(n, dtype=self._lu.dtype)
         return self._csr(np.asarray(Ld))
 
     @property
     def U(self):
+        if getattr(self, "_mode", "dense") == "sparse":
+            n = self.shape[0]
+            Up, Ui, Ux = self._Ucsc
+            cols = np.repeat(np.arange(n, dtype=np.int64), np.diff(Up))
+            indptr, row, col, val = _coo_to_csr_host(Ui, cols, Ux, n)
+            return self._csr.from_parts(val, col, indptr, (n, n))
         return self._csr(np.asarray(jnp.triu(self._lu)))
 
     def solve(self, rhs, trans="N"):
@@ -292,6 +386,20 @@ class SuperLU:
         t = {"N": 0, "T": 1, "H": 2}.get(trans)
         if t is None:
             raise ValueError("trans must be 'N', 'T' or 'H'")
+        if getattr(self, "_mode", "dense") == "sparse":
+            # real factors: A^H == A^T, so 'H' == 'T'; a complex rhs
+            # solves Re/Im parts against the same factors
+            if jnp.iscomplexobj(bmat):
+                xr = self._solve_sparse_real(
+                    jnp.real(bmat).astype(self._dt), trans
+                )
+                xi = self._solve_sparse_real(
+                    jnp.imag(bmat).astype(self._dt), trans
+                )
+                x = xr + 1j * xi
+            else:
+                x = self._solve_sparse_real(bmat.astype(self._dt), trans)
+            return x[:, 0] if squeeze else x
         if jnp.iscomplexobj(bmat) and not jnp.iscomplexobj(self._lu):
             # real factorization, complex rhs (e.g. spilu preconditioning a
             # complex Krylov solve): solve Re and Im against the same

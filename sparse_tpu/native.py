@@ -92,6 +92,18 @@ def _declare(cdll) -> None:
     cdll.ilu0_csr.argtypes = [i64, i64p, i64p, f64p]
     cdll.ic0_csr.restype = i64
     cdll.ic0_csr.argtypes = [i64, i64p, i64p, f64p]
+    cdll.splu_factor.restype = ctypes.c_void_p
+    cdll.splu_factor.argtypes = [i64, i64p, i64p, f64p, i64p]
+    cdll.splu_lnnz.restype = i64
+    cdll.splu_lnnz.argtypes = [ctypes.c_void_p]
+    cdll.splu_unnz.restype = i64
+    cdll.splu_unnz.argtypes = [ctypes.c_void_p]
+    cdll.splu_get.restype = None
+    cdll.splu_get.argtypes = [
+        ctypes.c_void_p, i64p, i64p, f64p, i64p, i64p, f64p, i64p,
+    ]
+    cdll.splu_free.restype = None
+    cdll.splu_free.argtypes = [ctypes.c_void_p]
 
 
 def _as_u64p(a):
@@ -310,3 +322,47 @@ def ic0_host(indptr, indices, data, n: int):
                     )
                 out[p] = v ** 0.5
     return out
+
+
+def splu_host(indptr, indices, data, n: int):
+    """Sparse LU with partial pivoting on host CSC arrays: P A = L U.
+
+    Gilbert-Peierls left-looking factorization (native C++; reference
+    analog: the vendor/scipy factorizations behind the reference's direct
+    solves). Inputs are the CSC parts of a square A; values factor in
+    f64. Returns ``(Lp, Li, Lx, Up, Ui, Ux, perm)`` — L unit-lower
+    (implicit diagonal) and U upper, both CSC over pivot row ids, with
+    ``perm[k]`` the original row chosen as pivot k — or ``None`` when the
+    native library is unavailable (callers keep their dense path).
+    Raises ``RuntimeError`` on a singular column.
+    """
+    import numpy as np
+
+    L = lib()
+    if L is None:
+        return None
+    indptr = np.ascontiguousarray(indptr, dtype=np.int64)
+    indices = np.ascontiguousarray(indices, dtype=np.int64)
+    data = np.ascontiguousarray(data, dtype=np.float64)
+    info = np.zeros(1, dtype=np.int64)
+    h = L.splu_factor(n, _as_i64p(indptr), _as_i64p(indices),
+                      _as_f64p(data), _as_i64p(info))
+    if not h:
+        raise RuntimeError(
+            f"splu: matrix is singular (column {-int(info[0]) - 1})"
+        )
+    try:
+        lnnz = L.splu_lnnz(h)
+        unnz = L.splu_unnz(h)
+        Lp = np.empty(n + 1, dtype=np.int64)
+        Li = np.empty(max(lnnz, 1), dtype=np.int64)
+        Lx = np.empty(max(lnnz, 1), dtype=np.float64)
+        Up = np.empty(n + 1, dtype=np.int64)
+        Ui = np.empty(max(unnz, 1), dtype=np.int64)
+        Ux = np.empty(max(unnz, 1), dtype=np.float64)
+        perm = np.empty(n, dtype=np.int64)
+        L.splu_get(h, _as_i64p(Lp), _as_i64p(Li), _as_f64p(Lx),
+                   _as_i64p(Up), _as_i64p(Ui), _as_f64p(Ux), _as_i64p(perm))
+    finally:
+        L.splu_free(h)
+    return Lp, Li[:lnnz], Lx[:lnnz], Up, Ui[:unnz], Ux[:unnz], perm
