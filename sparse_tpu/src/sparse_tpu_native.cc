@@ -461,13 +461,18 @@ int64_t splu_unnz(void* vh) { return (int64_t)((SpluHandle*)vh)->Ui.size(); }
 void splu_get(void* vh, int64_t* Lp, int64_t* Li, double* Lx, int64_t* Up,
               int64_t* Ui, double* Ux, int64_t* perm) {
   auto* h = (SpluHandle*)vh;
-  std::memcpy(Lp, h->Lp.data(), h->Lp.size() * sizeof(int64_t));
-  std::memcpy(Li, h->Li.data(), h->Li.size() * sizeof(int64_t));
-  std::memcpy(Lx, h->Lx.data(), h->Lx.size() * sizeof(double));
-  std::memcpy(Up, h->Up.data(), h->Up.size() * sizeof(int64_t));
-  std::memcpy(Ui, h->Ui.data(), h->Ui.size() * sizeof(int64_t));
-  std::memcpy(Ux, h->Ux.data(), h->Ux.size() * sizeof(double));
-  std::memcpy(perm, h->perm.data(), h->perm.size() * sizeof(int64_t));
+  // empty-vector data() may be null (diagonal matrices have empty L);
+  // memcpy from null is UB even at size 0
+  auto cp = [](void* dst, const void* src, size_t bytes) {
+    if (bytes) std::memcpy(dst, src, bytes);
+  };
+  cp(Lp, h->Lp.data(), h->Lp.size() * sizeof(int64_t));
+  cp(Li, h->Li.data(), h->Li.size() * sizeof(int64_t));
+  cp(Lx, h->Lx.data(), h->Lx.size() * sizeof(double));
+  cp(Up, h->Up.data(), h->Up.size() * sizeof(int64_t));
+  cp(Ui, h->Ui.data(), h->Ui.size() * sizeof(int64_t));
+  cp(Ux, h->Ux.data(), h->Ux.size() * sizeof(double));
+  cp(perm, h->perm.data(), h->perm.size() * sizeof(int64_t));
 }
 
 void splu_free(void* vh) { delete (SpluHandle*)vh; }
