@@ -229,3 +229,19 @@ def test_autotune_off_tpu_returns_default_and_caches():
     # PreparedDia with tile=None resolves through the same default off-TPU
     p = K.PreparedDia(data, (-1, 0, 1), (64, 64))
     assert p.plan.TM >= 1024
+
+
+def test_autotune_probe_failure_returns_default_without_crash(monkeypatch):
+    """On a backend where the chain/kernel cannot run, every candidate
+    drops out of the race and the default tile comes back — no exception
+    escapes (the wedge-safety contract of the one-attempt design)."""
+    from sparse_tpu.kernels import dia_spmv as K
+
+    K._TILE_CACHE.clear()
+    monkeypatch.setattr(K.jax, "default_backend", lambda: "tpu")
+    data = np.ones((3, 4096), dtype=np.float32)
+    tile, band = K.autotune_dia_tile(
+        data, (-1, 0, 1), (4096, 4096), chain=2, reps=1, budget_s=5
+    )
+    assert isinstance(tile, int) and tile in (16384, 32768, 65536, 131072)
+    assert ((-1, 0, 1), (4096, 4096), "float32") in K._TILE_CACHE
