@@ -307,8 +307,6 @@ def autotune_dia_tile(
         _TILE_CACHE[key] = result
         return result
 
-    t_begin = time.perf_counter()
-
     # Two clocks, never mixed in one race. Preferred: the compiled
     # fori_loop chain (one dispatch per timing) — but loop-wrapped kernels
     # are a known worker-fault class on the tunnel backend, so it gets
@@ -343,23 +341,29 @@ def autotune_dia_tile(
         # on the static plan, so every candidate's first call compiles
         # (~20-40 s through a remote tunnel) — that must never land in a
         # timed rep. Only the ACTIVE clock is warmed (finding: a spare
-        # compile per candidate can eat the whole probe budget).
+        # compile per candidate can eat the whole probe budget). Returns
+        # (best_secs, used_compiled_clock).
         if not _CHAIN_RETIRED[0]:
             run_compiled(pf, xp, plan)  # warm; may retire the clock
         if _CHAIN_RETIRED[0]:
             float(jnp.asarray(_chain_step(pf, xp, plan))[-1])  # warm host
         best = float("inf")
+        used_compiled = False
         for _ in range(reps):
             s = run_compiled(pf, xp, plan) if not _CHAIN_RETIRED[0] else None
             if s is None:
                 s = run_host(pf, xp, plan)
+            else:
+                used_compiled = True
             best = min(best, s)
-        return best
+        return best, used_compiled
 
     timings: dict[int, float] = {}
     for _race in range(2):
+        t_begin = time.perf_counter()  # each race gets the full budget
         retired_at_start = _CHAIN_RETIRED[0]
         timings = {}
+        any_compiled = False
         for tile in candidates:
             if timings and time.perf_counter() - t_begin > budget_s:
                 break  # out of probe budget: best-so-far wins
@@ -375,15 +379,20 @@ def autotune_dia_tile(
                     ),
                     plan,
                 )
-                timings[tile] = time_candidate(pf, xp, plan)
+                timings[tile], used = time_candidate(pf, xp, plan)
+                any_compiled = any_compiled or used
             except Exception:  # pragma: no cover - backend-dependent
                 continue  # an unlowerable candidate drops out of the race
-        if _CHAIN_RETIRED[0] == retired_at_start:
+        if _CHAIN_RETIRED[0] == retired_at_start or not any_compiled:
+            # no mid-race clock flip — or the flip happened before any
+            # compiled timing landed, so everything recorded is already
+            # pure host-clock: keep it, no re-race (extra device probes
+            # are wedge exposure)
             break
-        # the compiled clock died mid-race: timings mix two clocks whose
-        # offsets differ by ~a tunnel round-trip — discard and re-race
-        # everything on the host clock (the retirement is process-wide,
-        # so this happens at most once)
+        # the compiled clock died mid-race WITH compiled timings on the
+        # board: cross-clock offsets differ by ~a tunnel round-trip, so
+        # discard and re-race everything on the host clock (retirement is
+        # process-wide, so this happens at most once)
     if not timings:
         result = (65536, {})
     else:

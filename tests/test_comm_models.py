@@ -125,3 +125,16 @@ def test_spgemm2d_model_weak_scaling_shape():
     assert r22 < r11 and r42 < r22
     # a (1,1) grid shuffles nothing
     assert spgemm2d_comm_stats(A, A, (1, 1))["shuffle_entries_sent_max"] == 0
+
+
+def test_sort_model_s64_stays_capacity_bounded():
+    """S=64 at constant L: the host-only model needs no mesh, so the
+    64-shard weak-scaling claim is test-pinned directly — per-shard
+    exchange stays under the 2L capacity bound and uniform keys never
+    trip the odd-even fallback."""
+    rng = np.random.default_rng(64)
+    L = 4096
+    st = sort_comm_stats(rng.integers(0, 1 << 24, L * 64).astype(np.int64), 64)
+    assert not st["fallback_odd_even"]
+    assert st["bucket_entries_sent_max"] <= 2 * L
+    assert st["restore_entries_sent_max"] <= 2 * L

@@ -238,6 +238,10 @@ def test_autotune_probe_failure_returns_default_without_crash(monkeypatch):
     from sparse_tpu.kernels import dia_spmv as K
 
     K._TILE_CACHE.clear()
+    # the retirement flag is process-global by design; isolate it so this
+    # deliberately-failing probe can't leak host-clock-only behavior into
+    # later tests
+    monkeypatch.setattr(K, "_CHAIN_RETIRED", [False])
     monkeypatch.setattr(K.jax, "default_backend", lambda: "tpu")
     data = np.ones((3, 4096), dtype=np.float32)
     tile, band = K.autotune_dia_tile(
