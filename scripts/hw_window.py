@@ -98,32 +98,43 @@ def main():
               f"{', budget-truncated' if budget_truncated else ''})",
               flush=True)
         t1 = time.perf_counter()
+        # Popen + drain-after-kill rather than subprocess.run: run()'s
+        # TimeoutExpired carries only the bytes read up to the TIMEOUT;
+        # the explicit kill-then-drain also collects whatever the child
+        # wrote between the timeout and the kill, and hands back str not
+        # bytes. A partial GMG log still carries init/iteration evidence.
+        proc = subprocess.Popen(
+            argv, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+            text=True, cwd=REPO, env=env,
+        )
         try:
-            proc = subprocess.run(
-                argv, capture_output=True, text=True, cwd=REPO,
-                timeout=eff_to, env=env,
-            )
+            out, err = proc.communicate(timeout=eff_to)
             wall = time.perf_counter() - t1
-            _log_hw_text(name, proc.stdout + "\n--- stderr ---\n"
-                         + proc.stderr[-4000:])
+            _log_hw_text(name, out + "\n--- stderr ---\n" + err[-4000:])
             row = {"step": name, "rc": proc.returncode,
                    "wall_s": round(wall, 1)}
             print(json.dumps(row), flush=True)
-            tail = [ln for ln in proc.stdout.strip().splitlines()[-8:]]
-            for ln in tail:
+            for ln in out.strip().splitlines()[-8:]:
                 print(f"    {ln}", flush=True)
             results.append(row)
-        except subprocess.TimeoutExpired as e:
-            # bank whatever the step printed before dying — a partial GMG
-            # log still carries init/iteration evidence. TimeoutExpired
-            # delivers BYTES even under text=True (CPython behavior).
-            def _txt(v):
+        except subprocess.TimeoutExpired as outer:
+            proc.kill()
+
+            def _txt(v):  # TimeoutExpired attrs are bytes even w/ text=True
                 if isinstance(v, bytes):
                     return v.decode(errors="replace")
                 return v or ""
 
-            partial = _txt(e.stdout)
-            perr = _txt(e.stderr)
+            try:  # drain what the child printed before the kill
+                partial, perr = proc.communicate(timeout=30)
+            except subprocess.TimeoutExpired as inner:
+                # a grandchild still holds the pipes: salvage what the
+                # drain read before giving up on it
+                partial, perr = _txt(inner.stdout), _txt(inner.stderr)
+            if not partial:
+                partial = _txt(outer.stdout)  # pre-timeout reads, if any
+            if not perr:
+                perr = _txt(outer.stderr)
             _log_hw_text(
                 name,
                 f"{partial}\n--- stderr ---\n{perr[-4000:]}\n"
