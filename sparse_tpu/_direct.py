@@ -253,7 +253,9 @@ class SuperLU:
     Complex matrices keep the dense path (the native factorization is
     real f64), so complex n > ceiling still raises."""
 
-    def __init__(self, A, permc_spec=None):
+    def _setup_common(self, A):
+        """Shared constructor prologue for the splu and ILUT entry
+        points; returns the canonical csr form."""
         from .csr import csr_array
 
         A = A.tocsr()
@@ -263,6 +265,11 @@ class SuperLU:
         self.shape = (m, n)
         self.nnz = A.nnz
         self._csr = csr_array
+        return A
+
+    def __init__(self, A, permc_spec=None):
+        A = self._setup_common(A)
+        n = self.shape[0]
         is_complex = np.issubdtype(np.dtype(A.dtype), np.complexfloating)
         if n > DENSE_DIRECT_MAX_N:
             if not is_complex and self._init_sparse(A, permc_spec):
@@ -294,10 +301,12 @@ class SuperLU:
         self.perm_r = np.argsort(perm)
         self.perm_c = np.arange(n)
 
-    def _init_sparse(self, A, permc_spec=None):
+    def _init_sparse(self, A, permc_spec=None, ilut=None):
         """Native Gilbert-Peierls factorization -> device triangular-solve
         plans. Returns False when the native library is unavailable
         (caller falls back to the dense path / ceiling error).
+        ``ilut=(droptol, lfil)`` runs the INCOMPLETE variant on the same
+        machinery (the spilu fill_factor path).
 
         ``permc_spec="RCM"`` applies a SYMMETRIC reverse-Cuthill-McKee
         pre-permutation (rows and columns): fill under Gilbert-Peierls
@@ -319,9 +328,15 @@ class SuperLU:
             row, col = qinv[row], qinv[col]
         # CSC build = CSR of the transpose: sort by (col, row)
         cp, col_s, row_s, val_s = _coo_to_csr_host(col, row, val, n)
-        out = native.splu_host(
-            cp, row_s, np.asarray(val_s, dtype=np.float64), n
-        )
+        if ilut is None:
+            out = native.splu_host(
+                cp, row_s, np.asarray(val_s, dtype=np.float64), n
+            )
+        else:
+            out = native.ilut_host(
+                cp, row_s, np.asarray(val_s, dtype=np.float64), n,
+                droptol=ilut[0], lfil=ilut[1],
+            )
         if out is None:
             return False
         Lp, Li, Lx, Up, Ui, Ux, perm = out
@@ -357,6 +372,24 @@ class SuperLU:
         )
         self._LTprep = self._UTprep = None
         return True
+
+    @classmethod
+    def _ilut(cls, A, drop_tol, fill_factor):
+        """ILUT(p, tau) preconditioner with the SuperLU object surface —
+        scipy's actual ``spilu(drop_tol, fill_factor)`` algorithm, run on
+        the sparse-LU machinery (no size ceiling; real matrices). The
+        per-column keep count is ``fill_factor`` x the mean column count
+        split over the two factor halves. Returns None when the native
+        library is unavailable (caller falls back to ILU(0))."""
+        self = cls.__new__(cls)
+        A = self._setup_common(A)
+        n = self.shape[0]
+        avg = max(A.nnz / max(n, 1), 1.0)
+        lfil = max(1, int(np.ceil(float(fill_factor) * avg / 2.0)))
+        droptol = 1e-4 if drop_tol is None else float(drop_tol)
+        if not self._init_sparse(A, ilut=(droptol, lfil)):
+            return None
+        return self
 
     def _solve_sparse_real(self, bmat, trans):
         """PA = LU:  N: x = U\\(L\\(Pb));  T/H (real factors): A^T =
@@ -644,16 +677,30 @@ def splu(A, permc_spec=None, diag_pivot_thresh=None, relax=None,
 def spilu(A, drop_tol=None, fill_factor=None, drop_rule=None, **kw):
     """Incomplete-LU preconditioner factory (scipy.sparse.linalg.spilu).
 
-    Returns a real sparse ILU(0) factorization (:class:`SpILU`): O(nnz)
-    memory with no size ceiling, honoring ``drop_tol`` as a post-
-    factorization row-norm threshold. ``fill_factor``/``drop_rule`` are
-    accepted and ignored — ILU(0) never ADDS fill, so the fill cap is
-    vacuously satisfied (documented deviation from scipy's ILUT).
-    Complex matrices keep the exact dense factorization (the native
-    ILU(0) kernels are real; the pre-r4 behavior, size ceiling applies).
+    Two regimes, both O(nnz(factors)) memory with no size ceiling:
+
+    * ``fill_factor`` given (scipy's ILUT semantics): a TRUE ILUT(p, tau)
+      via the native Gilbert-Peierls core — threshold drop at
+      ``drop_tol`` (default 1e-4, scipy's default) relative to each
+      column's norm, at most ``fill_factor`` x the mean column count kept
+      per column across the two factor halves, partial pivoting.
+    * ``fill_factor`` omitted: ILU(0) on A's pattern (:class:`SpILU`),
+      honoring ``drop_tol`` as a post-factorization row-norm thinning —
+      the zero-fill preconditioner (documented deviation: scipy always
+      runs ILUT; ILU(0) is cheaper to build and its solves match the
+      reference's common usage).
+
+    ``drop_rule`` is accepted and ignored. Complex matrices keep the
+    exact dense factorization (the native kernels are real; size ceiling
+    applies).
     """
     if np.issubdtype(np.dtype(A.dtype), np.complexfloating):
         return SuperLU(A)
+    if fill_factor is not None:
+        obj = SuperLU._ilut(A, drop_tol, fill_factor)
+        if obj is not None:
+            return obj
+        # no native library: fall through to the ILU(0) factorization
     return SpILU(A, drop_tol=drop_tol)
 
 

@@ -94,6 +94,10 @@ def _declare(cdll) -> None:
     cdll.ic0_csr.argtypes = [i64, i64p, i64p, f64p]
     cdll.splu_factor.restype = ctypes.c_void_p
     cdll.splu_factor.argtypes = [i64, i64p, i64p, f64p, i64p]
+    cdll.ilut_factor.restype = ctypes.c_void_p
+    cdll.ilut_factor.argtypes = [
+        i64, i64p, i64p, f64p, ctypes.c_double, i64, i64p,
+    ]
     cdll.splu_lnnz.restype = i64
     cdll.splu_lnnz.argtypes = [ctypes.c_void_p]
     cdll.splu_unnz.restype = i64
@@ -324,6 +328,54 @@ def ic0_host(indptr, indices, data, n: int):
     return out
 
 
+def _lu_extract(L, h, n: int):
+    """Copy a factor handle's CSC parts out and free it."""
+    import numpy as np
+
+    try:
+        lnnz = L.splu_lnnz(h)
+        unnz = L.splu_unnz(h)
+        Lp = np.empty(n + 1, dtype=np.int64)
+        Li = np.empty(max(lnnz, 1), dtype=np.int64)
+        Lx = np.empty(max(lnnz, 1), dtype=np.float64)
+        Up = np.empty(n + 1, dtype=np.int64)
+        Ui = np.empty(max(unnz, 1), dtype=np.int64)
+        Ux = np.empty(max(unnz, 1), dtype=np.float64)
+        perm = np.empty(n, dtype=np.int64)
+        L.splu_get(h, _as_i64p(Lp), _as_i64p(Li), _as_f64p(Lx),
+                   _as_i64p(Up), _as_i64p(Ui), _as_f64p(Ux), _as_i64p(perm))
+    finally:
+        L.splu_free(h)
+    return Lp, Li[:lnnz], Lx[:lnnz], Up, Ui[:unnz], Ux[:unnz], perm
+
+
+def ilut_host(indptr, indices, data, n: int, droptol: float, lfil: int):
+    """ILUT(p, tau) on host CSC arrays via the Gilbert-Peierls core: drop
+    |entry| < droptol * ||A(:,j)||_2 (pivot kept), keep the ``lfil``
+    largest per column in each of L and off-diagonal U (0 = unlimited).
+    Same return contract as :func:`splu_host`; ``None`` without the
+    native library. Reference analog: scipy's SuperLU ILUT behind
+    ``spilu(drop_tol, fill_factor)``.
+    """
+    import numpy as np
+
+    L = lib()
+    if L is None:
+        return None
+    indptr = np.ascontiguousarray(indptr, dtype=np.int64)
+    indices = np.ascontiguousarray(indices, dtype=np.int64)
+    data = np.ascontiguousarray(data, dtype=np.float64)
+    info = np.zeros(1, dtype=np.int64)
+    h = L.ilut_factor(n, _as_i64p(indptr), _as_i64p(indices),
+                      _as_f64p(data), float(droptol), int(lfil),
+                      _as_i64p(info))
+    if not h:
+        raise RuntimeError(
+            f"ilut: matrix is singular (column {-int(info[0]) - 1})"
+        )
+    return _lu_extract(L, h, n)
+
+
 def splu_host(indptr, indices, data, n: int):
     """Sparse LU with partial pivoting on host CSC arrays: P A = L U.
 
@@ -351,18 +403,4 @@ def splu_host(indptr, indices, data, n: int):
         raise RuntimeError(
             f"splu: matrix is singular (column {-int(info[0]) - 1})"
         )
-    try:
-        lnnz = L.splu_lnnz(h)
-        unnz = L.splu_unnz(h)
-        Lp = np.empty(n + 1, dtype=np.int64)
-        Li = np.empty(max(lnnz, 1), dtype=np.int64)
-        Lx = np.empty(max(lnnz, 1), dtype=np.float64)
-        Up = np.empty(n + 1, dtype=np.int64)
-        Ui = np.empty(max(unnz, 1), dtype=np.int64)
-        Ux = np.empty(max(unnz, 1), dtype=np.float64)
-        perm = np.empty(n, dtype=np.int64)
-        L.splu_get(h, _as_i64p(Lp), _as_i64p(Li), _as_f64p(Lx),
-                   _as_i64p(Up), _as_i64p(Ui), _as_f64p(Ux), _as_i64p(perm))
-    finally:
-        L.splu_free(h)
-    return Lp, Li[:lnnz], Lx[:lnnz], Up, Ui[:unnz], Ux[:unnz], perm
+    return _lu_extract(L, h, n)

@@ -343,13 +343,15 @@ struct SpluHandle {
 
 }  // namespace
 
-extern "C" {
-
-// Factor the n x n CSC matrix (Ap, Ai, Ax). Returns an opaque handle (or
-// nullptr on failure) and sets *info to 0, or -(j+1) when column j has no
-// usable pivot (structurally or numerically singular).
-void* splu_factor(int64_t n, const int64_t* Ap, const int64_t* Ai,
-                  const double* Ax, int64_t* info) {
+// Shared Gilbert-Peierls core. droptol == 0 && lfil == 0 -> exact LU;
+// otherwise ILUT(p, tau): entries with |x| < droptol * ||A(:,j)||_2 are
+// dropped (pivot always kept) and at most lfil largest-|value| entries
+// are kept per column in EACH of L and U-off-diagonal (lfil == 0 means
+// unlimited). Dropping shrinks downstream reach, which is the point.
+static SpluHandle* lu_factor_core(int64_t n, const int64_t* Ap,
+                                  const int64_t* Ai, const double* Ax,
+                                  double droptol, int64_t lfil,
+                                  int64_t* info) {
   auto* h = new SpluHandle();
   h->n = n;
   h->Lp.assign(1, 0);
@@ -359,7 +361,7 @@ void* splu_factor(int64_t n, const int64_t* Ap, const int64_t* Ai,
   std::vector<double> x(n, 0.0);
   std::vector<unsigned char> mark(n, 0);
   std::vector<int64_t> topo, stack, pstack;
-  std::vector<std::pair<int64_t, double>> ucol;
+  std::vector<std::pair<int64_t, double>> ucol, lcol;
   topo.reserve(64);
   *info = 0;
 
@@ -399,7 +401,12 @@ void* splu_factor(int64_t n, const int64_t* Ap, const int64_t* Ai,
       }
     }
     // numeric: scatter A(:, j), eliminate in reverse postorder
-    for (int64_t p = Ap[j]; p < Ap[j + 1]; ++p) x[Ai[p]] = Ax[p];
+    double cn2 = 0.0;
+    for (int64_t p = Ap[j]; p < Ap[j + 1]; ++p) {
+      x[Ai[p]] = Ax[p];
+      cn2 += Ax[p] * Ax[p];
+    }
+    const double tau = droptol > 0.0 ? droptol * std::sqrt(cn2) : 0.0;
     for (int64_t t = (int64_t)topo.size() - 1; t >= 0; --t) {
       int64_t i = topo[t];
       int64_t k = pinv[i];
@@ -430,22 +437,53 @@ void* splu_factor(int64_t n, const int64_t* Ap, const int64_t* Ai,
     pinv[piv] = j;
     h->perm[j] = piv;
     // emit: pivoted rows -> U(:, j) (incl. the new diagonal), unpivoted
-    // rows -> L(:, j) scaled by the pivot; clear the workspace
+    // rows -> L(:, j) scaled by the pivot; ILUT drops on |x| < tau
+    // (never the pivot) then keeps the lfil largest per half; clear the
+    // workspace
     ucol.clear();
+    lcol.clear();
     for (int64_t i : topo) {
       if (pinv[i] >= 0) {
-        ucol.emplace_back(pinv[i], x[i]);
-      } else if (x[i] != 0.0) {
-        h->Li.push_back(i);  // ORIGINAL row id; remapped after the loop
-        h->Lx.push_back(x[i] / d);
+        if (pinv[i] == j || std::fabs(x[i]) >= tau)
+          ucol.emplace_back(pinv[i], x[i]);
+      } else if (x[i] != 0.0 && std::fabs(x[i]) >= tau) {
+        lcol.emplace_back(i, x[i] / d);  // ORIGINAL row id; remapped later
       }
       x[i] = 0.0;
       mark[i] = 0;
+    }
+    if (lfil > 0) {
+      auto by_mag = [](const std::pair<int64_t, double>& a,
+                       const std::pair<int64_t, double>& b) {
+        return std::fabs(a.second) > std::fabs(b.second);
+      };
+      if ((int64_t)lcol.size() > lfil) {
+        std::nth_element(lcol.begin(), lcol.begin() + lfil, lcol.end(),
+                         by_mag);
+        lcol.resize(lfil);
+      }
+      // U keeps its diagonal unconditionally + the lfil largest others
+      if ((int64_t)ucol.size() > lfil + 1) {
+        auto diag_it = std::find_if(
+            ucol.begin(), ucol.end(),
+            [j](const std::pair<int64_t, double>& e) { return e.first == j; });
+        std::swap(*diag_it, ucol.back());
+        auto dent = ucol.back();
+        ucol.pop_back();
+        std::nth_element(ucol.begin(), ucol.begin() + lfil, ucol.end(),
+                         by_mag);
+        ucol.resize(lfil);
+        ucol.push_back(dent);
+      }
     }
     std::sort(ucol.begin(), ucol.end());
     for (auto& e : ucol) {
       h->Ui.push_back(e.first);
       h->Ux.push_back(e.second);
+    }
+    for (auto& e : lcol) {
+      h->Li.push_back(e.first);
+      h->Lx.push_back(e.second);
     }
     h->Lp.push_back((int64_t)h->Li.size());
     h->Up.push_back((int64_t)h->Ui.size());
@@ -453,6 +491,23 @@ void* splu_factor(int64_t n, const int64_t* Ap, const int64_t* Ai,
   // L row ids -> pivot space (every row is pivoted by now)
   for (auto& i : h->Li) i = pinv[i];
   return h;
+}
+
+extern "C" {
+
+// Exact factorization of the n x n CSC matrix (Ap, Ai, Ax). Returns an
+// opaque handle (or nullptr on failure) and sets *info to 0, or -(j+1)
+// when column j has no usable pivot.
+void* splu_factor(int64_t n, const int64_t* Ap, const int64_t* Ai,
+                  const double* Ax, int64_t* info) {
+  return lu_factor_core(n, Ap, Ai, Ax, 0.0, 0, info);
+}
+
+// ILUT(p, tau) incomplete factorization — same handle/getter protocol.
+void* ilut_factor(int64_t n, const int64_t* Ap, const int64_t* Ai,
+                  const double* Ax, double droptol, int64_t lfil,
+                  int64_t* info) {
+  return lu_factor_core(n, Ap, Ai, Ax, droptol, lfil, info);
 }
 
 int64_t splu_lnnz(void* vh) { return (int64_t)((SpluHandle*)vh)->Li.size(); }

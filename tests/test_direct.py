@@ -442,3 +442,49 @@ def test_splu_rcm_ordering_cuts_fill(sparse_lu_forced):
         np.testing.assert_allclose(
             LU[lu.perm_r], S.toarray()[:, lu.perm_c], atol=1e-10
         )
+
+
+def test_spilu_fill_factor_runs_true_ilut(sparse_lu_forced):
+    """fill_factor given -> scipy's actual ILUT algorithm (threshold drop
+    + per-column fill cap on the Gilbert-Peierls core), not ILU(0)."""
+    m = 20
+    n = m * m
+    ex = np.ones(m)
+    T1 = sp.diags([-ex[:-1], 2 * ex, -ex[:-1]], [-1, 0, 1])
+    T2 = sp.diags([-100 * ex[:-1], 200 * ex, -100 * ex[:-1]], [-1, 0, 1])
+    S = (sp.kron(sp.identity(m), T1) + sp.kron(T2, sp.identity(m))).tocsr()
+    A = sparse.csr_array(S)
+    ilut = linalg.spilu(A, drop_tol=1e-3, fill_factor=10)
+    assert type(ilut).__name__ == "SuperLU" and ilut._mode == "sparse"
+    # fill bound: per column each half keeps <= ceil(ff * avg / 2), plus
+    # the U diagonals
+    avg = S.nnz / n
+    lfil = int(np.ceil(10 * avg / 2.0))
+    lnnz = ilut._Lcsc[2].size
+    unnz = ilut._Ucsc[2].size
+    assert lnnz <= lfil * n and unnz <= (lfil + 1) * n
+    # preconditions CG at least as well as ILU(0), far better than plain
+    b = np.random.default_rng(3).standard_normal(n)
+    def iters(M=None):
+        kw = {}
+        if M is not None:
+            kw["M"] = linalg.LinearOperator((n, n), dtype=np.float64,
+                                            matvec=M.solve)
+        _, it = linalg.cg(A, b, tol=1e-10, maxiter=2000, **kw)
+        return it
+    it_p, it_0, it_t = iters(), iters(linalg.spilu(A)), iters(ilut)
+    assert it_t <= it_0 < it_p
+    # the fill cap genuinely caps: with NO threshold drop, fill_factor=1
+    # must stay within its per-half-column bound and well under ff=20
+    tight = linalg.spilu(A, drop_tol=0.0, fill_factor=1)
+    loose = linalg.spilu(A, drop_tol=0.0, fill_factor=20)
+    lfil1 = int(np.ceil(avg / 2.0))
+    tnnz = tight._Lcsc[2].size + tight._Ucsc[2].size
+    assert tnnz <= 2 * lfil1 * n + n
+    assert tnnz < loose._Lcsc[2].size + loose._Ucsc[2].size
+    # no-native fallback: fill_factor silently degrades to ILU(0)
+    from sparse_tpu import native
+    from unittest import mock
+    with mock.patch.object(native, "ilut_host", lambda *a, **k: None):
+        obj = linalg.spilu(A, fill_factor=10)
+        assert type(obj).__name__ == "SpILU"
