@@ -61,6 +61,9 @@ STEPS = [
      [sys.executable, "scripts/tpu_complex_check.py"], {}),
     ("spgemm_micro", 900,
      [sys.executable, "examples/spgemm_microbenchmark.py"], {}),
+    ("dot_micro_10m", 900,
+     [sys.executable, "examples/dot_microbenchmark.py", "-n", "10000000",
+      "-i", "200", "--precision", "f32"], {}),
     ("quantum_cycle25", 1200,
      [sys.executable, "examples/quantum_evolution.py", "-graph", "cycle",
       "-nodes", "25", "-t", "0.05", "--precision", "f32"], {}),
