@@ -31,6 +31,13 @@ def _env_str(name: str, default: str) -> str:
     return os.environ.get(name, default)
 
 
+def _env_int(name: str, default: int) -> int:
+    try:
+        return int(os.environ.get(name, default))
+    except ValueError:
+        return default
+
+
 @dataclass
 class Settings:
     precise_windows: bool = field(
@@ -79,6 +86,15 @@ class Settings:
             if os.environ.get("SPARSE_TPU_FUSED_CG", "").lower() == "force"
             else _env_bool("SPARSE_TPU_FUSED_CG", True)
         )
+    )
+    # Row-tile for the fused CG iteration on the PUBLIC cg path. 65536 is
+    # the best variant across every hardware sweep (bench's
+    # twopass_t65536 headline, r2-r4); the kernel default of 16384 is the
+    # conservative VMEM floor kept for direct callers.
+    # The public path clamps this down for many-diagonal operators (VMEM
+    # plane scratch scales as 2*D*TM; see linalg._try_fused_cg).
+    fused_cg_tile: int = field(
+        default_factory=lambda: _env_int("SPARSE_TPU_FUSED_CG_TILE", 65536)
     )
 
 

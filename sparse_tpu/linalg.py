@@ -441,6 +441,15 @@ def _try_fused_cg(A, b, x0, tol, maxiter, conv_test_iters):
         if x0 is not None:
             x0 = jax.device_put(x0, dev)
 
+    # Known-best tile from the hardware sweeps (settings.fused_cg_tile,
+    # 65536), clamped so the kernel's VMEM plane scratch (2 * D double-
+    # buffered [TM] streams + ~10 vector buffers) stays ~<= 6 MB — a
+    # 32-diagonal operator at 65536 would need 17+ MB and fail Mosaic
+    # compilation outright, and cg() has no fallback past this gate.
+    D = len(offsets)
+    tile = max(16384, min(int(settings.fused_cg_tile),
+                          (6 << 20) // (max(2 * D + 10, 1) * 4)))
+
     tol2 = float(tol) ** 2
     chunk = max(int(conv_test_iters), 1)
     state = None
@@ -455,7 +464,7 @@ def _try_fused_cg(A, b, x0, tol, maxiter, conv_test_iters):
         k = min(chunk, max(maxiter - 1 - iters, 1))
         k = min(k, maxiter - iters)
         x, _r, rho, state = cg_dia_fused(
-            planes, offsets, b, x0, m, iters=k,
+            planes, offsets, b, x0, m, iters=k, tile=tile,
             state=state, return_state=True, interpret=interpret,
         )
         iters += k
