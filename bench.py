@@ -76,6 +76,81 @@ def _log_hw_text(name: str, text: str) -> None:
         traceback.print_exc(file=sys.stderr)
 
 
+def _log_session_record(rec, status: str, t_start: float) -> None:
+    """Append one machine-parseable SESSION record to records.jsonl on
+    EVERY bench run — wedged probe included (the observability gap that
+    left earlier rounds without a usable session log when the TPU probe
+    timed out). Session records carry ``kind`` and no top-level
+    ``metric``, so ``_freshest_session_record`` (which requires a
+    ``metric`` with '_tpu') can never mistake one for a live hardware
+    measurement. Includes the in-process telemetry summary when
+    SPARSE_TPU_TELEMETRY is on (worker subprocesses append their own
+    solver/autotune/comm events to the same log directly)."""
+    entry = {
+        "kind": "bench.session",
+        "status": status,
+        "budget_spent_s": round(time.monotonic() - t_start, 1),
+        "record": rec,
+    }
+    if os.environ.get("SPARSE_TPU_TELEMETRY"):
+        try:
+            from sparse_tpu import telemetry
+
+            entry["telemetry"] = telemetry.summary()
+        except Exception:
+            traceback.print_exc(file=sys.stderr)
+    _log_hw_record(entry)
+
+
+def _telemetry_models_stage(platform: str) -> None:
+    """With telemetry on, bank the session's structural models as events
+    (sparse_tpu.telemetry): the samplesort comm model is pure host
+    arithmetic, and off-TPU the SpMV comm model and the autotune gate
+    decision are recorded too, so even a CPU-only session log documents
+    the tile choice and the collective volumes the code WOULD move.
+    On TPU only the host-side model runs — real autotune probes and
+    solver events come from the measurement stages, and extra eager
+    device ops on a fragile tunnel are wedge exposure. Never fatal."""
+    try:
+        from sparse_tpu import telemetry
+
+        if not telemetry.enabled():
+            return
+        import numpy as np
+
+        from sparse_tpu.parallel.sort import sort_comm_stats
+
+        keys = np.random.default_rng(0).permutation(1 << 12).astype(np.int64)
+        st = sort_comm_stats(keys, 8)
+        telemetry.record(
+            "comm.sort", S=8, model=True, n=int(keys.size),
+            fallback_odd_even=st["fallback_odd_even"],
+            bucket_entries_sent_max=st["bucket_entries_sent_max"],
+            bytes=8 * (
+                st["exchange_bytes_per_shard_max"]
+                + st["sample_allgather_bytes_per_shard"]
+            ),
+        )
+        if platform != "tpu":
+            import jax.numpy as jnp
+
+            import sparse_tpu
+            from sparse_tpu.kernels.dia_spmv import autotune_dia_tile
+            from sparse_tpu.parallel.dist import shard_csr
+
+            # records an autotune.result (probed=False, gated) event
+            autotune_dia_tile(
+                jnp.ones((11, 1 << 14), dtype=jnp.float32),
+                tuple(range(-5, 6)), (1 << 14, 1 << 14),
+            )
+            # shard_csr records the comm.spmv structural model event
+            e = np.ones(256)
+            A = sparse_tpu.diags([-e[:-1], 2 * e, -e[:-1]], [-1, 0, 1]).tocsr()
+            shard_csr(A)
+    except Exception:
+        traceback.print_exc(file=sys.stderr)
+
+
 def _freshest_session_record():
     """Newest logged TPU record from records.jsonl, or None."""
     try:
@@ -451,6 +526,7 @@ def worker(platform_arg: str) -> None:
     enable_compilation_cache()  # reruns skip the 20-40 s tunnel compiles
 
     platform = jax.devices()[0].platform
+    _telemetry_models_stage(platform)
     if platform != "cpu":
         rec = None
         n = 6000
@@ -1019,6 +1095,9 @@ def main():
             }
         print(json.dumps(rec))
         sys.stdout.flush()
+        # the session log gets a record for EVERY run — probe timeouts and
+        # all — so the round artifact chain never goes dark again
+        _log_session_record(rec, status, t_start)
 
 
 if __name__ == "__main__":

@@ -680,9 +680,11 @@ def spilu(A, drop_tol=None, fill_factor=None, drop_rule=None, **kw):
     Two regimes, both O(nnz(factors)) memory with no size ceiling:
 
     * ``fill_factor`` given (scipy's ILUT semantics): a TRUE ILUT(p, tau)
-      via the native Gilbert-Peierls core — threshold drop at
-      ``drop_tol`` (default 1e-4, scipy's default) relative to each
-      column's norm, at most ``fill_factor`` x the mean column count kept
+      via the native Gilbert-Peierls core — SuperLU/Saad threshold drops
+      (``drop_tol`` default 1e-4, scipy's default): U entries drop below
+      ``drop_tol * ||A(:,j)||_2``, L entries drop when the SCALED
+      multiplier ``|l_ij|`` (pivot picked first) falls below
+      ``drop_tol``; at most ``fill_factor`` x the mean column count kept
       per column across the two factor halves, partial pivoting.
     * ``fill_factor`` omitted: ILU(0) on A's pattern (:class:`SpILU`),
       honoring ``drop_tol`` as a post-factorization row-norm thinning —
@@ -713,9 +715,22 @@ def factorized(A):
 @track_provenance
 def inv(A):
     """Sparse inverse via one factorization + n MXU triangular solves
-    (scipy.sparse.linalg.inv; returns the same sparse format)."""
-    lu = splu(A)
+    (scipy.sparse.linalg.inv; returns the same sparse format).
+
+    Guarded at ``DENSE_DIRECT_MAX_N`` independently of the splu ceiling
+    (ADVICE r5): splu now succeeds above it in sparse mode, but the
+    inverse of a sparse matrix is dense — a large n would attempt an
+    n x n materialization (multi-TB at 1e6 rows) and die in an OOM
+    instead of an informative error.
+    """
     n = A.shape[0]
+    if n > DENSE_DIRECT_MAX_N:
+        raise ValueError(
+            f"inv: n={n} exceeds the dense ceiling ({DENSE_DIRECT_MAX_N}); "
+            "the inverse of a sparse matrix is dense — use factorized(A) "
+            "(or splu(A).solve) to apply A^-1 to vectors instead"
+        )
+    lu = splu(A)
     # mode-independent dtype: dense mode factors in _lu's dtype, sparse
     # mode in _dt — and a dense n x n inverse is produced either way
     dt = lu._lu.dtype if getattr(lu, "_mode", "dense") == "dense" else lu._dt

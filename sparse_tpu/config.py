@@ -12,6 +12,10 @@ Flags (all env-overridable):
   SPARSE_TPU_SPMV_MODE        - 'auto' | 'segment' | 'ell' | 'pallas': SpMV kernel choice.
   SPARSE_TPU_FORCE_SERIAL     - force single-shard execution of distributed conversions
                                 (mirrors the force_serial special case in coo.py:242).
+  SPARSE_TPU_TELEMETRY        - structured observability (sparse_tpu.telemetry): solver
+                                events, kernel counters, comm volumes, JSONL session log.
+  SPARSE_TPU_TELEMETRY_PATH   - JSONL sink override (default results/axon/records.jsonl).
+  SPARSE_TPU_TELEMETRY_RING   - in-memory event ring capacity (default 4096).
 """
 
 from __future__ import annotations
@@ -95,6 +99,23 @@ class Settings:
     # plane scratch scales as 2*D*TM; see linalg._try_fused_cg).
     fused_cg_tile: int = field(
         default_factory=lambda: _env_int("SPARSE_TPU_FUSED_CG_TILE", 65536)
+    )
+    # Structured observability (sparse_tpu.telemetry). Off by default:
+    # every instrumentation site is a single attribute check when
+    # disabled. When on, solver iterations, autotune probes and
+    # structural comm volumes are recorded to a bounded in-memory ring
+    # and appended as JSONL to results/axon/records.jsonl (the committed
+    # hardware-evidence log bench.py already reads).
+    telemetry: bool = field(
+        default_factory=lambda: _env_bool("SPARSE_TPU_TELEMETRY", False)
+    )
+    # Empty string = the default sink (results/axon/records.jsonl next to
+    # the repo root). A relative override resolves against the cwd.
+    telemetry_path: str = field(
+        default_factory=lambda: _env_str("SPARSE_TPU_TELEMETRY_PATH", "")
+    )
+    telemetry_ring: int = field(
+        default_factory=lambda: max(_env_int("SPARSE_TPU_TELEMETRY_RING", 4096), 16)
     )
 
 

@@ -21,17 +21,29 @@ import inspect
 
 import jax
 
+from .config import settings
+
 
 def track_provenance(fn):
     """Wrap a public op so its trace carries a ``sparse_tpu.<name>`` scope.
 
     Profiles (``jax.profiler``) then attribute fused HLO back to the
     user-level library call — the named_scope mapping of SURVEY §5.
+
+    The provenance scopes double as telemetry event sources: with
+    ``settings.telemetry`` on, every public entry is counted under its
+    scope name (``telemetry.summary()["counts"]``), so a session log says
+    which library calls a workload actually exercised — the task-launch
+    attribution the reference gets from Legion provenance, without it.
     """
     scope = f"sparse_tpu.{fn.__qualname__}"
 
     @functools.wraps(fn)
     def wrapper(*args, **kwargs):
+        if settings.telemetry:
+            from . import telemetry
+
+            telemetry.count(scope)
         with jax.named_scope(scope):
             return fn(*args, **kwargs)
 

@@ -292,20 +292,32 @@ def autotune_dia_tile(
     """
     import time
 
+    from .. import telemetry
     from ..config import settings
 
     offsets = tuple(int(o) for o in offsets)
     shape = tuple(int(s) for s in shape)
     key = (offsets, shape, str(np.dtype(data.dtype)))
     if key in _TILE_CACHE:
+        telemetry.count("autotune.cache_hit")
         return _TILE_CACHE[key]
     # the off-switch (SPARSE_TPU_PALLAS_AUTOTUNE=0) gates EVERY probe
     # path, incl. bench's direct calls — it exists so an operator can
-    # forbid the extra cold Mosaic compiles on a fragile tunnel
+    # forbid the extra cold Mosaic compiles on a fragile tunnel.
+    # The gate result is NOT memoized (ADVICE r5): caching it under the
+    # geometry key would make a later same-session flip of the setting
+    # (or a backend change) return the gate default as if a probe ran.
     if not settings.pallas_autotune or jax.default_backend() != "tpu":
-        result = (65536, {})
-        _TILE_CACHE[key] = result
-        return result
+        reason = (
+            "autotune-disabled" if not settings.pallas_autotune
+            else "backend-not-tpu"
+        )
+        telemetry.record(
+            "autotune.result", tile=65536, probed=False, reason=reason,
+            shape=list(shape), diags=len(offsets),
+            dtype=str(np.dtype(data.dtype)),
+        )
+        return (65536, {})
 
     # Two clocks, never mixed in one race. Preferred: the compiled
     # fori_loop chain (one dispatch per timing) — but loop-wrapped kernels
@@ -398,6 +410,12 @@ def autotune_dia_tile(
     else:
         result = (min(timings, key=timings.get), timings)
     _TILE_CACHE[key] = result
+    telemetry.record(
+        "autotune.probe", tile=result[0], shape=list(shape),
+        diags=len(offsets), dtype=str(np.dtype(data.dtype)),
+        timings_us={str(t): round(s * 1e6, 1) for t, s in result[1].items()},
+        clock="host" if _CHAIN_RETIRED[0] else "compiled",
+    )
     return result
 
 
@@ -427,10 +445,18 @@ class PreparedDia:
         if sdt != jnp.dtype(data.dtype):
             data = data.astype(sdt)  # misaligned TM: stream at f32
         self.planes = dia_pack(data, self.plan)
+        from .. import telemetry
+
+        telemetry.count("kernel.dia_pack")
 
     def __call__(self, x, interpret=None):
         if interpret is None:
             interpret = jax.default_backend() != "tpu"
+        from .. import telemetry
+
+        # dispatch counter (counts trace entries once when called under
+        # jit — kernel dispatch counts, not device executions)
+        telemetry.count("kernel.dia_spmv_packed")
         y = dia_spmv_packed(
             self.planes, dia_pad_x(x, self.plan), self.plan, interpret=interpret
         )
@@ -510,11 +536,16 @@ def cached_prepared_spmv(obj, attr: str, data, offsets, shape, x):
             raise
         # never swallow silently: if this was a genuine kernel bug whose
         # message merely pattern-matched, the warning is the breadcrumb
+        from .. import telemetry
         from ..utils import user_warning
 
         user_warning(
             "Pallas DIA SpMV unavailable; failing over to the XLA "
             f"formulation permanently for this matrix: {e!r}"
+        )
+        telemetry.record(
+            "kernel.failover", kernel="dia_spmv", error=repr(e)[:200],
+            backend=jax.default_backend(),
         )
         setattr(obj, attr, _PALLAS_UNAVAILABLE)
         return None

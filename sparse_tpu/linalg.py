@@ -21,6 +21,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from . import telemetry
 from .base import SparseArray
 from .coverage import track_provenance
 from .utils import asjnp, host_int
@@ -323,6 +324,55 @@ def _vdot(a, b):
     return jnp.vdot(a, b)
 
 
+# -- telemetry plumbing ------------------------------------------------------
+# Per-iteration solver events reach the recorder three ways, matching the
+# three loop disciplines: host loops record directly (their per-iteration
+# dispatch already syncs), compiled lax.while_loop bodies tap out through
+# jax.debug.callback (concrete values arrive host-side; the tap — and its
+# extra ||r||^2 — exists only when telemetry is enabled, so the disabled
+# trace is unchanged), and the fused-CG chunk loop reuses the rho scalar
+# it already fetches per conv-test chunk (zero extra syncs).
+
+
+def _solve_event(solver: str, n, iters, path: str, resid2=None) -> None:
+    """One ``solver.solve`` event per completed solve (any path)."""
+    if not telemetry.enabled():
+        return
+    fields = {"solver": solver, "n": int(n), "iters": int(iters), "path": path}
+    if resid2 is not None:
+        fields["resid2"] = float(resid2)
+    telemetry.record("solver.solve", **fields)
+
+
+def _make_iter_tap(solver: str, path: str = "device"):
+    """Host-side tap for jax.debug.callback inside compiled solver loops,
+    or None when tapping is off. Taps run on the CPU backend only: host
+    callbacks out of device loops are an unproven class through the
+    remote-tunnel TPU backend (host/eager traffic is its documented
+    wedge trigger), and the TPU-relevant solve paths (fused CG chunks,
+    GMRES restart cycles) already report through scalars they fetch
+    anyway."""
+    if not telemetry.enabled() or jax.default_backend() != "cpu":
+        return None
+
+    def tap(i, rn2):
+        telemetry.record(
+            "solver.iter", solver=solver, path=path,
+            iter=int(i), resid2=float(rn2),
+        )
+
+    return tap
+
+
+def _effects_barrier() -> None:
+    """Drain pending debug-callback effects so tapped iteration events are
+    recorded before the solve returns (best-effort across jax versions)."""
+    try:
+        jax.effects_barrier()
+    except Exception:
+        pass
+
+
 # ---------------------------------------------------------------------------
 # CG (linalg.py:499)
 # ---------------------------------------------------------------------------
@@ -348,13 +398,16 @@ def cg(
     if M is None and callback is None:
         fused = _try_fused_cg(A, b, x0, tol, maxiter, conv_test_iters)
         if fused is not None:
+            _solve_event("cg", n, fused[1], "fused")
             return fused
     A = make_linear_operator(A)
     M = IdentityOperator(A.shape, dtype=A.dtype) if M is None else make_linear_operator(M)
     x = jnp.zeros_like(b) if x0 is None else asjnp(x0)
 
     if callback is not None:
-        return _cg_host_loop(A, b, x, tol, maxiter, M, callback, conv_test_iters)
+        out = _cg_host_loop(A, b, x, tol, maxiter, M, callback, conv_test_iters)
+        _solve_event("cg", n, out[1], "host")
+        return out
 
     r = b - A.matvec(x)
     try:
@@ -365,7 +418,9 @@ def cg(
         # kernel path for the whole solve
         if not isinstance(M, IdentityOperator):
             M.matvec(r)
-        return _cg_device_loop(A, b, x, r, tol, maxiter, M, conv_test_iters)
+        out = _cg_device_loop(A, b, x, r, tol, maxiter, M, conv_test_iters)
+        _solve_event("cg", n, out[1], "device")
+        return out
     except (
         jax.errors.TracerArrayConversionError,
         jax.errors.TracerBoolConversionError,
@@ -373,7 +428,9 @@ def cg(
     ):
         # A or M is a host-side Python operator (e.g. a numpy-based
         # preconditioner): run the reference-style host loop instead
-        return _cg_host_loop(A, b, x, tol, maxiter, M, None, conv_test_iters)
+        out = _cg_host_loop(A, b, x, tol, maxiter, M, None, conv_test_iters)
+        _solve_event("cg", n, out[1], "host")
+        return out
 
 
 def _try_fused_cg(A, b, x0, tol, maxiter, conv_test_iters):
@@ -468,14 +525,29 @@ def _try_fused_cg(A, b, x0, tol, maxiter, conv_test_iters):
             state=state, return_state=True, interpret=interpret,
         )
         iters += k
-        if float(rho) < tol2 or not np.isfinite(float(rho)):
+        rho_f = float(rho)
+        if telemetry.enabled():
+            # one event per conv-test chunk, reusing the rho scalar this
+            # loop already fetches — per-chunk granularity, zero extra
+            # syncs on the fused fast path
+            telemetry.record(
+                "solver.iter", solver="cg", path="fused", iter=iters,
+                resid2=rho_f, chunk=k,
+            )
+        if rho_f < tol2 or not np.isfinite(rho_f):
             break
     return x, iters
 
 
 def _cg_device_loop(A, b, x, r, tol, maxiter, M, conv_test_iters):
-    """Whole-solve lax.while_loop: scalars stay on device, one final sync."""
+    """Whole-solve lax.while_loop: scalars stay on device, one final sync.
+
+    With telemetry enabled, each iteration taps (iter, ||r||^2) out to the
+    recorder through ``jax.debug.callback`` — the loop stays one compiled
+    program; the extra reduction exists only in the instrumented trace.
+    """
     tol2 = jnp.asarray(tol, dtype=jnp.real(r).dtype) ** 2
+    tap = _make_iter_tap("cg")
 
     def body(state):
         x, r, p, rho, iters = state
@@ -488,6 +560,8 @@ def _cg_device_loop(A, b, x, r, tol, maxiter, M, conv_test_iters):
         alpha = rho_new / jnp.where(pq == 0, 1, pq)  # 0/0 guard: b=0 or exact x0
         x = x + alpha * p
         r = r - alpha * q
+        if tap is not None:
+            jax.debug.callback(tap, iters + 1, jnp.real(_vdot(r, r)))
         return x, r, p, rho_new, iters + 1
 
     def cond(state):
@@ -501,11 +575,19 @@ def _cg_device_loop(A, b, x, r, tol, maxiter, M, conv_test_iters):
     rho0 = jnp.zeros((), dtype=b.dtype)
     state = (x, r, p0, rho0, jnp.zeros((), dtype=jnp.int32))
     x, r, p, rho, iters = jax.lax.while_loop(cond, body, state)
-    return x, host_int(iters)
+    out = x, host_int(iters)
+    if tap is not None:
+        _effects_barrier()
+    return out
 
 
 def _cg_host_loop(A, b, x, tol, maxiter, M, callback, conv_test_iters):
-    """Host-driven CG matching the reference's periodic-blocking loop."""
+    """Host-driven CG matching the reference's periodic-blocking loop.
+
+    Telemetry mode records a ``solver.iter`` event per iteration; the
+    residual fetch adds one scalar sync per iteration on this (already
+    host-driven) path — the documented cost of observability here.
+    """
     r = b - A.matvec(x)
     iters = 0
     rho = None
@@ -521,6 +603,17 @@ def _cg_host_loop(A, b, x, tol, maxiter, M, callback, conv_test_iters):
         x = cg_axpby(x, p, rho, pq, isalpha=True)
         r = cg_axpby(r, q, rho, pq, isalpha=True, negate=True)
         iters += 1
+        if telemetry.enabled():
+            from .utils import in_trace
+
+            # under an OUTER jit trace the residual is a tracer; skip the
+            # event rather than change where/whether the loop fails (the
+            # loop's own conv-test float() governs, telemetry never does)
+            if not in_trace():
+                telemetry.record(
+                    "solver.iter", solver="cg", path="host", iter=iters,
+                    resid2=float(jnp.real(_vdot(r, r))),
+                )
         if callback is not None:
             callback(x)
         if (iters % conv_test_iters == 0 or iters == maxiter - 1) and float(
@@ -649,6 +742,7 @@ def bicgstab(A, b, x0=None, tol=1e-08, maxiter=None, callback=None, conv_test_it
     r = b - A.matvec(x)
     rtilde = r
     tol2 = jnp.asarray(tol, dtype=jnp.real(r).dtype) ** 2
+    tap = _make_iter_tap("bicgstab")
 
     def body(state):
         x, r, p, v, rho, alpha, omega, iters = state
@@ -666,6 +760,8 @@ def bicgstab(A, b, x0=None, tol=1e-08, maxiter=None, callback=None, conv_test_it
         omega_n = _vdot(t, s) / jnp.where(_vdot(t, t) == 0, 1, _vdot(t, t))
         x_n = x + alpha_n * p_n + omega_n * s
         r_n = s - omega_n * t
+        if tap is not None:
+            jax.debug.callback(tap, iters + 1, jnp.real(_vdot(r_n, r_n)))
         return x_n, r_n, p_n, v_n, rho_new, alpha_n, omega_n, iters + 1
 
     def cond(state):
@@ -683,7 +779,11 @@ def bicgstab(A, b, x0=None, tol=1e-08, maxiter=None, callback=None, conv_test_it
     x, iters = out[0], out[-1]
     if callback is not None:
         callback(x)
-    return x, host_int(iters)
+    iters = host_int(iters)
+    if tap is not None:
+        _effects_barrier()
+    _solve_event("bicgstab", n, iters, "device")
+    return x, iters
 
 
 # ---------------------------------------------------------------------------
@@ -757,8 +857,16 @@ def gmres(
             # the solve; count it (like the host path) so iters reflects
             # work and the outer loop stays bounded by maxiter
             total_iters += inner + (1 if bdown else 0)
+            if telemetry.enabled():
+                # restart-cycle granularity, reusing the one packed fetch
+                # the cycle already makes (no extra syncs)
+                telemetry.record(
+                    "solver.iter", solver="gmres", path="device",
+                    iter=total_iters, resid=float(abs(_beta)), inner=inner,
+                )
             if callback is not None:
                 callback(x)
+        _solve_event("gmres", n, total_iters, "device")
         return x, total_iters
     except (
         jax.errors.TracerArrayConversionError,
@@ -775,8 +883,14 @@ def gmres(
             break
         x, inner = _gmres_cycle_host(A, M, x, r, beta, restart, target)
         total_iters += inner
+        if telemetry.enabled():
+            telemetry.record(
+                "solver.iter", solver="gmres", path="host",
+                iter=total_iters, resid=float(beta), inner=inner,
+            )
         if callback is not None:
             callback(x)
+    _solve_event("gmres", n, total_iters, "host")
     return x, total_iters
 
 

@@ -41,10 +41,7 @@ from ..utils import asjnp
 from .mesh import get_mesh
 from .partition import balanced_row_splits, column_windows, equal_row_splits
 
-try:  # jax>=0.8 top-level; older releases keep it in experimental
-    from jax import shard_map
-except ImportError:  # pragma: no cover
-    from jax.experimental.shard_map import shard_map
+from .mesh import shard_map  # version-portable (check_vma/check_rep shim)
 
 
 @dataclass(eq=False)
@@ -125,12 +122,31 @@ class DistCSR:
         )
 
     # -- SpMV --------------------------------------------------------------
+    def _spmv_comm_bytes(self) -> int:
+        """Structural per-SpMV collective volume (bytes across all shards),
+        memoized — the counter ``spmv_padded`` accumulates per eager call."""
+        b = getattr(self, "_spmv_bytes_cache", None)
+        if b is None:
+            b = int(comm_stats(self)["spmv_collective_bytes_per_shard"]) * self.S
+            self._spmv_bytes_cache = b
+        return b
+
     def spmv_padded(self, xp: jax.Array) -> jax.Array:
         """y = A @ x entirely in padded layout ([n_pad] -> [m_pad]).
 
         This is the jit-safe inner-loop primitive; solvers call it inside
-        ``lax.while_loop`` without any host sync.
+        ``lax.while_loop`` without any host sync. Telemetry counts eager
+        dispatches and their structural comm volume (traced inner-loop
+        calls are accounted at the solver level instead — ``comm.cg``).
         """
+        from .. import telemetry
+
+        if telemetry.enabled():
+            from ..utils import in_trace
+
+            if not in_trace():
+                telemetry.count("comm.spmv.calls")
+                telemetry.add_bytes("comm.spmv.total", self._spmv_comm_bytes())
         if self._spmv_fn is None:
             self._spmv_fn = _build_spmv(self)
         return self._spmv_fn(
@@ -728,6 +744,19 @@ def shard_csr(
         dist.nz_rows = jax.device_put(nz_rows, sharding2)
         dist.nz_cols = jax.device_put(nz_cols, sharding2)
         dist.nz_vals = jax.device_put(nz_vals, sharding2)
+    from .. import telemetry
+
+    if telemetry.enabled():
+        # one event per sharded operator: the structural per-SpMV comm
+        # model (the introspection the reference gets from Legion's
+        # partition analysis) — eager SpMVs then accumulate against it
+        cs = comm_stats(dist)
+        telemetry.record(
+            "comm.spmv", model=True, shape=[int(m), int(n)], S=S,
+            mode=mode, layout=layout,
+            halo_entries_per_spmv=cs["halo_entries_per_spmv"],
+            bytes=int(cs["spmv_collective_bytes_per_shard"]) * S,
+        )
     return dist
 
 
@@ -820,7 +849,27 @@ def dist_cg(
         conv_test_iters=conv_test_iters, M=M,
     )
     xp, iters, converged = run(bp, xp)
-    return xp, int(iters), bool(converged)
+    iters, converged = int(iters), bool(converged)
+    from .. import telemetry
+
+    if telemetry.enabled():
+        # whole-solve collective volume from the structural model x the
+        # measured iteration count — the Legion-profiler-style comm
+        # attribution for the compiled while_loop (which is opaque to
+        # per-call counters by design)
+        cs = comm_stats(A, conv_test_iters)
+        telemetry.record(
+            "comm.cg", S=A.S, iters=iters, mode=A.mode,
+            bytes=int(cs["cg_iter_collective_bytes_per_shard"]) * iters * A.S,
+            bytes_per_iter_per_shard=int(
+                cs["cg_iter_collective_bytes_per_shard"]
+            ),
+        )
+        telemetry.record(
+            "solver.solve", solver="dist_cg", n=int(A.shape[0]),
+            iters=iters, path="device", converged=converged,
+        )
+    return xp, iters, converged
 
 
 def comm_stats(A: DistCSR, conv_test_iters: int = 25) -> dict:

@@ -24,10 +24,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from .mesh import get_mesh_2d
 
-try:  # jax>=0.8 top-level; older releases keep it in experimental
-    from jax import shard_map
-except ImportError:  # pragma: no cover
-    from jax.experimental.shard_map import shard_map
+from .mesh import shard_map  # version-portable (check_vma/check_rep shim)
 
 
 def _pad_rows(X: np.ndarray, mult: int) -> np.ndarray:

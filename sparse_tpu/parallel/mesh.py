@@ -21,6 +21,36 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+try:  # jax>=0.8 top-level; older releases keep it in experimental
+    from jax import shard_map as _shard_map_raw
+except ImportError:  # pragma: no cover - version-dependent
+    from jax.experimental.shard_map import shard_map as _shard_map_raw
+
+
+def _make_shard_map():
+    """Version-portable shard_map: the replication-check kwarg was renamed
+    check_rep -> check_vma across jax releases; every call site in this
+    package writes the new name and this shim translates for older jax.
+    Single source — all of ``sparse_tpu.parallel`` imports from here."""
+    import inspect
+
+    try:
+        params = inspect.signature(_shard_map_raw).parameters
+    except (TypeError, ValueError):  # pragma: no cover - exotic builds
+        return _shard_map_raw
+    if "check_vma" in params or "check_rep" not in params:
+        return _shard_map_raw
+
+    def shard_map(*args, **kwargs):
+        if "check_vma" in kwargs:
+            kwargs["check_rep"] = kwargs.pop("check_vma")
+        return _shard_map_raw(*args, **kwargs)
+
+    return shard_map
+
+
+shard_map = _make_shard_map()
+
 _initialized = False
 
 

@@ -35,10 +35,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from .mesh import get_mesh
 
-try:  # jax>=0.8 top-level; older releases keep it in experimental
-    from jax import shard_map
-except ImportError:  # pragma: no cover
-    from jax.experimental.shard_map import shard_map
+from .mesh import shard_map  # version-portable (check_vma/check_rep shim)
 
 
 def dist_sort(keys, payloads, mesh: Mesh | None = None, axis: str = "shards"):
@@ -296,6 +293,22 @@ def dist_sort_sample(keys, payloads=(), mesh: Mesh | None = None, axis: str = "s
     ps_sorted = [o.reshape(-1) for o in out[1 : 1 + len(payloads)]]
     send_matrix = np.asarray(out[1 + len(payloads)])  # [S, S]
     splitters = out[2 + len(payloads)]  # [S, S-1] (identical rows)
+
+    from .. import telemetry
+
+    if telemetry.enabled():
+        # exact bucket-exchange volume from the send matrix this function
+        # already fetches to size the alltoallv buffers — zero extra syncs
+        kit = np.dtype(keys.dtype).itemsize
+        entry_bytes = kit + sum(np.dtype(p.dtype).itemsize for p in payloads)
+        off_diag = int(send_matrix.sum() - np.trace(send_matrix))
+        telemetry.record(
+            "comm.sort", S=S, n=int(keys.shape[0]),
+            bucket_entries_sent=off_diag,
+            sample_allgather_bytes=int(S * S * S * kit),
+            fallback_odd_even=bool(send_matrix.sum(axis=0).max() > cap),
+            bytes=off_diag * entry_bytes + int(S * S * S * kit),
+        )
 
     if int(send_matrix.sum(axis=0).max()) > cap:
         # heavy duplicates around a splitter: capacity bound violated

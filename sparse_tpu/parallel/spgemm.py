@@ -33,10 +33,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from .mesh import get_mesh, get_mesh_2d
 from .partition import balanced_row_splits, equal_row_splits
 
-try:  # jax>=0.8 top-level; older releases keep it in experimental
-    from jax import shard_map
-except ImportError:  # pragma: no cover
-    from jax.experimental.shard_map import shard_map
+from .mesh import shard_map  # version-portable (check_vma/check_rep shim)
 
 
 # Diagnostic record of the last dist_spgemm's per-shard memory footprint
@@ -708,6 +705,28 @@ def dist_spgemm_2d(A, B, mesh2d=None, as_dist: bool = False):
         S=S_out, cap=cap, T=T, R=R_out, C=C_out, HL=HL, HR=HR, mode=mode,
         host_counts=int(sends.size),
     )
+    from .. import telemetry
+
+    if telemetry.enabled():
+        # exact volumes from THIS product's host-visible send counts (not
+        # the structural model in spgemm2d_comm_stats, which recomputes
+        # the product): replication envelope + gy-axis shuffle entries
+        # actually leaving each device
+        iw = np.dtype(idx_dt).itemsize
+        repl = (
+            annz_pad * (iw + a_data.dtype.itemsize) + (rows_pad + 1) * iw
+            + bnnz_pad * (iw + b_data.dtype.itemsize) + (cols_pad + 1) * iw
+        )
+        crossing = sends.sum(axis=2) - np.einsum("ijj->ij", sends)
+        entry_bytes = iw + np.dtype(lidt).itemsize + np.dtype(dt).itemsize
+        telemetry.record(
+            "comm.spgemm2d", grid=[gx, gy],
+            replicate_bytes_per_device=int(repl),
+            shuffle_entries_sent=int(crossing.sum()),
+            shuffle_entries_sent_max=int(crossing.max()),
+            exchange_cap_entries=int(cap),
+            bytes=int(repl) * S_out + int(crossing.sum()) * entry_bytes,
+        )
     if as_dist:
         return dist
 

@@ -437,16 +437,21 @@ static SpluHandle* lu_factor_core(int64_t n, const int64_t* Ap,
     pinv[piv] = j;
     h->perm[j] = piv;
     // emit: pivoted rows -> U(:, j) (incl. the new diagonal), unpivoted
-    // rows -> L(:, j) scaled by the pivot; ILUT drops on |x| < tau
-    // (never the pivot) then keeps the lfil largest per half; clear the
-    // workspace
+    // rows -> L(:, j) scaled by the pivot; then keep the lfil largest per
+    // half; clear the workspace. ILUT drop rules (SuperLU/Saad, ADVICE
+    // r5): U drops on the raw value |x| < tau = droptol * ||A(:,j)||2,
+    // L drops on the SCALED multiplier |x/d| < droptol — the pivot is
+    // picked first, so a large pivot no longer keeps entries that are
+    // tiny as L multipliers (nor a tiny pivot drop large ones). The
+    // U diagonal is never dropped.
     ucol.clear();
     lcol.clear();
     for (int64_t i : topo) {
       if (pinv[i] >= 0) {
         if (pinv[i] == j || std::fabs(x[i]) >= tau)
           ucol.emplace_back(pinv[i], x[i]);
-      } else if (x[i] != 0.0 && std::fabs(x[i]) >= tau) {
+      } else if (x[i] != 0.0 &&
+                 (droptol <= 0.0 || std::fabs(x[i] / d) >= droptol)) {
         lcol.emplace_back(i, x[i] / d);  // ORIGINAL row id; remapped later
       }
       x[i] = 0.0;

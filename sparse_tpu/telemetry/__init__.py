@@ -1,0 +1,70 @@
+"""sparse_tpu.telemetry — structured observability for the whole stack.
+
+The reference stack (legate.sparse) leans on Legion's built-in profiling
+and mapper introspection to see where time and communication go; the
+JAX/XLA reproduction has no such substrate, so this package provides
+one: every solver run, kernel-tile decision and collective is measurable
+through a single event stream.
+
+Surface
+-------
+* :func:`record` — ``record(kind, **fields)``: one structured event into
+  a bounded in-memory ring + the JSONL session log
+  (``results/axon/records.jsonl``, shared with bench.py's
+  hardware-evidence records). Zero overhead when disabled.
+* :func:`count` / :func:`add_bytes` — in-memory counters for hot paths
+  (kernel dispatches, host syncs, per-SpMV comm volumes) where an event
+  per call would flood the log.
+* :func:`span` — scoped wall-clock + optional device-sync timer
+  (``with span("cg.iter"): ...``). Trace-safe: a shared no-op inside
+  ``jit``/``scan`` traces; ``block_until_ready`` only at span exit.
+* :func:`summary` — counts, per-kind event totals, span p50/p95
+  latencies, bytes moved per collective family.
+* :func:`events` / :func:`reset` / :func:`configure` / :func:`flush` —
+  ring snapshot, state reset, sink redirection, sink flush.
+* ``schema`` (module) — the event-kind table + ``validate`` /
+  ``validate_jsonl`` used by tests and documented in docs/telemetry.md.
+
+Enabled by ``SPARSE_TPU_TELEMETRY=1`` (or ``settings.telemetry = True``);
+sink override via ``SPARSE_TPU_TELEMETRY_PATH`` / :func:`configure`.
+"""
+
+from __future__ import annotations
+
+from . import _schema as schema  # noqa: F401
+from ._recorder import (  # noqa: F401
+    add_bytes,
+    add_span,
+    bytes_by_kind,
+    configure,
+    count,
+    counters,
+    enabled,
+    events,
+    flush,
+    record,
+    reset,
+    sink_path,
+)
+from ._spans import Span, device_sync, span  # noqa: F401
+from ._summary import summary  # noqa: F401
+
+__all__ = [
+    "add_bytes",
+    "add_span",
+    "bytes_by_kind",
+    "configure",
+    "count",
+    "counters",
+    "device_sync",
+    "enabled",
+    "events",
+    "flush",
+    "record",
+    "reset",
+    "schema",
+    "sink_path",
+    "span",
+    "Span",
+    "summary",
+]

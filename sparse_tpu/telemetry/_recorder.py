@@ -1,0 +1,231 @@
+"""Event recorder core: bounded ring buffer + JSONL sink + counters.
+
+Reference analog: Legion's profiling/mapping introspection gives the
+reference stack per-task timing and communication attribution for free
+(SURVEY §5); JAX/XLA has nothing equivalent at the library level, so this
+module is the substrate every instrumentation site reports through.
+
+Design rules (the whole point of the module):
+
+* **Zero overhead when disabled.** Every entry point's first statement is
+  one attribute check on ``settings.telemetry``; nothing allocates, locks,
+  or touches the filesystem on the disabled path.
+* **Fault-tolerant sink.** The JSONL sink shares
+  ``results/axon/records.jsonl`` with bench.py's hardware-evidence
+  records (telemetry events carry ``kind`` and no top-level ``metric``,
+  so bench's freshest-TPU-record scan never confuses the two). Any
+  filesystem failure warns once and drops the sink — the in-memory ring
+  keeps working.
+* **Host-side only.** ``record()`` must be called with concrete values;
+  traced code reaches it through ``jax.debug.callback`` taps (see
+  ``linalg._cg_device_loop``) or not at all.
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import os
+import threading
+import time
+
+from ..config import settings
+
+_LOCK = threading.RLock()
+_RING: collections.deque | None = None
+_COUNTS: dict[str, int] = {}
+_BYTES: dict[str, int] = {}
+_SPANS: dict[str, list] = {}
+_SINK = None  # lazily-opened append-mode file object
+_SINK_FAILED = False
+_SINK_PATH_OPEN: str | None = None
+_PATH_OVERRIDE: str | None = None
+
+# repo root = two levels up from this package (sparse_tpu/telemetry/)
+_DEFAULT_SINK = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+    "results",
+    "axon",
+    "records.jsonl",
+)
+
+
+def enabled() -> bool:
+    """True when the telemetry subsystem records (``settings.telemetry`` /
+    ``SPARSE_TPU_TELEMETRY``). Instrumentation sites gate on this — one
+    attribute read — so the disabled path stays measurement-free."""
+    return bool(settings.telemetry)
+
+
+def sink_path() -> str:
+    """Resolved JSONL sink path (override > settings > default)."""
+    if _PATH_OVERRIDE:
+        return _PATH_OVERRIDE
+    return settings.telemetry_path or _DEFAULT_SINK
+
+
+def configure(path: str | None = None) -> None:
+    """Point the JSONL sink somewhere else (tests, bench subprocesses).
+
+    ``None`` restores the settings/default resolution. Closes any open
+    sink so the next record reopens at the new path; also clears the
+    failed-sink latch so a previously unwritable location can be retried.
+    """
+    global _PATH_OVERRIDE, _SINK, _SINK_FAILED, _SINK_PATH_OPEN
+    with _LOCK:
+        _PATH_OVERRIDE = path
+        if _SINK is not None:
+            try:
+                _SINK.close()
+            except OSError:
+                pass
+        _SINK = None
+        _SINK_PATH_OPEN = None
+        _SINK_FAILED = False
+
+
+def _ring() -> collections.deque:
+    global _RING
+    if _RING is None or _RING.maxlen != settings.telemetry_ring:
+        old = list(_RING) if _RING is not None else []
+        _RING = collections.deque(old, maxlen=settings.telemetry_ring)
+    return _RING
+
+
+def _jsonable(v):
+    """Best-effort JSON coercion for numpy/jax scalars and odd values —
+    the sink must never raise back into a hot path."""
+    try:
+        import numpy as np
+
+        if isinstance(v, (np.integer,)):
+            return int(v)
+        if isinstance(v, (np.floating,)):
+            return float(v)
+        if isinstance(v, np.ndarray):
+            return v.tolist()
+    except Exception:
+        pass
+    return str(v)
+
+
+def _write(ev: dict) -> None:
+    """Append one event line to the sink; failures disable the sink."""
+    global _SINK, _SINK_FAILED, _SINK_PATH_OPEN
+    if _SINK_FAILED:
+        return
+    path = sink_path()
+    try:
+        if _SINK is None or _SINK_PATH_OPEN != path:
+            if _SINK is not None:
+                _SINK.close()
+            os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+            _SINK = open(path, "a")
+            _SINK_PATH_OPEN = path
+        _SINK.write(json.dumps(ev, default=_jsonable) + "\n")
+        _SINK.flush()
+    except (OSError, ValueError):
+        _SINK_FAILED = True
+        _SINK = None
+        from ..utils import user_warning
+
+        user_warning(
+            f"telemetry: JSONL sink {path!r} unwritable; events stay "
+            "in the in-memory ring only"
+        )
+
+
+def record(kind: str, **fields):
+    """Record one structured event: ``record("solver.iter", iter=3, ...)``.
+
+    No-op (one attribute check) when telemetry is disabled. Events get
+    ``kind`` and a ``ts`` wall-clock stamp; a numeric ``bytes`` field
+    additionally accumulates into the per-kind byte totals reported by
+    :func:`~sparse_tpu.telemetry.summary`. Returns the event dict, or
+    ``None`` when disabled.
+    """
+    if not settings.telemetry:
+        return None
+    ev = {"kind": kind, "ts": time.time()}
+    ev.update(fields)
+    with _LOCK:
+        _ring().append(ev)
+        b = fields.get("bytes")
+        if isinstance(b, (int, float)) and not isinstance(b, bool):
+            _BYTES[kind] = _BYTES.get(kind, 0) + int(b)
+        _write(ev)
+    return ev
+
+
+def count(name: str, n: int = 1) -> None:
+    """Bump an in-memory counter (no event, no I/O) — the cheap form for
+    hot-path call counting (kernel dispatches, host syncs, public-API
+    provenance scopes). Surfaced by ``summary()["counts"]``."""
+    if not settings.telemetry:
+        return
+    with _LOCK:
+        _COUNTS[name] = _COUNTS.get(name, 0) + n
+
+
+def add_bytes(kind: str, n) -> None:
+    """Accumulate structural comm volume without emitting an event — the
+    per-SpMV counter form (an event per eager SpMV would flood the ring).
+    Totals appear in ``summary()["bytes_by_kind"]``."""
+    if not settings.telemetry:
+        return
+    with _LOCK:
+        _BYTES[kind] = _BYTES.get(kind, 0) + int(n)
+
+
+def add_span(name: str, dur_s: float) -> None:
+    """Feed one span duration into the latency aggregates (p50/p95)."""
+    if not settings.telemetry:
+        return
+    with _LOCK:
+        _SPANS.setdefault(name, []).append(float(dur_s))
+
+
+def events(kind: str | None = None) -> list:
+    """Snapshot of the in-memory ring (optionally filtered by kind)."""
+    with _LOCK:
+        evs = list(_RING or ())
+    if kind is not None:
+        evs = [e for e in evs if e.get("kind") == kind]
+    return evs
+
+
+def counters() -> dict:
+    with _LOCK:
+        return dict(_COUNTS)
+
+
+def bytes_by_kind() -> dict:
+    with _LOCK:
+        return dict(_BYTES)
+
+
+def span_durations() -> dict:
+    with _LOCK:
+        return {k: list(v) for k, v in _SPANS.items()}
+
+
+def flush() -> None:
+    """Flush the JSONL sink (records already flush per line; this exists
+    for symmetry and for callers that swap ``configure`` targets)."""
+    with _LOCK:
+        if _SINK is not None:
+            try:
+                _SINK.flush()
+            except OSError:
+                pass
+
+
+def reset() -> None:
+    """Clear the ring, counters, byte totals and span aggregates (the
+    sink file is untouched — it is an append-only session log)."""
+    global _RING
+    with _LOCK:
+        _RING = None
+        _COUNTS.clear()
+        _BYTES.clear()
+        _SPANS.clear()

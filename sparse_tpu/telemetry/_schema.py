@@ -1,0 +1,99 @@
+"""Event schema: the kinds the instrumentation emits and a validator.
+
+The schema is deliberately open — unknown kinds and extra fields are
+forward-compatible by design (a new instrumentation site must not break
+old consumers) — but every event carries ``kind`` + ``ts``, and known
+kinds carry their required fields. ``docs/telemetry.md`` documents the
+same table for human consumers; tests validate emitted events against
+this module so the doc, the code and the JSONL stay in sync.
+"""
+
+from __future__ import annotations
+
+#: fields every event carries (added by the recorder itself)
+BASE_FIELDS = frozenset({"kind", "ts"})
+
+#: required fields per known kind (beyond BASE_FIELDS)
+KINDS: dict[str, frozenset] = {
+    # -- solvers (linalg.py) ------------------------------------------------
+    # one per iteration (host/fused paths) or per conv-test chunk /
+    # restart cycle; resid2 = ||r||^2 in the solve dtype where available
+    "solver.iter": frozenset({"solver", "iter"}),
+    # one per completed solve, every path
+    "solver.solve": frozenset({"solver", "iters", "path"}),
+    # -- kernels (kernels/dia_spmv.py) -------------------------------------
+    # a completed tile-autotune race: timings_us maps probed tile -> best
+    # seconds-per-SpMV in microseconds; clock is 'compiled' | 'host'
+    "autotune.probe": frozenset({"tile", "shape", "timings_us"}),
+    # an autotune decision that did NOT probe (gate/cache) — never cached
+    # as if it were a probe result
+    "autotune.result": frozenset({"tile", "probed"}),
+    # a Pallas kernel permanently failing over to the XLA formulation
+    "kernel.failover": frozenset({"kernel", "error"}),
+    # -- distribution (parallel/) ------------------------------------------
+    # structural comm model of a freshly sharded operator (per-SpMV cost)
+    "comm.spmv": frozenset({"bytes", "mode", "S"}),
+    # whole-solve collective volume of a distributed CG run
+    "comm.cg": frozenset({"bytes", "S", "iters"}),
+    # 2-D SpGEMM replication + shuffle volumes
+    "comm.spgemm2d": frozenset({"bytes", "grid"}),
+    # samplesort exchange volumes (from the host-visible send matrix)
+    "comm.sort": frozenset({"bytes", "S"}),
+    # -- generic ------------------------------------------------------------
+    "span": frozenset({"name", "dur_s"}),
+    # bench.py session record (always written by a bench run, even when
+    # the TPU probe timed out)
+    "bench.session": frozenset({"status"}),
+}
+
+
+def validate(event: dict) -> list:
+    """Return a list of problems (empty = schema-valid).
+
+    Unknown kinds validate against BASE_FIELDS only (forward-compat);
+    known kinds additionally require their fields. ``ts`` must be a
+    positive number, ``kind`` a non-empty string.
+    """
+    problems = []
+    kind = event.get("kind")
+    if not isinstance(kind, str) or not kind:
+        problems.append("missing/empty kind")
+    ts = event.get("ts")
+    if not isinstance(ts, (int, float)) or isinstance(ts, bool) or ts <= 0:
+        problems.append("missing/invalid ts")
+    required = KINDS.get(kind, frozenset())
+    for f in sorted(required):
+        if f not in event:
+            problems.append(f"{kind}: missing required field {f!r}")
+    b = event.get("bytes")
+    if b is not None and (
+        isinstance(b, bool) or not isinstance(b, (int, float)) or b < 0
+    ):
+        problems.append(f"{kind}: bytes must be a non-negative number")
+    return problems
+
+
+def validate_jsonl(path: str) -> list:
+    """Validate every telemetry event line of a JSONL file; returns
+    ``[(lineno, problem), ...]``. Lines without a ``kind`` field (e.g.
+    bench.py hardware metric records sharing the session log) are
+    skipped — the two record families coexist in records.jsonl by
+    contract."""
+    import json
+
+    problems = []
+    with open(path) as f:
+        for i, line in enumerate(f, 1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                ev = json.loads(line)
+            except json.JSONDecodeError:
+                problems.append((i, "not json"))
+                continue
+            if not isinstance(ev, dict) or "kind" not in ev:
+                continue  # a bench metric record, not a telemetry event
+            for p in validate(ev):
+                problems.append((i, p))
+    return problems

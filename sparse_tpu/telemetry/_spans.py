@@ -1,0 +1,130 @@
+"""Scoped wall-clock + device-sync timers (``span("cg.iter")``).
+
+Trace safety is the defining constraint: library code wraps hot paths
+that are routinely re-entered under ``jit``/``vmap``/``scan`` tracing,
+where (a) wall-clock around tracer ops measures trace construction, not
+execution, and (b) a ``block_until_ready`` on a tracer raises. A span
+therefore degrades to a shared no-op object whenever telemetry is
+disabled OR a trace is active (``utils.in_trace``) — no allocation on
+the disabled path, no tracer leaks on the traced path.
+
+Device sync discipline: ``block_until_ready`` runs only at span exit and
+only on values handed to the span (``sync=...``) — never injected into
+the middle of user computations.
+"""
+
+from __future__ import annotations
+
+import time
+
+from ..config import settings
+from . import _recorder
+
+
+class _NullSpan:
+    """Shared disabled/traced span: every method is a no-op."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+    def annotate(self, **fields):
+        return self
+
+    def set_sync(self, value):
+        return value
+
+
+_NULL = _NullSpan()
+
+
+class Span:
+    """One timed scope. Use via :func:`span`; not constructed directly."""
+
+    __slots__ = ("name", "fields", "_t0", "_sync", "emit")
+
+    def __init__(self, name: str, fields: dict, sync, emit: bool):
+        self.name = name
+        self.fields = fields
+        self._sync = sync
+        self.emit = emit
+        self._t0 = None
+
+    def annotate(self, **fields):
+        """Attach fields to the span's event after entry (e.g. results
+        computed inside the scope)."""
+        self.fields.update(fields)
+        return self
+
+    def set_sync(self, value):
+        """Register a device value to block on at span exit; returns the
+        value unchanged so call sites stay expression-shaped."""
+        self._sync = value
+        return value
+
+    def __enter__(self):
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        if self._sync is not None and exc_type is None:
+            try:
+                import jax
+
+                jax.block_until_ready(self._sync)
+            except Exception:
+                pass  # sync is best-effort; the wall clock still stands
+        dur = time.perf_counter() - self._t0
+        _recorder.add_span(self.name, dur)
+        if self.emit:
+            _recorder.record(
+                "span",
+                name=self.name,
+                dur_s=round(dur, 9),
+                **({"error": exc_type.__name__} if exc_type else {}),
+                **self.fields,
+            )
+        return False
+
+
+def span(name: str, sync=None, emit: bool = True, **fields):
+    """Scoped timer: ``with span("cg.iter"): ...``.
+
+    Returns a shared no-op context when telemetry is disabled or a jax
+    trace is active (see module docstring). When live, records the
+    duration into the p50/p95 aggregates and (``emit=True``) emits a
+    ``span`` event. ``sync`` is an optional array/pytree blocked on at
+    exit so device work attributes to the span rather than a later
+    fence; pass ``emit=False`` for hot scopes that should aggregate
+    without flooding the event log.
+    """
+    if not settings.telemetry:
+        return _NULL
+    from ..utils import in_trace
+
+    if in_trace():
+        return _NULL
+    return Span(name, fields, sync, emit)
+
+
+def device_sync(value):
+    """Block on ``value`` when telemetry is enabled outside a trace —
+    the free-standing boundary fence for code not using spans. Returns
+    ``value`` unchanged; a pure pass-through when disabled/traced."""
+    if not settings.telemetry:
+        return value
+    from ..utils import in_trace
+
+    if in_trace():
+        return value
+    try:
+        import jax
+
+        jax.block_until_ready(value)
+    except Exception:
+        pass
+    return value

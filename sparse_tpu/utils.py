@@ -177,8 +177,16 @@ def host_int(x) -> int:
 
     Reference analog: reading a Legion future, e.g. ``int.from_bytes`` of the nnz
     future at ``sparse/io.py:45-47`` / ``sparse/base.py:47-48``. Every dynamic-nnz
-    site goes through here so the control/device sync boundaries stay auditable.
+    site goes through here so the control/device sync boundaries stay auditable —
+    and countable: telemetry tallies each fetch under ``host_sync.int``, making
+    the sync budget of a workload visible in ``telemetry.summary()``.
     """
+    from .config import settings
+
+    if settings.telemetry:
+        from . import telemetry
+
+        telemetry.count("host_sync.int")
     return int(x)
 
 

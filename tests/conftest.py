@@ -67,6 +67,7 @@ _QUICK_FILES = {
     "test_quantum.py",
     "test_shard_perf.py",
     "test_spatial.py",
+    "test_telemetry.py",
     "test_tropical.py",
 }
 

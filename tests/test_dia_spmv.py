@@ -218,14 +218,17 @@ def test_spmv_chain_matches_repeated_apply():
     np.testing.assert_allclose(got, np.asarray(xp), rtol=1e-5, atol=1e-6)
 
 
-def test_autotune_off_tpu_returns_default_and_caches():
+def test_autotune_off_tpu_returns_default_without_caching():
     from sparse_tpu.kernels import dia_spmv as K
 
     data = np.ones((3, 64), dtype=np.float32)
     K._TILE_CACHE.clear()
     tile, band = K.autotune_dia_tile(data, (-1, 0, 1), (64, 64))
     assert tile == 65536 and band == {}  # no probing off-TPU
-    assert ((-1, 0, 1), (64, 64), "float32") in K._TILE_CACHE
+    # the GATE result must not be memoized as if a probe ran (ADVICE r5):
+    # flipping pallas_autotune on later in the session — or moving to a
+    # TPU backend — must still probe this geometry
+    assert ((-1, 0, 1), (64, 64), "float32") not in K._TILE_CACHE
     # PreparedDia with tile=None resolves through the same default off-TPU
     p = K.PreparedDia(data, (-1, 0, 1), (64, 64))
     assert p.plan.TM >= 1024
