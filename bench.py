@@ -306,6 +306,31 @@ def kernel_sweep(n: int, platform: str) -> dict:
     attempt("ell_xla", lambda xx: csr_spmv_ell(ell_idx, ell_val, xx), ell_bytes)
     attempt("dia_xla", lambda xx: dia_spmv_xla(planes, offsets, xx, (N, N)), dia_bytes)
 
+    # prepared SELL-C-sigma rows (the general-matrix prepare/execute path).
+    # Bytes: stored slots (value+index) + x + y — the pack's actual traffic.
+    try:
+        from sparse_tpu.kernels.sell_spmv import PreparedCSR
+
+        sprep = PreparedCSR(indptr, cols, vals, (N, N))
+        sell_bytes = sprep.plan.stored_slots * 8 + N * 8
+        attempt("sell_xla", sprep.matvec_xla, sell_bytes)
+        if platform == "tpu":
+            # the Pallas row-block kernel; a Mosaic lowering failure fails
+            # over to XLA once — label the row the way the old ell_pallas
+            # delegating row was labeled, "(->xla)", so the sweep never
+            # claims a kernel that didn't run
+            attempt("sell_pallas", sprep, sell_bytes)
+            if sprep._pallas_ok is False and "sell_pallas" in out:
+                out["sell_pallas(->xla)"] = out.pop("sell_pallas")
+        else:
+            # off-TPU the kernel only exists in interpret mode (pure
+            # debugging; timing it would be meaningless) — its measured
+            # path here IS sell_xla above
+            out["sell_pallas"] = {"note": "interpret-only off-TPU; measured path is sell_xla"}
+    except Exception as e:
+        out["sell_xla"] = {"error": str(e)[:200]}
+        traceback.print_exc(file=sys.stderr)
+
     if platform == "tpu":
         from sparse_tpu.kernels.dia_spmv import PreparedDia, dia_spmv_pallas
 
@@ -321,6 +346,82 @@ def kernel_sweep(n: int, platform: str) -> dict:
         # no ell_pallas row: general (non-banded) gather SpMV has no
         # Mosaic-lowering-compatible kernel yet; its measured path IS
         # ell_xla above (the dead delegating kernel was removed, r3)
+    return out
+
+
+def skewed_degree_csr(m: int, seed: int = 7):
+    """Power-law-degree SPD test matrix (scipy CSR, f32): pareto row degrees
+    capped at m/20, symmetrized, diagonally dominant — the row-length-skew
+    shape where ELL's global-max padding explodes and the segment path was
+    the only general option before the SELL packing."""
+    import numpy as np
+    import scipy.sparse as sp
+
+    rng = np.random.default_rng(seed)
+    deg = np.minimum((rng.pareto(1.2, m) * 4 + 1).astype(int), max(m // 20, 8))
+    rows = np.repeat(np.arange(m), deg)
+    cols = rng.integers(0, m, rows.shape[0])
+    vals = rng.random(rows.shape[0])
+    G = sp.coo_matrix((vals, (rows, cols)), shape=(m, m)).tocsr()
+    A = (G + G.T) * 0.5
+    A = A + sp.diags(np.asarray(np.abs(A).sum(axis=1)).ravel() + 1.0)
+    return A.tocsr().astype(np.float32)
+
+
+def run_skewed_cg(m: int = 20000, iters: int = 100) -> dict:
+    """Skewed-degree CSR CG row: prepared-SELL vs segment-mode iters/s.
+
+    The tracked number for the general-matrix prepare/execute split
+    (ISSUE 2): both modes run the same compiled CG device loop; the only
+    difference is the SpMV kernel the trace embeds. Also reports the
+    plan-cache hit rate over a host-driven ``iters``-iteration solve
+    (per-iteration eager matvecs: 1 miss at prepare, hits thereafter).
+    """
+    import numpy as np
+
+    import sparse_tpu
+    from sparse_tpu import linalg, plan_cache
+    from sparse_tpu.config import settings
+
+    A_s = skewed_degree_csr(m)
+    b = np.random.default_rng(3).standard_normal(m).astype(np.float32)
+    out = {"m": m, "nnz": int(A_s.nnz), "iters": iters,
+           "max_deg": int(np.diff(A_s.indptr).max()),
+           "mean_deg": round(A_s.nnz / m, 1)}
+    prev = settings.spmv_mode
+    try:
+        for mode in ("segment", "sell"):
+            settings.spmv_mode = mode
+            A = sparse_tpu.csr_array(A_s)
+            x, _ = linalg.cg(A, b, maxiter=iters, tol=1e-30, conv_test_iters=2 * iters)
+            np.asarray(x)  # warm + fence
+            best = 0.0
+            for _ in range(3):
+                t0 = time.perf_counter()
+                x, it = linalg.cg(A, b, maxiter=iters, tol=1e-30,
+                                  conv_test_iters=2 * iters)
+                np.asarray(x)
+                best = max(best, it / (time.perf_counter() - t0))
+            out[f"{mode}_iters_per_s"] = round(best, 1)
+        if out.get("segment_iters_per_s"):
+            out["sell_vs_segment"] = round(
+                out["sell_iters_per_s"] / out["segment_iters_per_s"], 2
+            )
+        # plan-cache hit rate over a host-loop solve (per-iteration eager
+        # matvecs — the acceptance instrument: 1 miss at prepare, hits
+        # thereafter). A no-op callback forces the host loop.
+        settings.spmv_mode = "sell"
+        A = sparse_tpu.csr_array(A_s)
+        plan_cache.reset_stats()
+        linalg.cg(A, b, maxiter=iters, tol=1e-30, conv_test_iters=2 * iters,
+                  callback=lambda _x: None)
+        st = plan_cache.stats()
+        out["plan_cache"] = {
+            "hits": st["hits"], "misses": st["misses"],
+            "hit_rate": round(st["hit_rate"], 4),
+        }
+    finally:
+        settings.spmv_mode = prev
     return out
 
 
@@ -607,6 +708,10 @@ def worker(platform_arg: str) -> None:
             rec["kernels_n"] = sweep_n
         except Exception:
             traceback.print_exc(file=sys.stderr)
+        try:  # stage 4.5: skewed-degree general-matrix CG (prepared SELL)
+            rec["skewed_cg"] = run_skewed_cg()
+        except Exception:
+            traceback.print_exc(file=sys.stderr)
         print(json.dumps(rec))
         sys.stdout.flush()
         try:  # stage 5: full fused sweep — refines the headline if better
@@ -643,6 +748,10 @@ def worker(platform_arg: str) -> None:
         try:
             rec["kernels"] = kernel_sweep(256, platform)
             rec["kernels_n"] = 256
+        except Exception:
+            traceback.print_exc(file=sys.stderr)
+        try:  # skewed-degree CSR CG: the tracked prepared-SELL number
+            rec["skewed_cg"] = run_skewed_cg()
         except Exception:
             traceback.print_exc(file=sys.stderr)
         print(json.dumps(rec))

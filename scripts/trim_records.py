@@ -1,0 +1,84 @@
+#!/usr/bin/env python
+"""Cap the committed session log (results/axon/records.jsonl) to the
+latest bench session, so telemetry evidence doesn't grow the repo
+unboundedly (ISSUE 2 CI/tooling satellite).
+
+Kept lines:
+  * everything belonging to the LATEST session window — from the last
+    ``bench.session`` record's run start (its ts minus budget_spent_s,
+    with slack) onward;
+  * the freshest ``_tpu`` hardware metric record regardless of age —
+    bench.py's wedged-tunnel fallback (``_freshest_session_record``)
+    must never lose its only hardware evidence to a trim.
+
+Run from anywhere: ``python scripts/trim_records.py [--dry-run]``.
+CI/round tooling runs it before committing results.
+"""
+
+import json
+import os
+import sys
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+RECORDS = os.path.join(HERE, "..", "results", "axon", "records.jsonl")
+SLACK_S = 120.0  # clock slack around the session window
+
+
+def trim(path: str = RECORDS, dry_run: bool = False) -> int:
+    try:
+        with open(path) as f:
+            lines = [ln for ln in f.read().splitlines() if ln.strip()]
+    except OSError:
+        print("trim_records: no session log; nothing to do")
+        return 0
+
+    parsed = []
+    for ln in lines:
+        try:
+            parsed.append((ln, json.loads(ln)))
+        except json.JSONDecodeError:
+            parsed.append((ln, None))  # keep unparseable lines (evidence)
+
+    sessions = [
+        r for _, r in parsed
+        if isinstance(r, dict) and r.get("kind") == "bench.session"
+        and isinstance(r.get("ts"), (int, float))
+    ]
+    if not sessions:
+        print("trim_records: no bench.session record; keeping everything")
+        return 0
+    last = max(sessions, key=lambda r: r["ts"])
+    start = last["ts"] - float(last.get("budget_spent_s", 0.0)) - SLACK_S
+
+    freshest_line = None
+    best_ts = None
+    for ln, r in parsed:
+        if (
+            isinstance(r, dict)
+            and isinstance(r.get("metric"), str)
+            and "_tpu" in r["metric"]
+            and isinstance(r.get("ts"), (int, float))
+        ):
+            if best_ts is None or r["ts"] > best_ts:
+                best_ts, freshest_line = r["ts"], ln
+
+    kept = []
+    for ln, r in parsed:
+        ts = r.get("ts") if isinstance(r, dict) else None
+        in_window = isinstance(ts, (int, float)) and ts >= start
+        if in_window or r is None or ln == freshest_line:
+            kept.append(ln)
+
+    dropped = len(lines) - len(kept)
+    print(
+        f"trim_records: {len(lines)} lines -> {len(kept)} "
+        f"(dropped {dropped}; window starts {start:.0f})"
+    )
+    if dropped and not dry_run:
+        with open(path, "w") as f:
+            f.write("\n".join(kept) + "\n")
+    return dropped
+
+
+if __name__ == "__main__":
+    trim(dry_run="--dry-run" in sys.argv)

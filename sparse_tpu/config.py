@@ -9,7 +9,14 @@ Flags (all env-overridable):
   SPARSE_TPU_PRECISE_WINDOWS  - analog of LEGATE_SPARSE_PRECISE_IMAGES: compute exact
                                 per-shard column windows for the SpMV x-gather instead
                                 of cheap min/max bounds.
-  SPARSE_TPU_SPMV_MODE        - 'auto' | 'segment' | 'ell' | 'pallas': SpMV kernel choice.
+  SPARSE_TPU_SPMV_MODE        - 'auto' | 'segment' | 'ell' | 'sell' | 'pallas': SpMV
+                                kernel choice (docs/performance.md).
+  SPARSE_TPU_PLAN_CACHE       - library-wide operator plan cache (sparse_tpu.plan_cache):
+                                packed SELL/DIA operators and compiled distributed SpMV
+                                programs are prepared once per operator and reused.
+  SPARSE_TPU_PLAN_CACHE_CAP   - plan cache LRU capacity (entries; default 128).
+  SPARSE_TPU_SELL_C           - SELL-C-sigma chunk height (rows per chunk; default 8).
+  SPARSE_TPU_SELL_SIGMA       - SELL sorting-window size (rows; 0 = whole matrix).
   SPARSE_TPU_FORCE_SERIAL     - force single-shard execution of distributed conversions
                                 (mirrors the force_serial special case in coo.py:242).
   SPARSE_TPU_TELEMETRY        - structured observability (sparse_tpu.telemetry): solver
@@ -58,8 +65,28 @@ class Settings:
         default_factory=lambda: _env_bool("SPARSE_TPU_FORCE_SERIAL", False)
     )
     # Max nnz/row (relative to mean) at which the padded-row (ELL) SpMV fast path kicks
-    # in when spmv_mode == 'auto'.
+    # in when spmv_mode == 'auto'. Beyond it (skewed row profiles) 'auto'
+    # falls through to the prepared SELL-C-sigma packing instead of the
+    # scatter-shaped segment path (kernels/sell_spmv.py).
     ell_max_ratio: float = 4.0
+    # SELL-C-sigma packing geometry (kernels/sell_spmv.py): chunk height C
+    # (rows padded to each chunk's own max degree), sorting-window sigma
+    # (rows are degree-sorted only within sigma-row windows; 0 = global
+    # sort), and the max number of distinct-width slabs before chunk
+    # widths quantize to powers of two (bounds compile size).
+    sell_chunk: int = field(default_factory=lambda: max(_env_int("SPARSE_TPU_SELL_C", 8), 1))
+    sell_sigma: int = field(default_factory=lambda: _env_int("SPARSE_TPU_SELL_SIGMA", 4096))
+    sell_max_slabs: int = 16
+    # Library-wide operator plan cache (sparse_tpu.plan_cache): weak-ref
+    # keyed, LRU-bounded storage for prepared operators (SELL slabs,
+    # PreparedDia, compiled distributed SpMV programs). Off: every lookup
+    # misses and rebuilds — correctness identical, prepare cost per call.
+    plan_cache: bool = field(
+        default_factory=lambda: _env_bool("SPARSE_TPU_PLAN_CACHE", True)
+    )
+    plan_cache_capacity: int = field(
+        default_factory=lambda: max(_env_int("SPARSE_TPU_PLAN_CACHE_CAP", 128), 1)
+    )
     # Banded auto-detection for CSR SpMV: matrices with at most this many
     # distinct diagonals (and bounded fill blowup) route through the
     # zero-gather DIA kernel.

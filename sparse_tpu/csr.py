@@ -137,7 +137,7 @@ class csr_array(SparseArray):
     def _maybe_ell(self):
         """Build/cache the padded-row layout when profitable (settings.spmv_mode)."""
         mode = settings.spmv_mode
-        if mode == "segment":
+        if mode in ("segment", "sell"):
             return None
         m = self.shape[0]
         if m == 0 or self.nnz == 0:
@@ -160,6 +160,82 @@ class csr_array(SparseArray):
                     )
             return self._ell
         return None
+
+    # -- SELL-C-sigma prepared path ----------------------------------------
+    def _maybe_sell(self):
+        """Packed SELL-C-sigma operator via the library-wide plan cache.
+
+        The prepared general-SpMV path for skewed row profiles
+        (kernels/sell_spmv.py): under ``spmv_mode='sell'``/``'pallas'`` it
+        applies whenever the matrix has nonzeros; under ``'auto'`` only
+        when the padded-row (ELL) gate declined (max degree beyond
+        ``ell_max_ratio`` x mean — exactly where the segment path used to
+        be the only option). One host-side pack on first eager use,
+        cached in ``sparse_tpu.plan_cache`` keyed on this object; in-trace
+        first use degrades to the jit-safe segment path without caching
+        (same discipline as ``_maybe_ell``/``_maybe_dia``).
+        """
+        from . import plan_cache
+
+        mode = settings.spmv_mode
+        if mode not in ("auto", "sell", "pallas"):
+            return None
+        if self.shape[0] == 0 or self.nnz == 0:
+            return None
+        if in_trace():
+            # trace-safe lookup: an eagerly-warmed plan is reusable (its
+            # planes become compile-time constants, like the ELL cache);
+            # packing here would need host syncs, so a cold cache skips
+            return plan_cache.lookup(self, "sell")
+        if mode == "auto":
+            k = self._ell_width()
+            if k is None:
+                return None
+            mean = max(self.nnz / self.shape[0], 1.0)
+            if k <= settings.ell_max_ratio * mean:
+                return None  # tight profile: the ELL path takes it
+
+        def build():
+            from .kernels.sell_spmv import PreparedCSR
+
+            with host_scope():  # one-time pack, never via a tunnel
+                prep = PreparedCSR(
+                    self.indptr, self.indices, self.data, self.shape
+                )
+            # layouts are BUILT under host_scope; commit to the execution
+            # device once so accelerator hot paths don't re-ship the
+            # planes per matvec (same discipline as the DIA/ELL caches)
+            prep.slabs = tuple(
+                commit_to_exec_device((it, vt)) for it, vt in prep.slabs
+            )
+            (prep.pos,) = commit_to_exec_device((prep.pos,))
+            return prep
+
+        return plan_cache.get(self, "sell", build)
+
+    def prepare(self, mode: str | None = None):
+        """One-time eager layout/pack warm for the current (or given)
+        ``spmv_mode``; returns ``self`` for chaining.
+
+        The prepare half of the prepare/execute split: solvers whose first
+        matvec happens inside a compiled loop (multigrid operators, eigsh
+        Lanczos bodies) would otherwise pin the slowest kernel path for
+        the whole solve — ``make_linear_operator`` calls this eagerly so
+        every ``linalg`` solver starts from a packed operator.
+        """
+        if in_trace():
+            return self  # layout detection needs host syncs; no-op in-trace
+        prev = settings.spmv_mode
+        try:
+            if mode is not None:
+                settings.spmv_mode = mode
+            if settings.spmv_mode in ("auto", "pallas"):
+                self._maybe_dia()
+            self._maybe_sell()
+            self._maybe_ell()
+        finally:
+            settings.spmv_mode = prev
+        return self
 
     # -- products ----------------------------------------------------------
     @track_provenance
@@ -246,6 +322,13 @@ class csr_array(SparseArray):
         with host_scope():  # one-time eager analysis: never via a tunnel
             return self._maybe_dia_detect(m, n, nnz)
 
+    @staticmethod
+    def _fetch_offsets(offs_dev):
+        """Host fetch of the bounded-unique diagonal offsets — the one
+        device->host transfer of banded detection, split out so tests can
+        simulate backends where it fails (the axon-tunnel class)."""
+        return np.unique(np.asarray(offs_dev))
+
     def _maybe_dia_detect(self, m, n, nnz):
         rows = expand_rows(self.indptr, nnz)
         # bounded-size unique: >max_diags distinct offsets still yields
@@ -257,11 +340,27 @@ class csr_array(SparseArray):
                               size=min(settings.dia_max_diags + 1, nnz),
                               fill_value=jnp.iinfo(jnp.int32).max)
         try:
-            offs = np.unique(np.asarray(offs_dev))
-        except jax.errors.JaxRuntimeError:
+            offs = self._fetch_offsets(offs_dev)
+        except jax.errors.JaxRuntimeError as e:
             # experimental backends (the axon tunnel) can fail to execute
             # or transfer the bounded-unique — treat as not banded rather
-            # than crash the matvec; the SpMV still runs on ELL/segment
+            # than crash the matvec; the SpMV still runs on ELL/segment.
+            # NOT silently (the old behavior): a matrix that should ride
+            # the zero-gather DIA kernel degrading to gathers/segment is
+            # a perf cliff worth a breadcrumb, so record the degradation
+            # as a coverage event (tested by tests/test_sell_spmv.py).
+            from . import telemetry
+
+            telemetry.record(
+                "coverage.fallback", op="csr._maybe_dia",
+                reason="detection-fetch-failed", to="ell/segment",
+                error=repr(e)[:200], shape=[int(m), int(n)],
+            )
+            user_warning(
+                "banded (DIA) structure detection could not fetch its "
+                f"result on this backend ({e!r}); SpMV degrades to the "
+                "gather/segment path for this matrix"
+            )
             return None
         offs = offs[offs != np.iinfo(np.int32).max]
         D = len(offs)
@@ -298,6 +397,13 @@ class csr_array(SparseArray):
                 from .ops.dia_spmv import dia_spmv_xla
 
                 return dia_spmv_xla(dia[0], dia[1], x, self.shape)
+        # prepared SELL-C-sigma path: forced by mode 'sell', attempted for
+        # non-banded matrices under 'pallas', and the 'auto' fallthrough
+        # for skewed row profiles where the ELL gate declines (the shapes
+        # that used to pay the scatter-shaped segment path per matvec)
+        prep = self._maybe_sell()
+        if prep is not None:
+            return prep(x)
         ell = self._maybe_ell()
         if ell is not None:
             if not in_trace():
@@ -320,6 +426,9 @@ class csr_array(SparseArray):
         ell = self._maybe_ell()
         if ell is not None:
             return spmv_ops.csr_spmm_ell(ell[0], ell[1], B)
+        prep = self._maybe_sell()  # skewed profiles: slab gathers, XLA form
+        if prep is not None:
+            return prep.matmat(B)
         return spmv_ops.csr_spmm_segment(
             self.indptr, self.indices, self.data, B, self.shape[0]
         )

@@ -470,22 +470,23 @@ def cached_prepared_spmv(obj, attr: str, data, offsets, shape, x):
     """Shared band-gated PreparedDia dispatch for the format classes.
 
     Returns ``None`` when the band exceeds ``settings.pallas_max_band``
-    (caller falls back to the XLA formulation); otherwise caches a
-    :class:`PreparedDia` on ``obj`` under ``attr`` and applies it. Fresh
-    objects from ``_with_data``/constructors start without the attribute,
-    so mutation invalidates the cache for free.
+    (caller falls back to the XLA formulation); otherwise obtains a
+    :class:`PreparedDia` for ``obj`` from the library-wide
+    ``sparse_tpu.plan_cache`` (weak-ref keyed under ``attr``) and applies
+    it. Fresh objects from ``_with_data``/constructors are new cache keys,
+    so mutation invalidates the plan for free.
     """
+    from .. import plan_cache
     from ..config import settings
 
     band = max((abs(int(o)) for o in offsets), default=0)
     if band > settings.pallas_max_band:
         return None
-    prepared = getattr(obj, attr, None)
+    prepared = plan_cache.get(
+        obj, attr, lambda: PreparedDia(data, offsets, shape)
+    )
     if prepared is _PALLAS_UNAVAILABLE:
         return None
-    if prepared is None:
-        prepared = PreparedDia(data, offsets, shape)
-        setattr(obj, attr, prepared)
     try:
         return prepared(x)
     except (ValueError, NotImplementedError) as e:
@@ -547,7 +548,7 @@ def cached_prepared_spmv(obj, attr: str, data, offsets, shape, x):
             "kernel.failover", kernel="dia_spmv", error=repr(e)[:200],
             backend=jax.default_backend(),
         )
-        setattr(obj, attr, _PALLAS_UNAVAILABLE)
+        plan_cache.put(obj, attr, _PALLAS_UNAVAILABLE)
         return None
 
 

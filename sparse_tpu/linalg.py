@@ -260,6 +260,17 @@ class _SparseMatrixLinearOperator(LinearOperator):
     def __init__(self, A):
         super().__init__(A.shape, dtype=A.dtype)
         self.A = A
+        # Prepare/execute split: warm the operator's layout plan (DIA/ELL
+        # detection, SELL pack — all plan-cached) eagerly at wrap time, so
+        # solvers whose first matvec happens inside a compiled loop still
+        # run the whole solve on the prepared path. Advisory: any failure
+        # leaves per-matvec dispatch to its own fallbacks.
+        prepare = getattr(A, "prepare", None)
+        if prepare is not None:
+            try:
+                prepare()
+            except Exception:  # pragma: no cover - backend-dependent
+                pass
 
     def matvec(self, x, out=None):
         return self.A.dot(x)

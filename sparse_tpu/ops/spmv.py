@@ -61,6 +61,89 @@ def csr_spmv_ell(ell_indices, ell_data, x):
     return jax.lax.fori_loop(0, k, body, acc0)
 
 
+def _sell_slab_spmv(idx_t, val_t, x):
+    """y_slab = A_slab @ x on one SELL slab: [K, R] plane-major index/value
+    planes (rows of equal padded width K). Same gather-shaped op as
+    :func:`csr_spmv_ell`, stored plane-major so each plane is a contiguous
+    1-D gather; small K unrolls, large K runs under ``fori_loop``."""
+    K = idx_t.shape[0]
+    out_dt = jnp.result_type(val_t.dtype, x.dtype)
+    if K == 0:
+        return jnp.zeros((idx_t.shape[1],), dtype=out_dt)
+    if K <= ELL_UNROLL_MAX:
+        acc = val_t[0] * x[idx_t[0]]
+        for kk in range(1, K):
+            acc = acc + val_t[kk] * x[idx_t[kk]]
+        return acc.astype(out_dt)
+
+    def body(kk, acc):
+        return acc + val_t[kk] * x[idx_t[kk]]
+
+    acc0 = jnp.zeros((idx_t.shape[1],), dtype=out_dt)
+    return jax.lax.fori_loop(0, K, body, acc0)
+
+
+def csr_spmv_sell(slabs, pos, x, zero_rows: int, out_dtype=None):
+    """y = A @ x on the SELL-C-sigma layout (see ``kernels.sell_spmv``).
+
+    ``slabs`` is a static tuple of plane-major ``(idx_t, val_t)`` pairs
+    ([K_s, R_s] each — rows degree-sorted within sigma-windows, chunked into
+    C-row chunks padded to each chunk's max degree, chunks grouped by padded
+    width); ``pos`` maps original row -> position in the concatenated packed
+    output; ``zero_rows`` is the trailing all-empty-row block. Every step is
+    a contiguous 1-D gather + VPU add — no scatter, no segment ids, and
+    near-zero pad waste even under row-length skew (vs. ELL's global-max
+    padding). The portable default for prepared general SpMV; the Pallas
+    row-block variant lives in ``sparse_tpu.kernels.sell_spmv``.
+    """
+    x = jnp.asarray(x)  # numpy x would fail the fori-loop gather branch
+    out_dt = out_dtype or jnp.result_type(
+        slabs[0][1].dtype if slabs else x.dtype, x.dtype
+    )
+    parts = [_sell_slab_spmv(it, vt, x).astype(out_dt) for it, vt in slabs]
+    if zero_rows:
+        parts.append(jnp.zeros((zero_rows,), dtype=out_dt))
+    if not parts:  # empty matrix: pos is empty too
+        return jnp.zeros(pos.shape, dtype=out_dt)
+    packed = parts[0] if len(parts) == 1 else jnp.concatenate(parts)
+    return packed[pos]
+
+
+def csr_spmm_sell(slabs, pos, B, zero_rows: int, out_dtype=None):
+    """C = A @ B (dense [n, nB]) on the SELL layout: per-slab row-gathers of
+    B + fused accumulate, then one row-gather back to original order."""
+    B = jnp.asarray(B)
+    out_dt = out_dtype or jnp.result_type(
+        slabs[0][1].dtype if slabs else B.dtype, B.dtype
+    )
+    nB = B.shape[1]
+
+    def slab(it, vt):
+        K = it.shape[0]
+        if K == 0:
+            return jnp.zeros((it.shape[1], nB), dtype=out_dt)
+        if K <= ELL_UNROLL_MAX:
+            acc = vt[0][:, None] * B[it[0]]
+            for kk in range(1, K):
+                acc = acc + vt[kk][:, None] * B[it[kk]]
+            return acc.astype(out_dt)
+
+        def body(kk, acc):
+            return acc + vt[kk][:, None] * B[it[kk]]
+
+        return jax.lax.fori_loop(
+            0, K, body, jnp.zeros((it.shape[1], nB), dtype=out_dt)
+        )
+
+    parts = [slab(it, vt) for it, vt in slabs]
+    if zero_rows:
+        parts.append(jnp.zeros((zero_rows, nB), dtype=out_dt))
+    if not parts:
+        return jnp.zeros((pos.shape[0], nB), dtype=out_dt)
+    packed = parts[0] if len(parts) == 1 else jnp.concatenate(parts)
+    return packed[pos]
+
+
 def csr_spmm_segment(indptr, indices, data, B, m: int):
     """C = A @ B with B dense [k, n]. Reference: SPMM_CSR_DENSE row-split."""
     nnz = data.shape[0]

@@ -91,6 +91,23 @@ class DistCSR:
     def H(self) -> int:
         return max(self.HL, self.HR)
 
+    # -- compiled-program plans -------------------------------------------
+    def _plan_fn(self, field_name: str, kind: str, build):
+        """Resolve a compiled SPMD program through the library-wide plan
+        cache (``sparse_tpu.plan_cache``) — the distributed opt-in: eager
+        local-shard matvecs account one cache hit each, and the plan dies
+        with this layout object. The per-object field stays authoritative
+        for build-once semantics (a compiled ``shard_map`` program must
+        never be rebuilt per call — ``jax.jit`` keys on the wrapper
+        object), so disabling the cache costs only the counters."""
+        from .. import plan_cache
+
+        if getattr(self, field_name) is None:
+            setattr(self, field_name, build())
+        fn = getattr(self, field_name)
+        cached = plan_cache.get(self, kind, lambda: fn)
+        return cached if cached is not None else fn
+
     # -- vector layout helpers --------------------------------------------
     def pad_vector(self, x, splits=None, width=None) -> jax.Array:
         """Host/global vector [n] -> padded row-block layout [S*width], sharded."""
@@ -147,9 +164,8 @@ class DistCSR:
             if not in_trace():
                 telemetry.count("comm.spmv.calls")
                 telemetry.add_bytes("comm.spmv.total", self._spmv_comm_bytes())
-        if self._spmv_fn is None:
-            self._spmv_fn = _build_spmv(self)
-        return self._spmv_fn(
+        fn = self._plan_fn("_spmv_fn", "dist.spmv", lambda: _build_spmv(self))
+        return fn(
             xp,
             *(
                 (self.ell_idx, self.ell_val)
@@ -189,10 +205,11 @@ class DistCSR:
         follow x's layout; each shard halo-exchanges (or all_gathers) the B
         row-window it needs, then runs the local ELL/segment kernel.
         """
-        if self._spmm_fn is None:
-            # one jitted wrapper for all widths — jax.jit caches per shape
-            self._spmm_fn = _build_spmv(self, matrix=True)
-        return self._spmm_fn(Bp, *self._blocks())
+        # one jitted wrapper for all widths — jax.jit caches per shape
+        fn = self._plan_fn(
+            "_spmm_fn", "dist.spmm", lambda: _build_spmv(self, matrix=True)
+        )
+        return fn(Bp, *self._blocks())
 
     def rspmm_padded(self, Bp: jax.Array) -> jax.Array:
         """C = B @ A with dense B in padded *row-space* layout [p, m_pad].
@@ -203,9 +220,8 @@ class DistCSR:
         one ``psum`` over the mesh replicates the result — exactly the
         reference's ADD-reduction into a broadcast C.
         """
-        if self._rspmm_fn is None:
-            self._rspmm_fn = _build_rspmm(self)
-        return self._rspmm_fn(Bp)
+        fn = self._plan_fn("_rspmm_fn", "dist.rspmm", lambda: _build_rspmm(self))
+        return fn(Bp)
 
     def _blocks(self):
         return (
@@ -464,10 +480,13 @@ class DistCSRCol:
     pad_out_vector = DistCSR.pad_out_vector
     unpad_vector = DistCSR.unpad_vector
 
+    _plan_fn = DistCSR._plan_fn
+
     def spmv_padded(self, xp: jax.Array) -> jax.Array:
-        if self._spmv_fn is None:
-            self._spmv_fn = _build_spmv_col(self)
-        return self._spmv_fn(xp, self.nz_rows, self.nz_cols, self.nz_vals)
+        fn = self._plan_fn(
+            "_spmv_fn", "dist.spmv_col", lambda: _build_spmv_col(self)
+        )
+        return fn(xp, self.nz_rows, self.nz_cols, self.nz_vals)
 
     def dot(self, x) -> np.ndarray:
         xp = self.pad_vector(np.asarray(x))

@@ -1,0 +1,168 @@
+"""Library-wide operator plan cache: prepare once, execute everywhere.
+
+Reference analog: legate.sparse caches partitions and images per Store
+(``set_key_partition``, SURVEY §1) so a solve derives its layout once and
+every subsequent task launch reuses it. The TPU reproduction's "layouts"
+are packed operators (SELL slabs, prepared DIA planes) and compiled
+shard_map programs; this module is the one place they live, so
+``csr.dot``, ``LinearOperator`` and every solver in ``linalg`` reuse the
+same plan across a whole solve instead of re-deriving it per matvec.
+
+Design:
+
+* **Weak-ref keyed.** Entries are keyed by the operator *object* (a
+  ``csr_array``, a ``DistCSR``, ...) and die with it — a
+  ``weakref.finalize`` evicts all of an object's plans when it is
+  collected, so mutation-by-replacement (``_with_data``, fresh
+  constructions) invalidates for free and the cache can never resurrect
+  a stale layout. Objects that don't support weak references are never
+  cached (every lookup builds).
+* **Bounded.** LRU over ``settings.plan_cache_capacity`` (object, kind)
+  entries; eviction is counted.
+* **Observable.** Hit/miss/evict counters are always maintained (plain
+  ints, no I/O) and surfaced via :func:`stats`; with telemetry enabled
+  they also mirror into ``telemetry.summary()["counts"]`` under
+  ``plan_cache.hit`` / ``plan_cache.miss`` / ``plan_cache.evict``
+  (docs/telemetry.md).
+* **Switchable.** ``SPARSE_TPU_PLAN_CACHE=0`` (``settings.plan_cache``)
+  disables caching entirely: every lookup misses and builds, correctness
+  unchanged — the parity suite runs both ways.
+"""
+
+from __future__ import annotations
+
+import threading
+import weakref
+from collections import OrderedDict
+
+from .config import settings
+
+_LOCK = threading.RLock()
+# (id(obj), kind) -> (weakref | None, plan); OrderedDict for LRU order
+_ENTRIES: OrderedDict = OrderedDict()
+_FINALIZERS: dict[int, object] = {}  # id(obj) -> weakref.finalize handle
+_STATS = {"hits": 0, "misses": 0, "evictions": 0}
+_TELEMETRY_NAMES = {"hits": "plan_cache.hit", "misses": "plan_cache.miss",
+                    "evictions": "plan_cache.evict"}
+
+
+def _count(which: str) -> None:
+    _STATS[which] += 1
+    if settings.telemetry:
+        from . import telemetry
+
+        # counters are the cheap aggregate channel; one event per lookup
+        # would flood the ring on hot paths
+        telemetry.count(_TELEMETRY_NAMES[which])
+
+
+def _evict_object(oid: int) -> None:
+    """Drop every plan of a collected (or invalidated) object."""
+    with _LOCK:
+        dead = [k for k in _ENTRIES if k[0] == oid]
+        for k in dead:
+            del _ENTRIES[k]
+            _count("evictions")
+        _FINALIZERS.pop(oid, None)
+
+
+def get(obj, kind: str, build=None):
+    """Return the cached plan for ``(obj, kind)``, building on miss.
+
+    ``build`` is a zero-arg callable producing the plan; with
+    ``build=None`` a miss returns ``None`` (the trace-safe lookup form —
+    in-trace callers may not build, packing needs host syncs). Lookups
+    count exactly one hit or miss each. With the cache disabled every
+    call counts a miss and builds (when it can).
+    """
+    key = (id(obj), kind)
+    if settings.plan_cache:
+        with _LOCK:
+            ent = _ENTRIES.get(key)
+            if ent is not None and (ent[0] is None or ent[0]() is obj):
+                _ENTRIES.move_to_end(key)
+                _count("hits")
+                return ent[1]
+    _count("misses")
+    if build is None:
+        return None
+    plan = build()
+    if not settings.plan_cache or plan is None:
+        return plan
+    try:
+        ref = weakref.ref(obj)
+    except TypeError:
+        return plan  # un-weakref-able key: never cached (id reuse unsafe)
+    with _LOCK:
+        _ENTRIES[key] = (ref, plan)
+        _ENTRIES.move_to_end(key)
+        oid = id(obj)
+        if oid not in _FINALIZERS:
+            _FINALIZERS[oid] = weakref.finalize(obj, _evict_object, oid)
+        cap = max(int(settings.plan_cache_capacity), 1)
+        while len(_ENTRIES) > cap:
+            old_key, _ = _ENTRIES.popitem(last=False)
+            _count("evictions")
+    return plan
+
+
+def lookup(obj, kind: str):
+    """Trace-safe cached-plan lookup (never builds). See :func:`get`."""
+    return get(obj, kind, None)
+
+
+def put(obj, kind: str, plan) -> None:
+    """Store/replace a plan directly (no hit/miss accounting) — the
+    failover-marker form (``kernels.dia_spmv._PALLAS_UNAVAILABLE``).
+    Silently a no-op when caching is off or ``obj`` is un-weakref-able."""
+    if not settings.plan_cache:
+        return
+    try:
+        ref = weakref.ref(obj)
+    except TypeError:
+        return
+    with _LOCK:
+        _ENTRIES[(id(obj), kind)] = (ref, plan)
+        _ENTRIES.move_to_end((id(obj), kind))
+        oid = id(obj)
+        if oid not in _FINALIZERS:
+            _FINALIZERS[oid] = weakref.finalize(obj, _evict_object, oid)
+
+
+def invalidate(obj, kind: str | None = None) -> None:
+    """Drop an object's cached plans (one kind, or all of them)."""
+    with _LOCK:
+        if kind is None:
+            _evict_object(id(obj))
+            return
+        if _ENTRIES.pop((id(obj), kind), None) is not None:
+            _count("evictions")
+
+
+def stats() -> dict:
+    """Always-on counters: ``{hits, misses, evictions, size, hit_rate}``."""
+    with _LOCK:
+        out = dict(_STATS)
+        out["size"] = len(_ENTRIES)
+    total = out["hits"] + out["misses"]
+    out["hit_rate"] = out["hits"] / total if total else 0.0
+    return out
+
+
+def reset_stats() -> None:
+    with _LOCK:
+        for k in _STATS:
+            _STATS[k] = 0
+
+
+def clear() -> None:
+    """Drop every entry (counters untouched; evictions not counted —
+    this is a test/debug reset, not cache pressure)."""
+    with _LOCK:
+        _ENTRIES.clear()
+        for f in _FINALIZERS.values():
+            try:
+                f.detach()
+            except Exception:
+                pass
+        _FINALIZERS.clear()

@@ -30,6 +30,10 @@ KINDS: dict[str, frozenset] = {
     "autotune.result": frozenset({"tile", "probed"}),
     # a Pallas kernel permanently failing over to the XLA formulation
     "kernel.failover": frozenset({"kernel", "error"}),
+    # a structural fast path silently unavailable at runtime (e.g. banded
+    # detection's host fetch failing on an experimental backend), with the
+    # path actually taken in `to` — the perf-cliff breadcrumb
+    "coverage.fallback": frozenset({"op", "reason"}),
     # -- distribution (parallel/) ------------------------------------------
     # structural comm model of a freshly sharded operator (per-SpMV cost)
     "comm.spmv": frozenset({"bytes", "mode", "S"}),

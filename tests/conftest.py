@@ -64,7 +64,9 @@ _QUICK_FILES = {
     "test_grid2d.py",
     "test_io.py",
     "test_multigrid.py",
+    "test_plan_cache.py",
     "test_quantum.py",
+    "test_sell_spmv.py",
     "test_shard_perf.py",
     "test_spatial.py",
     "test_telemetry.py",

@@ -179,11 +179,15 @@ def test_spmv_mode_pallas_prepared_cache():
         settings.spmv_mode = "pallas"
         A = sparse_tpu.dia_array((data, offs), shape=(40, 40))
         np.testing.assert_allclose(np.asarray(A @ x), s @ x, rtol=1e-4, atol=1e-5)
-        assert getattr(A, "_prepared", None) is not None
+        # PreparedDia now lives in the library-wide plan cache (weak-ref
+        # keyed under the legacy attr name), not as an object attribute
+        from sparse_tpu import plan_cache
+
+        assert plan_cache.lookup(A, "_prepared") is not None
         np.testing.assert_allclose(np.asarray(A @ x), s @ x, rtol=1e-4, atol=1e-5)
         C = sparse_tpu.csr_array(s.tocsr())
         np.testing.assert_allclose(np.asarray(C @ x), s @ x, rtol=1e-4, atol=1e-5)
-        assert getattr(C, "_dia_prepared", None) is not None
+        assert plan_cache.lookup(C, "_dia_prepared") is not None
         # mutation produces a fresh object -> fresh cache
         C2 = C * 2.0
         np.testing.assert_allclose(np.asarray(C2 @ x), 2 * (s @ x), rtol=1e-4, atol=1e-5)

@@ -1,0 +1,193 @@
+"""Library-wide operator plan cache: counters, eviction, solver reuse.
+
+The acceptance instrument of ISSUE 2's prepare/execute split: one miss at
+prepare, hits for every subsequent matvec of a solve (>= 99% over a
+100-iteration CG), entries dying with their operator, LRU bounded, and a
+disable switch that changes performance only — never results.
+"""
+
+import gc
+
+import numpy as np
+import pytest
+import scipy.sparse as sp
+
+import sparse_tpu
+from sparse_tpu import linalg, plan_cache
+from sparse_tpu.config import settings
+
+
+class _Obj:
+    """A trivially weakref-able cache key."""
+
+
+def _delta(before, after):
+    return {k: after[k] - before[k] for k in ("hits", "misses", "evictions")}
+
+
+def test_get_counts_hits_and_misses():
+    o = _Obj()
+    before = plan_cache.stats()
+    assert plan_cache.get(o, "k", lambda: "plan") == "plan"
+    assert plan_cache.get(o, "k", lambda: "NEW") == "plan"  # cached wins
+    assert plan_cache.lookup(o, "k") == "plan"
+    assert plan_cache.lookup(o, "other") is None
+    d = _delta(before, plan_cache.stats())
+    assert d["hits"] == 2 and d["misses"] == 2
+
+
+def test_weakref_eviction():
+    o = _Obj()
+    plan_cache.get(o, "k", lambda: "plan")
+    before = plan_cache.stats()
+    del o
+    gc.collect()
+    after = plan_cache.stats()
+    assert after["evictions"] >= before["evictions"] + 1
+
+
+def test_invalidate_and_capacity_lru(monkeypatch):
+    monkeypatch.setattr(settings, "plan_cache_capacity", 4)
+    objs = [_Obj() for _ in range(6)]
+    for i, o in enumerate(objs):
+        plan_cache.get(o, "k", lambda i=i: i)
+    assert plan_cache.stats()["size"] <= 4
+    # the oldest entries were LRU-evicted; the newest are still hits
+    before = plan_cache.stats()
+    assert plan_cache.lookup(objs[-1], "k") == 5
+    assert plan_cache.lookup(objs[0], "k") is None
+    d = _delta(before, plan_cache.stats())
+    assert d["hits"] == 1 and d["misses"] == 1
+    plan_cache.invalidate(objs[-1], "k")
+    assert plan_cache.lookup(objs[-1], "k") is None
+
+
+def test_disabled_cache_builds_every_time(monkeypatch):
+    monkeypatch.setattr(settings, "plan_cache", False)
+    o = _Obj()
+    calls = []
+    for _ in range(3):
+        plan_cache.get(o, "k", lambda: calls.append(1))
+    assert len(calls) == 3
+    assert plan_cache.lookup(o, "k") is None
+
+
+def _skewed_spd(m=400, seed=5):
+    rng = np.random.default_rng(seed)
+    deg = np.minimum((rng.pareto(1.1, m) * 4 + 1).astype(int), m // 4)
+    rows = np.repeat(np.arange(m), deg)
+    cols = rng.integers(0, m, rows.shape[0])
+    G = sp.coo_matrix(
+        (rng.random(rows.shape[0]), (rows, cols)), shape=(m, m)
+    ).tocsr()
+    A = (G + G.T) * 0.5
+    return (A + sp.diags(np.asarray(np.abs(A).sum(axis=1)).ravel() + 1.0)).tocsr()
+
+
+def test_cg_100_iters_hit_rate(monkeypatch):
+    """The headline contract: a 100-iteration CG solve prepares once and
+    reuses the plan for every matvec — >= 99% hit rate (1 miss at
+    prepare). Host loop (per-iteration eager matvecs) via callback."""
+    monkeypatch.setattr(settings, "spmv_mode", "sell")
+    s = _skewed_spd()
+    A = sparse_tpu.csr_array(s)
+    b = np.random.default_rng(0).standard_normal(s.shape[0])
+    plan_cache.reset_stats()
+    x, iters = linalg.cg(
+        A, b, maxiter=100, tol=1e-30, conv_test_iters=200,
+        callback=lambda _x: None,
+    )
+    assert iters == 100
+    st = plan_cache.stats()
+    assert st["misses"] == 1
+    assert st["hit_rate"] >= 0.99
+    # and the solve is still a solve
+    np.testing.assert_allclose(np.asarray(A @ x), b, rtol=1e-4, atol=1e-5)
+
+
+def test_device_loop_cg_uses_prepared_plan(monkeypatch):
+    """The compiled-loop path: make_linear_operator warms the plan at wrap
+    time, so the traced while_loop embeds the packed operator (lookup hits
+    from inside the trace) and converges identically both cache states."""
+    s = _skewed_spd(200, seed=6)
+    b = np.random.default_rng(1).standard_normal(200)
+    monkeypatch.setattr(settings, "spmv_mode", "sell")
+    sols = {}
+    for cache_on in (True, False):
+        monkeypatch.setattr(settings, "plan_cache", cache_on)
+        A = sparse_tpu.csr_array(s)
+        x, _ = linalg.cg(A, b, maxiter=60, tol=1e-12)
+        sols[cache_on] = np.asarray(x)
+        if cache_on:
+            assert plan_cache.lookup(A, "sell") is not None
+    np.testing.assert_allclose(sols[True], sols[False], rtol=1e-6, atol=1e-8)
+
+
+def test_solvers_share_one_plan(monkeypatch):
+    """Different solvers over the same operator object share the plan:
+    exactly one sell pack, everything after is hits."""
+    monkeypatch.setattr(settings, "spmv_mode", "sell")
+    s = _skewed_spd(150, seed=7)
+    A = sparse_tpu.csr_array(s)
+    b = np.random.default_rng(2).standard_normal(150)
+    plan_cache.reset_stats()
+    linalg.cg(A, b, maxiter=10, tol=1e-30)
+    linalg.bicgstab(A, b, maxiter=5, tol=1e-30)
+    linalg.gmres(A, b, maxiter=1, restart=5, tol=1e-30)
+    st = plan_cache.stats()
+    assert st["misses"] <= 2  # one sell pack (+ at most one trace-cold lookup)
+    assert st["hits"] >= 3
+
+
+def test_dist_spmv_plans_ride_the_cache():
+    """DistCSR's compiled shard_map programs are plan-cache entries: eager
+    local-shard matvecs account hits, and the plan dies with the layout."""
+    from sparse_tpu.parallel.dist import shard_csr
+
+    e = np.ones(64)
+    A = sparse_tpu.diags([-e[:-1], 2 * e, -e[:-1]], [-1, 0, 1]).tocsr()
+    D = shard_csr(A)
+    x = np.random.default_rng(3).standard_normal(64)
+    plan_cache.reset_stats()
+    y1 = D.dot(x)
+    y2 = D.dot(x)
+    np.testing.assert_allclose(y1, y2)
+    st = plan_cache.stats()
+    assert st["hits"] >= 1
+    assert plan_cache.lookup(D, "dist.spmv") is not None
+
+
+def test_telemetry_counter_mirror(monkeypatch, tmp_path):
+    """With telemetry on, cache activity mirrors into summary()['counts']
+    under plan_cache.hit / plan_cache.miss (docs/telemetry.md)."""
+    from sparse_tpu import telemetry
+
+    monkeypatch.setattr(settings, "telemetry", True)
+    telemetry.configure(str(tmp_path / "t.jsonl"))
+    telemetry.reset()
+    try:
+        o = _Obj()
+        plan_cache.get(o, "k", lambda: "plan")
+        plan_cache.get(o, "k", lambda: "plan")
+        counts = telemetry.summary()["counts"]
+        assert counts.get("plan_cache.miss", 0) >= 1
+        assert counts.get("plan_cache.hit", 0) >= 1
+    finally:
+        telemetry.configure(None)
+        telemetry.reset()
+
+
+def test_unweakrefable_keys_never_cached():
+    """Objects without weakref support build every time (id-reuse safety)."""
+    import weakref
+
+    class NoRef:
+        __slots__ = ("x",)
+
+    o = NoRef()
+    with pytest.raises(TypeError):
+        weakref.ref(o)
+    built = []
+    for _ in range(2):
+        plan_cache.get(o, "k", lambda: built.append(1) or "p")
+    assert len(built) == 2

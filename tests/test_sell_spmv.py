@@ -1,0 +1,240 @@
+"""SELL-C-sigma prepared SpMV: pack correctness, mode parity, fallbacks.
+
+The prepared general-matrix path of ISSUE 2: every ``spmv_mode`` must agree
+with the dense/scipy oracle on the awkward shapes (empty rows, zero-nnz,
+duplicate columns, dtype axis, power-law row-length skew), with the plan
+cache enabled and disabled, and the Pallas row-block kernel (interpret mode
+off-TPU) must match the XLA slab formulation exactly.
+"""
+
+import gc
+
+import numpy as np
+import pytest
+import scipy.sparse as sp
+
+import sparse_tpu
+from sparse_tpu import plan_cache
+from sparse_tpu.config import Settings, settings
+from sparse_tpu.kernels.sell_spmv import PreparedCSR, sell_pack
+
+from .utils.sample import sample_csr, sample_vec
+
+MODES = ("segment", "ell", "sell", "pallas", "auto")
+
+
+def powerlaw_csr(m=300, seed=5, dtype=np.float64):
+    """Pathological power-law row-length profile (plus one near-dense row):
+    the shape where ELL's global-max padding explodes."""
+    rng = np.random.default_rng(seed)
+    deg = np.minimum((rng.pareto(1.0, m) * 3 + 1).astype(int), m - 1)
+    deg[0] = m - 1  # one near-dense row pins the global max
+    rows = np.repeat(np.arange(m), deg)
+    cols = rng.integers(0, m, rows.shape[0])
+    vals = rng.standard_normal(rows.shape[0])
+    if np.issubdtype(dtype, np.complexfloating):
+        vals = vals + 1j * rng.standard_normal(rows.shape[0])
+    return sp.coo_matrix((vals.astype(dtype), (rows, cols)), shape=(m, m)).tocsr()
+
+
+def _cases():
+    """(label, scipy_csr) pairs for the parity sweep."""
+    out = [
+        ("random_f64", sample_csr(37, 29, density=0.25, seed=1)),
+        ("random_f32", sample_csr(23, 31, dtype=np.float32, seed=2)),
+        ("c64", sample_csr(19, 19, dtype=np.complex64, seed=3)),
+        ("powerlaw", powerlaw_csr(120, seed=4)),
+        ("zero_nnz", sp.csr_matrix((7, 5), dtype=np.float64)),
+        (
+            "empty_rows",
+            sp.csr_matrix(
+                (np.array([1.0, 2.0]), np.array([1, 3]),
+                 np.array([0, 0, 2, 2, 2, 2])),
+                shape=(5, 4),
+            ),
+        ),
+        (
+            # duplicate column ids within a row (from_parts skips the
+            # COO-dedup canonicalization) must sum, not drop
+            "dup_cols",
+            sp.csr_matrix(
+                (np.array([1.0, 2.0, 4.0]), np.array([1, 1, 0]),
+                 np.array([0, 2, 3, 3])),
+                shape=(3, 3),
+            ),
+        ),
+    ]
+    return out
+
+
+@pytest.mark.parametrize("mode", MODES)
+@pytest.mark.parametrize("cache_on", [True, False], ids=["cache", "nocache"])
+def test_spmv_mode_parity(mode, cache_on, monkeypatch):
+    """Every mode x every awkward shape x cache on/off == dense reference."""
+    monkeypatch.setattr(settings, "spmv_mode", mode)
+    monkeypatch.setattr(settings, "plan_cache", cache_on)
+    for label, s in _cases():
+        A = sparse_tpu.csr_array.from_parts(
+            s.data, s.indices, s.indptr, s.shape
+        )
+        rng = np.random.default_rng(11)
+        x = rng.standard_normal(s.shape[1])
+        if np.issubdtype(s.dtype, np.complexfloating):
+            x = (x + 1j * rng.standard_normal(s.shape[1])).astype(s.dtype)
+        dense = s.toarray()
+        for rep in range(2):  # second call exercises the cached plan
+            got = np.asarray(A @ x)
+            np.testing.assert_allclose(
+                got, dense @ x, rtol=2e-5, atol=2e-5,
+                err_msg=f"{label} mode={mode} cache={cache_on} rep={rep}",
+            )
+        B = rng.standard_normal((s.shape[1], 4))
+        np.testing.assert_allclose(
+            np.asarray(A @ B), dense @ B, rtol=2e-5, atol=2e-5,
+            err_msg=f"{label} spmm mode={mode} cache={cache_on}",
+        )
+
+
+@pytest.mark.parametrize("C,sigma,max_slabs", [(4, 0, 16), (8, 32, 16), (8, 64, 3), (16, 1000, 16)])
+def test_sell_pack_geometry(C, sigma, max_slabs):
+    """Pack invariants across chunk/window/slab-budget settings: exact SpMV,
+    every nonzero stored once, pad bounded by the quantization guarantee."""
+    s = powerlaw_csr(130, seed=9)
+    plan, slabs, pos = sell_pack(
+        s.indptr, s.indices, s.data, s.shape, C=C, sigma=sigma,
+        max_slabs=max_slabs,
+    )
+    assert len(plan.slab_meta) <= max(max_slabs, 33)  # pow2 fallback bound
+    total_vals = sum(int((np.asarray(vt) != 0).sum()) for _, vt in slabs)
+    assert total_vals == int((s.data != 0).sum())
+    x = np.random.default_rng(0).standard_normal(s.shape[1])
+    from sparse_tpu.ops.spmv import csr_spmv_sell
+
+    got = np.asarray(csr_spmv_sell(slabs, pos, np.asarray(x), plan.zero_rows))
+    np.testing.assert_allclose(got, s @ x, rtol=1e-10, atol=1e-10)
+
+
+def test_sell_beats_ell_padding_on_skew():
+    """The point of the format: on the power-law profile the SELL stored
+    slots stay near nnz while ELL's global-max padding is >10x."""
+    s = powerlaw_csr(300, seed=5)
+    plan, _, _ = sell_pack(s.indptr, s.indices, s.data, s.shape)
+    kmax = int(np.diff(s.indptr).max())
+    ell_slots = s.shape[0] * kmax
+    assert plan.pad_ratio < 3.0
+    assert ell_slots / max(s.nnz, 1) > 10 * plan.pad_ratio
+
+
+def test_sell_pallas_interpret_matches_xla():
+    """The Pallas row-block kernel (interpret off-TPU) == XLA slab path."""
+    s = powerlaw_csr(90, seed=6).astype(np.float32)
+    prep = PreparedCSR(s.indptr, s.indices, s.data, s.shape)
+    x = np.random.default_rng(1).standard_normal(s.shape[1]).astype(np.float32)
+    y_xla = np.asarray(prep.matvec_xla(x))
+    y_pal = np.asarray(prep.matvec_pallas(x))
+    np.testing.assert_allclose(y_pal, y_xla, rtol=1e-6, atol=1e-6)
+
+
+def test_auto_mode_routes_skewed_to_sell(monkeypatch):
+    """'auto' folds the SELL option in: a skewed profile packs a SELL plan,
+    a tight (banded-free, bounded-degree) profile keeps the ELL path."""
+    monkeypatch.setattr(settings, "spmv_mode", "auto")
+    skewed = sparse_tpu.csr_array(powerlaw_csr(100, seed=8))
+    x = np.random.default_rng(2).standard_normal(100)
+    skewed @ x
+    assert plan_cache.lookup(skewed, "sell") is not None
+
+    tight = sparse_tpu.csr_array(sample_csr(40, 40, density=0.2, seed=3))
+    tight @ np.random.default_rng(3).standard_normal(40)
+    assert tight._ell is not None
+    assert plan_cache.lookup(tight, "sell") is None
+
+
+def test_sell_mode_env_roundtrip(monkeypatch):
+    """SPARSE_TPU_SPMV_MODE round-trips through config for the new mode."""
+    monkeypatch.setenv("SPARSE_TPU_SPMV_MODE", "sell")
+    assert Settings().spmv_mode == "sell"
+    monkeypatch.delenv("SPARSE_TPU_SPMV_MODE")
+    assert Settings().spmv_mode == "auto"
+    monkeypatch.setenv("SPARSE_TPU_PLAN_CACHE", "0")
+    assert Settings().plan_cache is False
+
+
+def test_prepare_api(monkeypatch):
+    """csr_array.prepare() warms the mode's plan eagerly and returns self."""
+    monkeypatch.setattr(settings, "spmv_mode", "sell")
+    A = sparse_tpu.csr_array(powerlaw_csr(80, seed=10))
+    assert A.prepare() is A
+    assert plan_cache.lookup(A, "sell") is not None
+    # explicit mode override does not disturb the ambient setting
+    monkeypatch.setattr(settings, "spmv_mode", "segment")
+    B = sparse_tpu.csr_array(powerlaw_csr(80, seed=11))
+    B.prepare(mode="sell")
+    assert settings.spmv_mode == "segment"
+    assert plan_cache.lookup(B, "sell") is not None
+
+
+def test_in_trace_cold_start_degrades_then_warm(monkeypatch):
+    """First use inside a trace cannot pack (host syncs) and must still be
+    correct; an eager warm then serves the compiled path the plan."""
+    import jax
+
+    monkeypatch.setattr(settings, "spmv_mode", "sell")
+    s = powerlaw_csr(60, seed=12)
+    A = sparse_tpu.csr_array(s)
+    x = np.random.default_rng(4).standard_normal(60)
+    y_cold = np.asarray(jax.jit(A._spmv)(np.asarray(x)))
+    np.testing.assert_allclose(y_cold, s @ x, rtol=1e-10)
+    assert plan_cache.lookup(A, "sell") is None  # no cache write in-trace
+    A.prepare()
+    y_warm = np.asarray(jax.jit(A._spmv)(np.asarray(x)))
+    np.testing.assert_allclose(y_warm, s @ x, rtol=1e-10)
+
+
+def test_dia_detection_fallback_emits_coverage_event(monkeypatch, tmp_path):
+    """The (formerly silent) banded-detection degradation now records a
+    coverage.fallback telemetry event and still returns a correct matvec."""
+    import jax
+
+    from sparse_tpu import telemetry
+
+    offs = [-1, 0, 1]
+    e = np.ones(32)
+    s = sp.diags([e[:-1], 2 * e, e[:-1]], offs, format="csr")
+    A = sparse_tpu.csr_array(s)
+
+    def boom(offs_dev):
+        raise jax.errors.JaxRuntimeError("UNIMPLEMENTED: transfer failed")
+
+    monkeypatch.setattr(sparse_tpu.csr_array, "_fetch_offsets", staticmethod(boom))
+    monkeypatch.setattr(settings, "telemetry", True)
+    telemetry.configure(str(tmp_path / "t.jsonl"))
+    telemetry.reset()
+    try:
+        with pytest.warns(UserWarning, match="detection"):
+            y = np.asarray(A @ np.ones(32))
+        np.testing.assert_allclose(y, s @ np.ones(32))
+        evs = telemetry.events("coverage.fallback")
+        assert len(evs) == 1
+        assert evs[0]["op"] == "csr._maybe_dia"
+        assert telemetry.schema.validate(evs[0]) == []
+    finally:
+        telemetry.configure(None)
+        telemetry.reset()
+
+
+def test_sell_plan_dies_with_matrix(monkeypatch):
+    """_with_data / fresh objects never inherit a stale plan; collected
+    matrices evict their plans (weak-ref keyed cache)."""
+    monkeypatch.setattr(settings, "spmv_mode", "sell")
+    s = powerlaw_csr(50, seed=13)
+    A = sparse_tpu.csr_array(s)
+    x = np.random.default_rng(5).standard_normal(50)
+    A @ x
+    A2 = A * 2.0  # fresh object -> fresh (cold) plan
+    assert plan_cache.lookup(A2, "sell") is None
+    np.testing.assert_allclose(np.asarray(A2 @ x), 2 * (s @ x), rtol=1e-10)
+    before = plan_cache.stats()["size"]
+    del A, A2
+    gc.collect()
+    assert plan_cache.stats()["size"] < before
