@@ -99,6 +99,16 @@ def _log_session_record(rec, status: str, t_start: float) -> None:
             entry["telemetry"] = telemetry.summary()
         except Exception:
             traceback.print_exc(file=sys.stderr)
+    try:
+        # plan-cache counters are ALWAYS-ON (plain ints, no telemetry
+        # needed): embed them so bench rounds can attribute cache
+        # behavior (prepare reuse, batched-bucket compiles) without a
+        # separate probe
+        from sparse_tpu import plan_cache
+
+        entry["plan_cache"] = plan_cache.stats()
+    except Exception:
+        traceback.print_exc(file=sys.stderr)
     _log_hw_record(entry)
 
 
@@ -425,6 +435,104 @@ def run_skewed_cg(m: int = 20000, iters: int = 100) -> dict:
     return out
 
 
+def run_batched_cg(B: int = 32, n: int = 4096, iters: int = 60) -> dict:
+    """Batched-solve row (ISSUE 3): B same-pattern SPD systems through the
+    batch subsystem vs B sequential ``linalg.cg`` calls — the serving
+    shape (same mesh, different coefficients/rhs). The tracked numbers:
+
+    * ``speedup``: sequential wall time / first batched dispatch (compile
+      included on BOTH sides — the honest cold-traffic comparison), with
+      ``speedup_warm`` for the steady state. Acceptance: >= 4x on CPU.
+    * ``plan_cache``: exactly ONE miss for the batch's single bucket
+      (asserted via the always-on cache stats; the pattern pack is warmed
+      outside the window, every later dispatch hits).
+    * ``b1_match``: batch-of-1 numerically matches the unbatched solve.
+
+    Fixed work (tol below reach, conv test at the end) so both sides run
+    ``iters`` CG iterations per system.
+    """
+    import numpy as np
+    import scipy.sparse as sp
+
+    import sparse_tpu
+    from sparse_tpu import linalg, plan_cache
+    from sparse_tpu.batch import BatchedCSR, SolveSession
+
+    rng = np.random.default_rng(11)
+    e = np.ones(n, dtype=np.float32)
+    base = sp.diags(
+        [-e[:-1], 2.5 * e, -e[:-1]], [-1, 0, 1], format="csr"
+    ).astype(np.float32)
+    base.sort_indices()
+    # same pattern, per-lane coefficients: scaled diagonal keeps SPD
+    mats = []
+    for i in range(B):
+        Ai = base.copy()
+        Ai.setdiag(2.5 + rng.random(n).astype(np.float32))
+        Ai.sort_indices()
+        mats.append(Ai.tocsr())
+    rhs = rng.standard_normal((B, n)).astype(np.float32)
+    cti = 2 * iters  # conv test only at iters-1: fixed work both sides
+    out = {"B": B, "n": n, "iters": iters}
+
+    # -- sequential lane: B independent cg() calls (each traces its own
+    # compiled loop — the per-request cost a serving stack actually pays)
+    t0 = time.perf_counter()
+    seq = []
+    for i in range(B):
+        x, it = linalg.cg(
+            sparse_tpu.csr_array(mats[i]), rhs[i], tol=1e-30,
+            maxiter=iters, conv_test_iters=cti,
+        )
+        seq.append(np.asarray(x))
+        assert it == iters
+    t_seq = time.perf_counter() - t0
+    out["seq_s"] = round(t_seq, 3)
+    out["seq_solves_per_s"] = round(B / t_seq, 2)
+
+    # -- batched lane: one SolveSession dispatch per flush
+    ses = SolveSession("cg", batch_max=B, conv_test_iters=cti)
+    pattern = ses.pattern_of(mats[0])
+    pattern.sell_pack()  # warm the pattern pack outside the window
+    snap = plan_cache.snapshot()
+    t0 = time.perf_counter()
+    X, its, _r2 = ses.solve_many(mats, rhs, tol=1e-30, maxiter=iters)
+    t_first = time.perf_counter() - t0
+    d = plan_cache.delta(snap)
+    out["batched_first_s"] = round(t_first, 3)
+    out["speedup"] = round(t_seq / t_first, 2)
+    # exactly one plan-cache miss per bucket (1 bucket here): the bucket
+    # program; the pattern pack HITS from inside its build
+    out["plan_cache"] = {"buckets": 1, **d,
+                         "one_miss_per_bucket": d["misses"] == 1}
+    snap = plan_cache.snapshot()
+    t0 = time.perf_counter()
+    ses.solve_many(mats, rhs, tol=1e-30, maxiter=iters)
+    t_warm = time.perf_counter() - t0
+    d2 = plan_cache.delta(snap)
+    out["batched_warm_s"] = round(t_warm, 3)
+    out["speedup_warm"] = round(t_seq / t_warm, 2)
+    out["warm_dispatch_cache"] = d2  # expect 0 misses: program reused
+    out["batched_solves_per_s"] = round(B / t_warm, 2)
+    # per-lane results match the sequential solves
+    out["lanes_match"] = bool(
+        max(
+            float(np.max(np.abs(X[i] - seq[i]))) for i in range(B)
+        ) < 1e-3
+    )
+
+    # -- batch-of-1 parity: the batched path degenerates exactly
+    x1, info = linalg.batched_cg(
+        BatchedCSR(pattern, mats[0].data[None, :]), rhs[:1], tol=1e-30,
+        maxiter=iters, conv_test_iters=cti,
+    )
+    diff = float(np.max(np.abs(np.asarray(x1)[0] - seq[0])))
+    out["b1_match"] = diff < 1e-4
+    out["b1_max_abs_diff"] = diff
+    out["b1_iters"] = int(np.asarray(info.iters)[0])
+    return out
+
+
 def run_spmm(n: int = 2000, width: int = 128):
     """SpMM row (VERDICT r3 #7): CSR x dense WIDE B — the MXU-shaped op
     the reference implements as a first-class task family
@@ -712,6 +820,10 @@ def worker(platform_arg: str) -> None:
             rec["skewed_cg"] = run_skewed_cg()
         except Exception:
             traceback.print_exc(file=sys.stderr)
+        try:  # stage 4.6: batched same-pattern solves (sparse_tpu.batch)
+            rec["batched_cg"] = run_batched_cg()
+        except Exception:
+            traceback.print_exc(file=sys.stderr)
         print(json.dumps(rec))
         sys.stdout.flush()
         try:  # stage 5: full fused sweep — refines the headline if better
@@ -752,6 +864,10 @@ def worker(platform_arg: str) -> None:
             traceback.print_exc(file=sys.stderr)
         try:  # skewed-degree CSR CG: the tracked prepared-SELL number
             rec["skewed_cg"] = run_skewed_cg()
+        except Exception:
+            traceback.print_exc(file=sys.stderr)
+        try:  # batched same-pattern solves: the tracked microbatching row
+            rec["batched_cg"] = run_batched_cg()
         except Exception:
             traceback.print_exc(file=sys.stderr)
         print(json.dumps(rec))

@@ -1,0 +1,160 @@
+"""Quick-lane integrity guard (ISSUE 3 satellite).
+
+The quick lane (`pytest tests/ -m quick`, conftest._QUICK_FILES) is the
+builder inner loop; it regresses silently in two ways: a listed file is
+deleted/renamed (the marker hook simply stops matching — nothing fails),
+or a refactor quietly drops tests from a quick module. This script fails
+on both, against a committed manifest:
+
+* every file in ``tests/conftest.py::_QUICK_FILES`` must exist;
+* every manifest entry must still be in ``_QUICK_FILES`` (and vice
+  versa — a new quick file must be manifested);
+* each file's statically-collected test count (``test_*`` functions at
+  module scope and inside ``Test*`` classes, counted by ``ast`` — no
+  imports, no jax init, so the check costs milliseconds) must not DROP
+  below the manifest; growth is fine and prompts a friendly note.
+
+Usage:
+    python scripts/check_quick_lane.py            # check, exit 1 on problems
+    python scripts/check_quick_lane.py --update   # regenerate the manifest
+
+Wired into the suite by ``tests/test_quick_lane.py`` (itself in the
+quick lane) so tier-1 catches lane regressions.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import os
+import sys
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+TESTS = os.path.join(REPO, "tests")
+CONFTEST = os.path.join(TESTS, "conftest.py")
+MANIFEST = os.path.join(TESTS, "quick_lane_manifest.json")
+
+
+def quick_files() -> set:
+    """The ``_QUICK_FILES`` set, read by ast (importing conftest mutates
+    the process env and initializes jax — far too heavy for a guard)."""
+    tree = ast.parse(open(CONFTEST).read(), filename=CONFTEST)
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, ast.Name) and t.id == "_QUICK_FILES":
+                    return set(ast.literal_eval(node.value))
+    raise RuntimeError(f"_QUICK_FILES not found in {CONFTEST}")
+
+
+def count_tests(path: str) -> int:
+    """Static test count: ``test_*`` defs at module scope plus methods of
+    ``Test*`` classes (pytest's default collection surface). Parametrize
+    multiplies runtime counts, but a *static* drop is exactly the
+    silent-deletion signal this guard exists for."""
+    tree = ast.parse(open(path).read(), filename=path)
+    n = 0
+    for node in tree.body:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            if node.name.startswith("test"):
+                n += 1
+        elif isinstance(node, ast.ClassDef) and node.name.startswith("Test"):
+            for sub in node.body:
+                if isinstance(
+                    sub, (ast.FunctionDef, ast.AsyncFunctionDef)
+                ) and sub.name.startswith("test"):
+                    n += 1
+    return n
+
+
+def current_counts() -> dict:
+    return {
+        f: count_tests(os.path.join(TESTS, f)) for f in sorted(quick_files())
+        if os.path.exists(os.path.join(TESTS, f))
+    }
+
+
+def check() -> list:
+    """Returns a list of problem strings (empty = lane intact)."""
+    problems = []
+    files = quick_files()
+    for f in sorted(files):
+        if not os.path.exists(os.path.join(TESTS, f)):
+            problems.append(
+                f"quick-lane file missing: tests/{f} is in _QUICK_FILES "
+                "but does not exist (renamed without updating conftest?)"
+            )
+    if not os.path.exists(MANIFEST):
+        problems.append(
+            f"manifest missing: {os.path.relpath(MANIFEST, REPO)} "
+            "(run scripts/check_quick_lane.py --update)"
+        )
+        return problems
+    manifest = json.load(open(MANIFEST))
+    recorded = manifest.get("files", {})
+    for f in sorted(set(recorded) - files):
+        problems.append(
+            f"tests/{f} is in the manifest but no longer in _QUICK_FILES "
+            "(lane shrank; update the manifest deliberately if intended)"
+        )
+    for f in sorted(files - set(recorded)):
+        problems.append(
+            f"tests/{f} joined _QUICK_FILES but is not manifested "
+            "(run scripts/check_quick_lane.py --update)"
+        )
+    for f, have in current_counts().items():
+        want = recorded.get(f)
+        if want is not None and have < want:
+            problems.append(
+                f"tests/{f}: {have} collected tests < manifest {want} "
+                "(tests dropped from the quick lane)"
+            )
+    total_want = manifest.get("total", 0)
+    total_have = sum(current_counts().values())
+    if total_have < total_want:
+        problems.append(
+            f"quick-lane total {total_have} < manifest total {total_want}"
+        )
+    return problems
+
+
+def update() -> dict:
+    counts = current_counts()
+    manifest = {
+        "_comment": (
+            "Committed quick-lane floor (scripts/check_quick_lane.py): "
+            "static per-file test counts; counts may grow freely, a drop "
+            "fails tests/test_quick_lane.py. Regenerate with --update."
+        ),
+        "files": counts,
+        "total": sum(counts.values()),
+    }
+    with open(MANIFEST, "w") as f:
+        json.dump(manifest, f, indent=1, sort_keys=True)
+        f.write("\n")
+    return manifest
+
+
+def main(argv) -> int:
+    if "--update" in argv:
+        m = update()
+        print(
+            f"manifest updated: {len(m['files'])} files, "
+            f"{m['total']} tests -> {os.path.relpath(MANIFEST, REPO)}"
+        )
+        return 0
+    problems = check()
+    for p in problems:
+        print(f"QUICK-LANE REGRESSION: {p}", file=sys.stderr)
+    if not problems:
+        counts = current_counts()
+        print(
+            f"quick lane intact: {len(counts)} files, "
+            f"{sum(counts.values())} tests (>= manifest)"
+        )
+    return 1 if problems else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

@@ -1,0 +1,64 @@
+"""sparse_tpu.batch — the batched solve subsystem.
+
+Serving many small/medium systems that share a sparsity pattern (the
+same mesh/graph with different coefficients or right-hand sides) is the
+dominant production shape; this package amortizes PR 2's prepare/execute
+split across whole batches of them:
+
+* :mod:`~sparse_tpu.batch.operator` — pattern-shared batched operators
+  (``BatchedCSR``/``BatchedDIA``): one SELL/DIA plan from the library
+  plan cache drives SpMV/SpMM for every lane of a ``(B, nnz)`` value
+  stack (batch-grid Pallas row-block kernel where available).
+* :mod:`~sparse_tpu.batch.krylov` — masked batched CG/BiCGStab/GMRES:
+  per-lane convergence masks, converged lanes frozen, per-lane iteration
+  counts and residuals; batch-of-1 matches the unbatched solvers.
+* :mod:`~sparse_tpu.batch.bucket` — pow2 batch/shape/nnz bucketing and
+  exact-by-construction padding, bounding the compiled-program count.
+* :mod:`~sparse_tpu.batch.service` — ``SolveSession``, the microbatcher:
+  queue, coalesce same-pattern requests, dispatch bucketed batches
+  through one cached compiled program each, scatter results back.
+
+Guide: ``docs/batching.md``. This is a beyond-reference capability —
+legate.sparse solves one system per launch (``docs/PARITY.md``).
+"""
+
+from .bucket import (  # noqa: F401
+    bucket_batch,
+    pad_lanes,
+    pad_pattern,
+    pattern_bucket,
+    pow2_ceil,
+)
+from .krylov import (  # noqa: F401
+    BatchedSolveInfo,
+    batched_bicgstab,
+    batched_cg,
+    batched_gmres,
+)
+from .operator import (  # noqa: F401
+    BatchedCSR,
+    BatchedDIA,
+    BatchedOperator,
+    SparsityPattern,
+    make_batched_operator,
+)
+from .service import SolveSession, SolveTicket  # noqa: F401
+
+__all__ = [
+    "BatchedCSR",
+    "BatchedDIA",
+    "BatchedOperator",
+    "BatchedSolveInfo",
+    "SolveSession",
+    "SolveTicket",
+    "SparsityPattern",
+    "batched_bicgstab",
+    "batched_cg",
+    "batched_gmres",
+    "bucket_batch",
+    "make_batched_operator",
+    "pad_lanes",
+    "pad_pattern",
+    "pattern_bucket",
+    "pow2_ceil",
+]

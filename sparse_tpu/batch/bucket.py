@@ -1,0 +1,148 @@
+"""Pow2 bucketing + padding: bound the compiled-program count of batching.
+
+Serving traffic is ragged — batch sizes, problem sizes and nnz counts all
+vary per flush — and every distinct shape a compiled batched program sees
+is a fresh XLA compile. This module quantizes the ragged dimensions to
+power-of-two buckets so the number of compiled programs stays
+logarithmic, and pads honestly:
+
+* **Batch lanes** (:func:`bucket_batch`, :func:`pad_lanes`): pad lanes
+  replicate lane 0's values with a zero right-hand side and a huge
+  tolerance — they converge at the first test point and never extend the
+  batch's runtime. The number of batched programs per (pattern, solver)
+  is then at most ``log2(settings.batch_max)``.
+* **Pattern shape/nnz** (:func:`pad_pattern`): a pattern padded with
+  empty trailing rows/columns (to a pow2 row count) and explicit zero
+  entries (to a pow2 nnz) is *exactly* equivalent for Krylov solves —
+  the padded region contributes zeros to every inner product and matvec,
+  so the iterates restricted to the real rows are unchanged (pinned by
+  ``tests/test_batch.py``). This lets near-sized patterns share compiled
+  programs when traffic carries many one-off meshes.
+
+Every ``(pattern, solver, bucket)`` triple is one plan-cache key
+(:mod:`sparse_tpu.plan_cache`) — the always-on cache stats are the
+instrument that shows exactly one compile/pack per bucket.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..config import settings
+
+
+def pow2_ceil(v: int) -> int:
+    """Smallest power of two >= v (v <= 1 -> 1)."""
+    v = int(v)
+    if v <= 1:
+        return 1
+    return 1 << (v - 1).bit_length()
+
+
+def bucket_batch(b: int, policy: str | None = None,
+                 batch_max: int | None = None) -> int:
+    """Padded lane count for a batch of ``b`` real requests under the
+    bucket policy ('pow2' quantizes up, 'exact' keeps ``b``), clamped to
+    ``settings.batch_max``."""
+    cap = int(batch_max if batch_max is not None else settings.batch_max)
+    b = min(int(b), cap)
+    policy = policy or settings.batch_bucket
+    if policy == "exact":
+        return b
+    if policy != "pow2":
+        raise ValueError(f"unknown bucket policy {policy!r}")
+    return min(pow2_ceil(b), cap)
+
+
+def pad_lanes(values, rhs, tols, bucket: int, x0=None, big_tol=1e30):
+    """Pad stacked per-lane arrays up to ``bucket`` lanes.
+
+    ``values`` is ``(b, nnz)``, ``rhs`` ``(b, n)``, ``tols`` ``(b,)``.
+    Pad lanes replicate lane 0's values (a well-posed operator), solve
+    ``A x = 0`` from ``x0 = 0`` and carry ``big_tol`` — converged at the
+    first test point, frozen thereafter, zero effect on real lanes.
+    Returns ``(values, rhs, tols, x0, nreal)``.
+    """
+    values = np.asarray(values)
+    rhs = np.asarray(rhs)
+    tols = np.asarray(tols, dtype=np.float64)
+    b = values.shape[0]
+    if rhs.shape[0] != b or tols.shape[0] != b:
+        raise ValueError("values/rhs/tols lane counts disagree")
+    if bucket < b:
+        raise ValueError(f"bucket {bucket} smaller than batch {b}")
+    if x0 is None:
+        x0 = np.zeros_like(rhs)
+    else:
+        x0 = np.asarray(x0)
+    pad = bucket - b
+    if pad:
+        values = np.concatenate(
+            [values, np.repeat(values[:1], pad, axis=0)], axis=0
+        )
+        rhs = np.concatenate(
+            [rhs, np.zeros((pad, rhs.shape[1]), dtype=rhs.dtype)], axis=0
+        )
+        x0 = np.concatenate(
+            [x0, np.zeros((pad, x0.shape[1]), dtype=x0.dtype)], axis=0
+        )
+        tols = np.concatenate([tols, np.full(pad, big_tol)], axis=0)
+    return values, rhs, tols, x0, b
+
+
+def pattern_bucket(n: int, nnz: int) -> tuple:
+    """The pow2 (rows, nnz) bucket of a pattern — the shape key under
+    which near-sized patterns can share compiled programs."""
+    return (pow2_ceil(n), pow2_ceil(nnz))
+
+
+def pad_pattern(pattern, n_to: int | None = None, nnz_to: int | None = None):
+    """Pad a :class:`~sparse_tpu.batch.operator.SparsityPattern` to a
+    (pow2) row count and nnz with empty rows and explicit zero entries.
+
+    The extra entries live in the last padded row pointing at column 0
+    (so no new column extent is needed beyond the padded square), and the
+    extra rows are empty: for CG/BiCGStab/GMRES with zero-padded values
+    and right-hand sides the solve restricted to the real rows is exactly
+    the unpadded solve. Returns ``(padded_pattern, pad_values_fn,
+    pad_rhs_fn)`` where the two callables lift ``(B, nnz)`` value stacks
+    and ``(B, n)`` right-hand sides into the padded shapes with zeros.
+    """
+    from .operator import SparsityPattern
+
+    n, nnz = pattern.shape[0], pattern.nnz
+    n_to = int(n_to if n_to is not None else pow2_ceil(n))
+    nnz_to = int(nnz_to if nnz_to is not None else pow2_ceil(nnz))
+    if n_to < n or nnz_to < nnz:
+        raise ValueError("pad target smaller than the pattern")
+    if pattern.shape[0] != pattern.shape[1]:
+        raise ValueError("pad_pattern expects a square pattern")
+    extra_nnz = nnz_to - nnz
+    indptr = np.concatenate([
+        pattern.indptr.astype(np.int64),
+        np.full(n_to - n, nnz, dtype=np.int64),
+    ])
+    # all pad entries sit in the last (padded) row — or extend the last
+    # real row when n_to == n; either way they are zero-valued
+    indptr[-1] = nnz_to
+    indices = np.concatenate([
+        pattern.indices.astype(np.int64),
+        np.zeros(extra_nnz, dtype=np.int64),  # zero-valued, col 0
+    ])
+    padded = SparsityPattern(indptr, indices, (n_to, n_to))
+
+    def pad_values(values):
+        values = np.asarray(values)
+        if values.shape[-1] != nnz:
+            raise ValueError(f"expected nnz={nnz} values")
+        pad = np.zeros(values.shape[:-1] + (extra_nnz,), dtype=values.dtype)
+        return np.concatenate([values, pad], axis=-1)
+
+    def pad_rhs(rhs):
+        rhs = np.asarray(rhs)
+        if rhs.shape[-1] != n:
+            raise ValueError(f"expected n={n} rhs")
+        pad = np.zeros(rhs.shape[:-1] + (n_to - n,), dtype=rhs.dtype)
+        return np.concatenate([rhs, pad], axis=-1)
+
+    return padded, pad_values, pad_rhs

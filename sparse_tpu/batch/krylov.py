@@ -1,0 +1,439 @@
+"""Masked batched Krylov solvers: CG, BiCGStab, GMRES over lane stacks.
+
+One compiled loop drives B independent systems; each lane carries its own
+convergence mask, iteration count and residual. Converged lanes FREEZE —
+every carried array updates through ``jnp.where(active, new, old)`` so a
+finished lane's iterate is bit-stable while its neighbors keep working —
+and the ``lax.while_loop`` exits as soon as the mask is all-true (or the
+global step count hits ``maxiter``). Convergence is tested at the same
+points as the unbatched solvers in :mod:`sparse_tpu.linalg` (every
+``conv_test_iters`` steps and at ``maxiter - 1``, absolute ``||r|| <
+tol``), so a batch of one reproduces the unbatched solve exactly — the
+parity contract ``tests/test_batch.py`` pins.
+
+Inputs pass through :func:`sparse_tpu.utils.asjnp`, i.e. complex host
+data bound for transfer-restricted backends rides the stacked-real shim
+(two real planes recombined in a compiled program) — c64 batches work
+through the public API on such backends the same way unbatched solves do.
+
+The loop cores (``_cg_loop``/``_bicgstab_loop``) are pure jnp and
+jit-safe: :class:`~sparse_tpu.batch.service.SolveSession` closes them
+over a pattern's packed matvec inside ONE jitted program per batch
+bucket, which is where the compile-amortization of microbatching comes
+from (one trace+compile serves every same-bucket dispatch).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .. import telemetry
+from ..utils import asjnp
+from .operator import BatchedOperator, as_batched_matvec
+
+
+@dataclass
+class BatchedSolveInfo:
+    """Per-lane outcome of a batched solve.
+
+    ``iters``/``resid2``/``converged`` are ``(B,)`` arrays: iteration
+    count at freeze (== the unbatched solver's ``iters`` for that lane),
+    final squared residual norm, and whether the lane met its tolerance
+    (as opposed to hitting ``maxiter``).
+    """
+
+    iters: object
+    resid2: object
+    converged: object
+
+    @property
+    def batch(self) -> int:
+        return int(np.asarray(self.iters).shape[0])
+
+
+def _bdot(a, b):
+    """Per-lane inner product with the first argument conjugated — the
+    batched form of ``linalg._vdot`` (scipy's ``np.vdot`` choice)."""
+    return jnp.sum(jnp.conj(a) * b, axis=-1)
+
+
+def _prep(A, b, x0, tol, maxiter):
+    """Shared entry glue: resolve the matvec, promote dtypes, shape the
+    per-lane tolerance. Returns (matvec, b, X0, tol(B,), maxiter, B, n)."""
+    mv = as_batched_matvec(A)
+    b = asjnp(b)
+    if b.ndim == 1:
+        b = b[None, :]
+    if b.ndim != 2:
+        raise ValueError(f"rhs must be (B, n); got {b.shape}")
+    if isinstance(A, BatchedOperator):
+        if A.batch != b.shape[0]:
+            raise ValueError(
+                f"operator batch {A.batch} != rhs batch {b.shape[0]}"
+            )
+        b = b.astype(jnp.result_type(b.dtype, A.dtype))
+    B, n = b.shape
+    if maxiter is None:
+        maxiter = n * 10
+    X0 = jnp.zeros_like(b) if x0 is None else asjnp(x0).astype(b.dtype)
+    if X0.ndim == 1:
+        X0 = X0[None, :]
+    rdt = jnp.zeros((), b.dtype).real.dtype
+    tol = jnp.broadcast_to(jnp.asarray(tol, dtype=rdt), (B,))
+    return mv, b, X0, tol, int(maxiter), B, n
+
+
+def _solve_event(solver: str, info: BatchedSolveInfo, n: int) -> None:
+    """One ``batch.solve`` event per completed batched solve. The per-lane
+    fetch only happens with telemetry on (documented sync cost)."""
+    if not telemetry.enabled():
+        return
+    iters = np.asarray(info.iters)
+    telemetry.record(
+        "batch.solve", solver=solver, B=int(iters.shape[0]), n=int(n),
+        iters_max=int(iters.max(initial=0)),
+        iters_mean=float(iters.mean()) if iters.size else 0.0,
+        converged=int(np.asarray(info.converged).sum()),
+    )
+
+
+# ---------------------------------------------------------------------------
+# CG
+# ---------------------------------------------------------------------------
+def _cg_loop(matvec, b, X0, tol, maxiter, conv_test_iters, Mvec=None):
+    """Masked batched CG core (pure jnp, jit-safe).
+
+    Same recurrences and test points as ``linalg._cg_device_loop``; every
+    carry masks on the per-lane ``active`` flag. Returns
+    ``(X, iters, resid2, converged)``.
+    """
+    tol2 = tol.astype(jnp.real(b).dtype) ** 2
+    B = b.shape[0]
+    cti = max(int(conv_test_iters), 1)
+    X = X0
+    R = b - matvec(X)
+    P = jnp.zeros_like(b)
+    rho = jnp.zeros((B,), dtype=b.dtype)
+    active0 = jnp.ones((B,), dtype=bool)
+    iters0 = jnp.zeros((B,), dtype=jnp.int32)
+
+    def body(st):
+        X, R, P, rho, active, iters, k = st
+        Z = R if Mvec is None else Mvec(R)
+        rho_new = _bdot(R, Z)
+        beta = rho_new / jnp.where(rho == 0, 1, rho)
+        Pn = jnp.where(k == 0, Z, Z + beta[:, None] * P)
+        Q = matvec(Pn)
+        pq = _bdot(Pn, Q)
+        alpha = rho_new / jnp.where(pq == 0, 1, pq)  # 0/0 guard: b=0/exact x0
+        am = active[:, None]
+        X = jnp.where(am, X + alpha[:, None] * Pn, X)
+        R = jnp.where(am, R - alpha[:, None] * Q, R)
+        P = jnp.where(am, Pn, P)
+        rho = jnp.where(active, rho_new, rho)
+        iters = iters + active.astype(jnp.int32)
+        k = k + 1
+        rn2 = jnp.real(_bdot(R, R))
+        tested = (k % cti == 0) | (k == maxiter - 1)
+        active = active & ~(tested & (rn2 < tol2))
+        return X, R, P, rho, active, iters, k
+
+    def cond(st):
+        active, k = st[4], st[6]
+        return (k < maxiter) & jnp.any(active)
+
+    st = (X, R, P, rho, active0, iters0, jnp.zeros((), jnp.int32))
+    X, R, _P, _rho, active, iters, _k = jax.lax.while_loop(cond, body, st)
+    return X, iters, jnp.real(_bdot(R, R)), ~active
+
+
+def batched_cg(A, b, x0=None, tol=1e-08, maxiter=None, M=None,
+               conv_test_iters=25):
+    """Batched conjugate gradient over a lane stack.
+
+    ``A`` is a :class:`~sparse_tpu.batch.operator.BatchedOperator`, a
+    ``(B, n) -> (B, n)`` callable, or anything
+    :func:`~sparse_tpu.batch.operator.make_batched_operator` accepts;
+    ``b`` is ``(B, n)`` (``tol`` broadcasts per-lane). Returns
+    ``(X, BatchedSolveInfo)``. Batch-of-1 matches :func:`sparse_tpu.
+    linalg.cg` (same recurrences and conv-test points).
+    """
+    mv, b, X0, tol, maxiter, _B, n = _prep(A, b, x0, tol, maxiter)
+    Mvec = None if M is None else as_batched_matvec(M)
+    X, iters, resid2, conv = _cg_loop(
+        mv, b, X0, tol, maxiter, conv_test_iters, Mvec
+    )
+    info = BatchedSolveInfo(iters, resid2, conv)
+    _solve_event("cg", info, n)
+    return X, info
+
+
+# ---------------------------------------------------------------------------
+# BiCGStab
+# ---------------------------------------------------------------------------
+def _bicgstab_loop(matvec, b, X0, tol, maxiter, conv_test_iters):
+    """Masked batched BiCGStab core — the recurrences of
+    ``linalg.bicgstab`` with per-lane scalars and frozen converged lanes."""
+    tol2 = tol.astype(jnp.real(b).dtype) ** 2
+    B = b.shape[0]
+    cti = max(int(conv_test_iters), 1)
+    X = X0
+    R = b - matvec(X)
+    Rt = R
+    Z = jnp.zeros_like(b)
+    one = jnp.ones((B,), dtype=b.dtype)
+    zero = jnp.zeros((B,), dtype=b.dtype)
+
+    def body(st):
+        X, R, P, V, rho, alpha, omega, active, iters, k = st
+        rho_new = _bdot(Rt, R)
+        beta = (rho_new / jnp.where(rho == 0, 1, rho)) * (
+            alpha / jnp.where(omega == 0, 1, omega)
+        )
+        Pn = jnp.where(
+            k == 0, R, R + beta[:, None] * (P - omega[:, None] * V)
+        )
+        Vn = matvec(Pn)
+        rv = _bdot(Rt, Vn)
+        alpha_n = rho_new / jnp.where(rv == 0, 1, rv)
+        S = R - alpha_n[:, None] * Vn
+        T = matvec(S)
+        tt = _bdot(T, T)
+        omega_n = _bdot(T, S) / jnp.where(tt == 0, 1, tt)
+        am = active[:, None]
+        X = jnp.where(
+            am, X + alpha_n[:, None] * Pn + omega_n[:, None] * S, X
+        )
+        R = jnp.where(am, S - omega_n[:, None] * T, R)
+        P = jnp.where(am, Pn, P)
+        V = jnp.where(am, Vn, V)
+        rho = jnp.where(active, rho_new, rho)
+        alpha = jnp.where(active, alpha_n, alpha)
+        omega = jnp.where(active, omega_n, omega)
+        iters = iters + active.astype(jnp.int32)
+        k = k + 1
+        rn2 = jnp.real(_bdot(R, R))
+        tested = (k % cti == 0) | (k == maxiter - 1)
+        active = active & ~(tested & (rn2 < tol2))
+        return X, R, P, V, rho, alpha, omega, active, iters, k
+
+    def cond(st):
+        active, k = st[7], st[9]
+        return (k < maxiter) & jnp.any(active)
+
+    st = (X, R, Z, Z, zero, one, one,
+          jnp.ones((B,), bool), jnp.zeros((B,), jnp.int32),
+          jnp.zeros((), jnp.int32))
+    out = jax.lax.while_loop(cond, body, st)
+    X, R, active, iters = out[0], out[1], out[7], out[8]
+    return X, iters, jnp.real(_bdot(R, R)), ~active
+
+
+def batched_bicgstab(A, b, x0=None, tol=1e-08, maxiter=None,
+                     conv_test_iters=25):
+    """Batched BiCGStab; see :func:`batched_cg` for the lane contract."""
+    mv, b, X0, tol, maxiter, _B, n = _prep(A, b, x0, tol, maxiter)
+    X, iters, resid2, conv = _bicgstab_loop(
+        mv, b, X0, tol, maxiter, conv_test_iters
+    )
+    info = BatchedSolveInfo(iters, resid2, conv)
+    _solve_event("bicgstab", info, n)
+    return X, info
+
+
+# ---------------------------------------------------------------------------
+# GMRES — batched restart cycles, host-driven outer loop
+# ---------------------------------------------------------------------------
+def _make_batched_gmres_cycle(mv, Mv, restart: int, dt):
+    """The device-resident restart cycle of ``linalg._make_gmres_cycle``
+    with a leading batch dimension: per-lane Hessenberg/Givens scalars
+    become ``(B,)`` vectors, the Krylov basis is ``(B, restart+1, n)``,
+    and lanes that converge or break down mid-cycle freeze (their
+    carries mask on ``~done``) while the shared step counter finishes the
+    others. ONE host sync per cycle: the packed per-lane ``(inner, entry
+    residual, breakdown)`` triple."""
+    rdt = jnp.zeros((), dt).real.dtype
+
+    @jax.jit
+    def cycle(X, b, target):
+        B, n = b.shape
+        R = Mv(b - mv(X))
+        beta = jnp.linalg.norm(R, axis=-1)
+        start_ok = beta > target
+        beta_safe = jnp.where(start_ok, beta, 1.0)
+        V = jnp.zeros((B, restart + 1, n), dtype=dt)
+        V = V.at[:, 0].set(R / beta_safe[:, None].astype(dt))
+        H = jnp.zeros((B, restart + 1, restart), dtype=dt)
+        cs = jnp.zeros((B, restart), dtype=rdt)
+        sn = jnp.zeros((B, restart), dtype=dt)
+        g = jnp.zeros((B, restart + 1), dtype=dt)
+        g = g.at[:, 0].set(beta.astype(dt))
+
+        def cond(st):
+            done, j = st[7], st[8]
+            return (j < restart) & jnp.any(~done)
+
+        def body(st):
+            V, H, cs, sn, g, kk, bd, done, j = st
+            w = Mv(mv(V[:, j]))
+            # masked modified Gram-Schmidt + one reorthogonalization pass,
+            # batched as full-basis einsums (MXU-shaped, like unbatched)
+            mask = (jnp.arange(restart + 1) <= j).astype(rdt)
+            hcol = jnp.einsum("bin,bn->bi", V.conj(), w) * mask
+            w = w - jnp.einsum("bi,bin->bn", hcol, V)
+            h2 = jnp.einsum("bin,bn->bi", V.conj(), w) * mask
+            w = w - jnp.einsum("bi,bin->bn", h2, V)
+            hcol = hcol + h2
+            hkk = jnp.linalg.norm(w, axis=-1)
+            grew = hkk > 1e-30
+            upd = ~done
+            vnew = jnp.where(
+                grew[:, None],
+                w / jnp.where(grew, hkk, 1.0)[:, None].astype(dt),
+                0.0,
+            )
+            V = V.at[:, j + 1].set(
+                jnp.where(upd[:, None], vnew, V[:, j + 1])
+            )
+            col = hcol.at[:, j + 1].set(hkk.astype(dt))
+
+            def giv(i, c):
+                t = cs[:, i] * c[:, i] + sn[:, i] * c[:, i + 1]
+                bt = (
+                    -jnp.conj(sn[:, i]) * c[:, i] + cs[:, i] * c[:, i + 1]
+                )
+                app = i < j
+                c = c.at[:, i].set(jnp.where(app, t, c[:, i]))
+                return c.at[:, i + 1].set(jnp.where(app, bt, c[:, i + 1]))
+
+            col = jax.lax.fori_loop(0, restart, giv, col)
+            hk, hk1 = col[:, j], col[:, j + 1]
+            ahk = jnp.abs(hk)
+            ahk1 = jnp.abs(hk1)
+            denom = jnp.sqrt(ahk * ahk + ahk1 * ahk1)
+            breakdown = denom <= 0
+            denom_s = jnp.where(breakdown, 1.0, denom)
+            ck = jnp.where(ahk == 0, 0.0, ahk / denom_s)
+            hk_unit = jnp.where(
+                ahk == 0, 1.0, hk / jnp.where(ahk == 0, 1.0, ahk).astype(dt)
+            )
+            sk = jnp.where(
+                ahk == 0,
+                jnp.conj(hk1) / jnp.where(ahk1 == 0, 1.0, ahk1).astype(dt),
+                hk_unit * jnp.conj(hk1) / denom_s.astype(dt),
+            )
+            col = col.at[:, j].set(ck.astype(dt) * hk + sk * hk1)
+            col = col.at[:, j + 1].set(0.0)
+            H = H.at[:, :, j].set(
+                jnp.where(upd[:, None], col, H[:, :, j])
+            )
+            cs = cs.at[:, j].set(jnp.where(upd, ck, cs[:, j]))
+            sn = sn.at[:, j].set(jnp.where(upd, sk, sn[:, j]))
+            gk1 = -jnp.conj(sk) * g[:, j]
+            ok = upd & ~breakdown
+            g = g.at[:, j + 1].set(jnp.where(ok, gk1, g[:, j + 1]))
+            g = g.at[:, j].set(
+                jnp.where(ok, ck.astype(dt) * g[:, j], g[:, j])
+            )
+            conv = jnp.abs(gk1) < target
+            kk = kk + ok.astype(jnp.int32)
+            bd = bd | (upd & breakdown)
+            done = done | (upd & (breakdown | conv))
+            return V, H, cs, sn, g, kk, bd, done, j + 1
+
+        st = (
+            V, H, cs, sn, g,
+            jnp.zeros((B,), jnp.int32), jnp.zeros((B,), bool),
+            ~start_ok, jnp.int32(0),
+        )
+        V, H, cs, sn, g, kk, bd, _done, _j = jax.lax.while_loop(
+            cond, body, st
+        )
+        # per-lane masked triangular solve: columns past each lane's kk
+        # get a unit diagonal and a zero rhs
+        idx = jnp.arange(restart)
+        mk = (idx[None, :] < kk[:, None]).astype(rdt)
+        Hs = H[:, :restart, :restart] * (mk[:, :, None] * mk[:, None, :])
+        Hs = Hs + jnp.einsum(
+            "bi,ij->bij", (1.0 - mk), jnp.eye(restart, dtype=rdt)
+        ).astype(dt)
+        gv = g[:, :restart] * mk
+        y = jax.vmap(
+            lambda h, rhs: jax.scipy.linalg.solve_triangular(
+                h, rhs, lower=False
+            )
+        )(Hs, gv)
+        X = X + jnp.einsum("bi,bin->bn", y, V[:, :restart])
+        info = jnp.stack(
+            [kk.astype(rdt), beta.astype(rdt), bd.astype(rdt)], axis=-1
+        )
+        return X, info
+
+    return cycle
+
+
+def batched_gmres(A, b, x0=None, tol=1e-08, restart=None, maxiter=None,
+                  M=None, atol=None):
+    """Batched restarted GMRES: compiled batched Arnoldi cycles, one host
+    sync per restart, per-lane masks at both granularities (mid-cycle
+    freezing on device, converged lanes skipped across restarts on host).
+
+    Same stopping rule as :func:`sparse_tpu.linalg.gmres`: relative
+    ``tol * ||b||`` floored by ``atol``, per lane. Returns
+    ``(X, BatchedSolveInfo)``; ``info.iters`` counts inner iterations
+    (breakdown stages included) exactly like the unbatched driver.
+    """
+    mv = as_batched_matvec(A)
+    b = asjnp(b)
+    if b.ndim == 1:
+        b = b[None, :]
+    dt = b.dtype
+    if isinstance(A, BatchedOperator):
+        dt = jnp.result_type(dt, A.dtype)
+    if x0 is not None:
+        x0 = asjnp(x0)
+        if x0.ndim == 1:
+            x0 = x0[None, :]
+        dt = jnp.result_type(dt, x0.dtype)
+    b = b.astype(dt)
+    B, n = b.shape
+    if restart is None:
+        restart = min(20, n)
+    restart = min(int(restart), n)
+    if maxiter is None:
+        maxiter = max(n // restart, 1) * 10
+    X = jnp.zeros_like(b) if x0 is None else x0.astype(dt)
+    rdt = jnp.zeros((), dt).real.dtype
+    bnorm = jnp.linalg.norm(b, axis=-1)
+    tol_l = jnp.broadcast_to(jnp.asarray(tol, rdt), (B,))
+    target = jnp.maximum(tol_l * bnorm, atol if atol is not None else 0.0)
+    target = jnp.maximum(target, 1e-30)
+
+    Mv = (lambda r: r) if M is None else as_batched_matvec(M)
+    cycle = _make_batched_gmres_cycle(mv, Mv, restart, jnp.dtype(dt))
+    iters = np.zeros((B,), dtype=np.int64)
+    lane_done = np.zeros((B,), dtype=bool)
+    beta_last = np.zeros((B,), dtype=np.float64)
+    for _outer in range(int(maxiter)):
+        X, info = cycle(X, b, target)
+        info_h = np.asarray(info)  # ONE host sync per restart cycle
+        inner = info_h[:, 0].astype(np.int64)
+        beta_last = np.where(lane_done, beta_last, info_h[:, 1])
+        bdown = info_h[:, 2] > 0
+        newly_done = (inner == 0) & ~bdown
+        # breakdown stages did a matvec but contribute no column; count
+        # them like the unbatched driver so iters reflects work
+        iters += np.where(lane_done, 0, inner + bdown.astype(np.int64))
+        lane_done |= newly_done
+        if lane_done.all():
+            break
+    resid2 = jnp.asarray(beta_last.astype(np.dtype(rdt)) ** 2)
+    info = BatchedSolveInfo(
+        jnp.asarray(iters.astype(np.int32)), resid2, jnp.asarray(lane_done)
+    )
+    _solve_event("gmres", info, n)
+    return X, info

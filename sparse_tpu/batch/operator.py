@@ -1,0 +1,523 @@
+"""Pattern-shared batched operators: one sparsity pattern, stacked values.
+
+The dominant serving shape for a production solver is not one giant
+system but MANY small/medium systems sharing a sparsity pattern — the
+same mesh/graph with different coefficients or right-hand sides (the
+batched-Krylov regime Ginkgo's batched solvers target on GPUs). The
+reference stack (legate.sparse) solves one system per launch; here the
+prepare/execute split of PR 2 amortizes further: the host-side pack
+(SELL slab geometry, DIA offset maps) is keyed on the *pattern* in
+``sparse_tpu.plan_cache`` and every lane of a ``(B, nnz)`` value stack
+repacks on device as a single gather through the pattern's source maps.
+
+Classes
+-------
+* :class:`SparsityPattern` — host-held shared CSR structure; THE
+  plan-cache key for everything batched.
+* :class:`BatchedCSR` — stacked values over one pattern, batched
+  SpMV/SpMM via the SELL slab formulation (vmap-compatible XLA path;
+  the Pallas row-block kernel gains a batch grid dimension under
+  ``spmv_mode='pallas'``, with the usual one-time XLA failover).
+* :class:`BatchedDIA` — stacked diagonal planes for banded patterns,
+  batched zero-gather SpMV (vmapped ``ops.dia_spmv``).
+* :func:`make_batched_operator` — coercion entry point (stacks of
+  csr_arrays / scipy matrices, dense ``[B, m, n]`` stacks, callables).
+
+Interop: every batched operator exposes ``as_block_operator()`` — the
+``(B*m, B*n)`` block-diagonal :class:`~sparse_tpu.linalg.LinearOperator`
+view — and ``linalg.make_linear_operator`` accepts batched operators
+through it, so the unbatched solver surface keeps working on a batch.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .. import plan_cache, telemetry
+from ..config import settings
+from ..ops import spmv as spmv_ops
+from ..utils import asjnp, commit_to_exec_device, host_scope, in_trace, user_warning
+
+
+class SparsityPattern:
+    """Immutable host-held CSR sparsity pattern shared by a batch.
+
+    Holds plain numpy ``indptr``/``indices`` (construction-time state, the
+    same discipline as ``kernels.sell_spmv.sell_pack``) plus a content
+    fingerprint used by :class:`~sparse_tpu.batch.service.SolveSession` to
+    coalesce requests; identity (this object) is the plan-cache key, so
+    one pattern object should be reused for all same-pattern work.
+    """
+
+    __slots__ = ("indptr", "indices", "shape", "nnz", "_fp", "__weakref__")
+
+    def __init__(self, indptr, indices, shape):
+        self.indptr = np.ascontiguousarray(np.asarray(indptr))
+        self.indices = np.ascontiguousarray(np.asarray(indices))
+        self.shape = (int(shape[0]), int(shape[1]))
+        self.nnz = int(self.indices.shape[0])
+        if self.indptr.shape[0] != self.shape[0] + 1:
+            raise ValueError(
+                f"indptr length {self.indptr.shape[0]} != rows+1 "
+                f"({self.shape[0] + 1})"
+            )
+        self._fp = None
+
+    @classmethod
+    def from_csr(cls, A) -> "SparsityPattern":
+        """From anything CSR-shaped (``csr_array``, scipy csr, or a
+        ``(indptr, indices, shape)`` triple already split out)."""
+        if isinstance(A, SparsityPattern):
+            return A
+        if hasattr(A, "tocsr") and not hasattr(A, "indptr"):
+            A = A.tocsr()
+        return cls(np.asarray(A.indptr), np.asarray(A.indices), A.shape)
+
+    @property
+    def fingerprint(self) -> tuple:
+        """Content hash for request coalescing (NOT the cache key — the
+        plan cache keys on this object's identity)."""
+        if self._fp is None:
+            import hashlib
+
+            h = hashlib.sha1()
+            h.update(np.int64(self.shape[0]).tobytes())
+            h.update(np.int64(self.shape[1]).tobytes())
+            h.update(self.indptr.astype(np.int64).tobytes())
+            h.update(self.indices.astype(np.int64).tobytes())
+            self._fp = (self.shape, self.nnz, h.hexdigest())
+        return self._fp
+
+    def matches(self, other: "SparsityPattern") -> bool:
+        return self is other or self.fingerprint == other.fingerprint
+
+    # -- SELL pattern pack (plan-cached) -----------------------------------
+    def sell_pack(self):
+        """The pattern's one-time SELL-C-sigma pack, via the library plan
+        cache: ``(plan, idx_slabs, pos, srcs)`` where ``srcs`` are the
+        per-slab packed-slot -> nnz-position maps every lane's values
+        gather through. One host-side pack per pattern, ever."""
+        return plan_cache.get(self, "sell.pattern", self._build_sell)
+
+    def _build_sell(self):
+        from ..kernels.sell_spmv import sell_pack
+
+        with host_scope():  # one-time pack, never via a tunnel
+            plan, slabs, pos, srcs = sell_pack(
+                self.indptr, self.indices,
+                np.zeros(self.nnz, dtype=np.float32),  # pattern-only pack
+                self.shape, with_srcs=True,
+            )
+        idx_slabs = tuple(
+            commit_to_exec_device((it,))[0] for it, _vt in slabs
+        )
+        srcs = tuple(commit_to_exec_device(srcs)) if srcs else ()
+        (pos,) = commit_to_exec_device((pos,))
+        telemetry.count("batch.pattern_pack")
+        return _SellPatternPack(plan, idx_slabs, pos, srcs)
+
+    # -- DIA pattern pack (plan-cached) ------------------------------------
+    def dia_pack(self, max_diags: int | None = None):
+        """Offsets + ``[D, n]`` nnz source map for banded patterns, via the
+        plan cache; raises ``ValueError`` when the pattern exceeds
+        ``max_diags`` (default ``settings.dia_max_diags``) diagonals."""
+        pack = plan_cache.get(self, "dia.pattern",
+                              lambda: self._build_dia(max_diags))
+        return pack
+
+    def _build_dia(self, max_diags):
+        limit = int(max_diags or settings.dia_max_diags)
+        counts = self.indptr[1:] - self.indptr[:-1]
+        rows = np.repeat(np.arange(self.shape[0], dtype=np.int64), counts)
+        offs_all = self.indices.astype(np.int64) - rows
+        offsets = np.unique(offs_all)
+        if len(offsets) > limit:
+            raise ValueError(
+                f"pattern has {len(offsets)} distinct diagonals "
+                f"(> {limit}); not DIA-shaped"
+            )
+        D, n = len(offsets), self.shape[1]
+        k_of = np.searchsorted(offsets, offs_all)
+        src = np.full((D, n), -1, dtype=np.int64)
+        # scipy DIA convention: data[k, j] holds A[j - o_k, j]
+        src[k_of, self.indices.astype(np.int64)] = np.arange(self.nnz)
+        src_dt = np.int32 if self.nnz < 2**31 else np.int64
+        (src_dev,) = commit_to_exec_device((jnp.asarray(src.astype(src_dt)),))
+        valid = jnp.asarray(src >= 0)
+        return (tuple(int(o) for o in offsets), src_dev, valid)
+
+    def __repr__(self):
+        return (
+            f"SparsityPattern(shape={self.shape}, nnz={self.nnz})"
+        )
+
+
+class _SellPatternPack:
+    """Device-resident pattern half of the batched SELL layout."""
+
+    __slots__ = ("plan", "idx_slabs", "pos", "srcs")
+
+    def __init__(self, plan, idx_slabs, pos, srcs):
+        self.plan, self.idx_slabs, self.pos, self.srcs = (
+            plan, idx_slabs, pos, srcs
+        )
+
+    def pack_values(self, values):
+        """Gather a ``(B, nnz)`` value stack into per-slab ``[B, K, R]``
+        planes (pad slots zero) — jit-safe, one gather per slab."""
+        values = jnp.asarray(values)
+        out = []
+        for src in self.srcs:
+            valid = src >= 0
+            out.append(
+                jnp.where(valid[None, :, :],
+                          values[:, jnp.maximum(src, 0)],
+                          jnp.zeros((), dtype=values.dtype))
+            )
+        return tuple(out)
+
+
+class BatchedOperator:
+    """Abstract batched linear operator: ``matvec`` maps ``(B, n)`` ->
+    ``(B, m)``, one independent system per lane."""
+
+    shape: tuple  # (B, m, n)
+    dtype: np.dtype
+
+    @property
+    def batch(self) -> int:
+        return self.shape[0]
+
+    def matvec(self, X):
+        raise NotImplementedError
+
+    def matmat(self, X):
+        """Default batched SpMM: column loop over ``(B, n, k)``."""
+        cols = [self.matvec(X[:, :, j]) for j in range(X.shape[2])]
+        return jnp.stack(cols, axis=2)
+
+    def __matmul__(self, X):
+        X = asjnp(X)
+        if X.ndim == 2:
+            return self.matvec(X)
+        if X.ndim == 3:
+            return self.matmat(X)
+        raise ValueError("batched operators apply to (B, n) or (B, n, k)")
+
+    def lane(self, i: int):
+        raise NotImplementedError
+
+    def as_block_operator(self):
+        """The ``(B*m, B*n)`` block-diagonal LinearOperator view — the
+        ``make_linear_operator`` interop: any unbatched solver can consume
+        a batch as one big decoupled system."""
+        from ..linalg import LinearOperator
+
+        B, m, n = self.shape
+
+        def mv(x):
+            return self.matvec(jnp.reshape(x, (B, n))).reshape(-1)
+
+        def mm(X):
+            k = X.shape[1]
+            Y = self.matmat(jnp.reshape(X.T, (k, B, n)).transpose(1, 2, 0))
+            return Y.reshape(B * m, k)
+
+        return LinearOperator((B * m, B * n), matvec=mv, matmat=mm,
+                              dtype=self.dtype)
+
+
+class BatchedCSR(BatchedOperator):
+    """Stacked CSR values ``(B, nnz)`` over one shared pattern.
+
+    Execution reuses a single SELL pattern plan (from the plan cache,
+    keyed on the pattern) across the whole batch: values repack on device
+    through the pattern's source maps, SpMV/SpMM run the vmap-batched
+    slab gathers (``ops.spmv.csr_spmv_sell_batched``). Under
+    ``spmv_mode='pallas'`` the batch-grid Pallas row-block kernel is
+    attempted first, failing over to the XLA formulation once —
+    remembered per operator, same discipline as
+    :class:`~sparse_tpu.kernels.sell_spmv.PreparedCSR`. Under
+    ``spmv_mode='segment'`` (and for in-trace first use with a cold plan
+    cache) the vmapped segment path runs instead — identical results,
+    no host-side pack.
+    """
+
+    def __init__(self, pattern, values, dtype=None):
+        self.pattern = SparsityPattern.from_csr(pattern)
+        values = asjnp(values, dtype=dtype)
+        if values.ndim == 1:
+            values = values[None, :]
+        if values.ndim != 2 or values.shape[1] != self.pattern.nnz:
+            raise ValueError(
+                f"values must be (B, nnz={self.pattern.nnz}); "
+                f"got {values.shape}"
+            )
+        self.values = values
+        m, n = self.pattern.shape
+        self.shape = (int(values.shape[0]), m, n)
+        self.dtype = np.dtype(values.dtype)
+        self._vals_packed = None  # per-slab [B, K, R] planes, lazy
+        self._pallas_ok = None  # None = untried, False = failed over
+
+    @classmethod
+    def from_stack(cls, mats, pattern=None):
+        """From a sequence of same-pattern matrices (``csr_array`` /
+        scipy CSR). Verifies the shared pattern (cheap fingerprint check
+        against the first lane) and stacks the values."""
+        mats = list(mats)
+        if not mats:
+            raise ValueError("empty batch")
+        first = SparsityPattern.from_csr(mats[0])
+        if pattern is None:
+            pattern = first
+        elif not pattern.matches(first):
+            raise ValueError("lane 0 does not match the given pattern")
+        vals = []
+        for i, A in enumerate(mats):
+            if i and not pattern.matches(SparsityPattern.from_csr(A)):
+                raise ValueError(f"lane {i} has a different sparsity pattern")
+            d = A.data if hasattr(A, "data") else A
+            vals.append(np.asarray(d))
+        return cls(pattern, asjnp(np.stack(vals)))
+
+    def lane(self, i: int):
+        """Lane ``i`` as a plain ``csr_array`` sharing the pattern buffers."""
+        from ..csr import csr_array
+
+        return csr_array.from_parts(
+            self.values[i], asjnp(self.pattern.indices),
+            asjnp(self.pattern.indptr), self.pattern.shape,
+        )
+
+    def with_values(self, values):
+        """Same pattern, new value stack (plan reuse is automatic — the
+        pattern object is the cache key)."""
+        return BatchedCSR(self.pattern, values)
+
+    # -- execution ---------------------------------------------------------
+    def _packed(self):
+        """(pattern pack, per-slab value planes); packs values once."""
+        pack = self.pattern.sell_pack()
+        if self._vals_packed is None:
+            vals = self.values
+            if not in_trace():
+                (vals,) = commit_to_exec_device((vals,))
+                self.values = vals
+            packed = pack.pack_values(vals)
+            if in_trace():
+                return pack, packed  # tracers: never cached on self
+            self._vals_packed = packed
+        return pack, self._vals_packed
+
+    def _pallas_viable(self, pack, X) -> bool:
+        from ..kernels.sell_spmv import PALLAS_MAX_K, PALLAS_MAX_X
+
+        if self._pallas_ok is False or not pack.idx_slabs:
+            return False
+        if X.shape[1] > PALLAS_MAX_X:
+            return False
+        if any(K > PALLAS_MAX_K for K, _, _ in pack.plan.slab_meta):
+            return False
+        return jnp.result_type(self.dtype, X.dtype) == jnp.float32
+
+    def matvec(self, X):
+        X = asjnp(X)
+        if X.ndim != 2 or X.shape != (self.batch, self.shape[2]):
+            raise ValueError(
+                f"matvec expects X of shape ({self.batch}, "
+                f"{self.shape[2]}); got {X.shape}"
+            )
+        telemetry.count("batch.spmv")
+        mode = settings.spmv_mode
+        if mode == "segment" or self.pattern.nnz == 0:
+            return self._matvec_segment(X)
+        if in_trace() and plan_cache.lookup(self.pattern, "sell.pattern") is None:
+            # in-trace first use with a cold cache: packing needs host
+            # work — degrade to the jit-safe segment path, same
+            # discipline as csr_array._maybe_sell
+            return self._matvec_segment(X)
+        pack, vals = self._packed()
+        if mode == "pallas" and self._pallas_viable(pack, X):
+            try:
+                from ..kernels.sell_spmv import sell_spmv_pallas_batched
+
+                Y = sell_spmv_pallas_batched(
+                    pack.plan, pack.idx_slabs, vals, pack.pos, X
+                )
+                self._pallas_ok = True
+                return Y
+            except (ValueError, NotImplementedError) as e:
+                import os
+
+                if os.environ.get("SPARSE_TPU_STRICT_PALLAS") and not (
+                    isinstance(e, NotImplementedError)
+                ):
+                    raise
+                user_warning(
+                    "batched Pallas SELL SpMV unavailable; failing over "
+                    f"to the XLA formulation permanently: {e!r}"
+                )
+                telemetry.record(
+                    "kernel.failover", kernel="sell_spmv_batched",
+                    error=repr(e)[:200], backend=jax.default_backend(),
+                )
+                self._pallas_ok = False
+        return spmv_ops.csr_spmv_sell_batched(
+            pack.idx_slabs, vals, pack.pos, X, pack.plan.zero_rows
+        )
+
+    def _matvec_segment(self, X):
+        return spmv_ops.csr_spmv_segment_batched(
+            asjnp(self.pattern.indptr), asjnp(self.pattern.indices),
+            self.values, X, self.pattern.shape[0],
+        )
+
+    def matmat(self, X):
+        X = asjnp(X)
+        if X.ndim != 3 or X.shape[:2] != (self.batch, self.shape[2]):
+            raise ValueError(
+                f"matmat expects X of shape ({self.batch}, "
+                f"{self.shape[2]}, k); got {X.shape}"
+            )
+        if settings.spmv_mode == "segment" or self.pattern.nnz == 0 or (
+            in_trace()
+            and plan_cache.lookup(self.pattern, "sell.pattern") is None
+        ):
+            return jax.vmap(
+                lambda d, x: spmv_ops.csr_spmm_segment(
+                    asjnp(self.pattern.indptr), asjnp(self.pattern.indices),
+                    d, x, self.pattern.shape[0],
+                )
+            )(self.values, X)
+        pack, vals = self._packed()
+        return spmv_ops.csr_spmm_sell_batched(
+            pack.idx_slabs, vals, pack.pos, X, pack.plan.zero_rows
+        )
+
+    def todia(self, max_diags=None) -> "BatchedDIA":
+        """Banded view: repack the value stack through the pattern's DIA
+        source map (plan-cached) — zero-gather batched SpMV."""
+        return BatchedDIA.from_batched_csr(self, max_diags=max_diags)
+
+    def __repr__(self):
+        return (
+            f"<BatchedCSR B={self.batch} shape={self.pattern.shape} "
+            f"nnz={self.pattern.nnz} dtype={self.dtype}>"
+        )
+
+
+class BatchedDIA(BatchedOperator):
+    """Stacked diagonal planes ``(B, D, n)`` over shared offsets — the
+    batched zero-gather SpMV for banded patterns (every PDE/mesh serving
+    shape): one vmapped ``ops.dia_spmv.dia_spmv_xla`` pass, no index
+    loads at all."""
+
+    def __init__(self, data, offsets, shape):
+        data = asjnp(data)
+        if data.ndim != 3:
+            raise ValueError("BatchedDIA data must be (B, D, n)")
+        self.data = data
+        self.offsets = tuple(int(o) for o in offsets)
+        m, n = int(shape[0]), int(shape[1])
+        if data.shape[1] != len(self.offsets) or data.shape[2] != n:
+            raise ValueError(
+                f"data {data.shape} inconsistent with offsets "
+                f"D={len(self.offsets)} and shape {shape}"
+            )
+        self.shape = (int(data.shape[0]), m, n)
+        self.dtype = np.dtype(data.dtype)
+
+    @classmethod
+    def from_batched_csr(cls, bcsr: BatchedCSR, max_diags=None):
+        offsets, src, valid = bcsr.pattern.dia_pack(max_diags=max_diags)
+        planes = jnp.where(
+            valid[None, :, :],
+            bcsr.values[:, jnp.maximum(src, 0)],
+            jnp.zeros((), dtype=bcsr.values.dtype),
+        )
+        return cls(planes, offsets, bcsr.pattern.shape)
+
+    def lane(self, i: int):
+        from ..dia import dia_array
+
+        return dia_array(
+            (self.data[i], np.asarray(self.offsets)),
+            shape=(self.shape[1], self.shape[2]),
+        )
+
+    def matvec(self, X):
+        from ..ops.dia_spmv import dia_spmv_xla
+
+        X = asjnp(X)
+        if X.ndim != 2 or X.shape != (self.batch, self.shape[2]):
+            raise ValueError(
+                f"matvec expects X of shape ({self.batch}, "
+                f"{self.shape[2]}); got {X.shape}"
+            )
+        telemetry.count("batch.spmv")
+        offsets, shape = self.offsets, (self.shape[1], self.shape[2])
+        return jax.vmap(
+            lambda d, x: dia_spmv_xla(d, offsets, x, shape)
+        )(self.data, X)
+
+    def __repr__(self):
+        return (
+            f"<BatchedDIA B={self.batch} shape={self.shape[1:]} "
+            f"D={len(self.offsets)} dtype={self.dtype}>"
+        )
+
+
+def make_batched_operator(A) -> BatchedOperator:
+    """Coerce ``A`` to a :class:`BatchedOperator`.
+
+    Accepts batched operators (returned as-is), sequences of same-pattern
+    CSR matrices, a dense ``[B, m, n]`` stack, or a ``(pattern, values)``
+    pair."""
+    if isinstance(A, BatchedOperator):
+        return A
+    if (
+        isinstance(A, tuple) and len(A) == 2
+        and isinstance(A[0], SparsityPattern)
+    ):
+        return BatchedCSR(A[0], A[1])
+    if isinstance(A, (list, tuple)) and A and (
+        hasattr(A[0], "indptr") or hasattr(A[0], "tocsr")
+    ):
+        return BatchedCSR.from_stack(A)
+    X = asjnp(A)
+    if X.ndim == 3:
+        return _BatchedDense(X)
+    raise TypeError(
+        f"cannot interpret {type(A).__name__} as a batched operator"
+    )
+
+
+class _BatchedDense(BatchedOperator):
+    """Dense ``[B, m, n]`` stack — the oracle/test operator."""
+
+    def __init__(self, stack):
+        self.stack = asjnp(stack)
+        self.shape = tuple(int(s) for s in self.stack.shape)
+        self.dtype = np.dtype(self.stack.dtype)
+
+    def lane(self, i: int):
+        return self.stack[i]
+
+    def matvec(self, X):
+        return jnp.einsum("bmn,bn->bm", self.stack, asjnp(X))
+
+    def matmat(self, X):
+        return jnp.einsum("bmn,bnk->bmk", self.stack, asjnp(X))
+
+
+def as_batched_matvec(A):
+    """Resolve ``A`` to a ``(B, n) -> (B, m)`` callable (batched
+    operators, callables, dense stacks) — the krylov entry-point glue."""
+    if isinstance(A, BatchedOperator):
+        return A.matvec
+    if callable(A):
+        return A
+    return make_batched_operator(A).matvec

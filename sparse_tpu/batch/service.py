@@ -1,0 +1,300 @@
+"""SolveSession: a microbatching front door for same-pattern solves.
+
+The serving loop this subsystem exists for: requests ``(A-values, b,
+tol)`` trickle in from many callers, almost all of them over a handful
+of sparsity patterns (the deployed meshes/graphs). The session queues
+them, coalesces same-pattern requests into bucketed batches
+(:mod:`sparse_tpu.batch.bucket`), dispatches each bucket through ONE
+compiled masked-Krylov program (:mod:`sparse_tpu.batch.krylov`), and
+scatters per-lane results back to their tickets.
+
+Compile-count control is the whole game: the per-bucket program — the
+pattern's packed SELL matvec closed inside a jitted solver loop — lives
+in :mod:`sparse_tpu.plan_cache` keyed ``(pattern, "batch.<solver>.B<bucket>...")``,
+so a bucket costs exactly ONE cache miss (pack + trace + compile) ever,
+and every later dispatch of that bucket is a cache hit straight into a
+warm executable. ``plan_cache.stats()`` is the always-on instrument;
+with telemetry enabled each dispatch additionally emits a
+``batch.dispatch`` event (batch size, bucket, padding waste, queue
+latency, per-lane iteration stats — docs/batching.md).
+"""
+
+from __future__ import annotations
+
+import time
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .. import plan_cache, telemetry
+from ..config import settings
+from ..ops import spmv as spmv_ops
+from . import bucket as bucketing
+from . import krylov
+from .operator import BatchedCSR, SparsityPattern
+
+_SOLVERS = ("cg", "bicgstab", "gmres")
+
+
+class SolveTicket:
+    """Handle for one submitted system. ``result()`` flushes the session
+    if the request is still queued, then returns ``(x, iters, resid2)``
+    (host numpy scalars/arrays for the lane)."""
+
+    __slots__ = ("_session", "_out", "t_submit")
+
+    def __init__(self, session):
+        self._session = session
+        self._out = None
+        self.t_submit = time.monotonic()
+
+    @property
+    def done(self) -> bool:
+        return self._out is not None
+
+    def _set(self, x, iters, resid2, converged):
+        self._out = (x, int(iters), float(resid2), bool(converged))
+
+    def result(self):
+        if self._out is None:
+            self._session.flush()
+        if self._out is None:  # pragma: no cover - defensive
+            raise RuntimeError("flush did not resolve this ticket")
+        return self._out[:3]
+
+    @property
+    def converged(self) -> bool:
+        if self._out is None:
+            self._session.flush()
+        return self._out[3]
+
+
+class _Request:
+    __slots__ = ("pattern", "values", "b", "tol", "x0", "maxiter", "ticket")
+
+    def __init__(self, pattern, values, b, tol, x0, maxiter, ticket):
+        self.pattern, self.values, self.b = pattern, values, b
+        self.tol, self.x0, self.maxiter = tol, x0, maxiter
+        self.ticket = ticket
+
+
+class SolveSession:
+    """Queue -> coalesce -> bucket -> dispatch -> scatter.
+
+    Parameters
+    ----------
+    solver : 'cg' | 'bicgstab' | 'gmres'
+    batch_max : max lanes per dispatched batch (default
+        ``settings.batch_max``)
+    bucket_policy : 'pow2' | 'exact' (default ``settings.batch_bucket``)
+    conv_test_iters : convergence-test cadence of the masked loops
+    restart : GMRES restart length (gmres only)
+    auto_flush : when set, ``submit`` flushes as soon as a pattern has
+        this many queued requests (a latency/throughput knob; None =
+        explicit ``flush()`` only)
+    """
+
+    def __init__(self, solver: str = "cg", batch_max: int | None = None,
+                 bucket_policy: str | None = None, conv_test_iters: int = 25,
+                 restart: int | None = None, auto_flush: int | None = None):
+        if solver not in _SOLVERS:
+            raise ValueError(f"solver must be one of {_SOLVERS}")
+        self.solver = solver
+        self.batch_max = int(batch_max or settings.batch_max)
+        self.bucket_policy = bucket_policy or settings.batch_bucket
+        self.conv_test_iters = int(conv_test_iters)
+        self.restart = restart
+        self.auto_flush = auto_flush
+        self._patterns: dict = {}  # fingerprint -> SparsityPattern (dedupe)
+        self._pending: dict = {}  # id(pattern) -> [Request]
+        self.dispatches = 0
+
+    # -- intake ------------------------------------------------------------
+    def pattern_of(self, A) -> SparsityPattern:
+        """Session-deduped pattern for ``A``: same structure => same
+        object => same plan-cache entries across callers."""
+        p = SparsityPattern.from_csr(A)
+        return self._patterns.setdefault(p.fingerprint, p)
+
+    def submit(self, A, b, tol: float = 1e-8, x0=None, maxiter=None,
+               pattern: SparsityPattern | None = None) -> SolveTicket:
+        """Queue one system. ``A`` is a CSR-shaped matrix (csr_array /
+        scipy) or, with ``pattern=`` given, a bare ``(nnz,)`` value
+        vector over that pattern."""
+        if pattern is None:
+            pattern = self.pattern_of(A)
+            values = np.asarray(A.data if hasattr(A, "data") else A)
+        else:
+            pattern = self._patterns.setdefault(
+                pattern.fingerprint, pattern
+            )
+            values = np.asarray(A)
+        if values.shape != (pattern.nnz,):
+            raise ValueError(
+                f"values shape {values.shape} != (nnz={pattern.nnz},)"
+            )
+        b = np.asarray(b)
+        if b.shape != (pattern.shape[0],):
+            raise ValueError(
+                f"rhs shape {b.shape} != ({pattern.shape[0]},)"
+            )
+        t = SolveTicket(self)
+        q = self._pending.setdefault(id(pattern), [])
+        q.append(_Request(pattern, values, b, float(tol), x0, maxiter, t))
+        if self.auto_flush is not None and len(q) >= self.auto_flush:
+            self.flush()
+        return t
+
+    @property
+    def pending(self) -> int:
+        return sum(len(q) for q in self._pending.values())
+
+    def solve_many(self, mats, rhs, tol: float = 1e-8, maxiter=None):
+        """Convenience one-shot: submit a same-pattern stack, flush, and
+        return ``(X (B, n), iters (B,), resid2 (B,))`` host arrays."""
+        tickets = [
+            self.submit(A, b, tol=tol, maxiter=maxiter)
+            for A, b in zip(mats, rhs)
+        ]
+        self.flush()
+        outs = [t.result() for t in tickets]
+        return (
+            np.stack([o[0] for o in outs]),
+            np.asarray([o[1] for o in outs]),
+            np.asarray([o[2] for o in outs]),
+        )
+
+    # -- dispatch ----------------------------------------------------------
+    def flush(self) -> int:
+        """Dispatch every queued request; returns the number of batches
+        dispatched. Groups by (pattern, dtype), splits groups into
+        ``batch_max``-sized chunks, pads each chunk to its bucket."""
+        dispatched = 0
+        pending, self._pending = self._pending, {}
+        for q in pending.values():
+            # one group per result dtype so stacked values are homogeneous
+            by_dt: dict = {}
+            for r in q:
+                dt = np.result_type(r.values.dtype, r.b.dtype)
+                by_dt.setdefault(np.dtype(dt), []).append(r)
+            for dt, reqs in sorted(by_dt.items(), key=lambda kv: kv[0].str):
+                for lo in range(0, len(reqs), self.batch_max):
+                    self._dispatch(reqs[lo:lo + self.batch_max], dt)
+                    dispatched += 1
+        return dispatched
+
+    def _dispatch(self, reqs, dt) -> None:
+        t0 = time.monotonic()
+        pattern = reqs[0].pattern
+        nb = len(reqs)
+        bkt = bucketing.bucket_batch(
+            nb, policy=self.bucket_policy, batch_max=self.batch_max
+        )
+        values = np.stack([r.values.astype(dt) for r in reqs])
+        rhs = np.stack([r.b.astype(dt) for r in reqs])
+        tols = np.asarray([r.tol for r in reqs])
+        x0 = None
+        if any(r.x0 is not None for r in reqs):
+            x0 = np.stack([
+                np.zeros(pattern.shape[0], dt) if r.x0 is None
+                else np.asarray(r.x0, dtype=dt)
+                for r in reqs
+            ])
+        values, rhs, tols, x0, _ = bucketing.pad_lanes(
+            values, rhs, tols, bkt, x0=x0
+        )
+        maxiter = max(
+            (r.maxiter if r.maxiter is not None else pattern.shape[0] * 10)
+            for r in reqs
+        )
+        snap = plan_cache.snapshot()
+        prog = plan_cache.get(
+            pattern,
+            f"batch.{self.solver}.B{bkt}.{np.dtype(dt).str}",
+            lambda: self._build_program(pattern, bkt, np.dtype(dt)),
+        )
+        X, iters, resid2, conv = prog(
+            jnp.asarray(values), jnp.asarray(rhs), jnp.asarray(x0),
+            jnp.asarray(tols), maxiter,
+        )
+        X = np.asarray(X)
+        iters = np.asarray(iters)
+        resid2 = np.asarray(resid2)
+        conv = np.asarray(conv)
+        for i, r in enumerate(reqs):
+            r.ticket._set(X[i], iters[i], resid2[i], conv[i])
+        self.dispatches += 1
+        if telemetry.enabled():
+            q_ms = [
+                (t0 - r.ticket.t_submit) * 1e3 for r in reqs
+            ]
+            cache_d = plan_cache.delta(snap)
+            telemetry.record(
+                "batch.dispatch", solver=self.solver, batch=nb,
+                bucket=bkt, pad_waste=bkt - nb,
+                queue_ms_max=round(max(q_ms), 3),
+                queue_ms_mean=round(sum(q_ms) / len(q_ms), 3),
+                dispatch_ms=round((time.monotonic() - t0) * 1e3, 3),
+                iters_max=int(iters[:nb].max(initial=0)),
+                iters_mean=float(iters[:nb].mean()) if nb else 0.0,
+                plan_cache=cache_d,
+                n=pattern.shape[0], nnz=pattern.nnz,
+            )
+
+    def _build_program(self, pattern: SparsityPattern, bkt: int, dt):
+        """The per-bucket compiled program: pattern pack + masked solver
+        loop under ONE ``jax.jit`` whose arguments are the value stack,
+        rhs, x0 and tolerances — so same-bucket dispatches with fresh
+        coefficients reuse the executable (no constants captured from
+        any particular batch)."""
+        if self.solver == "gmres":
+            return self._build_gmres_program(pattern, bkt, dt)
+        pack = pattern.sell_pack()
+        idx_slabs, pos, zero_rows = (
+            pack.idx_slabs, pack.pos, pack.plan.zero_rows
+        )
+        loop = (
+            krylov._cg_loop if self.solver == "cg"
+            else krylov._bicgstab_loop
+        )
+        cti = self.conv_test_iters
+
+        @jax.jit
+        def run(values, rhs, x0, tols, maxiter):
+            vals = pack.pack_values(values)
+
+            def mv(X):
+                return spmv_ops.csr_spmv_sell_batched(
+                    idx_slabs, vals, pos, X, zero_rows
+                )
+
+            return loop(mv, rhs, x0, tols, maxiter, cti)
+
+        return run
+
+    def _build_gmres_program(self, pattern, bkt, dt):
+        """GMRES keeps its host-driven outer restart loop, so the bucket
+        'program' is a closure dispatching :func:`krylov.batched_gmres`
+        over a pattern-packed operator — restart cycles still compile
+        once per bucket (the jitted cycle is rebuilt per dispatch; the
+        XLA executable comes from jax's compile cache)."""
+        restart = self.restart
+
+        restart_eff = restart or min(20, pattern.shape[0])
+
+        def run(values, rhs, x0, tols, maxiter):
+            op = BatchedCSR(pattern, values)
+            # batched_gmres takes a scalar-or-(B,) relative tol; the
+            # session's per-lane ABSOLUTE targets ride the atol floor.
+            # Its maxiter counts OUTER restarts; bound inner work by the
+            # session's maxiter contract.
+            outer = max(-(-int(maxiter) // restart_eff), 1)
+            X, info = krylov.batched_gmres(
+                op, rhs, x0=x0, tol=0.0, atol=tols, restart=restart_eff,
+                maxiter=outer,
+            )
+            return X, info.iters, info.resid2, info.converged
+
+        return run

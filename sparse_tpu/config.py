@@ -19,6 +19,11 @@ Flags (all env-overridable):
   SPARSE_TPU_SELL_SIGMA       - SELL sorting-window size (rows; 0 = whole matrix).
   SPARSE_TPU_FORCE_SERIAL     - force single-shard execution of distributed conversions
                                 (mirrors the force_serial special case in coo.py:242).
+  SPARSE_TPU_BATCH_MAX        - batched solve subsystem (sparse_tpu.batch): max lanes a
+                                SolveSession coalesces into one dispatched batch.
+  SPARSE_TPU_BATCH_BUCKET     - 'pow2' | 'exact': batch-size bucket policy. pow2 pads
+                                ragged batches up to powers of two so the number of
+                                compiled batched programs stays bounded.
   SPARSE_TPU_TELEMETRY        - structured observability (sparse_tpu.telemetry): solver
                                 events, kernel counters, comm volumes, JSONL session log.
   SPARSE_TPU_TELEMETRY_PATH   - JSONL sink override (default results/axon/records.jsonl).
@@ -126,6 +131,18 @@ class Settings:
     # plane scratch scales as 2*D*TM; see linalg._try_fused_cg).
     fused_cg_tile: int = field(
         default_factory=lambda: _env_int("SPARSE_TPU_FUSED_CG_TILE", 65536)
+    )
+    # Batched solve subsystem (sparse_tpu.batch): the microbatching
+    # SolveSession coalesces same-pattern requests into batches of at
+    # most `batch_max` lanes; ragged batch sizes pad up to the bucket
+    # the policy picks ('pow2' bounds the number of compiled batched
+    # programs per pattern to log2(batch_max); 'exact' compiles one
+    # program per distinct batch size — only sane for fixed traffic).
+    batch_max: int = field(
+        default_factory=lambda: max(_env_int("SPARSE_TPU_BATCH_MAX", 64), 1)
+    )
+    batch_bucket: str = field(
+        default_factory=lambda: _env_str("SPARSE_TPU_BATCH_BUCKET", "pow2")
     )
     # Structured observability (sparse_tpu.telemetry). Off by default:
     # every instrumentation site is a single attribute check when

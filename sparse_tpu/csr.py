@@ -231,7 +231,14 @@ class csr_array(SparseArray):
                 settings.spmv_mode = mode
             if settings.spmv_mode in ("auto", "pallas"):
                 self._maybe_dia()
-            self._maybe_sell()
+            if settings.plan_cache:
+                # with the plan cache DISABLED the pack has nowhere to
+                # live — plan_cache.get builds and discards — so an eager
+                # warm would charge every one-shot solve the full SELL
+                # pack cost for nothing (tests/test_plan_cache.py pins
+                # this). Execute-time _maybe_sell still packs when a
+                # matvec actually needs it.
+                self._maybe_sell()
             self._maybe_ell()
         finally:
             settings.spmv_mode = prev

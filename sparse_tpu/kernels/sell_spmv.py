@@ -96,7 +96,8 @@ class SellPlan:
         )
 
 
-def sell_pack(indptr, indices, data, shape, C=None, sigma=None, max_slabs=None):
+def sell_pack(indptr, indices, data, shape, C=None, sigma=None, max_slabs=None,
+              with_srcs=False):
     """Pack host CSR buffers into the SELL-C-sigma slab layout.
 
     Pure numpy (construction-time, never inside solver loops — the same
@@ -106,6 +107,13 @@ def sell_pack(indptr, indices, data, shape, C=None, sigma=None, max_slabs=None):
     exactly; if that yields more than ``max_slabs`` distinct widths
     (pathological profiles), widths quantize up to powers of two first —
     at most 2x pad on the affected chunks, bounded compile size always.
+
+    ``with_srcs=True`` additionally returns a tuple of per-slab ``[K, R]``
+    source maps (packed slot -> original nnz position, -1 for pad slots):
+    the pattern-reuse handle of the batched subsystem
+    (``sparse_tpu.batch.operator``) — a whole stack of same-pattern value
+    vectors repacks on device as one gather through these maps, so the
+    host-side pack runs once per *pattern*, not once per matrix.
     """
     from ..config import settings
 
@@ -142,7 +150,9 @@ def sell_pack(indptr, indices, data, shape, C=None, sigma=None, max_slabs=None):
         widths = np.unique(chunk_w[chunk_w > 0])
 
     idt = indices.dtype if indices.dtype in (np.int32, np.int64) else np.int32
+    src_dt = np.int32 if nnz < 2**31 else np.int64
     slabs = []
+    srcs = []
     slab_meta = []
     packed_rows = []  # original row ids, slab-major packed order
     for K in widths.tolist():
@@ -160,6 +170,10 @@ def sell_pack(indptr, indices, data, shape, C=None, sigma=None, max_slabs=None):
         idx_t[slot, rr] = indices[src]
         val_t[slot, rr] = data[src]
         slabs.append((jnp.asarray(idx_t), jnp.asarray(val_t)))
+        if with_srcs:
+            src_t = np.full((K, R), -1, dtype=src_dt)
+            src_t[slot, rr] = src.astype(src_dt)
+            srcs.append(jnp.asarray(src_t))
         slab_meta.append((K, R, R - len(rws)))
         packed_rows.append(rws)
         packed_rows.append(np.full(R - len(rws), -1, dtype=np.int64))  # pad rows
@@ -180,6 +194,8 @@ def sell_pack(indptr, indices, data, shape, C=None, sigma=None, max_slabs=None):
     pos_dt = np.int32 if len(flat) < 2**31 else np.int64
 
     plan = SellPlan(m, n, C, sigma_eff, slab_meta, len(zero_rws), nnz)
+    if with_srcs:
+        return plan, tuple(slabs), jnp.asarray(pos.astype(pos_dt)), tuple(srcs)
     return plan, tuple(slabs), jnp.asarray(pos.astype(pos_dt))
 
 
@@ -237,6 +253,75 @@ def sell_spmv_pallas(plan: SellPlan, slabs, pos, x, interpret=None):
         return jnp.zeros((plan.m,), dtype=out_dt)
     packed = parts[0] if len(parts) == 1 else jnp.concatenate(parts)
     return packed[pos]
+
+
+@partial(jax.jit, static_argnames=("K", "TM", "interpret"))
+def _sell_slab_pallas_batched(idx_t, val_bt, X, K: int, TM: int,
+                              interpret: bool = False):
+    """Batched form of :func:`_sell_slab_pallas`: the grid gains a leading
+    batch dimension, the shared ``[K, R]`` index planes stay resident while
+    value planes ``[B, K, R]`` and per-lane x vectors ``[B, n]`` stream one
+    lane at a time — the whole same-pattern stack runs as one kernel launch
+    instead of B dispatches."""
+    B, _, R = val_bt.shape
+    out_dt = jnp.result_type(val_bt.dtype, X.dtype)
+
+    def kernel(x_ref, idx_ref, val_ref, y_ref):
+        acc = jnp.zeros((TM,), dtype=out_dt)
+        for k in range(K):  # static per slab: plane loads unroll
+            acc = acc + val_ref[0, k, :] * x_ref[0, idx_ref[k, :]]
+        y_ref[0, :] = acc
+
+    return pl.pallas_call(
+        kernel,
+        grid=(B, R // TM),
+        in_specs=[
+            # one lane of x resident per grid step
+            pl.BlockSpec((1, X.shape[1]), lambda b, g: (b, 0),
+                         memory_space=pltpu.VMEM),
+            # index planes are PATTERN state: shared by every lane
+            pl.BlockSpec((K, TM), lambda b, g: (0, g),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, K, TM), lambda b, g: (b, 0, g),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((1, TM), lambda b, g: (b, g),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((B, R), out_dt),
+        interpret=interpret,
+    )(X, idx_t, val_bt)
+
+
+def sell_spmv_pallas_batched(plan: SellPlan, idx_slabs, val_slabs, pos, X,
+                             interpret=None):
+    """Y = A_b @ x_b per lane via the batch-grid Pallas row-block kernel.
+
+    ``idx_slabs`` are the shared pattern index planes, ``val_slabs`` the
+    stacked ``[B, K, R]`` value planes (``sparse_tpu.batch.operator`` packs
+    them through the pattern's source maps), ``X`` is ``[B, n]``. Same
+    failover contract as :func:`sell_spmv_pallas` — callers catch the
+    Mosaic lowering error once and fall back to the XLA formulation."""
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    B = X.shape[0]
+    out_dt = jnp.result_type(
+        val_slabs[0].dtype if val_slabs else X.dtype, X.dtype
+    )
+    parts = []
+    for idx_t, val_bt, (K, R, _) in zip(idx_slabs, val_slabs, plan.slab_meta):
+        TM = ROW_ALIGN  # rows are ROW_ALIGN-padded, so this always divides
+        while TM * 2 <= 1024 and R % (TM * 2) == 0:
+            TM *= 2
+        parts.append(
+            _sell_slab_pallas_batched(idx_t, val_bt, X, K, TM, interpret)
+            .astype(out_dt)
+        )
+    if plan.zero_rows:
+        parts.append(jnp.zeros((B, plan.zero_rows), dtype=out_dt))
+    if not parts:
+        return jnp.zeros((B, plan.m), dtype=out_dt)
+    packed = parts[0] if len(parts) == 1 else jnp.concatenate(parts, axis=1)
+    return packed[:, pos]
 
 
 class PreparedCSR:

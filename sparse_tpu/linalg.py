@@ -308,6 +308,13 @@ def make_linear_operator(A) -> LinearOperator:
         return A
     if isinstance(A, SparseArray):
         return _SparseMatrixLinearOperator(A)
+    from .batch.operator import BatchedOperator
+
+    if isinstance(A, BatchedOperator):
+        # a batch of B independent systems IS one (B*m, B*n) block-
+        # diagonal system: the unbatched solver surface keeps working on
+        # batched operators through this view (docs/batching.md)
+        return A.as_block_operator()
     return _DenseMatrixLinearOperator(A)
 
 
@@ -639,6 +646,36 @@ def spsolve(A, b, **kwargs):
     """Sparse solve via CG (reference linalg.py:88)."""
     x, _ = cg(A, b, **kwargs)
     return x
+
+
+# ---------------------------------------------------------------------------
+# Batched entry points (sparse_tpu.batch.krylov) — B independent systems
+# sharing one sparsity pattern, solved by one masked compiled loop with
+# per-lane convergence (docs/batching.md). Batch-of-1 matches the
+# unbatched solvers above.
+# ---------------------------------------------------------------------------
+def batched_cg(A, b, **kwargs):
+    """Batched CG over a lane stack; see
+    :func:`sparse_tpu.batch.krylov.batched_cg`."""
+    from .batch.krylov import batched_cg as _impl
+
+    return _impl(A, b, **kwargs)
+
+
+def batched_bicgstab(A, b, **kwargs):
+    """Batched BiCGStab; see
+    :func:`sparse_tpu.batch.krylov.batched_bicgstab`."""
+    from .batch.krylov import batched_bicgstab as _impl
+
+    return _impl(A, b, **kwargs)
+
+
+def batched_gmres(A, b, **kwargs):
+    """Batched restarted GMRES; see
+    :func:`sparse_tpu.batch.krylov.batched_gmres`."""
+    from .batch.krylov import batched_gmres as _impl
+
+    return _impl(A, b, **kwargs)
 
 
 # ---------------------------------------------------------------------------

@@ -144,6 +144,47 @@ def csr_spmm_sell(slabs, pos, B, zero_rows: int, out_dtype=None):
     return packed[pos]
 
 
+def csr_spmv_sell_batched(idx_slabs, val_slabs, pos, X, zero_rows: int,
+                          out_dtype=None):
+    """Y[b] = A_b @ X[b] on the SELL layout with one SHARED sparsity
+    pattern: ``idx_slabs`` (and ``pos``/``zero_rows``) are pattern state
+    packed once, ``val_slabs`` is a tuple of stacked ``[B, K, R]`` value
+    planes — the vmap-compatible XLA path of the batched subsystem
+    (``sparse_tpu.batch``). Every lane rides the same contiguous 1-D
+    gathers as :func:`csr_spmv_sell`; XLA batches them for free."""
+    X = jnp.asarray(X)
+
+    def one(vts, x):
+        return csr_spmv_sell(
+            tuple(zip(idx_slabs, vts)), pos, x, zero_rows, out_dtype
+        )
+
+    return jax.vmap(one)(tuple(val_slabs), X)
+
+
+def csr_spmm_sell_batched(idx_slabs, val_slabs, pos, X, zero_rows: int,
+                          out_dtype=None):
+    """C[b] = A_b @ X[b] (dense ``[B, n, k]``) on the shared-pattern SELL
+    layout — the batched counterpart of :func:`csr_spmm_sell`."""
+    X = jnp.asarray(X)
+
+    def one(vts, x):
+        return csr_spmm_sell(
+            tuple(zip(idx_slabs, vts)), pos, x, zero_rows, out_dtype
+        )
+
+    return jax.vmap(one)(tuple(val_slabs), X)
+
+
+def csr_spmv_segment_batched(indptr, indices, values, X, m: int):
+    """Y[b] = A_b @ X[b] via the general segment path, values ``[B, nnz]``
+    over one shared pattern — the trace-safe fallback of the batched
+    subsystem (no host-side pack required)."""
+    return jax.vmap(
+        lambda d, x: csr_spmv_segment(indptr, indices, d, x, m)
+    )(values, jnp.asarray(X))
+
+
 def csr_spmm_segment(indptr, indices, data, B, m: int):
     """C = A @ B with B dense [k, n]. Reference: SPMM_CSR_DENSE row-split."""
     nnz = data.shape[0]

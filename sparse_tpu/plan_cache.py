@@ -149,6 +149,22 @@ def stats() -> dict:
     return out
 
 
+def snapshot() -> dict:
+    """Copy of the raw always-on counters, for delta accounting without a
+    global reset (bench rows, ``batch.SolveSession`` dispatch telemetry —
+    concurrent users must not clobber each other's baselines)."""
+    with _LOCK:
+        return dict(_STATS)
+
+
+def delta(since: dict) -> dict:
+    """Counter movement since a :func:`snapshot`:
+    ``{hits, misses, evictions}``."""
+    with _LOCK:
+        return {k: _STATS[k] - since.get(k, 0)
+                for k in ("hits", "misses", "evictions")}
+
+
 def reset_stats() -> None:
     with _LOCK:
         for k in _STATS:

@@ -43,6 +43,13 @@ KINDS: dict[str, frozenset] = {
     "comm.spgemm2d": frozenset({"bytes", "grid"}),
     # samplesort exchange volumes (from the host-visible send matrix)
     "comm.sort": frozenset({"bytes", "S"}),
+    # -- batched solves (sparse_tpu.batch) ----------------------------------
+    # one per bucket a SolveSession dispatches: real lane count, padded
+    # bucket size, pad waste, queue latency and per-lane iteration stats
+    "batch.dispatch": frozenset({"solver", "batch", "bucket"}),
+    # one per completed batched Krylov solve (any entry point); B is the
+    # lane count, iters_max the slowest lane's iteration count
+    "batch.solve": frozenset({"solver", "B", "iters_max"}),
     # -- generic ------------------------------------------------------------
     "span": frozenset({"name", "dur_s"}),
     # bench.py session record (always written by a bench run, even when

@@ -48,6 +48,7 @@ jax.config.update("jax_enable_x64", True)
 # evidence; this is the inner-loop check. Chosen from measured per-module
 # wall times (r4 durations run) to stay under ~4 minutes total.
 _QUICK_FILES = {
+    "test_batch.py",
     "test_bench_evidence.py",
     "test_bsr.py",
     "test_checkpoint.py",
@@ -66,6 +67,7 @@ _QUICK_FILES = {
     "test_multigrid.py",
     "test_plan_cache.py",
     "test_quantum.py",
+    "test_quick_lane.py",
     "test_sell_spmv.py",
     "test_shard_perf.py",
     "test_spatial.py",

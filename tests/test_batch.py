@@ -1,0 +1,506 @@
+"""Batched solve subsystem (sparse_tpu.batch): operators, masked Krylov
+batches, bucketing, and the SolveSession microbatcher.
+
+The load-bearing contract is batch-of-1 parity: the masked batched
+solvers use the unbatched solvers' recurrences and convergence-test
+points, so ``B=1`` must reproduce ``linalg.cg``/``bicgstab``/``gmres``
+(f32/f64, and c64/c128 through the stacked-real transfer shim) — plus
+the masked-exit edge cases (already-converged lane, never-converging
+lane hitting maxiter) and the plan-cache accounting the bench row
+asserts (one pattern pack per pattern, one program per bucket).
+"""
+
+import numpy as np
+import pytest
+import scipy.sparse as sp
+
+import sparse_tpu
+from sparse_tpu import linalg, plan_cache, utils
+from sparse_tpu.batch import (
+    BatchedCSR,
+    BatchedDIA,
+    SolveSession,
+    SparsityPattern,
+    batched_bicgstab,
+    batched_cg,
+    batched_gmres,
+    bucket_batch,
+    make_batched_operator,
+    pad_lanes,
+    pad_pattern,
+    pow2_ceil,
+)
+from sparse_tpu.config import settings
+
+
+def _tridiag_stack(n=48, B=4, dtype=np.float64, seed=0):
+    """B SPD systems over one tridiagonal pattern, varied diagonals."""
+    rng = np.random.default_rng(seed)
+    e = np.ones(n)
+    base = sp.diags([-e[:-1], 3.0 * e, -e[:-1]], [-1, 0, 1], format="csr")
+    mats = []
+    for _ in range(B):
+        A = base.copy()
+        A.setdiag(3.0 + rng.random(n))
+        A.sort_indices()
+        mats.append(A.tocsr().astype(dtype))
+    rhs = rng.standard_normal((B, n)).astype(dtype)
+    return mats, rhs
+
+
+def _skewed(n=60, seed=3):
+    """Skewed general pattern with an empty row and a wide row."""
+    rng = np.random.default_rng(seed)
+    rows = np.concatenate([
+        np.zeros(8, np.int64), np.arange(2, n - 3, 2),
+        np.full(6, n - 2, np.int64),
+    ])
+    cols = rng.integers(0, n, rows.shape[0])
+    G = sp.coo_matrix(
+        (rng.random(rows.shape[0]), (rows, cols)), shape=(n, n)
+    ).tocsr()
+    A = (G + G.T) * 0.5
+    A = A + sp.diags(np.asarray(np.abs(A).sum(axis=1)).ravel() + 1.0)
+    A = A.tocsr()
+    A.sort_indices()
+    return A
+
+
+# ---------------------------------------------------------------------------
+# operators
+# ---------------------------------------------------------------------------
+def test_batched_csr_spmv_matches_lanes():
+    mats, _ = _tridiag_stack(B=3)
+    bc = BatchedCSR.from_stack(mats)
+    rng = np.random.default_rng(1)
+    X = rng.standard_normal((3, mats[0].shape[0]))
+    Y = np.asarray(bc.matvec(X))
+    for i in range(3):
+        np.testing.assert_allclose(Y[i], mats[i] @ X[i], rtol=1e-12)
+
+
+@pytest.mark.parametrize("mode", ["segment", "sell", "pallas", "auto"])
+def test_batched_csr_modes_agree(monkeypatch, mode):
+    """Every spmv_mode produces the same batched SpMV on a skewed
+    pattern (the pallas row dispatches the batch-grid kernel in
+    interpret mode off-TPU, failing over like PreparedCSR)."""
+    monkeypatch.setattr(settings, "spmv_mode", mode)
+    A = _skewed()
+    mats = []
+    for i in range(3):
+        m = A.copy()
+        m.data = m.data * (1.0 + i)
+        mats.append(m)
+    bc = BatchedCSR.from_stack(mats)
+    bc = BatchedCSR(bc.pattern, np.stack(
+        [m.data for m in mats]).astype(np.float32))
+    rng = np.random.default_rng(2)
+    X = rng.standard_normal((3, A.shape[0])).astype(np.float32)
+    Y = np.asarray(bc.matvec(X))
+    for i in range(3):
+        np.testing.assert_allclose(
+            Y[i], (mats[i] @ X[i]).astype(np.float32), rtol=2e-5, atol=1e-6
+        )
+
+
+def test_batched_csr_spmm_and_dense_stack():
+    mats, _ = _tridiag_stack(B=2, n=20)
+    bc = BatchedCSR.from_stack(mats)
+    rng = np.random.default_rng(4)
+    X = rng.standard_normal((2, 20, 3))
+    Y = np.asarray(bc.matmat(X))
+    for i in range(2):
+        np.testing.assert_allclose(Y[i], mats[i] @ X[i], rtol=1e-12)
+    dense = make_batched_operator(
+        np.stack([m.toarray() for m in mats])
+    )
+    Yd = np.asarray(dense.matmat(X))
+    np.testing.assert_allclose(Yd, Y, rtol=1e-12)
+
+
+def test_batched_dia_matches_csr_path():
+    mats, _ = _tridiag_stack(B=3, n=32)
+    bc = BatchedCSR.from_stack(mats)
+    bd = bc.todia()
+    assert isinstance(bd, BatchedDIA)
+    assert len(bd.offsets) == 3
+    rng = np.random.default_rng(5)
+    X = rng.standard_normal((3, 32))
+    np.testing.assert_allclose(
+        np.asarray(bd.matvec(X)), np.asarray(bc.matvec(X)), rtol=1e-12
+    )
+    # a genuinely non-banded pattern refuses the DIA view
+    with pytest.raises(ValueError):
+        BatchedCSR.from_stack([_skewed()]).todia(max_diags=4)
+
+
+def test_pattern_mismatch_rejected():
+    mats, _ = _tridiag_stack(B=2, n=16)
+    other = sp.eye(16, format="csr")
+    with pytest.raises(ValueError):
+        BatchedCSR.from_stack([mats[0], other])
+
+
+def test_pattern_pack_cached_once():
+    """One pattern object => one SELL pack, shared by every batch over
+    it (the batched form of the prepare/execute contract)."""
+    mats, _ = _tridiag_stack(B=2, n=24)
+    pattern = SparsityPattern.from_csr(mats[0])
+    vals = np.stack([m.data for m in mats])
+    before = plan_cache.snapshot()
+    bc1 = BatchedCSR(pattern, vals)
+    bc2 = BatchedCSR(pattern, vals * 2.0)
+    X = np.random.default_rng(0).standard_normal((2, 24))
+    bc1.matvec(X)
+    bc2.matvec(X)
+    bc1.matvec(X)
+    d = plan_cache.delta(before)
+    assert d["misses"] == 1  # the pattern pack; everything else hits
+    assert d["hits"] >= 2
+
+
+def test_lane_view_roundtrip():
+    mats, _ = _tridiag_stack(B=2, n=16)
+    bc = BatchedCSR.from_stack(mats)
+    lane = bc.lane(1)
+    assert isinstance(lane, sparse_tpu.csr_array)
+    np.testing.assert_allclose(lane.toarray(), mats[1].toarray())
+
+
+def test_block_operator_interop():
+    """make_linear_operator over a batch = the block-diagonal system:
+    the unbatched solver surface keeps working."""
+    mats, rhs = _tridiag_stack(B=3, n=24)
+    bc = BatchedCSR.from_stack(mats)
+    L = linalg.make_linear_operator(bc)
+    assert L.shape == (72, 72)
+    x, iters = linalg.cg(L, rhs.reshape(-1), tol=1e-10, maxiter=300)
+    X = np.asarray(x).reshape(3, 24)
+    for i in range(3):
+        np.testing.assert_allclose(
+            mats[i] @ X[i], rhs[i], rtol=1e-8, atol=1e-8
+        )
+
+
+# ---------------------------------------------------------------------------
+# batch-of-1 parity (the satellite contract)
+# ---------------------------------------------------------------------------
+@pytest.mark.parametrize("dtype", [np.float32, np.float64])
+def test_b1_cg_parity(dtype):
+    mats, rhs = _tridiag_stack(B=1, dtype=dtype, seed=7)
+    tol = 1e-6 if dtype == np.float32 else 1e-12
+    Xb, info = batched_cg(
+        BatchedCSR.from_stack(mats), rhs, tol=tol, maxiter=400
+    )
+    xu, iu = linalg.cg(sparse_tpu.csr_array(mats[0]), rhs[0], tol=tol,
+                       maxiter=400)
+    assert int(np.asarray(info.iters)[0]) == iu
+    # same recurrences, different SpMV kernel (batched SELL vs DIA):
+    # f32 agreement is eps-accumulation bounded, f64 essentially exact
+    np.testing.assert_allclose(
+        np.asarray(Xb)[0], np.asarray(xu),
+        rtol=1e-4 if dtype == np.float32 else 1e-12,
+        atol=1e-5 if dtype == np.float32 else 1e-12,
+    )
+    assert bool(np.asarray(info.converged)[0])
+
+
+@pytest.mark.parametrize("dtype", [np.float32, np.float64])
+def test_b1_bicgstab_parity(dtype):
+    mats, rhs = _tridiag_stack(B=1, dtype=dtype, seed=8)
+    tol = 1e-5 if dtype == np.float32 else 1e-12
+    Xb, info = batched_bicgstab(
+        BatchedCSR.from_stack(mats), rhs, tol=tol, maxiter=400
+    )
+    xu, iu = linalg.bicgstab(
+        sparse_tpu.csr_array(mats[0]), rhs[0], tol=tol, maxiter=400
+    )
+    assert int(np.asarray(info.iters)[0]) == iu
+    np.testing.assert_allclose(
+        np.asarray(Xb)[0], np.asarray(xu),
+        rtol=1e-4 if dtype == np.float32 else 1e-11, atol=1e-11,
+    )
+
+
+@pytest.mark.parametrize("dtype", [np.float32, np.float64])
+def test_b1_gmres_parity(dtype):
+    mats, rhs = _tridiag_stack(B=1, dtype=dtype, seed=9)
+    tol = 1e-5 if dtype == np.float32 else 1e-10
+    Xb, info = batched_gmres(BatchedCSR.from_stack(mats), rhs, tol=tol)
+    xu, iu = linalg.gmres(sparse_tpu.csr_array(mats[0]), rhs[0], tol=tol)
+    assert int(np.asarray(info.iters)[0]) == iu
+    np.testing.assert_allclose(
+        np.asarray(Xb)[0], np.asarray(xu),
+        rtol=1e-4 if dtype == np.float32 else 1e-9, atol=1e-9,
+    )
+
+
+def _hermitian_stack(n=32, seed=10):
+    rng = np.random.default_rng(seed)
+    hop = rng.random(n - 1) + 1j * rng.random(n - 1)
+    H = sp.diags(
+        [np.conj(hop), np.full(n, 4.0 + 0j), hop], [-1, 0, 1]
+    ).tocsr()
+    H.sort_indices()
+    zb = rng.standard_normal(n) + 1j * rng.standard_normal(n)
+    return H, zb
+
+
+def test_b1_cg_complex_via_stacked_shim(monkeypatch):
+    """c64/c128 batch-of-1 parity with the TRANSFER-RESTRICTED path
+    forced: complex host inputs ride utils.asjnp's stacked-real shim
+    into the batched solver, exactly like the unbatched solvers."""
+    monkeypatch.setattr(utils, "_TRANSFER_RESTRICTED", True)
+    H, zb = _hermitian_stack()
+    Xb, info = batched_cg(
+        BatchedCSR.from_stack([H]), zb[None, :], tol=1e-10, maxiter=400
+    )
+    xu, iu = linalg.cg(sparse_tpu.csr_array(H), zb, tol=1e-10, maxiter=400)
+    assert int(np.asarray(info.iters)[0]) == iu
+    np.testing.assert_allclose(
+        utils.tohost(Xb)[0], utils.tohost(xu), rtol=1e-10, atol=1e-12
+    )
+
+
+def test_b1_gmres_complex():
+    H, zb = _hermitian_stack(seed=11)
+    Xb, info = batched_gmres(
+        BatchedCSR.from_stack([H]), zb[None, :], tol=1e-9
+    )
+    xu, iu = linalg.gmres(sparse_tpu.csr_array(H), zb, tol=1e-9)
+    assert int(np.asarray(info.iters)[0]) == iu
+    np.testing.assert_allclose(
+        np.asarray(Xb)[0], np.asarray(xu), rtol=1e-7, atol=1e-9
+    )
+
+
+# ---------------------------------------------------------------------------
+# masked-exit edge cases
+# ---------------------------------------------------------------------------
+def test_masked_lanes_match_unbatched_iters():
+    """Mixed batch: an already-converged lane (b = 0), a normal lane, a
+    never-converging lane (impossible tol) — per-lane iteration counts
+    equal the three separate unbatched solves, converged lanes freeze."""
+    mats, rhs = _tridiag_stack(B=3, seed=12)
+    rhs = rhs.copy()
+    rhs[0] = 0.0  # already converged at entry
+    tols = np.array([1e-10, 1e-10, 1e-300])
+    Xb, info = batched_cg(
+        BatchedCSR.from_stack(mats), rhs, tol=tols, maxiter=40,
+        conv_test_iters=5,
+    )
+    iters_b = np.asarray(info.iters)
+    conv_b = np.asarray(info.converged)
+    for i in range(3):
+        xu, iu = linalg.cg(
+            sparse_tpu.csr_array(mats[i]), rhs[i], tol=float(tols[i]),
+            maxiter=40, conv_test_iters=5,
+        )
+        assert iters_b[i] == iu
+        np.testing.assert_allclose(
+            np.asarray(Xb)[i], np.asarray(xu), rtol=1e-10, atol=1e-12
+        )
+    # the impossible lane hit maxiter and is flagged unconverged
+    assert iters_b[2] == 40 and not conv_b[2]
+    assert conv_b[0] and conv_b[1]
+
+
+def test_converged_lane_result_is_frozen():
+    """A lane that converges early must return the SAME iterate whether
+    its batch-mates keep running or not."""
+    mats, rhs = _tridiag_stack(B=2, seed=13)
+    tols = np.array([1e-8, 1e-300])  # lane 1 runs to maxiter
+    X2, info2 = batched_cg(
+        BatchedCSR.from_stack(mats), rhs, tol=tols, maxiter=60,
+        conv_test_iters=5,
+    )
+    X1, info1 = batched_cg(
+        BatchedCSR.from_stack(mats[:1]), rhs[:1], tol=1e-8, maxiter=60,
+        conv_test_iters=5,
+    )
+    assert np.asarray(info2.iters)[0] == np.asarray(info1.iters)[0]
+    np.testing.assert_array_equal(np.asarray(X2)[0], np.asarray(X1)[0])
+
+
+def test_bicgstab_maxiter_lane():
+    mats, rhs = _tridiag_stack(B=2, seed=14)
+    tols = np.array([1e-8, 1e-300])
+    _X, info = batched_bicgstab(
+        BatchedCSR.from_stack(mats), rhs, tol=tols, maxiter=60,
+        conv_test_iters=4,
+    )
+    iters = np.asarray(info.iters)
+    conv = np.asarray(info.converged)
+    assert iters[1] == 60 and not conv[1]
+    assert conv[0] and iters[0] < 60
+
+
+# ---------------------------------------------------------------------------
+# bucketing
+# ---------------------------------------------------------------------------
+def test_pow2_bucketing(monkeypatch):
+    assert [pow2_ceil(v) for v in (0, 1, 2, 3, 5, 8, 9)] == \
+        [1, 1, 2, 4, 8, 8, 16]
+    monkeypatch.setattr(settings, "batch_max", 16)
+    assert bucket_batch(5) == 8
+    assert bucket_batch(5, policy="exact") == 5
+    assert bucket_batch(100) == 16  # clamped to batch_max
+    with pytest.raises(ValueError):
+        bucket_batch(3, policy="fibonacci")
+
+
+def test_pad_lanes_converge_instantly():
+    mats, rhs = _tridiag_stack(B=3, seed=15)
+    vals = np.stack([m.data for m in mats])
+    tols = np.full(3, 1e-10)
+    v, r, t, x0, nreal = pad_lanes(vals, rhs, tols, 4)
+    assert v.shape[0] == r.shape[0] == t.shape[0] == 4 and nreal == 3
+    pattern = SparsityPattern.from_csr(mats[0])
+    _X, info = batched_cg(
+        BatchedCSR(pattern, v), r, tol=t, maxiter=100, conv_test_iters=5
+    )
+    iters = np.asarray(info.iters)
+    # the pad lane (zero rhs, huge tol) froze at the first test point
+    assert iters[3] == 5 and bool(np.asarray(info.converged)[3])
+
+
+def test_pad_pattern_exact_for_krylov():
+    """Shape/nnz pow2 padding is exact: the padded solve restricted to
+    the real rows equals the unpadded solve (empty pad rows and zero
+    entries contribute nothing to any inner product)."""
+    A = _tridiag_stack(B=1, n=27, seed=16)[0][0]
+    b = np.random.default_rng(17).standard_normal(27)
+    pattern = SparsityPattern.from_csr(A)
+    padded, pad_values, pad_rhs = pad_pattern(pattern)
+    assert padded.shape == (32, 32)
+    assert padded.nnz == pow2_ceil(pattern.nnz)
+    Xp, infop = batched_cg(
+        BatchedCSR(padded, pad_values(A.data[None, :])),
+        pad_rhs(b[None, :]), tol=1e-10, maxiter=200,
+    )
+    xu, iu = linalg.cg(sparse_tpu.csr_array(A), b, tol=1e-10, maxiter=200)
+    assert int(np.asarray(infop.iters)[0]) == iu
+    np.testing.assert_allclose(
+        np.asarray(Xp)[0, :27], np.asarray(xu), rtol=1e-10, atol=1e-12
+    )
+    np.testing.assert_allclose(np.asarray(Xp)[0, 27:], 0.0, atol=1e-12)
+
+
+# ---------------------------------------------------------------------------
+# SolveSession
+# ---------------------------------------------------------------------------
+def test_session_scatter_and_correctness():
+    mats, rhs = _tridiag_stack(B=5, seed=18)
+    ses = SolveSession("cg", batch_max=8)
+    tickets = [
+        ses.submit(mats[i], rhs[i], tol=1e-10, maxiter=200)
+        for i in range(5)
+    ]
+    assert ses.pending == 5 and not tickets[0].done
+    assert ses.flush() == 1  # one bucket: same pattern, one chunk
+    assert ses.pending == 0
+    for i, t in enumerate(tickets):
+        x, iters, resid2 = t.result()
+        assert t.done and t.converged
+        np.testing.assert_allclose(mats[i] @ x, rhs[i], rtol=1e-7,
+                                   atol=1e-7)
+        assert iters > 0 and resid2 < 1e-18
+
+
+def test_session_one_miss_per_bucket():
+    """The bench-row contract: a bucket costs exactly one plan-cache
+    miss ever; same-bucket redispatches hit the compiled program."""
+    mats, rhs = _tridiag_stack(B=4, seed=19)
+    ses = SolveSession("cg", batch_max=4)
+    pattern = ses.pattern_of(mats[0])
+    pattern.sell_pack()  # pattern warm (its own, separate entry)
+    before = plan_cache.snapshot()
+    ses.solve_many(mats, rhs, tol=1e-8, maxiter=100)
+    d = plan_cache.delta(before)
+    assert d["misses"] == 1  # the bucket program, nothing else
+    before = plan_cache.snapshot()
+    ses.solve_many(mats, rhs, tol=1e-8, maxiter=100)
+    d2 = plan_cache.delta(before)
+    assert d2["misses"] == 0 and d2["hits"] >= 1
+
+
+def test_session_buckets_split_and_pad(monkeypatch):
+    """7 requests under batch_max=4 -> two dispatches; the 3-lane tail
+    pads to its pow2 bucket of 4."""
+    mats, rhs = _tridiag_stack(B=7, seed=20)
+    ses = SolveSession("cg", batch_max=4)
+    tickets = [
+        ses.submit(mats[i], rhs[i], tol=1e-8, maxiter=100)
+        for i in range(7)
+    ]
+    assert ses.flush() == 2
+    for i, t in enumerate(tickets):
+        x, _it, _r2 = t.result()
+        np.testing.assert_allclose(mats[i] @ x, rhs[i], rtol=1e-6,
+                                   atol=1e-6)
+
+
+def test_session_auto_flush_and_mixed_tols():
+    mats, rhs = _tridiag_stack(B=2, seed=21)
+    ses = SolveSession("cg", auto_flush=2)
+    t0 = ses.submit(mats[0], rhs[0], tol=1e-4, maxiter=100)
+    assert not t0.done
+    t1 = ses.submit(mats[1], rhs[1], tol=1e-12, maxiter=400)
+    # auto_flush fired on the second submit
+    assert t0.done and t1.done
+    _x0, it0, r0 = t0.result()
+    _x1, it1, r1 = t1.result()
+    assert r0 < 1e-8 and r1 < 1e-22  # tol^2 per lane
+    assert it1 >= it0  # the tighter lane iterated at least as long
+
+
+@pytest.mark.parametrize("solver", ["bicgstab", "gmres"])
+def test_session_other_solvers(solver):
+    mats, rhs = _tridiag_stack(B=3, seed=22)
+    ses = SolveSession(solver, batch_max=4)
+    X, iters, _r2 = ses.solve_many(mats, rhs, tol=1e-9, maxiter=300)
+    for i in range(3):
+        np.testing.assert_allclose(mats[i] @ X[i], rhs[i], rtol=1e-6,
+                                   atol=1e-6)
+        assert iters[i] > 0
+
+
+def test_session_telemetry_dispatch_event(monkeypatch, tmp_path):
+    """With telemetry on, each dispatch emits a schema-valid
+    batch.dispatch event carrying batch/bucket/padding/queue stats."""
+    from sparse_tpu import telemetry
+
+    monkeypatch.setattr(settings, "telemetry", True)
+    telemetry.configure(str(tmp_path / "t.jsonl"))
+    telemetry.reset()
+    try:
+        mats, rhs = _tridiag_stack(B=3, seed=23)
+        ses = SolveSession("cg", batch_max=4)
+        ses.solve_many(mats, rhs, tol=1e-8, maxiter=100)
+        evs = telemetry.events("batch.dispatch")
+        assert len(evs) == 1
+        ev = evs[0]
+        assert telemetry.schema.validate(ev) == []
+        assert ev["batch"] == 3 and ev["bucket"] == 4
+        assert ev["pad_waste"] == 1
+        assert ev["queue_ms_max"] >= 0 and ev["iters_max"] > 0
+        # the public krylov entry points log batch.solve events (the
+        # session's jitted bucket programs use the raw loops instead)
+        _X, _info = batched_cg(
+            BatchedCSR.from_stack(mats), rhs, tol=1e-8, maxiter=100
+        )
+        solves = telemetry.events("batch.solve")
+        assert solves and telemetry.schema.validate(solves[0]) == []
+        assert solves[0]["B"] == 3
+    finally:
+        telemetry.configure(None)
+        telemetry.reset()
+
+
+def test_session_rejects_bad_shapes():
+    mats, rhs = _tridiag_stack(B=1, n=16)
+    ses = SolveSession("cg")
+    with pytest.raises(ValueError):
+        ses.submit(mats[0], rhs[0][:-1])
+    with pytest.raises(ValueError):
+        SolveSession("sor")

@@ -43,6 +43,22 @@ def test_freshest_picks_newest_tpu_line(tmp_path, monkeypatch):
     assert rec["value"] == 214.0 and rec["ts"] == 200.0
 
 
+def test_session_record_embeds_plan_cache_stats(tmp_path, monkeypatch):
+    """Every bench.session record carries the always-on plan-cache
+    counters (ISSUE 3 satellite): rounds attribute cache behavior —
+    prepare reuse, batched-bucket compiles — without a separate probe."""
+    import time
+
+    _redirect(monkeypatch, tmp_path)
+    bench._log_session_record({"metric": "x"}, "ok", time.monotonic())
+    line = open(bench.RECORDS_PATH).read().splitlines()[-1]
+    rec = json.loads(line)
+    assert rec["kind"] == "bench.session" and rec["status"] == "ok"
+    pc = rec["plan_cache"]
+    for key in ("hits", "misses", "evictions", "size", "hit_rate"):
+        assert key in pc
+
+
 def test_log_hw_text_writes_out_file(tmp_path, monkeypatch):
     _redirect(monkeypatch, tmp_path)
     bench._log_hw_text("gmg_n_2000", "Iterations / sec: 97.1\n")

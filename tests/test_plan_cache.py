@@ -62,6 +62,38 @@ def test_invalidate_and_capacity_lru(monkeypatch):
     assert plan_cache.lookup(objs[-1], "k") is None
 
 
+def test_no_eager_pack_when_cache_disabled(monkeypatch):
+    """Regression (ISSUE 3 satellite): make_linear_operator's auto-warm
+    used to pack a SELL plan even with SPARSE_TPU_PLAN_CACHE=0 — a full
+    pack built and immediately discarded, charged to every one-shot
+    solve. With the cache off the warm must skip; execute-time packing
+    (an actual matvec) still works."""
+    from sparse_tpu.kernels import sell_spmv as ks
+
+    monkeypatch.setattr(settings, "plan_cache", False)
+    monkeypatch.setattr(settings, "spmv_mode", "sell")
+    packs = []
+    real = ks.sell_pack
+    monkeypatch.setattr(
+        ks, "sell_pack", lambda *a, **k: packs.append(1) or real(*a, **k)
+    )
+    s = _skewed_spd(120, seed=9)
+    A = sparse_tpu.csr_array(s)
+    linalg.make_linear_operator(A)  # the auto-warm wrap
+    assert packs == []  # no pack: nowhere to cache it
+    y = A @ np.ones(120)  # eager matvec: packs (uncached) and executes
+    assert len(packs) == 1
+    np.testing.assert_allclose(np.asarray(y), s @ np.ones(120), rtol=1e-10)
+    # with the cache ON the warm packs exactly once and the matvec reuses
+    monkeypatch.setattr(settings, "plan_cache", True)
+    packs.clear()
+    A2 = sparse_tpu.csr_array(s)
+    linalg.make_linear_operator(A2)
+    assert len(packs) == 1
+    A2 @ np.ones(120)
+    assert len(packs) == 1
+
+
 def test_disabled_cache_builds_every_time(monkeypatch):
     monkeypatch.setattr(settings, "plan_cache", False)
     o = _Obj()

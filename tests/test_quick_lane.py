@@ -1,0 +1,48 @@
+"""Quick-lane integrity: the committed manifest floor must hold.
+
+Wires ``scripts/check_quick_lane.py`` into the suite (ISSUE 3 satellite)
+so tier-1 catches a quick-lane file going missing/unmarked or its test
+count silently dropping. The check is pure-ast static analysis — no
+subprocess, no collection, milliseconds.
+"""
+
+import importlib.util
+import os
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_checker():
+    spec = importlib.util.spec_from_file_location(
+        "check_quick_lane",
+        os.path.join(REPO, "scripts", "check_quick_lane.py"),
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_quick_lane_intact():
+    mod = _load_checker()
+    assert mod.check() == []
+
+
+def test_this_file_is_in_the_lane():
+    """The guard itself must ride the lane it guards."""
+    mod = _load_checker()
+    assert "test_quick_lane.py" in mod.quick_files()
+
+
+def test_static_counter_sees_this_function():
+    mod = _load_checker()
+    n = mod.count_tests(os.path.abspath(__file__))
+    assert n >= 3  # the three tests in this module
+
+
+def test_manifest_matches_conftest():
+    import json
+
+    mod = _load_checker()
+    manifest = json.load(open(mod.MANIFEST))
+    assert set(manifest["files"]) == mod.quick_files()
+    assert manifest["total"] == sum(manifest["files"].values())
