@@ -109,6 +109,16 @@ def _log_session_record(rec, status: str, t_start: float) -> None:
         entry["plan_cache"] = plan_cache.stats()
     except Exception:
         traceback.print_exc(file=sys.stderr)
+    try:
+        # the always-on metrics registry (counters/gauges/histograms —
+        # telemetry/_metrics.py): the same numbers metrics_text() would
+        # expose to a scrape, embedded so scripts/axon_report.py can
+        # roll sessions up without a live process
+        from sparse_tpu.telemetry import _metrics
+
+        entry["metrics"] = _metrics.snapshot()
+    except Exception:
+        traceback.print_exc(file=sys.stderr)
     _log_hw_record(entry)
 
 

@@ -1,0 +1,402 @@
+#!/usr/bin/env python
+"""Offline session analyzer: join records.jsonl with BENCH_r*.json,
+roll up latencies / comm volumes / cache behavior / anomalies, and
+(optionally) flag regressions against a baseline report.
+
+Usage:
+    python scripts/axon_report.py [records.jsonl]
+        [--bench BENCH_r05.json ...]   # join bench evidence files
+        [--json OUT.json]              # write the machine report
+        [--compare BASELINE.json]      # a report written by --json
+        [--threshold 0.2]              # relative regression gate
+        [--quiet]
+
+Exit codes: 0 = ok, 1 = regressions found (--compare), 2 = bad usage /
+missing input — so ``axon_report --compare`` gates CI directly.
+
+Pure-stdlib on purpose: no sparse_tpu import, no jax init — the report
+reads the same JSONL/JSON artifacts the repo already commits, in
+milliseconds (the quick-lane smoke runs it against the committed
+``results/axon/records.jsonl`` every test run).
+
+The comparable surface is ``report["metrics"]``: a flat
+``{name: {"v": value, "hib": higher_is_better}}`` dict covering span
+latencies (p50/p95), per-solver iteration means, comm volumes, anomaly
+counts and joined bench metric values. ``--compare`` flags any metric
+that moved against its direction by more than ``--threshold``
+(relative) and exits 1.
+"""
+
+from __future__ import annotations
+
+import glob as _glob
+import json
+import os
+import sys
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+DEFAULT_RECORDS = os.path.join(REPO, "results", "axon", "records.jsonl")
+
+
+# ---------------------------------------------------------------------------
+# loading
+# ---------------------------------------------------------------------------
+def load_records(path: str) -> tuple:
+    """(telemetry events, bench hardware-metric records) of a session
+    log; unparseable lines are skipped (evidence files survive partial
+    writes)."""
+    events, hw = [], []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if not isinstance(rec, dict):
+                continue
+            if "kind" in rec:
+                events.append(rec)
+            elif isinstance(rec.get("metric"), str):
+                hw.append(rec)
+    return events, hw
+
+
+def load_bench_files(paths) -> list:
+    """``{"metric", "value", "unit", "source"}`` rows from BENCH_r*.json
+    style evidence files (the committed round artifacts: a ``parsed``
+    metric dict per file)."""
+    rows = []
+    for path in paths:
+        try:
+            data = json.load(open(path))
+        except (OSError, json.JSONDecodeError):
+            continue
+        parsed = data.get("parsed") if isinstance(data, dict) else None
+        if isinstance(parsed, dict) and isinstance(parsed.get("metric"), str):
+            rows.append({
+                "metric": parsed["metric"],
+                "value": parsed.get("value"),
+                "unit": parsed.get("unit"),
+                "source": os.path.basename(path),
+            })
+    return rows
+
+
+# ---------------------------------------------------------------------------
+# rollups
+# ---------------------------------------------------------------------------
+def _percentile(sorted_vals: list, q: float) -> float:
+    if not sorted_vals:
+        return 0.0
+    idx = min(int(q * (len(sorted_vals) - 1) + 0.5), len(sorted_vals) - 1)
+    return sorted_vals[idx]
+
+
+def _num(v):
+    return v if isinstance(v, (int, float)) and not isinstance(v, bool) else None
+
+
+def build_report(records_path: str, bench_paths=()) -> dict:
+    """The whole analysis as one JSON-serializable dict (see module
+    docstring for the ``metrics`` comparison surface)."""
+    events, hw = load_records(records_path)
+
+    by_kind: dict = {}
+    for e in events:
+        by_kind[e["kind"]] = by_kind.get(e["kind"], 0) + 1
+
+    # span latency table (from span events; the in-memory aggregates are
+    # not in the log — the events are)
+    span_durs: dict = {}
+    for e in events:
+        if e.get("kind") == "span" and _num(e.get("dur_s")) is not None:
+            span_durs.setdefault(str(e.get("name", "?")), []).append(
+                float(e["dur_s"])
+            )
+    spans = {}
+    for name, durs in sorted(span_durs.items()):
+        ds = sorted(durs)
+        spans[name] = {
+            "n": len(ds),
+            "total_s": round(sum(ds), 6),
+            "p50_s": round(_percentile(ds, 0.50), 9),
+            "p95_s": round(_percentile(ds, 0.95), 9),
+            "max_s": round(ds[-1], 9),
+        }
+
+    # per-solver rollup from solver.solve events
+    solvers: dict = {}
+    for e in events:
+        if e.get("kind") != "solver.solve":
+            continue
+        s = solvers.setdefault(str(e.get("solver", "?")), {
+            "solves": 0, "iters_total": 0, "paths": {},
+        })
+        s["solves"] += 1
+        it = _num(e.get("iters"))
+        s["iters_total"] += int(it) if it is not None else 0
+        p = str(e.get("path", "?"))
+        s["paths"][p] = s["paths"].get(p, 0) + 1
+    for s in solvers.values():
+        s["iters_mean"] = round(
+            s["iters_total"] / s["solves"], 3
+        ) if s["solves"] else 0.0
+
+    # structural comm volumes
+    comm_bytes: dict = {}
+    for e in events:
+        b = _num(e.get("bytes"))
+        if b is not None and str(e.get("kind", "")).startswith("comm."):
+            comm_bytes[e["kind"]] = comm_bytes.get(e["kind"], 0) + int(b)
+
+    # plan-cache behavior: the last session embed is the session total;
+    # batch.dispatch deltas attribute movement to the solve service
+    sessions = [e for e in events if e.get("kind") == "bench.session"]
+    cache = {"session": None, "batch_dispatch_delta": None}
+    if sessions:
+        last = max(sessions, key=lambda e: e.get("ts", 0))
+        pc = last.get("plan_cache")
+        if isinstance(pc, dict):
+            cache["session"] = pc
+    deltas = [
+        e.get("plan_cache") for e in events
+        if e.get("kind") == "batch.dispatch"
+        and isinstance(e.get("plan_cache"), dict)
+    ]
+    if deltas:
+        agg: dict = {}
+        for d in deltas:
+            for k, v in d.items():
+                if _num(v) is not None:
+                    agg[k] = agg.get(k, 0) + v
+        cache["batch_dispatch_delta"] = agg
+
+    anomalies = [
+        {k: e.get(k) for k in ("ts", "solver", "reason", "iter", "lane",
+                               "resid2", "path") if k in e}
+        for e in events if e.get("kind") == "solver.anomaly"
+    ]
+
+    bench_rows = load_bench_files(bench_paths)
+    for e in sessions:
+        rec = e.get("record")
+        if isinstance(rec, dict) and isinstance(rec.get("metric"), str):
+            bench_rows.append({
+                "metric": rec["metric"], "value": rec.get("value"),
+                "unit": rec.get("unit"), "source": "bench.session",
+            })
+    for rec in hw:
+        bench_rows.append({
+            "metric": rec["metric"], "value": rec.get("value"),
+            "unit": rec.get("unit"), "source": "records.jsonl",
+        })
+
+    # -- the flat comparison surface ----------------------------------------
+    metrics: dict = {}
+    for name, st in spans.items():
+        metrics[f"span.{name}.p50_s"] = {"v": st["p50_s"], "hib": False}
+        metrics[f"span.{name}.p95_s"] = {"v": st["p95_s"], "hib": False}
+    for name, s in solvers.items():
+        metrics[f"solver.{name}.iters_mean"] = {
+            "v": s["iters_mean"], "hib": False,
+        }
+    for kind, b in comm_bytes.items():
+        metrics[f"bytes.{kind}"] = {"v": b, "hib": False}
+    metrics["anomalies.count"] = {"v": len(anomalies), "hib": False}
+    if cache["session"] and _num(cache["session"].get("hit_rate")) is not None:
+        metrics["plan_cache.hit_rate"] = {
+            "v": cache["session"]["hit_rate"], "hib": True,
+        }
+    seen_bench = set()
+    for row in bench_rows:
+        v = _num(row.get("value"))
+        # first occurrence wins: explicit --bench files outrank embeds
+        if v is not None and row["metric"] not in seen_bench:
+            seen_bench.add(row["metric"])
+            metrics[f"bench.{row['metric']}"] = {"v": v, "hib": True}
+
+    return {
+        "records": os.path.relpath(records_path, REPO)
+        if records_path.startswith(REPO) else records_path,
+        "events_total": len(events),
+        "events_by_kind": dict(sorted(by_kind.items())),
+        "spans": spans,
+        "solvers": solvers,
+        "comm_bytes": comm_bytes,
+        "cache": cache,
+        "anomalies": anomalies[:100],
+        "bench": bench_rows,
+        "metrics": metrics,
+    }
+
+
+# ---------------------------------------------------------------------------
+# comparison
+# ---------------------------------------------------------------------------
+def compare(current: dict, baseline: dict, threshold: float = 0.2) -> list:
+    """Regressions of ``current`` vs ``baseline`` (both report dicts):
+    metrics present in both whose value moved AGAINST its direction by
+    more than ``threshold`` relative. Returns
+    ``[{metric, base, cur, delta_pct}, ...]``; improvements and new /
+    vanished metrics are never regressions."""
+    regressions = []
+    cur_m = current.get("metrics", {})
+    base_m = baseline.get("metrics", {})
+    for name in sorted(set(cur_m) & set(base_m)):
+        cv, bv = _num(cur_m[name].get("v")), _num(base_m[name].get("v"))
+        if cv is None or bv is None or bv == 0:
+            continue
+        hib = bool(cur_m[name].get("hib"))
+        rel = (cv - bv) / abs(bv)
+        worse = -rel if hib else rel
+        if worse > threshold:
+            regressions.append({
+                "metric": name,
+                "base": bv,
+                "cur": cv,
+                "delta_pct": round(rel * 100.0, 1),
+            })
+    return regressions
+
+
+# ---------------------------------------------------------------------------
+# CLI
+# ---------------------------------------------------------------------------
+def _print_report(rep: dict) -> None:
+    print(f"axon_report: {rep['records']} — {rep['events_total']} events")
+    if rep["events_by_kind"]:
+        print("  events by kind:")
+        for k, n in rep["events_by_kind"].items():
+            print(f"    {k:<22} {n}")
+    if rep["spans"]:
+        print("  spans (p50/p95/max seconds):")
+        for name, st in rep["spans"].items():
+            print(
+                f"    {name:<28} n={st['n']:<6} p50={st['p50_s']:.6f} "
+                f"p95={st['p95_s']:.6f} max={st['max_s']:.6f}"
+            )
+    if rep["solvers"]:
+        print("  solvers:")
+        for name, s in rep["solvers"].items():
+            print(
+                f"    {name:<12} solves={s['solves']:<5} "
+                f"iters_mean={s['iters_mean']:<8} paths={s['paths']}"
+            )
+    if rep["comm_bytes"]:
+        print("  comm volumes (structural bytes):")
+        for k, b in rep["comm_bytes"].items():
+            print(f"    {k:<22} {b}")
+    if rep["cache"]["session"]:
+        c = rep["cache"]["session"]
+        print(
+            f"  plan cache: hits={c.get('hits')} misses={c.get('misses')} "
+            f"hit_rate={c.get('hit_rate', 0):.4f}"
+        )
+    if rep["anomalies"]:
+        print(f"  anomalies ({len(rep['anomalies'])}):")
+        for a in rep["anomalies"][:10]:
+            print(
+                f"    {a.get('solver', '?'):<10} {a.get('reason', '?'):<12}"
+                f" iter={a.get('iter')} lane={a.get('lane')}"
+            )
+    if rep["bench"]:
+        print("  bench metrics:")
+        seen = set()
+        for row in rep["bench"]:
+            if row["metric"] in seen:
+                continue
+            seen.add(row["metric"])
+            print(
+                f"    {row['metric']:<34} {row['value']} {row['unit'] or ''}"
+                f"  [{row['source']}]"
+            )
+
+
+def main(argv) -> int:
+    if "--help" in argv or "-h" in argv:
+        print(__doc__)
+        return 0
+    args = list(argv)
+    quiet = "--quiet" in args
+    if quiet:
+        args.remove("--quiet")
+
+    def take(flag, default=None, many=False):
+        vals = []
+        while flag in args:
+            i = args.index(flag)
+            if i + 1 >= len(args):
+                print(f"axon_report: {flag} needs a value", file=sys.stderr)
+                raise SystemExit(2)
+            vals.append(args[i + 1])
+            del args[i:i + 2]
+        if many:
+            return vals
+        return vals[-1] if vals else default
+
+    bench_args = take("--bench", many=True)
+    out_json = take("--json")
+    baseline_path = take("--compare")
+    try:
+        threshold = float(take("--threshold", "0.2"))
+    except ValueError:
+        print("axon_report: --threshold must be a number", file=sys.stderr)
+        return 2
+    records = args[0] if args else DEFAULT_RECORDS
+    if not os.path.exists(records):
+        print(f"axon_report: no session log at {records}", file=sys.stderr)
+        return 2
+
+    bench_paths = []
+    for pat in bench_args:
+        hits = sorted(_glob.glob(pat))
+        bench_paths.extend(hits if hits else [pat])
+
+    rep = build_report(records, bench_paths)
+    if not quiet:
+        _print_report(rep)
+    if out_json:
+        d = os.path.dirname(os.path.abspath(out_json))
+        os.makedirs(d, exist_ok=True)
+        with open(out_json, "w") as f:
+            json.dump(rep, f, indent=1, sort_keys=True)
+            f.write("\n")
+        if not quiet:
+            print(f"  report -> {out_json}")
+
+    if baseline_path:
+        try:
+            baseline = json.load(open(baseline_path))
+        except (OSError, json.JSONDecodeError) as e:
+            print(f"axon_report: bad baseline {baseline_path}: {e}",
+                  file=sys.stderr)
+            return 2
+        regs = compare(rep, baseline, threshold)
+        if regs:
+            print(
+                f"axon_report: {len(regs)} regression(s) vs "
+                f"{os.path.basename(baseline_path)} "
+                f"(threshold {threshold:.0%}):",
+                file=sys.stderr,
+            )
+            for r in regs:
+                print(
+                    f"  REGRESSION {r['metric']}: {r['base']} -> {r['cur']} "
+                    f"({r['delta_pct']:+.1f}%)",
+                    file=sys.stderr,
+                )
+            return 1
+        if not quiet:
+            print(
+                f"  no regressions vs {os.path.basename(baseline_path)} "
+                f"(threshold {threshold:.0%})"
+            )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

@@ -1,0 +1,54 @@
+#!/usr/bin/env python
+"""Export a telemetry session log as Chrome-trace/Perfetto JSON.
+
+Usage:
+    python scripts/axon_trace.py [records.jsonl] [out.json]
+
+Defaults: ``results/axon/records.jsonl`` -> ``results/axon/trace.json``.
+Open the output in https://ui.perfetto.dev (or chrome://tracing) for
+the timeline view — one process lane per subsystem (solver, kernels,
+comm, plan_cache, batch, bench, spans), spans as nested slices,
+``resid2`` as a per-solver counter track (docs/telemetry.md).
+
+bench.py hardware metric records sharing the log (no ``kind`` field)
+are skipped by contract; a trimmed/partial session exports fine.
+"""
+
+import os
+import sys
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+DEFAULT_IN = os.path.join(REPO, "results", "axon", "records.jsonl")
+DEFAULT_OUT = os.path.join(REPO, "results", "axon", "trace.json")
+
+
+def main(argv) -> int:
+    args = [a for a in argv if not a.startswith("-")]
+    if "--help" in argv or "-h" in argv:
+        print(__doc__)
+        return 0
+    src = args[0] if len(args) > 0 else DEFAULT_IN
+    out = args[1] if len(args) > 1 else DEFAULT_OUT
+    if not os.path.exists(src):
+        print(f"axon_trace: no session log at {src}", file=sys.stderr)
+        return 2
+
+    sys.path.insert(0, REPO)
+    from sparse_tpu.telemetry import _trace
+
+    events = _trace.read_events_jsonl(src)
+    if not events:
+        print(f"axon_trace: {src} holds no telemetry events", file=sys.stderr)
+        return 1
+    _trace.export_trace(out, events=events)
+    spans = sum(1 for e in events if e.get("kind") == "span")
+    print(
+        f"axon_trace: {len(events)} events ({spans} spans) -> {out}\n"
+        "open in https://ui.perfetto.dev"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

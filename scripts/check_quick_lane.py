@@ -35,6 +35,16 @@ TESTS = os.path.join(REPO, "tests")
 CONFTEST = os.path.join(TESTS, "conftest.py")
 MANIFEST = os.path.join(TESTS, "quick_lane_manifest.json")
 
+# CLI tooling the quick lane exercises (tests/test_axon_report.py loads
+# these by path): a rename/deletion must fail here, not at collection
+# time inside an importlib call with a cryptic spec error.
+_REQUIRED_SCRIPTS = (
+    "axon_report.py",
+    "axon_trace.py",
+    "check_quick_lane.py",
+    "trim_records.py",
+)
+
 
 def quick_files() -> set:
     """The ``_QUICK_FILES`` set, read by ast (importing conftest mutates
@@ -75,9 +85,28 @@ def current_counts() -> dict:
     }
 
 
+def check_scripts() -> list:
+    """Tooling integrity: every required script exists and parses
+    (pure-ast, same zero-import discipline as the test counter)."""
+    problems = []
+    for name in _REQUIRED_SCRIPTS:
+        path = os.path.join(HERE, name)
+        if not os.path.exists(path):
+            problems.append(
+                f"scripts/{name} is required by the quick lane but missing "
+                "(renamed without updating check_quick_lane._REQUIRED_SCRIPTS?)"
+            )
+            continue
+        try:
+            ast.parse(open(path).read(), filename=path)
+        except SyntaxError as e:
+            problems.append(f"scripts/{name} does not parse: {e}")
+    return problems
+
+
 def check() -> list:
     """Returns a list of problem strings (empty = lane intact)."""
-    problems = []
+    problems = check_scripts()
     files = quick_files()
     for f in sorted(files):
         if not os.path.exists(os.path.join(TESTS, f)):

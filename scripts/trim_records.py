@@ -20,8 +20,70 @@ import os
 import sys
 
 HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
 RECORDS = os.path.join(HERE, "..", "results", "axon", "records.jsonl")
 SLACK_S = 120.0  # clock slack around the session window
+
+
+def _roundtrip_ok(kept, original) -> bool:
+    """The trimmed log must still round-trip through the schema
+    validator and the Chrome-trace exporter (line renumbering and
+    partial sessions are exactly where a naive trim corrupts the log).
+
+    Trimming only removes whole lines, so the kept lines' schema
+    problems must be a subset of the original's (a pre-existing bad
+    line that survives the window is evidence, not a trim failure) and
+    ``export_trace``'s builder must accept the kept events. Returns
+    False — caller aborts the rewrite — on any new problem. Skipped
+    (True, with a note) when sparse_tpu isn't importable."""
+    try:
+        sys.path.insert(0, REPO)
+        from sparse_tpu.telemetry import _schema, _trace
+    except Exception as e:  # no jax in this interpreter: don't block a trim
+        print(f"trim_records: round-trip check skipped ({e!r})")
+        return True
+
+    def problems_by_line(lines):
+        bad = {}
+        for ln in lines:
+            try:
+                ev = json.loads(ln)
+            except json.JSONDecodeError:
+                continue
+            if not isinstance(ev, dict) or "kind" not in ev:
+                continue  # bench metric record: not a telemetry event
+            probs = _schema.validate(ev)
+            if probs:
+                bad[ln] = tuple(probs)
+        return bad
+
+    orig_bad = problems_by_line(original)
+    new_bad = {
+        ln: p for ln, p in problems_by_line(kept).items()
+        if ln not in orig_bad
+    }
+    if new_bad:
+        print(
+            f"trim_records: ABORT — trim would introduce {len(new_bad)} "
+            "schema problem(s) the original log did not have"
+        )
+        return False
+    try:
+        events = []
+        for ln in kept:
+            try:
+                ev = json.loads(ln)
+            except json.JSONDecodeError:
+                continue
+            if isinstance(ev, dict) and "kind" in ev:
+                events.append(ev)
+        trace = _trace.to_chrome_trace(events)
+        if "traceEvents" not in trace:
+            raise ValueError("no traceEvents in export")
+    except Exception as e:
+        print(f"trim_records: ABORT — trimmed log fails trace export ({e!r})")
+        return False
+    return True
 
 
 def trim(path: str = RECORDS, dry_run: bool = False) -> int:
@@ -75,6 +137,11 @@ def trim(path: str = RECORDS, dry_run: bool = False) -> int:
         f"(dropped {dropped}; window starts {start:.0f})"
     )
     if dropped and not dry_run:
+        if not _roundtrip_ok(kept, lines):
+            return 0  # keep the original log untouched
+        # the log's directory can be absent in a fresh checkout that
+        # never ran bench (results/axon is created lazily by the sink)
+        os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
         with open(path, "w") as f:
             f.write("\n".join(kept) + "\n")
     return dropped

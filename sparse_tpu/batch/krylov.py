@@ -99,6 +99,30 @@ def _solve_event(solver: str, info: BatchedSolveInfo, n: int) -> None:
         iters_mean=float(iters.mean()) if iters.size else 0.0,
         converged=int(np.asarray(info.converged).sum()),
     )
+    # final per-lane health sweep (NaN lanes flag even when the per-iter
+    # taps were off, e.g. on TPU backends)
+    telemetry.health.end_batch(
+        solver, iters, np.asarray(info.resid2), np.asarray(info.converged)
+    )
+
+
+def _make_lanes_tap(solver: str):
+    """Per-iteration (iter, per-lane ||r||^2, per-lane tol^2) tap for the
+    masked compiled loops, or None when off — the batched analog of
+    ``linalg._make_iter_tap``, with the same CPU-backend-only discipline
+    (host callbacks out of device loops are the remote-tunnel wedge
+    class). Feeds the health monitor's per-lane detectors; converged
+    (frozen) lanes are masked by their tolerance inside ``observe_lanes``
+    so a finished lane's bit-stable residual never reads as stagnation."""
+    if not telemetry.enabled() or jax.default_backend() != "cpu":
+        return None
+
+    def tap(k, rn2, tol2):
+        telemetry.health.observe_lanes(
+            solver, int(k), np.asarray(rn2), np.asarray(tol2)
+        )
+
+    return tap
 
 
 # ---------------------------------------------------------------------------
@@ -114,6 +138,7 @@ def _cg_loop(matvec, b, X0, tol, maxiter, conv_test_iters, Mvec=None):
     tol2 = tol.astype(jnp.real(b).dtype) ** 2
     B = b.shape[0]
     cti = max(int(conv_test_iters), 1)
+    tap = _make_lanes_tap("cg")
     X = X0
     R = b - matvec(X)
     P = jnp.zeros_like(b)
@@ -138,6 +163,8 @@ def _cg_loop(matvec, b, X0, tol, maxiter, conv_test_iters, Mvec=None):
         iters = iters + active.astype(jnp.int32)
         k = k + 1
         rn2 = jnp.real(_bdot(R, R))
+        if tap is not None:
+            jax.debug.callback(tap, k, rn2, tol2)
         tested = (k % cti == 0) | (k == maxiter - 1)
         active = active & ~(tested & (rn2 < tol2))
         return X, R, P, rho, active, iters, k
@@ -181,6 +208,7 @@ def _bicgstab_loop(matvec, b, X0, tol, maxiter, conv_test_iters):
     tol2 = tol.astype(jnp.real(b).dtype) ** 2
     B = b.shape[0]
     cti = max(int(conv_test_iters), 1)
+    tap = _make_lanes_tap("bicgstab")
     X = X0
     R = b - matvec(X)
     Rt = R
@@ -217,6 +245,8 @@ def _bicgstab_loop(matvec, b, X0, tol, maxiter, conv_test_iters):
         iters = iters + active.astype(jnp.int32)
         k = k + 1
         rn2 = jnp.real(_bdot(R, R))
+        if tap is not None:
+            jax.debug.callback(tap, k, rn2, tol2)
         tested = (k % cti == 0) | (k == maxiter - 1)
         active = active & ~(tested & (rn2 < tol2))
         return X, R, P, V, rho, alpha, omega, active, iters, k
@@ -418,9 +448,17 @@ def batched_gmres(A, b, x0=None, tol=1e-08, restart=None, maxiter=None,
     iters = np.zeros((B,), dtype=np.int64)
     lane_done = np.zeros((B,), dtype=bool)
     beta_last = np.zeros((B,), dtype=np.float64)
+    tol2_h = np.asarray(target, dtype=np.float64) ** 2 if telemetry.enabled() else None
     for _outer in range(int(maxiter)):
         X, info = cycle(X, b, target)
         info_h = np.asarray(info)  # ONE host sync per restart cycle
+        if tol2_h is not None:
+            # per-lane entry residuals the cycle already fetched, squared
+            # to the health monitor's resid2 convention — cycle granularity
+            telemetry.health.observe_lanes(
+                "gmres", _outer + 1, info_h[:, 1].astype(np.float64) ** 2,
+                tol2_h,
+            )
         inner = info_h[:, 0].astype(np.int64)
         beta_last = np.where(lane_done, beta_last, info_h[:, 1])
         bdown = info_h[:, 2] > 0

@@ -31,11 +31,20 @@ import numpy as np
 from .. import plan_cache, telemetry
 from ..config import settings
 from ..ops import spmv as spmv_ops
+from ..telemetry import _metrics
 from . import bucket as bucketing
 from . import krylov
 from .operator import BatchedCSR, SparsityPattern
 
 _SOLVERS = ("cg", "bicgstab", "gmres")
+
+# Always-on session levels (telemetry/_metrics.py — scrapeable via
+# telemetry.metrics_text()): queued-request depth across all live
+# sessions, real-lanes-per-bucket occupancy ratio, and dispatch count.
+_QUEUE_DEPTH = _metrics.gauge("batch.queue_depth")
+_BUCKET_OCCUPANCY = _metrics.histogram("batch.bucket_occupancy")
+_DISPATCHES = _metrics.counter("batch.dispatches")
+_PAD_WASTE = _metrics.counter("batch.pad_lanes")
 
 
 class SolveTicket:
@@ -143,6 +152,7 @@ class SolveSession:
         t = SolveTicket(self)
         q = self._pending.setdefault(id(pattern), [])
         q.append(_Request(pattern, values, b, float(tol), x0, maxiter, t))
+        _QUEUE_DEPTH.inc()
         if self.auto_flush is not None and len(q) >= self.auto_flush:
             self.flush()
         return t
@@ -173,6 +183,7 @@ class SolveSession:
         ``batch_max``-sized chunks, pads each chunk to its bucket."""
         dispatched = 0
         pending, self._pending = self._pending, {}
+        _QUEUE_DEPTH.dec(sum(len(q) for q in pending.values()))
         for q in pending.values():
             # one group per result dtype so stacked values are homogeneous
             by_dt: dict = {}
@@ -226,6 +237,9 @@ class SolveSession:
         for i, r in enumerate(reqs):
             r.ticket._set(X[i], iters[i], resid2[i], conv[i])
         self.dispatches += 1
+        _DISPATCHES.inc()
+        _BUCKET_OCCUPANCY.observe(nb / bkt)
+        _PAD_WASTE.inc(bkt - nb)
         if telemetry.enabled():
             q_ms = [
                 (t0 - r.ticket.t_submit) * 1e3 for r in reqs

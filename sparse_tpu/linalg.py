@@ -353,13 +353,16 @@ def _vdot(a, b):
 
 
 def _solve_event(solver: str, n, iters, path: str, resid2=None) -> None:
-    """One ``solver.solve`` event per completed solve (any path)."""
+    """One ``solver.solve`` event per completed solve (any path); also
+    finalizes the health monitor's report for this solve
+    (``telemetry.last_solve_report()``)."""
     if not telemetry.enabled():
         return
     fields = {"solver": solver, "n": int(n), "iters": int(iters), "path": path}
     if resid2 is not None:
         fields["resid2"] = float(resid2)
     telemetry.record("solver.solve", **fields)
+    telemetry.health.end_solve(solver, iters, resid2=resid2, path=path)
 
 
 def _make_iter_tap(solver: str, path: str = "device"):
@@ -378,6 +381,9 @@ def _make_iter_tap(solver: str, path: str = "device"):
             "solver.iter", solver=solver, path=path,
             iter=int(i), resid2=float(rn2),
         )
+        # same concrete scalars feed the health monitor's residual
+        # history + NaN/stall/divergence detectors (telemetry/_health.py)
+        telemetry.health.observe(solver, int(i), float(rn2), path=path)
 
     return tap
 
@@ -552,6 +558,7 @@ def _try_fused_cg(A, b, x0, tol, maxiter, conv_test_iters):
                 "solver.iter", solver="cg", path="fused", iter=iters,
                 resid2=rho_f, chunk=k,
             )
+            telemetry.health.observe("cg", iters, rho_f, path="fused")
         if rho_f < tol2 or not np.isfinite(rho_f):
             break
     return x, iters
@@ -628,10 +635,12 @@ def _cg_host_loop(A, b, x, tol, maxiter, M, callback, conv_test_iters):
             # event rather than change where/whether the loop fails (the
             # loop's own conv-test float() governs, telemetry never does)
             if not in_trace():
+                rn2 = float(jnp.real(_vdot(r, r)))
                 telemetry.record(
                     "solver.iter", solver="cg", path="host", iter=iters,
-                    resid2=float(jnp.real(_vdot(r, r))),
+                    resid2=rn2,
                 )
+                telemetry.health.observe("cg", iters, rn2, path="host")
         if callback is not None:
             callback(x)
         if (iters % conv_test_iters == 0 or iters == maxiter - 1) and float(
@@ -912,6 +921,12 @@ def gmres(
                     "solver.iter", solver="gmres", path="device",
                     iter=total_iters, resid=float(abs(_beta)), inner=inner,
                 )
+                # cycle granularity: the entry residual the cycle already
+                # fetched, squared to the monitor's resid2 convention
+                telemetry.health.observe(
+                    "gmres", total_iters, float(abs(_beta)) ** 2,
+                    path="device",
+                )
             if callback is not None:
                 callback(x)
         _solve_event("gmres", n, total_iters, "device")
@@ -935,6 +950,9 @@ def gmres(
             telemetry.record(
                 "solver.iter", solver="gmres", path="host",
                 iter=total_iters, resid=float(beta), inner=inner,
+            )
+            telemetry.health.observe(
+                "gmres", total_iters, float(beta) ** 2, path="host"
             )
         if callback is not None:
             callback(x)

@@ -888,6 +888,12 @@ def dist_cg(
             "solver.solve", solver="dist_cg", n=int(A.shape[0]),
             iters=iters, path="device", converged=converged,
         )
+        # the compiled mesh loop has no per-iteration visibility, but the
+        # health monitor still closes a report (outcome + anomaly sweep
+        # on the final residual) so last_solve_report() covers dist too
+        telemetry.health.end_solve(
+            "dist_cg", iters, converged=converged, path="device"
+        )
     return xp, iters, converged
 
 

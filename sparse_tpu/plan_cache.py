@@ -19,9 +19,13 @@ Design:
   cached (every lookup builds).
 * **Bounded.** LRU over ``settings.plan_cache_capacity`` (object, kind)
   entries; eviction is counted.
-* **Observable.** Hit/miss/evict counters are always maintained (plain
-  ints, no I/O) and surfaced via :func:`stats`; with telemetry enabled
-  they also mirror into ``telemetry.summary()["counts"]`` under
+* **Observable.** Hit/miss/evict counters are always maintained and
+  surfaced via :func:`stats`; they live on the always-on metrics
+  registry (``telemetry/_metrics.py`` — ``plan_cache.hits`` /
+  ``plan_cache.misses`` / ``plan_cache.evictions`` counters plus a lazy
+  ``plan_cache.size`` gauge, all visible in
+  ``telemetry.metrics_text()``). With telemetry enabled they also
+  mirror into ``telemetry.summary()["counts"]`` under
   ``plan_cache.hit`` / ``plan_cache.miss`` / ``plan_cache.evict``
   (docs/telemetry.md).
 * **Switchable.** ``SPARSE_TPU_PLAN_CACHE=0`` (``settings.plan_cache``)
@@ -36,18 +40,27 @@ import weakref
 from collections import OrderedDict
 
 from .config import settings
+from .telemetry import _metrics
 
 _LOCK = threading.RLock()
 # (id(obj), kind) -> (weakref | None, plan); OrderedDict for LRU order
 _ENTRIES: OrderedDict = OrderedDict()
 _FINALIZERS: dict[int, object] = {}  # id(obj) -> weakref.finalize handle
-_STATS = {"hits": 0, "misses": 0, "evictions": 0}
+# the always-on counters live on the metrics registry (one metrics
+# surface — telemetry.metrics_text() exposes them as
+# sparse_tpu_plan_cache_{hits,misses,evictions}_total + a size gauge)
+_COUNTERS = {
+    "hits": _metrics.counter("plan_cache.hits"),
+    "misses": _metrics.counter("plan_cache.misses"),
+    "evictions": _metrics.counter("plan_cache.evictions"),
+}
+_metrics.gauge("plan_cache.size", fn=lambda: len(_ENTRIES))
 _TELEMETRY_NAMES = {"hits": "plan_cache.hit", "misses": "plan_cache.miss",
                     "evictions": "plan_cache.evict"}
 
 
 def _count(which: str) -> None:
-    _STATS[which] += 1
+    _COUNTERS[which].inc()
     if settings.telemetry:
         from . import telemetry
 
@@ -140,9 +153,11 @@ def invalidate(obj, kind: str | None = None) -> None:
 
 
 def stats() -> dict:
-    """Always-on counters: ``{hits, misses, evictions, size, hit_rate}``."""
+    """Always-on counters: ``{hits, misses, evictions, size, hit_rate}``
+    (read back from the metrics registry — same numbers a Prometheus
+    scrape of ``telemetry.metrics_text()`` sees)."""
     with _LOCK:
-        out = dict(_STATS)
+        out = {k: int(c.value) for k, c in _COUNTERS.items()}
         out["size"] = len(_ENTRIES)
     total = out["hits"] + out["misses"]
     out["hit_rate"] = out["hits"] / total if total else 0.0
@@ -154,21 +169,21 @@ def snapshot() -> dict:
     global reset (bench rows, ``batch.SolveSession`` dispatch telemetry —
     concurrent users must not clobber each other's baselines)."""
     with _LOCK:
-        return dict(_STATS)
+        return {k: int(c.value) for k, c in _COUNTERS.items()}
 
 
 def delta(since: dict) -> dict:
     """Counter movement since a :func:`snapshot`:
     ``{hits, misses, evictions}``."""
     with _LOCK:
-        return {k: _STATS[k] - since.get(k, 0)
+        return {k: int(_COUNTERS[k].value) - since.get(k, 0)
                 for k in ("hits", "misses", "evictions")}
 
 
 def reset_stats() -> None:
     with _LOCK:
-        for k in _STATS:
-            _STATS[k] = 0
+        for c in _COUNTERS.values():
+            c.reset()
 
 
 def clear() -> None:

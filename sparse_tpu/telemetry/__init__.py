@@ -12,14 +12,25 @@ Surface
   a bounded in-memory ring + the JSONL session log
   (``results/axon/records.jsonl``, shared with bench.py's
   hardware-evidence records). Zero overhead when disabled.
-* :func:`count` / :func:`add_bytes` — in-memory counters for hot paths
-  (kernel dispatches, host syncs, per-SpMV comm volumes) where an event
-  per call would flood the log.
+* :func:`count` / :func:`add_bytes` — hot-path counters (kernel
+  dispatches, host syncs, per-SpMV comm volumes) where an event per
+  call would flood the log; stored on the metrics registry.
 * :func:`span` — scoped wall-clock + optional device-sync timer
   (``with span("cg.iter"): ...``). Trace-safe: a shared no-op inside
   ``jit``/``scan`` traces; ``block_until_ready`` only at span exit.
 * :func:`summary` — counts, per-kind event totals, span p50/p95
-  latencies, bytes moved per collective family.
+  latencies, bytes moved per collective family, ring drop count.
+* :mod:`metrics <._metrics>` — the ALWAYS-ON registry (counters,
+  gauges, log-bucket histograms) behind the plan-cache stats, recorder
+  counters and SolveSession levels; :func:`metrics_text` is its
+  Prometheus text exposition.
+* :func:`export_trace` — Chrome-trace/Perfetto JSON of the session
+  (lanes per subsystem, nested spans) — ``scripts/axon_trace.py`` is
+  the CLI over a records.jsonl.
+* :mod:`health <._health>` — solver health monitor: bounded residual
+  histories, NaN/stagnation/divergence detectors emitting
+  ``solver.anomaly`` events; :func:`last_solve_report` returns the most
+  recent solve's forensics.
 * :func:`events` / :func:`reset` / :func:`configure` / :func:`flush` —
   ring snapshot, state reset, sink redirection, sink flush.
 * ``schema`` (module) — the event-kind table + ``validate`` /
@@ -27,11 +38,17 @@ Surface
 
 Enabled by ``SPARSE_TPU_TELEMETRY=1`` (or ``settings.telemetry = True``);
 sink override via ``SPARSE_TPU_TELEMETRY_PATH`` / :func:`configure`.
+The metrics registry alone is always on (plain int bumps — the plan
+cache has counted that way since PR 2).
 """
 
 from __future__ import annotations
 
+from . import _health as health  # noqa: F401
+from . import _metrics as metrics  # noqa: F401
 from . import _schema as schema  # noqa: F401
+from ._health import last_solve_report  # noqa: F401
+from ._metrics import metrics_text  # noqa: F401
 from ._recorder import (  # noqa: F401
     add_bytes,
     add_span,
@@ -39,15 +56,28 @@ from ._recorder import (  # noqa: F401
     configure,
     count,
     counters,
+    dropped,
     enabled,
     events,
     flush,
     record,
-    reset,
     sink_path,
 )
+from ._recorder import reset as _reset_recorder
 from ._spans import Span, device_sync, span  # noqa: F401
 from ._summary import summary  # noqa: F401
+from ._trace import export_trace, to_chrome_trace  # noqa: F401
+
+
+def reset() -> None:
+    """Clear the in-memory state: ring, counters, byte totals, span
+    aggregates, drop count and the health monitor's solve reports (the
+    JSONL sink file is untouched — it is an append-only session log).
+    The always-on metrics families owned by other modules (plan cache,
+    batch service) keep their values; reset those at their owners."""
+    _reset_recorder()
+    health.reset()
+
 
 __all__ = [
     "add_bytes",
@@ -57,9 +87,15 @@ __all__ = [
     "count",
     "counters",
     "device_sync",
+    "dropped",
     "enabled",
     "events",
+    "export_trace",
     "flush",
+    "health",
+    "last_solve_report",
+    "metrics",
+    "metrics_text",
     "record",
     "reset",
     "schema",
@@ -67,4 +103,5 @@ __all__ = [
     "span",
     "Span",
     "summary",
+    "to_chrome_trace",
 ]

@@ -1,0 +1,278 @@
+"""Solver health monitoring: residual forensics for every Krylov solve.
+
+The convergence questions a serving stack actually asks — "did that
+solve blow up, stall, or diverge, and when" — need more than the final
+iteration count. This module rides the *existing* per-iteration
+telemetry taps (``linalg._make_iter_tap``, the fused-CG chunk fetch,
+GMRES cycle fetches, and the batched loops' lane taps) to keep a
+bounded residual history per solve, run three detectors, and emit
+structured ``solver.anomaly`` events:
+
+* **nonfinite** — ``||r||^2`` went NaN/Inf (breakdown, bad operator
+  data, overflow);
+* **divergence** — the residual grew ``DIVERGENCE_FACTOR`` past the
+  best value seen (the solve is actively getting worse);
+* **stagnation** — no meaningful improvement (relative ``STALL_RTOL``)
+  for ``STALL_WINDOW`` consecutive observed iterations (singular or
+  indefinite systems grinding to maxiter).
+
+Each anomaly fires at most once per (reason, lane) per solve — a
+diverging 10k-iteration solve is one event, not 10k — and also bumps
+the always-on ``solver.anomalies`` metrics counters
+(:mod:`._metrics`), so anomaly *counts* are scrapeable even when the
+event ring has rotated. ``telemetry.last_solve_report()`` returns the
+most recent solve's full report (history, anomalies, outcome).
+
+Zero overhead when telemetry is off: every entry point's first
+statement is the one ``settings.telemetry`` attribute check, and the
+taps feeding this module only exist in instrumented traces.
+"""
+
+from __future__ import annotations
+
+import collections
+import math
+import threading
+
+import numpy as np
+
+from ..config import settings
+from . import _metrics, _recorder
+
+#: max (iter, resid2) points kept per solve report
+HISTORY_MAX = 256
+#: iterations without meaningful improvement before "stagnation"
+STALL_WINDOW = 40
+#: relative improvement below this does not reset the stall window
+STALL_RTOL = 1e-4
+#: resid2 growth over the best seen that flags "divergence" (~1e4x ||r||)
+DIVERGENCE_FACTOR = 1e8
+
+_LOCK = threading.RLock()
+_CURRENT = None
+_LAST = None
+
+# registered at import (telemetry/__init__ imports this module), so the
+# anomaly counter is present in metrics_text() from the first scrape
+_ANOMALIES = _metrics.counter("solver.anomalies")
+
+
+class _Report:
+    """Mutable per-solve state; dict-ified by :func:`last_solve_report`."""
+
+    __slots__ = (
+        "solver", "path", "lanes", "history", "best", "best_iter",
+        "last_iter", "anomalies", "iters", "final_resid2", "converged",
+        "_flags",
+    )
+
+    def __init__(self, solver: str, path: str, lanes: int | None = None):
+        self.solver = solver
+        self.path = path
+        self.lanes = lanes
+        self.history = collections.deque(maxlen=HISTORY_MAX)
+        # scalars for unbatched solves; numpy arrays for lane stacks
+        self.best = None
+        self.best_iter = None
+        self.last_iter = -1
+        self.anomalies = []
+        self.iters = None
+        self.final_resid2 = None
+        self.converged = None
+        self._flags = set()
+
+    def as_dict(self) -> dict:
+        return {
+            "solver": self.solver,
+            "path": self.path,
+            "lanes": self.lanes,
+            "iters": self.iters,
+            "final_resid2": self.final_resid2,
+            "converged": self.converged,
+            "anomalies": list(self.anomalies),
+            "resid_history": [tuple(p) for p in self.history],
+        }
+
+
+def _anomaly(rep: _Report, reason: str, it, resid2, lane=None) -> None:
+    """Record one anomaly, throttled to once per (reason, lane) per
+    solve; mirrors into the event stream and the metrics registry."""
+    key = (reason, lane)
+    if key in rep._flags:
+        return
+    rep._flags.add(key)
+    entry = {"reason": reason, "iter": it, "resid2": resid2}
+    if lane is not None:
+        entry["lane"] = lane
+    rep.anomalies.append(entry)
+    _ANOMALIES.inc()
+    _metrics.counter("solver.anomalies.by_reason", reason=reason).inc()
+    fields = {"solver": rep.solver, "reason": reason, "path": rep.path}
+    if it is not None:
+        fields["iter"] = int(it)
+    if resid2 is not None:
+        fields["resid2"] = resid2
+    if lane is not None:
+        fields["lane"] = int(lane)
+    _recorder.record("solver.anomaly", **fields)
+
+
+def _fresh(solver: str, path: str, lanes=None) -> _Report:
+    global _CURRENT, _LAST
+    rep = _Report(solver, path, lanes)
+    if _CURRENT is not None:
+        _LAST = _CURRENT
+    _CURRENT = rep
+    return rep
+
+
+def _current_for(solver: str, path: str, it, lanes=None) -> _Report:
+    """The active report, starting a new one when the observation can't
+    belong to the current solve (different solver/path, or the iteration
+    counter went backwards)."""
+    rep = _CURRENT
+    if (
+        rep is None
+        or rep.iters is not None  # previous solve already finalized
+        or rep.solver != solver
+        or rep.path != path
+        or rep.lanes != lanes
+        or (it is not None and it <= rep.last_iter)
+    ):
+        rep = _fresh(solver, path, lanes)
+    return rep
+
+
+def observe(solver: str, it: int, resid2: float, path: str = "device") -> None:
+    """One (iteration, ||r||^2) observation of an unbatched solve —
+    called from the solver loops' existing telemetry taps."""
+    if not settings.telemetry:
+        return
+    with _LOCK:
+        rep = _current_for(solver, path, it)
+        rep.last_iter = it
+        rep.history.append((int(it), float(resid2)))
+        if not math.isfinite(resid2):
+            _anomaly(rep, "nonfinite", it, float(resid2))
+            return
+        if rep.best is None or resid2 < rep.best * (1.0 - STALL_RTOL):
+            rep.best = float(resid2)
+            rep.best_iter = int(it)
+            return
+        if resid2 > rep.best * DIVERGENCE_FACTOR and rep.best > 0.0:
+            _anomaly(rep, "divergence", it, float(resid2))
+        if it - rep.best_iter >= STALL_WINDOW:
+            _anomaly(rep, "stagnation", it, float(resid2))
+
+
+def observe_lanes(
+    solver: str, it: int, resid2s, tol2s=None, path: str = "batched"
+) -> None:
+    """Per-lane observation of a batched solve (one call per iteration,
+    ``resid2s`` shaped ``(B,)``). Lanes already at their tolerance are
+    excluded from stall/divergence checks — converged lanes FREEZE in
+    the masked loops, which would otherwise read as stagnation."""
+    if not settings.telemetry:
+        return
+    r = np.asarray(resid2s, dtype=np.float64)
+    B = int(r.shape[0]) if r.ndim else 1
+    r = r.reshape((B,))
+    with _LOCK:
+        rep = _current_for(solver, path, it, lanes=B)
+        rep.last_iter = it
+        rep.history.append((int(it), float(np.nanmax(r))))
+        if rep.best is None:
+            rep.best = np.full((B,), np.inf)
+            rep.best_iter = np.zeros((B,), dtype=np.int64)
+        done = np.zeros((B,), dtype=bool)
+        if tol2s is not None:
+            t = np.asarray(tol2s, dtype=np.float64).reshape((-1,))
+            if t.shape[0] == B:
+                with np.errstate(invalid="ignore"):
+                    done = r <= t
+        finite = np.isfinite(r)
+        for lane in np.nonzero(~finite & ~done)[0]:
+            _anomaly(rep, "nonfinite", it, float(r[lane]), lane=int(lane))
+        with np.errstate(invalid="ignore"):
+            improved = finite & (r < rep.best * (1.0 - STALL_RTOL))
+        rep.best = np.where(improved, r, rep.best)
+        rep.best_iter = np.where(improved, it, rep.best_iter)
+        live = finite & ~done & ~improved
+        with np.errstate(invalid="ignore"):
+            diverged = live & (rep.best > 0) & (r > rep.best * DIVERGENCE_FACTOR)
+        for lane in np.nonzero(diverged)[0]:
+            _anomaly(rep, "divergence", it, float(r[lane]), lane=int(lane))
+        stalled = live & (it - rep.best_iter >= STALL_WINDOW)
+        for lane in np.nonzero(stalled)[0]:
+            _anomaly(rep, "stagnation", it, float(r[lane]), lane=int(lane))
+
+
+def end_solve(
+    solver: str, iters, resid2=None, converged=None, path: str = "device"
+) -> None:
+    """Finalize the active report at solve completion (called from the
+    ``solver.solve`` event sites). Runs a final nonfinite check so
+    solves with no per-iteration visibility (TPU device loops) still
+    flag a NaN outcome."""
+    if not settings.telemetry:
+        return
+    with _LOCK:
+        global _LAST, _CURRENT
+        rep = _CURRENT
+        if rep is None or rep.solver != solver or rep.iters is not None:
+            rep = _Report(solver, path)
+        rep.iters = int(iters) if iters is not None else None
+        if resid2 is not None:
+            rep.final_resid2 = float(resid2)
+            if not math.isfinite(rep.final_resid2):
+                _anomaly(rep, "nonfinite", rep.iters, rep.final_resid2)
+        if converged is not None:
+            rep.converged = bool(converged)
+        _LAST = rep
+        _CURRENT = None
+
+
+def end_batch(solver: str, iters, resid2s, converged, path: str = "batched") -> None:
+    """Finalize a batched solve from its per-lane outcome arrays: final
+    nonfinite sweep per lane, then the report closes like
+    :func:`end_solve`."""
+    if not settings.telemetry:
+        return
+    r = np.asarray(resid2s, dtype=np.float64).reshape((-1,))
+    it = np.asarray(iters).reshape((-1,))
+    conv = np.asarray(converged).reshape((-1,))
+    B = int(r.shape[0])
+    with _LOCK:
+        global _LAST, _CURRENT
+        rep = _CURRENT
+        if (
+            rep is None or rep.solver != solver or rep.iters is not None
+            or rep.lanes != B
+        ):
+            rep = _Report(solver, path, lanes=B)
+        for lane in np.nonzero(~np.isfinite(r))[0]:
+            _anomaly(
+                rep, "nonfinite", int(it[lane]), float(r[lane]),
+                lane=int(lane),
+            )
+        rep.iters = int(it.max(initial=0))
+        rep.final_resid2 = float(np.nanmax(r)) if B else None
+        rep.converged = bool(conv.all()) if B else None
+        _LAST = rep
+        _CURRENT = None
+
+
+def last_solve_report() -> dict | None:
+    """Dict view of the most recent solve's health report (the active
+    solve if one is mid-flight), or ``None`` when nothing was observed
+    (telemetry off, or no instrumented solve yet)."""
+    with _LOCK:
+        rep = _CURRENT if _CURRENT is not None else _LAST
+        return rep.as_dict() if rep is not None else None
+
+
+def reset() -> None:
+    global _CURRENT, _LAST
+    with _LOCK:
+        _CURRENT = None
+        _LAST = None

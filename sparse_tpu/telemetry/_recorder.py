@@ -30,11 +30,15 @@ import threading
 import time
 
 from ..config import settings
+from . import _metrics
 
 _LOCK = threading.RLock()
 _RING: collections.deque | None = None
-_COUNTS: dict[str, int] = {}
-_BYTES: dict[str, int] = {}
+# count()/add_bytes() live on the always-on metrics registry (one metrics
+# surface — telemetry/_metrics.py); these are the family names there.
+_COUNTS_METRIC = "telemetry.counts"
+_BYTES_METRIC = "telemetry.bytes"
+_DROPPED = 0  # events evicted from a full ring (satellite: overflow was silent)
 _SPANS: dict[str, list] = {}
 _SINK = None  # lazily-opened append-mode file object
 _SINK_FAILED = False
@@ -146,13 +150,17 @@ def record(kind: str, **fields):
     """
     if not settings.telemetry:
         return None
+    global _DROPPED
     ev = {"kind": kind, "ts": time.time()}
     ev.update(fields)
     with _LOCK:
-        _ring().append(ev)
+        ring = _ring()
+        if ring.maxlen is not None and len(ring) == ring.maxlen:
+            _DROPPED += 1  # the deque evicts silently; we don't
+        ring.append(ev)
         b = fields.get("bytes")
         if isinstance(b, (int, float)) and not isinstance(b, bool):
-            _BYTES[kind] = _BYTES.get(kind, 0) + int(b)
+            _metrics.counter(_BYTES_METRIC, kind=kind).add(int(b))
         _write(ev)
     return ev
 
@@ -160,21 +168,22 @@ def record(kind: str, **fields):
 def count(name: str, n: int = 1) -> None:
     """Bump an in-memory counter (no event, no I/O) — the cheap form for
     hot-path call counting (kernel dispatches, host syncs, public-API
-    provenance scopes). Surfaced by ``summary()["counts"]``."""
+    provenance scopes). Stored on the always-on metrics registry
+    (``telemetry.counts`` family — visible in ``metrics_text()``) and
+    surfaced by ``summary()["counts"]``."""
     if not settings.telemetry:
         return
-    with _LOCK:
-        _COUNTS[name] = _COUNTS.get(name, 0) + n
+    _metrics.counter(_COUNTS_METRIC, name=name).inc(n)
 
 
 def add_bytes(kind: str, n) -> None:
     """Accumulate structural comm volume without emitting an event — the
     per-SpMV counter form (an event per eager SpMV would flood the ring).
-    Totals appear in ``summary()["bytes_by_kind"]``."""
+    Totals appear in ``summary()["bytes_by_kind"]`` and as the
+    ``telemetry.bytes`` metrics family."""
     if not settings.telemetry:
         return
-    with _LOCK:
-        _BYTES[kind] = _BYTES.get(kind, 0) + int(n)
+    _metrics.counter(_BYTES_METRIC, kind=kind).add(int(n))
 
 
 def add_span(name: str, dur_s: float) -> None:
@@ -195,13 +204,24 @@ def events(kind: str | None = None) -> list:
 
 
 def counters() -> dict:
-    with _LOCK:
-        return dict(_COUNTS)
+    return {
+        k: int(v)
+        for k, v in _metrics.label_values(_COUNTS_METRIC, "name").items()
+    }
 
 
 def bytes_by_kind() -> dict:
+    return {
+        k: int(v)
+        for k, v in _metrics.label_values(_BYTES_METRIC, "kind").items()
+    }
+
+
+def dropped() -> int:
+    """Events silently evicted from the full ring since the last reset
+    (they are still in the JSONL sink when one is writable)."""
     with _LOCK:
-        return dict(_BYTES)
+        return _DROPPED
 
 
 def span_durations() -> dict:
@@ -221,11 +241,13 @@ def flush() -> None:
 
 
 def reset() -> None:
-    """Clear the ring, counters, byte totals and span aggregates (the
-    sink file is untouched — it is an append-only session log)."""
-    global _RING
+    """Clear the ring, counters, byte totals, drop count and span
+    aggregates (the sink file is untouched — it is an append-only
+    session log)."""
+    global _RING, _DROPPED
     with _LOCK:
         _RING = None
-        _COUNTS.clear()
-        _BYTES.clear()
+        _DROPPED = 0
+        _metrics.remove(_COUNTS_METRIC)
+        _metrics.remove(_BYTES_METRIC)
         _SPANS.clear()

@@ -21,6 +21,10 @@ KINDS: dict[str, frozenset] = {
     "solver.iter": frozenset({"solver", "iter"}),
     # one per completed solve, every path
     "solver.solve": frozenset({"solver", "iters", "path"}),
+    # a health-monitor detection (telemetry/_health.py): reason is
+    # 'nonfinite' | 'divergence' | 'stagnation'; batched solves add the
+    # lane index; at most one event per (reason, lane) per solve
+    "solver.anomaly": frozenset({"solver", "reason"}),
     # -- kernels (kernels/dia_spmv.py) -------------------------------------
     # a completed tile-autotune race: timings_us maps probed tile -> best
     # seconds-per-SpMV in microseconds; clock is 'compiled' | 'host'

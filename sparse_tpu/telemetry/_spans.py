@@ -71,7 +71,10 @@ class Span:
         return self
 
     def __exit__(self, exc_type, exc, tb):
-        if self._sync is not None and exc_type is None:
+        # the device sync stays best-effort on BOTH paths: a span exiting
+        # on an exception still blocks on work it registered (the timing
+        # is recorded either way, with the exception type in `error`)
+        if self._sync is not None:
             try:
                 import jax
 

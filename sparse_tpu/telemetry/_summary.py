@@ -27,6 +27,7 @@ def summary() -> dict:
         {
           "enabled": bool,
           "events": total events currently in the ring,
+          "dropped": events evicted from the full ring (0 = none lost),
           "events_by_kind": {kind: n},
           "counts": {name: n},              # count() counters
           "bytes_by_kind": {kind: bytes},   # structural comm volumes
@@ -54,6 +55,7 @@ def summary() -> dict:
     return {
         "enabled": _recorder.enabled(),
         "events": len(evs),
+        "dropped": _recorder.dropped(),
         "events_by_kind": by_kind,
         "counts": _recorder.counters(),
         "bytes_by_kind": _recorder.bytes_by_kind(),

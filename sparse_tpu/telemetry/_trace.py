@@ -1,0 +1,191 @@
+"""Chrome-trace / Perfetto export of a telemetry session.
+
+``telemetry.export_trace(path)`` (or ``scripts/axon_trace.py`` over a
+``records.jsonl``) writes the Trace Event Format JSON that
+``ui.perfetto.dev`` and ``chrome://tracing`` open directly — the
+timeline view the reference stack gets from Legion's profiler.
+
+Layout: one *process* lane per subsystem (solver, kernels, comm,
+plan_cache, batch, bench, spans) with named *thread* tracks inside it
+(per solver, per event kind, per span family). Mapping:
+
+* ``span`` events become complete (``"X"``) slices — the recorder stamps
+  a span at *exit* with its duration, so the slice start is
+  ``ts - dur_s`` and nesting falls out of containment (an inner span
+  both starts later and ends earlier than its parent on the same
+  track).
+* ``solver.iter`` events additionally feed a per-solver ``resid2``
+  counter track (``"C"``), so convergence plots right under the
+  iteration marks.
+* everything else becomes an instant (``"i"``) event carrying its full
+  field dict in ``args``.
+
+The exporter is tolerant by construction: unknown kinds land in an
+"other" lane, malformed events are skipped, and it never raises on
+event *content* — a partial/trimmed session log still exports.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+
+from . import _recorder
+
+#: subsystem lanes: ordered (pid, process name, kind-prefix tuple)
+_LANES = (
+    (1, "solver", ("solver.",)),
+    (2, "kernels", ("autotune.", "kernel.", "coverage.")),
+    (3, "comm", ("comm.",)),
+    (4, "plan_cache", ("plan_cache.",)),
+    (5, "batch", ("batch.",)),
+    (6, "bench", ("bench.",)),
+    (7, "spans", ("span",)),
+)
+_OTHER_PID = 8
+
+
+def _lane_of(ev: dict) -> tuple:
+    """(pid, thread-track name) for one event."""
+    kind = ev.get("kind", "")
+    if kind == "span":
+        name = str(ev.get("name", "span"))
+        return 7, name.split(".", 1)[0]
+    for pid, _pname, prefixes in _LANES:
+        for p in prefixes:
+            if kind.startswith(p):
+                if pid == 1:
+                    return pid, str(ev.get("solver", kind))
+                return pid, kind
+    return _OTHER_PID, kind or "?"
+
+
+def _num(v):
+    if isinstance(v, bool) or not isinstance(v, (int, float)):
+        return None
+    f = float(v)
+    return f if math.isfinite(f) else None
+
+
+def to_chrome_trace(events) -> dict:
+    """Build the Trace Event Format dict from an event iterable.
+
+    Events without a valid ``ts`` are skipped; nothing here raises on
+    malformed content. Timestamps stay absolute epoch microseconds —
+    Perfetto normalizes to the trace's own origin.
+    """
+    trace_events = []
+    tids: dict = {}  # (pid, track name) -> tid int
+    pids_seen = set()
+
+    def tid_of(pid: int, track: str) -> int:
+        key = (pid, track)
+        t = tids.get(key)
+        if t is None:
+            t = len([1 for (p, _n) in tids if p == pid]) + 1
+            tids[key] = t
+        pids_seen.add(pid)
+        return t
+
+    for ev in events:
+        if not isinstance(ev, dict):
+            continue
+        ts = _num(ev.get("ts"))
+        if ts is None:
+            continue
+        kind = ev.get("kind")
+        if not isinstance(kind, str) or not kind:
+            continue
+        pid, track = _lane_of(ev)
+        tid = tid_of(pid, track)
+        ts_us = ts * 1e6
+        args = {
+            k: v for k, v in ev.items() if k not in ("kind", "ts")
+        }
+        if kind == "span":
+            dur = _num(ev.get("dur_s"))
+            dur_us = max(dur * 1e6, 0.0) if dur is not None else 0.0
+            trace_events.append({
+                "ph": "X", "name": str(ev.get("name", "span")),
+                "cat": "span", "pid": pid, "tid": tid,
+                "ts": ts_us - dur_us, "dur": dur_us, "args": args,
+            })
+            continue
+        trace_events.append({
+            "ph": "i", "name": kind, "cat": kind.split(".", 1)[0],
+            "pid": pid, "tid": tid, "ts": ts_us, "s": "t", "args": args,
+        })
+        if kind == "solver.iter":
+            resid = _num(ev.get("resid2", ev.get("resid")))
+            if resid is not None:
+                trace_events.append({
+                    "ph": "C", "name": f"resid2.{ev.get('solver', '?')}",
+                    "pid": pid, "tid": tid, "ts": ts_us,
+                    "args": {"resid2": resid},
+                })
+
+    trace_events.sort(key=lambda e: e["ts"])
+
+    meta = []
+    names = {pid: pname for pid, pname, _p in _LANES}
+    names[_OTHER_PID] = "other"
+    for pid in sorted(pids_seen):
+        meta.append({
+            "ph": "M", "name": "process_name", "pid": pid, "tid": 0,
+            "args": {"name": f"sparse_tpu/{names.get(pid, 'other')}"},
+        })
+        meta.append({
+            "ph": "M", "name": "process_sort_index", "pid": pid, "tid": 0,
+            "args": {"sort_index": pid},
+        })
+    for (pid, track), tid in sorted(tids.items()):
+        meta.append({
+            "ph": "M", "name": "thread_name", "pid": pid, "tid": tid,
+            "args": {"name": track},
+        })
+    return {
+        "traceEvents": meta + trace_events,
+        "displayTimeUnit": "ms",
+        "otherData": {"source": "sparse_tpu.telemetry"},
+    }
+
+
+def read_events_jsonl(path: str) -> list:
+    """Telemetry events of a records.jsonl (bench metric records — no
+    ``kind`` — and unparseable lines are skipped, by the same contract
+    as ``schema.validate_jsonl``)."""
+    events = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                ev = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if isinstance(ev, dict) and "kind" in ev:
+                events.append(ev)
+    return events
+
+
+def export_trace(path: str, events=None, source: str | None = None) -> str:
+    """Write the session as Chrome-trace JSON; returns ``path``.
+
+    ``events`` defaults to the live in-memory ring; pass ``source=`` a
+    records.jsonl path to export a logged session instead (works with
+    telemetry disabled — this is offline analysis, not instrumentation).
+    """
+    if events is None:
+        events = (
+            read_events_jsonl(source) if source is not None
+            else _recorder.events()
+        )
+    trace = to_chrome_trace(events)
+    import os
+
+    d = os.path.dirname(os.path.abspath(path))
+    os.makedirs(d, exist_ok=True)
+    with open(path, "w") as f:
+        json.dump(trace, f)
+    return path

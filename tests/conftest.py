@@ -48,6 +48,7 @@ jax.config.update("jax_enable_x64", True)
 # evidence; this is the inner-loop check. Chosen from measured per-module
 # wall times (r4 durations run) to stay under ~4 minutes total.
 _QUICK_FILES = {
+    "test_axon_report.py",
     "test_batch.py",
     "test_bench_evidence.py",
     "test_bsr.py",

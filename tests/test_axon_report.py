@@ -1,0 +1,174 @@
+"""Axon offline tooling: axon_report analyzer/compare, axon_trace CLI,
+trim_records round-trip (ISSUE 4).
+
+The report and trace scripts are the operator's view of a session log;
+these tests pin (a) the smoke contract — the committed
+``results/axon/records.jsonl`` always analyzes and always exports valid
+Chrome-trace JSON, (b) the regression gate — ``--compare`` exits
+nonzero on a >=20% span-latency regression and zero otherwise, and
+(c) the trim round-trip — a trimmed log still validates and exports.
+
+axon_report is pure-stdlib (no jax init), so everything here except the
+trim/trace checks runs in milliseconds.
+"""
+
+import importlib.util
+import json
+import os
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+RECORDS = os.path.join(REPO, "results", "axon", "records.jsonl")
+
+
+def _load(name):
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(REPO, "scripts", f"{name}.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _write_records(path, span_durs, ts0=1700000000.0):
+    """A synthetic session: one span family plus a solver rollup, the
+    minimum surface the comparison gate needs."""
+    lines = []
+    ts = ts0
+    for d in span_durs:
+        ts += 1.0
+        lines.append({
+            "kind": "span", "ts": ts, "name": "bench.step", "dur_s": d,
+        })
+    lines.append({
+        "kind": "solver.solve", "ts": ts + 1, "solver": "cg",
+        "iters": 10, "path": "device", "n": 32,
+    })
+    with open(path, "w") as f:
+        for rec in lines:
+            f.write(json.dumps(rec) + "\n")
+    return path
+
+
+# -- the committed-log smoke (quick-lane CI satellite) ------------------------
+
+
+def test_report_smoke_on_committed_log():
+    rep = _load("axon_report").build_report(RECORDS)
+    assert rep["events_total"] > 0
+    assert "solver.iter" in rep["events_by_kind"]
+    assert rep["solvers"].get("cg", {}).get("solves", 0) >= 1
+    assert rep["metrics"], "the comparison surface must not be empty"
+
+
+def test_report_cli_smoke_exits_zero(capsys):
+    assert _load("axon_report").main([RECORDS, "--quiet"]) == 0
+
+
+def test_report_joins_bench_evidence():
+    bench = os.path.join(REPO, "BENCH_r05.json")
+    if not os.path.exists(bench):
+        pytest.skip("no BENCH_r05.json in this checkout")
+    rep = _load("axon_report").build_report(RECORDS, [bench])
+    assert any(r["source"] == "BENCH_r05.json" for r in rep["bench"])
+    assert any(k.startswith("bench.") for k in rep["metrics"])
+
+
+# -- the regression gate ------------------------------------------------------
+
+
+def test_compare_flags_span_latency_regression(tmp_path):
+    mod = _load("axon_report")
+    base_rec = _write_records(str(tmp_path / "base.jsonl"), [0.010] * 8)
+    base_json = str(tmp_path / "base.json")
+    assert mod.main([base_rec, "--quiet", "--json", base_json]) == 0
+    # inject a 30% span-latency regression (>= the 20% default gate)
+    slow_rec = _write_records(str(tmp_path / "slow.jsonl"), [0.013] * 8)
+    rc = mod.main([slow_rec, "--quiet", "--compare", base_json])
+    assert rc == 1
+    regs = mod.compare(
+        mod.build_report(slow_rec), json.load(open(base_json))
+    )
+    assert any(r["metric"] == "span.bench.step.p50_s" for r in regs)
+
+
+def test_compare_passes_within_threshold_and_on_improvement(tmp_path):
+    mod = _load("axon_report")
+    base_rec = _write_records(str(tmp_path / "base.jsonl"), [0.010] * 8)
+    base_json = str(tmp_path / "base.json")
+    mod.main([base_rec, "--quiet", "--json", base_json])
+    same_rec = _write_records(str(tmp_path / "same.jsonl"), [0.011] * 8)
+    assert mod.main([same_rec, "--quiet", "--compare", base_json]) == 0
+    fast_rec = _write_records(str(tmp_path / "fast.jsonl"), [0.004] * 8)
+    assert mod.main([fast_rec, "--quiet", "--compare", base_json]) == 0
+    # a tighter threshold flags the 10% move the default ignores
+    assert mod.main(
+        [same_rec, "--quiet", "--compare", base_json, "--threshold", "0.05"]
+    ) == 1
+
+
+def test_compare_missing_inputs_exit_2(tmp_path):
+    mod = _load("axon_report")
+    assert mod.main([str(tmp_path / "nope.jsonl")]) == 2
+    rec = _write_records(str(tmp_path / "r.jsonl"), [0.01])
+    assert mod.main([rec, "--compare", str(tmp_path / "nope.json")]) == 2
+
+
+# -- trace CLI + schema -------------------------------------------------------
+
+
+def test_trace_cli_produces_valid_chrome_trace(tmp_path):
+    out = str(tmp_path / "trace.json")
+    assert _load("axon_trace").main([RECORDS, out]) == 0
+    trace = json.load(open(out))
+    evs = trace["traceEvents"]
+    assert isinstance(evs, list) and evs
+    for e in evs:
+        assert e["ph"] in ("X", "i", "C", "M")
+        assert isinstance(e["pid"], int) and isinstance(e["tid"], int)
+        assert isinstance(e["name"], str) and e["name"]
+        if e["ph"] == "X":
+            assert e["dur"] >= 0
+        if e["ph"] != "M":
+            assert isinstance(e["ts"], (int, float))
+    # the committed log's solver iterations land in the solver lane
+    assert any(
+        e["ph"] == "i" and e["name"] == "solver.iter" for e in evs
+    )
+
+
+def test_trace_cli_missing_input_exits_2(tmp_path):
+    assert _load("axon_trace").main([str(tmp_path / "nope.jsonl")]) == 2
+
+
+# -- trim round-trip ----------------------------------------------------------
+
+
+def test_trim_keeps_log_exportable(tmp_path):
+    """Prepend a stale session, trim, and require the survivor to still
+    schema-validate and export (the ISSUE 4 trim satellite)."""
+    trim = _load("trim_records")
+    committed = open(RECORDS).read()
+    stale = [
+        {"kind": "solver.iter", "ts": 1000.0, "solver": "cg", "iter": 1},
+        {"kind": "bench.session", "ts": 1010.0, "status": "cpu",
+         "budget_spent_s": 5.0},
+    ]
+    target = tmp_path / "records.jsonl"
+    with open(target, "w") as f:
+        for rec in stale:
+            f.write(json.dumps(rec) + "\n")
+        f.write(committed)
+    dropped = trim.trim(str(target), dry_run=False)
+    assert dropped >= len(stale)
+
+    from sparse_tpu import telemetry
+
+    assert telemetry.schema.validate_jsonl(str(target)) == []
+    from sparse_tpu.telemetry import _trace
+
+    events = _trace.read_events_jsonl(str(target))
+    assert events
+    trace = _trace.to_chrome_trace(events)
+    assert trace["traceEvents"]

@@ -342,3 +342,287 @@ def test_provenance_scopes_counted(tel):
     counts = telemetry.counters()
     assert counts.get("sparse_tpu.cg", 0) >= 1
     assert counts.get("host_sync.int", 0) >= 1
+
+
+def test_ring_overflow_counts_dropped(tel, monkeypatch):
+    # overflow used to be silent (the deque just evicts); now the drop
+    # count is surfaced in summary() and rides the bench.session embed
+    monkeypatch.setattr(settings, "telemetry_ring", 32)
+    telemetry.reset()
+    for i in range(100):
+        telemetry.record("custom.tick", i=i)
+    s = telemetry.summary()
+    assert s["events"] == 32
+    assert s["dropped"] == 68 == telemetry.dropped()
+    telemetry.reset()
+    assert telemetry.summary()["dropped"] == 0
+
+
+def test_span_exception_records_error_and_timing(tel):
+    # a span exiting on an exception keeps the timing, tags the event
+    # with the exception type, and still attempts the best-effort sync
+    with pytest.raises(ValueError):
+        with telemetry.span("boom.op", sync=jnp.ones(4), n=4):
+            raise ValueError("inner failure")
+    ev = telemetry.events("span")[-1]
+    assert ev["name"] == "boom.op"
+    assert ev["error"] == "ValueError"
+    assert ev["dur_s"] >= 0 and ev["n"] == 4
+    assert telemetry.summary()["spans"]["boom.op"]["n"] == 1
+
+
+# -- metrics registry (telemetry/_metrics.py) --------------------------------
+
+
+def test_metrics_counter_gauge_histogram_semantics():
+    from sparse_tpu.telemetry import _metrics as M
+
+    c = M.counter("test.sem.counter", case="a")
+    v0 = c.value
+    c.inc()
+    c.inc(2)
+    # get-or-create: same name+labels is the same object; different
+    # labels are a different series
+    assert M.counter("test.sem.counter", case="a") is c
+    assert M.counter("test.sem.counter", case="b") is not c
+    assert c.value == v0 + 3
+
+    g = M.gauge("test.sem.gauge")
+    g.set(4.5)
+    assert g.value == 4.5
+    g.inc()
+    g.dec(2)
+    assert g.value == pytest.approx(3.5)
+    lazy = M.gauge("test.sem.lazy", fn=lambda: 7)
+    assert lazy.value == 7
+
+    h = M.histogram("test.sem.hist")
+    h.reset()
+    obs = [1e-9, 0.25, 3.0, 1e12, float("inf")]
+    for v in obs:
+        h.observe(v)
+    h.observe(float("nan"))  # ignored, never poisons sum/count
+    assert h.count == len(obs)
+    buckets = h.buckets()
+    # cumulative and complete: monotone, +Inf bucket holds everything
+    accs = [acc for _b, acc in buckets]
+    assert accs == sorted(accs) and accs[-1] == len(obs)
+    # each finite observation lands at (or below) its own power of two
+    import math
+
+    assert buckets[-1][0] == math.inf
+
+
+def test_metrics_text_prometheus_exposition(tel):
+    from sparse_tpu import plan_cache
+
+    class Obj:
+        pass
+
+    o = Obj()
+    plan_cache.get(o, "test.kind", lambda: "plan")  # miss (build)
+    plan_cache.get(o, "test.kind", lambda: "plan")  # hit
+    txt = telemetry.metrics_text()
+    assert "# TYPE sparse_tpu_plan_cache_hits_total counter" in txt
+    assert "# TYPE sparse_tpu_plan_cache_size gauge" in txt
+    # acceptance surface: plan_cache hit/miss and solver anomaly counts
+    hits = [
+        ln for ln in txt.splitlines()
+        if ln.startswith("sparse_tpu_plan_cache_hits_total ")
+    ]
+    misses = [
+        ln for ln in txt.splitlines()
+        if ln.startswith("sparse_tpu_plan_cache_misses_total ")
+    ]
+    assert hits and float(hits[0].split()[-1]) >= 1
+    assert misses and float(misses[0].split()[-1]) >= 1
+    assert "sparse_tpu_solver_anomalies_total" in txt
+    # sample lines are Prometheus-shaped: sanitized name, numeric value
+    for ln in txt.splitlines():
+        if not ln or ln.startswith("#"):
+            continue
+        name = ln.split("{")[0].split()[0]
+        assert name.replace("_", "a").replace(":", "a").isalnum(), ln
+        float(ln.rsplit(None, 1)[1].replace("+Inf", "inf"))
+    # the registry numbers match the stats() readback
+    assert plan_cache.stats()["hits"] == float(hits[0].split()[-1])
+
+
+def test_metrics_disabled_path_allocates_nothing(monkeypatch):
+    telemetry.reset()
+    monkeypatch.setattr(settings, "telemetry", False)
+    from sparse_tpu.telemetry import _metrics as M
+
+    before = len(M._REGISTRY)
+    telemetry.count("never.counted", 3)
+    telemetry.add_bytes("never.bytes", 10)
+    # the disabled path returns before touching the registry: no new
+    # series, nothing to read back
+    assert len(M._REGISTRY) == before
+    assert telemetry.counters() == {}
+    assert telemetry.bytes_by_kind() == {}
+
+
+def test_batch_service_levels_on_registry(tel):
+    from sparse_tpu.batch.service import SolveSession
+    from sparse_tpu.telemetry import _metrics as M
+
+    e = np.ones(12)
+    S = sp.diags([-e[:-1], 4.0 * e, -e[:-1]], [-1, 0, 1]).tocsr()
+    depth = M.gauge("batch.queue_depth")
+    occ = M.histogram("batch.bucket_occupancy")
+    n_obs = occ.count
+    sess = SolveSession("cg", batch_max=4)
+    for _ in range(3):
+        sess.submit(sparse_tpu.csr_array(S), np.ones(12), tol=1e-8)
+    assert depth.value >= 3
+    sess.flush()
+    assert depth.value == 0
+    assert occ.count == n_obs + 1  # one bucket dispatched, one ratio
+
+
+# -- trace export (telemetry/_trace.py) --------------------------------------
+
+
+def test_trace_export_synthetic_session(tel, tmp_path):
+    with telemetry.span("solve.outer", n=8):
+        with telemetry.span("solve.inner"):
+            pass
+    telemetry.record(
+        "solver.iter", solver="cg", path="host", iter=1, resid2=2.0
+    )
+    telemetry.record("comm.spmv", bytes=128, mode="halo", S=2)
+    out = tmp_path / "trace.json"
+    telemetry.export_trace(str(out))
+    t = json.load(open(out))
+    evs = t["traceEvents"]
+    assert isinstance(evs, list) and evs
+    for e in evs:
+        assert {"ph", "pid", "tid", "name"} <= set(e)
+        if e["ph"] != "M":
+            assert isinstance(e["ts"], (int, float))
+    # spans become complete slices; the inner span nests inside the outer
+    spans = {e["name"]: e for e in evs if e["ph"] == "X"}
+    assert set(spans) == {"solve.outer", "solve.inner"}
+    outer, inner = spans["solve.outer"], spans["solve.inner"]
+    assert outer["tid"] == inner["tid"]  # same family track => nesting
+    assert outer["ts"] <= inner["ts"] + 1e-3
+    assert inner["ts"] + inner["dur"] <= outer["ts"] + outer["dur"] + 1e-3
+    # solver iterations also feed a resid2 counter track
+    counters = [e for e in evs if e["ph"] == "C"]
+    assert counters and counters[0]["args"]["resid2"] == 2.0
+    # subsystem lanes are named processes
+    pnames = {
+        e["args"]["name"] for e in evs
+        if e["ph"] == "M" and e["name"] == "process_name"
+    }
+    assert {"sparse_tpu/solver", "sparse_tpu/comm", "sparse_tpu/spans"} <= pnames
+
+
+def test_trace_export_from_jsonl_source(tel, tmp_path):
+    A, b = _laplacian()
+    linalg.cg(A, b, tol=1e-8)
+    out = tmp_path / "trace.json"
+    telemetry.export_trace(str(out), source=str(tel))
+    t = json.load(open(out))
+    iters = [
+        e for e in t["traceEvents"]
+        if e["ph"] == "i" and e["name"] == "solver.iter"
+    ]
+    assert iters, "logged solver iterations must appear on the timeline"
+
+
+# -- solver health monitor (telemetry/_health.py) ----------------------------
+
+
+def test_health_nan_detected_in_tiny_cg(tel):
+    n = 8
+    e = np.ones(n)
+    S = sp.diags([-e[:-1], 2.0 * e, -e[:-1]], [-1, 0, 1]).tocsr()
+    S.data[0] = np.nan  # forced NaN: first matvec poisons the residual
+    linalg.cg(sparse_tpu.csr_array(S), np.ones(n), tol=1e-10, maxiter=20)
+    evs = telemetry.events("solver.anomaly")
+    assert evs and evs[0]["solver"] == "cg"
+    assert evs[0]["reason"] == "nonfinite"
+    rep = telemetry.last_solve_report()
+    assert rep is not None and rep["solver"] == "cg"
+    assert any(a["reason"] == "nonfinite" for a in rep["anomalies"])
+    assert rep["iters"] is not None  # solver.solve finalized the report
+    # one event per (reason, lane) per solve — never one per iteration
+    assert len([e for e in evs if e["reason"] == "nonfinite"]) == 1
+
+
+def test_health_stagnation_detected_in_tiny_cg(tel):
+    from sparse_tpu.telemetry import _health
+
+    # singular diagonal with b in the null direction: the residual is
+    # bit-invariant across iterations — the textbook stall
+    n = 8
+    d = np.ones(n)
+    d[-1] = 0.0
+    A = sparse_tpu.csr_array(sp.diags([d], [0]).tocsr())
+    b = np.zeros(n)
+    b[-1] = 1.0
+    linalg.cg(
+        A, b, tol=1e-12, maxiter=_health.STALL_WINDOW + 10,
+        conv_test_iters=1000,
+    )
+    reasons = {e["reason"] for e in telemetry.events("solver.anomaly")}
+    assert "stagnation" in reasons
+    rep = telemetry.last_solve_report()
+    assert any(a["reason"] == "stagnation" for a in rep["anomalies"])
+
+
+def test_health_divergence_detector_direct(tel):
+    h = telemetry.health
+    h.reset()
+    h.observe("cg", 1, 1.0)
+    h.observe("cg", 2, 1e12)  # 1e12 > best * DIVERGENCE_FACTOR
+    rep = telemetry.last_solve_report()
+    assert any(a["reason"] == "divergence" for a in rep["anomalies"])
+    evs = telemetry.events("solver.anomaly")
+    assert evs[-1]["reason"] == "divergence" and evs[-1]["iter"] == 2
+
+
+def test_health_batched_lane_anomaly(tel):
+    from sparse_tpu.batch.krylov import batched_cg
+    from sparse_tpu.batch.operator import BatchedCSR, SparsityPattern
+
+    n = 16
+    e = np.ones(n)
+    S = sp.diags([-e[:-1], 3.0 * e, -e[:-1]], [-1, 0, 1]).tocsr()
+    pat = SparsityPattern.from_csr(sparse_tpu.csr_array(S))
+    op = BatchedCSR(pat, np.stack([S.data] * 3))
+    b = np.ones((3, n))
+    b[1, 0] = np.nan  # poison exactly one lane
+    X, info = batched_cg(op, b, tol=1e-8, maxiter=30)
+    evs = telemetry.events("solver.anomaly")
+    nan_evs = [e for e in evs if e["reason"] == "nonfinite"]
+    assert nan_evs and all(e.get("lane") == 1 for e in nan_evs)
+    rep = telemetry.last_solve_report()
+    assert rep["lanes"] == 3
+    assert any(
+        a["reason"] == "nonfinite" and a.get("lane") == 1
+        for a in rep["anomalies"]
+    )
+    # healthy lanes converged and stayed clean
+    conv = np.asarray(info.converged)
+    assert bool(conv[0]) and bool(conv[2]) and not bool(conv[1])
+
+
+def test_health_clean_solve_reports_no_anomalies(tel):
+    A, b = _laplacian()
+    x, iters = linalg.cg(A, b, tol=1e-10)
+    rep = telemetry.last_solve_report()
+    assert rep["solver"] == "cg" and rep["iters"] == iters
+    assert rep["anomalies"] == []
+    assert len(rep["resid_history"]) >= min(iters, 1)
+    assert telemetry.events("solver.anomaly") == []
+
+
+def test_health_zero_overhead_when_disabled(monkeypatch):
+    telemetry.reset()
+    monkeypatch.setattr(settings, "telemetry", False)
+    telemetry.health.observe("cg", 1, float("nan"))
+    telemetry.health.end_solve("cg", 5)
+    assert telemetry.last_solve_report() is None
