@@ -340,7 +340,9 @@ def kernel_sweep(n: int, platform: str) -> dict:
             # delegating row was labeled, "(->xla)", so the sweep never
             # claims a kernel that didn't run
             attempt("sell_pallas", sprep, sell_bytes)
-            if sprep._pallas_ok is False and "sell_pallas" in out:
+            from sparse_tpu.resilience import failover as _failover
+
+            if _failover.failed(sprep.KERNEL, sprep) and "sell_pallas" in out:
                 out["sell_pallas(->xla)"] = out.pop("sell_pallas")
         else:
             # off-TPU the kernel only exists in interpret mode (pure

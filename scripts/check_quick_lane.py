@@ -41,6 +41,7 @@ MANIFEST = os.path.join(TESTS, "quick_lane_manifest.json")
 _REQUIRED_SCRIPTS = (
     "axon_report.py",
     "axon_trace.py",
+    "chaos_check.py",
     "check_quick_lane.py",
     "trim_records.py",
 )

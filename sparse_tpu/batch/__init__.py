@@ -42,7 +42,15 @@ from .operator import (  # noqa: F401
     SparsityPattern,
     make_batched_operator,
 )
-from .service import SolveSession, SolveTicket  # noqa: F401
+from .service import (  # noqa: F401
+    SolveSession,
+    SolveTicket,
+    TicketDeadlineError,
+    TicketError,
+    TicketFailedError,
+    TicketState,
+    TicketUnresolvedError,
+)
 
 __all__ = [
     "BatchedCSR",
@@ -51,6 +59,11 @@ __all__ = [
     "BatchedSolveInfo",
     "SolveSession",
     "SolveTicket",
+    "TicketDeadlineError",
+    "TicketError",
+    "TicketFailedError",
+    "TicketState",
+    "TicketUnresolvedError",
     "SparsityPattern",
     "batched_bicgstab",
     "batched_cg",

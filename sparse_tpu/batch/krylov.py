@@ -32,8 +32,20 @@ import jax.numpy as jnp
 import numpy as np
 
 from .. import telemetry
+from ..resilience import faults as _faults
 from ..utils import asjnp
 from .operator import BatchedOperator, as_batched_matvec
+
+
+def _maybe_faulty_mv(mv):
+    """Install the fault-injection wrapper on a batched matvec when a
+    matvec clause is active (resilience.faults) — absent otherwise, so
+    clean traces are byte-identical."""
+    if _faults.ACTIVE and _faults.targets("matvec") and not getattr(
+        mv, "_fault_wrapped", False
+    ):
+        return _faults.wrap_batched_matvec(mv)
+    return mv
 
 
 @dataclass
@@ -64,7 +76,7 @@ def _bdot(a, b):
 def _prep(A, b, x0, tol, maxiter):
     """Shared entry glue: resolve the matvec, promote dtypes, shape the
     per-lane tolerance. Returns (matvec, b, X0, tol(B,), maxiter, B, n)."""
-    mv = as_batched_matvec(A)
+    mv = _maybe_faulty_mv(as_batched_matvec(A))
     b = asjnp(b)
     if b.ndim == 1:
         b = b[None, :]
@@ -417,7 +429,7 @@ def batched_gmres(A, b, x0=None, tol=1e-08, restart=None, maxiter=None,
     ``(X, BatchedSolveInfo)``; ``info.iters`` counts inner iterations
     (breakdown stages included) exactly like the unbatched driver.
     """
-    mv = as_batched_matvec(A)
+    mv = _maybe_faulty_mv(as_batched_matvec(A))
     b = asjnp(b)
     if b.ndim == 1:
         b = b[None, :]

@@ -38,7 +38,7 @@ import numpy as np
 from .. import plan_cache, telemetry
 from ..config import settings
 from ..ops import spmv as spmv_ops
-from ..utils import asjnp, commit_to_exec_device, host_scope, in_trace, user_warning
+from ..utils import asjnp, commit_to_exec_device, host_scope, in_trace
 
 
 class SparsityPattern:
@@ -260,7 +260,6 @@ class BatchedCSR(BatchedOperator):
         self.shape = (int(values.shape[0]), m, n)
         self.dtype = np.dtype(values.dtype)
         self._vals_packed = None  # per-slab [B, K, R] planes, lazy
-        self._pallas_ok = None  # None = untried, False = failed over
 
     @classmethod
     def from_stack(cls, mats, pattern=None):
@@ -312,10 +311,15 @@ class BatchedCSR(BatchedOperator):
             self._vals_packed = packed
         return pack, self._vals_packed
 
+    #: failover-registry kernel name; latched per PATTERN (failure is a
+    #: geometry/backend property, so `with_values` siblings share it)
+    KERNEL = "sell_spmv_batched"
+
     def _pallas_viable(self, pack, X) -> bool:
         from ..kernels.sell_spmv import PALLAS_MAX_K, PALLAS_MAX_X
+        from ..resilience import failover
 
-        if self._pallas_ok is False or not pack.idx_slabs:
+        if failover.failed(self.KERNEL, self.pattern) or not pack.idx_slabs:
             return False
         if X.shape[1] > PALLAS_MAX_X:
             return False
@@ -341,30 +345,19 @@ class BatchedCSR(BatchedOperator):
             return self._matvec_segment(X)
         pack, vals = self._packed()
         if mode == "pallas" and self._pallas_viable(pack, X):
+            from ..resilience import failover
+
             try:
                 from ..kernels.sell_spmv import sell_spmv_pallas_batched
 
-                Y = sell_spmv_pallas_batched(
+                # forced-failure injection + the shared one-time
+                # Pallas->XLA failover ladder (resilience/failover.py)
+                failover.maybe_inject(self.KERNEL)
+                return sell_spmv_pallas_batched(
                     pack.plan, pack.idx_slabs, vals, pack.pos, X
                 )
-                self._pallas_ok = True
-                return Y
             except (ValueError, NotImplementedError) as e:
-                import os
-
-                if os.environ.get("SPARSE_TPU_STRICT_PALLAS") and not (
-                    isinstance(e, NotImplementedError)
-                ):
-                    raise
-                user_warning(
-                    "batched Pallas SELL SpMV unavailable; failing over "
-                    f"to the XLA formulation permanently: {e!r}"
-                )
-                telemetry.record(
-                    "kernel.failover", kernel="sell_spmv_batched",
-                    error=repr(e)[:200], backend=jax.default_backend(),
-                )
-                self._pallas_ok = False
+                failover.handle(self.KERNEL, self.pattern, e)
         return spmv_ops.csr_spmv_sell_batched(
             pack.idx_slabs, vals, pack.pos, X, pack.plan.zero_rows
         )

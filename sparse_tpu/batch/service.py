@@ -1,4 +1,4 @@
-"""SolveSession: a microbatching front door for same-pattern solves.
+"""SolveSession: a resilient microbatching front door for same-pattern solves.
 
 The serving loop this subsystem exists for: requests ``(A-values, b,
 tol)`` trickle in from many callers, almost all of them over a handful
@@ -17,12 +17,23 @@ warm executable. ``plan_cache.stats()`` is the always-on instrument;
 with telemetry enabled each dispatch additionally emits a
 ``batch.dispatch`` event (batch size, bucket, padding waste, queue
 latency, per-lane iteration stats — docs/batching.md).
+
+Resilience (ISSUE 5, docs/resilience.md): tickets carry an explicit
+:class:`TicketState` and per-ticket deadlines; ``flush()`` is
+exception-safe (one failed bucket program marks ITS tickets failed and
+every other bucket still dispatches); lanes that come back unconverged
+or nonfinite requeue ONCE into a fallback bucket (safer solver —
+default GMRES — at a promoted dtype), emitting ``batch.requeue``; and
+when the compiled-program path itself is unavailable (Pallas lowering
+gone, plan-cache failure, injected dispatch faults) the bucket degrades
+to per-lane eager solves rather than stranding its tickets
+(``batch.degraded``).
 """
 
 from __future__ import annotations
 
+import enum
 import time
-from functools import partial
 
 import jax
 import jax.numpy as jnp
@@ -31,6 +42,7 @@ import numpy as np
 from .. import plan_cache, telemetry
 from ..config import settings
 from ..ops import spmv as spmv_ops
+from ..resilience import faults as _faults
 from ..telemetry import _metrics
 from . import bucket as bucketing
 from . import krylov
@@ -45,38 +57,130 @@ _QUEUE_DEPTH = _metrics.gauge("batch.queue_depth")
 _BUCKET_OCCUPANCY = _metrics.histogram("batch.bucket_occupancy")
 _DISPATCHES = _metrics.counter("batch.dispatches")
 _PAD_WASTE = _metrics.counter("batch.pad_lanes")
+# resilience levels
+_REQUEUES = _metrics.counter("batch.requeues")
+_DEGRADED = _metrics.counter("batch.degraded")
+_BUCKET_FAILURES = _metrics.counter("batch.bucket_failures")
+_DEADLINE_FAILED = _metrics.counter("batch.deadline_failed")
+
+
+class TicketState(enum.Enum):
+    """Lifecycle of a submitted system (ISSUE 5 satellite: unresolved
+    and failed tickets used to be indistinguishable bare RuntimeErrors)."""
+
+    PENDING = "pending"
+    DONE = "done"
+    FAILED = "failed"
+
+
+class TicketError(RuntimeError):
+    """Base of the ticket error family."""
+
+
+class TicketUnresolvedError(TicketError):
+    """``result()`` on a ticket no flush has resolved (should not happen
+    through the public API — flush resolves or fails every ticket)."""
+
+
+class TicketFailedError(TicketError):
+    """The ticket's bucket failed (program error, exhausted dispatch
+    retries); ``__cause__`` carries the underlying exception."""
+
+
+class TicketDeadlineError(TicketFailedError):
+    """The ticket's deadline passed before its bucket dispatched."""
+
+
+class InjectedDispatchFailure(RuntimeError):
+    """A ``drop:dispatch`` fault clause fired (resilience.faults) — the
+    injected stand-in for a dispatch lost to a worker/backend failure."""
 
 
 class SolveTicket:
     """Handle for one submitted system. ``result()`` flushes the session
     if the request is still queued, then returns ``(x, iters, resid2)``
-    (host numpy scalars/arrays for the lane)."""
+    (host numpy scalars/arrays for the lane). Failed tickets raise
+    :class:`TicketFailedError` (:class:`TicketDeadlineError` for
+    deadline misses) instead of returning garbage."""
 
-    __slots__ = ("_session", "_out", "t_submit")
+    __slots__ = ("_session", "_out", "t_submit", "state", "error",
+                 "deadline_s", "requeued", "solver")
 
-    def __init__(self, session):
+    def __init__(self, session, deadline_s=None):
         self._session = session
         self._out = None
         self.t_submit = time.monotonic()
+        self.state = TicketState.PENDING
+        self.error = None
+        self.deadline_s = None if deadline_s is None else float(deadline_s)
+        self.requeued = False
+        self.solver = None  # the solver that produced the final result
 
     @property
     def done(self) -> bool:
-        return self._out is not None
+        return self.state is TicketState.DONE
 
-    def _set(self, x, iters, resid2, converged):
-        self._out = (x, int(iters), float(resid2), bool(converged))
+    @property
+    def failed(self) -> bool:
+        return self.state is TicketState.FAILED
+
+    @property
+    def expired(self) -> bool:
+        return (
+            self.deadline_s is not None
+            and time.monotonic() - self.t_submit >= self.deadline_s
+        )
+
+    def _offer(self, x, iters, resid2, converged, solver=None):
+        """Install a result, keeping the better one when a fallback
+        dispatch re-solves the lane (converged beats unconverged, then
+        smaller residual; a FAILED ticket is revived by any result)."""
+        new = (x, int(iters), float(resid2), bool(converged))
+        if self._out is not None:
+            old = self._out
+            better = (new[3] and not old[3]) or (
+                new[3] == old[3]
+                and (np.isfinite(new[2]) and not np.isfinite(old[2])
+                     or (np.isfinite(new[2]) and np.isfinite(old[2])
+                         and new[2] < old[2]))
+            )
+            if not better:
+                return
+        self._out = new
+        self.state = TicketState.DONE
+        self.error = None
+        if solver is not None:
+            self.solver = solver
+
+    def _fail(self, exc) -> None:
+        if self.state is TicketState.DONE:
+            return  # a resolved ticket never regresses to failed
+        self.state = TicketState.FAILED
+        self.error = exc
 
     def result(self):
-        if self._out is None:
+        if self.state is TicketState.PENDING:
             self._session.flush()
-        if self._out is None:  # pragma: no cover - defensive
-            raise RuntimeError("flush did not resolve this ticket")
+        if self.state is TicketState.FAILED:
+            raise (
+                self.error
+                if isinstance(self.error, TicketError)
+                else TicketFailedError(
+                    f"bucket dispatch failed: {self.error!r}"
+                )
+            ) from (self.error if isinstance(self.error, Exception) else None)
+        if self._out is None:
+            raise TicketUnresolvedError(
+                "flush did not resolve this ticket"
+            )
         return self._out[:3]
 
     @property
     def converged(self) -> bool:
-        if self._out is None:
+        if self.state is TicketState.PENDING:
             self._session.flush()
+        if self._out is None:
+            return False
         return self._out[3]
 
 
@@ -87,6 +191,16 @@ class _Request:
         self.pattern, self.values, self.b = pattern, values, b
         self.tol, self.x0, self.maxiter = tol, x0, maxiter
         self.ticket = ticket
+
+
+def _promote(dt: np.dtype) -> np.dtype:
+    """The requeue bucket's 'safer dtype': one precision step up."""
+    dt = np.dtype(dt)
+    if dt == np.float32:
+        return np.dtype(np.float64)
+    if dt == np.complex64:
+        return np.dtype(np.complex128)
+    return dt
 
 
 class SolveSession:
@@ -103,19 +217,32 @@ class SolveSession:
     auto_flush : when set, ``submit`` flushes as soon as a pattern has
         this many queued requests (a latency/throughput knob; None =
         explicit ``flush()`` only)
+    requeue : requeue unconverged/nonfinite lanes once into a fallback
+        bucket (``fallback_solver`` at a promoted dtype); on by default
+    fallback_solver : solver of the requeue bucket (default 'gmres' —
+        the most breakdown-tolerant of the three)
+    dispatch_attempts : tries per bucket before its tickets fail (>= 1;
+        retries cover transient dispatch faults, e.g. injected drops)
     """
 
     def __init__(self, solver: str = "cg", batch_max: int | None = None,
                  bucket_policy: str | None = None, conv_test_iters: int = 25,
-                 restart: int | None = None, auto_flush: int | None = None):
+                 restart: int | None = None, auto_flush: int | None = None,
+                 requeue: bool = True, fallback_solver: str = "gmres",
+                 dispatch_attempts: int = 2):
         if solver not in _SOLVERS:
             raise ValueError(f"solver must be one of {_SOLVERS}")
+        if fallback_solver not in _SOLVERS:
+            raise ValueError(f"fallback_solver must be one of {_SOLVERS}")
         self.solver = solver
         self.batch_max = int(batch_max or settings.batch_max)
         self.bucket_policy = bucket_policy or settings.batch_bucket
         self.conv_test_iters = int(conv_test_iters)
         self.restart = restart
         self.auto_flush = auto_flush
+        self.requeue = bool(requeue)
+        self.fallback_solver = fallback_solver
+        self.dispatch_attempts = max(int(dispatch_attempts), 1)
         self._patterns: dict = {}  # fingerprint -> SparsityPattern (dedupe)
         self._pending: dict = {}  # id(pattern) -> [Request]
         self.dispatches = 0
@@ -128,10 +255,14 @@ class SolveSession:
         return self._patterns.setdefault(p.fingerprint, p)
 
     def submit(self, A, b, tol: float = 1e-8, x0=None, maxiter=None,
-               pattern: SparsityPattern | None = None) -> SolveTicket:
+               pattern: SparsityPattern | None = None,
+               deadline_s: float | None = None) -> SolveTicket:
         """Queue one system. ``A`` is a CSR-shaped matrix (csr_array /
         scipy) or, with ``pattern=`` given, a bare ``(nnz,)`` value
-        vector over that pattern."""
+        vector over that pattern. ``deadline_s`` is a per-ticket wall
+        budget measured from submission: a ticket still queued when its
+        deadline passes fails with :class:`TicketDeadlineError` instead
+        of dispatching stale work."""
         if pattern is None:
             pattern = self.pattern_of(A)
             values = np.asarray(A.data if hasattr(A, "data") else A)
@@ -149,7 +280,7 @@ class SolveSession:
             raise ValueError(
                 f"rhs shape {b.shape} != ({pattern.shape[0]},)"
             )
-        t = SolveTicket(self)
+        t = SolveTicket(self, deadline_s=deadline_s)
         q = self._pending.setdefault(id(pattern), [])
         q.append(_Request(pattern, values, b, float(tol), x0, maxiter, t))
         _QUEUE_DEPTH.inc()
@@ -180,24 +311,69 @@ class SolveSession:
     def flush(self) -> int:
         """Dispatch every queued request; returns the number of batches
         dispatched. Groups by (pattern, dtype), splits groups into
-        ``batch_max``-sized chunks, pads each chunk to its bucket."""
+        ``batch_max``-sized chunks, pads each chunk to its bucket.
+
+        Exception-safe by contract (ISSUE 5 satellite): a bucket whose
+        program raises marks only ITS tickets :class:`TicketFailedError`
+        (after ``dispatch_attempts`` tries) — every other pending bucket
+        still dispatches, and the session stays usable."""
         dispatched = 0
         pending, self._pending = self._pending, {}
         _QUEUE_DEPTH.dec(sum(len(q) for q in pending.values()))
         for q in pending.values():
+            # per-ticket deadlines: fail stale work instead of solving it
+            live = []
+            for r in q:
+                if r.ticket.expired:
+                    r.ticket._fail(TicketDeadlineError(
+                        f"deadline {r.ticket.deadline_s}s passed before "
+                        "dispatch"
+                    ))
+                    _DEADLINE_FAILED.inc()
+                else:
+                    live.append(r)
+            if len(live) != len(q) and telemetry.enabled():
+                telemetry.record(
+                    "batch.deadline", solver=self.solver,
+                    lanes=len(q) - len(live),
+                )
             # one group per result dtype so stacked values are homogeneous
             by_dt: dict = {}
-            for r in q:
+            for r in live:
                 dt = np.result_type(r.values.dtype, r.b.dtype)
                 by_dt.setdefault(np.dtype(dt), []).append(r)
             for dt, reqs in sorted(by_dt.items(), key=lambda kv: kv[0].str):
                 for lo in range(0, len(reqs), self.batch_max):
-                    self._dispatch(reqs[lo:lo + self.batch_max], dt)
-                    dispatched += 1
+                    chunk = reqs[lo:lo + self.batch_max]
+                    err = None
+                    for _attempt in range(self.dispatch_attempts):
+                        try:
+                            self._dispatch(chunk, dt)
+                            dispatched += 1
+                            err = None
+                            break
+                        except Exception as e:  # noqa: BLE001 - contract
+                            err = e
+                            if not isinstance(e, InjectedDispatchFailure):
+                                break  # real failures don't auto-retry
+                    if err is not None:
+                        _BUCKET_FAILURES.inc()
+                        for r in chunk:
+                            r.ticket._fail(err)
         return dispatched
 
-    def _dispatch(self, reqs, dt) -> None:
+    def _dispatch(self, reqs, dt, solver: str | None = None,
+                  allow_requeue: bool = True) -> None:
+        solver = solver or self.solver
         t0 = time.monotonic()
+        if _faults.ACTIVE:
+            for act in _faults.dispatch_actions():
+                if act[0] == "drop":
+                    raise InjectedDispatchFailure(
+                        "injected dispatch drop (resilience.faults)"
+                    )
+                if act[0] == "delay":
+                    time.sleep(act[1] / 1e3)
         pattern = reqs[0].pattern
         nb = len(reqs)
         bkt = bucketing.bucket_batch(
@@ -221,21 +397,49 @@ class SolveSession:
             for r in reqs
         )
         snap = plan_cache.snapshot()
-        prog = plan_cache.get(
-            pattern,
-            f"batch.{self.solver}.B{bkt}.{np.dtype(dt).str}",
-            lambda: self._build_program(pattern, bkt, np.dtype(dt)),
-        )
-        X, iters, resid2, conv = prog(
-            jnp.asarray(values), jnp.asarray(rhs), jnp.asarray(x0),
-            jnp.asarray(tols), maxiter,
-        )
-        X = np.asarray(X)
-        iters = np.asarray(iters)
-        resid2 = np.asarray(resid2)
-        conv = np.asarray(conv)
+        faulty = _faults.ACTIVE and _faults.targets("matvec")
+        key = f"batch.{solver}.B{bkt}.{np.dtype(dt).str}"
+        if faulty:
+            # fault-wrapped programs carry the injection callback in
+            # their trace: never share cache entries with clean ones
+            key += ".faults"
+        try:
+            prog = plan_cache.get(
+                pattern, key,
+                lambda: self._build_program(pattern, bkt, np.dtype(dt),
+                                            solver=solver),
+            )
+            X, iters, resid2, conv = prog(
+                jnp.asarray(values), jnp.asarray(rhs), jnp.asarray(x0),
+                jnp.asarray(tols), maxiter,
+            )
+            X = np.asarray(X)
+            iters = np.asarray(iters)
+            resid2 = np.asarray(resid2)
+            conv = np.asarray(conv)
+        except Exception as e:  # noqa: BLE001 - degrade, don't strand
+            # Graceful degradation (ISSUE 5): the compiled batched path
+            # is unavailable (Pallas lowering gone mid-session, plan
+            # cache failure, injected program fault) — solve the lanes
+            # one by one on the eager path instead of failing the bucket.
+            _DEGRADED.inc()
+            if telemetry.enabled():
+                telemetry.record(
+                    "batch.degraded", solver=solver, reason=repr(e)[:200],
+                    lanes=nb,
+                )
+            self._solve_degraded(reqs, dt, solver)
+            return
+        requeue_lanes = []
         for i, r in enumerate(reqs):
-            r.ticket._set(X[i], iters[i], resid2[i], conv[i])
+            r.ticket._offer(X[i], iters[i], resid2[i], conv[i],
+                            solver=solver)
+            if (
+                allow_requeue and self.requeue and not r.ticket.requeued
+                and (not conv[i] or not np.isfinite(resid2[i]))
+            ):
+                r.ticket.requeued = True
+                requeue_lanes.append(r)
         self.dispatches += 1
         _DISPATCHES.inc()
         _BUCKET_OCCUPANCY.observe(nb / bkt)
@@ -246,7 +450,7 @@ class SolveSession:
             ]
             cache_d = plan_cache.delta(snap)
             telemetry.record(
-                "batch.dispatch", solver=self.solver, batch=nb,
+                "batch.dispatch", solver=solver, batch=nb,
                 bucket=bkt, pad_waste=bkt - nb,
                 queue_ms_max=round(max(q_ms), 3),
                 queue_ms_mean=round(sum(q_ms) / len(q_ms), 3),
@@ -256,21 +460,97 @@ class SolveSession:
                 plan_cache=cache_d,
                 n=pattern.shape[0], nnz=pattern.nnz,
             )
+        if requeue_lanes:
+            self._requeue(requeue_lanes, dt)
 
-    def _build_program(self, pattern: SparsityPattern, bkt: int, dt):
+    # -- resilience paths --------------------------------------------------
+    def _requeue(self, reqs, dt) -> None:
+        """Failed-lane requeue: one fallback bucket under the safer
+        solver/dtype; the fallback result only replaces a lane's first
+        result when it is better (``SolveTicket._offer``)."""
+        fb_dt = _promote(dt)
+        _REQUEUES.inc(len(reqs))
+        if telemetry.enabled():
+            telemetry.record(
+                "batch.requeue", solver=self.fallback_solver,
+                lanes=len(reqs), from_solver=self.solver,
+                dtype=np.dtype(fb_dt).str,
+            )
+        # fresh maxiter budget: the lane may have failed BECAUSE the
+        # caller's budget was too small for the requested solver
+        fb = [
+            _Request(r.pattern, r.values, r.b, r.tol, None, None, r.ticket)
+            for r in reqs
+        ]
+        try:
+            self._dispatch(fb, fb_dt, solver=self.fallback_solver,
+                           allow_requeue=False)
+        except Exception:  # noqa: BLE001 - first results already stand
+            # the requeue is best-effort: every lane already holds its
+            # first (unconverged) result, which result() returns
+            pass
+
+    def _solve_degraded(self, reqs, dt, solver: str) -> None:
+        """Per-lane eager fallback when the compiled bucket program is
+        unavailable: each lane solves through the plain linalg drivers
+        over a csr view of the pattern; per-lane failures fail only that
+        lane's ticket."""
+        from .. import linalg
+        from ..csr import csr_array
+        from ..utils import asjnp
+
+        pattern = reqs[0].pattern
+        indices = asjnp(pattern.indices)
+        indptr = asjnp(pattern.indptr)
+        for r in reqs:
+            try:
+                A = csr_array.from_parts(
+                    asjnp(r.values.astype(dt)), indices, indptr,
+                    pattern.shape,
+                )
+                b = asjnp(r.b.astype(dt))
+                maxiter = (
+                    r.maxiter if r.maxiter is not None
+                    else pattern.shape[0] * 10
+                )
+                if solver == "gmres":
+                    x, iters = linalg.gmres(
+                        A, b, tol=0.0, atol=r.tol, restart=self.restart
+                    )
+                elif solver == "bicgstab":
+                    x, iters = linalg.bicgstab(
+                        A, b, tol=r.tol, maxiter=maxiter
+                    )
+                else:
+                    x, iters = linalg.cg(A, b, tol=r.tol, maxiter=maxiter)
+                resid2 = float(
+                    np.linalg.norm(r.b - np.asarray(A @ asjnp(np.asarray(x))))
+                    ** 2
+                )
+                r.ticket._offer(
+                    np.asarray(x), iters, resid2,
+                    np.isfinite(resid2) and resid2 <= r.tol ** 2,
+                    solver=solver,
+                )
+            except Exception as e:  # noqa: BLE001 - lane isolation
+                r.ticket._fail(e)
+
+    def _build_program(self, pattern: SparsityPattern, bkt: int, dt,
+                       solver: str | None = None):
         """The per-bucket compiled program: pattern pack + masked solver
         loop under ONE ``jax.jit`` whose arguments are the value stack,
         rhs, x0 and tolerances — so same-bucket dispatches with fresh
         coefficients reuse the executable (no constants captured from
         any particular batch)."""
-        if self.solver == "gmres":
+        solver = solver or self.solver
+        if solver == "gmres":
             return self._build_gmres_program(pattern, bkt, dt)
         pack = pattern.sell_pack()
         idx_slabs, pos, zero_rows = (
             pack.idx_slabs, pack.pos, pack.plan.zero_rows
         )
         loop = (
-            krylov._cg_loop if self.solver == "cg"
+            krylov._cg_loop if solver == "cg"
             else krylov._bicgstab_loop
         )
         cti = self.conv_test_iters
@@ -284,7 +564,8 @@ class SolveSession:
                     idx_slabs, vals, pos, X, zero_rows
                 )
 
-            return loop(mv, rhs, x0, tols, maxiter, cti)
+            return loop(krylov._maybe_faulty_mv(mv), rhs, x0, tols,
+                        maxiter, cti)
 
         return run
 

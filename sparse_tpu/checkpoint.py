@@ -17,11 +17,13 @@ from __future__ import annotations
 
 import os
 import tempfile
+import zipfile
 
 import numpy as np
 import jax.numpy as jnp
 
-from .utils import asjnp
+from .resilience import faults as _faults
+from .utils import asjnp, user_warning
 
 __all__ = ["CheckpointManager", "checkpointed_cg", "checkpointed_solve_ivp"]
 
@@ -53,11 +55,31 @@ class CheckpointManager:
             raise
 
     def load(self):
+        """Returns ``(step, arrays)`` or ``(None, None)`` when no usable
+        checkpoint exists. A corrupt/truncated file (torn disk, partial
+        copy — the atomic-rename write can't protect against external
+        damage) is treated as *absent*, with a warning and a
+        ``checkpoint.corrupt`` telemetry event: load() is called
+        mid-recovery, where raising would turn a degraded solve into a
+        dead one (ISSUE 5 satellite)."""
         if not os.path.exists(self.path):
             return None, None
-        with np.load(self.path, allow_pickle=False) as z:
-            step = int(z["__step__"])
-            out = {k: z[k] for k in z.files if k != "__step__"}
+        try:
+            with np.load(self.path, allow_pickle=False) as z:
+                step = int(z["__step__"])
+                out = {k: z[k] for k in z.files if k != "__step__"}
+        except (OSError, ValueError, KeyError, EOFError,
+                zipfile.BadZipFile) as e:
+            user_warning(
+                f"checkpoint {self.path!r} is corrupt/truncated "
+                f"({e!r}); ignoring it"
+            )
+            from . import telemetry
+
+            telemetry.record(
+                "checkpoint.corrupt", path=self.path, error=repr(e)[:200]
+            )
+            return None, None
         return step, out
 
     def delete(self):
@@ -116,6 +138,11 @@ def checkpointed_cg(A, b, path, tol=1e-8, maxiter=None, chunk=250,
         lambda s: jax.lax.while_loop(cond, body, s)
     )
     while done < maxiter and bool(jnp.real(rho) > tol2):
+        if _faults.ACTIVE:
+            # chunk boundaries are exactly where real preemption is
+            # survivable (the last save covers everything before here) —
+            # the injected preemption fires at the same points
+            _faults.check_preempt("cg.checkpoint.chunk")
         # cap the chunk to the remaining budget (a traced scalar: the
         # final short chunk does not recompile)
         cap = jnp.int32(min(chunk, maxiter - done))

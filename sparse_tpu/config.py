@@ -28,6 +28,9 @@ Flags (all env-overridable):
                                 events, kernel counters, comm volumes, JSONL session log.
   SPARSE_TPU_TELEMETRY_PATH   - JSONL sink override (default results/axon/records.jsonl).
   SPARSE_TPU_TELEMETRY_RING   - in-memory event ring capacity (default 4096).
+  SPARSE_TPU_FAULTS           - fault-injection spec (sparse_tpu.resilience.faults), e.g.
+                                "nonfinite:matvec:p=0.01,seed=7;fail:pallas". Empty
+                                (default) = injection machinery entirely inert.
 """
 
 from __future__ import annotations
@@ -161,6 +164,11 @@ class Settings:
     telemetry_ring: int = field(
         default_factory=lambda: max(_env_int("SPARSE_TPU_TELEMETRY_RING", 4096), 16)
     )
+    # Fault injection (sparse_tpu.resilience.faults): a seeded chaos spec
+    # ("fault:site:k=v,..." clauses, ";"-separated — docs/resilience.md).
+    # Empty = off: every hook is a single module-boolean check and no
+    # wrapper is installed anywhere (traced programs byte-identical).
+    faults: str = field(default_factory=lambda: _env_str("SPARSE_TPU_FAULTS", ""))
 
 
 settings = Settings()

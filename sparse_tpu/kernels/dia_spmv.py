@@ -248,7 +248,8 @@ _TILE_CACHE: dict = {}
 # wrapped kernels are a known worker-fault class on the tunnel backend, and
 # repeated faulting attempts are the main tunnel-wedge trigger — so after
 # the FIRST failure anywhere (any geometry, any call) the compiled clock is
-# never attempted again this process (same pattern as _PALLAS_UNAVAILABLE).
+# never attempted again this process (same one-time-latch pattern as the
+# resilience.failover registry, but autotune-local).
 _CHAIN_RETIRED = [False]
 
 
@@ -463,7 +464,8 @@ class PreparedDia:
         return y[: self.plan.m]
 
 
-_PALLAS_UNAVAILABLE = object()  # per-object marker: no Mosaic lowering here
+#: failover-registry kernel name (resilience/failover.py)
+DIA_KERNEL = "dia_spmv"
 
 
 def cached_prepared_spmv(obj, attr: str, data, offsets, shape, x):
@@ -475,80 +477,36 @@ def cached_prepared_spmv(obj, attr: str, data, offsets, shape, x):
     ``sparse_tpu.plan_cache`` (weak-ref keyed under ``attr``) and applies
     it. Fresh objects from ``_with_data``/constructors are new cache keys,
     so mutation invalidates the plan for free.
+
+    Failure handling lives in the shared failover registry
+    (``sparse_tpu.resilience.failover``): this site classifies with the
+    strict lowering-unavailability vocabulary (``vocab=True`` — on a
+    real TPU only the historical interpret-mode message is benign, a
+    genuine Mosaic compile regression stays LOUD; off-TPU any
+    lowering-availability wording qualifies), honors
+    ``SPARSE_TPU_STRICT_PALLAS``, emits the consistent
+    ``kernel.failover`` event, and latches per matrix object — a latch
+    :func:`~sparse_tpu.resilience.failover.probe` can clear again when
+    the backend heals.
     """
     from .. import plan_cache
     from ..config import settings
+    from ..resilience import failover
 
     band = max((abs(int(o)) for o in offsets), default=0)
     if band > settings.pallas_max_band:
         return None
+    if failover.failed(DIA_KERNEL, obj):
+        return None
     prepared = plan_cache.get(
         obj, attr, lambda: PreparedDia(data, offsets, shape)
     )
-    if prepared is _PALLAS_UNAVAILABLE:
-        return None
     try:
+        # forced-failure injection point, then the real kernel attempt
+        failover.maybe_inject(DIA_KERNEL)
         return prepared(x)
     except (ValueError, NotImplementedError) as e:
-        # Pallas has no lowering on this backend (e.g. the examples'
-        # CPU-scoped build phase running with spmv_mode=pallas): fail
-        # over to the XLA formulation ONCE and remember. The exact
-        # message varies across jax versions, so match any
-        # lowering-availability wording; a shape/dtype mismatch (a real
-        # caller error) matches none of these and is re-raised.
-        msg = str(e).lower()
-        if jax.default_backend() == "tpu":
-            # on the REAL kernel target only the historical interpret-mode
-            # message is a known-benign unavailability; anything else
-            # (e.g. a genuine Mosaic compile regression) must stay LOUD —
-            # a silent XLA fallback would mask a kernel regression while
-            # the bench still claims the Pallas path
-            unavailable = "interpret mode" in msg
-        else:
-            # off-TPU (CPU build phases, tests) the wording varies across
-            # jax versions; match the lowering-availability vocabulary
-            unavailable = isinstance(e, NotImplementedError) or any(
-                s in msg
-                for s in (
-                    "interpret mode",
-                    "lowering",
-                    "not implemented",
-                    "unsupported backend",
-                    "unimplemented",
-                    "mosaic",
-                )
-            )
-        if not unavailable:
-            raise
-        # Strict mode (opt-in; THIS repo's tests/conftest.py sets it): the
-        # broad off-TPU match could mask a genuine kernel regression
-        # behind the XLA fallback, so re-raise pattern-matched
-        # ValueErrors (the likely kernel-bug shape: Mosaic/lowering
-        # errors wrap as ValueError). A bare NotImplementedError is the
-        # canonical lowering-genuinely-absent signal on minimal jax
-        # builds and keeps the failover even in strict mode. Downstream
-        # test suites that never set SPARSE_TPU_STRICT_PALLAS keep the
-        # documented production failover unconditionally.
-        import os
-
-        if os.environ.get("SPARSE_TPU_STRICT_PALLAS") and not isinstance(
-            e, NotImplementedError
-        ):
-            raise
-        # never swallow silently: if this was a genuine kernel bug whose
-        # message merely pattern-matched, the warning is the breadcrumb
-        from .. import telemetry
-        from ..utils import user_warning
-
-        user_warning(
-            "Pallas DIA SpMV unavailable; failing over to the XLA "
-            f"formulation permanently for this matrix: {e!r}"
-        )
-        telemetry.record(
-            "kernel.failover", kernel="dia_spmv", error=repr(e)[:200],
-            backend=jax.default_backend(),
-        )
-        plan_cache.put(obj, attr, _PALLAS_UNAVAILABLE)
+        failover.handle(DIA_KERNEL, obj, e, vocab=True)
         return None
 
 

@@ -339,14 +339,16 @@ class PreparedCSR:
     lowering), the XLA slab formulation otherwise.
     """
 
-    __slots__ = ("plan", "slabs", "pos", "_pallas_ok")
+    __slots__ = ("plan", "slabs", "pos", "__weakref__")
+
+    #: failover-registry kernel name (resilience/failover.py)
+    KERNEL = "sell_spmv"
 
     def __init__(self, indptr, indices, data, shape, C=None, sigma=None,
                  max_slabs=None):
         self.plan, self.slabs, self.pos = sell_pack(
             indptr, indices, data, shape, C=C, sigma=sigma, max_slabs=max_slabs
         )
-        self._pallas_ok = None  # None = untried, False = failed over
         from .. import telemetry
 
         telemetry.count("kernel.sell_pack")
@@ -356,7 +358,9 @@ class PreparedCSR:
         return (self.plan.m, self.plan.n)
 
     def _pallas_viable(self, x) -> bool:
-        if self._pallas_ok is False or not self.slabs:
+        from ..resilience import failover
+
+        if failover.failed(self.KERNEL, self) or not self.slabs:
             return False
         if x.shape[0] > PALLAS_MAX_X:
             return False
@@ -380,36 +384,31 @@ class PreparedCSR:
             self.slabs, self.pos, jnp.asarray(B), self.plan.zero_rows
         )
 
+    def probe_pallas(self, x=None) -> bool:
+        """Probe-based reinstate hook: run one real Pallas matvec; on
+        success any failover latch for this operator clears
+        (``kernel.reinstate`` event) and later calls retry the kernel."""
+        from ..resilience import failover
+
+        if x is None:
+            x = jnp.zeros((self.plan.n,), dtype=jnp.float32)
+        return failover.probe(
+            self.KERNEL, self,
+            lambda: jax.block_until_ready(self.matvec_pallas(x)),
+        )
+
     def __call__(self, x):
         from .. import telemetry
         from ..config import settings
+        from ..resilience import failover
 
         telemetry.count("kernel.sell_spmv")
         if settings.spmv_mode == "pallas" and self._pallas_viable(x):
             try:
-                y = self.matvec_pallas(x)
-                self._pallas_ok = True
-                return y
+                # forced-failure injection + the shared one-time
+                # Pallas->XLA failover ladder (resilience/failover.py)
+                failover.maybe_inject(self.KERNEL)
+                return self.matvec_pallas(x)
             except (ValueError, NotImplementedError) as e:
-                # No Mosaic lowering for the in-VMEM gather on this
-                # backend: fail over to the XLA formulation ONCE and
-                # remember — same discipline (and strict-mode escape
-                # hatch) as kernels.dia_spmv.cached_prepared_spmv.
-                import os
-
-                if os.environ.get("SPARSE_TPU_STRICT_PALLAS") and not isinstance(
-                    e, NotImplementedError
-                ):
-                    raise
-                from ..utils import user_warning
-
-                user_warning(
-                    "Pallas SELL SpMV unavailable; failing over to the XLA "
-                    f"formulation permanently for this operator: {e!r}"
-                )
-                telemetry.record(
-                    "kernel.failover", kernel="sell_spmv", error=repr(e)[:200],
-                    backend=jax.default_backend(),
-                )
-                self._pallas_ok = False
+                failover.handle(self.KERNEL, self, e)
         return self.matvec_xla(x)

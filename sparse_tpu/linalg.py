@@ -24,6 +24,7 @@ import numpy as np
 from . import telemetry
 from .base import SparseArray
 from .coverage import track_provenance
+from .resilience import faults as _faults
 from .utils import asjnp, host_int
 from ._direct import (  # noqa: F401  (re-exported scipy.sparse.linalg surface)
     SpILU,
@@ -303,19 +304,50 @@ class _DenseMatrixLinearOperator(LinearOperator):
         return self.A @ X
 
 
+class _FaultyOperator(LinearOperator):
+    """Fault-injection wrapper (resilience.faults): matvec outputs pass
+    through the seeded corruption callback. Only ever constructed when a
+    matvec fault clause is active — clean builds never see this class in
+    a trace (the zero-code-path-change contract)."""
+
+    _fault_wrapped = True
+
+    def __init__(self, base):
+        super().__init__(base.shape, dtype=base.dtype)
+        self._base = base
+
+    def matvec(self, x, out=None):
+        return _faults.corrupt_traced(self._base.matvec(x))
+
+    def rmatvec(self, x, out=None):
+        return self._base.rmatvec(x)
+
+    def matmat(self, X, out=None):
+        return self._base.matmat(X)
+
+
+def _maybe_faulty(op: LinearOperator) -> LinearOperator:
+    if getattr(op, "_fault_wrapped", False) or not _faults.targets("matvec"):
+        return op
+    return _FaultyOperator(op)
+
+
 def make_linear_operator(A) -> LinearOperator:
     if isinstance(A, LinearOperator):
-        return A
+        return _maybe_faulty(A) if _faults.ACTIVE else A
     if isinstance(A, SparseArray):
-        return _SparseMatrixLinearOperator(A)
-    from .batch.operator import BatchedOperator
+        op = _SparseMatrixLinearOperator(A)
+    else:
+        from .batch.operator import BatchedOperator
 
-    if isinstance(A, BatchedOperator):
-        # a batch of B independent systems IS one (B*m, B*n) block-
-        # diagonal system: the unbatched solver surface keeps working on
-        # batched operators through this view (docs/batching.md)
-        return A.as_block_operator()
-    return _DenseMatrixLinearOperator(A)
+        if isinstance(A, BatchedOperator):
+            # a batch of B independent systems IS one (B*m, B*n) block-
+            # diagonal system: the unbatched solver surface keeps working
+            # on batched operators through this view (docs/batching.md)
+            op = A.as_block_operator()
+        else:
+            op = _DenseMatrixLinearOperator(A)
+    return _maybe_faulty(op) if _faults.ACTIVE else op
 
 
 aslinearoperator = make_linear_operator
@@ -352,7 +384,9 @@ def _vdot(a, b):
 # it already fetches per conv-test chunk (zero extra syncs).
 
 
-def _solve_event(solver: str, n, iters, path: str, resid2=None) -> None:
+def _solve_event(
+    solver: str, n, iters, path: str, resid2=None, converged=None
+) -> None:
     """One ``solver.solve`` event per completed solve (any path); also
     finalizes the health monitor's report for this solve
     (``telemetry.last_solve_report()``)."""
@@ -361,8 +395,12 @@ def _solve_event(solver: str, n, iters, path: str, resid2=None) -> None:
     fields = {"solver": solver, "n": int(n), "iters": int(iters), "path": path}
     if resid2 is not None:
         fields["resid2"] = float(resid2)
+    if converged is not None:
+        fields["converged"] = bool(converged)
     telemetry.record("solver.solve", **fields)
-    telemetry.health.end_solve(solver, iters, resid2=resid2, path=path)
+    telemetry.health.end_solve(
+        solver, iters, resid2=resid2, converged=converged, path=path
+    )
 
 
 def _make_iter_tap(solver: str, path: str = "device"):
@@ -422,8 +460,15 @@ def cg(
     if M is None and callback is None:
         fused = _try_fused_cg(A, b, x0, tol, maxiter, conv_test_iters)
         if fused is not None:
-            _solve_event("cg", n, fused[1], "fused")
-            return fused
+            x_f, it_f, rho_f, info_f = fused
+            # info_f != 0 distinguishes a nonfinite-rho exit (-1) and a
+            # maxiter exit (iters) from convergence (0) — the final rho
+            # rides the health report so the recovery policy engine sees
+            # breakdowns even on paths with no per-iter taps (ISSUE 5)
+            _solve_event(
+                "cg", n, it_f, "fused", resid2=rho_f, converged=info_f == 0
+            )
+            return x_f, it_f
     A = make_linear_operator(A)
     M = IdentityOperator(A.shape, dtype=A.dtype) if M is None else make_linear_operator(M)
     x = jnp.zeros_like(b) if x0 is None else asjnp(x0)
@@ -463,8 +508,10 @@ def _try_fused_cg(A, b, x0, tol, maxiter, conv_test_iters):
     in conv-test-sized chunks with one host rho fetch per chunk — the
     same iterates and stopping rule as ``_cg_device_loop`` (absolute
     ||r|| < tol every conv_test_iters), at ~2x the step-loop throughput
-    on real TPUs (BENCH_NOTES.md). Returns (x, iters) or None when the
-    path doesn't apply.
+    on real TPUs (BENCH_NOTES.md). Returns ``(x, iters, rho_f, info)`` —
+    ``info`` 0 = converged, -1 = nonfinite rho (breakdown/corruption; NOT
+    the same exit as convergence — ISSUE 5 satellite), iters = maxiter
+    exhausted — or None when the path doesn't apply.
     """
     import jax
 
@@ -472,6 +519,11 @@ def _try_fused_cg(A, b, x0, tol, maxiter, conv_test_iters):
 
     mode = settings.fused_cg
     if not mode:
+        return None
+    if _faults.ACTIVE and _faults.targets("matvec"):
+        # matvec corruption injects through the operator wrapper, which
+        # the fused kernel bypasses — take the standard loop so the
+        # chaos spec actually applies to this solve
         return None
     interpret = False
     if jax.default_backend() != "tpu":
@@ -536,7 +588,12 @@ def _try_fused_cg(A, b, x0, tol, maxiter, conv_test_iters):
     state = None
     iters = 0
     x = None
+    rho_f = None
     while iters < maxiter:
+        if _faults.ACTIVE:
+            # chunk boundaries are the preemption points this loop
+            # survives (the carry state is host-visible here)
+            _faults.check_preempt("cg.fused.chunk")
         # mirror _cg_device_loop's test points exactly: every conv_test
         # iterations AND at iters == maxiter - 1 (so a solve converging at
         # the last test reports maxiter-1, not maxiter). The off-size last
@@ -559,9 +616,15 @@ def _try_fused_cg(A, b, x0, tol, maxiter, conv_test_iters):
                 resid2=rho_f, chunk=k,
             )
             telemetry.health.observe("cg", iters, rho_f, path="fused")
-        if rho_f < tol2 or not np.isfinite(rho_f):
-            break
-    return x, iters
+        if not np.isfinite(rho_f):
+            # a nonfinite rho is a BREAKDOWN exit, not convergence: flag
+            # it so callers (and the recovery policy via the health
+            # report) can tell the two apart (ISSUE 5 satellite)
+            return x, iters, rho_f, -1
+        if rho_f < tol2:
+            return x, iters, rho_f, 0
+    info = 0 if (rho_f is not None and rho_f < tol2) else iters
+    return x, iters, rho_f, info
 
 
 def _cg_device_loop(A, b, x, r, tol, maxiter, M, conv_test_iters):
@@ -799,7 +862,20 @@ def bicgstab(A, b, x0=None, tol=1e-08, maxiter=None, callback=None, conv_test_it
     r = b - A.matvec(x)
     rtilde = r
     tol2 = jnp.asarray(tol, dtype=jnp.real(r).dtype) ** 2
-    tap = _make_iter_tap("bicgstab")
+    base_tap = _make_iter_tap("bicgstab")
+    tap = None
+    if base_tap is not None:
+        # same tap cadence, two more scalars: |rho|, |omega| feed the
+        # health monitor's breakdown detector — the rho/omega breakdowns
+        # the recurrence silently where-guards become observable
+        # `solver.anomaly reason=breakdown` events the recovery policy
+        # escalates on (ISSUE 5)
+        def tap(i, rn2, abs_rho, abs_omega):
+            base_tap(i, rn2)
+            telemetry.health.observe_breakdown(
+                "bicgstab", int(i), float(abs_rho), float(abs_omega),
+                resid2=float(rn2),
+            )
 
     def body(state):
         x, r, p, v, rho, alpha, omega, iters = state
@@ -818,7 +894,10 @@ def bicgstab(A, b, x0=None, tol=1e-08, maxiter=None, callback=None, conv_test_it
         x_n = x + alpha_n * p_n + omega_n * s
         r_n = s - omega_n * t
         if tap is not None:
-            jax.debug.callback(tap, iters + 1, jnp.real(_vdot(r_n, r_n)))
+            jax.debug.callback(
+                tap, iters + 1, jnp.real(_vdot(r_n, r_n)),
+                jnp.abs(rho_new), jnp.abs(omega_n),
+            )
         return x_n, r_n, p_n, v_n, rho_new, alpha_n, omega_n, iters + 1
 
     def cond(state):

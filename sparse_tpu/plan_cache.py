@@ -125,8 +125,7 @@ def lookup(obj, kind: str):
 
 
 def put(obj, kind: str, plan) -> None:
-    """Store/replace a plan directly (no hit/miss accounting) — the
-    failover-marker form (``kernels.dia_spmv._PALLAS_UNAVAILABLE``).
+    """Store/replace a plan directly (no hit/miss accounting).
     Silently a no-op when caching is off or ``obj`` is un-weakref-able."""
     if not settings.plan_cache:
         return

@@ -1,0 +1,57 @@
+"""sparse_tpu.resilience — fault injection + bounded, observable recovery.
+
+The detect-only observability stack (``sparse_tpu.telemetry``) gets an
+*acting* counterpart:
+
+* :mod:`.faults` — seeded, spec-driven fault injector gated by
+  ``SPARSE_TPU_FAULTS`` (matvec corruption, forced Pallas failure,
+  dispatch drop/delay, chunk-boundary preemption). Strictly zero
+  overhead and zero code-path change when unset.
+* :mod:`.failover` — the one registry behind every Pallas->XLA failover
+  (SELL, DIA, batched SELL): consistent ``kernel.failover`` events,
+  strict-mode rules in one place, and a probe-based reinstate hook.
+* :mod:`.policy` — the recovery engine: health verdicts -> bounded retry
+  ladder (restart from iterate, BiCGStab-breakdown -> GMRES escalation,
+  nonfinite -> checkpoint rollback / clean re-solve) with per-solve
+  attempt + deadline budgets, emitting ``solver.retry`` /
+  ``solver.recovered`` / ``solver.giveup``.
+
+The resilient :class:`~sparse_tpu.batch.service.SolveSession` (ticket
+deadlines, failed-lane requeue, degraded mode) builds on the same
+pieces. docs/resilience.md is the human-facing guide.
+"""
+
+from __future__ import annotations
+
+from . import failover, faults  # noqa: F401
+from .failover import InjectedPallasFailure  # noqa: F401
+from .faults import FaultSpecError, Preempted  # noqa: F401
+
+__all__ = [
+    "FaultSpecError",
+    "InjectedPallasFailure",
+    "Preempted",
+    "RecoveryInfo",
+    "RecoveryPolicy",
+    "failover",
+    "faults",
+    "policy",
+    "solve_with_recovery",
+]
+
+
+def __getattr__(name):
+    # policy imports linalg (lazily at call time, but keep the package
+    # import light and cycle-proof anyway): resolve on first touch
+    if name in ("policy", "RecoveryPolicy", "RecoveryInfo",
+                "solve_with_recovery"):
+        import importlib
+
+        _policy = importlib.import_module(".policy", __name__)
+
+        globals()["policy"] = _policy
+        globals()["RecoveryPolicy"] = _policy.RecoveryPolicy
+        globals()["RecoveryInfo"] = _policy.RecoveryInfo
+        globals()["solve_with_recovery"] = _policy.solve_with_recovery
+        return globals()[name]
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -1,0 +1,346 @@
+"""Recovery policy engine: turn solver-health verdicts into bounded action.
+
+``telemetry/_health.py`` *detects* (nonfinite, divergence, stagnation,
+breakdown); nothing in the stack acted on a detection before this module
+— a NaN'd 10k-iteration solve simply returned garbage. The engine runs a
+solve through a bounded retry ladder (in the spirit of
+interpolation-restart resilience for Krylov methods):
+
+==============  =========================================================
+verdict         action
+==============  =========================================================
+stagnation      restart the same solver from the current (best) iterate;
+                a second stagnation escalates down the solver ladder
+                (cg -> bicgstab -> gmres)
+breakdown       BiCGStab rho/omega breakdown (detected by the health
+                monitor's breakdown tap; silently ``where``-guarded in
+                the recurrence itself): escalate straight to GMRES
+nonfinite       roll back to the last ``CheckpointManager`` state when
+                one is wired, else clean re-solve from zero
+preempt         injected/real preemption at a chunk boundary: resume
+                from checkpoint/best iterate
+==============  =========================================================
+
+Every retry emits a ``solver.retry`` event (+ ``resilience.retries``
+metrics counter); a solve that converges after >= 1 retry emits
+``solver.recovered``; an exhausted attempt/deadline budget emits
+``solver.giveup``. Those chains (``fault.injected -> solver.retry ->
+solver.recovered``) are what ``scripts/chaos_check.py`` and the
+acceptance test assert through ``axon_report``.
+
+Residual verification runs under :func:`faults.suspended` so the check
+itself is pristine even when the operator is fault-wrapped, and uses the
+same convergence convention as the underlying solver (absolute ``||r|| <
+tol`` for CG/BiCGStab, ``max(tol * ||b||, atol)`` for GMRES).
+"""
+
+from __future__ import annotations
+
+import math
+import time
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from ..config import settings
+from ..telemetry import _metrics
+from . import faults
+
+__all__ = [
+    "RecoveryInfo",
+    "RecoveryPolicy",
+    "solve_with_recovery",
+]
+
+_RETRIES = _metrics.counter("resilience.retries")
+_RECOVERED = _metrics.counter("resilience.recovered")
+_GIVEUPS = _metrics.counter("resilience.giveups")
+
+#: escalation ladder: where a solver goes when restarting stops helping
+ESCALATION = {"cg": "bicgstab", "bicgstab": "gmres", "gmres": "gmres"}
+
+
+@dataclass
+class RecoveryPolicy:
+    """Attempt/deadline budgets and ladder knobs for one recovered solve.
+
+    ``max_attempts`` counts solve attempts including the first;
+    ``deadline_s`` is wall-clock for the whole ladder (checked between
+    attempts — a running attempt is never interrupted). ``escalate``
+    overrides the solver ladder; ``restart_first`` is how many
+    non-improving same-solver restarts a stagnating solve gets before
+    escalating (an attempt that *improved* the best residual always
+    restarts for free — progress is never punished with an escalation).
+    ``segment_iters``: once a nonfinite/preempt verdict appears, later
+    attempts advance in verified segments of this many iterations from
+    the best iterate, so a corruption mid-solve costs one segment of
+    progress instead of the whole solve (interpolation-restart style);
+    corrupted segments HALVE the segment (floor 8) — under heavy
+    corruption shorter segments are exponentially more likely to
+    complete clean — and each clean segment doubles it back toward the
+    full length (AIMD, so the cadence tracks the corruption rate).
+    ``verify_factor`` relaxes the pristine residual check (the solvers
+    test their *recurrence* residual; the true residual can sit slightly
+    above it in low precision)."""
+
+    max_attempts: int = 4
+    deadline_s: float | None = None
+    escalate: dict = field(default_factory=lambda: dict(ESCALATION))
+    restart_first: int = 1
+    segment_iters: int | None = 50
+    verify_factor: float = 1.0
+
+    def next_solver(self, solver: str) -> str:
+        return self.escalate.get(solver, "gmres")
+
+
+@dataclass
+class RecoveryInfo:
+    """Outcome of :func:`solve_with_recovery`."""
+
+    converged: bool
+    attempts: int
+    iters_total: int
+    resid: float
+    solver: str  # the solver that produced the returned iterate
+    recovered: bool  # converged after at least one retry
+    gave_up_reason: str | None = None
+    history: list = field(default_factory=list)  # per-attempt dicts
+
+
+def _finite(x) -> bool:
+    return bool(np.isfinite(np.asarray(x)).all())
+
+
+def _verify(op, b_np, x, target: float):
+    """Pristine residual check: ``(rnorm, finite, converged)``. One
+    matvec under ``faults.suspended()``."""
+    with faults.suspended():
+        xa = np.asarray(x)
+        if not np.isfinite(xa).all():
+            return math.inf, False, False
+        r = b_np - np.asarray(op.matvec(x))
+    finite = bool(np.isfinite(r).all())
+    rnorm = float(np.linalg.norm(r)) if finite else math.inf
+    return rnorm, finite, rnorm <= target
+
+
+def _health_reasons() -> set:
+    """Anomaly reasons of the most recent solve (empty when telemetry is
+    off — the engine then falls back to residual-only classification)."""
+    if not settings.telemetry:
+        return set()
+    from .. import telemetry
+
+    rep = telemetry.last_solve_report()
+    if not rep:
+        return set()
+    return {a.get("reason") for a in rep.get("anomalies", ())}
+
+
+def _run_attempt(solver: str, A, b, x0, tol, target, maxiter, restart, M):
+    """Dispatch one attempt through the public linalg solvers. Returns
+    ``(x, iters)``; lets :class:`faults.Preempted` propagate."""
+    from .. import linalg
+
+    if solver == "cg":
+        return linalg.cg(A, b, x0=x0, tol=tol, maxiter=maxiter, M=M)
+    if solver == "bicgstab":
+        return linalg.bicgstab(A, b, x0=x0, tol=tol, maxiter=maxiter)
+    if solver == "gmres":
+        # drive GMRES to the ladder's ABSOLUTE target via atol so an
+        # escalated attempt meets the original solver's stopping rule
+        return linalg.gmres(
+            A, b, x0=x0, tol=0.0, atol=target, restart=restart, M=M
+        )
+    raise ValueError(f"unknown solver {solver!r}")
+
+
+def solve_with_recovery(
+    A,
+    b,
+    solver: str = "cg",
+    tol: float = 1e-8,
+    maxiter=None,
+    x0=None,
+    M=None,
+    restart=None,
+    policy: RecoveryPolicy | None = None,
+    checkpoint=None,
+):
+    """Solve ``A x = b`` with bounded, observable recovery.
+
+    ``checkpoint`` is an optional :class:`~sparse_tpu.checkpoint.
+    CheckpointManager` (or path): finite improving iterates are persisted
+    between attempts and a nonfinite/preempted attempt rolls back to the
+    last saved state instead of restarting from zero. Returns
+    ``(x, RecoveryInfo)``; never raises on solver failure — an exhausted
+    budget returns the best iterate with ``info.converged=False`` and a
+    ``solver.giveup`` event.
+    """
+    from .. import linalg, telemetry
+    from ..utils import asjnp
+
+    pol = policy or RecoveryPolicy()
+    if checkpoint is not None and not hasattr(checkpoint, "load"):
+        from ..checkpoint import CheckpointManager
+
+        checkpoint = CheckpointManager(checkpoint)
+    op = linalg.make_linear_operator(A)
+    b_np = np.asarray(b)
+    n = b_np.shape[0]
+    if maxiter is None:
+        maxiter = 10 * n
+    # the underlying solvers test absolute ||r|| < tol (gmres: relative,
+    # floored by atol) — verify against the matching target
+    bnorm = float(np.linalg.norm(b_np))
+    target = float(tol) * max(bnorm, 1.0) if solver == "gmres" else float(tol)
+
+    verify_target = target * max(float(pol.verify_factor), 1.0)
+    t0 = time.monotonic()
+    cur_solver = solver
+    cur_x0 = x0
+    attempt_maxiter = maxiter
+    seg = None  # None until the first nonfinite/preempt verdict
+    restarts_used = 0
+    iters_total = 0
+    history: list = []
+    best_x = None
+    best_rnorm = math.inf
+
+    for attempt in range(1, max(int(pol.max_attempts), 1) + 1):
+        reason = None
+        x = None
+        iters = 0
+        prev_best = best_rnorm
+        try:
+            x, iters = _run_attempt(
+                cur_solver, A, asjnp(b), cur_x0, tol, target,
+                attempt_maxiter, restart, M,
+            )
+            iters_total += int(iters)
+            rnorm, finite, ok = _verify(op, b_np, x, verify_target)
+        except faults.Preempted as e:
+            reason, rnorm, finite, ok = "preempt", math.inf, False, False
+            history.append(
+                {"attempt": attempt, "solver": cur_solver,
+                 "reason": "preempt", "error": str(e)}
+            )
+        if reason is None:
+            history.append(
+                {"attempt": attempt, "solver": cur_solver,
+                 "iters": int(iters), "rnorm": rnorm}
+            )
+            if finite and rnorm < best_rnorm:
+                best_x, best_rnorm = x, rnorm
+                if checkpoint is not None:
+                    checkpoint.save(attempt, x=np.asarray(x))
+            if ok:
+                recovered = attempt > 1
+                if recovered:
+                    _RECOVERED.inc()
+                    telemetry.record(
+                        "solver.recovered", solver=cur_solver,
+                        attempts=attempt, iters_total=iters_total,
+                        resid=rnorm, requested=solver,
+                    )
+                return x, RecoveryInfo(
+                    converged=True, attempts=attempt,
+                    iters_total=iters_total, resid=rnorm,
+                    solver=cur_solver, recovered=recovered,
+                    history=history,
+                )
+            # classify the failure (health verdicts refine the residual
+            # view: breakdown is only visible through the monitor's tap)
+            verdicts = _health_reasons()
+            if not finite:
+                reason = "nonfinite"
+            elif "breakdown" in verdicts:
+                reason = "breakdown"
+            else:
+                reason = "stagnation"
+
+        # -- budget gates ---------------------------------------------------
+        gave_up = None
+        if attempt >= pol.max_attempts:
+            gave_up = "attempts"
+        elif pol.deadline_s is not None and (
+            time.monotonic() - t0
+        ) >= pol.deadline_s:
+            gave_up = "deadline"
+        if gave_up:
+            _GIVEUPS.inc()
+            telemetry.record(
+                "solver.giveup", solver=cur_solver, attempts=attempt,
+                reason=gave_up, last_verdict=reason, resid=best_rnorm,
+                requested=solver,
+            )
+            x_out = best_x if best_x is not None else (
+                x if x is not None else asjnp(np.zeros_like(b_np))
+            )
+            return x_out, RecoveryInfo(
+                converged=False, attempts=attempt,
+                iters_total=iters_total, resid=best_rnorm,
+                solver=cur_solver, recovered=False,
+                gave_up_reason=gave_up, history=history,
+            )
+
+        # -- ladder ---------------------------------------------------------
+        improved = (
+            reason not in ("nonfinite", "preempt")
+            and math.isfinite(best_rnorm)
+            and best_rnorm < prev_best * (1.0 - 1e-3)
+        )
+        if reason == "breakdown":
+            action = "escalate"
+            cur_solver = "gmres"
+            cur_x0 = best_x
+        elif reason in ("nonfinite", "preempt"):
+            state = None
+            if checkpoint is not None:
+                _, state = checkpoint.load()
+            if state is not None and "x" in state:
+                action = "rollback"
+                cur_x0 = asjnp(state["x"]).astype(b_np.dtype)
+            elif best_x is not None:
+                action = "rollback"
+                cur_x0 = best_x
+            else:
+                action = "clean"
+                cur_x0 = None
+            if pol.segment_iters:
+                # advance in verified segments from here on: a repeat
+                # corruption costs one segment, not the whole solve.
+                # AIMD on the segment length: halve per corrupted
+                # segment (shorter segments are exponentially likelier
+                # to complete clean), double back per clean one below.
+                seg = max(
+                    (seg if seg is not None
+                     else 2 * int(pol.segment_iters)) // 2, 8,
+                )
+                attempt_maxiter = seg
+        else:  # stagnation
+            if seg is not None:
+                # last segment completed clean: grow back toward full
+                seg = min(seg * 2, max(int(pol.segment_iters), 1))
+                attempt_maxiter = seg
+            if improved:
+                # the attempt made real progress (short maxiter budget,
+                # verified segment): keep going from the best iterate —
+                # progress never consumes the restart budget
+                action = "restart"
+            elif restarts_used < pol.restart_first:
+                action = "restart"
+                restarts_used += 1
+            else:
+                action = "escalate"
+                cur_solver = pol.next_solver(cur_solver)
+                restarts_used = 0
+            cur_x0 = best_x if best_x is not None else x
+        _RETRIES.inc()
+        _metrics.counter("resilience.retries.by_reason", reason=reason).inc()
+        telemetry.record(
+            "solver.retry", solver=cur_solver, attempt=attempt,
+            reason=reason, action=action, requested=solver,
+            resid=best_rnorm if math.isfinite(best_rnorm) else None,
+        )

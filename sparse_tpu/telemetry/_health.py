@@ -14,7 +14,13 @@ structured ``solver.anomaly`` events:
   best value seen (the solve is actively getting worse);
 * **stagnation** — no meaningful improvement (relative ``STALL_RTOL``)
   for ``STALL_WINDOW`` consecutive observed iterations (singular or
-  indefinite systems grinding to maxiter).
+  indefinite systems grinding to maxiter);
+* **breakdown** — a Krylov scalar recurrence degenerated: BiCGStab's
+  rho or omega hit exact zero while the residual is still nonzero (the
+  recurrence silently ``where``-guards the division and stops making
+  progress). Fed by :func:`observe_breakdown` from the solver's
+  telemetry tap; the recovery policy engine
+  (``sparse_tpu.resilience.policy``) escalates such solves to GMRES.
 
 Each anomaly fires at most once per (reason, lane) per solve — a
 diverging 10k-iteration solve is one event, not 10k — and also bumps
@@ -163,6 +169,41 @@ def observe(solver: str, it: int, resid2: float, path: str = "device") -> None:
             _anomaly(rep, "divergence", it, float(resid2))
         if it - rep.best_iter >= STALL_WINDOW:
             _anomaly(rep, "stagnation", it, float(resid2))
+
+
+def observe_breakdown(
+    solver: str, it: int, abs_rho: float, abs_omega: float,
+    resid2: float | None = None, path: str = "device",
+) -> None:
+    """One (|rho|, |omega|) observation from a BiCGStab-family tap. An
+    exact zero in either scalar while the residual is still nonzero is
+    the classic breakdown the recurrences ``where``-guard silently —
+    flag it as a ``breakdown`` anomaly (throttled once per solve like
+    every other reason). A zero scalar at zero residual is just exact
+    convergence and stays silent."""
+    if not settings.telemetry:
+        return
+    if resid2 is not None and (resid2 == 0.0 or not math.isfinite(resid2)):
+        return  # converged exactly, or nonfinite (observe() flags that)
+    rho_zero = not (abs_rho > 0.0) and math.isfinite(abs_rho)
+    omega_zero = not (abs_omega > 0.0) and math.isfinite(abs_omega)
+    if not (rho_zero or omega_zero):
+        return
+    with _LOCK:
+        # like _current_for, but an observation at the CURRENT iteration
+        # attaches to the live report (this tap fires alongside the same
+        # iteration's resid2 observe(), which already advanced last_iter)
+        rep = _CURRENT
+        if (
+            rep is None
+            or rep.iters is not None
+            or rep.solver != solver
+            or rep.path != path
+            or (it is not None and it < rep.last_iter)
+        ):
+            rep = _fresh(solver, path)
+        rep.last_iter = max(rep.last_iter, int(it))
+        _anomaly(rep, "breakdown", it, resid2)
 
 
 def observe_lanes(

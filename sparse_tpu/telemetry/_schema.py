@@ -22,9 +22,27 @@ KINDS: dict[str, frozenset] = {
     # one per completed solve, every path
     "solver.solve": frozenset({"solver", "iters", "path"}),
     # a health-monitor detection (telemetry/_health.py): reason is
-    # 'nonfinite' | 'divergence' | 'stagnation'; batched solves add the
-    # lane index; at most one event per (reason, lane) per solve
+    # 'nonfinite' | 'divergence' | 'stagnation' | 'breakdown'; batched
+    # solves add the lane index; at most one event per (reason, lane)
+    # per solve
     "solver.anomaly": frozenset({"solver", "reason"}),
+    # -- resilience (sparse_tpu.resilience) ---------------------------------
+    # one injected fault firing (faults.py): site is
+    # 'matvec' | 'pallas' | 'dispatch' | 'chunk', fault the clause kind
+    "fault.injected": frozenset({"site", "fault"}),
+    # the recovery policy engine retrying a solve: reason is the health
+    # verdict ('nonfinite' | 'breakdown' | 'stagnation' | 'preempt'),
+    # action the ladder step ('restart' | 'escalate' | 'rollback' |
+    # 'clean'), solver the one the NEXT attempt runs
+    "solver.retry": frozenset({"solver", "attempt", "reason"}),
+    # a recovered solve: converged after >= 1 retry
+    "solver.recovered": frozenset({"solver", "attempts"}),
+    # attempt/deadline budget exhausted without convergence
+    "solver.giveup": frozenset({"solver", "attempts"}),
+    # a probe reinstated a previously failed-over Pallas kernel
+    "kernel.reinstate": frozenset({"kernel"}),
+    # CheckpointManager.load() skipped a corrupt/truncated .npz
+    "checkpoint.corrupt": frozenset({"path"}),
     # -- kernels (kernels/dia_spmv.py) -------------------------------------
     # a completed tile-autotune race: timings_us maps probed tile -> best
     # seconds-per-SpMV in microseconds; clock is 'compiled' | 'host'
@@ -54,6 +72,14 @@ KINDS: dict[str, frozenset] = {
     # one per completed batched Krylov solve (any entry point); B is the
     # lane count, iters_max the slowest lane's iteration count
     "batch.solve": frozenset({"solver", "B", "iters_max"}),
+    # unconverged/nonfinite lanes requeued into a fallback bucket
+    # (safer solver/dtype — docs/resilience.md)
+    "batch.requeue": frozenset({"solver", "lanes"}),
+    # a bucket degraded to per-lane eager solves (compiled path
+    # unavailable); reason carries the triggering error
+    "batch.degraded": frozenset({"solver", "reason"}),
+    # tickets failed by their per-ticket deadline before dispatch
+    "batch.deadline": frozenset({"solver", "lanes"}),
     # -- generic ------------------------------------------------------------
     "span": frozenset({"name", "dur_s"}),
     # bench.py session record (always written by a bench run, even when

@@ -69,6 +69,7 @@ _QUICK_FILES = {
     "test_plan_cache.py",
     "test_quantum.py",
     "test_quick_lane.py",
+    "test_resilience.py",
     "test_sell_spmv.py",
     "test_shard_perf.py",
     "test_spatial.py",

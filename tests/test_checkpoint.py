@@ -59,6 +59,55 @@ def test_checkpointed_cg_resumes_exactly(tmp_path):
     assert it_res >= it_part
 
 
+def test_load_tolerates_truncated_and_corrupt_npz(tmp_path):
+    """ISSUE 5 satellite: load() is called mid-recovery — a torn/corrupt
+    file must read as 'no checkpoint' (with a warning), never raise."""
+    p = tmp_path / "ck.npz"
+    m = CheckpointManager(p)
+    m.save(3, x=np.arange(32.0))
+    # truncate the zip mid-payload (external damage the atomic rename
+    # can't prevent)
+    raw = p.read_bytes()
+    p.write_bytes(raw[: len(raw) // 2])
+    with pytest.warns(UserWarning, match="corrupt/truncated"):
+        assert m.load() == (None, None)
+    # outright garbage (not a zip at all)
+    p.write_bytes(b"this is not an npz")
+    with pytest.warns(UserWarning, match="corrupt/truncated"):
+        assert m.load() == (None, None)
+    # a valid npz MISSING the step counter is corrupt too
+    np.savez(p, x=np.arange(4.0))
+    with pytest.warns(UserWarning, match="corrupt/truncated"):
+        assert m.load() == (None, None)
+    # recovery proceeds: a fresh save over the damaged file works
+    m.save(4, x=np.ones(8))
+    step, state = m.load()
+    assert step == 4 and state["x"].sum() == 8
+
+
+def test_load_corrupt_emits_telemetry_event(tmp_path):
+    from sparse_tpu import telemetry
+    from sparse_tpu.config import settings
+
+    p = tmp_path / "ck.npz"
+    m = CheckpointManager(p)
+    m.save(1, x=np.zeros(4))
+    p.write_bytes(b"garbage")
+    old = settings.telemetry
+    telemetry.configure(str(tmp_path / "records.jsonl"))
+    settings.telemetry = True
+    try:
+        with pytest.warns(UserWarning):
+            m.load()
+        (ev,) = telemetry.events("checkpoint.corrupt")
+        assert ev["path"].endswith("ck.npz")
+        assert not telemetry.schema.validate(ev)
+    finally:
+        settings.telemetry = old
+        telemetry.configure(None)
+        telemetry.reset()
+
+
 def test_checkpointed_cg_keep_on_success(tmp_path):
     n = 120
     S = _spd(n, seed=2)

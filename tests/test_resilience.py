@@ -1,0 +1,683 @@
+"""Bastion resilience subsystem (ISSUE 5): fault injector, failover
+registry, recovery policy engine, resilient SolveSession.
+
+The two load-bearing contracts:
+
+* **Zero overhead when off** — with ``SPARSE_TPU_FAULTS`` unset the
+  injection machinery must change NOTHING: no operator wrapper, jaxpr
+  byte-identical, bitwise-identical solver results, no extra host syncs.
+* **Bounded, observable recovery** — under seeded injection every
+  solver (and a ``SolveSession`` batch) converges through the retry
+  ladder, emitting the ``fault.injected -> solver.retry ->
+  solver.recovered`` chains the chaos gate asserts.
+"""
+
+import importlib.util
+import os
+import time
+
+import jax
+import numpy as np
+import pytest
+import scipy.sparse as sp
+
+import sparse_tpu
+from sparse_tpu import linalg, telemetry
+from sparse_tpu.batch import (
+    SolveSession,
+    TicketDeadlineError,
+    TicketFailedError,
+    TicketState,
+)
+from sparse_tpu.config import settings
+from sparse_tpu.resilience import (
+    FaultSpecError,
+    Preempted,
+    RecoveryPolicy,
+    failover,
+    faults,
+    solve_with_recovery,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _clean_state(tmp_path):
+    """Every test starts and ends fault-free with a scratch telemetry
+    sink (never the committed session log)."""
+    faults.clear()
+    failover.clear()
+    old_tel = settings.telemetry
+    telemetry.configure(str(tmp_path / "records.jsonl"))
+    telemetry.reset()
+    yield
+    faults.clear()
+    failover.clear()
+    settings.telemetry = old_tel
+    telemetry.configure(None)
+    telemetry.reset()
+
+
+def _spd(n=48, seed=0):
+    rng = np.random.default_rng(seed)
+    e = np.ones(n)
+    A = sp.diags([-e[:-1], 3.0 * e, -e[:-1]], [-1, 0, 1], format="csr")
+    A = A.copy()
+    A.setdiag(3.0 + rng.random(n))
+    A.sort_indices()
+    return A
+
+
+def _stack(n=48, B=4, seed=0):
+    rng = np.random.default_rng(seed)
+    mats = []
+    for _ in range(B):
+        A = _spd(n)
+        A.setdiag(3.0 + rng.random(n))
+        mats.append(A.tocsr())
+    return mats, rng.standard_normal((B, n))
+
+
+# ---------------------------------------------------------------------------
+# fault spec grammar
+# ---------------------------------------------------------------------------
+def test_spec_parse_basic():
+    (c,) = faults.parse_spec("nonfinite:matvec:p=0.01,seed=7")
+    assert c.fault == "nonfinite" and c.site == "matvec"
+    assert c.p == 0.01 and c.seed == 7 and c.n is None
+
+
+def test_spec_parse_defaults_and_multi():
+    cs = faults.parse_spec(
+        " fail:pallas:kernel=sell_spmv,n=1 ; drop:dispatch ;"
+        " preempt:chunk:p=0.5 ;"
+    )
+    assert [c.site for c in cs] == ["pallas", "dispatch", "chunk"]
+    assert cs[0].kernel == "sell_spmv" and cs[0].n == 1 and cs[0].p == 1.0
+    assert cs[1].fault == "drop" and cs[1].seed == 0
+    assert cs[2].p == 0.5
+
+
+@pytest.mark.parametrize("bad", [
+    "nonfinite",  # no site
+    "nonfinite:pallas",  # fault/site mismatch
+    "nan:matvec",  # unknown fault
+    "nonfinite:matvec:p=nope",  # bad value
+    "nonfinite:matvec:p",  # not key=value
+    "nonfinite:matvec:p=2",  # p outside [0, 1]
+])
+def test_spec_parse_errors(bad):
+    with pytest.raises(FaultSpecError):
+        faults.parse_spec(bad)
+
+
+def test_spec_env_roundtrip(monkeypatch):
+    monkeypatch.setenv("SPARSE_TPU_FAULTS", "inf:matvec:p=0.25,seed=9")
+    faults.reload_from_env()
+    assert faults.ACTIVE and faults.targets("matvec")
+    monkeypatch.delenv("SPARSE_TPU_FAULTS")
+    faults.reload_from_env()
+    assert not faults.ACTIVE
+
+
+# ---------------------------------------------------------------------------
+# injector behavior
+# ---------------------------------------------------------------------------
+def test_corrupt_array_deterministic_and_pure():
+    a = np.ones(64)
+    faults.configure("nonfinite:matvec:p=0.5,seed=42")
+    outs1 = [faults.corrupt_array(a) for _ in range(8)]
+    faults.configure("nonfinite:matvec:p=0.5,seed=42")
+    outs2 = [faults.corrupt_array(a) for _ in range(8)]
+    for o1, o2 in zip(outs1, outs2):
+        np.testing.assert_array_equal(o1, o2)
+    assert np.isfinite(a).all(), "input must never be mutated"
+    assert any(np.isnan(o).any() for o in outs1)
+
+
+def test_corrupt_kinds_and_budget():
+    a = np.ones(16)
+    faults.configure("inf:matvec:p=1,n=1")
+    o1 = faults.corrupt_array(a)
+    o2 = faults.corrupt_array(a)
+    assert np.isinf(o1).any() and np.isfinite(o2).all()  # n=1 budget
+    faults.configure("bitflip:matvec:p=1,scale=1e6")
+    o3 = faults.corrupt_array(a)
+    assert o3.max() == pytest.approx(1e6)
+
+
+def test_injection_events_and_counters():
+    settings.telemetry = True
+    before = telemetry.metrics.counter("faults.injected").value
+    faults.configure("nonfinite:matvec:p=1,seed=0")
+    faults.corrupt_array(np.ones(4))
+    evs = telemetry.events("fault.injected")
+    assert evs and evs[-1]["site"] == "matvec"
+    assert evs[-1]["fault"] == "nonfinite"
+    assert not telemetry.schema.validate(evs[-1])
+    assert telemetry.metrics.counter("faults.injected").value == before + 1
+
+
+def test_suspended_context():
+    faults.configure("nonfinite:matvec:p=1")
+    with faults.suspended():
+        assert np.isfinite(faults.corrupt_array(np.ones(4))).all()
+    assert np.isnan(faults.corrupt_array(np.ones(4))).any()
+
+
+def test_preempt_draws_and_raises():
+    faults.configure("preempt:chunk:p=1,n=2")
+    with pytest.raises(Preempted):
+        faults.check_preempt("test.site")
+    with pytest.raises(Preempted):
+        faults.check_preempt("test.site")
+    faults.check_preempt("test.site")  # budget exhausted: no raise
+
+
+# ---------------------------------------------------------------------------
+# zero overhead / zero code-path change when off
+# ---------------------------------------------------------------------------
+def test_zero_overhead_when_off():
+    S = _spd()
+    A = sparse_tpu.csr_array(S)
+    b = np.random.default_rng(1).standard_normal(S.shape[0])
+
+    def jaxpr_of():
+        op = linalg.make_linear_operator(A)
+        assert not getattr(op, "_fault_wrapped", False)
+        return str(jax.make_jaxpr(op.matvec)(b))
+
+    # baseline BEFORE any injector has ever been configured this test
+    x_ref, it_ref = linalg.cg(A, b, tol=1e-10)
+    jaxpr_ref = jaxpr_of()
+    linalg.HOST_SYNCS = 0
+    linalg.gmres(A, b, tol=1e-10)
+    syncs_ref = linalg.HOST_SYNCS
+
+    # configure + clear an injector: traces and results must be
+    # BYTE-identical afterwards — no residue of the machinery
+    faults.configure("nonfinite:matvec:p=1;fail:pallas;drop:dispatch")
+    faults.clear()
+    assert jaxpr_of() == jaxpr_ref
+    x_after, it_after = linalg.cg(A, b, tol=1e-10)
+    assert it_after == it_ref
+    np.testing.assert_array_equal(np.asarray(x_ref), np.asarray(x_after))
+    linalg.HOST_SYNCS = 0
+    linalg.gmres(A, b, tol=1e-10)
+    assert linalg.HOST_SYNCS == syncs_ref
+
+
+def test_wrapper_installed_only_when_active():
+    A = sparse_tpu.csr_array(_spd())
+    assert not getattr(
+        linalg.make_linear_operator(A), "_fault_wrapped", False
+    )
+    faults.configure("nonfinite:matvec:p=0")
+    op = linalg.make_linear_operator(A)
+    assert getattr(op, "_fault_wrapped", False)
+    # no double wrap through repeated make_linear_operator
+    assert linalg.make_linear_operator(op) is op
+    faults.clear()
+    assert not getattr(
+        linalg.make_linear_operator(A), "_fault_wrapped", False
+    )
+
+
+# ---------------------------------------------------------------------------
+# recovery policy engine
+# ---------------------------------------------------------------------------
+def test_recovery_clean_solve_no_retry_events():
+    settings.telemetry = True
+    S = _spd()
+    A = sparse_tpu.csr_array(S)
+    b = np.random.default_rng(2).standard_normal(S.shape[0])
+    x, info = solve_with_recovery(A, b, solver="cg", tol=1e-10)
+    assert info.converged and info.attempts == 1 and not info.recovered
+    assert np.linalg.norm(S @ np.asarray(x) - b) <= 1e-9
+    assert not telemetry.events("solver.retry")
+    assert not telemetry.events("solver.recovered")
+
+
+@pytest.mark.parametrize("solver", ["cg", "bicgstab", "gmres"])
+def test_recovery_under_nan_injection(solver):
+    settings.telemetry = True
+    S = _spd(64)
+    A = sparse_tpu.csr_array(S)
+    b = np.random.default_rng(1).standard_normal(64)
+    faults.configure("nonfinite:matvec:p=0.01,seed=7")
+    x, info = solve_with_recovery(
+        A, b, solver=solver, tol=1e-8,
+        policy=RecoveryPolicy(max_attempts=10),
+    )
+    faults.clear()
+    assert info.converged, info.history
+    target = 1e-8 * max(np.linalg.norm(b), 1.0) if solver == "gmres" else 1e-8
+    assert np.linalg.norm(S @ np.asarray(x) - b) <= 10 * target
+    assert telemetry.events("fault.injected")
+    if info.recovered:
+        chain = [e["kind"] for e in telemetry.events()]
+        assert chain.index("fault.injected") < chain.index("solver.retry")
+        assert telemetry.events("solver.recovered")
+
+
+def test_recovery_stagnation_restarts_from_iterate():
+    settings.telemetry = True
+    S = _spd(96, seed=5)
+    A = sparse_tpu.csr_array(S)
+    b = np.random.default_rng(3).standard_normal(96)
+    # maxiter far below what one attempt needs: progress accumulates
+    # across restarts from the best iterate (never punished by
+    # escalation), so the ladder converges where one attempt cannot
+    x, info = solve_with_recovery(
+        A, b, solver="cg", tol=1e-9, maxiter=12,
+        policy=RecoveryPolicy(max_attempts=12),
+    )
+    assert info.converged and info.attempts > 1 and info.recovered
+    assert info.solver == "cg", "improving restarts must not escalate"
+    assert np.linalg.norm(S @ np.asarray(x) - b) <= 1e-8
+    retries = telemetry.events("solver.retry")
+    assert retries and all(r["reason"] == "stagnation" for r in retries)
+    assert all(r["action"] == "restart" for r in retries)
+
+
+def test_recovery_bicgstab_breakdown_escalates_to_gmres():
+    settings.telemetry = True
+    # the classic omega-breakdown shape: skew system, one iteration
+    # makes t . s == 0 while ||r|| > 0 — silently where-guarded in the
+    # recurrence, detected by the health monitor's breakdown tap
+    A = sparse_tpu.csr_array(sp.csr_matrix(np.array([[0., 1.], [-1., 0.]])))
+    b = np.array([1., 0.])
+    x, info = solve_with_recovery(
+        A, b, solver="bicgstab", tol=1e-10,
+        policy=RecoveryPolicy(max_attempts=4),
+    )
+    assert info.converged and info.solver == "gmres"
+    reasons = {e["reason"] for e in telemetry.events("solver.anomaly")}
+    assert "breakdown" in reasons
+    (retry,) = [
+        e for e in telemetry.events("solver.retry")
+        if e["reason"] == "breakdown"
+    ]
+    assert retry["action"] == "escalate" and retry["solver"] == "gmres"
+
+
+def test_recovery_nonfinite_rolls_back_to_checkpoint(tmp_path):
+    from sparse_tpu.checkpoint import CheckpointManager
+
+    settings.telemetry = True
+    S = _spd(48)
+    A = sparse_tpu.csr_array(S)
+    b = np.random.default_rng(4).standard_normal(48)
+    x_good = sp.linalg.spsolve(S.tocsc(), b)
+    mgr = CheckpointManager(tmp_path / "ck.npz")
+    mgr.save(1, x=x_good)  # a near-perfect iterate from "before the crash"
+    faults.configure("nonfinite:matvec:p=1,n=1,seed=0")  # poison attempt 1
+    x, info = solve_with_recovery(
+        A, b, solver="cg", tol=1e-8, checkpoint=mgr,
+        policy=RecoveryPolicy(max_attempts=4),
+    )
+    assert info.converged and info.recovered
+    (retry,) = [
+        e for e in telemetry.events("solver.retry")
+        if e["reason"] == "nonfinite"
+    ]
+    assert retry["action"] == "rollback"
+    # rolling back to the solved state means the retry converges at the
+    # FIRST conv-test point (one 25-iteration chunk) — nothing like a
+    # from-scratch solve, which needs several chunks at this tol
+    assert info.history[-1]["iters"] <= 25
+
+
+def test_recovery_deadline_gives_up():
+    settings.telemetry = True
+    S = _spd()
+    A = sparse_tpu.csr_array(S)
+    b = np.random.default_rng(5).standard_normal(S.shape[0])
+    x, info = solve_with_recovery(
+        A, b, solver="cg", tol=1e-12, maxiter=2,
+        policy=RecoveryPolicy(max_attempts=10, deadline_s=0.0),
+    )
+    assert not info.converged and info.gave_up_reason == "deadline"
+    (ev,) = telemetry.events("solver.giveup")
+    assert ev["reason"] == "deadline"
+
+
+def test_recovery_attempt_budget_gives_up():
+    S = _spd()
+    A = sparse_tpu.csr_array(S)
+    b = np.random.default_rng(6).standard_normal(S.shape[0])
+    faults.configure("nonfinite:matvec:p=1,seed=0")  # unrecoverable
+    x, info = solve_with_recovery(
+        A, b, solver="cg", tol=1e-10, policy=RecoveryPolicy(max_attempts=3),
+    )
+    assert not info.converged and info.gave_up_reason == "attempts"
+    assert info.attempts == 3
+
+
+def test_recovery_preempted_checkpointed_solve(tmp_path):
+    from sparse_tpu.checkpoint import checkpointed_cg
+
+    S = _spd(64)
+    A = sparse_tpu.csr_array(S)
+    b = np.random.default_rng(7).standard_normal(64)
+    faults.configure("preempt:chunk:p=1,n=2,seed=0")
+    p = tmp_path / "ck.npz"
+    done = None
+    for _ in range(5):
+        try:
+            done = checkpointed_cg(A, b, p, tol=1e-10, chunk=15)
+            break
+        except Preempted:
+            continue
+    assert done is not None
+    x, iters = done
+    assert np.linalg.norm(S @ np.asarray(x) - b) <= 1e-8
+
+
+# ---------------------------------------------------------------------------
+# fused-CG nonfinite exit (ISSUE 5 satellite regression)
+# ---------------------------------------------------------------------------
+def test_fused_cg_nonfinite_rho_is_not_convergence(monkeypatch):
+    monkeypatch.setattr(settings, "fused_cg", "force")
+    settings.telemetry = True
+    n = 64
+    e = np.ones(n, np.float32)
+    A = sparse_tpu.dia_array(
+        (np.stack([-e, 3 * e, -e]), np.array([-1, 0, 1])), shape=(n, n)
+    )
+    b_bad = np.ones(n, np.float32)
+    b_bad[3] = np.nan
+    out = linalg._try_fused_cg(A, b_bad.copy(), None, 1e-6, n * 10, 25)
+    assert out is not None
+    _x, _iters, rho_f, info = out
+    assert info == -1 and not np.isfinite(rho_f)
+    # through the public cg(): the health report must show a nonfinite
+    # anomaly and converged=False — distinguishable from convergence
+    telemetry.reset()
+    linalg.cg(A, b_bad.copy(), tol=1e-6)
+    rep = telemetry.last_solve_report()
+    assert rep["converged"] is False
+    assert any(a["reason"] == "nonfinite" for a in rep["anomalies"])
+    # clean solve: info == 0 and the report says converged
+    telemetry.reset()
+    out = linalg._try_fused_cg(
+        A, np.ones(n, np.float32), None, 1e-6, n * 10, 25
+    )
+    assert out[3] == 0
+    linalg.cg(A, np.ones(n, np.float32), tol=1e-6)
+    assert telemetry.last_solve_report()["converged"] is True
+
+
+# ---------------------------------------------------------------------------
+# failover registry
+# ---------------------------------------------------------------------------
+def test_registry_mark_reinstate_cycle():
+    settings.telemetry = True
+
+    class Obj:
+        pass
+
+    o = Obj()
+    assert not failover.failed("k1", o)
+    failover.mark_failed("k1", o, error="boom")
+    assert failover.failed("k1", o)
+    (ev,) = telemetry.events("kernel.failover")
+    assert ev["kernel"] == "k1" and not telemetry.schema.validate(ev)
+    assert failover.probe("k1", o, lambda: None)
+    assert not failover.failed("k1", o)
+    (rev,) = telemetry.events("kernel.reinstate")
+    assert rev["kernel"] == "k1"
+    # failed probe leaves the latch
+    failover.mark_failed("k1", o, error="boom2")
+    assert not failover.probe(
+        "k1", o, lambda: (_ for _ in ()).throw(RuntimeError("still down"))
+    )
+    assert failover.failed("k1", o)
+
+
+def test_injected_pallas_failure_sell(monkeypatch):
+    from sparse_tpu.kernels.sell_spmv import PreparedCSR
+
+    settings.telemetry = True
+    monkeypatch.setattr(settings, "spmv_mode", "pallas")
+    G = _spd(32).astype(np.float32)
+    prep = PreparedCSR(G.indptr, G.indices, G.data, G.shape)
+    x = np.random.default_rng(0).standard_normal(32).astype(np.float32)
+    faults.configure("fail:pallas:kernel=sell_spmv,n=1")
+    with pytest.warns(UserWarning, match="failing over"):
+        y = np.asarray(prep(x))
+    np.testing.assert_allclose(y, G @ x, rtol=1e-5, atol=1e-5)
+    assert failover.failed(prep.KERNEL, prep)
+    assert telemetry.events("fault.injected")
+    (ev,) = telemetry.events("kernel.failover")
+    assert ev["kernel"] == "sell_spmv" and "injected" in ev["error"].lower()
+    # probe-based reinstate: injection cleared, the real kernel works
+    faults.clear()
+    assert prep.probe_pallas(x)
+    assert not failover.failed(prep.KERNEL, prep)
+    assert telemetry.events("kernel.reinstate")
+
+
+def test_injected_pallas_failure_dia():
+    from sparse_tpu.kernels.dia_spmv import DIA_KERNEL, cached_prepared_spmv
+
+    settings.telemetry = True
+    n = 32
+    e = np.ones(n, np.float32)
+    data = np.stack([-e, 3 * e, -e])
+    offsets = (-1, 0, 1)
+
+    class Holder:
+        pass
+
+    h = Holder()
+    x = np.linspace(0, 1, n, dtype=np.float32)
+    faults.configure("fail:pallas:kernel=dia_spmv,n=1")
+    with pytest.warns(UserWarning, match="failing over"):
+        out = cached_prepared_spmv(h, "dia", data, offsets, (n, n), x)
+    assert out is None  # caller takes the XLA formulation
+    assert failover.failed(DIA_KERNEL, h)
+    (ev,) = telemetry.events("kernel.failover")
+    assert ev["kernel"] == "dia_spmv"
+
+
+def test_injected_pallas_failure_batched(monkeypatch):
+    from sparse_tpu.batch import BatchedCSR
+
+    settings.telemetry = True
+    monkeypatch.setattr(settings, "spmv_mode", "pallas")
+    mats, _ = _stack(n=32, B=3)
+    bc = BatchedCSR.from_stack([m.astype(np.float32) for m in mats])
+    X = np.random.default_rng(1).standard_normal((3, 32)).astype(np.float32)
+    faults.configure("fail:pallas:kernel=sell_spmv_batched,n=1")
+    with pytest.warns(UserWarning, match="failing over"):
+        Y = np.asarray(bc.matvec(X))
+    for i in range(3):
+        np.testing.assert_allclose(
+            Y[i], mats[i] @ X[i], rtol=1e-4, atol=1e-4
+        )
+    # latched on the PATTERN: with_values siblings share the latch
+    assert failover.failed(bc.KERNEL, bc.pattern)
+    sib = bc.with_values(bc.values)
+    assert failover.failed(sib.KERNEL, sib.pattern)
+
+
+# ---------------------------------------------------------------------------
+# resilient SolveSession
+# ---------------------------------------------------------------------------
+def test_ticket_states_and_failed_bucket_isolation():
+    settings.telemetry = True
+    mats, rhs = _stack()
+    s = SolveSession("cg")
+    t_ok = s.submit(mats[0], rhs[0], tol=1e-10)
+    assert t_ok.state is TicketState.PENDING and not t_ok.done
+    skew = sp.csr_matrix(np.array([[2., 1.], [1., 2.]]))
+    t_bad = s.submit(skew, np.array([1., 0.]))
+    orig = s._dispatch
+
+    def poisoned(reqs, dt, **kw):
+        if reqs[0].pattern.shape[0] == 2:
+            raise RuntimeError("bucket program exploded")
+        return orig(reqs, dt, **kw)
+
+    s._dispatch = poisoned
+    s.flush()  # must NOT raise: one failed bucket cannot strand the rest
+    assert t_ok.state is TicketState.DONE and t_ok.converged
+    assert t_bad.state is TicketState.FAILED
+    with pytest.raises(TicketFailedError, match="exploded"):
+        t_bad.result()
+    # the session stays usable after a failed bucket
+    t2 = s.submit(skew, np.array([1., 0.]), tol=1e-12)
+    s._dispatch = orig
+    s.flush()
+    assert t2.converged
+
+
+def test_ticket_deadline():
+    settings.telemetry = True
+    mats, rhs = _stack()
+    s = SolveSession("cg")
+    t_late = s.submit(mats[0], rhs[0], deadline_s=0.0)
+    t_fine = s.submit(mats[1], rhs[1], tol=1e-10)
+    time.sleep(0.005)
+    s.flush()
+    assert t_late.state is TicketState.FAILED
+    with pytest.raises(TicketDeadlineError):
+        t_late.result()
+    assert t_fine.converged
+    (ev,) = telemetry.events("batch.deadline")
+    assert ev["lanes"] == 1 and not telemetry.schema.validate(ev)
+
+
+def test_requeue_unconverged_lane_into_fallback_bucket():
+    settings.telemetry = True
+    mats, rhs = _stack()
+    s = SolveSession("cg")
+    # a starved maxiter can't converge under cg; the requeue bucket
+    # (gmres, fresh budget, promoted dtype) must finish the lane
+    t = s.submit(mats[0], rhs[0], tol=1e-9, maxiter=3)
+    s.flush()
+    x, iters, resid2 = t.result()
+    assert t.converged and t.solver == "gmres" and t.requeued
+    assert np.linalg.norm(mats[0] @ x - rhs[0]) <= 1e-8
+    (ev,) = telemetry.events("batch.requeue")
+    assert ev["lanes"] == 1 and ev["from_solver"] == "cg"
+    assert not telemetry.schema.validate(ev)
+
+
+def test_requeue_disabled_keeps_first_result():
+    mats, rhs = _stack()
+    s = SolveSession("cg", requeue=False)
+    t = s.submit(mats[0], rhs[0], tol=1e-9, maxiter=3)
+    s.flush()
+    assert not t.converged and t.state is TicketState.DONE
+
+
+def test_degraded_mode_per_lane_solve():
+    settings.telemetry = True
+    mats, rhs = _stack()
+    s = SolveSession("cg")
+    t = s.submit(mats[0], rhs[0], tol=1e-10)
+
+    def broken(*a, **k):
+        raise RuntimeError("pallas/plan-cache unavailable")
+
+    s._build_program = broken
+    s.flush()
+    x, iters, resid2 = t.result()
+    assert t.converged
+    assert np.linalg.norm(mats[0] @ x - rhs[0]) <= 1e-8
+    (ev,) = telemetry.events("batch.degraded")
+    assert "unavailable" in ev["reason"]
+    assert not telemetry.schema.validate(ev)
+
+
+def test_injected_dispatch_drop_retries_then_succeeds():
+    settings.telemetry = True
+    mats, rhs = _stack()
+    faults.configure("drop:dispatch:p=1,n=1")  # first dispatch only
+    s = SolveSession("cg")
+    t = s.submit(mats[0], rhs[0], tol=1e-10)
+    s.flush()
+    assert t.converged  # retried within flush
+    assert telemetry.events("fault.injected")
+
+
+def test_injected_dispatch_drop_exhausts_to_failed():
+    mats, rhs = _stack()
+    faults.configure("drop:dispatch:p=1")  # every dispatch drops
+    s = SolveSession("cg", requeue=False)
+    t = s.submit(mats[0], rhs[0])
+    s.flush()
+    assert t.state is TicketState.FAILED
+    with pytest.raises(TicketFailedError):
+        t.result()
+
+
+def test_session_batch_recovers_under_matvec_injection():
+    settings.telemetry = True
+    mats, rhs = _stack(n=64, B=4, seed=3)
+    faults.configure("nonfinite:matvec:p=0.01,seed=7")
+    s = SolveSession("cg")
+    X, iters, resid2 = s.solve_many(mats, rhs, tol=1e-8)
+    faults.clear()
+    for m, x, b in zip(mats, X, rhs):
+        assert np.linalg.norm(m @ x - b) <= 1e-7
+    assert telemetry.events("batch.dispatch")
+
+
+def test_b1_parity_under_recovery_features():
+    """The resilient session (requeue on, deadlines available) must keep
+    the B=1 == unbatched contract (same iteration count, machine-eps
+    iterates — the test_batch.py parity tolerance) when nothing fails."""
+    mats, rhs = _stack(B=1)
+    s = SolveSession("cg")
+    X, iters, resid2 = s.solve_many(mats, rhs[:1], tol=1e-10)
+    A1 = sparse_tpu.csr_array(mats[0])
+    x_ref, it_ref = linalg.cg(A1, rhs[0], tol=1e-10)
+    assert int(iters[0]) == int(it_ref)
+    np.testing.assert_allclose(X[0], np.asarray(x_ref), rtol=1e-12)
+
+
+# ---------------------------------------------------------------------------
+# chaos gate (the acceptance scenario, via the CI script)
+# ---------------------------------------------------------------------------
+def _load_script(name):
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(REPO, "scripts", f"{name}.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_chaos_check_quick_scenario():
+    chaos = _load_script("chaos_check")
+    assert chaos.main([]) == 0
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("seed", [0, 1, 2, 3, 4])
+def test_chaos_sweep(seed):
+    """Seeded chaos sweep: heavier corruption, every solver still
+    recovers or gives up CLEANLY (finite outputs, bounded attempts)."""
+    settings.telemetry = True
+    S = _spd(96, seed=seed)
+    A = sparse_tpu.csr_array(S)
+    b = np.random.default_rng(seed).standard_normal(96)
+    faults.configure(
+        f"nonfinite:matvec:p=0.02,seed={seed};"
+        f"preempt:chunk:p=0.05,seed={seed}"
+    )
+    x, info = solve_with_recovery(
+        A, b, solver="cg", tol=1e-8,
+        policy=RecoveryPolicy(max_attempts=15),
+    )
+    faults.clear()
+    assert info.attempts <= 15
+    if info.converged:
+        assert np.linalg.norm(S @ np.asarray(x) - b) <= 1e-6
+    else:
+        assert info.gave_up_reason in ("attempts", "deadline")
+        assert telemetry.events("solver.giveup")
