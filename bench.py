@@ -91,11 +91,20 @@ def _log_session_record(rec, status: str, t_start: float) -> None:
         "status": status,
         "budget_spent_s": round(time.monotonic() - t_start, 1),
         "record": rec,
+        # watchdog-killed probes this run (ISSUE 6 satellite: the bare
+        # 'probe timed out' stderr lines, now a session-record field)
+        "timeouts": list(PROBE_TIMEOUTS),
     }
     if os.environ.get("SPARSE_TPU_TELEMETRY"):
         try:
             from sparse_tpu import telemetry
 
+            # the probe timeouts as structured events, emitted here (one
+            # deferred batch) so a wedged-tunnel timeout never triggers a
+            # first sparse_tpu import mid-run; t_wall preserves when the
+            # watchdog actually fired
+            for to in PROBE_TIMEOUTS:
+                telemetry.record("bench.probe_timeout", **to)
             entry["telemetry"] = telemetry.summary()
         except Exception:
             traceback.print_exc(file=sys.stderr)
@@ -950,6 +959,7 @@ def _run_example(script: str, attempts, timeout_s: int, keep_trying=False,
             )
         except subprocess.TimeoutExpired:
             print(f"bench: {script} {args} timed out", file=sys.stderr)
+            _note_probe_timeout(script, min(left, share))
             continue
         if proc.returncode != 0:
             sys.stderr.write(proc.stderr[-2000:])
@@ -1126,6 +1136,7 @@ def _try_platform(platform_arg: str, timeout_s: int):
             "salvaging partial output",
             file=sys.stderr,
         )
+        _note_probe_timeout(f"worker:{platform_arg}", timeout_s)
         def _dec(v):
             return v.decode(errors="replace") if isinstance(v, bytes) else (v or "")
 
@@ -1146,6 +1157,24 @@ def _try_platform(platform_arg: str, timeout_s: int):
     return None
 
 
+PROBE_TIMEOUTS: list = []  # [{"probe", "timeout_s", "t_wall"}] this run
+
+
+def _note_probe_timeout(probe: str, timeout_s: float) -> None:
+    """Structured record of a watchdog-killed probe (ISSUE 6 satellite:
+    'probe timed out' used to be a bare stderr line — three in the
+    BENCH_r05 tail — invisible to every session artifact). The entries
+    land in the session record's ``timeouts`` field; with telemetry on
+    each is also a ``bench.probe_timeout`` event, emitted by
+    ``_log_session_record`` (not here) so a mid-run timeout cannot wedge
+    the bench on a first jax/sparse_tpu import."""
+    PROBE_TIMEOUTS.append({
+        "probe": probe,
+        "timeout_s": round(float(timeout_s), 1),
+        "t_wall": round(time.time(), 3),
+    })
+
+
 def _probe_tpu(timeout_s: float) -> str:
     """Run the --probe subprocess. Returns one of:
     'tpu'  — a live non-cpu backend answered within the watchdog;
@@ -1164,6 +1193,7 @@ def _probe_tpu(timeout_s: float) -> str:
         )
     except subprocess.TimeoutExpired:
         print(f"bench: probe timed out after {timeout_s:.0f}s", file=sys.stderr)
+        _note_probe_timeout("tpu", timeout_s)
         return "dead"
     for line in reversed(proc.stdout.strip().splitlines()):
         try:

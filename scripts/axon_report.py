@@ -9,6 +9,7 @@ Usage:
         [--json OUT.json]              # write the machine report
         [--compare BASELINE.json]      # a report written by --json
         [--threshold 0.2]              # relative regression gate
+        [--peak-gflops G] [--peak-gbs B]  # roofline ceilings (optional)
         [--quiet]
 
 Exit codes: 0 = ok, 1 = regressions found (--compare), 2 = bad usage /
@@ -22,9 +23,21 @@ milliseconds (the quick-lane smoke runs it against the committed
 The comparable surface is ``report["metrics"]``: a flat
 ``{name: {"v": value, "hib": higher_is_better}}`` dict covering span
 latencies (p50/p95), per-solver iteration means, comm volumes, anomaly
-counts and joined bench metric values. ``--compare`` flags any metric
-that moved against its direction by more than ``--threshold``
-(relative) and exits 1.
+counts, per-ticket latency percentiles (p50/p95/p99) + SLO misses, the
+session's cold-start compile budget, and joined bench metric values.
+``--compare`` flags any metric that moved against its direction by more
+than ``--threshold`` (relative) and exits 1.
+
+Axon v3 additions (ISSUE 6): ``report["tickets"]`` rolls up the
+``batch.ticket`` terminal events (states, requeues, SLO misses, latency
+percentiles, mean phase breakdown); ``report["programs"]`` joins each
+``plan_cache.compile`` attribution (compile seconds, XLA flops / bytes
+/ peak HBM) with the measured ``batch.dispatch`` solve wall time of the
+same program into an achieved GFLOP/s / GB/s table (+ percent-of-peak
+when ``--peak-gflops`` / ``--peak-gbs`` ceilings are given);
+``report["cold_start_s"]`` is the total compile+pack seconds the
+session paid — the number ROADMAP item 4 (persistent plan cache) is
+out to kill.
 """
 
 from __future__ import annotations
@@ -100,7 +113,115 @@ def _num(v):
     return v if isinstance(v, (int, float)) and not isinstance(v, bool) else None
 
 
-def build_report(records_path: str, bench_paths=()) -> dict:
+_TICKET_PHASES = ("queue", "pack", "compile", "solve", "readback")
+
+
+def _tickets_rollup(events) -> dict:
+    """Per-ticket latency/SLO rollup from ``batch.ticket`` terminal
+    events: states, requeues, SLO misses, p50/p95/p99 latency and the
+    mean phase breakdown (the serving-path waterfall)."""
+    evs = [e for e in events if e.get("kind") == "batch.ticket"]
+    lats = sorted(
+        float(e["latency_ms"]) for e in evs
+        if _num(e.get("latency_ms")) is not None
+    )
+    states: dict = {}
+    by_solver: dict = {}
+    phase_tot: dict = {}
+    phase_n: dict = {}
+    requeued = slo_misses = 0
+    for e in evs:
+        states[str(e.get("state", "?"))] = (
+            states.get(str(e.get("state", "?")), 0) + 1
+        )
+        by_solver[str(e.get("solver", "?"))] = (
+            by_solver.get(str(e.get("solver", "?")), 0) + 1
+        )
+        if e.get("requeued"):
+            requeued += 1
+        if e.get("slo_miss"):
+            slo_misses += 1
+        ph = e.get("phases")
+        if isinstance(ph, dict):
+            for p in _TICKET_PHASES:
+                v = _num(ph.get(f"{p}_ms"))
+                if v is not None:
+                    phase_tot[p] = phase_tot.get(p, 0.0) + v
+                    phase_n[p] = phase_n.get(p, 0) + 1
+    return {
+        "n": len(evs),
+        "states": states,
+        "by_solver": by_solver,
+        "requeued": requeued,
+        "slo_misses": slo_misses,
+        "latency_ms": {
+            "p50": round(_percentile(lats, 0.50), 3),
+            "p95": round(_percentile(lats, 0.95), 3),
+            "p99": round(_percentile(lats, 0.99), 3),
+            "max": round(lats[-1], 3) if lats else 0.0,
+            "mean": round(sum(lats) / len(lats), 3) if lats else 0.0,
+        },
+        "phase_ms_mean": {
+            p: round(phase_tot[p] / phase_n[p], 3) for p in phase_tot
+        },
+    }
+
+
+def _programs_rollup(events, peak_gflops=None, peak_gbs=None) -> dict:
+    """The achieved-vs-roofline table: ``plan_cache.compile``
+    attribution (compile wall-clock, XLA flops/bytes/peak HBM per
+    program) joined with measured ``batch.dispatch`` solve wall time of
+    the same program key. Achieved rates use total flops moved over
+    total solve seconds; ``--peak-*`` ceilings add percent-of-roofline
+    columns."""
+    programs: dict = {}
+    for e in events:
+        if e.get("kind") != "plan_cache.compile":
+            continue
+        key = str(e.get("program", "?"))
+        p = programs.setdefault(key, {"solves": 0, "solve_ms_total": 0.0})
+        for f in ("solver", "bucket", "dtype", "n", "nnz", "flops",
+                  "bytes", "peak_bytes", "compile_s", "pack_s"):
+            if f in e:
+                p[f] = e[f]
+    for e in events:
+        if e.get("kind") != "batch.dispatch" or "program" not in e:
+            continue
+        key = str(e["program"])
+        p = programs.setdefault(key, {"solves": 0, "solve_ms_total": 0.0})
+        p["solves"] += 1
+        sm = _num(e.get("solve_ms"))
+        if sm is not None:
+            p["solve_ms_total"] = round(p["solve_ms_total"] + sm, 3)
+    for p in programs.values():
+        solve_s = p["solve_ms_total"] / 1e3
+        flops, nbytes = _num(p.get("flops")), _num(p.get("bytes"))
+        if solve_s > 0 and p["solves"]:
+            if flops:
+                p["achieved_gflops"] = round(
+                    flops * p["solves"] / solve_s / 1e9, 4
+                )
+                if peak_gflops:
+                    p["pct_peak_gflops"] = round(
+                        100.0 * p["achieved_gflops"] / peak_gflops, 2
+                    )
+            if nbytes:
+                p["achieved_gbs"] = round(
+                    nbytes * p["solves"] / solve_s / 1e9, 4
+                )
+                if peak_gbs:
+                    p["pct_peak_gbs"] = round(
+                        100.0 * p["achieved_gbs"] / peak_gbs, 2
+                    )
+        if flops and nbytes:
+            # arithmetic intensity: which roofline regime the program
+            # sits in (SpMV-shaped programs live far left of the ridge)
+            p["flops_per_byte"] = round(flops / nbytes, 4)
+    return programs
+
+
+def build_report(records_path: str, bench_paths=(), peak_gflops=None,
+                 peak_gbs=None) -> dict:
     """The whole analysis as one JSON-serializable dict (see module
     docstring for the ``metrics`` comparison surface)."""
     events, hw = load_records(records_path)
@@ -181,6 +302,13 @@ def build_report(records_path: str, bench_paths=()) -> dict:
         for e in events if e.get("kind") == "solver.anomaly"
     ]
 
+    tickets = _tickets_rollup(events)
+    programs = _programs_rollup(events, peak_gflops, peak_gbs)
+    cold_start_s = round(sum(
+        (_num(p.get("compile_s")) or 0.0) + (_num(p.get("pack_s")) or 0.0)
+        for p in programs.values()
+    ), 6)
+
     bench_rows = load_bench_files(bench_paths)
     for e in sessions:
         rec = e.get("record")
@@ -207,6 +335,21 @@ def build_report(records_path: str, bench_paths=()) -> dict:
     for kind, b in comm_bytes.items():
         metrics[f"bytes.{kind}"] = {"v": b, "hib": False}
     metrics["anomalies.count"] = {"v": len(anomalies), "hib": False}
+    if tickets["n"]:
+        for q in ("p50", "p95", "p99"):
+            metrics[f"tickets.latency_ms.{q}"] = {
+                "v": tickets["latency_ms"][q], "hib": False,
+            }
+        metrics["tickets.slo_misses"] = {
+            "v": tickets["slo_misses"], "hib": False,
+        }
+    if cold_start_s:
+        metrics["cold_start_s"] = {"v": cold_start_s, "hib": False}
+    for key, p in programs.items():
+        if _num(p.get("achieved_gflops")) is not None:
+            metrics[f"program.{key}.achieved_gflops"] = {
+                "v": p["achieved_gflops"], "hib": True,
+            }
     if cache["session"] and _num(cache["session"].get("hit_rate")) is not None:
         metrics["plan_cache.hit_rate"] = {
             "v": cache["session"]["hit_rate"], "hib": True,
@@ -229,6 +372,9 @@ def build_report(records_path: str, bench_paths=()) -> dict:
         "comm_bytes": comm_bytes,
         "cache": cache,
         "anomalies": anomalies[:100],
+        "tickets": tickets,
+        "programs": programs,
+        "cold_start_s": cold_start_s,
         "bench": bench_rows,
         "metrics": metrics,
     }
@@ -303,6 +449,46 @@ def _print_report(rep: dict) -> None:
                 f"    {a.get('solver', '?'):<10} {a.get('reason', '?'):<12}"
                 f" iter={a.get('iter')} lane={a.get('lane')}"
             )
+    tk = rep.get("tickets") or {}
+    if tk.get("n"):
+        lat = tk["latency_ms"]
+        print(
+            f"  tickets: n={tk['n']} states={tk['states']} "
+            f"requeued={tk['requeued']} slo_misses={tk['slo_misses']}"
+        )
+        print(
+            f"    latency_ms p50={lat['p50']} p95={lat['p95']} "
+            f"p99={lat['p99']} max={lat['max']}"
+        )
+        if tk.get("phase_ms_mean"):
+            ph = tk["phase_ms_mean"]
+            print(
+                "    phase mean (ms): "
+                + " ".join(
+                    f"{p}={ph[p]}" for p in _TICKET_PHASES if p in ph
+                )
+            )
+    progs = rep.get("programs") or {}
+    if progs:
+        print(
+            f"  programs ({len(progs)}; cold start "
+            f"{rep.get('cold_start_s', 0)}s compile+pack):"
+        )
+        for key, p in sorted(progs.items()):
+            bits = [f"solves={p.get('solves', 0)}"]
+            for f, fmt in (
+                ("compile_s", "compile={}s"), ("flops", "flops={:.3g}"),
+                ("bytes", "bytes={:.3g}"),
+                ("achieved_gflops", "achieved={}GF/s"),
+                ("achieved_gbs", "{}GB/s"),
+                ("pct_peak_gflops", "{}%peakF"),
+                ("pct_peak_gbs", "{}%peakB"),
+                ("flops_per_byte", "AI={}"),
+            ):
+                v = p.get(f)
+                if v is not None:
+                    bits.append(fmt.format(v))
+            print(f"    {key:<30} " + " ".join(bits))
     if rep["bench"]:
         print("  bench metrics:")
         seen = set()
@@ -343,8 +529,13 @@ def main(argv) -> int:
     baseline_path = take("--compare")
     try:
         threshold = float(take("--threshold", "0.2"))
+        pk_gf = take("--peak-gflops")
+        peak_gflops = float(pk_gf) if pk_gf is not None else None
+        pk_gb = take("--peak-gbs")
+        peak_gbs = float(pk_gb) if pk_gb is not None else None
     except ValueError:
-        print("axon_report: --threshold must be a number", file=sys.stderr)
+        print("axon_report: --threshold/--peak-* must be numbers",
+              file=sys.stderr)
         return 2
     records = args[0] if args else DEFAULT_RECORDS
     if not os.path.exists(records):
@@ -356,7 +547,8 @@ def main(argv) -> int:
         hits = sorted(_glob.glob(pat))
         bench_paths.extend(hits if hits else [pat])
 
-    rep = build_report(records, bench_paths)
+    rep = build_report(records, bench_paths, peak_gflops=peak_gflops,
+                       peak_gbs=peak_gbs)
     if not quiet:
         _print_report(rep)
     if out_json:

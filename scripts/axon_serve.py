@@ -1,0 +1,104 @@
+#!/usr/bin/env python
+"""Run the live Axon serving exporter (telemetry/_serve.py) as a CLI.
+
+Usage:
+    python scripts/axon_serve.py [--port 9109] [--host 127.0.0.1] [--once]
+
+Starts ``telemetry.serve()`` — a daemon-threaded stdlib HTTP server —
+and blocks until Ctrl-C. Endpoints (docs/telemetry.md, "operating a
+serving session"):
+
+    /metrics   Prometheus text exposition of the always-on registry
+               (plan-cache counters, batch-service levels, per-ticket
+               latency histograms, per-program compile/flops gauges)
+    /healthz   JSON: health-monitor anomalies, kernel-failover latch
+               states, fault-injection status, uptime
+    /session   JSON: queue depth, bucket occupancy, per-session ticket
+               states, compiled-program attribution, cold-start budget
+
+``--once`` starts the server on the requested port (0 = ephemeral),
+self-scrapes all three endpoints, prints a one-line digest per endpoint
+and exits 0 — the hand-run smoke check. In-process serving (the normal
+deployment: the process running the SolveSession calls
+``telemetry.serve()`` itself) needs no CLI; this script exists for
+ad-hoc inspection of a long-lived python -i / notebook session exposing
+the library via the same process, and as the documented entry point.
+
+Exit codes: 0 = clean shutdown / --once ok, 2 = bad usage.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import urllib.request
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+
+
+def main(argv) -> int:
+    if "--help" in argv or "-h" in argv:
+        print(__doc__)
+        return 0
+    args = list(argv)
+    once = "--once" in args
+    if once:
+        args.remove("--once")
+
+    def take(flag, default):
+        if flag in args:
+            i = args.index(flag)
+            if i + 1 >= len(args):
+                print(f"axon_serve: {flag} needs a value", file=sys.stderr)
+                raise SystemExit(2)
+            v = args[i + 1]
+            del args[i:i + 2]
+            return v
+        return default
+
+    host = take("--host", "127.0.0.1")
+    try:
+        port = int(take("--port", "0" if once else "9109"))
+    except ValueError:
+        print("axon_serve: --port must be an integer", file=sys.stderr)
+        return 2
+    if args:
+        print(f"axon_serve: unknown arguments {args}", file=sys.stderr)
+        return 2
+
+    sys.path.insert(0, REPO)
+    from sparse_tpu import telemetry
+
+    server = telemetry.serve(port=port, host=host)
+    print(f"axon_serve: listening on {server.url} "
+          "(/metrics /healthz /session)")
+    if once:
+        for ep in ("/metrics", "/healthz", "/session"):
+            body = urllib.request.urlopen(server.url + ep, timeout=5).read()
+            if ep == "/metrics":
+                n = sum(
+                    1 for ln in body.decode().splitlines()
+                    if ln and not ln.startswith("#")
+                )
+                print(f"  {ep}: {n} series")
+            else:
+                payload = json.loads(body)
+                keys = ", ".join(sorted(payload))
+                print(f"  {ep}: {{{keys}}}")
+        server.stop()
+        return 0
+    try:
+        while True:
+            import time
+
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        print("axon_serve: shutting down")
+        server.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

@@ -7,8 +7,11 @@ Usage:
 Defaults: ``results/axon/records.jsonl`` -> ``results/axon/trace.json``.
 Open the output in https://ui.perfetto.dev (or chrome://tracing) for
 the timeline view — one process lane per subsystem (solver, kernels,
-comm, plan_cache, batch, bench, spans), spans as nested slices,
-``resid2`` as a per-solver counter track (docs/telemetry.md).
+comm, plan_cache, batch, bench, spans, resilience, tickets), spans as
+nested slices, ``resid2`` as a per-solver counter track, and one track
+per serving ticket (``batch.ticket`` terminal events render as an
+end-to-end slice containing the queue → pack → compile → solve →
+readback phase breakdown) — docs/telemetry.md.
 
 bench.py hardware metric records sharing the log (no ``kind`` field)
 are skipped by contract; a trimmed/partial session exports fine.

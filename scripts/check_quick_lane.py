@@ -40,6 +40,7 @@ MANIFEST = os.path.join(TESTS, "quick_lane_manifest.json")
 # time inside an importlib call with a cryptic spec error.
 _REQUIRED_SCRIPTS = (
     "axon_report.py",
+    "axon_serve.py",
     "axon_trace.py",
     "chaos_check.py",
     "check_quick_lane.py",

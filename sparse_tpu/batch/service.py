@@ -28,12 +28,29 @@ when the compiled-program path itself is unavailable (Pallas lowering
 gone, plan-cache failure, injected dispatch faults) the bucket degrades
 to per-lane eager solves rather than stranding its tickets
 (``batch.degraded``).
+
+Request-scoped observability (ISSUE 6, Axon v3): every ticket carries a
+process-unique id (``telemetry.new_ticket_id``); each dispatch runs
+inside a :func:`telemetry.ticket_scope` so EVERY event it causes —
+``batch.dispatch``, a ``kernel.failover`` five layers down,
+``fault.injected``, ``batch.requeue`` — carries the originating ids;
+and flush resolution emits one ``batch.ticket`` terminal event per
+request with the end-to-end latency and its phase breakdown (queue wait
+→ pack → compile → solve → readback). Latencies feed the always-on
+``batch.ticket_latency`` histogram (per solver) and, when the session
+has an ``slo_ms`` target, the ``batch.slo_misses`` counter — the
+percentiles/SLO surface ``scripts/axon_report.py`` rolls up and the
+live exporter (``telemetry.serve()``) scrapes. Bucket-program builds
+route through :mod:`telemetry._cost <sparse_tpu.telemetry._cost>` so
+each (pattern, solver, bucket, dtype) program's compile wall-clock and
+XLA cost/memory analysis land in ``plan_cache.compile`` events.
 """
 
 from __future__ import annotations
 
 import enum
 import time
+import weakref
 
 import jax
 import jax.numpy as jnp
@@ -43,7 +60,7 @@ from .. import plan_cache, telemetry
 from ..config import settings
 from ..ops import spmv as spmv_ops
 from ..resilience import faults as _faults
-from ..telemetry import _metrics
+from ..telemetry import _cost, _metrics
 from . import bucket as bucketing
 from . import krylov
 from .operator import BatchedCSR, SparsityPattern
@@ -62,6 +79,25 @@ _REQUEUES = _metrics.counter("batch.requeues")
 _DEGRADED = _metrics.counter("batch.degraded")
 _BUCKET_FAILURES = _metrics.counter("batch.bucket_failures")
 _DEADLINE_FAILED = _metrics.counter("batch.deadline_failed")
+# serving levels (ISSUE 6): end-to-end ticket latency (seconds, per
+# final solver) and SLO misses across all sessions with an slo_ms target
+_SLO_MISSES = _metrics.counter(
+    "batch.slo_misses",
+    help="tickets whose end-to-end latency exceeded the session slo_ms",
+)
+_TICKET_LATENCY_HELP = (
+    "end-to-end ticket latency in seconds (submit -> resolved)"
+)
+
+# live sessions, weakly held: the /session serving endpoint
+# (telemetry/_serve.py) reads their stats without keeping them alive
+_SESSIONS: "weakref.WeakSet" = weakref.WeakSet()
+
+
+def sessions_stats() -> list:
+    """``session_stats()`` of every live session (the ``/session``
+    exporter endpoint's payload; order is not meaningful)."""
+    return [s.session_stats() for s in list(_SESSIONS)]
 
 
 class TicketState(enum.Enum):
@@ -101,10 +137,17 @@ class SolveTicket:
     if the request is still queued, then returns ``(x, iters, resid2)``
     (host numpy scalars/arrays for the lane). Failed tickets raise
     :class:`TicketFailedError` (:class:`TicketDeadlineError` for
-    deadline misses) instead of returning garbage."""
+    deadline misses) instead of returning garbage.
+
+    ``id`` is the process-unique trace id every event the ticket causes
+    carries (``telemetry.ticket_scope``); ``phase_ms`` accumulates the
+    per-phase latency breakdown (queue/pack/compile/solve/readback)
+    across the first dispatch and any requeue, and is what the
+    ``batch.ticket`` terminal event and the Perfetto ticket lane render."""
 
     __slots__ = ("_session", "_out", "t_submit", "state", "error",
-                 "deadline_s", "requeued", "solver")
+                 "deadline_s", "requeued", "solver", "id", "phase_ms",
+                 "t_done", "t_mark")
 
     def __init__(self, session, deadline_s=None):
         self._session = session
@@ -115,6 +158,10 @@ class SolveTicket:
         self.deadline_s = None if deadline_s is None else float(deadline_s)
         self.requeued = False
         self.solver = None  # the solver that produced the final result
+        self.id = telemetry.new_ticket_id()
+        self.phase_ms: dict = {}
+        self.t_done = None  # set once, at first terminal resolution
+        self.t_mark = None  # end of the last phase-accounted dispatch
 
     @property
     def done(self) -> bool:
@@ -223,13 +270,18 @@ class SolveSession:
         the most breakdown-tolerant of the three)
     dispatch_attempts : tries per bucket before its tickets fail (>= 1;
         retries cover transient dispatch faults, e.g. injected drops)
+    slo_ms : the session's end-to-end latency objective per ticket
+        (submit -> resolved, milliseconds). Purely observational: a
+        ticket over the target still returns normally, but counts into
+        ``batch.slo_misses`` and its ``batch.ticket`` terminal event is
+        flagged ``slo_miss`` (None = no objective, nothing counted)
     """
 
     def __init__(self, solver: str = "cg", batch_max: int | None = None,
                  bucket_policy: str | None = None, conv_test_iters: int = 25,
                  restart: int | None = None, auto_flush: int | None = None,
                  requeue: bool = True, fallback_solver: str = "gmres",
-                 dispatch_attempts: int = 2):
+                 dispatch_attempts: int = 2, slo_ms: float | None = None):
         if solver not in _SOLVERS:
             raise ValueError(f"solver must be one of {_SOLVERS}")
         if fallback_solver not in _SOLVERS:
@@ -243,9 +295,13 @@ class SolveSession:
         self.requeue = bool(requeue)
         self.fallback_solver = fallback_solver
         self.dispatch_attempts = max(int(dispatch_attempts), 1)
+        self.slo_ms = None if slo_ms is None else float(slo_ms)
         self._patterns: dict = {}  # fingerprint -> SparsityPattern (dedupe)
         self._pending: dict = {}  # id(pattern) -> [Request]
         self.dispatches = 0
+        # terminal-state tallies for the /session serving endpoint
+        self._ticket_counts = {"done": 0, "failed": 0, "slo_miss": 0}
+        _SESSIONS.add(self)
 
     # -- intake ------------------------------------------------------------
     def pattern_of(self, A) -> SparsityPattern:
@@ -292,6 +348,20 @@ class SolveSession:
     def pending(self) -> int:
         return sum(len(q) for q in self._pending.values())
 
+    def session_stats(self) -> dict:
+        """JSON-friendly live view of this session (the ``/session``
+        exporter endpoint aggregates these across live sessions)."""
+        return {
+            "solver": self.solver,
+            "fallback_solver": self.fallback_solver,
+            "batch_max": self.batch_max,
+            "bucket_policy": self.bucket_policy,
+            "slo_ms": self.slo_ms,
+            "patterns": len(self._patterns),
+            "dispatches": self.dispatches,
+            "tickets": {"pending": self.pending, **self._ticket_counts},
+        }
+
     def solve_many(self, mats, rhs, tol: float = 1e-8, maxiter=None):
         """Convenience one-shot: submit a same-pattern stack, flush, and
         return ``(X (B, n), iters (B,), resid2 (B,))`` host arrays."""
@@ -322,7 +392,7 @@ class SolveSession:
         _QUEUE_DEPTH.dec(sum(len(q) for q in pending.values()))
         for q in pending.values():
             # per-ticket deadlines: fail stale work instead of solving it
-            live = []
+            live, expired = [], []
             for r in q:
                 if r.ticket.expired:
                     r.ticket._fail(TicketDeadlineError(
@@ -330,12 +400,14 @@ class SolveSession:
                         "dispatch"
                     ))
                     _DEADLINE_FAILED.inc()
+                    expired.append(r)
                 else:
                     live.append(r)
-            if len(live) != len(q) and telemetry.enabled():
+            if expired and telemetry.enabled():
                 telemetry.record(
                     "batch.deadline", solver=self.solver,
-                    lanes=len(q) - len(live),
+                    lanes=len(expired),
+                    tickets=[r.ticket.id for r in expired],
                 )
             # one group per result dtype so stacked values are homogeneous
             by_dt: dict = {}
@@ -360,10 +432,68 @@ class SolveSession:
                         _BUCKET_FAILURES.inc()
                         for r in chunk:
                             r.ticket._fail(err)
+        # every flushed ticket is terminal now (done, failed, or
+        # deadline-expired): emit its batch.ticket terminal event and
+        # feed the latency/SLO surfaces exactly once per ticket
+        for q in pending.values():
+            for r in q:
+                self._finalize_ticket(r.ticket)
         return dispatched
+
+    def _finalize_ticket(self, t: SolveTicket) -> None:
+        """Terminal accounting for one resolved ticket: end-to-end
+        latency into the always-on ``batch.ticket_latency`` histogram
+        (labeled by the solver that produced the result), SLO-miss
+        counting against the session target, and — telemetry on — the
+        ``batch.ticket`` terminal event closing the ticket's trace."""
+        if t.t_done is not None:
+            return  # already finalized (a requeue resolves in-flush)
+        t.t_done = time.monotonic()
+        latency_s = t.t_done - t.t_submit
+        solver = t.solver or self.solver
+        _metrics.histogram(
+            "batch.ticket_latency", help=_TICKET_LATENCY_HELP,
+            solver=solver,
+        ).observe(latency_s)
+        slo_miss = self.slo_ms is not None and latency_s * 1e3 > self.slo_ms
+        if slo_miss:
+            _SLO_MISSES.inc()
+            self._ticket_counts["slo_miss"] += 1
+        state = "done" if t.done else "failed"
+        self._ticket_counts[state] += 1
+        if telemetry.enabled():
+            fields = {
+                "ticket": t.id,
+                "state": state,
+                "solver": solver,
+                "latency_ms": round(latency_s * 1e3, 3),
+                "requeued": t.requeued,
+            }
+            if t.phase_ms:
+                fields["phases"] = {
+                    k: round(v, 3) for k, v in t.phase_ms.items()
+                }
+            if t.done:
+                fields["converged"] = bool(t._out[3])
+            if isinstance(t.error, TicketDeadlineError):
+                fields["reason"] = "deadline"
+            elif t.error is not None:
+                fields["reason"] = repr(t.error)[:200]
+            if self.slo_ms is not None:
+                fields["slo_ms"] = self.slo_ms
+                fields["slo_miss"] = slo_miss
+            telemetry.record("batch.ticket", **fields)
 
     def _dispatch(self, reqs, dt, solver: str | None = None,
                   allow_requeue: bool = True) -> None:
+        # every event this dispatch causes — batch.*, kernel.failover,
+        # fault.injected, plan_cache.compile — carries the lanes' ticket
+        # ids (replace semantics: a requeue re-enters with its own lanes)
+        with telemetry.ticket_scope(*(r.ticket.id for r in reqs)):
+            self._dispatch_scoped(reqs, dt, solver, allow_requeue)
+
+    def _dispatch_scoped(self, reqs, dt, solver: str | None,
+                         allow_requeue: bool) -> None:
         solver = solver or self.solver
         t0 = time.monotonic()
         if _faults.ACTIVE:
@@ -403,16 +533,40 @@ class SolveSession:
             # fault-wrapped programs carry the injection callback in
             # their trace: never share cache entries with clean ones
             key += ".faults"
+        args = (
+            jnp.asarray(values), jnp.asarray(rhs), jnp.asarray(x0),
+            jnp.asarray(tols), maxiter,
+        )
+        t_packed = time.monotonic()
+        built: dict = {}
+
+        def build():
+            # a cache miss builds AND attributes: pack/trace wall-clock,
+            # AOT compile duration, XLA cost/memory analysis — one
+            # plan_cache.compile event per program, ever (same cadence
+            # as the miss itself)
+            tb = time.perf_counter()
+            fn = self._build_program(pattern, bkt, np.dtype(dt),
+                                     solver=solver)
+            prog, info = _cost.attribute(
+                key, fn, args,
+                pack_s=time.perf_counter() - tb,
+                solver=solver, bucket=bkt, dtype=np.dtype(dt).str,
+                n=pattern.shape[0], nnz=pattern.nnz,
+            )
+            built.update(info)
+            return prog
+
         try:
-            prog = plan_cache.get(
-                pattern, key,
-                lambda: self._build_program(pattern, bkt, np.dtype(dt),
-                                            solver=solver),
-            )
-            X, iters, resid2, conv = prog(
-                jnp.asarray(values), jnp.asarray(rhs), jnp.asarray(x0),
-                jnp.asarray(tols), maxiter,
-            )
+            prog = plan_cache.get(pattern, key, build)
+            t_solve0 = time.monotonic()
+            out = prog(*args)
+            try:
+                jax.block_until_ready(out)
+            except Exception:
+                pass  # non-jax leaves (ints) — np.asarray blocks below
+            t_solved = time.monotonic()
+            X, iters, resid2, conv = out
             X = np.asarray(X)
             iters = np.asarray(iters)
             resid2 = np.asarray(resid2)
@@ -430,6 +584,7 @@ class SolveSession:
                 )
             self._solve_degraded(reqs, dt, solver)
             return
+        t_read = time.monotonic()
         requeue_lanes = []
         for i, r in enumerate(reqs):
             r.ticket._offer(X[i], iters[i], resid2[i], conv[i],
@@ -445,6 +600,35 @@ class SolveSession:
         _BUCKET_OCCUPANCY.observe(nb / bkt)
         _PAD_WASTE.inc(bkt - nb)
         if telemetry.enabled():
+            # bucket-level phase wall clocks, accumulated onto each
+            # lane's ticket (a requeued lane sums both dispatches).
+            # compile_ms is the build's share (pattern pack + AOT
+            # compile), which ran inside plan_cache.get — i.e. between
+            # t_packed and t_solve0 — so the phases stay disjoint
+            compile_ms = (
+                built.get("compile_s", 0.0) + built.get("pack_s", 0.0)
+            ) * 1e3
+            pack_ms = max((t_packed - t0) * 1e3, 0.0)
+            solve_ms = max((t_solved - t_solve0) * 1e3, 0.0)
+            readback_ms = max((t_read - t_solved) * 1e3, 0.0)
+            for r in reqs:
+                ph = r.ticket.phase_ms
+                # queue wait accrues from submit (first dispatch) or
+                # from the end of the previously accounted dispatch (a
+                # requeue) — the phases of a requeued ticket then tile
+                # its latency instead of double-counting the first pass
+                base = (
+                    r.ticket.t_mark if r.ticket.t_mark is not None
+                    else r.ticket.t_submit
+                )
+                ph["queue_ms"] = ph.get("queue_ms", 0.0) + max(
+                    (t0 - base) * 1e3, 0.0
+                )
+                ph["pack_ms"] = ph.get("pack_ms", 0.0) + pack_ms
+                ph["compile_ms"] = ph.get("compile_ms", 0.0) + compile_ms
+                ph["solve_ms"] = ph.get("solve_ms", 0.0) + solve_ms
+                ph["readback_ms"] = ph.get("readback_ms", 0.0) + readback_ms
+                r.ticket.t_mark = t_read
             q_ms = [
                 (t0 - r.ticket.t_submit) * 1e3 for r in reqs
             ]
@@ -455,6 +639,9 @@ class SolveSession:
                 queue_ms_max=round(max(q_ms), 3),
                 queue_ms_mean=round(sum(q_ms) / len(q_ms), 3),
                 dispatch_ms=round((time.monotonic() - t0) * 1e3, 3),
+                solve_ms=round(solve_ms, 3),
+                compile_ms=round(compile_ms, 3),
+                program=key,
                 iters_max=int(iters[:nb].max(initial=0)),
                 iters_mean=float(iters[:nb].mean()) if nb else 0.0,
                 plan_cache=cache_d,
@@ -471,10 +658,14 @@ class SolveSession:
         fb_dt = _promote(dt)
         _REQUEUES.inc(len(reqs))
         if telemetry.enabled():
+            # explicit tickets: the enclosing dispatch scope covers the
+            # WHOLE original bucket, this event is about the requeued
+            # lanes only
             telemetry.record(
                 "batch.requeue", solver=self.fallback_solver,
                 lanes=len(reqs), from_solver=self.solver,
                 dtype=np.dtype(fb_dt).str,
+                tickets=[r.ticket.id for r in reqs],
             )
         # fresh maxiter budget: the lane may have failed BECAUSE the
         # caller's budget was too small for the requested solver
@@ -495,45 +686,56 @@ class SolveSession:
         unavailable: each lane solves through the plain linalg drivers
         over a csr view of the pattern; per-lane failures fail only that
         lane's ticket."""
-        from .. import linalg
-        from ..csr import csr_array
         from ..utils import asjnp
 
         pattern = reqs[0].pattern
         indices = asjnp(pattern.indices)
         indptr = asjnp(pattern.indptr)
         for r in reqs:
-            try:
-                A = csr_array.from_parts(
-                    asjnp(r.values.astype(dt)), indices, indptr,
-                    pattern.shape,
+            # narrow the trace context to the one lane being solved so
+            # the eager solvers' events attribute per request
+            with telemetry.ticket_scope(r.ticket.id):
+                self._solve_degraded_lane(
+                    r, dt, solver, indices, indptr, pattern
                 )
-                b = asjnp(r.b.astype(dt))
-                maxiter = (
-                    r.maxiter if r.maxiter is not None
-                    else pattern.shape[0] * 10
+
+    def _solve_degraded_lane(self, r, dt, solver, indices, indptr,
+                             pattern) -> None:
+        from .. import linalg
+        from ..csr import csr_array
+        from ..utils import asjnp
+
+        try:
+            A = csr_array.from_parts(
+                asjnp(r.values.astype(dt)), indices, indptr,
+                pattern.shape,
+            )
+            b = asjnp(r.b.astype(dt))
+            maxiter = (
+                r.maxiter if r.maxiter is not None
+                else pattern.shape[0] * 10
+            )
+            if solver == "gmres":
+                x, iters = linalg.gmres(
+                    A, b, tol=0.0, atol=r.tol, restart=self.restart
                 )
-                if solver == "gmres":
-                    x, iters = linalg.gmres(
-                        A, b, tol=0.0, atol=r.tol, restart=self.restart
-                    )
-                elif solver == "bicgstab":
-                    x, iters = linalg.bicgstab(
-                        A, b, tol=r.tol, maxiter=maxiter
-                    )
-                else:
-                    x, iters = linalg.cg(A, b, tol=r.tol, maxiter=maxiter)
-                resid2 = float(
-                    np.linalg.norm(r.b - np.asarray(A @ asjnp(np.asarray(x))))
-                    ** 2
+            elif solver == "bicgstab":
+                x, iters = linalg.bicgstab(
+                    A, b, tol=r.tol, maxiter=maxiter
                 )
-                r.ticket._offer(
-                    np.asarray(x), iters, resid2,
-                    np.isfinite(resid2) and resid2 <= r.tol ** 2,
-                    solver=solver,
-                )
-            except Exception as e:  # noqa: BLE001 - lane isolation
-                r.ticket._fail(e)
+            else:
+                x, iters = linalg.cg(A, b, tol=r.tol, maxiter=maxiter)
+            resid2 = float(
+                np.linalg.norm(r.b - np.asarray(A @ asjnp(np.asarray(x))))
+                ** 2
+            )
+            r.ticket._offer(
+                np.asarray(x), iters, resid2,
+                np.isfinite(resid2) and resid2 <= r.tol ** 2,
+                solver=solver,
+            )
+        except Exception as e:  # noqa: BLE001 - lane isolation
+            r.ticket._fail(e)
 
     def _build_program(self, pattern: SparsityPattern, bkt: int, dt,
                        solver: str | None = None):

@@ -17,6 +17,8 @@ the reference's periodic-sync behavior.
 
 from __future__ import annotations
 
+import time
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -265,13 +267,30 @@ class _SparseMatrixLinearOperator(LinearOperator):
         # detection, SELL pack — all plan-cached) eagerly at wrap time, so
         # solvers whose first matvec happens inside a compiled loop still
         # run the whole solve on the prepared path. Advisory: any failure
-        # leaves per-matvec dispatch to its own fallbacks.
+        # leaves per-matvec dispatch to its own fallbacks. A warm that
+        # actually BUILT a plan (plan-cache miss moved) is attributed as
+        # cold-start cost (telemetry._cost), so `axon_report`'s compile
+        # budget covers eager-path prepares, not just bucket programs.
         prepare = getattr(A, "prepare", None)
         if prepare is not None:
+            from . import plan_cache
+            from .telemetry import _cost
+
+            snap = plan_cache.snapshot()
+            t0 = time.perf_counter()
             try:
                 prepare()
             except Exception:  # pragma: no cover - backend-dependent
                 pass
+            if plan_cache.delta(snap).get("misses"):
+                _cost.record_pack(
+                    f"prepare.{type(A).__name__}.{np.dtype(A.dtype).str}"
+                    f".n{A.shape[0]}",
+                    time.perf_counter() - t0,
+                    n=int(A.shape[0]),
+                    nnz=int(getattr(A, "nnz", 0)),
+                    dtype=np.dtype(A.dtype).str,
+                )
 
     def matvec(self, x, out=None):
         return self.A.dot(x)

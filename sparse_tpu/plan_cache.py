@@ -152,14 +152,21 @@ def invalidate(obj, kind: str | None = None) -> None:
 
 
 def stats() -> dict:
-    """Always-on counters: ``{hits, misses, evictions, size, hit_rate}``
-    (read back from the metrics registry — same numbers a Prometheus
-    scrape of ``telemetry.metrics_text()`` sees)."""
+    """Always-on counters: ``{hits, misses, evictions, size, hit_rate,
+    compile_s}`` (read back from the metrics registry — same numbers a
+    Prometheus scrape of ``telemetry.metrics_text()`` sees).
+    ``compile_s`` is the session's cold-start budget: total wall-clock
+    seconds spent building/compiling attributed programs
+    (telemetry/_cost.py), so bench session records carry the compile
+    tax next to the hit rate it bought."""
     with _LOCK:
         out = {k: int(c.value) for k, c in _COUNTERS.items()}
         out["size"] = len(_ENTRIES)
     total = out["hits"] + out["misses"]
     out["hit_rate"] = out["hits"] / total if total else 0.0
+    from .telemetry import _cost
+
+    out["compile_s"] = round(_cost.total_compile_s(), 6)
     return out
 
 

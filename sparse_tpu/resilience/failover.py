@@ -46,6 +46,7 @@ __all__ = [
     "clear",
     "failed",
     "handle",
+    "latches",
     "mark_failed",
     "maybe_inject",
     "probe",
@@ -227,6 +228,27 @@ def snapshot() -> dict:
     object id (0 = kernel-wide) — introspection/debugging surface."""
     with _LOCK:
         return {f"{k}[{oid or '*'}]": err for (k, oid), err in _FAILED.items()}
+
+
+def latches() -> dict:
+    """JSON-friendly per-kernel latch view for serving surfaces
+    (``/healthz``): ``{kernel: {"scoped": n per-operator latches,
+    "kernel_wide": bool, "error": the most recent latch's error}}`` —
+    operator ids stay internal (they are meaningless across processes
+    and would churn every scrape)."""
+    with _LOCK:
+        items = list(_FAILED.items())
+    out: dict = {}
+    for (kernel, oid), err in items:
+        st = out.setdefault(
+            kernel, {"scoped": 0, "kernel_wide": False, "error": ""}
+        )
+        if oid == 0:
+            st["kernel_wide"] = True
+        else:
+            st["scoped"] += 1
+        st["error"] = str(err)[:200]
+    return out
 
 
 def clear() -> None:

@@ -167,6 +167,7 @@ def solve_with_recovery(
     restart=None,
     policy: RecoveryPolicy | None = None,
     checkpoint=None,
+    ticket: str | None = None,
 ):
     """Solve ``A x = b`` with bounded, observable recovery.
 
@@ -177,9 +178,24 @@ def solve_with_recovery(
     ``(x, RecoveryInfo)``; never raises on solver failure — an exhausted
     budget returns the best iterate with ``info.converged=False`` and a
     ``solver.giveup`` event.
+
+    ``ticket`` threads a request-scoped trace id (``telemetry.
+    new_ticket_id()`` / a ``SolveTicket.id``) through the whole ladder:
+    every event any attempt emits — ``solver.retry``, a deep
+    ``kernel.failover``, the terminal ``solver.recovered``/``giveup`` —
+    then carries it (``telemetry.ticket_scope``), so a recovered solve
+    reads as one request in the ticket-aware Axon tooling.
     """
     from .. import linalg, telemetry
     from ..utils import asjnp
+
+    if ticket is not None:
+        with telemetry.ticket_scope(ticket):
+            return solve_with_recovery(
+                A, b, solver=solver, tol=tol, maxiter=maxiter, x0=x0,
+                M=M, restart=restart, policy=policy,
+                checkpoint=checkpoint, ticket=None,
+            )
 
     pol = policy or RecoveryPolicy()
     if checkpoint is not None and not hasattr(checkpoint, "load"):

@@ -24,6 +24,22 @@ Surface
   gauges, log-bucket histograms) behind the plan-cache stats, recorder
   counters and SolveSession levels; :func:`metrics_text` is its
   Prometheus text exposition.
+* :func:`serve` — the live serving exporter (:mod:`._serve`): a
+  daemon-threaded stdlib HTTP server (OFF until called) exposing
+  ``/metrics`` (Prometheus text), ``/healthz`` (anomalies, failover
+  latches, fault-injection state) and ``/session`` (queue depth, ticket
+  states, program attribution); ``scripts/axon_serve.py`` is the CLI.
+* :func:`ticket_scope` / :func:`new_ticket_id` /
+  :func:`current_tickets` — request-scoped trace context
+  (:mod:`._context`): events recorded inside a scope carry the
+  originating ticket ids, which is how one serving request stays
+  traceable across ``batch.dispatch`` → ``kernel.failover`` →
+  ``batch.requeue`` → its ``batch.ticket`` terminal event.
+* :mod:`cost <._cost>` — compile-time cost attribution: AOT
+  compile capture (wall-clock, XLA ``cost_analysis`` flops/bytes,
+  ``memory_analysis`` peak HBM) per plan-cached program, feeding
+  ``plan_cache.compile`` events, per-program gauges and
+  ``axon_report``'s achieved-vs-roofline table.
 * :func:`export_trace` — Chrome-trace/Perfetto JSON of the session
   (lanes per subsystem, nested spans) — ``scripts/axon_trace.py`` is
   the CLI over a records.jsonl.
@@ -44,9 +60,15 @@ cache has counted that way since PR 2).
 
 from __future__ import annotations
 
+from . import _cost as cost  # noqa: F401
 from . import _health as health  # noqa: F401
 from . import _metrics as metrics  # noqa: F401
 from . import _schema as schema  # noqa: F401
+from ._context import (  # noqa: F401
+    current_tickets,
+    new_ticket_id,
+    ticket_scope,
+)
 from ._health import last_solve_report  # noqa: F401
 from ._metrics import metrics_text  # noqa: F401
 from ._recorder import (  # noqa: F401
@@ -64,6 +86,7 @@ from ._recorder import (  # noqa: F401
     sink_path,
 )
 from ._recorder import reset as _reset_recorder
+from ._serve import AxonServer, serve, serving, stop_serving  # noqa: F401
 from ._spans import Span, device_sync, span  # noqa: F401
 from ._summary import summary  # noqa: F401
 from ._trace import export_trace, to_chrome_trace  # noqa: F401
@@ -71,21 +94,26 @@ from ._trace import export_trace, to_chrome_trace  # noqa: F401
 
 def reset() -> None:
     """Clear the in-memory state: ring, counters, byte totals, span
-    aggregates, drop count and the health monitor's solve reports (the
-    JSONL sink file is untouched — it is an append-only session log).
-    The always-on metrics families owned by other modules (plan cache,
+    aggregates, drop count, the health monitor's solve reports and the
+    program attribution table (the JSONL sink file is untouched — it is
+    an append-only session log; a running exporter keeps serving). The
+    always-on metrics families owned by other modules (plan cache,
     batch service) keep their values; reset those at their owners."""
     _reset_recorder()
     health.reset()
+    cost.reset()
 
 
 __all__ = [
     "add_bytes",
     "add_span",
+    "AxonServer",
     "bytes_by_kind",
     "configure",
+    "cost",
     "count",
     "counters",
+    "current_tickets",
     "device_sync",
     "dropped",
     "enabled",
@@ -96,12 +124,17 @@ __all__ = [
     "last_solve_report",
     "metrics",
     "metrics_text",
+    "new_ticket_id",
     "record",
     "reset",
     "schema",
+    "serve",
+    "serving",
     "sink_path",
     "span",
     "Span",
+    "stop_serving",
     "summary",
+    "ticket_scope",
     "to_chrome_trace",
 ]

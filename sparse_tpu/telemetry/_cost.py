@@ -1,0 +1,202 @@
+"""Compile-time cost attribution for plan-cached programs.
+
+The plan cache makes the serving path's compile economics legible as
+*counts* (one miss per bucket, ever); this module makes them legible as
+*costs*. At the moment a program is built on a cache miss,
+:func:`attribute` ahead-of-time lowers and compiles it (``jax.jit``'s
+AOT surface), measures the wall-clock compile duration, and captures
+XLA's own ``cost_analysis()`` (flops, bytes accessed) and
+``memory_analysis()`` (peak temp/argument/output HBM) for the compiled
+executable. Each capture:
+
+* registers into a bounded in-process program table (:func:`programs`)
+  — the ``/session`` serving endpoint and ``scripts/axon_report.py``'s
+  achieved-vs-roofline table read it;
+* bumps always-on metrics (``plan_cache.compiles`` /
+  ``plan_cache.compile_seconds`` counters, per-program
+  ``plan_cache.program_*`` gauges) so a Prometheus scrape sees the
+  session's cold-start budget without the event log;
+* emits one ``plan_cache.compile`` event (telemetry on), which is what
+  the report joins against measured ``batch.dispatch`` solve wall time.
+
+Everything is best-effort by construction: backends without cost
+analysis, non-jitted programs (the GMRES host-driven closure), or an
+AOT path that rejects the arguments all degrade to "no analysis, keep
+the original callable" — attribution must never break a solve.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from . import _metrics, _recorder
+
+#: bounded program table: program key -> attribution dict
+_PROGRAMS: dict = {}
+_PROGRAMS_MAX = 256
+_LOCK = threading.RLock()
+
+# registered at import so the cold-start budget is present in
+# metrics_text() from the first scrape
+_COMPILES = _metrics.counter(
+    "plan_cache.compiles", help="programs compiled (plan-cache misses "
+    "that built an executable)",
+)
+_COMPILE_SECONDS = _metrics.counter(
+    "plan_cache.compile_seconds",
+    help="total wall-clock seconds spent building (pack) and compiling "
+    "plan-cached programs (the session's cold-start budget)",
+)
+
+
+def _cost_dict(compiled):
+    """XLA cost analysis of a compiled executable as a flat dict
+    (handles the list-of-dict shape older jax versions return)."""
+    try:
+        ca = compiled.cost_analysis()
+    except Exception:
+        return {}
+    if isinstance(ca, (list, tuple)):
+        ca = ca[0] if ca else {}
+    return ca if isinstance(ca, dict) else {}
+
+
+def _memory_dict(compiled):
+    """Peak-memory attribution from ``memory_analysis()`` (attribute
+    names per jax's ``CompiledMemoryStats``); empty when unsupported."""
+    try:
+        ma = compiled.memory_analysis()
+    except Exception:
+        return {}
+    if ma is None:
+        return {}
+    out = {}
+    for name, key in (
+        ("temp_size_in_bytes", "temp_bytes"),
+        ("argument_size_in_bytes", "arg_bytes"),
+        ("output_size_in_bytes", "out_bytes"),
+        ("generated_code_size_in_bytes", "code_bytes"),
+    ):
+        v = getattr(ma, name, None)
+        if isinstance(v, (int, float)) and not isinstance(v, bool) and v >= 0:
+            out[key] = int(v)
+    if out:
+        out["peak_bytes"] = (
+            out.get("temp_bytes", 0) + out.get("arg_bytes", 0)
+            + out.get("out_bytes", 0)
+        )
+    return out
+
+
+class _Program:
+    """A plan-cache entry wrapping an AOT-compiled executable with the
+    original jitted callable as fallback: if the compiled object ever
+    rejects a call (argument layout drift), the entry permanently
+    reverts to the jit path — same results, just a recompile."""
+
+    __slots__ = ("fn", "compiled")
+
+    def __init__(self, fn, compiled):
+        self.fn = fn
+        self.compiled = compiled
+
+    def __call__(self, *args):
+        if self.compiled is not None:
+            try:
+                return self.compiled(*args)
+            except Exception:
+                self.compiled = None
+        return self.fn(*args)
+
+
+def _register(program: str, info: dict) -> None:
+    with _LOCK:
+        if program not in _PROGRAMS and len(_PROGRAMS) >= _PROGRAMS_MAX:
+            _PROGRAMS.pop(next(iter(_PROGRAMS)))
+        _PROGRAMS[program] = info
+    _COMPILES.inc()
+    # cold-start budget = pack + compile, matching axon_report's
+    # cold_start_s so /session and the report quote the same number
+    _COMPILE_SECONDS.add(
+        float(info.get("compile_s") or 0.0)
+        + float(info.get("pack_s") or 0.0)
+    )
+    for key, metric in (
+        ("flops", "plan_cache.program_flops"),
+        ("bytes", "plan_cache.program_bytes"),
+        ("peak_bytes", "plan_cache.program_peak_bytes"),
+        ("compile_s", "plan_cache.program_compile_s"),
+    ):
+        v = info.get(key)
+        if isinstance(v, (int, float)) and not isinstance(v, bool):
+            _metrics.gauge(metric, program=program).set(float(v))
+    _recorder.record("plan_cache.compile", **info)
+
+
+def attribute(program: str, fn, args, pack_s: float | None = None,
+              **labels):
+    """Attribute one freshly built program: AOT-compile ``fn`` on the
+    concrete ``args`` when it exposes the jit AOT surface, capture
+    compile wall-clock + cost/memory analysis, and return
+    ``(callable, info)`` — the callable to cache in place of ``fn``
+    (the compiled wrapper, or ``fn`` itself when AOT is unavailable)
+    plus the attribution dict. ``labels`` (solver, bucket, dtype, n,
+    nnz, ...) ride into the event, the table, and the report join."""
+    info = {"program": program, **labels}
+    if pack_s is not None:
+        info["pack_s"] = round(float(pack_s), 6)
+    lower = getattr(fn, "lower", None)
+    out = fn
+    if lower is not None:
+        try:
+            lowered = lower(*args)
+            t0 = time.perf_counter()
+            compiled = lowered.compile()
+            info["compile_s"] = round(time.perf_counter() - t0, 6)
+            ca = _cost_dict(compiled)
+            flops = ca.get("flops")
+            if isinstance(flops, (int, float)) and flops >= 0:
+                info["flops"] = float(flops)
+            nbytes = ca.get("bytes accessed")
+            if isinstance(nbytes, (int, float)) and nbytes >= 0:
+                info["bytes"] = float(nbytes)
+            info.update(_memory_dict(compiled))
+            out = _Program(fn, compiled)
+        except Exception:
+            # AOT rejected (dynamic-shape program, experimental backend):
+            # the jit path still compiles lazily on first call — record
+            # the pack-only attribution and move on
+            info.pop("compile_s", None)
+    _register(program, info)
+    return out, info
+
+
+def record_pack(program: str, pack_s: float, **labels) -> None:
+    """Attribution for a host-side prepare with no executable of its own
+    (operator auto-warm at ``make_linear_operator``, the GMRES closure's
+    pattern pack): wall-clock only, same table/event/metrics plumbing."""
+    _register(
+        program,
+        {"program": program, "pack_s": round(float(pack_s), 6), **labels},
+    )
+
+
+def programs() -> dict:
+    """Snapshot of the program attribution table
+    (``{program: {compile_s, flops, bytes, peak_bytes, ...}}``)."""
+    with _LOCK:
+        return {k: dict(v) for k, v in _PROGRAMS.items()}
+
+
+def total_compile_s() -> float:
+    """The session's cold-start budget so far: total wall-clock seconds
+    spent compiling plan-cached programs (always-on counter)."""
+    return float(_COMPILE_SECONDS.value)
+
+
+def reset() -> None:
+    """Clear the program table (tests); the always-on counters keep
+    their values like every other registry-owned metric."""
+    with _LOCK:
+        _PROGRAMS.clear()

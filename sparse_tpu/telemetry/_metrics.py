@@ -36,6 +36,8 @@ _LOCK = threading.RLock()
 _REGISTRY: dict = {}
 # name -> metric class, for TYPE lines and family grouping
 _FAMILIES: dict = {}
+# name -> help text, for HELP lines (optional, set via help= at creation)
+_HELP: dict = {}
 
 # Log-2 histogram geometry: upper bounds 2**k for k in [_BK_MIN, _BK_MAX),
 # plus a +Inf overflow bucket. Spans ~1e-6 .. ~1e9 — microseconds to
@@ -171,9 +173,11 @@ class Histogram:
             self._n = 0
 
 
-def _get(cls, name: str, labels: dict, **kw):
+def _get(cls, name: str, labels: dict, help=None, **kw):
     key = (name, _labels_key(labels))
     with _LOCK:
+        if help:
+            _HELP.setdefault(name, str(help))
         m = _REGISTRY.get(key)
         if m is None:
             m = cls(name, dict(labels), **kw)
@@ -182,22 +186,23 @@ def _get(cls, name: str, labels: dict, **kw):
         return m
 
 
-def counter(name: str, /, **labels) -> Counter:
-    """Get-or-create a counter (same name+labels => same object)."""
-    return _get(Counter, name, labels)
+def counter(name: str, /, help=None, **labels) -> Counter:
+    """Get-or-create a counter (same name+labels => same object).
+    ``help`` registers the family's HELP text (first writer wins)."""
+    return _get(Counter, name, labels, help=help)
 
 
-def gauge(name: str, /, fn=None, **labels) -> Gauge:
+def gauge(name: str, /, fn=None, help=None, **labels) -> Gauge:
     """Get-or-create a gauge; ``fn`` makes it lazily sampled."""
-    g = _get(Gauge, name, labels)
+    g = _get(Gauge, name, labels, help=help)
     if fn is not None:
         g.fn = fn
     return g
 
 
-def histogram(name: str, /, **labels) -> Histogram:
+def histogram(name: str, /, help=None, **labels) -> Histogram:
     """Get-or-create a log-2 bucket histogram."""
-    return _get(Histogram, name, labels)
+    return _get(Histogram, name, labels, help=help)
 
 
 def label_values(name: str, label: str) -> dict:
@@ -216,6 +221,7 @@ def remove(name: str) -> None:
         for key in [k for k in _REGISTRY if k[0] == name]:
             del _REGISTRY[key]
         _FAMILIES.pop(name, None)
+        _HELP.pop(name, None)
 
 
 def zero(prefix: str = "") -> None:
@@ -237,11 +243,30 @@ def _sanitize(name: str) -> str:
     return s
 
 
+def _escape_label(v) -> str:
+    """Prometheus label-value escaping (exposition format 0.0.4):
+    backslash, double-quote and newline must be escaped — unescaped they
+    corrupt the whole scrape, not just one series."""
+    return (
+        str(v)
+        .replace("\\", "\\\\")
+        .replace('"', '\\"')
+        .replace("\n", "\\n")
+    )
+
+
+def _escape_help(s: str) -> str:
+    """HELP-text escaping: backslash and newline only (quotes are legal
+    in HELP lines per the format spec)."""
+    return str(s).replace("\\", "\\\\").replace("\n", "\\n")
+
+
 def _fmt_labels(labels: dict) -> str:
     if not labels:
         return ""
     inner = ",".join(
-        f'{_sanitize(str(k))}="{str(v)}"' for k, v in sorted(labels.items())
+        f'{_sanitize(str(k))}="{_escape_label(v)}"'
+        for k, v in sorted(labels.items())
     )
     return "{" + inner + "}"
 
@@ -263,10 +288,14 @@ def metrics_text() -> str:
 
     Dotted names become ``sparse_tpu_<name>`` with non-alphanumerics
     mapped to ``_``; counters gain the conventional ``_total`` suffix,
-    histograms expose ``_bucket``/``_sum``/``_count`` series.
+    histograms expose ``_bucket``/``_sum``/``_count`` series. Every
+    family gets ``# HELP`` + ``# TYPE`` lines (registered help text, or
+    the dotted name as the fallback description), and label values are
+    escaped per the format spec (:func:`_escape_label`).
     """
     with _LOCK:
         families = dict(_FAMILIES)
+        helps = dict(_HELP)
         by_name: dict = {}
         for (name, _), m in sorted(_REGISTRY.items()):
             by_name.setdefault(name, []).append(m)
@@ -274,19 +303,23 @@ def metrics_text() -> str:
     for name in sorted(by_name):
         cls = families.get(name, Counter)
         base = "sparse_tpu_" + _sanitize(name)
+        help_text = _escape_help(helps.get(name, f"sparse_tpu {name}"))
         if cls is Counter:
+            lines.append(f"# HELP {base}_total {help_text}")
             lines.append(f"# TYPE {base}_total counter")
             for m in by_name[name]:
                 lines.append(
                     f"{base}_total{_fmt_labels(m.labels)} {_fmt_value(m.value)}"
                 )
         elif cls is Gauge:
+            lines.append(f"# HELP {base} {help_text}")
             lines.append(f"# TYPE {base} gauge")
             for m in by_name[name]:
                 lines.append(
                     f"{base}{_fmt_labels(m.labels)} {_fmt_value(m.value)}"
                 )
         else:  # Histogram
+            lines.append(f"# HELP {base} {help_text}")
             lines.append(f"# TYPE {base} histogram")
             for m in by_name[name]:
                 for bound, acc in m.buckets():

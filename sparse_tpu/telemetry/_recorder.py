@@ -30,7 +30,7 @@ import threading
 import time
 
 from ..config import settings
-from . import _metrics
+from . import _context, _metrics
 
 _LOCK = threading.RLock()
 _RING: collections.deque | None = None
@@ -143,16 +143,20 @@ def record(kind: str, **fields):
     """Record one structured event: ``record("solver.iter", iter=3, ...)``.
 
     No-op (one attribute check) when telemetry is disabled. Events get
-    ``kind`` and a ``ts`` wall-clock stamp; a numeric ``bytes`` field
-    additionally accumulates into the per-kind byte totals reported by
-    :func:`~sparse_tpu.telemetry.summary`. Returns the event dict, or
-    ``None`` when disabled.
+    ``kind`` and a ``ts`` wall-clock stamp; inside an active
+    :func:`~._context.ticket_scope` they additionally gain a ``tickets``
+    field (explicit ``ticket``/``tickets`` fields win) so deep
+    instrumentation sites attribute to the requests they serve. A
+    numeric ``bytes`` field additionally accumulates into the per-kind
+    byte totals reported by :func:`~sparse_tpu.telemetry.summary`.
+    Returns the event dict, or ``None`` when disabled.
     """
     if not settings.telemetry:
         return None
     global _DROPPED
     ev = {"kind": kind, "ts": time.time()}
     ev.update(fields)
+    _context.annotate(ev)
     with _LOCK:
         ring = _ring()
         if ring.maxlen is not None and len(ring) == ring.maxlen:

@@ -80,11 +80,25 @@ KINDS: dict[str, frozenset] = {
     "batch.degraded": frozenset({"solver", "reason"}),
     # tickets failed by their per-ticket deadline before dispatch
     "batch.deadline": frozenset({"solver", "lanes"}),
+    # the per-ticket TERMINAL event: one per submitted system per flush
+    # resolution, carrying the final state ('done' | 'failed'), the
+    # end-to-end latency and the per-phase breakdown (queue/pack/compile/
+    # solve/readback ms) — the record a ticket trace ends on
+    "batch.ticket": frozenset({"ticket", "state"}),
+    # -- plan cache (sparse_tpu.plan_cache / telemetry/_cost.py) ------------
+    # one per compiled (or host-packed) plan-cached program: wall-clock
+    # compile/pack seconds plus XLA cost/memory analysis when available
+    # (flops, bytes, peak_bytes) — the roofline join key is `program`
+    "plan_cache.compile": frozenset({"program"}),
     # -- generic ------------------------------------------------------------
     "span": frozenset({"name", "dur_s"}),
     # bench.py session record (always written by a bench run, even when
     # the TPU probe timed out)
     "bench.session": frozenset({"status"}),
+    # a bench probe subprocess killed by its watchdog (used to be a bare
+    # stderr line — ISSUE 6 satellite); the session record's `timeouts`
+    # field carries the same entries
+    "bench.probe_timeout": frozenset({"probe"}),
 }
 
 

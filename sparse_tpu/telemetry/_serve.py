@@ -1,0 +1,208 @@
+"""Live serving exporter: a stdlib HTTP surface over the telemetry state.
+
+``telemetry.serve()`` starts a daemon-threaded ``http.server`` (off by
+default — nothing listens unless called) exposing the three surfaces a
+serving operator scrapes:
+
+* ``/metrics`` — the always-on registry as Prometheus text exposition
+  (format 0.0.4): plan-cache counters, batch-service levels, per-ticket
+  latency histograms, per-program compile/flops gauges.
+* ``/healthz`` — liveness + degradation as JSON: the health monitor's
+  most recent solve anomalies, kernel-failover latch states, fault
+  injection status, uptime. ``status`` is ``"ok"`` unless a failover is
+  latched or the last solve flagged anomalies (``"degraded"`` — still
+  HTTP 200: degraded is an operating state, not an outage).
+* ``/session`` — the live serving picture as JSON: queue depth, bucket
+  occupancy, per-session ticket states (``batch.SolveSession``'s weak
+  registry), and the compiled-program attribution table.
+
+Bounded overhead by construction: every handler reads in-memory state
+under the registry locks (no device touch, no event emission, no
+filesystem), responses are built per request, and the server thread is
+a daemon so it never blocks interpreter exit. ``scripts/axon_serve.py``
+is the CLI over this module.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from . import _health, _metrics
+
+_LOCK = threading.Lock()
+_SERVER = None
+
+#: Prometheus text exposition content type (format 0.0.4)
+METRICS_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+
+def _healthz() -> dict:
+    """The /healthz payload (also importable for tests/CLIs)."""
+    anomalies: list = []
+    rep = _health.last_solve_report()
+    if rep:
+        anomalies = list(rep.get("anomalies") or ())
+    latches: dict = {}
+    faults_status = {"active": False, "spec": "", "fires": {}}
+    try:
+        from ..resilience import failover, faults
+
+        latches = failover.latches()
+        from ..config import settings
+
+        faults_status = {
+            "active": bool(faults.ACTIVE),
+            "spec": settings.faults,
+            "fires": faults.stats(),
+        }
+    except Exception:
+        pass  # health must answer even mid-teardown
+    degraded = bool(latches) or bool(anomalies)
+    return {
+        "status": "degraded" if degraded else "ok",
+        "uptime_s": round(time.monotonic() - (_SERVER.t0 if _SERVER else 0), 3)
+        if _SERVER else 0.0,
+        "last_solve_anomalies": anomalies,
+        "failover_latches": latches,
+        "faults": faults_status,
+    }
+
+
+def _session() -> dict:
+    """The /session payload: live queue/bucket/ticket state plus the
+    program attribution table."""
+    from . import _cost
+
+    sessions: list = []
+    try:
+        from ..batch import service
+
+        sessions = service.sessions_stats()
+    except Exception:
+        pass  # no batch subsystem imported yet — an empty serving picture
+    occupancy = _metrics.histogram("batch.bucket_occupancy")
+    return {
+        "queue_depth": _metrics.gauge("batch.queue_depth").value,
+        "dispatches": _metrics.counter("batch.dispatches").value,
+        "bucket_occupancy": {
+            "count": occupancy.count,
+            "sum": round(occupancy.sum, 6),
+        },
+        "slo_misses": _metrics.counter("batch.slo_misses").value,
+        "sessions": sessions,
+        "programs": _cost.programs(),
+        "cold_start_s": round(_cost.total_compile_s(), 6),
+    }
+
+
+class _Handler(BaseHTTPRequestHandler):
+    # the exporter is a metrics surface, not an access log
+    def log_message(self, fmt, *args):  # noqa: A003 - stdlib signature
+        pass
+
+    def _send(self, code: int, body: bytes, ctype: str) -> None:
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _send_json(self, payload: dict, code: int = 200) -> None:
+        self._send(
+            code, (json.dumps(payload, default=str) + "\n").encode(),
+            "application/json; charset=utf-8",
+        )
+
+    def do_GET(self):  # noqa: N802 - stdlib signature
+        path = self.path.split("?", 1)[0].rstrip("/") or "/"
+        try:
+            if path == "/metrics":
+                self._send(
+                    200, _metrics.metrics_text().encode(),
+                    METRICS_CONTENT_TYPE,
+                )
+            elif path == "/healthz":
+                self._send_json(_healthz())
+            elif path == "/session":
+                self._send_json(_session())
+            elif path == "/":
+                self._send(
+                    200,
+                    b"sparse_tpu axon exporter: /metrics /healthz /session\n",
+                    "text/plain; charset=utf-8",
+                )
+            else:
+                self._send_json({"error": f"no such endpoint {path}"}, 404)
+        except BrokenPipeError:
+            pass  # scraper hung up mid-response
+        except Exception as e:  # noqa: BLE001 - exporter never crashes
+            try:
+                self._send_json({"error": repr(e)}, 500)
+            except Exception:
+                pass
+
+
+class AxonServer:
+    """Handle for a running exporter; ``stop()`` (or context-manager
+    exit) shuts the listener down and joins the daemon thread."""
+
+    def __init__(self, host: str, port: int):
+        self._httpd = ThreadingHTTPServer((host, port), _Handler)
+        self._httpd.daemon_threads = True
+        self.host = host
+        self.port = int(self._httpd.server_address[1])
+        self.t0 = time.monotonic()
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever,
+            name="sparse-tpu-axon-serve",
+            daemon=True,
+        )
+        self._thread.start()
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    def stop(self) -> None:
+        global _SERVER
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        self._thread.join(timeout=5)
+        with _LOCK:
+            if _SERVER is self:
+                _SERVER = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False
+
+
+def serve(port: int = 0, host: str = "127.0.0.1") -> AxonServer:
+    """Start (or return the already-running) exporter. ``port=0`` binds
+    an ephemeral port — read it back from the handle (``server.port``).
+    The server is a daemon thread: it never outlives the process and
+    costs nothing until a scraper connects."""
+    global _SERVER
+    with _LOCK:
+        if _SERVER is not None:
+            return _SERVER
+        _SERVER = AxonServer(host, port)
+        return _SERVER
+
+
+def serving() -> AxonServer | None:
+    """The live exporter handle, or ``None`` when not serving."""
+    return _SERVER
+
+
+def stop_serving() -> None:
+    """Stop the exporter if one is running (idempotent)."""
+    s = _SERVER
+    if s is not None:
+        s.stop()

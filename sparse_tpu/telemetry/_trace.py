@@ -6,8 +6,9 @@
 timeline view the reference stack gets from Legion's profiler.
 
 Layout: one *process* lane per subsystem (solver, kernels, comm,
-plan_cache, batch, bench, spans) with named *thread* tracks inside it
-(per solver, per event kind, per span family). Mapping:
+plan_cache, batch, bench, spans, resilience, tickets) with named
+*thread* tracks inside it (per solver, per event kind, per span family,
+per ticket). Mapping:
 
 * ``span`` events become complete (``"X"``) slices — the recorder stamps
   a span at *exit* with its duration, so the slice start is
@@ -17,8 +18,16 @@ plan_cache, batch, bench, spans) with named *thread* tracks inside it
 * ``solver.iter`` events additionally feed a per-solver ``resid2``
   counter track (``"C"``), so convergence plots right under the
   iteration marks.
+* ``batch.ticket`` terminal events render the whole request as nested
+  slices on the ticket's own track in the "tickets" lane: one
+  end-to-end slice (the ticket's latency, ending at the event's
+  timestamp) containing consecutive phase slices (queue wait → pack →
+  compile → solve → readback) from the event's ``phases`` breakdown —
+  the per-request view the reference stack's task timeline gives for
+  free.
 * everything else becomes an instant (``"i"``) event carrying its full
-  field dict in ``args``.
+  field dict in ``args`` (ticket-scope ids ride along in ``args``, so
+  the trace stays greppable per request).
 
 The exporter is tolerant by construction: unknown kinds land in an
 "other" lane, malformed events are skipped, and it never raises on
@@ -41,8 +50,13 @@ _LANES = (
     (5, "batch", ("batch.",)),
     (6, "bench", ("bench.",)),
     (7, "spans", ("span",)),
+    (8, "resilience", ("fault.", "checkpoint.", "resilience.")),
 )
-_OTHER_PID = 8
+_TICKETS_PID = 9
+_OTHER_PID = 10
+
+#: batch.ticket phase order, matching the serving path's breakdown
+_TICKET_PHASES = ("queue", "pack", "compile", "solve", "readback")
 
 
 def _lane_of(ev: dict) -> tuple:
@@ -51,6 +65,8 @@ def _lane_of(ev: dict) -> tuple:
     if kind == "span":
         name = str(ev.get("name", "span"))
         return 7, name.split(".", 1)[0]
+    if kind == "batch.ticket":
+        return _TICKETS_PID, str(ev.get("ticket", "ticket"))
     for pid, _pname, prefixes in _LANES:
         for p in prefixes:
             if kind.startswith(p):
@@ -111,6 +127,38 @@ def to_chrome_trace(events) -> dict:
                 "ts": ts_us - dur_us, "dur": dur_us, "args": args,
             })
             continue
+        if kind == "batch.ticket":
+            # one end-to-end slice ending at the terminal event's ts,
+            # containing consecutive phase slices (queue -> ... ->
+            # readback); malformed/missing phase fields just shrink the
+            # breakdown — the total slice always renders
+            phases = ev.get("phases")
+            phases = phases if isinstance(phases, dict) else {}
+            phase_us = []
+            for p in _TICKET_PHASES:
+                d = _num(phases.get(f"{p}_ms"))
+                if d is not None and d > 0.0:
+                    phase_us.append((p, d * 1e3))
+            lat = _num(ev.get("latency_ms"))
+            total_us = max(
+                lat * 1e3 if lat is not None else 0.0,
+                sum(d for _p, d in phase_us),
+            )
+            start_us = ts_us - total_us
+            trace_events.append({
+                "ph": "X", "name": f"ticket {ev.get('ticket', '?')}",
+                "cat": "ticket", "pid": pid, "tid": tid,
+                "ts": start_us, "dur": total_us, "args": args,
+            })
+            cursor = start_us
+            for p, d in phase_us:
+                trace_events.append({
+                    "ph": "X", "name": p, "cat": "ticket.phase",
+                    "pid": pid, "tid": tid, "ts": cursor, "dur": d,
+                    "args": {"phase": p},
+                })
+                cursor += d
+            continue
         trace_events.append({
             "ph": "i", "name": kind, "cat": kind.split(".", 1)[0],
             "pid": pid, "tid": tid, "ts": ts_us, "s": "t", "args": args,
@@ -128,6 +176,7 @@ def to_chrome_trace(events) -> dict:
 
     meta = []
     names = {pid: pname for pid, pname, _p in _LANES}
+    names[_TICKETS_PID] = "tickets"
     names[_OTHER_PID] = "other"
     for pid in sorted(pids_seen):
         meta.append({

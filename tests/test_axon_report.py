@@ -172,3 +172,163 @@ def test_trim_keeps_log_exportable(tmp_path):
     assert events
     trace = _trace.to_chrome_trace(events)
     assert trace["traceEvents"]
+
+
+# -- Axon v3: lanes round-trip, ticket rollups, roofline, serve smoke ---------
+
+
+def _write_v3_records(path, ts0=1700000000.0):
+    """A synthetic serving session: two tickets (one requeued, one SLO
+    miss), one attributed program, plus one event per batch/resilience
+    kind — the lane and rollup surfaces ISSUE 6 pins."""
+    ts = ts0
+    lines = [
+        {"kind": "plan_cache.compile", "ts": ts,
+         "program": "batch.cg.B4.<f8", "solver": "cg", "bucket": 4,
+         "dtype": "<f8", "n": 64, "nnz": 190, "compile_s": 0.25,
+         "pack_s": 0.05, "flops": 2.0e6, "bytes": 1.0e6,
+         "peak_bytes": 3_000_000},
+        {"kind": "batch.dispatch", "ts": ts + 1.0, "solver": "cg",
+         "batch": 2, "bucket": 4, "pad": 2, "program": "batch.cg.B4.<f8",
+         "solve_ms": 10.0, "tickets": ["tk-1", "tk-2"]},
+        {"kind": "batch.requeue", "ts": ts + 1.1, "solver": "gmres",
+         "lanes": 1, "from_solver": "cg", "tickets": ["tk-2"]},
+        {"kind": "batch.dispatch", "ts": ts + 1.5, "solver": "gmres",
+         "batch": 1, "bucket": 1, "pad": 0, "program": "batch.cg.B4.<f8",
+         "solve_ms": 10.0, "tickets": ["tk-2"]},
+        {"kind": "batch.ticket", "ts": ts + 1.2, "ticket": "tk-1",
+         "state": "done", "solver": "cg", "latency_ms": 12.0,
+         "requeued": False, "slo_ms": 50.0, "slo_miss": False,
+         "phases": {"queue_ms": 1.0, "pack_ms": 0.5, "compile_ms": 2.0,
+                    "solve_ms": 8.0, "readback_ms": 0.5}},
+        {"kind": "batch.ticket", "ts": ts + 1.6, "ticket": "tk-2",
+         "state": "done", "solver": "gmres", "latency_ms": 80.0,
+         "requeued": True, "slo_ms": 50.0, "slo_miss": True,
+         "phases": {"queue_ms": 30.0, "pack_ms": 1.0, "compile_ms": 20.0,
+                    "solve_ms": 28.0, "readback_ms": 1.0}},
+        {"kind": "fault.injected", "ts": ts + 2.0, "fault": "nonfinite",
+         "site": "matvec"},
+        {"kind": "solver.retry", "ts": ts + 2.1, "solver": "cg",
+         "attempt": 1, "action": "restart", "reason": "stagnation"},
+        {"kind": "kernel.reinstate", "ts": ts + 2.2, "kernel": "dia_spmv"},
+        {"kind": "bench.probe_timeout", "ts": ts + 3.0, "probe": "tpu",
+         "timeout_s": 120.0},
+    ]
+    with open(path, "w") as f:
+        for rec in lines:
+            f.write(json.dumps(rec) + "\n")
+    return path
+
+
+def test_v3_kinds_schema_valid_and_lanes_round_trip(tmp_path):
+    """Satellite: batch.* and resilience.* kinds get their own process
+    lanes (never the "other" catch-all), the new ticket/compile kinds
+    included, via a full JSONL -> trace CLI round-trip."""
+    rec = _write_v3_records(str(tmp_path / "v3.jsonl"))
+    from sparse_tpu import telemetry
+
+    assert telemetry.schema.validate_jsonl(rec) == []
+
+    out = str(tmp_path / "v3-trace.json")
+    assert _load("axon_trace").main([rec, out]) == 0
+    trace = json.load(open(out))
+    evs = trace["traceEvents"]
+    lane_name = {
+        m["pid"]: m["args"]["name"].split("/")[-1]
+        for m in evs if m.get("ph") == "M" and m["name"] == "process_name"
+    }
+    lane_of = {
+        e["name"]: lane_name[e["pid"]] for e in evs if e.get("ph") == "i"
+    }
+    assert lane_of["batch.dispatch"] == "batch"
+    assert lane_of["batch.requeue"] == "batch"
+    assert lane_of["fault.injected"] == "resilience"
+    assert lane_of["solver.retry"] == "solver"
+    assert lane_of["kernel.reinstate"] == "kernels"
+    assert lane_of["plan_cache.compile"] == "plan_cache"
+    assert lane_of["bench.probe_timeout"] == "bench"
+    assert "other" not in lane_of.values()
+    # each ticket renders one end-to-end slice + its phase slices on the
+    # tickets lane; the requeued ticket's phases tile its latency
+    tickets = [e for e in evs if e.get("cat") == "ticket"]
+    assert {e["name"] for e in tickets} == {"ticket tk-1", "ticket tk-2"}
+    assert all(lane_name[e["pid"]] == "tickets" for e in tickets)
+    (tk2,) = [e for e in tickets if e["name"] == "ticket tk-2"]
+    assert tk2["dur"] == pytest.approx(80.0 * 1e3)
+    phases = [
+        e for e in evs if e.get("cat") == "ticket.phase"
+        and e["tid"] == tk2["tid"]
+    ]
+    assert [p["name"] for p in phases] == [
+        "queue", "pack", "compile", "solve", "readback"
+    ]
+    assert sum(p["dur"] for p in phases) <= tk2["dur"]
+
+
+def test_report_ticket_percentiles_slo_and_roofline(tmp_path):
+    rec = _write_v3_records(str(tmp_path / "v3.jsonl"))
+    mod = _load("axon_report")
+    rep = mod.build_report(rec, peak_gflops=100.0, peak_gbs=50.0)
+
+    tk = rep["tickets"]
+    assert tk["n"] == 2 and tk["requeued"] == 1 and tk["slo_misses"] == 1
+    assert tk["states"] == {"done": 2}
+    # nearest-rank on two samples: the upper median
+    assert tk["latency_ms"]["p50"] == 80.0
+    assert tk["latency_ms"]["p99"] == 80.0
+    assert tk["latency_ms"]["mean"] == pytest.approx(46.0)
+    assert tk["phase_ms_mean"]["solve"] == pytest.approx(18.0)
+
+    # roofline join: 2 dispatches x 2MFLOP over 20ms of solve time
+    prog = rep["programs"]["batch.cg.B4.<f8"]
+    assert prog["solves"] == 2 and prog["solve_ms_total"] == 20.0
+    assert prog["achieved_gflops"] == pytest.approx(0.2)
+    assert prog["pct_peak_gflops"] == pytest.approx(0.2, rel=0.01)
+    assert prog["achieved_gbs"] == pytest.approx(0.1)
+    assert prog["flops_per_byte"] == pytest.approx(2.0)
+    assert rep["cold_start_s"] == pytest.approx(0.3)
+
+    # ...and the comparable metrics surface carries all of it
+    m = rep["metrics"]
+    assert m["tickets.latency_ms.p99"]["v"] == tk["latency_ms"]["p99"]
+    assert m["tickets.slo_misses"]["v"] == 1
+    assert m["cold_start_s"]["v"] == pytest.approx(0.3)
+    assert m["program.batch.cg.B4.<f8.achieved_gflops"]["hib"] is True
+
+    # the CLI renders the new sections without error
+    out_json = str(tmp_path / "rep.json")
+    assert mod.main(
+        [rec, "--json", out_json, "--peak-gflops", "100",
+         "--peak-gbs", "50", "--quiet"]
+    ) == 0
+    assert json.load(open(out_json))["tickets"]["n"] == 2
+
+
+def test_report_without_serving_events_omits_ticket_metrics(tmp_path):
+    rec = _write_records(str(tmp_path / "plain.jsonl"), [0.01] * 4)
+    rep = _load("axon_report").build_report(rec)
+    assert rep["tickets"]["n"] == 0
+    assert rep["programs"] == {} and rep["cold_start_s"] == 0
+    assert not any(k.startswith("tickets.") for k in rep["metrics"])
+
+
+def test_axon_serve_once_smoke(capsys):
+    """Quick-lane smoke (ISSUE 6 satellite): start the exporter on an
+    ephemeral port, scrape /metrics + /healthz + /session, shut down
+    cleanly — all through the CLI's --once path."""
+    assert _load("axon_serve").main(["--once"]) == 0
+    out = capsys.readouterr().out
+    assert "listening on http://127.0.0.1:" in out
+    assert "/metrics: " in out and "series" in out
+    assert "/healthz: " in out and "status" in out
+    assert "/session: " in out and "queue_depth" in out
+
+    from sparse_tpu import telemetry
+
+    assert telemetry.serving() is None  # --once left nothing running
+
+
+def test_axon_serve_bad_usage_exits_2(capsys):
+    mod = _load("axon_serve")
+    assert mod.main(["--port", "nope"]) == 2
+    assert mod.main(["--bogus"]) == 2

@@ -504,3 +504,50 @@ def test_session_rejects_bad_shapes():
         ses.submit(mats[0], rhs[0][:-1])
     with pytest.raises(ValueError):
         SolveSession("sor")
+
+
+# -- Axon v3: serving levels (SLO, ticket latency, live session view) --------
+
+
+def test_slo_miss_counter_and_ticket_latency_histogram():
+    from sparse_tpu.telemetry import _metrics as M
+
+    mats, rhs = _tridiag_stack(B=2)
+    misses0 = M.counter("batch.slo_misses").value
+    s = SolveSession("cg", slo_ms=0.0)  # every ticket misses a 0ms SLO
+    h0 = M.histogram("batch.ticket_latency", solver="cg").count
+    X, iters, resid2 = s.solve_many(mats, rhs, tol=1e-8)
+    assert M.counter("batch.slo_misses").value == misses0 + 2
+    assert M.histogram("batch.ticket_latency", solver="cg").count == h0 + 2
+    st = s.session_stats()
+    assert st["tickets"]["done"] == 2 and st["tickets"]["slo_miss"] == 2
+    assert st["slo_ms"] == 0.0 and st["tickets"]["pending"] == 0
+
+    # no objective -> nothing counted
+    s2 = SolveSession("cg")
+    s2.solve_many(mats, rhs, tol=1e-8)
+    assert M.counter("batch.slo_misses").value == misses0 + 2
+    assert s2.session_stats()["tickets"]["slo_miss"] == 0
+
+
+def test_sessions_stats_tracks_live_sessions_weakly():
+    import gc
+
+    from sparse_tpu.batch import service
+
+    mats, rhs = _tridiag_stack(B=1)
+    s = SolveSession("bicgstab")
+    s.submit(mats[0], rhs[0], tol=1e-8)
+    stats = service.sessions_stats()
+    mine = [
+        st for st in stats
+        if st["solver"] == "bicgstab" and st["tickets"]["pending"] == 1
+    ]
+    assert mine, "a live session must appear in the serving view"
+    s.flush()
+    del s
+    gc.collect()
+    assert not [
+        st for st in service.sessions_stats()
+        if st["solver"] == "bicgstab" and st["tickets"]["pending"] == 1
+    ]

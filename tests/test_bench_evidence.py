@@ -65,3 +65,45 @@ def test_log_hw_text_writes_out_file(tmp_path, monkeypatch):
     outs = list(tmp_path.glob("*_gmg_n_2000.out"))
     assert len(outs) == 1
     assert "97.1" in outs[0].read_text()
+
+
+def test_probe_timeouts_recorded_in_session_record(tmp_path, monkeypatch):
+    """ISSUE 6 satellite: a watchdog-killed probe is a structured
+    artifact — a ``timeouts`` entry in the bench.session record and,
+    with telemetry on, one schema-valid ``bench.probe_timeout`` event —
+    not a bare stderr line."""
+    import time
+
+    from sparse_tpu import telemetry
+    from sparse_tpu.config import settings
+
+    _redirect(monkeypatch, tmp_path)
+    monkeypatch.setattr(bench, "PROBE_TIMEOUTS", [])
+    monkeypatch.setenv("SPARSE_TPU_TELEMETRY", "1")
+    monkeypatch.setattr(settings, "telemetry", True)
+    telemetry.reset()
+    telemetry.configure(str(tmp_path / "tel.jsonl"))
+    try:
+        bench._note_probe_timeout("tpu", 120.0)
+        bench._note_probe_timeout("worker:tpu", 333.3)
+        bench._log_session_record({"metric": "x"}, "dead", time.monotonic())
+        rec = json.loads(open(bench.RECORDS_PATH).read().splitlines()[-1])
+        assert [t["probe"] for t in rec["timeouts"]] == ["tpu", "worker:tpu"]
+        assert rec["timeouts"][0]["timeout_s"] == 120.0
+        assert all("t_wall" in t for t in rec["timeouts"])
+        evs = telemetry.events("bench.probe_timeout")
+        assert [e["probe"] for e in evs] == ["tpu", "worker:tpu"]
+        assert all(not telemetry.schema.validate(e) for e in evs)
+    finally:
+        telemetry.configure(None)
+        telemetry.reset()
+
+
+def test_no_timeouts_yields_empty_field(tmp_path, monkeypatch):
+    import time
+
+    _redirect(monkeypatch, tmp_path)
+    monkeypatch.setattr(bench, "PROBE_TIMEOUTS", [])
+    bench._log_session_record({"metric": "x"}, "ok", time.monotonic())
+    rec = json.loads(open(bench.RECORDS_PATH).read().splitlines()[-1])
+    assert rec["timeouts"] == []

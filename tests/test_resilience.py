@@ -681,3 +681,87 @@ def test_chaos_sweep(seed):
     else:
         assert info.gave_up_reason in ("attempts", "deadline")
         assert telemetry.events("solver.giveup")
+
+
+# -- Axon v3: request-scoped ticket tracing through the session --------------
+
+
+def test_ticket_id_traceable_across_requeue_chain():
+    """The ISSUE 6 acceptance chain: a flush that triggers a requeue
+    yields ONE ticket id traceable across ``batch.dispatch`` →
+    ``batch.requeue`` → the terminal ``batch.ticket`` event, in both the
+    JSONL records and the exported Perfetto trace."""
+    settings.telemetry = True
+    mats, rhs = _stack()
+    s = SolveSession("cg")
+    t = s.submit(mats[0], rhs[0], tol=1e-9, maxiter=3)
+    tid = t.id
+    assert tid.startswith("tk-")
+    s.flush()
+    assert t.converged and t.requeued
+
+    evs = telemetry.events()
+    chain = [
+        e["kind"] for e in evs
+        if tid in (e.get("tickets") or ()) or e.get("ticket") == tid
+    ]
+    # both dispatches (original + requeue bucket) carry the id, the
+    # requeue event names it explicitly, and the terminal event ends it
+    assert chain.count("batch.dispatch") == 2
+    assert "batch.requeue" in chain and chain[-1] == "batch.ticket"
+    (term,) = [e for e in evs if e.get("kind") == "batch.ticket"]
+    assert term["ticket"] == tid and term["state"] == "done"
+    assert term["requeued"] is True and term["solver"] == "gmres"
+    assert term["latency_ms"] > 0
+    # the phase breakdown tiles the latency (disjoint phases across the
+    # two dispatches — the requeue accounting must not double count)
+    phases = term["phases"]
+    assert set(phases) == {
+        "queue_ms", "pack_ms", "compile_ms", "solve_ms", "readback_ms"
+    }
+    assert sum(phases.values()) <= term["latency_ms"] * 1.05
+    assert not telemetry.schema.validate(term)
+
+    # the same chain renders in the Perfetto export: a tickets lane with
+    # one end-to-end slice and its nested phase slices
+    trace = telemetry.to_chrome_trace(evs)
+    lanes = {
+        m["args"]["name"]: m["pid"]
+        for m in trace["traceEvents"]
+        if m.get("ph") == "M" and m.get("name") == "process_name"
+    }
+    ticket_lane = [k for k in lanes if k.endswith("tickets")]
+    assert ticket_lane
+    slices = [
+        e for e in trace["traceEvents"]
+        if e.get("cat") == "ticket" and tid in e.get("name", "")
+    ]
+    assert len(slices) == 1
+    assert slices[0]["dur"] == pytest.approx(
+        term["latency_ms"] * 1e3, rel=0.01
+    )
+    phase_names = [
+        e["name"] for e in trace["traceEvents"]
+        if e.get("cat") == "ticket.phase" and e["pid"] == slices[0]["pid"]
+        and e["tid"] == slices[0]["tid"]
+    ]
+    assert phase_names == [
+        "queue", "pack", "compile", "solve", "readback"
+    ]
+
+
+def test_solve_with_recovery_threads_ticket_through_ladder():
+    settings.telemetry = True
+    A = _spd()
+    b = np.ones(A.shape[0])
+    tid = telemetry.new_ticket_id()
+    x, info = solve_with_recovery(
+        sparse_tpu.csr_array(A), b, solver="cg", tol=1e-8, ticket=tid
+    )
+    assert info.converged
+    tagged = [
+        e for e in telemetry.events() if tid in (e.get("tickets") or ())
+    ]
+    assert tagged, "recovery-ladder events must carry the ticket id"
+    kinds = {e["kind"] for e in tagged}
+    assert "solver.solve" in kinds or "solver.recovered" in kinds

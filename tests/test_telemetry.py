@@ -626,3 +626,295 @@ def test_health_zero_overhead_when_disabled(monkeypatch):
     telemetry.health.observe("cg", 1, float("nan"))
     telemetry.health.end_solve("cg", 5)
     assert telemetry.last_solve_report() is None
+
+
+# -- Axon v3: request-scoped trace context (telemetry/_context.py) -----------
+
+
+def test_ticket_ids_unique_and_scoped(tel):
+    ids = {telemetry.new_ticket_id() for _ in range(64)}
+    assert len(ids) == 64
+    assert all(i.startswith("tk-") for i in ids)
+    assert telemetry.current_tickets() == ()
+    with telemetry.ticket_scope("tk-a", "tk-b"):
+        assert telemetry.current_tickets() == ("tk-a", "tk-b")
+        # REPLACE semantics: a nested scope (the requeue dispatch) owns
+        # the context, and the outer set comes back on exit
+        with telemetry.ticket_scope("tk-c"):
+            assert telemetry.current_tickets() == ("tk-c",)
+        assert telemetry.current_tickets() == ("tk-a", "tk-b")
+    assert telemetry.current_tickets() == ()
+
+
+def test_events_inside_scope_carry_tickets(tel):
+    telemetry.record("span", name="outside", dur_s=0.0)
+    with telemetry.ticket_scope("tk-x"):
+        telemetry.record("span", name="inside", dur_s=0.0)
+        # explicit ticket fields are authoritative — never overwritten
+        telemetry.record("batch.requeue", solver="gmres", lanes=1,
+                         tickets=["tk-explicit"])
+        telemetry.record("batch.ticket", ticket="tk-own", state="done")
+    by_kind = {}
+    for e in telemetry.events():
+        by_kind.setdefault(e["kind"], []).append(e)
+    spans = {e["name"]: e for e in by_kind["span"]}
+    assert "tickets" not in spans["outside"]
+    assert spans["inside"]["tickets"] == ["tk-x"]
+    assert by_kind["batch.requeue"][0]["tickets"] == ["tk-explicit"]
+    assert "tickets" not in by_kind["batch.ticket"][0]
+
+
+def test_ticket_scope_zero_cost_when_disabled(monkeypatch):
+    telemetry.reset()
+    monkeypatch.setattr(settings, "telemetry", False)
+    with telemetry.ticket_scope("tk-z"):
+        assert telemetry.record("span", name="n", dur_s=0.0) is None
+    assert telemetry.events() == []
+
+
+# -- Axon v3: Prometheus exposition conformance (_metrics.metrics_text) ------
+
+
+def test_metrics_text_escapes_label_values(tel):
+    from sparse_tpu.telemetry import _metrics as M
+
+    try:
+        M.counter(
+            "test.escape.counter",
+            prog='back\\slash "quoted"\nnewline',
+        ).inc()
+        txt = telemetry.metrics_text()
+        (line,) = [
+            ln for ln in txt.splitlines()
+            if ln.startswith("sparse_tpu_test_escape_counter_total{")
+        ]
+        # the raw control characters never reach the exposition...
+        assert "\n" not in line  # splitlines guarantees it; belt+braces
+        assert '\\\\' in line and '\\"' in line and "\\n" in line
+        assert line.endswith("} 1")
+        # ...and a conformant parser recovers the original value
+        val = line[line.index('{') + 1:line.rindex('}')]
+        assert val == 'prog="back\\\\slash \\"quoted\\"\\nnewline"'
+    finally:
+        M.remove("test.escape.counter")
+
+
+def test_metrics_text_help_type_and_histogram_series(tel):
+    from sparse_tpu.telemetry import _metrics as M
+
+    try:
+        M.counter("test.fmt.counter", help="counts things").inc(2)
+        M.gauge("test.fmt.gauge", help="level\nwith newline").set(1.5)
+        h = M.histogram("test.fmt.hist", solver="cg")
+        for v in (0.001, 0.5, 3.0):
+            h.observe(v)
+        txt = telemetry.metrics_text()
+        lines = txt.splitlines()
+        # every family leads with HELP then TYPE, in that order
+        for i, ln in enumerate(lines):
+            if ln.startswith("# TYPE "):
+                assert lines[i - 1].startswith(
+                    "# HELP " + ln.split()[2] + " "
+                ), ln
+        assert "# HELP sparse_tpu_test_fmt_counter_total counts things" \
+            in lines
+        # newline in HELP text is escaped per the format spec
+        assert ("# HELP sparse_tpu_test_fmt_gauge level\\nwith newline"
+                in lines)
+        assert "# TYPE sparse_tpu_test_fmt_hist histogram" in lines
+        # the three conventional histogram series, cumulative buckets,
+        # +Inf bucket == _count, le label present on every _bucket line
+        bucket = [
+            ln for ln in lines
+            if ln.startswith("sparse_tpu_test_fmt_hist_bucket")
+        ]
+        assert bucket and all('le="' in ln for ln in bucket)
+        assert 'solver="cg"' in bucket[0]
+        counts = [float(ln.rsplit(None, 1)[1]) for ln in bucket]
+        assert counts == sorted(counts)
+        inf_line = [ln for ln in bucket if 'le="+Inf"' in ln]
+        assert len(inf_line) == 1 and counts[-1] == 3.0
+        (cnt,) = [
+            ln for ln in lines
+            if ln.startswith("sparse_tpu_test_fmt_hist_count")
+        ]
+        (tot,) = [
+            ln for ln in lines
+            if ln.startswith("sparse_tpu_test_fmt_hist_sum")
+        ]
+        assert float(cnt.rsplit(None, 1)[1]) == 3.0
+        assert float(tot.rsplit(None, 1)[1]) == pytest.approx(3.501)
+    finally:
+        for name in ("test.fmt.counter", "test.fmt.gauge",
+                     "test.fmt.hist"):
+            M.remove(name)
+
+
+# -- Axon v3: live serving exporter (telemetry/_serve.py) --------------------
+
+
+def _scrape(url, timeout=5):
+    import urllib.request
+
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        return r.status, r.headers.get("Content-Type", ""), r.read()
+
+
+def test_serve_endpoints_scrape_and_shutdown(tel):
+    import urllib.error
+
+    assert telemetry.serving() is None
+    srv = telemetry.serve(port=0)
+    try:
+        assert srv.port > 0
+        # serve() is idempotent while running
+        assert telemetry.serve(port=0) is srv
+        assert telemetry.serving() is srv
+
+        code, ctype, body = _scrape(srv.url + "/metrics")
+        assert code == 200 and ctype.startswith("text/plain")
+        assert "version=0.0.4" in ctype
+        text = body.decode()
+        assert "# TYPE sparse_tpu_plan_cache_hits_total counter" in text
+        assert "# HELP " in text
+
+        code, ctype, body = _scrape(srv.url + "/healthz")
+        assert code == 200 and ctype.startswith("application/json")
+        hz = json.loads(body)
+        assert hz["status"] in ("ok", "degraded")
+        for key in ("last_solve_anomalies", "failover_latches", "faults",
+                    "uptime_s"):
+            assert key in hz
+        assert hz["faults"]["active"] is False
+
+        code, ctype, body = _scrape(srv.url + "/session")
+        sess = json.loads(body)
+        for key in ("queue_depth", "dispatches", "sessions", "programs",
+                    "cold_start_s", "slo_misses"):
+            assert key in sess
+
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _scrape(srv.url + "/nope")
+        assert ei.value.code == 404
+    finally:
+        srv.stop()
+    assert telemetry.serving() is None
+    # a stopped exporter's port is actually released (clean shutdown)
+    with pytest.raises(Exception):
+        _scrape(srv.url + "/metrics", timeout=1)
+
+
+def test_serve_healthz_reflects_failover_latch(tel):
+    from sparse_tpu.resilience import failover
+    from sparse_tpu.telemetry import _serve
+
+    failover.clear()
+    try:
+        failover.mark_failed("dia_spmv", error="lowering boom")
+        hz = _serve._healthz()
+        assert hz["status"] == "degraded"
+        assert hz["failover_latches"]["dia_spmv"]["kernel_wide"] is True
+        assert "lowering boom" in hz["failover_latches"]["dia_spmv"]["error"]
+    finally:
+        failover.clear()
+    assert _serve._healthz()["status"] in ("ok", "degraded")
+
+
+# -- Axon v3: compile-time cost attribution (telemetry/_cost.py) -------------
+
+
+def test_cost_attribute_captures_compile_and_emits_event(tel):
+    from sparse_tpu.telemetry import _cost
+
+    @jax.jit
+    def prog(x):
+        return (x * 2.0).sum()
+
+    x = jnp.ones(64)
+    before = _cost.total_compile_s()
+    wrapped, info = _cost.attribute(
+        "test.prog.unit", prog, (x,), pack_s=0.001,
+        solver="cg", bucket=4, dtype="<f8",
+    )
+    assert info["program"] == "test.prog.unit"
+    assert info["compile_s"] >= 0 and info["pack_s"] == 0.001
+    # the wrapped program computes the same thing through the AOT path
+    assert float(wrapped(x)) == float(prog(x))
+    assert "test.prog.unit" in _cost.programs()
+    assert _cost.total_compile_s() > before
+    (ev,) = telemetry.events("plan_cache.compile")
+    assert ev["program"] == "test.prog.unit" and ev["solver"] == "cg"
+    assert not telemetry.schema.validate(ev)
+    # per-program gauges landed in the exposition
+    txt = telemetry.metrics_text()
+    assert "sparse_tpu_plan_cache_program_compile_s" in txt
+    assert 'program="test.prog.unit"' in txt
+    # cold-start budget includes both compile and pack shares
+    assert _cost.total_compile_s() - before == pytest.approx(
+        info["compile_s"] + 0.001, abs=1e-9
+    )
+
+
+def test_cost_attribute_non_aot_callable_degrades(tel):
+    from sparse_tpu.telemetry import _cost
+
+    def plain(x):  # no .lower: the GMRES host-driven closure shape
+        return x + 1
+
+    wrapped, info = _cost.attribute("test.prog.plain", plain, (1,))
+    assert wrapped is plain and "compile_s" not in info
+    assert _cost.programs()["test.prog.plain"]["program"] == \
+        "test.prog.plain"
+
+
+def test_cost_program_wrapper_falls_back_on_arg_drift(tel):
+    from sparse_tpu.telemetry._cost import _Program
+
+    calls = {"fn": 0}
+
+    def fn(x):
+        calls["fn"] += 1
+        return x * 2
+
+    class Rejecting:
+        def __call__(self, x):
+            raise TypeError("layout drift")
+
+    p = _Program(fn, Rejecting())
+    assert p(3) == 6 and calls["fn"] == 1
+    assert p.compiled is None  # permanently reverted to the jit path
+    assert p(4) == 8 and calls["fn"] == 2
+
+
+# -- Axon v3: health-monitor dedup across sequential solves ------------------
+
+
+def test_health_anomaly_dedup_across_sequential_solves(tel):
+    """One ``solver.anomaly`` per (reason, lane) per solve — a session
+    running several solves gets one event per solve, not one total and
+    not one per iteration; the metrics counter stays cumulative."""
+    from sparse_tpu.telemetry import _metrics as M
+
+    n = 8
+    e = np.ones(n)
+    S = sp.diags([-e[:-1], 2.0 * e, -e[:-1]], [-1, 0, 1]).tocsr()
+    S.data[0] = np.nan
+    A = sparse_tpu.csr_array(S)
+    b = np.ones(n)
+    c0 = M.counter("solver.anomalies.by_reason",
+                   reason="nonfinite").value
+    for _ in range(3):
+        linalg.cg(A, b, tol=1e-10, maxiter=20)
+    evs = [
+        e for e in telemetry.events("solver.anomaly")
+        if e["reason"] == "nonfinite"
+    ]
+    assert len(evs) == 3
+    # each solve's report was finalized separately: the LAST report has
+    # exactly one nonfinite anomaly, not three accumulated
+    rep = telemetry.last_solve_report()
+    assert len([
+        a for a in rep["anomalies"] if a["reason"] == "nonfinite"
+    ]) == 1
+    assert M.counter("solver.anomalies.by_reason",
+                     reason="nonfinite").value == c0 + 3
