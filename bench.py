@@ -1108,6 +1108,45 @@ def _try_amg(timeout_s: int = 420):
     return {f"amg_iters_per_s_{labels[i]}": v}
 
 
+def _try_multichip_comm(timeout_s: float):
+    """Multichip measured-comm lane (ISSUE 7): run the S=8 CPU dryrun's
+    collective-accounting stage (``__graft_entry__.dryrun_comm``) in a
+    subprocess and return its structured stats — measured vs model bytes
+    per shard for halo- and gather-mode ``dist_cg`` plus the <=10%
+    agreement verdict. CPU-only by construction (the dryrun forces the
+    virtual mesh), so it runs on every platform without touching a
+    fragile tunnel. Returns the parsed dict, or None."""
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)  # never dial the tunnel for this
+    try:
+        proc = subprocess.run(
+            [
+                sys.executable, "-c",
+                "import __graft_entry__ as g; g.dryrun_comm(8)",
+            ],
+            capture_output=True,
+            text=True,
+            timeout=max(60, timeout_s),
+            cwd=HERE,
+            env=env,
+        )
+    except subprocess.TimeoutExpired:
+        _note_probe_timeout("multichip_comm", timeout_s)
+        return None
+    for line in reversed(proc.stdout.strip().splitlines()):
+        if line.startswith("MULTICHIP_COMM_JSON: "):
+            try:
+                return json.loads(line[len("MULTICHIP_COMM_JSON: "):])
+            except json.JSONDecodeError:
+                break
+    sys.stderr.write(proc.stderr[-1500:])
+    print(
+        f"bench: multichip comm dryrun rc={proc.returncode} without stats",
+        file=sys.stderr,
+    )
+    return None
+
+
 def _try_platform(platform_arg: str, timeout_s: int):
     """Run a worker subprocess; return its parsed JSON line or None."""
     stdout, stderr, rc = "", "", None
@@ -1271,6 +1310,22 @@ def main():
             # survive SIGKILL; the driver reads the LAST metric line)
             print(json.dumps(rec))
             sys.stdout.flush()
+        if rec is not None and remaining() > 150:
+            try:  # multichip measured-comm lane — structured, never fatal
+                mc = _try_multichip_comm(min(240, remaining() - 60))
+                if mc:
+                    rec["multichip_comm"] = mc
+                    if not mc.get("ok"):
+                        print(
+                            "bench: multichip measured-vs-model comm "
+                            "DIVERGED beyond tolerance: "
+                            + json.dumps(mc.get("modes", {})),
+                            file=sys.stderr,
+                        )
+                    print(json.dumps(rec))
+                    sys.stdout.flush()
+            except Exception:
+                traceback.print_exc(file=sys.stderr)
         if (
             rec is not None
             and "_tpu" in rec.get("metric", "")

@@ -1,0 +1,220 @@
+#!/usr/bin/env python
+"""Merge N per-process telemetry logs into one clock-aligned session log.
+
+Under multi-controller the JSONL sink splits per process
+(``records.<pid>.jsonl`` — sparse_tpu/telemetry/_recorder.py), and each
+file leads with a ``session.start`` record carrying the process identity
+(``pi``/``pid``) plus the session clock base: the wall-clock ``epoch``
+and the ``mono``tonic reading taken at that same instant. Every event
+additionally carries ``tm``, its monotonic offset since session start.
+This script recombines the files into ONE session log that
+``axon_trace`` renders with per-process lanes and ``axon_report``
+analyzes/compares as usual.
+
+Usage:
+    python scripts/axon_merge.py [FILES_OR_GLOBS...]
+        [-o OUT.jsonl]        # default results/axon/records.merged.jsonl
+        [--align wall|session]
+        [--json]              # print the summary as JSON
+        [--quiet]
+
+Clock alignment (per event): ``ts' = anchor + tm`` where ``tm`` is the
+event's monotonic offset —
+
+* ``wall`` (default): ``anchor`` is the file's own session epoch. Events
+  keep real wall-clock placement but become monotonic-consistent within
+  each process (NTP steps mid-session cannot reorder a process's lane).
+* ``session``: every ``anchor`` is the EARLIEST session epoch across the
+  inputs — all sessions start at a common origin. Use when the hosts'
+  wall clocks are known-skewed and relative timing is what matters.
+
+Events without ``tm`` (or files without a ``session.start``) keep their
+raw ``ts``. Missing ``pi``/``pid`` stamps are backfilled from the file's
+session.start (or the ``records.<pid>.jsonl`` filename), so the merged
+trace never renders an unattributed lane. bench.py hardware records (no
+``kind``) pass through on raw ``ts``. Exit codes: 0 ok, 2 bad usage /
+no input files.
+"""
+
+from __future__ import annotations
+
+import glob as _glob
+import json
+import os
+import re
+import sys
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+DEFAULT_GLOB = os.path.join(REPO, "results", "axon", "records*.jsonl")
+DEFAULT_OUT = os.path.join(REPO, "results", "axon", "records.merged.jsonl")
+
+_PID_NAME = re.compile(r"\.(\d+)\.jsonl$")
+
+
+def _num(v):
+    return v if isinstance(v, (int, float)) and not isinstance(v, bool) else None
+
+
+def load_process_log(path: str) -> dict:
+    """One per-process file -> ``{"path", "anchor", "records"}`` where
+    ``anchor`` is the first ``session.start`` (or None) and ``records``
+    every parsed JSON line (unparseable lines are dropped — the merged
+    log must stay machine-clean)."""
+    records = []
+    anchor = None
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if not isinstance(rec, dict):
+                continue
+            records.append(rec)
+            if (
+                anchor is None
+                and rec.get("kind") == "session.start"
+                and _num(rec.get("epoch")) is not None
+            ):
+                anchor = rec
+    if anchor is None:
+        m = _PID_NAME.search(os.path.basename(path))
+        if m:  # identity from the sink-split filename, clockless
+            anchor = {"pid": int(m.group(1))}
+    return {"path": path, "anchor": anchor, "records": records}
+
+
+def merge_logs(logs, align: str = "wall"):
+    """Merge loaded per-process logs (see :func:`load_process_log`) into
+    one ts-sorted record list; returns ``(records, summary)``."""
+    ref_epoch = None
+    for lg in logs:
+        ep = _num((lg["anchor"] or {}).get("epoch"))
+        if ep is not None and (ref_epoch is None or ep < ref_epoch):
+            ref_epoch = ep
+
+    merged = []
+    summary = {"files": [], "events": 0, "passthrough": 0, "align": align}
+    for lg in logs:
+        anchor = lg["anchor"] or {}
+        epoch = _num(anchor.get("epoch"))
+        base = (
+            ref_epoch if (align == "session" and ref_epoch is not None)
+            else epoch
+        )
+        n_ev = 0
+        for rec in lg["records"]:
+            rec = dict(rec)
+            if "kind" in rec:
+                n_ev += 1
+                tm = _num(rec.get("tm"))
+                if tm is not None and base is not None:
+                    rec["ts"] = base + tm
+                if "pi" not in rec and "pi" in anchor:
+                    rec["pi"] = anchor["pi"]
+                if "pid" not in rec and "pid" in anchor:
+                    rec["pid"] = anchor["pid"]
+            else:
+                summary["passthrough"] += 1
+            merged.append(rec)
+        summary["files"].append({
+            "path": os.path.basename(lg["path"]),
+            "events": n_ev,
+            "pi": anchor.get("pi"),
+            "pid": anchor.get("pid"),
+            "epoch": epoch,
+            "offset_s": round(epoch - ref_epoch, 6)
+            if (epoch is not None and ref_epoch is not None) else None,
+        })
+        summary["events"] += n_ev
+    merged.sort(key=lambda r: _num(r.get("ts")) or 0.0)
+    summary["processes"] = len({
+        f["pid"] for f in summary["files"] if f["pid"] is not None
+    })
+    return merged, summary
+
+
+def merge_files(paths, out_path: str, align: str = "wall"):
+    """Load, merge and write; returns the summary dict."""
+    logs = [load_process_log(p) for p in paths]
+    merged, summary = merge_logs(logs, align=align)
+    d = os.path.dirname(os.path.abspath(out_path))
+    os.makedirs(d, exist_ok=True)
+    with open(out_path, "w") as f:
+        for rec in merged:
+            f.write(json.dumps(rec) + "\n")
+    summary["out"] = out_path
+    return summary
+
+
+def main(argv) -> int:
+    if "--help" in argv or "-h" in argv:
+        print(__doc__)
+        return 0
+    args = list(argv)
+    quiet = "--quiet" in args
+    as_json = "--json" in args
+    for flag in ("--quiet", "--json"):
+        while flag in args:
+            args.remove(flag)
+
+    def take(flag, default=None):
+        if flag in args:
+            i = args.index(flag)
+            if i + 1 >= len(args):
+                print(f"axon_merge: {flag} needs a value", file=sys.stderr)
+                raise SystemExit(2)
+            v = args[i + 1]
+            del args[i:i + 2]
+            return v
+        return default
+
+    out = take("-o", take("--out", DEFAULT_OUT))
+    align = take("--align", "wall")
+    if align not in ("wall", "session"):
+        print("axon_merge: --align must be 'wall' or 'session'",
+              file=sys.stderr)
+        return 2
+
+    patterns = args if args else [DEFAULT_GLOB]
+    paths = []
+    for pat in patterns:
+        hits = sorted(_glob.glob(pat))
+        paths.extend(hits if hits else [pat])
+    # never fold a previous merge output back into itself
+    out_abs = os.path.abspath(out)
+    paths = [p for p in dict.fromkeys(paths) if os.path.abspath(p) != out_abs]
+    missing = [p for p in paths if not os.path.exists(p)]
+    if missing or not paths:
+        for p in missing:
+            print(f"axon_merge: no such file {p}", file=sys.stderr)
+        if not paths:
+            print("axon_merge: no input files", file=sys.stderr)
+        return 2
+
+    summary = merge_files(paths, out, align=align)
+    if as_json:
+        print(json.dumps(summary, sort_keys=True))
+    elif not quiet:
+        print(
+            f"axon_merge: {len(summary['files'])} file(s), "
+            f"{summary['processes']} process(es), {summary['events']} events "
+            f"(+{summary['passthrough']} bench records) -> {out}"
+        )
+        for f in summary["files"]:
+            off = (
+                f"+{f['offset_s']}s" if f["offset_s"] is not None else "no clock"
+            )
+            print(
+                f"  {f['path']:<28} pi={f['pi']} pid={f['pid']} "
+                f"events={f['events']} ({off})"
+            )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

@@ -10,6 +10,7 @@ Usage:
         [--compare BASELINE.json]      # a report written by --json
         [--threshold 0.2]              # relative regression gate
         [--peak-gflops G] [--peak-gbs B]  # roofline ceilings (optional)
+        [--peak-ici-gbs I]             # per-shard interconnect ceiling
         [--quiet]
 
 Exit codes: 0 = ok, 1 = regressions found (--compare), 2 = bad usage /
@@ -38,6 +39,14 @@ when ``--peak-gflops`` / ``--peak-gbs`` ceilings are given);
 ``report["cold_start_s"]`` is the total compile+pack seconds the
 session paid — the number ROADMAP item 4 (persistent plan cache) is
 out to kill.
+
+Axon v4 additions (ISSUE 7): ``report["comm"]`` rolls up the
+``comm.measured`` events (parallel/comm.py trace-time accounting) per
+site — measured vs analytic-model bytes, divergence %, and the achieved
+per-shard interconnect GB/s joined with the ``--peak-ici-gbs`` ceiling.
+``comm.<site>.abs_divergence_pct`` rides the ``--compare`` metrics
+surface, so a model/implementation drift (or an unaccounted collective)
+fails the regression gate like any latency regression would.
 """
 
 from __future__ import annotations
@@ -220,8 +229,50 @@ def _programs_rollup(events, peak_gflops=None, peak_gbs=None) -> dict:
     return programs
 
 
+def _comm_rollup(events, peak_ici_gbs=None) -> dict:
+    """Measured-vs-model collective accounting per site, from the
+    ``comm.measured`` events: total measured bytes, the analytic model's
+    bytes for the same solves, divergence %, and — when the events carry
+    solve wall time — achieved per-shard GB/s vs the ``--peak-ici-gbs``
+    interconnect roofline. ``exact=False`` marks sites whose accounting
+    includes a capacity bound (ragged exchanges)."""
+    sites: dict = {}
+    for e in events:
+        if e.get("kind") != "comm.measured":
+            continue
+        s = sites.setdefault(str(e.get("site", "?")), {
+            "events": 0, "measured_bytes": 0, "model_bytes": 0,
+            "bytes_per_shard": 0, "solve_s": 0.0, "executions": 0,
+            "exact": True,
+        })
+        s["events"] += 1
+        s["measured_bytes"] += int(_num(e.get("bytes")) or 0)
+        s["model_bytes"] += int(_num(e.get("model_bytes")) or 0)
+        s["bytes_per_shard"] += int(_num(e.get("bytes_per_shard")) or 0)
+        s["solve_s"] += float(_num(e.get("solve_s")) or 0.0)
+        s["executions"] += int(_num(e.get("executions")) or 0)
+        if e.get("exact") is False:
+            s["exact"] = False
+    for s in sites.values():
+        if s["model_bytes"]:
+            s["divergence_pct"] = round(
+                100.0 * (s["measured_bytes"] - s["model_bytes"])
+                / s["model_bytes"], 3,
+            )
+        if s["solve_s"] > 0 and s["bytes_per_shard"]:
+            s["achieved_gbs_per_shard"] = round(
+                s["bytes_per_shard"] / s["solve_s"] / 1e9, 6
+            )
+            if peak_ici_gbs:
+                s["pct_peak_ici"] = round(
+                    100.0 * s["achieved_gbs_per_shard"] / peak_ici_gbs, 3
+                )
+        s["solve_s"] = round(s["solve_s"], 6)
+    return sites
+
+
 def build_report(records_path: str, bench_paths=(), peak_gflops=None,
-                 peak_gbs=None) -> dict:
+                 peak_gbs=None, peak_ici_gbs=None) -> dict:
     """The whole analysis as one JSON-serializable dict (see module
     docstring for the ``metrics`` comparison surface)."""
     events, hw = load_records(records_path)
@@ -303,6 +354,7 @@ def build_report(records_path: str, bench_paths=(), peak_gflops=None,
     ]
 
     tickets = _tickets_rollup(events)
+    comm = _comm_rollup(events, peak_ici_gbs)
     programs = _programs_rollup(events, peak_gflops, peak_gbs)
     cold_start_s = round(sum(
         (_num(p.get("compile_s")) or 0.0) + (_num(p.get("pack_s")) or 0.0)
@@ -334,6 +386,17 @@ def build_report(records_path: str, bench_paths=(), peak_gflops=None,
         }
     for kind, b in comm_bytes.items():
         metrics[f"bytes.{kind}"] = {"v": b, "hib": False}
+    for site, s in comm.items():
+        if _num(s.get("divergence_pct")) is not None and s.get("exact"):
+            # measured drift from the analytic model: direction-free, so
+            # compare |divergence| (a site can drift either way)
+            metrics[f"comm.{site}.abs_divergence_pct"] = {
+                "v": round(abs(s["divergence_pct"]), 3), "hib": False,
+            }
+        if _num(s.get("achieved_gbs_per_shard")) is not None:
+            metrics[f"comm.{site}.achieved_gbs_per_shard"] = {
+                "v": s["achieved_gbs_per_shard"], "hib": True,
+            }
     metrics["anomalies.count"] = {"v": len(anomalies), "hib": False}
     if tickets["n"]:
         for q in ("p50", "p95", "p99"):
@@ -370,6 +433,7 @@ def build_report(records_path: str, bench_paths=(), peak_gflops=None,
         "spans": spans,
         "solvers": solvers,
         "comm_bytes": comm_bytes,
+        "comm": comm,
         "cache": cache,
         "anomalies": anomalies[:100],
         "tickets": tickets,
@@ -436,6 +500,21 @@ def _print_report(rep: dict) -> None:
         print("  comm volumes (structural bytes):")
         for k, b in rep["comm_bytes"].items():
             print(f"    {k:<22} {b}")
+    if rep.get("comm"):
+        print("  measured comm (vs analytic model):")
+        for site, s in sorted(rep["comm"].items()):
+            bits = [f"measured={s['measured_bytes']}"]
+            if s.get("model_bytes"):
+                bits.append(f"model={s['model_bytes']}")
+            if s.get("divergence_pct") is not None:
+                bits.append(f"div={s['divergence_pct']:+.2f}%")
+            if s.get("achieved_gbs_per_shard") is not None:
+                bits.append(f"{s['achieved_gbs_per_shard']}GB/s/shard")
+            if s.get("pct_peak_ici") is not None:
+                bits.append(f"{s['pct_peak_ici']}%ICI")
+            if not s.get("exact"):
+                bits.append("(capacity-bounded)")
+            print(f"    {site:<22} " + " ".join(bits))
     if rep["cache"]["session"]:
         c = rep["cache"]["session"]
         print(
@@ -533,6 +612,8 @@ def main(argv) -> int:
         peak_gflops = float(pk_gf) if pk_gf is not None else None
         pk_gb = take("--peak-gbs")
         peak_gbs = float(pk_gb) if pk_gb is not None else None
+        pk_ici = take("--peak-ici-gbs")
+        peak_ici_gbs = float(pk_ici) if pk_ici is not None else None
     except ValueError:
         print("axon_report: --threshold/--peak-* must be numbers",
               file=sys.stderr)
@@ -548,7 +629,7 @@ def main(argv) -> int:
         bench_paths.extend(hits if hits else [pat])
 
     rep = build_report(records, bench_paths, peak_gflops=peak_gflops,
-                       peak_gbs=peak_gbs)
+                       peak_gbs=peak_gbs, peak_ici_gbs=peak_ici_gbs)
     if not quiet:
         _print_report(rep)
     if out_json:

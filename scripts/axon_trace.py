@@ -15,6 +15,12 @@ readback phase breakdown) — docs/telemetry.md.
 
 bench.py hardware metric records sharing the log (no ``kind`` field)
 are skipped by contract; a trimmed/partial session exports fine.
+
+Multi-controller sessions: merge the per-process ``records.<pid>.jsonl``
+files first (``python scripts/axon_merge.py``) and point this script at
+the merged log — events carrying more than one ``pi`` (process_index)
+render each controller's subsystem lanes side by side under a ``p<pi>/``
+prefix (``p0/comm``, ``p1/solver``, ...).
 """
 
 import os

@@ -1,5 +1,5 @@
 #!/usr/bin/env python
-"""Cap the committed session log (results/axon/records.jsonl) to the
+"""Cap the committed session logs (results/axon/records*.jsonl) to the
 latest bench session, so telemetry evidence doesn't grow the repo
 unboundedly (ISSUE 2 CI/tooling satellite).
 
@@ -9,19 +9,28 @@ Kept lines:
     with slack) onward;
   * the freshest ``_tpu`` hardware metric record regardless of age —
     bench.py's wedged-tunnel fallback (``_freshest_session_record``)
-    must never lose its only hardware evidence to a trim.
+    must never lose its only hardware evidence to a trim;
+  * the latest ``session.start`` record regardless of age — without its
+    epoch/monotonic clock base a per-process file can no longer be
+    clock-aligned by ``scripts/axon_merge.py`` (ISSUE 7 satellite).
 
-Run from anywhere: ``python scripts/trim_records.py [--dry-run]``.
-CI/round tooling runs it before committing results.
+Under multi-controller the sink splits into ``records.<pid>.jsonl``
+per-process files; the CLI globs and trims each one (a per-process file
+without a ``bench.session`` record is kept whole — the window anchor
+lives in the controller-0 log). Run from anywhere:
+``python scripts/trim_records.py [--dry-run]``. CI/round tooling runs
+it before committing results.
 """
 
+import glob as _glob
 import json
 import os
 import sys
 
 HERE = os.path.dirname(os.path.abspath(__file__))
 REPO = os.path.dirname(HERE)
-RECORDS = os.path.join(HERE, "..", "results", "axon", "records.jsonl")
+AXON_DIR = os.path.join(HERE, "..", "results", "axon")
+RECORDS = os.path.join(AXON_DIR, "records.jsonl")
 SLACK_S = 120.0  # clock slack around the session window
 
 
@@ -91,7 +100,7 @@ def trim(path: str = RECORDS, dry_run: bool = False) -> int:
         with open(path) as f:
             lines = [ln for ln in f.read().splitlines() if ln.strip()]
     except OSError:
-        print("trim_records: no session log; nothing to do")
+        print(f"trim_records: no session log at {os.path.basename(path)}")
         return 0
 
     parsed = []
@@ -114,6 +123,8 @@ def trim(path: str = RECORDS, dry_run: bool = False) -> int:
 
     freshest_line = None
     best_ts = None
+    session_line = None  # latest session.start: the merge clock base
+    session_ts = None
     for ln, r in parsed:
         if (
             isinstance(r, dict)
@@ -123,17 +134,25 @@ def trim(path: str = RECORDS, dry_run: bool = False) -> int:
         ):
             if best_ts is None or r["ts"] > best_ts:
                 best_ts, freshest_line = r["ts"], ln
+        if (
+            isinstance(r, dict)
+            and r.get("kind") == "session.start"
+            and isinstance(r.get("ts"), (int, float))
+        ):
+            if session_ts is None or r["ts"] > session_ts:
+                session_ts, session_line = r["ts"], ln
 
     kept = []
     for ln, r in parsed:
         ts = r.get("ts") if isinstance(r, dict) else None
         in_window = isinstance(ts, (int, float)) and ts >= start
-        if in_window or r is None or ln == freshest_line:
+        if in_window or r is None or ln in (freshest_line, session_line):
             kept.append(ln)
 
     dropped = len(lines) - len(kept)
     print(
-        f"trim_records: {len(lines)} lines -> {len(kept)} "
+        f"trim_records: {os.path.basename(path)}: "
+        f"{len(lines)} lines -> {len(kept)} "
         f"(dropped {dropped}; window starts {start:.0f})"
     )
     if dropped and not dry_run:
@@ -147,5 +166,17 @@ def trim(path: str = RECORDS, dry_run: bool = False) -> int:
     return dropped
 
 
+def trim_all(dry_run: bool = False) -> int:
+    """Trim every committed session log — the single-controller
+    ``records.jsonl`` plus any per-process ``records.<pid>.jsonl`` the
+    multi-controller sink split produced. Merge outputs
+    (``records.merged.jsonl``) are trimmed like any other log."""
+    paths = sorted(_glob.glob(os.path.join(AXON_DIR, "records*.jsonl")))
+    if not paths:
+        print("trim_records: no session logs; nothing to do")
+        return 0
+    return sum(trim(p, dry_run=dry_run) for p in paths)
+
+
 if __name__ == "__main__":
-    trim(dry_run="--dry-run" in sys.argv)
+    trim_all(dry_run="--dry-run" in sys.argv)

@@ -6,6 +6,7 @@ ppermute/all_to_all) over ICI/DCN.
 """
 
 from .partition import balanced_row_splits, column_windows, equal_row_splits  # noqa: F401
+from . import comm  # noqa: F401  (measured collective accounting)
 from .dist import DistCSR, DistCSRCol, comm_stats, dist_cg, shard_csr, shard_csr_cols  # noqa: F401
 from .spgemm import dist_spgemm, dist_spgemm_2d  # noqa: F401
 from .grid2d import cdist_2d, lookup_2d  # noqa: F401

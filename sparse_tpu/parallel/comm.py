@@ -1,0 +1,291 @@
+"""Measured collective accounting: thin wrappers over the XLA collectives.
+
+Every explicit collective this package issues (``psum``, ``psum_scatter``,
+``ppermute``, ``all_gather``, ``all_to_all``, ``ragged_all_to_all``) goes
+through a wrapper here. At trace time the wrapper computes the exact
+payload bytes from the operand's static shape and notes them on a
+:class:`SiteLedger` — the per-program record of what one *execution* of
+that program moves over the interconnect. Host-side call sites then
+``commit()`` the ledger with the execution count they observed (one per
+eager SpMV, ``iters + 1`` per distributed CG solve), which feeds the
+always-on ``comm.collectives{op,site}`` / ``comm.collective_bytes{op,site}``
+metric families, and — with telemetry on — emit a ``comm.measured`` event
+reconciled against the analytic ``model=True`` estimates the same sites
+have recorded since PR 1 (``comm_stats`` / ``sort_comm_stats`` /
+``spgemm2d_comm_stats``). Divergence between the two is itself a signal:
+the model drifted from the implementation, or a collective was added
+without accounting.
+
+Why trace-time accounting counts as *measured*: shard_map bodies run with
+static shapes, so the payload of each collective is exact at trace time —
+unlike the analytic models, which re-derive the volumes from the matrix
+structure and can silently disagree with what was actually compiled.
+Two caveats, both carried on the events:
+
+* GSPMD-inserted collectives (the ``psum`` behind a ``jnp.vdot`` on
+  sharded operands) are invisible to wrappers — the scalar reduction
+  traffic of a Krylov iteration is counted only by the model (a few
+  itemsizes per iteration; the documented expected divergence).
+* ``ragged_all_to_all`` payloads are runtime-dynamic; the wrapper
+  accounts the operand *capacity* as an upper bound and marks the entry
+  ``exact=False`` (the ledger's ``exact`` flag goes false with it).
+
+Byte conventions (bytes **per shard** per execution, chosen to match the
+analytic models'):
+
+=================  =======================================================
+``ppermute``       payload nbytes (each shard sends/receives one payload)
+``all_gather``     ``(S - 1) *`` local-block nbytes (received from peers)
+``psum``           logical payload nbytes (the models count a reduced
+                   scalar as one itemsize, not the ring's ``2(S-1)/S`` x)
+``psum_scatter``   ``nbytes * (S - 1) / S`` (ring reduce-scatter)
+``all_to_all``     ``nbytes * (S - 1) / S`` (off-diagonal chunks)
+``ragged_a2a``     operand capacity nbytes (upper bound, ``exact=False``)
+=================  =======================================================
+
+Metrics are ALWAYS ON (the plan-cache discipline: plain counter bumps);
+only the ``comm.measured`` events are telemetry-gated.
+"""
+
+from __future__ import annotations
+
+import threading
+
+import jax
+
+from ..telemetry import _metrics
+
+_LOCK = threading.RLock()
+#: site name -> the most recently constructed ledger for it (observability
+#: snapshot surface; per-object ledgers stay authoritative for commits)
+_SITES: dict = {}
+#: (site, key) -> ledger, for :func:`ledger`'s get-or-create form
+_LEDGERS: dict = {}
+
+#: always-on metric family names
+BYTES_METRIC = "comm.collective_bytes"
+CALLS_METRIC = "comm.collectives"
+
+
+def _nbytes(x) -> int:
+    """Static payload bytes of an array/tracer (shape x itemsize)."""
+    import numpy as np
+
+    return int(np.prod(x.shape, dtype=np.int64)) * int(
+        np.dtype(x.dtype).itemsize
+    )
+
+
+class SiteLedger:
+    """Per-program collective accounting for one instrumentation site.
+
+    ``note()`` is idempotent per ``(op, tag)`` — a re-trace of the same
+    program (new shapes after a width change, jit cache miss) overwrites
+    rather than double-counts, so the ledger always describes ONE
+    execution of the most recently traced program.
+    """
+
+    __slots__ = ("site", "_entries", "_exact")
+
+    def __init__(self, site: str):
+        self.site = str(site)
+        self._entries: dict = {}  # (op, tag) -> bytes per shard per exec
+        self._exact: dict = {}  # (op, tag) -> bool
+        with _LOCK:
+            _SITES[self.site] = self
+
+    def note(self, op: str, tag: str, nbytes: int, exact: bool = True) -> None:
+        """Record one collective call site's per-execution payload."""
+        with _LOCK:
+            self._entries[(op, tag)] = int(nbytes)
+            self._exact[(op, tag)] = bool(exact)
+
+    @property
+    def entries(self) -> dict:
+        with _LOCK:
+            return dict(self._entries)
+
+    @property
+    def exact(self) -> bool:
+        """True when every noted payload is exact (no capacity bounds)."""
+        with _LOCK:
+            return all(self._exact.values())
+
+    def bytes_per_shard(self) -> int:
+        """Interconnect bytes one shard moves per program execution."""
+        with _LOCK:
+            return sum(self._entries.values())
+
+    def per_op(self) -> dict:
+        """``{op: {"calls": k, "bytes": b}}`` per program execution."""
+        out: dict = {}
+        with _LOCK:
+            items = list(self._entries.items())
+        for (op, _tag), b in items:
+            d = out.setdefault(op, {"calls": 0, "bytes": 0})
+            d["calls"] += 1
+            d["bytes"] += b
+        return out
+
+    def commit(self, executions: int = 1, shards: int = 1) -> None:
+        """Fold ``executions`` runs of this program into the always-on
+        metrics registry. ``shards`` scales per-shard bytes to the total
+        across the mesh (the convention the model events use)."""
+        if executions <= 0 or not self._entries:
+            return
+        for op, d in self.per_op().items():
+            _metrics.counter(
+                CALLS_METRIC, op=op, site=self.site,
+                help="collective launches accounted by sparse_tpu.parallel.comm",
+            ).inc(d["calls"] * executions)
+            _metrics.counter(
+                BYTES_METRIC, op=op, site=self.site,
+                help="measured collective payload bytes (all shards)",
+            ).add(d["bytes"] * executions * shards)
+
+
+def ledger(site: str, key=None) -> SiteLedger:
+    """Get-or-create the shared ledger for ``(site, key)``.
+
+    ``key`` distinguishes geometries that trace through the same code
+    site (mesh size, exchange capacity): a jit-cached program for
+    geometry A must never commit against bytes a later geometry-B trace
+    noted. Per-layout objects (``DistCSR``) construct their own
+    :class:`SiteLedger` instead; call sites whose program re-traces on
+    every call may share a keyless ledger (each trace fully overwrites
+    the same tag set)."""
+    k = (site, key)
+    with _LOCK:
+        led = _LEDGERS.get(k)
+    if led is None:
+        led = SiteLedger(site)
+        with _LOCK:
+            _LEDGERS[k] = led
+    return led
+
+
+def sites() -> dict:
+    """Snapshot of every known site's per-execution accounting."""
+    with _LOCK:
+        leds = list(_SITES.values())
+    return {
+        led.site: {
+            "bytes_per_shard": led.bytes_per_shard(),
+            "exact": led.exact,
+            "ops": led.per_op(),
+        }
+        for led in leds
+        if led.entries
+    }
+
+
+def metrics_snapshot() -> dict:
+    """``{site: {op: bytes}}`` of the committed always-on byte totals."""
+    with _LOCK:
+        items = [
+            m for (n, _), m in _metrics._REGISTRY.items() if n == BYTES_METRIC
+        ]
+    out: dict = {}
+    for m in items:
+        out.setdefault(m.labels.get("site", "?"), {})[
+            m.labels.get("op", "?")
+        ] = int(m.value)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# the wrappers — drop-in signatures over jax.lax, plus ledger/tag/axis_size
+# ---------------------------------------------------------------------------
+def ppermute(x, axis_name, perm, *, ledger=None, tag=""):
+    if ledger is not None:
+        ledger.note("ppermute", tag, _nbytes(x))
+    return jax.lax.ppermute(x, axis_name, perm)
+
+
+def all_gather(x, axis_name, *, axis_size, ledger=None, tag="", **kwargs):
+    if ledger is not None:
+        ledger.note("all_gather", tag, (int(axis_size) - 1) * _nbytes(x))
+    return jax.lax.all_gather(x, axis_name, **kwargs)
+
+
+def psum(x, axis_name, *, ledger=None, tag=""):
+    if ledger is not None:
+        ledger.note("psum", tag, _nbytes(x))
+    return jax.lax.psum(x, axis_name)
+
+
+def psum_scatter(x, axis_name, *, axis_size, ledger=None, tag="", **kwargs):
+    if ledger is not None:
+        S = int(axis_size)
+        ledger.note("psum_scatter", tag, _nbytes(x) * (S - 1) // max(S, 1))
+    return jax.lax.psum_scatter(x, axis_name, **kwargs)
+
+
+def all_to_all(
+    x, axis_name, split_axis, concat_axis, *, axis_size, ledger=None, tag=""
+):
+    if ledger is not None:
+        S = int(axis_size)
+        ledger.note("all_to_all", tag, _nbytes(x) * (S - 1) // max(S, 1))
+    return jax.lax.all_to_all(x, axis_name, split_axis, concat_axis)
+
+
+def ragged_all_to_all(
+    operand, output, input_offsets, send_sizes, output_offsets, recv_sizes,
+    *, axis_name, ledger=None, tag="",
+):
+    if ledger is not None:
+        # runtime-ragged payload: account the send-buffer capacity as an
+        # upper bound and flag the entry inexact (docs/telemetry.md)
+        ledger.note("ragged_all_to_all", tag, _nbytes(operand), exact=False)
+    return jax.lax.ragged_all_to_all(
+        operand, output, input_offsets, send_sizes, output_offsets,
+        recv_sizes, axis_name=axis_name,
+    )
+
+
+# ---------------------------------------------------------------------------
+# reconciliation: the measured-vs-model event
+# ---------------------------------------------------------------------------
+def record_measured(
+    site: str,
+    led: SiteLedger,
+    *,
+    executions: int,
+    shards: int,
+    model_bytes=None,
+    solve_s=None,
+    **fields,
+):
+    """Emit one ``comm.measured`` event (telemetry-gated): the ledger's
+    trace-derived bytes scaled by the observed execution count, reconciled
+    against the analytic ``model_bytes`` when given (``divergence_pct`` —
+    expected small-positive: the model omits setup executions, the
+    measurement omits GSPMD-inserted scalar psums). ``solve_s`` adds the
+    achieved per-shard GB/s the report's ``--peak-ici-gbs`` roofline
+    consumes. Returns the event dict or ``None`` when disabled."""
+    from .. import telemetry
+
+    if not telemetry.enabled() or not led.entries:
+        return None
+    per_shard = led.bytes_per_shard() * int(executions)
+    total = per_shard * int(shards)
+    ev = dict(
+        site=site,
+        bytes=total,
+        bytes_per_shard=per_shard,
+        executions=int(executions),
+        S=int(shards),
+        ops=led.per_op(),
+        exact=led.exact,
+        **fields,
+    )
+    if isinstance(model_bytes, (int, float)) and model_bytes > 0:
+        ev["model_bytes"] = int(model_bytes)
+        ev["divergence_pct"] = round(
+            100.0 * (total - model_bytes) / model_bytes, 3
+        )
+    if isinstance(solve_s, (int, float)) and solve_s > 0:
+        ev["solve_s"] = round(float(solve_s), 6)
+        ev["gbs_per_shard"] = round(per_shard / solve_s / 1e9, 6)
+    return telemetry.record("comm.measured", **ev)

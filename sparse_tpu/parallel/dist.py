@@ -38,6 +38,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..utils import asjnp
+from . import comm
 from .mesh import get_mesh
 from .partition import balanced_row_splits, column_windows, equal_row_splits
 
@@ -148,6 +149,18 @@ class DistCSR:
             self._spmv_bytes_cache = b
         return b
 
+    def _commit_comm(self, attr: str) -> None:
+        """Fold one eager execution of a compiled program into the
+        always-on measured-comm metrics (``comm.collective_bytes{op,site}``,
+        ``parallel/comm.py``). Traced inner-loop calls are accounted at
+        the solver level instead (``dist_cg``)."""
+        led = getattr(self, attr, None)
+        if led is not None and led.entries:
+            from ..utils import in_trace
+
+            if not in_trace():
+                led.commit(1, self.S)
+
     def spmv_padded(self, xp: jax.Array) -> jax.Array:
         """y = A @ x entirely in padded layout ([n_pad] -> [m_pad]).
 
@@ -165,7 +178,7 @@ class DistCSR:
                 telemetry.count("comm.spmv.calls")
                 telemetry.add_bytes("comm.spmv.total", self._spmv_comm_bytes())
         fn = self._plan_fn("_spmv_fn", "dist.spmv", lambda: _build_spmv(self))
-        return fn(
+        out = fn(
             xp,
             *(
                 (self.ell_idx, self.ell_val)
@@ -173,6 +186,11 @@ class DistCSR:
                 else (self.nz_rows, self.nz_cols, self.nz_vals)
             ),
         )
+        # measured accounting: the trace populated the ledger by the time
+        # the dispatch returns, so an eager call commits exactly one
+        # program execution's collective volume
+        self._commit_comm("_comm_ledger")
+        return out
 
     # -- SpMM --------------------------------------------------------------
     def pad_matrix(self, B, splits=None, width=None) -> jax.Array:
@@ -209,7 +227,9 @@ class DistCSR:
         fn = self._plan_fn(
             "_spmm_fn", "dist.spmm", lambda: _build_spmv(self, matrix=True)
         )
-        return fn(Bp, *self._blocks())
+        out = fn(Bp, *self._blocks())
+        self._commit_comm("_comm_ledger_spmm")
+        return out
 
     def rspmm_padded(self, Bp: jax.Array) -> jax.Array:
         """C = B @ A with dense B in padded *row-space* layout [p, m_pad].
@@ -221,7 +241,9 @@ class DistCSR:
         reference's ADD-reduction into a broadcast C.
         """
         fn = self._plan_fn("_rspmm_fn", "dist.rspmm", lambda: _build_rspmm(self))
-        return fn(Bp)
+        out = fn(Bp)
+        self._commit_comm("_comm_ledger_rspmm")
+        return out
 
     def _blocks(self):
         return (
@@ -322,21 +344,36 @@ def _build_spmv(A: DistCSR, matrix: bool = False):
     perm_right = [(i, i + 1) for i in range(S - 1)]  # tail -> right neighbor
     perm_left = [(i + 1, i) for i in range(S - 1)]  # head -> left neighbor
     is_mat = matrix
+    # measured-comm ledger: populated at trace time with the exact payload
+    # bytes of every collective this program issues (parallel/comm.py);
+    # per-object so distinct layouts/geometries never collide
+    led = comm.SiteLedger("dist.spmm" if matrix else "dist.spmv")
+    setattr(A, "_comm_ledger_spmm" if matrix else "_comm_ledger", led)
 
     def gather_x(x_l):
         """Each shard's addressable x/B slab from its local block (leading
         axis = the n dimension; halo/all_gather both slice it)."""
         if mode == "gather":
             # Replicate fallback: one all_gather over the mesh axis.
-            return jax.lax.all_gather(x_l, axis, tiled=True)  # [S*C, ...]
+            return comm.all_gather(
+                x_l, axis, axis_size=S, ledger=led, tag="x", tiled=True
+            )  # [S*C, ...]
         if S == 1 or HL + HR == 0:
             return x_l
         parts = []
         if HL:
-            parts.append(jax.lax.ppermute(x_l[-HL:], axis, perm_right))
+            parts.append(
+                comm.ppermute(
+                    x_l[-HL:], axis, perm_right, ledger=led, tag="halo_l"
+                )
+            )
         parts.append(x_l)
         if HR:
-            parts.append(jax.lax.ppermute(x_l[:HR], axis, perm_left))
+            parts.append(
+                comm.ppermute(
+                    x_l[:HR], axis, perm_left, ledger=led, tag="halo_r"
+                )
+            )
         return jnp.concatenate(parts)  # [HL + C + HR, ...]
 
     if layout == "ell":
@@ -396,6 +433,8 @@ def _build_rspmm(A: DistCSR):
     mesh, axis, S, R, C, HL = A.mesh, A.axis, A.S, A.R, A.C, A.HL
     mode, layout = A.mode, A.layout
     n_pad = S * C
+    led = comm.SiteLedger("dist.rspmm")
+    A._comm_ledger_rspmm = led
 
     def shard_fn(B_l, *blocks):
         s = jax.lax.axis_index(axis)
@@ -413,7 +452,8 @@ def _build_rspmm(A: DistCSR):
         cols = jnp.clip(cols, 0, n_pad - 1)  # padding entries carry val 0
         contrib = B_l[:, rows] * vals  # [p, Kf]
         out = jax.ops.segment_sum(contrib.T, cols, num_segments=n_pad)
-        return jax.lax.psum(out.T, axis)  # [p, n_pad] replicated
+        # [p, n_pad] replicated (ADD-reduction into a broadcast C)
+        return comm.psum(out.T, axis, ledger=led, tag="reduce")
 
     if layout == "ell":
         block_specs = (P(axis, None, None), P(axis, None, None))
@@ -481,12 +521,15 @@ class DistCSRCol:
     unpad_vector = DistCSR.unpad_vector
 
     _plan_fn = DistCSR._plan_fn
+    _commit_comm = DistCSR._commit_comm
 
     def spmv_padded(self, xp: jax.Array) -> jax.Array:
         fn = self._plan_fn(
             "_spmv_fn", "dist.spmv_col", lambda: _build_spmv_col(self)
         )
-        return fn(xp, self.nz_rows, self.nz_cols, self.nz_vals)
+        out = fn(xp, self.nz_rows, self.nz_cols, self.nz_vals)
+        self._commit_comm("_comm_ledger")
+        return out
 
     def dot(self, x) -> np.ndarray:
         xp = self.pad_vector(np.asarray(x))
@@ -500,6 +543,8 @@ class DistCSRCol:
 def _build_spmv_col(A: DistCSRCol):
     mesh, axis, S, R = A.mesh, A.axis, A.S, A.R
     m_pad = S * R
+    led = comm.SiteLedger("dist.spmv_col")
+    A._comm_ledger = led
 
     def shard_fn(x_l, rows_l, cols_l, vals_l):
         x = x_l.reshape(-1)
@@ -516,7 +561,9 @@ def _build_spmv_col(A: DistCSRCol):
             return y_full
         # reduce partial sums across the mesh AND re-shard to row blocks in
         # one collective (rides ICI as a ring reduce-scatter)
-        return jax.lax.psum_scatter(y_full, axis, tiled=True)
+        return comm.psum_scatter(
+            y_full, axis, axis_size=S, ledger=led, tag="y", tiled=True
+        )
 
     smapped = shard_map(
         shard_fn,
@@ -867,8 +914,19 @@ def dist_cg(
         A, tol=tol, atol=atol, maxiter=maxiter,
         conv_test_iters=conv_test_iters, M=M,
     )
+    import time as _time
+
+    t0 = _time.perf_counter()
     xp, iters, converged = run(bp, xp)
-    iters, converged = int(iters), bool(converged)
+    iters, converged = int(iters), bool(converged)  # host fetch = fence
+    solve_s = _time.perf_counter() - t0
+    # the compiled loop runs one SpMV per iteration plus the initial
+    # residual SpMV; commit that many executions of the traced program's
+    # measured collective volume into the always-on metrics
+    executions = iters + 1
+    led = getattr(A, "_comm_ledger", None)
+    if led is not None and led.entries:
+        led.commit(executions, A.S)
     from .. import telemetry
 
     if telemetry.enabled():
@@ -877,13 +935,27 @@ def dist_cg(
         # attribution for the compiled while_loop (which is opaque to
         # per-call counters by design)
         cs = comm_stats(A, conv_test_iters)
+        model_bytes = (
+            int(cs["cg_iter_collective_bytes_per_shard"]) * iters * A.S
+        )
         telemetry.record(
             "comm.cg", S=A.S, iters=iters, mode=A.mode,
-            bytes=int(cs["cg_iter_collective_bytes_per_shard"]) * iters * A.S,
+            bytes=model_bytes,
             bytes_per_iter_per_shard=int(
                 cs["cg_iter_collective_bytes_per_shard"]
             ),
         )
+        if led is not None and led.entries:
+            # trace-derived measured bytes reconciled against the model:
+            # divergence is the drift signal (expected residue: the model
+            # counts the GSPMD scalar psums the wrappers cannot see, the
+            # measurement counts the initial-residual SpMV the model
+            # omits — both shrink with iteration count)
+            comm.record_measured(
+                "dist.cg", led, executions=executions, shards=A.S,
+                model_bytes=model_bytes, solve_s=solve_s,
+                mode=A.mode, iters=iters,
+            )
         telemetry.record(
             "solver.solve", solver="dist_cg", n=int(A.shape[0]),
             iters=iters, path="device", converged=converged,

@@ -22,6 +22,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from . import comm
 from .mesh import get_mesh_2d
 
 from .mesh import shard_map  # version-portable (check_vma/check_rep shim)
@@ -160,14 +161,16 @@ def lookup_2d(sorted_sets: np.ndarray, queries: np.ndarray, mesh: Mesh | None = 
     qs_p = np.concatenate([queries, np.repeat(pad_row, Qp - Q, 0)])
     Sl = Sp // gy
 
+    led = comm.ledger("grid2d.lookup")
+
     def tile(q_l, s_l):
         j = jax.lax.axis_index(ax_y)
         pos, found = _searchsorted_rows(s_l, q_l)
         gpos = jnp.where(found, pos.astype(jnp.int64) + j.astype(jnp.int64) * Sl, 0)
         # each query is found in exactly one y-block; psum combines
         return (
-            jax.lax.psum(gpos, ax_y),
-            jax.lax.psum(found.astype(jnp.int32), ax_y),
+            comm.psum(gpos, ax_y, ledger=led, tag="pos"),
+            comm.psum(found.astype(jnp.int32), ax_y, ledger=led, tag="found"),
         )
 
     smapped = shard_map(
@@ -180,6 +183,7 @@ def lookup_2d(sorted_sets: np.ndarray, queries: np.ndarray, mesh: Mesh | None = 
     qd = jax.device_put(qs_p, NamedSharding(mesh, P(ax_x, None)))
     sd = jax.device_put(sets_p, NamedSharding(mesh, P(ax_y, None)))
     gpos, found = jax.jit(smapped)(qd, sd)
+    led.commit(1, gx * gy)  # always-on measured-comm metrics
     gpos = np.asarray(gpos)[:Q]
     found = np.asarray(found)[:Q]
     if not np.all(found == 1):

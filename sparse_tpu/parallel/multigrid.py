@@ -28,7 +28,38 @@ __all__ = [
     "make_dist_vcycle",
     "make_replicated_tail",
     "tail_crossover",
+    "hierarchy_comm_per_cycle",
 ]
+
+
+def hierarchy_comm_per_cycle(ops) -> dict:
+    """Measured per-V-cycle collective bytes of a sharded hierarchy.
+
+    Sums each level's trace-populated SpMV ledgers (``parallel/comm.py``;
+    the vcycle applies A three times and R/P once per level) into
+    per-level and total bytes-per-shard-per-cycle — the weak-scaling
+    number for the preconditioner, from the traced programs rather than
+    the structural model. Levels whose programs have not been traced yet
+    (no solve run) contribute zero; ``exact`` goes false if any level's
+    accounting carries a capacity bound.
+    """
+    levels = []
+    total = 0
+    exact = True
+    for i, (Ad, Rd, Pd) in enumerate(ops):
+        per_level = 0
+        for op, execs in ((Ad, 3), (Rd, 1), (Pd, 1)):
+            led = getattr(op, "_comm_ledger", None) if op is not None else None
+            if led is not None and led.entries:
+                per_level += led.bytes_per_shard() * execs
+                exact = exact and led.exact
+        levels.append(per_level)
+        total += per_level
+    return {
+        "levels_bytes_per_shard": levels,
+        "bytes_per_shard_per_cycle": total,
+        "exact": exact,
+    }
 
 
 def tail_crossover(sizes, replicate_below: int, bottom_always: bool = False):

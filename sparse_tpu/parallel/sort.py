@@ -33,6 +33,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from . import comm
 from .mesh import get_mesh
 
 from .mesh import shard_map  # version-portable (check_vma/check_rep shim)
@@ -50,6 +51,10 @@ def dist_sort(keys, payloads, mesh: Mesh | None = None, axis: str = "shards"):
         mesh = get_mesh()
     S = int(mesh.devices.size)
     payloads = tuple(payloads)
+    # fresh ledger per call: the shard_map closure below re-traces every
+    # call, and the tag set varies with S — a shared ledger would keep
+    # stale round tags from a larger previous mesh
+    led = comm.SiteLedger("sort.oddeven")
 
     def shard_fn(k_l, *p_l):
         k = k_l.reshape(-1)
@@ -65,8 +70,11 @@ def dist_sort(keys, payloads, mesh: Mesh | None = None, axis: str = "shards"):
             if not pairs:
                 continue
             perm = pairs + [(j, i) for i, j in pairs]
-            other_k = jax.lax.ppermute(k, axis, perm)
-            other_ps = [jax.lax.ppermute(p, axis, perm) for p in ps]
+            other_k = comm.ppermute(k, axis, perm, ledger=led, tag=f"k{r}")
+            other_ps = [
+                comm.ppermute(p, axis, perm, ledger=led, tag=f"p{r}.{i}")
+                for i, p in enumerate(ps)
+            ]
             q = me - start
             paired = (q >= 0) & (q < len(pairs) * 2)
             is_left = paired & (q % 2 == 0)
@@ -97,6 +105,7 @@ def dist_sort(keys, payloads, mesh: Mesh | None = None, axis: str = "shards"):
         shard_fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
         check_vma=False,
     )(keys, *payloads)
+    led.commit(1, S)  # always-on measured-comm metrics (one sort pass)
     skeys = out[0].reshape(-1)
     spayloads = tuple(o.reshape(-1) for o in out[1:])
     return skeys, spayloads
@@ -116,7 +125,12 @@ def _sentinel(dtype):
 # ---------------------------------------------------------------------------
 @lru_cache(maxsize=None)
 def _sample_phase1(mesh, axis, S, n_payloads):
-    """Local sort + splitter selection + per-destination send counts."""
+    """Local sort + splitter selection + per-destination send counts.
+
+    The returned callable carries its own ``comm_ledger`` (one per cached
+    build) so a geometry's committed bytes can never come from another
+    build's trace."""
+    led = comm.SiteLedger("sort.sample1")
 
     def shard_fn(k_l, *p_l):
         k = k_l.reshape(-1)
@@ -127,7 +141,12 @@ def _sample_phase1(mesh, axis, S, n_payloads):
         # regular sampling: S evenly spaced samples per shard
         pos = jnp.array([(j + 1) * L // (S + 1) for j in range(S)])
         samples = k[jnp.clip(pos, 0, L - 1)]
-        all_samples = jnp.sort(jax.lax.all_gather(samples, axis, tiled=True))
+        all_samples = jnp.sort(
+            comm.all_gather(
+                samples, axis, axis_size=S, ledger=led, tag="samples",
+                tiled=True,
+            )
+        )
         splitters = all_samples[jnp.arange(1, S) * S]  # [S-1]
         bounds = jnp.searchsorted(k, splitters, side="left").astype(jnp.int32)
         starts = jnp.concatenate([jnp.zeros((1,), jnp.int32), bounds])
@@ -141,15 +160,24 @@ def _sample_phase1(mesh, axis, S, n_payloads):
         P(axis, None),
         P(axis, None),
     )
-    return jax.jit(
+    jitted = jax.jit(
         shard_map(
             shard_fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
             check_vma=False,
         )
     )
 
+    def phase1(*args):
+        return jitted(*args)
 
-def _ragged_a2a(x, out_buf, in_off, send, out_off, recv, axis, S, pair_cap, native):
+    phase1.comm_ledger = led
+    return phase1
+
+
+def _ragged_a2a(
+    x, out_buf, in_off, send, out_off, recv, axis, S, pair_cap, native,
+    ledger=None, tag="",
+):
     """ragged_all_to_all, with a dense-slot emulation for backends that
     don't implement the HLO (XLA:CPU — the virtual test mesh).
 
@@ -158,7 +186,10 @@ def _ragged_a2a(x, out_buf, in_off, send, out_off, recv, axis, S, pair_cap, nati
     most a full L-block to one destination) and compacts received chunks to
     ``out_off`` with an out-of-bounds-dropping scatter. Only the native
     path's traffic is the alltoallv shape; the emulation is for
-    correctness-testing the algorithm on the CPU mesh.
+    correctness-testing the algorithm on the CPU mesh. ``ledger``/``tag``
+    route the measured-comm accounting (parallel/comm.py): the native
+    ragged payload is accounted at send-buffer capacity (``exact=False``),
+    the emulation at its actual dense-slot wire volume.
     """
     if native:
         # jax.lax.ragged_all_to_all's output_offsets are SENDER-side: entry
@@ -166,14 +197,19 @@ def _ragged_a2a(x, out_buf, in_off, send, out_off, recv, axis, S, pair_cap, nati
         # caller passes receiver-side offsets (where peer j's chunk lands in
         # MY buffer — what the emulation consumes); one all_to_all of the
         # offset vector is exactly that transpose.
-        out_off_send = jax.lax.all_to_all(out_off[:, None], axis, 0, 0).reshape(-1)
-        return jax.lax.ragged_all_to_all(
-            x, out_buf, in_off, send, out_off_send, recv, axis_name=axis
+        out_off_send = comm.all_to_all(
+            out_off[:, None], axis, 0, 0, axis_size=S,
+            ledger=ledger, tag=f"{tag}.off",
+        ).reshape(-1)
+        return comm.ragged_all_to_all(
+            x, out_buf, in_off, send, out_off_send, recv, axis_name=axis,
+            ledger=ledger, tag=tag,
         )
     idx = jnp.arange(pair_cap, dtype=jnp.int32)
     gathered = x[jnp.clip(in_off[:, None] + idx[None, :], 0, x.shape[0] - 1)]
     slots = jnp.where(idx[None, :] < send[:, None], gathered, 0)
-    ex = jax.lax.all_to_all(slots, axis, 0, 0)  # row j = chunk from source j
+    # row j = chunk from source j
+    ex = comm.all_to_all(slots, axis, 0, 0, axis_size=S, ledger=ledger, tag=tag)
     pos = jnp.where(
         idx[None, :] < recv[:, None],
         out_off[:, None] + idx[None, :],
@@ -184,8 +220,12 @@ def _ragged_a2a(x, out_buf, in_off, send, out_off, recv, axis, S, pair_cap, nati
 
 @lru_cache(maxsize=None)
 def _sample_phase2(mesh, axis, S, L, cap, n_payloads, key_dtype, p_dtypes, native):
-    """Bucket exchange -> local merge -> exact-rank rebalance exchange."""
+    """Bucket exchange -> local merge -> exact-rank rebalance exchange.
+
+    Like phase 1, the returned callable carries its own ``comm_ledger``
+    (one per cached build — the geometry args ARE the cache key)."""
     sent = _sentinel(jnp.dtype(key_dtype))
+    led = comm.SiteLedger("sort.sample2")
 
     def shard_fn(k_l, *rest):
         p_l = rest[:n_payloads]
@@ -196,20 +236,23 @@ def _sample_phase2(mesh, axis, S, L, cap, n_payloads, key_dtype, p_dtypes, nativ
         starts = jnp.concatenate([jnp.zeros((1,), jnp.int32), bounds])
         ends = jnp.concatenate([bounds, jnp.full((1,), L, jnp.int32)])
         send = ends - starts
-        recv = jax.lax.all_to_all(send[:, None], axis, 0, 0).reshape(-1)
+        recv = comm.all_to_all(
+            send[:, None], axis, 0, 0, axis_size=S, ledger=led, tag="counts",
+        ).reshape(-1)
         out_off = jnp.concatenate(
             [jnp.zeros((1,), jnp.int32), jnp.cumsum(recv)[:-1].astype(jnp.int32)]
         )
         buf = jnp.full((cap,), sent, dtype=k.dtype)
         k2 = _ragged_a2a(
-            k, buf, starts, send, out_off, recv, axis, S, L, native
+            k, buf, starts, send, out_off, recv, axis, S, L, native,
+            ledger=led, tag="bucket.k",
         )
         ps2 = [
             _ragged_a2a(
                 p, jnp.zeros((cap,), dtype=p.dtype), starts, send, out_off,
-                recv, axis, S, L, native,
+                recv, axis, S, L, native, ledger=led, tag=f"bucket.p{i}",
             )
-            for p in ps
+            for i, p in enumerate(ps)
         ]
         # merge: one stable sort applies the same permutation to keys and
         # payloads, so duplicate keys keep their own payloads
@@ -218,7 +261,9 @@ def _sample_phase2(mesh, axis, S, L, cap, n_payloads, key_dtype, p_dtypes, nativ
         ps2 = [p[order] for p in ps2]
         # rebalance to exact global ranks [s*L, (s+1)*L)
         nvalid = jnp.sum(recv).astype(jnp.int32)
-        counts_all = jax.lax.all_gather(nvalid, axis)  # [S]
+        counts_all = comm.all_gather(
+            nvalid, axis, axis_size=S, ledger=led, tag="nvalid"
+        )  # [S]
         me = jax.lax.axis_index(axis)
         gstart = jnp.sum(jnp.where(jnp.arange(S) < me, counts_all, 0))
         slot = jnp.arange(cap, dtype=jnp.int32)
@@ -232,20 +277,23 @@ def _sample_phase2(mesh, axis, S, L, cap, n_payloads, key_dtype, p_dtypes, nativ
         )
         starts2 = bnds2[:-1]
         send2 = bnds2[1:] - bnds2[:-1]
-        recv2 = jax.lax.all_to_all(send2[:, None], axis, 0, 0).reshape(-1)
+        recv2 = comm.all_to_all(
+            send2[:, None], axis, 0, 0, axis_size=S, ledger=led,
+            tag="counts2",
+        ).reshape(-1)
         off2 = jnp.concatenate(
             [jnp.zeros((1,), jnp.int32), jnp.cumsum(recv2)[:-1].astype(jnp.int32)]
         )
         k3 = _ragged_a2a(
             k2, jnp.full((L,), sent, dtype=k.dtype), starts2, send2, off2,
-            recv2, axis, S, L, native,
+            recv2, axis, S, L, native, ledger=led, tag="restore.k",
         )
         ps3 = [
             _ragged_a2a(
                 p, jnp.zeros((L,), dtype=p.dtype), starts2, send2, off2,
-                recv2, axis, S, L, native,
+                recv2, axis, S, L, native, ledger=led, tag=f"restore.p{i}",
             )
-            for p in ps2
+            for i, p in enumerate(ps2)
         ]
         # chunks arrive ordered by source rank and sources hold ascending
         # rank ranges, so the concatenation is already globally sorted
@@ -256,12 +304,18 @@ def _sample_phase2(mesh, axis, S, L, cap, n_payloads, key_dtype, p_dtypes, nativ
         P(axis, None),  # splitters [S, S-1] (identical rows)
     )
     out_specs = tuple(P(axis, None) for _ in range(1 + n_payloads))
-    return jax.jit(
+    jitted = jax.jit(
         shard_map(
             shard_fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
             check_vma=False,
         )
     )
+
+    def phase2(*args):
+        return jitted(*args)
+
+    phase2.comm_ledger = led
+    return phase2
 
 
 def dist_sort_sample(keys, payloads=(), mesh: Mesh | None = None, axis: str = "shards"):
@@ -289,6 +343,7 @@ def dist_sort_sample(keys, payloads=(), mesh: Mesh | None = None, axis: str = "s
 
     phase1 = _sample_phase1(mesh, axis, S, len(payloads))
     out = phase1(keys, *payloads)
+    phase1.comm_ledger.commit(1, S)
     k_sorted = out[0].reshape(-1)
     ps_sorted = [o.reshape(-1) for o in out[1 : 1 + len(payloads)]]
     send_matrix = np.asarray(out[1 + len(payloads)])  # [S, S]
@@ -296,18 +351,20 @@ def dist_sort_sample(keys, payloads=(), mesh: Mesh | None = None, axis: str = "s
 
     from .. import telemetry
 
+    model_bytes = None
     if telemetry.enabled():
         # exact bucket-exchange volume from the send matrix this function
         # already fetches to size the alltoallv buffers — zero extra syncs
         kit = np.dtype(keys.dtype).itemsize
         entry_bytes = kit + sum(np.dtype(p.dtype).itemsize for p in payloads)
         off_diag = int(send_matrix.sum() - np.trace(send_matrix))
+        model_bytes = off_diag * entry_bytes + int(S * S * S * kit)
         telemetry.record(
             "comm.sort", S=S, n=int(keys.shape[0]),
             bucket_entries_sent=off_diag,
             sample_allgather_bytes=int(S * S * S * kit),
             fallback_odd_even=bool(send_matrix.sum(axis=0).max() > cap),
-            bytes=off_diag * entry_bytes + int(S * S * S * kit),
+            bytes=model_bytes,
         )
 
     if int(send_matrix.sum(axis=0).max()) > cap:
@@ -331,6 +388,15 @@ def dist_sort_sample(keys, payloads=(), mesh: Mesh | None = None, axis: str = "s
             "to the odd-even transposition sort"
         )
         return dist_sort(k_sorted, tuple(ps_sorted), mesh=mesh, axis=axis)
+    led2 = phase2.comm_ledger
+    led2.commit(1, S)
+    # measured-vs-model reconciliation: capacity-accounted (the ragged
+    # exchange payload is runtime-dynamic), so exact=False and the
+    # divergence is a bound check rather than a drift alarm
+    comm.record_measured(
+        "sort.sample", led2, executions=1, shards=S,
+        model_bytes=model_bytes, n=int(keys.shape[0]),
+    )
     return out2[0].reshape(-1), tuple(o.reshape(-1) for o in out2[1:])
 
 

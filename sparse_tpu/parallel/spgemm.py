@@ -402,9 +402,13 @@ def _spgemm2d_shuffle(
     and ordered) merge with ONE stable row sort. Output: per-device local
     COO in the DistCSR padded coordinate space ([S_out*C_out] columns) plus
     per-device valid counts and column-window stats."""
+    from . import comm
     from .sort import _ragged_a2a
 
     ax_x, ax_y = mesh.axis_names
+    # geometry-keyed: jit caches this program per static-arg combo, so the
+    # committed bytes must come from the ledger THIS geometry traced
+    led = comm.ledger("spgemm2d.shuffle", key=(mesh, gy, cap, U))
 
     def body(r_l, c_l, v_l, sub, roff, csp):
         r1 = r_l.reshape(-1)
@@ -414,22 +418,24 @@ def _spgemm2d_shuffle(
             jnp.int32
         )
         starts, send = bounds[:-1], bounds[1:] - bounds[:-1]
-        recv = jax.lax.all_to_all(send[:, None], ax_y, 0, 0).reshape(-1)
+        recv = comm.all_to_all(
+            send[:, None], ax_y, 0, 0, axis_size=gy, ledger=led, tag="counts",
+        ).reshape(-1)
         out_off = jnp.concatenate(
             [jnp.zeros((1,), jnp.int32), jnp.cumsum(recv)[:-1].astype(jnp.int32)]
         )
         sent_row = jnp.asarray(rows_real, r1.dtype)  # > any real local row
         r2 = _ragged_a2a(
             r1, jnp.full((cap,), sent_row), starts, send, out_off, recv,
-            ax_y, gy, U, native,
+            ax_y, gy, U, native, ledger=led, tag="rows",
         )
         c2 = _ragged_a2a(
             c1, jnp.zeros((cap,), c1.dtype), starts, send, out_off, recv,
-            ax_y, gy, U, native,
+            ax_y, gy, U, native, ledger=led, tag="cols",
         )
         v2 = _ragged_a2a(
             v1, jnp.zeros((cap,), v1.dtype), starts, send, out_off, recv,
-            ax_y, gy, U, native,
+            ax_y, gy, U, native, ledger=led, tag="vals",
         )
         # chunks arrive in source order (out_off is cumsum over j') with
         # disjoint ascending column ranges, and each chunk is (row, col)
@@ -653,6 +659,12 @@ def dist_spgemm_2d(A, B, mesh2d=None, as_dist: bool = False):
         mesh=mesh2d, cap=cap, U=T, gy=gy, rows_real=rows_real, R_out=R_out,
         S_out=S_out, C_out=C_out, native=native,
     )
+    from . import comm as _comm
+
+    _shuffle_led = _comm.ledger(
+        "spgemm2d.shuffle", key=(mesh2d, gy, cap, T)
+    )
+    _shuffle_led.commit(1, S_out)
 
     # O(S) window stats -> halo widths via the policy shared with shard_csr
     cmin_h = np.asarray(cmin).reshape(-1)
@@ -726,6 +738,15 @@ def dist_spgemm_2d(A, B, mesh2d=None, as_dist: bool = False):
             shuffle_entries_sent_max=int(crossing.max()),
             exchange_cap_entries=int(cap),
             bytes=int(repl) * S_out + int(crossing.sum()) * entry_bytes,
+        )
+        # shuffle-phase reconciliation (capacity-accounted: exact=False);
+        # the model side here is the shuffle volume only — replication is
+        # host device_put traffic, not a wrapped collective
+        _comm.record_measured(
+            "spgemm2d.shuffle", _shuffle_led,
+            executions=1, shards=S_out,
+            model_bytes=int(crossing.sum()) * entry_bytes or None,
+            grid=[gx, gy],
         )
     if as_dist:
         return dist

@@ -82,7 +82,9 @@ from ._recorder import (  # noqa: F401
     enabled,
     events,
     flush,
+    process_identity,
     record,
+    session_info,
     sink_path,
 )
 from ._recorder import reset as _reset_recorder
@@ -125,7 +127,9 @@ __all__ = [
     "metrics",
     "metrics_text",
     "new_ticket_id",
+    "process_identity",
     "record",
+    "session_info",
     "reset",
     "schema",
     "serve",

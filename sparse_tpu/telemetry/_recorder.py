@@ -44,6 +44,10 @@ _SINK = None  # lazily-opened append-mode file object
 _SINK_FAILED = False
 _SINK_PATH_OPEN: str | None = None
 _PATH_OVERRIDE: str | None = None
+# -- per-process identity + session clock base (Axon v4) --------------------
+_IDENT: dict | None = None  # cached process_identity()
+_SESSION: dict | None = None  # {"epoch", "mono", "session"} clock base
+_SESSION_STAMPED: set = set()  # sink paths that already carry session.start
 
 # repo root = two levels up from this package (sparse_tpu/telemetry/)
 _DEFAULT_SINK = os.path.join(
@@ -61,11 +65,118 @@ def enabled() -> bool:
     return bool(settings.telemetry)
 
 
+def _env_int(name: str):
+    v = os.environ.get(name)
+    if v is None:
+        return None
+    try:
+        return int(v)
+    except ValueError:
+        return None
+
+
+def process_identity() -> dict:
+    """This controller process's identity, cached for the process
+    lifetime: ``{"pi": process_index, "pid", "procs": process_count,
+    "devices", "backend"}``.
+
+    ``SPARSE_TPU_PROCESS_INDEX`` / ``SPARSE_TPU_PROCESS_COUNT`` override
+    the jax runtime answer (tests simulate multi-controller without N
+    hosts; fleet launchers can stamp identity before jax initializes).
+    Every failure degrades to the single-controller identity — the
+    recorder must never raise from an identity probe."""
+    global _IDENT
+    if _IDENT is not None:
+        return _IDENT
+    pi = _env_int("SPARSE_TPU_PROCESS_INDEX")
+    procs = _env_int("SPARSE_TPU_PROCESS_COUNT")
+    devices = None
+    backend = ""
+    try:
+        import jax
+
+        if pi is None:
+            pi = int(jax.process_index())
+        if procs is None:
+            procs = int(jax.process_count())
+        devices = len(jax.devices())
+        backend = str(jax.default_backend())
+    except Exception:
+        pass
+    _IDENT = {
+        "pi": int(pi or 0),
+        "pid": os.getpid(),
+        "procs": int(procs or 1),
+        "devices": devices,
+        "backend": backend,
+    }
+    return _IDENT
+
+
+def reset_identity() -> None:
+    """Drop the cached identity (tests that monkeypatch the env
+    overrides; a fork that wants its own pid stamp)."""
+    global _IDENT, _SESSION
+    with _LOCK:
+        _IDENT = None
+        _SESSION = None
+        _SESSION_STAMPED.clear()
+
+
+def session_info() -> dict:
+    """The session clock base: ``{"epoch": wall-clock start, "mono":
+    monotonic reading at that instant, "session": id}``. Established at
+    the first read and stable for the process lifetime — the pair is what
+    lets ``scripts/axon_merge.py`` clock-align per-process logs (aligned
+    ts = epoch + per-event monotonic offset ``tm``)."""
+    global _SESSION
+    if _SESSION is None:
+        with _LOCK:
+            if _SESSION is None:
+                ep = time.time()
+                _SESSION = {
+                    "epoch": ep,
+                    "mono": time.monotonic(),
+                    "session": f"{os.getpid():x}-{int(ep)}",
+                }
+    return _SESSION
+
+
+def _session_start_event() -> dict:
+    base = session_info()
+    ident = process_identity()
+    return {
+        "kind": "session.start",
+        "ts": base["epoch"],
+        "tm": 0.0,
+        "epoch": base["epoch"],
+        "mono": base["mono"],
+        "pi": ident["pi"],
+        "pid": ident["pid"],
+        "procs": ident["procs"],
+        "devices": ident["devices"],
+        "backend": ident["backend"],
+        "session": base["session"],
+    }
+
+
 def sink_path() -> str:
-    """Resolved JSONL sink path (override > settings > default)."""
-    if _PATH_OVERRIDE:
-        return _PATH_OVERRIDE
-    return settings.telemetry_path or _DEFAULT_SINK
+    """Resolved JSONL sink path (override > settings > default).
+
+    Under multi-controller (``process_count > 1`` — or the env overrides
+    simulating it) the sink splits per process: ``records.jsonl`` becomes
+    ``records.<pid>.jsonl``, so N controllers on shared storage never
+    interleave writes into one file; ``scripts/axon_merge.py`` recombines
+    them into one session log."""
+    base = _PATH_OVERRIDE or settings.telemetry_path or _DEFAULT_SINK
+    try:
+        ident = process_identity()
+        if ident["procs"] > 1:
+            root, ext = os.path.splitext(base)
+            return f"{root}.{ident['pid']}{ext or '.jsonl'}"
+    except Exception:
+        pass
+    return base
 
 
 def configure(path: str | None = None) -> None:
@@ -126,6 +237,17 @@ def _write(ev: dict) -> None:
             os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
             _SINK = open(path, "a")
             _SINK_PATH_OPEN = path
+        if path not in _SESSION_STAMPED:
+            # first write to this sink: lead with the session-start record
+            # (process identity + the epoch/monotonic clock base
+            # axon_merge aligns on). Sink-only by design — the in-memory
+            # ring stays event-count-faithful for summaries.
+            _SESSION_STAMPED.add(path)
+            if ev.get("kind") != "session.start":
+                _SINK.write(
+                    json.dumps(_session_start_event(), default=_jsonable)
+                    + "\n"
+                )
         _SINK.write(json.dumps(ev, default=_jsonable) + "\n")
         _SINK.flush()
     except (OSError, ValueError):
@@ -154,7 +276,17 @@ def record(kind: str, **fields):
     if not settings.telemetry:
         return None
     global _DROPPED
-    ev = {"kind": kind, "ts": time.time()}
+    base = session_info()
+    ident = process_identity()
+    ev = {
+        "kind": kind,
+        "ts": time.time(),
+        # monotonic offset since session start: the wall-jump-proof
+        # timestamp axon_merge aligns multi-host logs on
+        "tm": round(time.monotonic() - base["mono"], 6),
+        "pi": ident["pi"],
+        "pid": ident["pid"],
+    }
     ev.update(fields)
     _context.annotate(ev)
     with _LOCK:

@@ -57,6 +57,11 @@ KINDS: dict[str, frozenset] = {
     # path actually taken in `to` — the perf-cliff breadcrumb
     "coverage.fallback": frozenset({"op", "reason"}),
     # -- distribution (parallel/) ------------------------------------------
+    # measured collective volume of a compiled program (parallel/comm.py
+    # trace-time accounting x observed executions), reconciled against the
+    # analytic model when one exists (model_bytes / divergence_pct);
+    # exact=False marks capacity-accounted ragged exchanges
+    "comm.measured": frozenset({"site", "bytes"}),
     # structural comm model of a freshly sharded operator (per-SpMV cost)
     "comm.spmv": frozenset({"bytes", "mode", "S"}),
     # whole-solve collective volume of a distributed CG run
@@ -91,6 +96,12 @@ KINDS: dict[str, frozenset] = {
     # (flops, bytes, peak_bytes) — the roofline join key is `program`
     "plan_cache.compile": frozenset({"program"}),
     # -- generic ------------------------------------------------------------
+    # one per process per sink file, written before the first event: the
+    # controller's identity (process_index/pid/process_count, device
+    # count, backend) plus the session clock base — wall-clock `epoch`
+    # and the `mono`tonic reading at that instant — that
+    # scripts/axon_merge.py uses to clock-align per-process logs
+    "session.start": frozenset({"epoch", "mono", "pi", "pid"}),
     "span": frozenset({"name", "dur_s"}),
     # bench.py session record (always written by a bench run, even when
     # the TPU probe timed out)

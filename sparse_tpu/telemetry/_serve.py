@@ -30,13 +30,52 @@ import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
-from . import _health, _metrics
+from . import _health, _metrics, _recorder
 
 _LOCK = threading.Lock()
 _SERVER = None
 
 #: Prometheus text exposition content type (format 0.0.4)
 METRICS_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+
+def _process_block() -> dict:
+    """Process identity for fleet scrapes (Axon v4 satellite): with N
+    controllers each serving its own exporter, a scraper must be able to
+    tell WHICH process (and which per-process record file) it reached."""
+    try:
+        ident = dict(_recorder.process_identity())
+        base = _recorder.session_info()
+        ident["session"] = base["session"]
+        ident["session_epoch"] = base["epoch"]
+        ident["sink"] = _recorder.sink_path()
+        return ident
+    except Exception:
+        return {}
+
+
+def _register_identity_metrics() -> None:
+    """Expose identity on the always-on registry so every /metrics scrape
+    carries it as labels (the Prometheus *_info convention)."""
+    try:
+        ident = _recorder.process_identity()
+        _metrics.gauge(
+            "process.info",
+            help="process identity (value is always 1; the labels carry it)",
+            process_index=ident["pi"],
+            pid=ident["pid"],
+            procs=ident["procs"],
+            backend=ident["backend"] or "?",
+        ).set(1)
+        _metrics.gauge(
+            "process.devices", help="jax-visible device count"
+        ).set(ident["devices"] or 0)
+        _metrics.gauge(
+            "process.session_epoch",
+            help="wall-clock epoch of this process's telemetry session",
+        ).set(_recorder.session_info()["epoch"])
+    except Exception:
+        pass  # identity is best-effort; the exporter must still serve
 
 
 def _healthz() -> dict:
@@ -65,6 +104,7 @@ def _healthz() -> dict:
         "status": "degraded" if degraded else "ok",
         "uptime_s": round(time.monotonic() - (_SERVER.t0 if _SERVER else 0), 3)
         if _SERVER else 0.0,
+        "process": _process_block(),
         "last_solve_anomalies": anomalies,
         "failover_latches": latches,
         "faults": faults_status,
@@ -192,6 +232,7 @@ def serve(port: int = 0, host: str = "127.0.0.1") -> AxonServer:
     with _LOCK:
         if _SERVER is not None:
             return _SERVER
+        _register_identity_metrics()
         _SERVER = AxonServer(host, port)
         return _SERVER
 

@@ -51,9 +51,14 @@ _LANES = (
     (6, "bench", ("bench.",)),
     (7, "spans", ("span",)),
     (8, "resilience", ("fault.", "checkpoint.", "resilience.")),
+    (9, "session", ("session.",)),
 )
-_TICKETS_PID = 9
-_OTHER_PID = 10
+_TICKETS_PID = 10
+_OTHER_PID = 11
+#: per-process lane namespacing stride: a merged multi-controller log
+#: (scripts/axon_merge.py) renders process i's subsystem lanes at
+#: ``pid + i * _PROC_STRIDE`` under a ``p<process_index>/`` name prefix
+_PROC_STRIDE = 100
 
 #: batch.ticket phase order, matching the serving path's breakdown
 _TICKET_PHASES = ("queue", "pack", "compile", "solve", "readback")
@@ -89,10 +94,27 @@ def to_chrome_trace(events) -> dict:
     Events without a valid ``ts`` are skipped; nothing here raises on
     malformed content. Timestamps stay absolute epoch microseconds —
     Perfetto normalizes to the trace's own origin.
+
+    When the events carry more than one ``pi`` (process_index — a merged
+    multi-controller session from ``scripts/axon_merge.py``), every
+    subsystem lane is replicated per process at ``pid + i *
+    _PROC_STRIDE`` under a ``p<pi>/`` name prefix, so each controller's
+    solver/comm/batch activity renders side by side on the one timeline.
+    A single-process log renders exactly as before.
     """
+    events = [e for e in events if isinstance(e, dict)]
+
+    def _pi_of(ev):
+        pi = ev.get("pi")
+        return pi if isinstance(pi, int) and not isinstance(pi, bool) else None
+
+    pis = sorted({p for p in (_pi_of(e) for e in events) if p is not None})
+    multi = len(pis) > 1
+
     trace_events = []
     tids: dict = {}  # (pid, track name) -> tid int
     pids_seen = set()
+    pid_meta: dict = {}  # final pid -> (base pid, pi or None)
 
     def tid_of(pid: int, track: str) -> int:
         key = (pid, track)
@@ -104,8 +126,6 @@ def to_chrome_trace(events) -> dict:
         return t
 
     for ev in events:
-        if not isinstance(ev, dict):
-            continue
         ts = _num(ev.get("ts"))
         if ts is None:
             continue
@@ -113,6 +133,14 @@ def to_chrome_trace(events) -> dict:
         if not isinstance(kind, str) or not kind:
             continue
         pid, track = _lane_of(ev)
+        if multi:
+            pi = _pi_of(ev)
+            ns = pis.index(pi) if pi is not None else 0
+            base = pid
+            pid = pid + ns * _PROC_STRIDE
+            pid_meta[pid] = (base, pis[ns] if pi is not None else pis[0])
+        else:
+            pid_meta[pid] = (pid, None)
         tid = tid_of(pid, track)
         ts_us = ts * 1e6
         args = {
@@ -179,9 +207,15 @@ def to_chrome_trace(events) -> dict:
     names[_TICKETS_PID] = "tickets"
     names[_OTHER_PID] = "other"
     for pid in sorted(pids_seen):
+        base, pi = pid_meta.get(pid, (pid, None))
+        lane = names.get(base, "other")
+        label = (
+            f"sparse_tpu/p{pi}/{lane}" if pi is not None
+            else f"sparse_tpu/{lane}"
+        )
         meta.append({
             "ph": "M", "name": "process_name", "pid": pid, "tid": 0,
-            "args": {"name": f"sparse_tpu/{names.get(pid, 'other')}"},
+            "args": {"name": label},
         })
         meta.append({
             "ph": "M", "name": "process_sort_index", "pid": pid, "tid": 0,

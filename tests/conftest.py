@@ -53,6 +53,7 @@ _QUICK_FILES = {
     "test_bench_evidence.py",
     "test_bsr.py",
     "test_checkpoint.py",
+    "test_comm_measured.py",
     "test_coo.py",
     "test_csr_conversion.py",
     "test_csr_dot.py",

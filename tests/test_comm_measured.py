@@ -1,0 +1,421 @@
+"""Axon v4 mesh observability (ISSUE 7): measured collective accounting
+(``sparse_tpu/parallel/comm.py``), per-process event identity, and the
+multi-host telemetry merge.
+
+Pins the PR's acceptance surface: (a) the S=8 CPU dryrun parity —
+measured ``comm.measured`` bytes for halo- AND gather-mode ``dist_cg``
+agree with the analytic ``comm_stats`` model within 10%, with the
+per-SpMV accounting agreeing EXACTLY; (b) always-on
+``comm.collective_bytes{op,site}`` metrics accumulate without telemetry
+enabled; (c) the recorder stamps every event with process identity and
+leads each sink file with a ``session.start`` clock base; (d)
+``scripts/axon_merge.py`` round-trips two per-process logs into one
+clock-aligned session that ``axon_trace`` renders with per-process lanes
+(never "other") and ``axon_report --compare`` accepts.
+"""
+
+import importlib.util
+import json
+import os
+
+import numpy as np
+import pytest
+import scipy.sparse as sp
+
+import sparse_tpu
+from sparse_tpu import telemetry
+from sparse_tpu.config import settings
+from sparse_tpu.parallel import comm
+from sparse_tpu.telemetry import _metrics, _recorder
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXDIR = os.path.join(REPO, "testdata", "axon_two_proc")
+
+
+def _load(name):
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(REPO, "scripts", f"{name}.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.fixture
+def tel(tmp_path, monkeypatch):
+    """Telemetry enabled with an isolated sink; fully reset afterwards."""
+    telemetry.reset()
+    monkeypatch.setattr(settings, "telemetry", True)
+    telemetry.configure(str(tmp_path / "records.jsonl"))
+    yield tmp_path / "records.jsonl"
+    telemetry.configure(None)
+    telemetry.reset()
+
+
+def _band_csr(n=1024, offs=(-8, -4, -1, 0, 1, 4, 8)):
+    """SPD band matrix whose halo (16 entries) dwarfs the per-iteration
+    scalar psums — the shape where the 10% reconciliation is meaningful."""
+    A = sp.diags([np.ones(n - abs(k)) for k in offs], offs).tocsr()
+    return (A + sp.diags(np.full(n, 20.0))).astype(np.float32)
+
+
+def _bytes_metric(site):
+    vals = 0
+    with _metrics._LOCK:
+        items = [
+            m for (nm, _), m in _metrics._REGISTRY.items()
+            if nm == comm.BYTES_METRIC and m.labels.get("site") == site
+        ]
+    for m in items:
+        vals += int(m.value)
+    return vals
+
+
+# -- (a) SiteLedger semantics -------------------------------------------------
+
+
+def test_ledger_idempotent_notes_and_commit_math():
+    led = comm.SiteLedger("test.site")
+    led.note("ppermute", "a", 100)
+    led.note("ppermute", "a", 120)  # re-trace overwrites, never doubles
+    led.note("all_gather", "b", 50, exact=False)
+    assert led.bytes_per_shard() == 170
+    assert not led.exact
+    per = led.per_op()
+    assert per["ppermute"] == {"calls": 1, "bytes": 120}
+    assert per["all_gather"] == {"calls": 1, "bytes": 50}
+    before = _bytes_metric("test.site")
+    led.commit(executions=3, shards=4)
+    assert _bytes_metric("test.site") - before == 170 * 3 * 4
+    assert comm.sites()["test.site"]["bytes_per_shard"] == 170
+
+
+# -- (b) S=8 dryrun parity: the acceptance criterion --------------------------
+
+
+@pytest.mark.parametrize("mode,kwargs", [
+    ("halo", {}),
+    ("gather", {"halo_max_ratio": 0.0}),
+])
+def test_dist_cg_measured_matches_model_within_10pct(tel, mode, kwargs):
+    from sparse_tpu.parallel.dist import comm_stats, dist_cg, shard_csr
+
+    A = _band_csr()
+    D = shard_csr(sparse_tpu.csr_array(A), **kwargs)
+    assert D.mode == mode
+    b = np.ones(A.shape[0], np.float32)
+    _, iters, _ = dist_cg(D, b, tol=1e-30, maxiter=25, conv_test_iters=5)
+    assert iters == 25
+    cs = comm_stats(D, 5)
+    led = D._comm_ledger
+    # per-SpMV: trace-derived bytes equal the structural model EXACTLY
+    assert led.bytes_per_shard() == cs["spmv_collective_bytes_per_shard"]
+    evs = telemetry.events("comm.measured")
+    ev = [e for e in evs if e.get("site") == "dist.cg"][-1]
+    assert ev["S"] == D.S and ev["executions"] == iters + 1
+    assert ev["exact"] is True
+    # whole-solve reconciliation within the 10% gate (residue: GSPMD
+    # scalar psums on the model side, the initial-residual SpMV on the
+    # measured side)
+    assert abs(ev["divergence_pct"]) <= 10.0
+    assert ev["bytes"] == led.bytes_per_shard() * (iters + 1) * D.S
+    assert ev["solve_s"] > 0 and ev["gbs_per_shard"] >= 0
+
+
+def test_dist_cg_halo_vs_gather_measured_ordering(tel):
+    """The gather fallback must measure as strictly more traffic than the
+    halo path on the same operator — the regression the accounting is
+    for (a banded matrix silently flipping to gather)."""
+    from sparse_tpu.parallel.dist import dist_cg, shard_csr
+
+    A = _band_csr(512)
+    b = np.ones(512, np.float32)
+    Dh = shard_csr(sparse_tpu.csr_array(A))
+    dist_cg(Dh, b, tol=1e-30, maxiter=5, conv_test_iters=5)
+    Dg = shard_csr(sparse_tpu.csr_array(A), halo_max_ratio=0.0)
+    dist_cg(Dg, b, tol=1e-30, maxiter=5, conv_test_iters=5)
+    assert (
+        Dg._comm_ledger.bytes_per_shard()
+        > 10 * Dh._comm_ledger.bytes_per_shard()
+    )
+
+
+# -- (c) always-on metrics (no telemetry) -------------------------------------
+
+
+def test_eager_spmv_commits_always_on_metrics():
+    from sparse_tpu.parallel.dist import shard_csr
+
+    assert not telemetry.enabled()
+    A = _band_csr(512)
+    D = shard_csr(sparse_tpu.csr_array(A))
+    x = np.ones(512, np.float32)
+    D.dot(x)  # first call traces AND commits one execution
+    base = _bytes_metric("dist.spmv")
+    per_exec = D._comm_ledger.bytes_per_shard() * D.S
+    assert per_exec > 0
+    D.dot(x)
+    D.dot(x)
+    assert _bytes_metric("dist.spmv") - base == 2 * per_exec
+
+
+def test_col_split_psum_scatter_accounted():
+    from sparse_tpu.parallel.dist import shard_csr_cols
+
+    A = _band_csr(512)
+    Dc = shard_csr_cols(sparse_tpu.csr_array(A))
+    v = np.ones(512, np.float32)
+    assert np.all(np.isfinite(Dc.dot(v)))
+    led = Dc._comm_ledger
+    it = np.dtype(np.float32).itemsize
+    S = Dc.S
+    expect = (S * Dc.R * it) * (S - 1) // S  # ring reduce-scatter of y_full
+    assert led.entries == {("psum_scatter", "y"): expect}
+
+
+def test_samplesort_sites_accounted(tel):
+    from sparse_tpu.parallel.sort import dist_sort_host
+
+    keys = np.random.default_rng(5).permutation(1 << 10).astype(np.int64)
+    sk, _ = dist_sort_host(keys)
+    np.testing.assert_array_equal(sk, np.sort(keys))
+    st = comm.sites()
+    assert st.get("sort.sample1", {}).get("bytes_per_shard", 0) > 0
+    assert st.get("sort.sample2", {}).get("bytes_per_shard", 0) > 0
+    evs = [
+        e for e in telemetry.events("comm.measured")
+        if e.get("site") == "sort.sample"
+    ]
+    # capacity-shaped accounting (dense-slot emulation on the CPU mesh is
+    # exact wire volume; the native ragged path marks exact=False)
+    assert evs and evs[-1]["bytes"] > 0
+    assert evs[-1]["model_bytes"] > 0
+
+
+def test_hierarchy_comm_per_cycle_sums_ledgers():
+    from sparse_tpu.parallel.mesh import get_mesh
+    from sparse_tpu.parallel.multigrid import (
+        hierarchy_comm_per_cycle,
+        shard_hierarchy,
+    )
+
+    nf, nc = 256, 64
+    Af = sparse_tpu.csr_array(_band_csr(nf))
+    cols = (np.arange(nc) * 4).astype(np.int64)
+    R = sparse_tpu.csr_array.from_parts(
+        np.ones(nc, np.float32), cols, np.arange(nc + 1, dtype=np.int64),
+        (nc, nf),
+    )
+    P = R.T.tocsr()
+    Ac = R @ Af @ P
+    ops, _ = shard_hierarchy([Af, Ac], [(R, P)], get_mesh(8))
+    # untraced hierarchy: nothing to sum yet
+    assert hierarchy_comm_per_cycle(ops)["bytes_per_shard_per_cycle"] == 0
+    for Ad, Rd, Pd in ops:
+        for op in (Ad, Rd, Pd):
+            if op is not None:
+                op.dot(np.ones(op.shape[1], np.float32))
+    stats = hierarchy_comm_per_cycle(ops)
+    expect = [
+        sum(
+            (op._comm_ledger.bytes_per_shard() if op is not None and
+             getattr(op, "_comm_ledger", None) is not None else 0) * k
+            for op, k in ((Ad, 3), (Rd, 1), (Pd, 1))
+        )
+        for Ad, Rd, Pd in ops
+    ]
+    assert stats["levels_bytes_per_shard"] == expect
+    assert stats["bytes_per_shard_per_cycle"] == sum(expect) > 0
+    assert stats["exact"] is True
+
+
+# -- (d) per-process identity -------------------------------------------------
+
+
+def test_events_carry_identity_and_session_start(tel):
+    telemetry.record("solver.solve", solver="cg", iters=1, path="host")
+    ident = telemetry.process_identity()
+    ev = telemetry.events("solver.solve")[-1]
+    assert ev["pi"] == ident["pi"] and ev["pid"] == ident["pid"]
+    assert isinstance(ev["tm"], float) and ev["tm"] >= 0.0
+    lines = [json.loads(ln) for ln in open(telemetry.sink_path())]
+    assert lines[0]["kind"] == "session.start"
+    assert lines[0]["epoch"] > 0 and lines[0]["mono"] >= 0
+    assert lines[0]["pid"] == ident["pid"]
+    assert lines[0]["session"] == telemetry.session_info()["session"]
+    from sparse_tpu.telemetry import schema
+
+    assert schema.validate_jsonl(telemetry.sink_path()) == []
+
+
+def test_multi_controller_sink_splits_per_pid(tmp_path):
+    telemetry.reset()
+    os.environ["SPARSE_TPU_PROCESS_COUNT"] = "2"
+    os.environ["SPARSE_TPU_PROCESS_INDEX"] = "1"
+    _recorder.reset_identity()
+    settings.telemetry = True
+    telemetry.configure(str(tmp_path / "records.jsonl"))
+    try:
+        telemetry.record("span", name="x", dur_s=0.01)
+        path = telemetry.sink_path()
+        assert path.endswith(f"records.{os.getpid()}.jsonl")
+        assert os.path.exists(path)
+        assert not os.path.exists(tmp_path / "records.jsonl")
+        first = json.loads(open(path).readline())
+        assert first["kind"] == "session.start" and first["pi"] == 1
+        assert first["procs"] == 2
+    finally:
+        settings.telemetry = False
+        telemetry.configure(None)
+        os.environ.pop("SPARSE_TPU_PROCESS_COUNT", None)
+        os.environ.pop("SPARSE_TPU_PROCESS_INDEX", None)
+        _recorder.reset_identity()
+        telemetry.reset()
+
+
+# -- (e) the merge round-trip -------------------------------------------------
+
+
+def _fixture_paths():
+    return [
+        os.path.join(FIXDIR, "records.1001.jsonl"),
+        os.path.join(FIXDIR, "records.1002.jsonl"),
+    ]
+
+
+def test_axon_merge_roundtrip_two_process_fixture(tmp_path):
+    m = _load("axon_merge")
+    out = str(tmp_path / "merged.jsonl")
+    summary = m.merge_files(_fixture_paths(), out, align="session")
+    assert summary["processes"] == 2
+    recs = [json.loads(ln) for ln in open(out)]
+    assert len(recs) == summary["events"]
+    ts = [r["ts"] for r in recs]
+    assert ts == sorted(ts)
+    # session alignment: both session.start records land on one origin
+    starts = [r for r in recs if r["kind"] == "session.start"]
+    assert len(starts) == 2 and starts[0]["ts"] == starts[1]["ts"]
+    # every event attributed — the trace must never need an "other" lane
+    assert all("pi" in r for r in recs)
+    from sparse_tpu.telemetry import _trace
+
+    trace = _trace.to_chrome_trace(recs)
+    names = [
+        e["args"]["name"] for e in trace["traceEvents"]
+        if e.get("name") == "process_name"
+    ]
+    assert any(n.startswith("sparse_tpu/p0/") for n in names)
+    assert any(n.startswith("sparse_tpu/p1/") for n in names)
+    assert any(n.endswith("/comm") for n in names)  # per-device comm lanes
+    assert not any("other" in n for n in names)
+
+
+def test_axon_merge_wall_alignment_preserves_skew(tmp_path):
+    m = _load("axon_merge")
+    out = str(tmp_path / "merged_wall.jsonl")
+    m.merge_files(_fixture_paths(), out, align="wall")
+    recs = [json.loads(ln) for ln in open(out)]
+    starts = sorted(
+        (r for r in recs if r["kind"] == "session.start"),
+        key=lambda r: r["ts"],
+    )
+    # the fixture's controllers start 3.2s apart on the wall clock
+    assert starts[1]["ts"] - starts[0]["ts"] == pytest.approx(3.2)
+
+
+def test_axon_merge_cli_and_report_compare_roundtrip(tmp_path):
+    """The quick-lane smoke (ISSUE 7 CI satellite): merge the committed
+    two-process fixture, then axon_report --json on the merged log and
+    --compare against its own report must both exit 0."""
+    m = _load("axon_merge")
+    out = str(tmp_path / "merged.jsonl")
+    assert m.main([os.path.join(FIXDIR, "records.*.jsonl"), "-o", out]) == 0
+    rep_path = str(tmp_path / "report.json")
+    r = _load("axon_report")
+    assert r.main([out, "--quiet", "--json", rep_path]) == 0
+    assert (
+        r.main([out, "--quiet", "--compare", rep_path, "--threshold", "0.2"])
+        == 0
+    )
+    rep = json.load(open(rep_path))
+    assert rep["comm"]["dist.cg"]["events"] == 2
+    assert "comm.dist.cg.abs_divergence_pct" in rep["metrics"]
+
+
+def test_report_comm_rollup_ici_roofline(tmp_path):
+    r = _load("axon_report")
+    path = str(tmp_path / "records.jsonl")
+    with open(path, "w") as f:
+        f.write(json.dumps({
+            "kind": "comm.measured", "ts": 1.0, "site": "dist.cg",
+            "bytes": 8_000_000, "bytes_per_shard": 1_000_000,
+            "executions": 26, "S": 8, "exact": True,
+            "model_bytes": 8_400_000, "solve_s": 0.01,
+        }) + "\n")
+    rep = r.build_report(path, peak_ici_gbs=100.0)
+    site = rep["comm"]["dist.cg"]
+    assert site["divergence_pct"] == pytest.approx(-4.762, abs=1e-3)
+    assert site["achieved_gbs_per_shard"] == pytest.approx(0.1)
+    assert site["pct_peak_ici"] == pytest.approx(0.1)
+    assert rep["metrics"]["comm.dist.cg.abs_divergence_pct"]["v"] == pytest.approx(4.762, abs=1e-3)
+    assert rep["metrics"]["comm.dist.cg.achieved_gbs_per_shard"]["hib"]
+
+
+# -- (f) trim keeps per-process logs mergeable -------------------------------
+
+
+def test_trim_keeps_latest_session_start(tmp_path):
+    t = _load("trim_records")
+    path = str(tmp_path / "records.4242.jsonl")
+    old_session = {"kind": "session.start", "ts": 100.0, "epoch": 100.0,
+                   "mono": 1.0, "pi": 0, "pid": 4242}
+    with open(path, "w") as f:
+        f.write(json.dumps(old_session) + "\n")
+        f.write(json.dumps({"kind": "span", "ts": 101.0, "name": "old",
+                            "dur_s": 0.1}) + "\n")
+        f.write(json.dumps({"kind": "bench.session", "ts": 5000.0,
+                            "status": "ok", "budget_spent_s": 10.0}) + "\n")
+        f.write(json.dumps({"kind": "span", "ts": 5001.0, "name": "new",
+                            "dur_s": 0.1}) + "\n")
+    dropped = t.trim(path)
+    kept = [json.loads(ln) for ln in open(path)]
+    assert dropped == 1  # the old span went; the old session.start stayed
+    assert any(r.get("kind") == "session.start" for r in kept)
+    assert not any(r.get("name") == "old" for r in kept)
+
+
+def test_trim_all_globs_per_process_files(tmp_path, monkeypatch):
+    t = _load("trim_records")
+    monkeypatch.setattr(t, "AXON_DIR", str(tmp_path))
+    for pid in (1, 2):
+        with open(tmp_path / f"records.{pid}.jsonl", "w") as f:
+            f.write(json.dumps({"kind": "span", "ts": 1.0, "name": "x",
+                                "dur_s": 0.1}) + "\n")
+    # no bench.session anchor in either file: both kept whole, no crash
+    assert t.trim_all() == 0
+    for pid in (1, 2):
+        assert (tmp_path / f"records.{pid}.jsonl").exists()
+
+
+# -- (g) serving identity -----------------------------------------------------
+
+
+def test_serve_exposes_process_identity(tel):
+    import urllib.request
+
+    server = telemetry.serve(port=0)
+    try:
+        with urllib.request.urlopen(server.url + "/healthz", timeout=5) as r:
+            h = json.loads(r.read())
+        ident = telemetry.process_identity()
+        assert h["process"]["pi"] == ident["pi"]
+        assert h["process"]["pid"] == ident["pid"]
+        assert h["process"]["session_epoch"] > 0
+        assert "sink" in h["process"]
+        with urllib.request.urlopen(server.url + "/metrics", timeout=5) as r:
+            text = r.read().decode()
+        assert "sparse_tpu_process_info{" in text
+        assert f'pid="{ident["pid"]}"' in text
+        assert "sparse_tpu_process_devices" in text
+    finally:
+        telemetry.stop_serving()
