@@ -554,6 +554,94 @@ def run_batched_cg(B: int = 32, n: int = 4096, iters: int = 60) -> dict:
     return out
 
 
+def run_cold_start(B: int = 8, n: int = 2048, iters: int = 40) -> dict:
+    """Cold-start row (ISSUE 9): the restart tax of the ``batched_cg``
+    serving shape, measured at the three cache temperatures the Vault
+    story distinguishes:
+
+    * ``cold_s``: fresh process equivalent — empty vault, cleared
+      in-process plan cache; the first ``solve_many`` pays pattern pack
+      + bucket-program trace/compile.
+    * ``disk_warm_s``: killed-and-restarted process equivalent — the
+      in-process tier cleared again, but the vault retained; the session
+      replays the warm-start manifest at construction (``replay_s``) so
+      the timed serving call runs at ZERO plan-cache misses
+      (``disk_warm_misses`` pins it).
+    * ``warm_s``: steady state (same session again).
+
+    The tracked win is ``disk_warm_s`` ≈ ``warm_s`` << ``cold_s``; the
+    row embeds in the bench session record, and ``scripts/axon_report.py``
+    lifts ``cold_start.{cold_s,disk_warm_s,warm_s}`` onto the
+    ``--compare`` regression surface.
+    """
+    import shutil
+    import tempfile
+
+    import numpy as np
+    import scipy.sparse as sp
+
+    from sparse_tpu import plan_cache, vault
+    from sparse_tpu.batch import SolveSession
+    from sparse_tpu.config import settings
+
+    rng = np.random.default_rng(17)
+    e = np.ones(n, dtype=np.float32)
+    base = sp.diags(
+        [-e[:-1], 2.5 * e, -e[:-1]], [-1, 0, 1], format="csr"
+    ).astype(np.float32)
+    mats = []
+    for _ in range(B):
+        Ai = base.copy()
+        Ai.setdiag(2.5 + rng.random(n).astype(np.float32))
+        Ai.sort_indices()
+        mats.append(Ai.tocsr())
+    rhs = rng.standard_normal((B, n)).astype(np.float32)
+    cti = 2 * iters  # fixed work: conv test only at the end
+    out = {"B": B, "n": n, "iters": iters}
+    vdir = tempfile.mkdtemp(prefix="stpu_bench_vault_")
+    old_vault = settings.vault
+    try:
+        settings.vault = vdir
+
+        def serve(ses):
+            snap = plan_cache.snapshot()
+            t0 = time.perf_counter()
+            ses.solve_many(mats, rhs, tol=1e-30, maxiter=iters)
+            return time.perf_counter() - t0, plan_cache.delta(snap)
+
+        # cold: both tiers empty
+        plan_cache.clear()
+        ses = SolveSession("cg", batch_max=B, conv_test_iters=cti,
+                           warm_start=False)
+        out["cold_s"], d_cold = serve(ses)
+        out["cold_misses"] = d_cold["misses"]
+        # disk-warm: in-process tier gone (the restart), vault retained
+        plan_cache.clear()
+        t0 = time.perf_counter()
+        ses2 = SolveSession("cg", batch_max=B, conv_test_iters=cti,
+                            warm_start=True)
+        out["replay_s"] = time.perf_counter() - t0
+        out["replayed_programs"] = ses2.warm_replayed
+        out["disk_warm_s"], d_dw = serve(ses2)
+        out["disk_warm_misses"] = d_dw["misses"]  # acceptance: 0
+        out["disk_warm_zero_miss"] = d_dw["misses"] == 0
+        # warm: steady state of the same process
+        out["warm_s"], _ = serve(ses2)
+        out["cold_vs_disk_warm"] = round(
+            out["cold_s"] / max(out["disk_warm_s"], 1e-9), 2
+        )
+        vs = vault.stats()
+        out["vault"] = {
+            k: vs[k] for k in ("hits", "misses", "writes", "quarantined")
+        }
+        for k in ("cold_s", "disk_warm_s", "warm_s", "replay_s"):
+            out[k] = round(out[k], 4)
+    finally:
+        settings.vault = old_vault
+        shutil.rmtree(vdir, ignore_errors=True)
+    return out
+
+
 def run_spmm(n: int = 2000, width: int = 128):
     """SpMM row (VERDICT r3 #7): CSR x dense WIDE B — the MXU-shaped op
     the reference implements as a first-class task family
@@ -751,9 +839,12 @@ def worker(platform_arg: str) -> None:
     else:
         import jax
 
+    from sparse_tpu.config import settings as _settings
     from sparse_tpu.utils import enable_compilation_cache
 
-    enable_compilation_cache()  # reruns skip the 20-40 s tunnel compiles
+    # reruns skip the 20-40 s tunnel compiles; SPARSE_TPU_COMPILE_CACHE
+    # (the serving-path knob, ISSUE 9 satellite) overrides the location
+    enable_compilation_cache(_settings.compile_cache or None)
 
     platform = jax.devices()[0].platform
     _telemetry_models_stage(platform)
@@ -845,6 +936,10 @@ def worker(platform_arg: str) -> None:
             rec["batched_cg"] = run_batched_cg()
         except Exception:
             traceback.print_exc(file=sys.stderr)
+        try:  # stage 4.7: vault cold/disk-warm/warm restart row (ISSUE 9)
+            rec["cold_start"] = run_cold_start()
+        except Exception:
+            traceback.print_exc(file=sys.stderr)
         print(json.dumps(rec))
         sys.stdout.flush()
         try:  # stage 5: full fused sweep — refines the headline if better
@@ -889,6 +984,10 @@ def worker(platform_arg: str) -> None:
             traceback.print_exc(file=sys.stderr)
         try:  # batched same-pattern solves: the tracked microbatching row
             rec["batched_cg"] = run_batched_cg()
+        except Exception:
+            traceback.print_exc(file=sys.stderr)
+        try:  # vault cold/disk-warm/warm restart row (ISSUE 9)
+            rec["cold_start"] = run_cold_start()
         except Exception:
             traceback.print_exc(file=sys.stderr)
         print(json.dumps(rec))

@@ -408,6 +408,18 @@ def build_report(records_path: str, bench_paths=(), peak_gflops=None,
         }
     if cold_start_s:
         metrics["cold_start_s"] = {"v": cold_start_s, "hib": False}
+    # the bench cold_start row (ISSUE 9): cold vs disk-warm vs warm
+    # serving times ride the --compare surface so the vault's warm-
+    # restart win is a pinned regression metric, not just a bench line
+    cold_row = None
+    for e in sorted(sessions, key=lambda e: e.get("ts", 0)):
+        rec = e.get("record")
+        if isinstance(rec, dict) and isinstance(rec.get("cold_start"), dict):
+            cold_row = rec["cold_start"]
+    if cold_row:
+        for k in ("cold_s", "replay_s", "disk_warm_s", "warm_s"):
+            if _num(cold_row.get(k)) is not None:
+                metrics[f"cold_start.{k}"] = {"v": cold_row[k], "hib": False}
     for key, p in programs.items():
         if _num(p.get("achieved_gflops")) is not None:
             metrics[f"program.{key}.achieved_gflops"] = {
@@ -439,6 +451,7 @@ def build_report(records_path: str, bench_paths=(), peak_gflops=None,
         "tickets": tickets,
         "programs": programs,
         "cold_start_s": cold_start_s,
+        "cold_start_row": cold_row,
         "bench": bench_rows,
         "metrics": metrics,
     }

@@ -21,6 +21,17 @@ chains are missing:
 4. **Checkpoint preemption** — ``checkpointed_cg`` under
    ``preempt:chunk`` injection: re-running after each preemption resumes
    from the checkpoint and finishes the solve.
+5. **Vault io chaos** (ISSUE 9) — ``io:*`` fault clauses against the
+   persistent plan-cache tier: a bitflipped read, a truncated write, a
+   stale-format artifact and an injected ENOSPC must each degrade to
+   quarantine + rebuild (``vault.quarantined`` / ``vault.write_failed``
+   evidence, ``vault.quarantine`` events) with the rebuilt pack
+   identical — no crash, no wrong layout.
+6. **Kill-and-restart** (ISSUE 9 acceptance drill) — a subprocess
+   serving ``SolveSession`` traffic over a vault SIGKILLs itself
+   mid-traffic; a fresh process replays the warm-start manifest and
+   serves the same bucket set with ZERO plan-cache misses in the
+   serving window (disk-tier hits only), all lanes converged.
 
 Telemetry is pointed at a temp sink (never the committed
 ``results/axon/records.jsonl``). Wired into the quick lane through
@@ -29,12 +40,17 @@ Telemetry is pointed at a temp sink (never the committed
 
 Usage:
     python scripts/chaos_check.py [--json]
+
+(``--vault-child serve|warm`` is the internal entry point of scenario
+6's subprocesses — it reads ``SPARSE_TPU_VAULT`` from the env.)
 """
 
 from __future__ import annotations
 
 import json
 import os
+import signal
+import subprocess
 import sys
 import tempfile
 
@@ -213,10 +229,242 @@ def run(report: dict) -> list:
             problems.append(f"preempt: resumed solve wrong (||r||={rnorm:.2e})")
         if resumes == 0:
             problems.append("preempt: injection never fired (spec drift?)")
+
+    # -- 5. vault io chaos: corruption quarantines, never escapes -----------
+    problems += _vault_io_chaos(report)
+
+    # -- 6. kill-and-restart: warm replay serves at zero misses -------------
+    problems += _vault_kill_restart(report)
     return problems
 
 
+def _vault_io_chaos(report: dict) -> list:
+    """``io:*`` fault injection against the persistent tier: every
+    corruption mode quarantines + rebuilds identically; an injected
+    ENOSPC degrades the write, not the pack."""
+    import numpy as np
+
+    from sparse_tpu import plan_cache, telemetry as tel, vault
+    from sparse_tpu.batch.operator import SparsityPattern
+    from sparse_tpu.config import settings
+    from sparse_tpu.resilience import faults
+
+    problems = []
+    tel.reset()
+    vdir = tempfile.mkdtemp(prefix="chaos_vault_io_")
+    old_vault = settings.vault
+    settings.vault = vdir
+
+    def repack(n):
+        """Fresh pattern object + cleared tier 1 => forced disk read."""
+        plan_cache.clear()
+        return SparsityPattern.from_csr(_tridiag(n)).sell_pack()
+
+    def same(a, b):
+        return (
+            a is not None and b is not None and a.plan == b.plan
+            and np.array_equal(np.asarray(a.pos), np.asarray(b.pos))
+        )
+
+    try:
+        # A: bitflip-on-read — the stored artifact corrupts in flight
+        p0 = SparsityPattern.from_csr(_tridiag(40)).sell_pack()
+        base = vault.stats()
+        faults.configure("bitflip:io:p=1,seed=5,n=1")
+        try:
+            p1 = repack(40)
+        finally:
+            faults.clear()
+        st = vault.stats()
+        if st["quarantined"] <= base["quarantined"]:
+            problems.append("vault io: bitflipped read not quarantined")
+        if not same(p0, p1):
+            problems.append("vault io: rebuild after bitflip differs")
+
+        # B: truncate-on-write — a torn artifact survives on disk
+        faults.configure("truncate:io:p=1,n=1")
+        try:
+            pb = SparsityPattern.from_csr(_tridiag(48)).sell_pack()
+        finally:
+            faults.clear()
+        base = vault.stats()
+        pb2 = repack(48)
+        st = vault.stats()
+        if st["quarantined"] <= base["quarantined"]:
+            problems.append("vault io: truncated artifact not quarantined")
+        if not same(pb, pb2):
+            problems.append("vault io: rebuild after truncation differs")
+
+        # C: ENOSPC at write — persistence fails, the pack must not
+        faults.configure("enospc:io:p=1,n=1")
+        base = vault.stats()
+        try:
+            pc = SparsityPattern.from_csr(_tridiag(56)).sell_pack()
+        finally:
+            faults.clear()
+        st = vault.stats()
+        if st["write_failed"] <= base["write_failed"]:
+            problems.append("vault io: ENOSPC not counted as write_failed")
+        if pc is None:
+            problems.append("vault io: ENOSPC broke the pack itself")
+
+        # D: stale-format artifact from an 'older' writer
+        faults.configure("stale:io:p=1,n=1")
+        try:
+            pd = SparsityPattern.from_csr(_tridiag(64)).sell_pack()
+        finally:
+            faults.clear()
+        base = vault.stats()
+        pd2 = repack(64)
+        st = vault.stats()
+        if st["quarantined"] <= base["quarantined"]:
+            problems.append("vault io: stale-format artifact not quarantined")
+        if not same(pd, pd2):
+            problems.append("vault io: rebuild after stale-format differs")
+
+        kinds = _event_kinds(tel)
+        if kinds.get("vault.quarantine", 0) == 0:
+            problems.append("vault io: no vault.quarantine events")
+        if kinds.get("fault.injected", 0) == 0:
+            problems.append("vault io: no fault.injected events from io site")
+        report["vault_io"] = {"stats": vault.stats(), "events": kinds}
+    finally:
+        settings.vault = old_vault
+        faults.clear()
+        plan_cache.clear()
+    return problems
+
+
+#: scenario 6's traffic shape (shared by parent assertions and children)
+VAULT_B = 4
+VAULT_N = 64
+
+
+def _vault_traffic():
+    import numpy as np
+
+    rng = np.random.default_rng(21)
+    mats = []
+    for _ in range(VAULT_B):
+        M = _tridiag(VAULT_N)
+        M.setdiag(3.0 + rng.random(VAULT_N))
+        M.sort_indices()
+        mats.append(M.tocsr())
+    rhs = rng.standard_normal((VAULT_B, VAULT_N))
+    return mats, rhs
+
+
+def _vault_kill_restart(report: dict) -> list:
+    """Scenario 6 parent: child A serves over a fresh vault and SIGKILLs
+    itself mid-traffic; child B (a genuinely fresh process) must come
+    back warm — manifest replayed, zero plan-cache misses while serving
+    the same bucket set, every lane converged."""
+    problems = []
+    vdir = tempfile.mkdtemp(prefix="chaos_vault_kr_")
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["SPARSE_TPU_VAULT"] = vdir
+    # the XLA-executable tier rides along (ISSUE 9 satellite): both
+    # children share one persistent compilation cache dir
+    env["SPARSE_TPU_COMPILE_CACHE"] = os.path.join(vdir, "_xla_cache")
+    env.pop("SPARSE_TPU_FAULTS", None)
+
+    def child(mode):
+        return subprocess.run(
+            [sys.executable, os.path.abspath(__file__),
+             "--vault-child", mode],
+            env=env, capture_output=True, text=True, timeout=300,
+        )
+
+    serve = child("serve")
+    if "SERVED" not in serve.stdout:
+        problems.append(
+            f"vault restart: serve child never served "
+            f"(rc={serve.returncode}, stderr tail: "
+            f"{serve.stderr[-300:]!r})"
+        )
+    elif serve.returncode != -signal.SIGKILL:
+        problems.append(
+            "vault restart: serve child was supposed to die by SIGKILL "
+            f"mid-traffic (rc={serve.returncode})"
+        )
+    warm = child("warm")
+    out = None
+    for line in warm.stdout.splitlines():
+        if line.startswith("WARM "):
+            try:
+                out = json.loads(line[5:])
+            except json.JSONDecodeError:
+                pass
+    if out is None:
+        problems.append(
+            f"vault restart: warm child produced no report "
+            f"(rc={warm.returncode}, stderr tail: {warm.stderr[-300:]!r})"
+        )
+        return problems
+    report["vault_restart"] = out
+    if out.get("replayed", 0) < 1:
+        problems.append("vault restart: manifest replayed no programs")
+    d = out.get("delta", {})
+    if d.get("misses", 1) != 0:
+        problems.append(
+            f"vault restart: serving window had {d.get('misses')} "
+            "plan-cache misses (warm restart must serve on hits only)"
+        )
+    if d.get("hits", 0) < 1:
+        problems.append("vault restart: serving window saw no cache hits")
+    if out.get("vault", {}).get("hits", 0) < 1:
+        problems.append("vault restart: no disk-tier hits during replay")
+    bad = [r for r in out.get("resids", [1.0]) if not (r <= 10 * TOL)]
+    if bad:
+        problems.append(
+            f"vault restart: {len(bad)} lanes unconverged after warm "
+            f"restart (worst ||r||={max(bad):.2e})"
+        )
+    return problems
+
+
+def vault_child(mode: str) -> int:
+    """Scenario 6 child entry (``--vault-child serve|warm``): reads the
+    vault dir from ``SPARSE_TPU_VAULT``."""
+    import jax
+
+    jax.config.update("jax_enable_x64", True)
+    import numpy as np
+
+    from sparse_tpu import plan_cache, vault
+    from sparse_tpu.batch import SolveSession
+
+    mats, rhs = _vault_traffic()
+    if mode == "serve":
+        ses = SolveSession("cg", warm_start=False)
+        ses.solve_many(mats, rhs, tol=TOL)
+        print("SERVED", flush=True)
+        # resubmit the same traffic and die mid-serving — the crash the
+        # vault exists to survive (no flush: requests are in flight)
+        for A, b in zip(mats, rhs):
+            ses.submit(A, b, tol=TOL)
+        os.kill(os.getpid(), signal.SIGKILL)
+        return 1  # unreachable
+    ses = SolveSession("cg", warm_start=True)
+    snap = plan_cache.snapshot()
+    X, _iters, _r2 = ses.solve_many(mats, rhs, tol=TOL)
+    resids = [
+        float(np.linalg.norm(m @ x - b)) for m, x, b in zip(mats, X, rhs)
+    ]
+    print("WARM " + json.dumps({
+        "replayed": ses.warm_replayed,
+        "delta": plan_cache.delta(snap),
+        "resids": resids,
+        "vault": vault.stats(),
+    }), flush=True)
+    return 0
+
+
 def main(argv) -> int:
+    if "--vault-child" in argv:
+        i = argv.index("--vault-child")
+        return vault_child(argv[i + 1] if i + 1 < len(argv) else "serve")
     report: dict = {}
     from sparse_tpu import telemetry as tel
     from sparse_tpu.config import settings
@@ -243,12 +491,16 @@ def main(argv) -> int:
     for p in problems:
         print(f"CHAOS FAILURE: {p}", file=sys.stderr)
     if not problems:
+        vr = report.get("vault_restart", {})
         print(
             "chaos check passed: "
             f"{len([k for k in report if k.startswith('solver.')])} solvers "
             "recovered, pallas failover+reinstate ok, "
             f"batch lanes ok, {report.get('preempt', {}).get('resumes', 0)} "
-            "preemption resume(s)"
+            "preemption resume(s), vault io quarantines ok, "
+            f"kill-and-restart warm ({vr.get('replayed', 0)} program(s) "
+            f"replayed, {vr.get('delta', {}).get('misses', '?')} serving "
+            "misses)"
         )
     return 1 if problems else 0
 

@@ -46,6 +46,7 @@ _REQUIRED_SCRIPTS = (
     "chaos_check.py",
     "check_quick_lane.py",
     "trim_records.py",
+    "vault_gc.py",
 )
 
 

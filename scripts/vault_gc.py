@@ -1,0 +1,131 @@
+#!/usr/bin/env python
+"""Size-budgeted GC for a sparse_tpu Vault directory (ISSUE 9 satellite).
+
+The persistent plan-cache tier (``sparse_tpu.vault``,
+``SPARSE_TPU_VAULT=<dir>``) grows one verified artifact per distinct
+prepared operator; on a long-lived box that is unbounded. The library
+sweeps after every write (``vault.gc``, ``SPARSE_TPU_VAULT_CAP_MB``);
+this CLI is the operational mirror of ``trim_records.py`` for cron /
+round tooling — stdlib-only (no jax import; it must run on boxes where
+the serving venv is down), same mtime-LRU policy as the in-library
+sweep:
+
+* artifacts (``objects/**/*.stv``) evict oldest-mtime-first until the
+  total fits the cap (loads touch mtime, so hot artifacts survive);
+* stale tmp files (``tmp/*`` older than 1 h — crashed writers'
+  leftovers) are always pruned;
+* the quarantine sidecar keeps its newest 32 files (debugging evidence,
+  not an archive).
+
+Usage:
+    python scripts/vault_gc.py [--dir D] [--cap-mb N] [--dry-run]
+
+``--dir`` defaults to ``$SPARSE_TPU_VAULT``; ``--cap-mb`` to
+``$SPARSE_TPU_VAULT_CAP_MB`` (512). Exits 0 always (an absent vault is
+"nothing to do", not an error).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+SUFFIX = ".stv"  # must match sparse_tpu/vault/_store.py
+QUARANTINE_KEEP = 32
+TMP_MAX_AGE_S = 3600.0
+
+
+def _files(root: str):
+    out = []
+    for dirpath, _dirs, names in os.walk(root):
+        for name in names:
+            p = os.path.join(dirpath, name)
+            try:
+                st = os.stat(p)
+            except OSError:
+                continue
+            out.append((p, st.st_size, st.st_mtime))
+    return out
+
+
+def gc(vault_dir: str, cap_mb: float, dry_run: bool = False) -> dict:
+    """One sweep; returns ``{artifacts, total_mb, evicted, tmp_pruned,
+    quarantine_pruned}``."""
+    res = {"artifacts": 0, "total_mb": 0.0, "evicted": 0,
+           "tmp_pruned": 0, "quarantine_pruned": 0}
+    now = time.time()
+    # stale tmp files: a crashed writer's leftovers
+    for p, _s, mt in _files(os.path.join(vault_dir, "tmp")):
+        if now - mt > TMP_MAX_AGE_S:
+            if not dry_run:
+                try:
+                    os.unlink(p)
+                except OSError:
+                    continue
+            res["tmp_pruned"] += 1
+    # quarantine sidecar: newest QUARANTINE_KEEP survive
+    q = sorted(_files(os.path.join(vault_dir, "quarantine")),
+               key=lambda t: t[2])
+    for p, _s, _mt in q[:-QUARANTINE_KEEP] if len(q) > QUARANTINE_KEEP else []:
+        if not dry_run:
+            try:
+                os.unlink(p)
+            except OSError:
+                continue
+        res["quarantine_pruned"] += 1
+    # artifacts: mtime-LRU down to the cap
+    arts = [
+        t for t in _files(os.path.join(vault_dir, "objects"))
+        if t[0].endswith(SUFFIX)
+    ]
+    total = sum(s for _p, s, _m in arts)
+    res["artifacts"] = len(arts)
+    res["total_mb"] = round(total / 2**20, 3)
+    for p, s, _mt in sorted(arts, key=lambda t: t[2]):
+        if total <= cap_mb * 2**20:
+            break
+        if not dry_run:
+            try:
+                os.unlink(p)
+            except OSError:
+                continue
+        total -= s
+        res["evicted"] += 1
+    return res
+
+
+def main(argv) -> int:
+    vault_dir = os.environ.get("SPARSE_TPU_VAULT", "")
+    cap_mb = float(os.environ.get("SPARSE_TPU_VAULT_CAP_MB", "512") or 512)
+    dry_run = "--dry-run" in argv
+    it = iter(argv)
+    for a in it:
+        if a == "--dir":
+            vault_dir = next(it, "")
+        elif a.startswith("--dir="):
+            vault_dir = a.split("=", 1)[1]
+        elif a == "--cap-mb":
+            cap_mb = float(next(it, cap_mb))
+        elif a.startswith("--cap-mb="):
+            cap_mb = float(a.split("=", 1)[1])
+    if not vault_dir:
+        print("vault_gc: no vault directory (--dir or SPARSE_TPU_VAULT); "
+              "nothing to do")
+        return 0
+    if not os.path.isdir(vault_dir):
+        print(f"vault_gc: {vault_dir} does not exist; nothing to do")
+        return 0
+    res = gc(vault_dir, cap_mb, dry_run=dry_run)
+    mode = " (dry run)" if dry_run else ""
+    print(
+        f"vault_gc{mode}: {res['artifacts']} artifacts, "
+        f"{res['total_mb']} MB vs cap {cap_mb} MB -> "
+        f"evicted {res['evicted']}, tmp pruned {res['tmp_pruned']}, "
+        f"quarantine pruned {res['quarantine_pruned']}"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

@@ -98,8 +98,20 @@ class SparsityPattern:
         """The pattern's one-time SELL-C-sigma pack, via the library plan
         cache: ``(plan, idx_slabs, pos, srcs)`` where ``srcs`` are the
         per-slab packed-slot -> nnz-position maps every lane's values
-        gather through. One host-side pack per pattern, ever."""
-        return plan_cache.get(self, "sell.pattern", self._build_sell)
+        gather through. One host-side pack per pattern, ever — per
+        *vault*, not per process, when the persistent tier is enabled
+        (the pack is content-keyed on the structure fingerprint plus the
+        SELL geometry settings, so a warm restart loads it from disk)."""
+
+        def vault_key():
+            from ..vault import _codecs
+
+            return _codecs.sell_pattern_key(self)
+
+        return plan_cache.get(
+            self, "sell.pattern", self._build_sell,
+            vault_kind="sell_pattern", vault_key=vault_key,
+        )
 
     def _build_sell(self):
         from ..kernels.sell_spmv import sell_pack

@@ -275,13 +275,23 @@ class SolveSession:
         ticket over the target still returns normally, but counts into
         ``batch.slo_misses`` and its ``batch.ticket`` terminal event is
         flagged ``slo_miss`` (None = no objective, nothing counted)
+    warm_start : replay the vault's warm-start manifest on construction
+        (ISSUE 9, docs/performance.md): hot (pattern, solver, bucket,
+        dtype) programs from previous processes re-load their pattern
+        packs from the disk tier and re-build/compile ahead of traffic,
+        so serving-path dispatches start at zero plan-cache misses.
+        Default ``None`` = replay iff the vault is enabled
+        (``SPARSE_TPU_VAULT``); ``False`` always skips. Replay is
+        best-effort — a corrupt manifest or artifact degrades to an
+        ordinary cold start, never a construction failure.
     """
 
     def __init__(self, solver: str = "cg", batch_max: int | None = None,
                  bucket_policy: str | None = None, conv_test_iters: int = 25,
                  restart: int | None = None, auto_flush: int | None = None,
                  requeue: bool = True, fallback_solver: str = "gmres",
-                 dispatch_attempts: int = 2, slo_ms: float | None = None):
+                 dispatch_attempts: int = 2, slo_ms: float | None = None,
+                 warm_start: bool | None = None):
         if solver not in _SOLVERS:
             raise ValueError(f"solver must be one of {_SOLVERS}")
         if fallback_solver not in _SOLVERS:
@@ -302,6 +312,19 @@ class SolveSession:
         # terminal-state tallies for the /session serving endpoint
         self._ticket_counts = {"done": 0, "failed": 0, "slo_miss": 0}
         _SESSIONS.add(self)
+        # serving-path persistent XLA compile cache (ISSUE 9 satellite):
+        # env-gated so bucket-program executables survive restarts
+        # alongside the vault's packed artifacts
+        if settings.compile_cache:
+            from ..utils import enable_compilation_cache
+
+            enable_compilation_cache(settings.compile_cache)
+        self.warm_replayed = 0
+        from .. import vault
+
+        if (vault.enabled() if warm_start is None else warm_start):
+            if vault.enabled():
+                self.warm_replayed = self._replay_manifest()
 
     # -- intake ------------------------------------------------------------
     def pattern_of(self, A) -> SparsityPattern:
@@ -361,6 +384,76 @@ class SolveSession:
             "dispatches": self.dispatches,
             "tickets": {"pending": self.pending, **self._ticket_counts},
         }
+
+    # -- warm restart (ISSUE 9) --------------------------------------------
+    def _replay_manifest(self) -> int:
+        """Replay the vault's warm-start manifest: for every recorded
+        hot (pattern, solver, bucket, dtype) program, load the pattern
+        structure + SELL pack from the disk tier and rebuild/compile the
+        bucket program ahead of traffic. Returns the number of programs
+        replayed; every failure skips its entry (a warm start is an
+        optimization, never a liability)."""
+        from .. import vault
+
+        t0 = time.monotonic()
+        entries = vault.manifest_entries()
+        replayed = 0
+        for e in entries:
+            try:
+                solver = e.get("solver")
+                bkt = int(e.get("bucket", 0))
+                dtstr = e.get("dtype", "")
+                if solver not in _SOLVERS or bkt < 1 or not dtstr:
+                    continue
+                dt = np.dtype(dtstr)
+                pat = vault.load_pattern(e.get("pattern", ""))
+                if pat is None:
+                    continue
+                pat = self._patterns.setdefault(pat.fingerprint, pat)
+                pat.sell_pack()  # disk-tier hit (or rebuild + deposit)
+                self._prebuild(pat, solver, bkt, dt)
+                replayed += 1
+            except Exception:  # noqa: BLE001 - entry isolation
+                continue
+        if replayed:
+            _metrics.counter("vault.replayed").inc(replayed)
+        if telemetry.enabled():
+            telemetry.record(
+                "vault.replay", entries=len(entries), programs=replayed,
+                wall_ms=round((time.monotonic() - t0) * 1e3, 3),
+            )
+        return replayed
+
+    def _prebuild(self, pattern: SparsityPattern, solver: str, bkt: int,
+                  dt) -> None:
+        """Build (and AOT-compile, via the usual cost attribution) one
+        bucket program outside any dispatch — argument shapes/dtypes
+        mirror ``_dispatch`` exactly, so the first real dispatch of this
+        bucket is a plan-cache hit into a warm executable."""
+        dt = np.dtype(dt)
+        key = f"batch.{solver}.B{bkt}.{dt.str}"
+        n = pattern.shape[0]
+        # the same conversion pipeline as a real dispatch (np stacks ->
+        # jnp.asarray), so trace signatures match under any x64 setting
+        args = (
+            jnp.asarray(np.zeros((bkt, pattern.nnz), dtype=dt)),
+            jnp.asarray(np.zeros((bkt, n), dtype=dt)),
+            jnp.asarray(np.zeros((bkt, n), dtype=dt)),
+            jnp.asarray(np.zeros((bkt,), dtype=np.float64)),
+            n * 10,
+        )
+
+        def build():
+            tb = time.perf_counter()
+            fn = self._build_program(pattern, bkt, dt, solver=solver)
+            prog, _info = _cost.attribute(
+                key, fn, args, pack_s=time.perf_counter() - tb,
+                solver=solver, bucket=bkt, dtype=dt.str,
+                n=n, nnz=pattern.nnz, warm_start=True,
+            )
+            return prog
+
+        plan_cache.get(pattern, key, build)
 
     def solve_many(self, mats, rhs, tol: float = 1e-8, maxiter=None):
         """Convenience one-shot: submit a same-pattern stack, flush, and
@@ -559,6 +652,19 @@ class SolveSession:
 
         try:
             prog = plan_cache.get(pattern, key, build)
+            if built and not faulty:
+                # a freshly built bucket program is warm-start state:
+                # note it (and its pattern artifact) in the vault
+                # manifest so a restarted process replays it. Fault-
+                # wrapped programs are never noted — their traces carry
+                # the injection callback.
+                from .. import vault
+
+                if vault.enabled():
+                    vault.note_program(
+                        pattern, solver=solver, bucket=bkt,
+                        dtype=np.dtype(dt).str,
+                    )
             t_solve0 = time.monotonic()
             out = prog(*args)
             try:

@@ -31,6 +31,16 @@ Flags (all env-overridable):
   SPARSE_TPU_FAULTS           - fault-injection spec (sparse_tpu.resilience.faults), e.g.
                                 "nonfinite:matvec:p=0.01,seed=7;fail:pallas". Empty
                                 (default) = injection machinery entirely inert.
+  SPARSE_TPU_VAULT            - directory of the persistent plan-cache tier
+                                (sparse_tpu.vault): prepared SELL/DIA artifacts and the
+                                warm-start manifest persist across processes. Empty
+                                (default) = disk tier off, in-process cache only.
+  SPARSE_TPU_VAULT_CAP_MB     - vault size budget in MB (default 512); the mtime-LRU GC
+                                sweep (vault.gc / scripts/vault_gc.py) evicts past it.
+  SPARSE_TPU_COMPILE_CACHE    - directory for jax's persistent XLA compilation cache on
+                                the serving path: SolveSession construction (and bench)
+                                call utils.enable_compilation_cache(dir) when set, so
+                                bucket-program executables persist across restarts too.
 """
 
 from __future__ import annotations
@@ -169,6 +179,21 @@ class Settings:
     # Empty = off: every hook is a single module-boolean check and no
     # wrapper is installed anywhere (traced programs byte-identical).
     faults: str = field(default_factory=lambda: _env_str("SPARSE_TPU_FAULTS", ""))
+    # Persistent plan-cache tier (sparse_tpu.vault): directory holding
+    # verified prepared-operator artifacts + the warm-start manifest.
+    # Empty = disk tier off (in-process weak-ref LRU only). Every read
+    # is verify-then-load with quarantine on failure; every write is
+    # atomic (tmp + fsync + rename) — docs/performance.md.
+    vault: str = field(default_factory=lambda: _env_str("SPARSE_TPU_VAULT", ""))
+    vault_cap_mb: int = field(
+        default_factory=lambda: max(_env_int("SPARSE_TPU_VAULT_CAP_MB", 512), 1)
+    )
+    # Serving-path persistent XLA compilation cache dir: when set,
+    # SolveSession/bench call utils.enable_compilation_cache(dir) so the
+    # compiled-executable tier survives restarts alongside the vault.
+    compile_cache: str = field(
+        default_factory=lambda: _env_str("SPARSE_TPU_COMPILE_CACHE", "")
+    )
 
 
 settings = Settings()

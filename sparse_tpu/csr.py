@@ -211,7 +211,23 @@ class csr_array(SparseArray):
             (prep.pos,) = commit_to_exec_device((prep.pos,))
             return prep
 
-        return plan_cache.get(self, "sell", build)
+        def vault_key():
+            # content fingerprint: exact buffers + the SELL geometry
+            # settings the pack depends on (sparse_tpu.vault._codecs)
+            from .vault import _codecs
+
+            return _codecs.prepared_csr_key(
+                self.indptr, self.indices, self.data, self.shape
+            )
+
+        return plan_cache.get(
+            self, "sell", build,
+            vault_kind="prepared_csr", vault_key=vault_key,
+            # canonicalized: the packed planes carry jax's dtype (f64
+            # narrows to f32 without x64), and that is what a loaded
+            # artifact must agree with
+            expect={"dtype": str(jax.dtypes.canonicalize_dtype(self.dtype))},
+        )
 
     def prepare(self, mode: str | None = None):
         """One-time eager layout/pack warm for the current (or given)

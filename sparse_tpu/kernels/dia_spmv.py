@@ -450,6 +450,17 @@ class PreparedDia:
 
         telemetry.count("kernel.dia_pack")
 
+    @classmethod
+    def from_parts(cls, plan: DiaPlan, planes) -> "PreparedDia":
+        """Reassemble from an already-packed plane buffer — the vault
+        codec's constructor. The stored :class:`DiaPlan` carries the
+        session that wrote it's autotuned row tile, so a disk hit also
+        skips the autotune probe."""
+        prep = object.__new__(cls)
+        prep.plan = plan
+        prep.planes = planes
+        return prep
+
     def __call__(self, x, interpret=None):
         if interpret is None:
             interpret = jax.default_backend() != "tpu"
@@ -466,6 +477,12 @@ class PreparedDia:
 
 #: failover-registry kernel name (resilience/failover.py)
 DIA_KERNEL = "dia_spmv"
+
+
+def _vault_codecs():
+    from ..vault import _codecs
+
+    return _codecs
 
 
 def cached_prepared_spmv(obj, attr: str, data, offsets, shape, x):
@@ -499,7 +516,14 @@ def cached_prepared_spmv(obj, attr: str, data, offsets, shape, x):
     if failover.failed(DIA_KERNEL, obj):
         return None
     prepared = plan_cache.get(
-        obj, attr, lambda: PreparedDia(data, offsets, shape)
+        obj, attr, lambda: PreparedDia(data, offsets, shape),
+        # persistent tier (sparse_tpu.vault): the packed plane buffer +
+        # autotuned tile persist across processes, content-keyed on the
+        # exact planes/offsets/shape (dtype rides the array hash)
+        vault_kind="prepared_dia",
+        vault_key=lambda: _vault_codecs().prepared_dia_key(
+            data, offsets, shape
+        ),
     )
     try:
         # forced-failure injection point, then the real kernel attempt

@@ -353,6 +353,18 @@ class PreparedCSR:
 
         telemetry.count("kernel.sell_pack")
 
+    @classmethod
+    def from_parts(cls, plan: SellPlan, slabs, pos) -> "PreparedCSR":
+        """Reassemble a prepared operator from already-packed parts —
+        the vault codec's constructor (``sparse_tpu.vault._codecs``): a
+        verified disk artifact re-enters without re-running the host
+        pack (and without counting a fresh ``kernel.sell_pack``)."""
+        prep = object.__new__(cls)
+        prep.plan = plan
+        prep.slabs = tuple((it, vt) for it, vt in slabs)
+        prep.pos = pos
+        return prep
+
     @property
     def shape(self):
         return (self.plan.m, self.plan.n)

@@ -1,4 +1,4 @@
-"""Library-wide operator plan cache: prepare once, execute everywhere.
+"""Library-wide two-tier operator plan cache: prepare once, execute everywhere.
 
 Reference analog: legate.sparse caches partitions and images per Store
 (``set_key_partition``, SURVEY §1) so a solve derives its layout once and
@@ -7,6 +7,16 @@ are packed operators (SELL slabs, prepared DIA planes) and compiled
 shard_map programs; this module is the one place they live, so
 ``csr.dot``, ``LinearOperator`` and every solver in ``linalg`` reuse the
 same plan across a whole solve instead of re-deriving it per matvec.
+
+Two tiers (ISSUE 9): the in-process weak-ref LRU below is tier 1; when
+``SPARSE_TPU_VAULT`` points at a directory, :mod:`sparse_tpu.vault` is
+tier 2 — a crash-safe on-disk store of serialized prepared artifacts
+keyed by CONTENT fingerprints. A lookup that misses in-process consults
+the disk tier before building (``disk_hits`` in :func:`stats`); a build
+deposits its artifact back so the NEXT process skips the pack. Disk
+reads are verify-then-load with quarantine on any corruption — a bad
+artifact degrades to a rebuild, never an error (docs/performance.md,
+docs/resilience.md).
 
 Design:
 
@@ -53,10 +63,14 @@ _COUNTERS = {
     "hits": _metrics.counter("plan_cache.hits"),
     "misses": _metrics.counter("plan_cache.misses"),
     "evictions": _metrics.counter("plan_cache.evictions"),
+    # tier-2 hits: the in-process tier missed but the vault's verified
+    # artifact load replaced the build ("miss" stays = "had to build")
+    "disk_hits": _metrics.counter("plan_cache.disk_hits"),
 }
 _metrics.gauge("plan_cache.size", fn=lambda: len(_ENTRIES))
 _TELEMETRY_NAMES = {"hits": "plan_cache.hit", "misses": "plan_cache.miss",
-                    "evictions": "plan_cache.evict"}
+                    "evictions": "plan_cache.evict",
+                    "disk_hits": "plan_cache.disk_hit"}
 
 
 def _count(which: str) -> None:
@@ -70,23 +84,38 @@ def _count(which: str) -> None:
 
 
 def _evict_object(oid: int) -> None:
-    """Drop every plan of a collected (or invalidated) object."""
+    """Drop every plan of a collected (or invalidated) object. Runs from
+    ``weakref.finalize`` at GC time, so it must tolerate entries already
+    gone (a concurrent ``clear()``/eviction) rather than ever raise."""
     with _LOCK:
         dead = [k for k in _ENTRIES if k[0] == oid]
         for k in dead:
-            del _ENTRIES[k]
-            _count("evictions")
+            if _ENTRIES.pop(k, None) is not None:
+                _count("evictions")
         _FINALIZERS.pop(oid, None)
 
 
-def get(obj, kind: str, build=None):
+def get(obj, kind: str, build=None, *, vault_kind: str | None = None,
+        vault_key=None, expect: dict | None = None):
     """Return the cached plan for ``(obj, kind)``, building on miss.
 
     ``build`` is a zero-arg callable producing the plan; with
     ``build=None`` a miss returns ``None`` (the trace-safe lookup form —
-    in-trace callers may not build, packing needs host syncs). Lookups
-    count exactly one hit or miss each. With the cache disabled every
-    call counts a miss and builds (when it can).
+    in-trace callers may not build, packing needs host syncs, and the
+    disk tier is never consulted). Lookups count exactly one of
+    hit / disk_hit / miss each ("miss" always means "built"). With the
+    cache disabled every call counts a miss and builds (when it can) —
+    both tiers off, correctness unchanged.
+
+    ``vault_kind``/``vault_key`` opt a build site into the persistent
+    tier (:mod:`sparse_tpu.vault`): ``vault_key`` is the artifact's
+    content fingerprint — a string, or a zero-arg callable evaluated
+    only when the vault is enabled (fingerprinting hashes the operator's
+    buffers; sites must not pay that when there is no disk tier).
+    ``expect`` adds load-time meta assertions (e.g. dtype) on top of the
+    store's own verify ladder. An in-process miss then tries a verified
+    disk load before building; a build deposits its artifact back.
+    Disk-tier failures of any kind degrade to the build path.
     """
     key = (id(obj), kind)
     if settings.plan_cache:
@@ -96,10 +125,32 @@ def get(obj, kind: str, build=None):
                 _ENTRIES.move_to_end(key)
                 _count("hits")
                 return ent[1]
-    _count("misses")
-    if build is None:
-        return None
-    plan = build()
+    plan = None
+    vk = None
+    use_vault = (
+        build is not None and vault_kind is not None and settings.plan_cache
+        and settings.vault
+    )
+    if use_vault:
+        from . import vault
+
+        try:
+            vk = vault_key() if callable(vault_key) else vault_key
+        except Exception:
+            vk = None  # unfingerprintable content: tier 1 + build only
+        if vk:
+            plan = vault.fetch(vault_kind, vk, expect=expect)
+    if plan is not None:
+        _count("disk_hits")
+    else:
+        _count("misses")
+        if build is None:
+            return None
+        plan = build()
+        if use_vault and vk and plan is not None:
+            from . import vault
+
+            vault.deposit(vault_kind, vk, plan)
     if not settings.plan_cache or plan is None:
         return plan
     try:
@@ -152,18 +203,22 @@ def invalidate(obj, kind: str | None = None) -> None:
 
 
 def stats() -> dict:
-    """Always-on counters: ``{hits, misses, evictions, size, hit_rate,
-    compile_s}`` (read back from the metrics registry — same numbers a
-    Prometheus scrape of ``telemetry.metrics_text()`` sees).
-    ``compile_s`` is the session's cold-start budget: total wall-clock
-    seconds spent building/compiling attributed programs
+    """Always-on counters: ``{hits, misses, disk_hits, evictions, size,
+    hit_rate, compile_s}`` (read back from the metrics registry — same
+    numbers a Prometheus scrape of ``telemetry.metrics_text()`` sees).
+    ``disk_hits`` counts persistent-tier loads that replaced a build
+    (``misses`` always means "built"); ``hit_rate`` counts both tiers'
+    hits. ``compile_s`` is the session's cold-start budget: total
+    wall-clock seconds spent building/compiling attributed programs
     (telemetry/_cost.py), so bench session records carry the compile
     tax next to the hit rate it bought."""
     with _LOCK:
         out = {k: int(c.value) for k, c in _COUNTERS.items()}
         out["size"] = len(_ENTRIES)
-    total = out["hits"] + out["misses"]
-    out["hit_rate"] = out["hits"] / total if total else 0.0
+    total = out["hits"] + out["disk_hits"] + out["misses"]
+    out["hit_rate"] = (
+        (out["hits"] + out["disk_hits"]) / total if total else 0.0
+    )
     from .telemetry import _cost
 
     out["compile_s"] = round(_cost.total_compile_s(), 6)
@@ -180,10 +235,10 @@ def snapshot() -> dict:
 
 def delta(since: dict) -> dict:
     """Counter movement since a :func:`snapshot`:
-    ``{hits, misses, evictions}``."""
+    ``{hits, misses, evictions, disk_hits}``."""
     with _LOCK:
         return {k: int(_COUNTERS[k].value) - since.get(k, 0)
-                for k in ("hits", "misses", "evictions")}
+                for k in ("hits", "misses", "evictions", "disk_hits")}
 
 
 def reset_stats() -> None:

@@ -21,6 +21,10 @@ semicolon-separated list of clauses::
     drop:dispatch:p=0.5                # SolveSession dispatch failure
     delay:dispatch:ms=25               # dispatch latency injection
     preempt:chunk:p=0.1,seed=3         # preemption at chunk boundaries
+    truncate:io:p=0.5                  # vault write survives torn/half
+    bitflip:io:p=0.1,seed=5            # flip one byte on artifact read
+    stale:io                           # write with an outdated format
+    enospc:io:n=1                      # artifact write hits ENOSPC
 
 Each clause fires with probability ``p`` (default 1) from its own seeded
 ``numpy`` Generator (``seed``, default 0) so a chaos run is bit-for-bit
@@ -62,6 +66,7 @@ __all__ = [
     "corrupt_array",
     "corrupt_traced",
     "dispatch_actions",
+    "io_actions",
     "parse_spec",
     "reload_from_env",
     "should_fail_pallas",
@@ -77,7 +82,17 @@ SITES = {
     "pallas": ("fail",),
     "dispatch": ("drop", "delay"),
     "chunk": ("preempt",),
+    # persistent plan-cache tier (sparse_tpu.vault): disk failure modes.
+    # Write path: truncate (torn write left on disk), stale (artifact
+    # from an outdated format), enospc (OSError at write). Read path:
+    # bitflip (one corrupted byte). Every one must quarantine + rebuild,
+    # never crash or mis-serve (docs/resilience.md).
+    "io": ("truncate", "bitflip", "stale", "enospc"),
 }
+
+#: which io faults apply on which half of the artifact IO path
+_IO_WRITE_FAULTS = ("truncate", "stale", "enospc")
+_IO_READ_FAULTS = ("bitflip",)
 
 _INJECTED = _metrics.counter("faults.injected")
 
@@ -395,6 +410,34 @@ def dispatch_actions() -> list:
         elif c.fault == "delay":
             _record_fire(c, ms=c.ms)
             acts.append(("delay", c.ms))
+    return acts
+
+
+def io_actions(op: str) -> list:
+    """Fired ``io``-site actions for one vault operation; ``op`` is
+    ``'write'`` or ``'read'``. Returns ``[(fault, frac), ...]`` where
+    ``frac`` (bitflip only) positions the flipped byte as a fraction of
+    the blob length — drawn from the clause's seeded RNG so a chaos run
+    corrupts the same byte every time."""
+    inj = _INJECTOR
+    if inj is None or _SUSPEND > 0:
+        return []
+    admissible = _IO_WRITE_FAULTS if op == "write" else _IO_READ_FAULTS
+    acts = []
+    for i in inj.by_site.get("io", ()):
+        c = inj.clauses[i]
+        if c.fault not in admissible:
+            continue
+        with _LOCK:
+            fire = inj._draw(i)
+            frac = (
+                float(inj._rngs[i].random()) if fire and c.fault == "bitflip"
+                else 0.0
+            )
+        if not fire:
+            continue
+        _record_fire(c, op=op)
+        acts.append((c.fault, frac))
     return acts
 
 

@@ -95,6 +95,24 @@ KINDS: dict[str, frozenset] = {
     # compile/pack seconds plus XLA cost/memory analysis when available
     # (flops, bytes, peak_bytes) — the roofline join key is `program`
     "plan_cache.compile": frozenset({"program"}),
+    # -- vault (sparse_tpu.vault, the persistent plan-cache tier) -----------
+    # one artifact write attempt: artifact is the codec kind ('pattern' |
+    # 'sell_pattern' | 'prepared_csr' | 'prepared_dia'), ok whether the
+    # atomic write landed (False = cleaned up, vault unchanged)
+    "vault.store": frozenset({"artifact", "ok"}),
+    # one successful verified artifact load (disk-tier hit)
+    "vault.load": frozenset({"artifact", "hit"}),
+    # a verify failure: the file was moved into the quarantine sidecar;
+    # reason is the verify-ladder step that failed ('bad-magic' |
+    # 'bad-header' | 'stale-format' | 'stale-jax' | 'key-mismatch' |
+    # 'truncated' | 'checksum' | 'decode-error' | 'expect-*' |
+    # 'manifest')
+    "vault.quarantine": frozenset({"artifact", "reason"}),
+    # a size-budgeted LRU sweep that evicted artifacts
+    "vault.gc": frozenset({"evicted"}),
+    # a SolveSession replayed the warm-start manifest on construction:
+    # entries read, programs successfully replayed
+    "vault.replay": frozenset({"entries", "programs"}),
     # -- generic ------------------------------------------------------------
     # one per process per sink file, written before the first event: the
     # controller's identity (process_index/pid/process_count, device

@@ -76,6 +76,7 @@ _QUICK_FILES = {
     "test_spatial.py",
     "test_telemetry.py",
     "test_tropical.py",
+    "test_vault.py",
 }
 
 
