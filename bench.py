@@ -1246,6 +1246,45 @@ def _try_multichip_comm(timeout_s: float):
     return None
 
 
+def _try_fleet(timeout_s: float):
+    """Fleet serving lane (ISSUE 10): run the S=8 ``fleet_batched_cg``
+    scenario (``__graft_entry__.dryrun_fleet``) in a subprocess and
+    return its structured row — sharded vs single-device wall times on
+    the batched_cg workload, per-lane parity at machine eps, and the
+    measured-vs-model psum accounting with its <=10% verdict. CPU-only
+    by construction (the dryrun forces the virtual mesh). Returns the
+    parsed dict, or None."""
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)  # never dial the tunnel for this
+    try:
+        proc = subprocess.run(
+            [
+                sys.executable, "-c",
+                "import __graft_entry__ as g; g.dryrun_fleet(8)",
+            ],
+            capture_output=True,
+            text=True,
+            timeout=max(60, timeout_s),
+            cwd=HERE,
+            env=env,
+        )
+    except subprocess.TimeoutExpired:
+        _note_probe_timeout("fleet_batched_cg", timeout_s)
+        return None
+    for line in reversed(proc.stdout.strip().splitlines()):
+        if line.startswith("MULTICHIP_FLEET_JSON: "):
+            try:
+                return json.loads(line[len("MULTICHIP_FLEET_JSON: "):])
+            except json.JSONDecodeError:
+                break
+    sys.stderr.write(proc.stderr[-1500:])
+    print(
+        f"bench: fleet dryrun rc={proc.returncode} without stats",
+        file=sys.stderr,
+    )
+    return None
+
+
 def _try_platform(platform_arg: str, timeout_s: int):
     """Run a worker subprocess; return its parsed JSON line or None."""
     stdout, stderr, rc = "", "", None
@@ -1419,6 +1458,26 @@ def main():
                             "bench: multichip measured-vs-model comm "
                             "DIVERGED beyond tolerance: "
                             + json.dumps(mc.get("modes", {})),
+                            file=sys.stderr,
+                        )
+                    print(json.dumps(rec))
+                    sys.stdout.flush()
+            except Exception:
+                traceback.print_exc(file=sys.stderr)
+        if rec is not None and remaining() > 150:
+            try:  # fleet serving lane (ISSUE 10) — structured, never fatal
+                fl = _try_fleet(min(300, remaining() - 60))
+                if fl:
+                    rec["fleet_batched_cg"] = fl
+                    if not fl.get("ok"):
+                        print(
+                            "bench: fleet_batched_cg FAILED its parity/"
+                            "comm gates: " + json.dumps({
+                                k: fl.get(k) for k in (
+                                    "max_abs_diff", "divergence_pct",
+                                    "iters_equal",
+                                )
+                            }),
                             file=sys.stderr,
                         )
                     print(json.dumps(rec))

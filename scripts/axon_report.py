@@ -420,6 +420,31 @@ def build_report(records_path: str, bench_paths=(), peak_gflops=None,
         for k in ("cold_s", "replay_s", "disk_warm_s", "warm_s"):
             if _num(cold_row.get(k)) is not None:
                 metrics[f"cold_start.{k}"] = {"v": cold_row[k], "hib": False}
+    # the bench fleet_batched_cg row (ISSUE 10): mesh-sharded vs single-
+    # device serving on the batched_cg workload — warm wall times, the
+    # sharded speedup, and the |measured-vs-model| psum divergence all
+    # ride the --compare surface
+    fleet_row = None
+    for e in sorted(sessions, key=lambda e: e.get("ts", 0)):
+        rec = e.get("record")
+        if isinstance(rec, dict) and isinstance(
+            rec.get("fleet_batched_cg"), dict
+        ):
+            fleet_row = rec["fleet_batched_cg"]
+    if fleet_row:
+        for k in ("single_warm_s", "fleet_warm_s"):
+            if _num(fleet_row.get(k)) is not None:
+                metrics[f"fleet_batched_cg.{k}"] = {
+                    "v": fleet_row[k], "hib": False,
+                }
+        if _num(fleet_row.get("speedup_warm")) is not None:
+            metrics["fleet_batched_cg.speedup_warm"] = {
+                "v": fleet_row["speedup_warm"], "hib": True,
+            }
+        if _num(fleet_row.get("divergence_pct")) is not None:
+            metrics["fleet_batched_cg.abs_divergence_pct"] = {
+                "v": abs(fleet_row["divergence_pct"]), "hib": False,
+            }
     for key, p in programs.items():
         if _num(p.get("achieved_gflops")) is not None:
             metrics[f"program.{key}.achieved_gflops"] = {
@@ -452,6 +477,7 @@ def build_report(records_path: str, bench_paths=(), peak_gflops=None,
         "programs": programs,
         "cold_start_s": cold_start_s,
         "cold_start_row": cold_row,
+        "fleet_row": fleet_row,
         "bench": bench_rows,
         "metrics": metrics,
     }

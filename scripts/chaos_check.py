@@ -32,6 +32,13 @@ chains are missing:
    mid-traffic; a fresh process replays the warm-start manifest and
    serves the same bucket set with ZERO plan-cache misses in the
    serving window (disk-tier hits only), all lanes converged.
+7. **Fleet kill-and-restart** (ISSUE 10 acceptance drill) — the same
+   drill under ``SPARSE_TPU_FLEET=auto`` on the forced 8-device virtual
+   CPU mesh: the serving child builds mesh-SHARDED bucket programs (the
+   manifest entries carry the mesh fingerprint), and the fresh process
+   replays the mesh-keyed manifest back to a zero-serving-miss window —
+   proving warm restarts survive under distributed serving, not just
+   single-device.
 
 Telemetry is pointed at a temp sink (never the committed
 ``results/axon/records.jsonl``). Wired into the quick lane through
@@ -235,6 +242,9 @@ def run(report: dict) -> list:
 
     # -- 6. kill-and-restart: warm replay serves at zero misses -------------
     problems += _vault_kill_restart(report)
+
+    # -- 7. kill-and-restart under FLEET mode: mesh-keyed manifest ----------
+    problems += _fleet_kill_restart(report)
     return problems
 
 
@@ -424,9 +434,100 @@ def _vault_kill_restart(report: dict) -> list:
     return problems
 
 
+def _fleet_kill_restart(report: dict) -> list:
+    """Scenario 7: the scenario-6 drill under fleet mode. Children run
+    with ``SPARSE_TPU_FLEET=auto`` on a forced 8-device virtual CPU
+    mesh, so the serve child's bucket programs are mesh-SHARDED and its
+    manifest entries carry the mesh fingerprint; the fresh process must
+    replay the mesh-keyed manifest back to a zero-serving-miss window."""
+    problems = []
+    vdir = tempfile.mkdtemp(prefix="chaos_vault_fleet_")
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    flags = env.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        env["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8"
+        ).strip()
+    env["SPARSE_TPU_VAULT"] = vdir
+    env["SPARSE_TPU_COMPILE_CACHE"] = os.path.join(vdir, "_xla_cache")
+    env["SPARSE_TPU_FLEET"] = "auto"
+    # VAULT_B=4 real lanes must clear the batch-sharding threshold (the
+    # bucket then rounds 4 -> 8, one lane per virtual device)
+    env["SPARSE_TPU_FLEET_MIN_B"] = "2"
+    env.pop("SPARSE_TPU_FAULTS", None)
+
+    def child(mode):
+        return subprocess.run(
+            [sys.executable, os.path.abspath(__file__),
+             "--vault-child", mode],
+            env=env, capture_output=True, text=True, timeout=300,
+        )
+
+    serve = child("serve")
+    if "SERVED" not in serve.stdout:
+        problems.append(
+            f"fleet restart: serve child never served "
+            f"(rc={serve.returncode}, stderr tail: "
+            f"{serve.stderr[-300:]!r})"
+        )
+    elif serve.returncode != -signal.SIGKILL:
+        problems.append(
+            "fleet restart: serve child was supposed to die by SIGKILL "
+            f"mid-traffic (rc={serve.returncode})"
+        )
+    warm = child("warm")
+    out = None
+    for line in warm.stdout.splitlines():
+        if line.startswith("WARM "):
+            try:
+                out = json.loads(line[5:])
+            except json.JSONDecodeError:
+                pass
+    if out is None:
+        problems.append(
+            f"fleet restart: warm child produced no report "
+            f"(rc={warm.returncode}, stderr tail: {warm.stderr[-300:]!r})"
+        )
+        return problems
+    report["fleet_restart"] = out
+    meshes = [m for m in out.get("manifest_mesh", []) if m]
+    if not meshes:
+        problems.append(
+            "fleet restart: manifest entries carry no mesh fingerprint "
+            "(sharded programs were not noted as mesh-keyed)"
+        )
+    want_fp = out.get("mesh", {}).get("fingerprint")
+    if want_fp and any(m != want_fp for m in meshes):
+        problems.append(
+            f"fleet restart: manifest mesh {meshes} does not match the "
+            f"serving mesh {want_fp!r}"
+        )
+    if out.get("replayed", 0) < 1:
+        problems.append("fleet restart: mesh-keyed manifest replayed no "
+                        "programs")
+    d = out.get("delta", {})
+    if d.get("misses", 1) != 0:
+        problems.append(
+            f"fleet restart: serving window had {d.get('misses')} "
+            "plan-cache misses (mesh-keyed warm restart must serve on "
+            "hits only)"
+        )
+    if d.get("hits", 0) < 1:
+        problems.append("fleet restart: serving window saw no cache hits")
+    bad = [r for r in out.get("resids", [1.0]) if not (r <= 10 * TOL)]
+    if bad:
+        problems.append(
+            f"fleet restart: {len(bad)} lanes unconverged after warm "
+            f"restart (worst ||r||={max(bad):.2e})"
+        )
+    return problems
+
+
 def vault_child(mode: str) -> int:
-    """Scenario 6 child entry (``--vault-child serve|warm``): reads the
-    vault dir from ``SPARSE_TPU_VAULT``."""
+    """Scenario 6/7 child entry (``--vault-child serve|warm``): reads
+    the vault dir from ``SPARSE_TPU_VAULT`` (and, scenario 7, the fleet
+    mode from ``SPARSE_TPU_FLEET`` on the forced 8-device mesh)."""
     import jax
 
     jax.config.update("jax_enable_x64", True)
@@ -457,6 +558,12 @@ def vault_child(mode: str) -> int:
         "delta": plan_cache.delta(snap),
         "resids": resids,
         "vault": vault.stats(),
+        # scenario 7 evidence: which mesh fingerprints the manifest
+        # carries and what mesh this process actually served on
+        "manifest_mesh": [
+            e.get("mesh") for e in vault.manifest_entries()
+        ],
+        "mesh": ses.session_stats().get("mesh", {}),
     }), flush=True)
     return 0
 
@@ -492,6 +599,7 @@ def main(argv) -> int:
         print(f"CHAOS FAILURE: {p}", file=sys.stderr)
     if not problems:
         vr = report.get("vault_restart", {})
+        fr = report.get("fleet_restart", {})
         print(
             "chaos check passed: "
             f"{len([k for k in report if k.startswith('solver.')])} solvers "
@@ -500,7 +608,9 @@ def main(argv) -> int:
             "preemption resume(s), vault io quarantines ok, "
             f"kill-and-restart warm ({vr.get('replayed', 0)} program(s) "
             f"replayed, {vr.get('delta', {}).get('misses', '?')} serving "
-            "misses)"
+            f"misses), fleet restart warm ({fr.get('replayed', 0)} "
+            f"mesh-keyed program(s), {fr.get('delta', {}).get('misses', '?')} "
+            "serving misses)"
         )
     return 1 if problems else 0
 

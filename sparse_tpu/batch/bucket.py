@@ -10,7 +10,12 @@ logarithmic, and pads honestly:
   replicate lane 0's values with a zero right-hand side and a huge
   tolerance — they converge at the first test point and never extend the
   batch's runtime. The number of batched programs per (pattern, solver)
-  is then at most ``log2(settings.batch_max)``.
+  is then at most ``log2(settings.batch_max)``. Under the fleet serving
+  tier buckets additionally round up to a multiple of the mesh size so
+  lane stacks split evenly across devices (``multiple_of``); the extra
+  mesh-pad lanes carry the same instant-converge contract, and pad
+  accounting (occupancy, pad waste) counts against the final rounded
+  bucket.
 * **Pattern shape/nnz** (:func:`pad_pattern`): a pattern padded with
   empty trailing rows/columns (to a pow2 row count) and explicit zero
   entries (to a pow2 nnz) is *exactly* equivalent for Krylov solves —
@@ -40,18 +45,33 @@ def pow2_ceil(v: int) -> int:
 
 
 def bucket_batch(b: int, policy: str | None = None,
-                 batch_max: int | None = None) -> int:
+                 batch_max: int | None = None,
+                 multiple_of: int = 1) -> int:
     """Padded lane count for a batch of ``b`` real requests under the
     bucket policy ('pow2' quantizes up, 'exact' keeps ``b``), clamped to
-    ``settings.batch_max``."""
+    ``settings.batch_max``.
+
+    ``multiple_of`` is the mesh constraint of the fleet serving tier
+    (``sparse_tpu.fleet``): a batch-sharded bucket must split evenly
+    over the mesh's S devices, so the bucket additionally rounds up to a
+    multiple of S *after* the policy quantization. The ``batch_max``
+    clamp is then applied in mesh units — a cap that is not itself a
+    multiple of S rounds up rather than producing an unshardable bucket
+    (the pad-accounting bugfix: callers must count pad lanes against the
+    FINAL bucket this returns, never against ``batch_max``)."""
     cap = int(batch_max if batch_max is not None else settings.batch_max)
+    m = max(int(multiple_of), 1)
     b = min(int(b), cap)
     policy = policy or settings.batch_bucket
     if policy == "exact":
-        return b
-    if policy != "pow2":
+        bkt = b
+    elif policy == "pow2":
+        bkt = min(pow2_ceil(b), cap)
+    else:
         raise ValueError(f"unknown bucket policy {policy!r}")
-    return min(pow2_ceil(b), cap)
+    if m > 1:
+        bkt = -(-bkt // m) * m  # ceil to the mesh multiple
+    return bkt
 
 
 def pad_lanes(values, rhs, tols, bucket: int, x0=None, big_tol=1e30):

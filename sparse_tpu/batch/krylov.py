@@ -140,17 +140,32 @@ def _make_lanes_tap(solver: str):
 # ---------------------------------------------------------------------------
 # CG
 # ---------------------------------------------------------------------------
-def _cg_loop(matvec, b, X0, tol, maxiter, conv_test_iters, Mvec=None):
+def _cg_loop(matvec, b, X0, tol, maxiter, conv_test_iters, Mvec=None,
+             lane_reduce=None):
     """Masked batched CG core (pure jnp, jit-safe).
 
     Same recurrences and test points as ``linalg._cg_device_loop``; every
     carry masks on the per-lane ``active`` flag. Returns
     ``(X, iters, resid2, converged)``.
+
+    ``lane_reduce`` generalizes the all-converged exit for mesh-sharded
+    lane stacks (``sparse_tpu.fleet``): the while condition's
+    "any lane still active" test runs through it instead of the local
+    ``jnp.any``, so a shard_map body passes a psum-over-the-batch-axis
+    reduction and every shard exits the SAME global iteration — frozen
+    (converged) lanes stay bit-stable while any shard anywhere still
+    works. ``None`` (the default) traces byte-identically to the
+    single-device loop.
     """
     tol2 = tol.astype(jnp.real(b).dtype) ** 2
     B = b.shape[0]
     cti = max(int(conv_test_iters), 1)
-    tap = _make_lanes_tap("cg")
+    any_active = jnp.any if lane_reduce is None else lane_reduce
+    # mesh-sharded loops never tap per-iteration: a host callback from a
+    # shard_map body would report LOCAL lane indices (misattributed) and
+    # serialize the shards through the host; the end_batch health sweep
+    # still covers fleet solves
+    tap = None if lane_reduce is not None else _make_lanes_tap("cg")
     X = X0
     R = b - matvec(X)
     P = jnp.zeros_like(b)
@@ -183,7 +198,7 @@ def _cg_loop(matvec, b, X0, tol, maxiter, conv_test_iters, Mvec=None):
 
     def cond(st):
         active, k = st[4], st[6]
-        return (k < maxiter) & jnp.any(active)
+        return (k < maxiter) & any_active(active)
 
     st = (X, R, P, rho, active0, iters0, jnp.zeros((), jnp.int32))
     X, R, _P, _rho, active, iters, _k = jax.lax.while_loop(cond, body, st)
@@ -214,13 +229,18 @@ def batched_cg(A, b, x0=None, tol=1e-08, maxiter=None, M=None,
 # ---------------------------------------------------------------------------
 # BiCGStab
 # ---------------------------------------------------------------------------
-def _bicgstab_loop(matvec, b, X0, tol, maxiter, conv_test_iters):
+def _bicgstab_loop(matvec, b, X0, tol, maxiter, conv_test_iters,
+                   lane_reduce=None):
     """Masked batched BiCGStab core — the recurrences of
-    ``linalg.bicgstab`` with per-lane scalars and frozen converged lanes."""
+    ``linalg.bicgstab`` with per-lane scalars and frozen converged lanes.
+    ``lane_reduce`` is the sharded all-converged exit hook (see
+    :func:`_cg_loop`)."""
     tol2 = tol.astype(jnp.real(b).dtype) ** 2
     B = b.shape[0]
     cti = max(int(conv_test_iters), 1)
-    tap = _make_lanes_tap("bicgstab")
+    any_active = jnp.any if lane_reduce is None else lane_reduce
+    # sharded loops: no per-iteration host taps (see _cg_loop)
+    tap = None if lane_reduce is not None else _make_lanes_tap("bicgstab")
     X = X0
     R = b - matvec(X)
     Rt = R
@@ -265,7 +285,7 @@ def _bicgstab_loop(matvec, b, X0, tol, maxiter, conv_test_iters):
 
     def cond(st):
         active, k = st[7], st[9]
-        return (k < maxiter) & jnp.any(active)
+        return (k < maxiter) & any_active(active)
 
     st = (X, R, Z, Z, zero, one, one,
           jnp.ones((B,), bool), jnp.zeros((B,), jnp.int32),

@@ -29,6 +29,18 @@ gone, plan-cache failure, injected dispatch faults) the bucket degrades
 to per-lane eager solves rather than stranding its tickets
 (``batch.degraded``).
 
+Fleet serving tier (ISSUE 10, docs/batching.md "Serving across a
+mesh"): with ``SPARSE_TPU_FLEET=auto`` (or ``fleet=`` at construction)
+a per-(pattern, bucket) policy (:mod:`sparse_tpu.fleet`) shards
+dispatches over the device mesh — same-pattern buckets batch-shard
+their lane stacks across the mesh batch axis (per-lane results
+bit-identical, the all-converged exit a measured lane-count psum),
+single oversized systems row-shard through ``DistCSR``/``dist_cg`` as
+B=1 bucket programs. Program keys gain the mesh fingerprint, vault
+manifest entries record it (a different-topology restart cold-starts
+cleanly), and ``session_stats()`` reports the mesh shape plus
+per-device lane occupancy.
+
 Request-scoped observability (ISSUE 6, Axon v3): every ticket carries a
 process-unique id (``telemetry.new_ticket_id``); each dispatch runs
 inside a :func:`telemetry.ticket_scope` so EVERY event it causes —
@@ -56,9 +68,11 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from .. import fleet as fleet_mod
 from .. import plan_cache, telemetry
 from ..config import settings
 from ..ops import spmv as spmv_ops
+from ..parallel import comm as _comm
 from ..resilience import faults as _faults
 from ..telemetry import _cost, _metrics
 from . import bucket as bucketing
@@ -291,7 +305,9 @@ class SolveSession:
                  restart: int | None = None, auto_flush: int | None = None,
                  requeue: bool = True, fallback_solver: str = "gmres",
                  dispatch_attempts: int = 2, slo_ms: float | None = None,
-                 warm_start: bool | None = None):
+                 warm_start: bool | None = None, fleet=None,
+                 fleet_mesh=None, fleet_min_b: int | None = None,
+                 row_shard_min_n: int | None = None):
         if solver not in _SOLVERS:
             raise ValueError(f"solver must be one of {_SOLVERS}")
         if fallback_solver not in _SOLVERS:
@@ -306,6 +322,20 @@ class SolveSession:
         self.fallback_solver = fallback_solver
         self.dispatch_attempts = max(int(dispatch_attempts), 1)
         self.slo_ms = None if slo_ms is None else float(slo_ms)
+        # mesh-sharded serving tier (ISSUE 10, docs/batching.md): the
+        # per-(pattern, bucket) strategy policy. `fleet` may be a mode
+        # string ('auto'/'batch'/'row'), True/False, a ready FleetPolicy,
+        # or None = settings.fleet (SPARSE_TPU_FLEET). Off (the default
+        # env) leaves every code path byte-identical to the classic
+        # single-device session.
+        self.fleet = fleet_mod.FleetPolicy.resolve(
+            fleet, mesh=fleet_mesh, min_b=fleet_min_b,
+            row_min_n=row_shard_min_n,
+        )
+        # per-device real-lane occupancy of the most recent dispatch
+        # (the /session device dimension; also on the always-on
+        # fleet.device_occupancy gauge family)
+        self._device_occ: list = []
         self._patterns: dict = {}  # fingerprint -> SparsityPattern (dedupe)
         self._pending: dict = {}  # id(pattern) -> [Request]
         self.dispatches = 0
@@ -373,7 +403,13 @@ class SolveSession:
 
     def session_stats(self) -> dict:
         """JSON-friendly live view of this session (the ``/session``
-        exporter endpoint aggregates these across live sessions)."""
+        exporter endpoint aggregates these across live sessions).
+
+        ``mesh`` is the active serving-mesh shape (ISSUE 10 satellite:
+        the stats used to have no device dimension at all) and
+        ``device_occupancy`` the per-device real-lane occupancy of the
+        most recent dispatch — ``[real/slot]`` per device for sharded
+        buckets, a single entry for the single-device path."""
         return {
             "solver": self.solver,
             "fallback_solver": self.fallback_solver,
@@ -382,6 +418,8 @@ class SolveSession:
             "slo_ms": self.slo_ms,
             "patterns": len(self._patterns),
             "dispatches": self.dispatches,
+            "mesh": self.fleet.describe(),
+            "device_occupancy": list(self._device_occ),
             "tickets": {"pending": self.pending, **self._ticket_counts},
         }
 
@@ -398,6 +436,7 @@ class SolveSession:
         t0 = time.monotonic()
         entries = vault.manifest_entries()
         replayed = 0
+        mesh_skipped = 0
         for e in entries:
             try:
                 solver = e.get("solver")
@@ -405,13 +444,29 @@ class SolveSession:
                 dtstr = e.get("dtype", "")
                 if solver not in _SOLVERS or bkt < 1 or not dtstr:
                     continue
+                # mesh-keyed entries (the fleet tier) only replay on the
+                # SAME topology: a fingerprint mismatch — restart on a
+                # different pod shape, fleet turned off — skips the
+                # entry for a clean cold start instead of compiling a
+                # program this mesh cannot dispatch
+                mesh_fp = e.get("mesh")
+                if mesh_fp:
+                    if not (
+                        self.fleet.enabled
+                        and mesh_fp == self.fleet.fingerprint
+                    ):
+                        mesh_skipped += 1
+                        continue
+                    plan = self.fleet.plan_for(e.get("strategy", "batch"))
+                else:
+                    plan = fleet_mod.FleetPlan("single")
                 dt = np.dtype(dtstr)
                 pat = vault.load_pattern(e.get("pattern", ""))
                 if pat is None:
                     continue
                 pat = self._patterns.setdefault(pat.fingerprint, pat)
                 pat.sell_pack()  # disk-tier hit (or rebuild + deposit)
-                self._prebuild(pat, solver, bkt, dt)
+                self._prebuild(pat, solver, bkt, dt, plan=plan)
                 replayed += 1
             except Exception:  # noqa: BLE001 - entry isolation
                 continue
@@ -420,18 +475,22 @@ class SolveSession:
         if telemetry.enabled():
             telemetry.record(
                 "vault.replay", entries=len(entries), programs=replayed,
+                mesh_skipped=mesh_skipped,
                 wall_ms=round((time.monotonic() - t0) * 1e3, 3),
             )
         return replayed
 
     def _prebuild(self, pattern: SparsityPattern, solver: str, bkt: int,
-                  dt) -> None:
+                  dt, plan=None) -> None:
         """Build (and AOT-compile, via the usual cost attribution) one
         bucket program outside any dispatch — argument shapes/dtypes
-        mirror ``_dispatch`` exactly, so the first real dispatch of this
+        mirror ``_dispatch`` exactly (including the fleet strategy's
+        mesh-fingerprinted key), so the first real dispatch of this
         bucket is a plan-cache hit into a warm executable."""
         dt = np.dtype(dt)
-        key = f"batch.{solver}.B{bkt}.{dt.str}"
+        if plan is None:
+            plan = fleet_mod.FleetPlan("single")
+        key = f"batch.{solver}.B{bkt}.{dt.str}{plan.key_suffix}"
         n = pattern.shape[0]
         # the same conversion pipeline as a real dispatch (np stacks ->
         # jnp.asarray), so trace signatures match under any x64 setting
@@ -445,7 +504,8 @@ class SolveSession:
 
         def build():
             tb = time.perf_counter()
-            fn = self._build_program(pattern, bkt, dt, solver=solver)
+            fn = self._build_program(pattern, bkt, dt, solver=solver,
+                                     plan=plan)
             prog, _info = _cost.attribute(
                 key, fn, args, pack_s=time.perf_counter() - tb,
                 solver=solver, bucket=bkt, dtype=dt.str,
@@ -577,6 +637,63 @@ class SolveSession:
                 fields["slo_miss"] = slo_miss
             telemetry.record("batch.ticket", **fields)
 
+    def _fleet_account(self, plan, solver, dt, nb, bkt, iters,
+                       solve_s) -> None:
+        """Post-dispatch fleet accounting (ISSUE 10): per-device lane
+        occupancy (session stats + always-on gauges), the batch-sharded
+        program's measured-collective commit (the per-iteration
+        all-converged psum the shard_map trace noted), and — telemetry
+        on — the ``fleet.dispatch``/``fleet.shard`` events plus the
+        ``comm.measured`` reconciliation against the analytic model."""
+        S = plan.S
+        if plan.strategy == "row":
+            # a row-sharded system spans EVERY device (row blocks), so
+            # each one is fully occupied by the single lane
+            occ = [1] * S
+            per = 1
+        else:
+            occ = fleet_mod.device_lane_counts(nb, bkt, S)
+            per = max(bkt // max(S, 1), 1)
+        self._device_occ = [round(c / per, 4) for c in occ]
+        for d, c in enumerate(occ):
+            _metrics.gauge(
+                "fleet.device_occupancy", device=str(d),
+                help="real lanes / bucket slots on this device in the "
+                "most recent dispatched bucket",
+            ).set(c / per)
+        if not plan.sharded:
+            return
+        led = None
+        execs = 0
+        if plan.strategy == "batch" and solver != "gmres":
+            # the while-condition psum ran (global iterations + 1)
+            # times; global iterations == the slowest lane's freeze
+            # step (pad lanes freeze at the first test point, so the
+            # max over ALL bkt lanes is exact)
+            led = fleet_mod.batch_ledger(plan.fingerprint, solver, bkt, dt)
+            execs = int(np.asarray(iters).max(initial=0)) + 1
+            if led.entries:
+                led.commit(execs, S)
+        if not telemetry.enabled():
+            return
+        telemetry.record(
+            "fleet.dispatch", strategy=plan.strategy, S=S, bucket=bkt,
+            lanes=nb, solver=solver, mesh=plan.fingerprint,
+            device_lanes=occ,
+        )
+        for d, c in enumerate(occ):
+            telemetry.record(
+                "fleet.shard", device=d, lanes=c, bucket_lanes=per,
+                strategy=plan.strategy,
+            )
+        if led is not None and led.entries and execs > 1:
+            _comm.record_measured(
+                "fleet.batch", led, executions=execs, shards=S,
+                model_bytes=fleet_mod.batch_comm_model_bytes(S, execs - 1),
+                solve_s=solve_s, strategy=plan.strategy, bucket=bkt,
+                solver=solver,
+            )
+
     def _dispatch(self, reqs, dt, solver: str | None = None,
                   allow_requeue: bool = True) -> None:
         # every event this dispatch causes — batch.*, kernel.failover,
@@ -599,9 +716,19 @@ class SolveSession:
                     time.sleep(act[1] / 1e3)
         pattern = reqs[0].pattern
         nb = len(reqs)
-        bkt = bucketing.bucket_batch(
-            nb, policy=self.bucket_policy, batch_max=self.batch_max
-        )
+        # fleet strategy first, bucket second: a batch-sharded bucket
+        # must round up to a mesh multiple (mesh-pad lanes carry the
+        # same instant-converge contract as ordinary pad lanes and are
+        # counted against the FINAL bucket below — the pad-accounting
+        # bugfix), a row-sharded submission is exactly one lane
+        plan = self.fleet.decide(pattern, nb, solver)
+        if plan.strategy == "row":
+            bkt = 1
+        else:
+            bkt = bucketing.bucket_batch(
+                nb, policy=self.bucket_policy, batch_max=self.batch_max,
+                multiple_of=(plan.S if plan.strategy == "batch" else 1),
+            )
         values = np.stack([r.values.astype(dt) for r in reqs])
         rhs = np.stack([r.b.astype(dt) for r in reqs])
         tols = np.asarray([r.tol for r in reqs])
@@ -621,7 +748,7 @@ class SolveSession:
         )
         snap = plan_cache.snapshot()
         faulty = _faults.ACTIVE and _faults.targets("matvec")
-        key = f"batch.{solver}.B{bkt}.{np.dtype(dt).str}"
+        key = f"batch.{solver}.B{bkt}.{np.dtype(dt).str}{plan.key_suffix}"
         if faulty:
             # fault-wrapped programs carry the injection callback in
             # their trace: never share cache entries with clean ones
@@ -640,7 +767,7 @@ class SolveSession:
             # as the miss itself)
             tb = time.perf_counter()
             fn = self._build_program(pattern, bkt, np.dtype(dt),
-                                     solver=solver)
+                                     solver=solver, plan=plan)
             prog, info = _cost.attribute(
                 key, fn, args,
                 pack_s=time.perf_counter() - tb,
@@ -660,10 +787,16 @@ class SolveSession:
                 # the injection callback.
                 from .. import vault
 
-                if vault.enabled():
+                if vault.enabled() and plan.strategy != "row":
+                    # row programs are rebuilt per dispatch (no compiled
+                    # artifact worth replaying); batch-sharded programs
+                    # note the mesh fingerprint so only a same-topology
+                    # restart replays them
                     vault.note_program(
                         pattern, solver=solver, bucket=bkt,
                         dtype=np.dtype(dt).str,
+                        mesh=(plan.fingerprint if plan.sharded else None),
+                        strategy=(plan.strategy if plan.sharded else None),
                     )
             t_solve0 = time.monotonic()
             out = prog(*args)
@@ -703,8 +836,13 @@ class SolveSession:
                 requeue_lanes.append(r)
         self.dispatches += 1
         _DISPATCHES.inc()
+        # occupancy/waste count against the FINAL bucket (incl. any
+        # mesh-multiple rounding); pad lanes are excluded by construction
         _BUCKET_OCCUPANCY.observe(nb / bkt)
         _PAD_WASTE.inc(bkt - nb)
+        self._fleet_account(
+            plan, solver, dt, nb, bkt, iters, max(t_solved - t_solve0, 0.0)
+        )
         if telemetry.enabled():
             # bucket-level phase wall clocks, accumulated onto each
             # lane's ticket (a requeued lane sums both dispatches).
@@ -752,6 +890,7 @@ class SolveSession:
                 iters_mean=float(iters[:nb].mean()) if nb else 0.0,
                 plan_cache=cache_d,
                 n=pattern.shape[0], nnz=pattern.nnz,
+                strategy=plan.strategy, S=plan.S,
             )
         if requeue_lanes:
             self._requeue(requeue_lanes, dt)
@@ -844,13 +983,34 @@ class SolveSession:
             r.ticket._fail(e)
 
     def _build_program(self, pattern: SparsityPattern, bkt: int, dt,
-                       solver: str | None = None):
+                       solver: str | None = None, plan=None):
         """The per-bucket compiled program: pattern pack + masked solver
         loop under ONE ``jax.jit`` whose arguments are the value stack,
         rhs, x0 and tolerances — so same-bucket dispatches with fresh
         coefficients reuse the executable (no constants captured from
-        any particular batch)."""
+        any particular batch).
+
+        ``plan`` routes the fleet strategies (ISSUE 10): 'batch' wraps
+        the SAME loop cores in a ``shard_map`` over the mesh batch axis
+        with the psum all-converged exit (gmres shards its inputs and
+        lets GSPMD partition the host-driven cycle), 'row' wraps
+        ``DistCSR``/``dist_cg`` in a B=1 bucket signature. 'single' (or
+        ``None``) is byte-identical to the classic path."""
         solver = solver or self.solver
+        if plan is not None and plan.strategy == "row":
+            return fleet_mod.build_row_program(
+                pattern, dt, plan.mesh,
+                conv_test_iters=self.conv_test_iters,
+            )
+        if plan is not None and plan.strategy == "batch":
+            return fleet_mod.build_batch_program(
+                pattern, bkt, dt, solver, plan.mesh,
+                self.conv_test_iters,
+                gmres_inner=(
+                    self._build_gmres_program(pattern, bkt, dt)
+                    if solver == "gmres" else None
+                ),
+            )
         if solver == "gmres":
             return self._build_gmres_program(pattern, bkt, dt)
         pack = pattern.sell_pack()

@@ -41,6 +41,13 @@ Flags (all env-overridable):
                                 the serving path: SolveSession construction (and bench)
                                 call utils.enable_compilation_cache(dir) when set, so
                                 bucket-program executables persist across restarts too.
+  SPARSE_TPU_FLEET            - mesh-sharded serving tier (sparse_tpu.fleet): 'auto'
+                                enables both sharding strategies, 'batch' / 'row'
+                                restrict to one; empty (default) = single-device
+                                serving, code path unchanged.
+  SPARSE_TPU_FLEET_MIN_B      - minimum REAL lane count before a bucket batch-shards
+                                across the mesh (default 8; below it the collective
+                                and padding overhead outweighs the parallelism).
 """
 
 from __future__ import annotations
@@ -193,6 +200,19 @@ class Settings:
     # compiled-executable tier survives restarts alongside the vault.
     compile_cache: str = field(
         default_factory=lambda: _env_str("SPARSE_TPU_COMPILE_CACHE", "")
+    )
+    # Mesh-sharded serving tier (sparse_tpu.fleet): '' = off (the
+    # single-device SolveSession path, byte-identical programs);
+    # 'auto' = both strategies ('batch' shards the bucket's lane stacks
+    # across the mesh batch axis, 'row' routes oversized single systems
+    # through DistCSR/dist_cg); 'batch' / 'row' restrict to one. Truthy
+    # spellings ('1', 'on', 'true') mean 'auto'.
+    fleet: str = field(default_factory=lambda: _env_str("SPARSE_TPU_FLEET", ""))
+    # Minimum real lanes in a bucket before batch-sharding pays: below
+    # this the pad waste (bucket rounds up to a mesh multiple) and the
+    # per-iteration all-converged psum outweigh the parallel matvec.
+    fleet_min_b: int = field(
+        default_factory=lambda: max(_env_int("SPARSE_TPU_FLEET_MIN_B", 8), 1)
     )
 
 

@@ -1,0 +1,249 @@
+"""Fleet: the mesh-sharded serving tier behind ``SolveSession``.
+
+The batch subsystem (:mod:`sparse_tpu.batch`) coalesces same-pattern
+traffic into bucketed masked-Krylov dispatches — but every dispatch runs
+on ONE device. The distributed layer (:mod:`sparse_tpu.parallel.dist`)
+spans the mesh — but solves one system at a time. Fleet fuses them so a
+single session serves a whole pod (ROADMAP item 1; the reference treats
+distribution as first-class, SURVEY §2c/§3.2–3.3):
+
+* **batch-sharded** (:func:`build_batch_program`) — the same-pattern
+  serving shape. The SELL pattern plan is a replicated closure constant;
+  the ``(B, nnz)`` value stack, the rhs, x0 and the per-lane tolerances
+  shard across the mesh batch axis under ``shard_map``. Each device runs
+  the ordinary masked-Krylov loop over its local lanes; the
+  all-converged exit is GLOBAL — a per-iteration lane-count ``psum``
+  through the :mod:`sparse_tpu.parallel.comm` wrappers (so the
+  ``comm.collectives`` / ``comm.collective_bytes`` metrics and the
+  ``comm.measured`` reconciliation come for free) keeps every shard on
+  the same iteration until the last lane anywhere freezes. Per-lane
+  iterates are bit-identical to the single-device program: lanes never
+  exchange data, only the exit predicate crosses the mesh.
+* **row-sharded** (:func:`build_row_program`) — single systems too large
+  for one device. The submission becomes a B=1 bucket program wrapping
+  ``shard_csr``/``dist_cg`` (row-block layout, halo-exchange SpMV, GSPMD
+  psum reductions), so oversized traffic flows through the SAME
+  ticket/flush/requeue path as everything else instead of bypassing the
+  session.
+
+Strategy selection is per (pattern, bucket): :class:`FleetPolicy.decide`
+picks batch-sharding when a bucket carries at least
+``settings.fleet_min_b`` real lanes, row-sharding for lone oversized CG
+systems, and the unchanged single-device path otherwise (a 1-device mesh
+ALWAYS selects single — the compiled program is byte-identical to
+non-fleet mode, pinned by jaxpr-identity tests).
+
+Compiled programs live in the ordinary plan cache under keys that embed
+the :func:`~sparse_tpu.parallel.mesh.mesh_fingerprint`, and the vault
+warm-start manifest records the fingerprint per program — a restart on a
+different topology cold-starts cleanly instead of mis-replaying programs
+compiled for the old mesh.
+
+Enable with ``SPARSE_TPU_FLEET=auto`` (or ``batch`` / ``row`` to
+restrict; docs/batching.md "Serving across a mesh").
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..config import settings
+from ._shard import (  # noqa: F401
+    FLEET_AXIS,
+    batch_comm_model_bytes,
+    batch_ledger,
+    build_batch_program,
+    shard_inputs,
+)
+from ._row import build_row_program  # noqa: F401
+
+__all__ = [
+    "FLEET_AXIS", "FleetPlan", "FleetPolicy", "batch_comm_model_bytes",
+    "batch_ledger", "build_batch_program", "build_row_program",
+    "device_lane_counts", "fleet_mesh", "shard_inputs",
+]
+
+#: default row-sharding threshold: a single system at or beyond this many
+#: rows routes through DistCSR/dist_cg instead of a one-lane batch
+#: program (overridable per session via ``row_shard_min_n``)
+ROW_SHARD_MIN_N = 1 << 18
+
+_MODES = ("auto", "batch", "row")
+
+
+def fleet_mesh(num_shards: int | None = None):
+    """The fleet's 1-D serving mesh over the visible devices, batch axis
+    named :data:`FLEET_AXIS` (row-sharded programs reuse the same mesh —
+    their row-block axis is the same physical ring)."""
+    from ..parallel.mesh import get_mesh
+
+    return get_mesh(num_shards, axis=FLEET_AXIS)
+
+
+def device_lane_counts(nb: int, bucket: int, S: int) -> list:
+    """Real lanes per device for a block-sharded bucket: lanes are a
+    real-first prefix of the padded stack and shard_map splits the batch
+    axis into S contiguous blocks, so device ``d`` owns lanes
+    ``[d*bucket/S, (d+1)*bucket/S)`` and its real count is the overlap
+    with ``[0, nb)``. The per-device occupancy surface of
+    ``session_stats()`` and the ``fleet.shard`` events."""
+    per = max(int(bucket) // max(int(S), 1), 1)
+    return [
+        max(0, min(int(nb) - d * per, per)) for d in range(max(int(S), 1))
+    ]
+
+
+class FleetPlan:
+    """One strategy decision: how a particular (pattern, bucket)
+    dispatches. ``key_suffix`` is what the decision contributes to the
+    bucket program's plan-cache key — empty for the single-device path
+    (so fleet-off and mesh=1 share keys, programs and vault manifests
+    with the classic session)."""
+
+    __slots__ = ("strategy", "mesh", "fingerprint")
+
+    def __init__(self, strategy: str, mesh=None, fingerprint: str | None = None):
+        self.strategy = strategy
+        self.mesh = mesh
+        self.fingerprint = fingerprint
+
+    @property
+    def sharded(self) -> bool:
+        return self.strategy != "single"
+
+    @property
+    def S(self) -> int:
+        return 1 if self.mesh is None else int(self.mesh.devices.size)
+
+    @property
+    def key_suffix(self) -> str:
+        if not self.sharded:
+            return ""
+        return f".{self.strategy}[{self.fingerprint}]"
+
+    def __repr__(self):
+        return (
+            f"FleetPlan({self.strategy!r}, S={self.S}, "
+            f"mesh={self.fingerprint!r})"
+        )
+
+
+_SINGLE = FleetPlan("single")
+
+
+class FleetPolicy:
+    """Per-session strategy selector (constructed by ``SolveSession``).
+
+    Parameters
+    ----------
+    mode : '' (disabled) | 'auto' | 'batch' | 'row'
+    mesh : the serving mesh (default: :func:`fleet_mesh` over every
+        visible device). A 1-device mesh disables sharding outright.
+    min_b : minimum REAL lanes before a bucket batch-shards
+        (default ``settings.fleet_min_b``)
+    row_min_n : row threshold for the oversized-single-system strategy
+        (default :data:`ROW_SHARD_MIN_N`)
+    """
+
+    def __init__(self, mode: str = "", mesh=None, min_b: int | None = None,
+                 row_min_n: int | None = None):
+        mode = _canonical_mode(mode)
+        self.mode = mode
+        self.min_b = int(min_b if min_b is not None else settings.fleet_min_b)
+        self.row_min_n = int(
+            row_min_n if row_min_n is not None else ROW_SHARD_MIN_N
+        )
+        self.mesh = None
+        self.fingerprint = None
+        if mode:
+            from ..parallel.mesh import mesh_fingerprint
+
+            self.mesh = mesh if mesh is not None else fleet_mesh()
+            self.fingerprint = mesh_fingerprint(self.mesh)
+
+    @classmethod
+    def resolve(cls, fleet=None, mesh=None, min_b=None, row_min_n=None):
+        """The ``SolveSession`` constructor hook: ``fleet`` may be a
+        ready policy, a mode string, ``True`` (= 'auto'), ``False``
+        (= off regardless of env), or ``None`` (= ``settings.fleet``)."""
+        if isinstance(fleet, cls):
+            return fleet
+        if fleet is None:
+            mode = settings.fleet
+        elif fleet is False:
+            mode = ""
+        elif fleet is True:
+            mode = "auto"
+        else:
+            mode = str(fleet)
+        return cls(mode, mesh=mesh, min_b=min_b, row_min_n=row_min_n)
+
+    @property
+    def enabled(self) -> bool:
+        return bool(self.mode) and self.S > 1
+
+    @property
+    def S(self) -> int:
+        return 1 if self.mesh is None else int(self.mesh.devices.size)
+
+    def bucket_multiple(self) -> int:
+        """What bucket sizes must be divisible by so batch-sharding stays
+        available: the mesh size when the policy can batch-shard, else 1
+        (bucketing must not inflate pads for strategies that cannot use
+        the mesh)."""
+        return self.S if self.enabled and self.mode in ("auto", "batch") else 1
+
+    def decide(self, pattern, nb: int, solver: str) -> FleetPlan:
+        """Strategy for a bucket of ``nb`` real lanes over ``pattern``
+        (the bucket itself is derived FROM the decision — batch-sharded
+        buckets round up to a mesh multiple, row-sharded buckets are
+        exactly 1); single unless a sharded strategy clearly pays."""
+        if not self.enabled:
+            return _SINGLE
+        if (
+            self.mode in ("auto", "row")
+            and nb == 1
+            and solver == "cg"
+            and int(pattern.shape[0]) >= self.row_min_n
+        ):
+            return FleetPlan("row", self.mesh, self.fingerprint)
+        if self.mode in ("auto", "batch") and nb >= self.min_b:
+            return FleetPlan("batch", self.mesh, self.fingerprint)
+        return _SINGLE
+
+    def plan_for(self, strategy: str) -> FleetPlan:
+        """The plan a recorded manifest entry replays under (the entry
+        already named its strategy; the fingerprint match happened
+        upstream)."""
+        if strategy == "single" or not self.enabled:
+            return _SINGLE
+        return FleetPlan(strategy, self.mesh, self.fingerprint)
+
+    def describe(self) -> dict:
+        """JSON-friendly mesh block for ``session_stats()``."""
+        if not self.mode:
+            return {"enabled": False, "devices": 1}
+        return {
+            "enabled": self.enabled,
+            "mode": self.mode,
+            "devices": self.S,
+            "axis": None if self.mesh is None else self.mesh.axis_names[0],
+            "fingerprint": self.fingerprint,
+            "min_b": self.min_b,
+            "row_min_n": self.row_min_n,
+        }
+
+
+def _canonical_mode(mode) -> str:
+    """'' stays off; truthy spellings mean 'auto'; unknown modes raise
+    (a typo'd SPARSE_TPU_FLEET must not silently serve single-device)."""
+    mode = str(mode or "").strip().lower()
+    if mode in ("", "0", "off", "false", "no"):
+        return ""
+    if mode in ("1", "on", "true", "yes"):
+        return "auto"
+    if mode not in _MODES:
+        raise ValueError(
+            f"fleet mode {mode!r} not one of {('',) + _MODES}"
+        )
+    return mode

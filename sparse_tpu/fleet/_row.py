@@ -1,0 +1,101 @@
+"""Row-sharded B=1 bucket programs: oversized systems through the mesh.
+
+The complementary fleet strategy: a single system too large for one
+device cannot batch-shard (there is nothing to split on the batch axis),
+but it IS the shape :mod:`sparse_tpu.parallel.dist` was built for —
+row-block layout over the mesh, halo-exchange SpMV, GSPMD psum
+reductions inside one compiled CG while_loop. This module wraps that
+path in a bucket-program signature (``run(values, rhs, x0, tols,
+maxiter) -> (X, iters, resid2, converged)`` with a leading B=1 lane
+axis), so oversized submissions flow through ``SolveSession``'s normal
+ticket/flush/requeue machinery instead of bypassing the session: they
+get deadlines, dispatch retries, terminal ``batch.ticket`` events and —
+if the mesh solve comes back unconverged — the standard requeue into the
+single-device fallback bucket.
+
+Cost shape: the row-block *layout* is rebuilt per dispatch (values
+change per request and ``DistCSR`` bakes them into its shard planes) and
+``dist_cg`` retraces per call — acceptable because row-sharded traffic
+is by definition rare and enormous (the solve dominates), and honest:
+the program key still takes exactly one plan-cache miss per
+(pattern, mesh), covering the *dispatcher* closure. Collective
+accounting rides ``DistCSR``'s own ledger (``dist.cg`` site), so
+``comm.measured`` reconciliation is inherited from Axon v4 unchanged.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+class _HostCSR:
+    """The duck ``shard_csr`` expects: host indptr/indices/data/shape."""
+
+    __slots__ = ("indptr", "indices", "data", "shape")
+
+    def __init__(self, indptr, indices, data, shape):
+        self.indptr, self.indices = indptr, indices
+        self.data, self.shape = data, shape
+
+
+def _host_spmv(pattern, vals, x):
+    """Host-side CSR matvec for the residual the ticket contract needs
+    (complex-safe; empty rows contribute nothing)."""
+    m = int(pattern.shape[0])
+    seg = np.repeat(
+        np.arange(m, dtype=np.int64), np.diff(pattern.indptr)
+    )
+    prod = vals * x[pattern.indices]
+    if np.iscomplexobj(prod):
+        return np.bincount(seg, weights=prod.real, minlength=m) + 1j * (
+            np.bincount(seg, weights=prod.imag, minlength=m)
+        )
+    return np.bincount(seg, weights=prod, minlength=m)
+
+
+def build_row_program(pattern, dt, mesh, conv_test_iters: int = 25):
+    """One row-sharded B=1 bucket program over ``pattern``.
+
+    The returned ``run`` is a host closure (never jitted at this level —
+    layout construction is host work); per call it lays the request's
+    values out over the mesh (nnz-balanced row blocks), runs the
+    compiled distributed CG to the lane's ABSOLUTE tolerance (the
+    session contract: ``||r|| < tol``), and returns numpy lane stacks
+    shaped exactly like a batch program's output.
+    """
+    from ..parallel.dist import dist_cg, shard_csr
+
+    axis = mesh.axis_names[0]
+    dt = np.dtype(dt)
+    cti = int(conv_test_iters)
+
+    def run(values, rhs, x0, tols, maxiter):
+        values = np.asarray(values).astype(dt, copy=False)
+        rhs = np.asarray(rhs).astype(dt, copy=False)
+        x0 = np.asarray(x0).astype(dt, copy=False)
+        tols = np.asarray(tols, dtype=np.float64)
+        if values.shape[0] != 1:
+            raise ValueError(
+                f"row-sharded programs serve B=1 buckets; got "
+                f"B={values.shape[0]}"
+            )
+        A = _HostCSR(pattern.indptr, pattern.indices, values[0],
+                     pattern.shape)
+        D = shard_csr(A, mesh=mesh, axis=axis, balanced=True)
+        xp, iters, _conv = dist_cg(
+            D, rhs[0], x0=(x0[0] if np.any(x0) else None),
+            tol=0.0, atol=float(tols[0]), maxiter=int(maxiter),
+            conv_test_iters=cti,
+        )
+        x = D.unpad_vector(xp).astype(dt, copy=False)
+        r = rhs[0] - _host_spmv(pattern, values[0], x)
+        resid2 = float(np.real(np.vdot(r, r)))
+        conv = np.isfinite(resid2) and resid2 < float(tols[0]) ** 2
+        return (
+            x[None, :],
+            np.asarray([int(iters)], dtype=np.int32),
+            np.asarray([resid2], dtype=np.float64),
+            np.asarray([conv], dtype=bool),
+        )
+
+    return run

@@ -30,6 +30,13 @@ Two caveats, both carried on the events:
   accounts the operand *capacity* as an upper bound and marks the entry
   ``exact=False`` (the ledger's ``exact`` flag goes false with it).
 
+Sites (beyond the PR-7 originals): the fleet serving tier
+(:mod:`sparse_tpu.fleet`) accounts its batch-sharded programs' only
+collective — the per-iteration all-converged lane-count ``psum`` —
+under the ``fleet.batch`` site, one ledger per (mesh fingerprint,
+solver, bucket, dtype) geometry; ``SolveSession`` commits the observed
+execution count after every sharded dispatch.
+
 Byte conventions (bytes **per shard** per execution, chosen to match the
 analytic models'):
 

@@ -103,6 +103,22 @@ def get_mesh_2d(num_procs_: int | None = None, axes=("gx", "gy")) -> Mesh:
     return Mesh(np.array(devs[: gx * gy]).reshape(gx, gy), axes)
 
 
+def mesh_fingerprint(mesh: Mesh) -> str:
+    """Deterministic topology identity of a mesh: platform kinds, grid
+    shape and axis names — ``"cpu:8:shards"`` for an 8-way 1-D CPU mesh.
+
+    Stable across processes on the same topology (device *kinds* and
+    counts, never volatile ids), so it can key persisted artifacts: the
+    fleet serving tier (``sparse_tpu.fleet``) bakes it into plan-cache
+    keys and the vault warm-start manifest, ensuring a restart on a
+    DIFFERENT topology cold-starts cleanly instead of replaying programs
+    compiled for the old mesh."""
+    devs = mesh.devices
+    kinds = sorted({str(getattr(d, "platform", "?")) for d in devs.flat})
+    shape = "x".join(str(int(s)) for s in devs.shape)
+    return f"{'+'.join(kinds)}:{shape}:{','.join(mesh.axis_names)}"
+
+
 def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
 

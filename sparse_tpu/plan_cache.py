@@ -86,9 +86,23 @@ def _count(which: str) -> None:
 def _evict_object(oid: int) -> None:
     """Drop every plan of a collected (or invalidated) object. Runs from
     ``weakref.finalize`` at GC time, so it must tolerate entries already
-    gone (a concurrent ``clear()``/eviction) rather than ever raise."""
+    gone (a concurrent ``clear()``/eviction) rather than ever raise.
+
+    The RLock does NOT protect against re-entrancy here: an allocation
+    inside this function can trigger GC, which can run ANOTHER object's
+    finalizer on the same thread (the lock re-enters) and mutate
+    ``_ENTRIES`` under our iteration — so the scan retries on the
+    resulting KeyError/RuntimeError instead of leaking it into the
+    interpreter's unraisable hook."""
     with _LOCK:
-        dead = [k for k in _ENTRIES if k[0] == oid]
+        for _ in range(4):
+            try:
+                dead = [k for k in _ENTRIES if k[0] == oid]
+                break
+            except (KeyError, RuntimeError):  # re-entrant finalizer race
+                continue
+        else:
+            dead = []  # give up cleanly; the LRU cap bounds orphans
         for k in dead:
             if _ENTRIES.pop(k, None) is not None:
                 _count("evictions")

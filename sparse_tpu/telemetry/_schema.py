@@ -90,6 +90,14 @@ KINDS: dict[str, frozenset] = {
     # end-to-end latency and the per-phase breakdown (queue/pack/compile/
     # solve/readback ms) — the record a ticket trace ends on
     "batch.ticket": frozenset({"ticket", "state"}),
+    # -- fleet (sparse_tpu.fleet, the mesh-sharded serving tier) ------------
+    # one per mesh-sharded bucket dispatch: the strategy the policy
+    # picked ('batch' | 'row'), mesh size S, bucket/lane counts, the
+    # mesh fingerprint, and per-device real-lane counts (device_lanes)
+    "fleet.dispatch": frozenset({"strategy", "S", "bucket"}),
+    # per-device detail of one sharded dispatch: real lanes this device
+    # served out of its bucket_lanes-slot block (occupancy numerator)
+    "fleet.shard": frozenset({"device", "lanes"}),
     # -- plan cache (sparse_tpu.plan_cache / telemetry/_cost.py) ------------
     # one per compiled (or host-packed) plan-cached program: wall-clock
     # compile/pack seconds plus XLA cost/memory analysis when available

@@ -112,20 +112,33 @@ def load_pattern(key: str):
     return fetch("pattern", key)
 
 
-def note_program(pattern, solver: str, bucket: int, dtype: str) -> None:
+def note_program(pattern, solver: str, bucket: int, dtype: str,
+                 mesh: str | None = None,
+                 strategy: str | None = None) -> None:
     """Record one freshly built bucket program in the warm-start
-    manifest (and ensure its pattern artifact exists). Best-effort."""
+    manifest (and ensure its pattern artifact exists). Best-effort.
+
+    ``mesh``/``strategy`` are the fleet tier's topology fingerprint and
+    sharding strategy (ISSUE 10): a mesh-keyed entry only replays in a
+    process whose serving mesh carries the SAME fingerprint — a restart
+    on a different topology skips it (clean cold start) instead of
+    compiling a program the new mesh cannot dispatch. ``None`` (the
+    default) marks a single-device program, replayable anywhere."""
     if not _store.enabled():
         return
     try:
         key = store_pattern(pattern)
-        _manifest.note({
+        entry = {
             "pattern": key,
             "solver": solver,
             "bucket": int(bucket),
             "dtype": dtype,
             "n": int(pattern.shape[0]),
             "nnz": int(pattern.nnz),
-        })
+        }
+        if mesh:
+            entry["mesh"] = str(mesh)
+            entry["strategy"] = str(strategy or "batch")
+        _manifest.note(entry)
     except Exception:
         return

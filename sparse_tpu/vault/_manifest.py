@@ -49,8 +49,12 @@ def _entries_checksum(entries: list) -> str:
 
 
 def _entry_key(e: dict) -> tuple:
+    # `mesh` is the fleet tier's topology fingerprint (ISSUE 10): the
+    # same (pattern, solver, bucket, dtype) program compiled for a
+    # different mesh is a DIFFERENT executable and must dedup separately
+    # (absent == single-device, so pre-fleet manifests stay valid)
     return (e.get("pattern"), e.get("solver"), e.get("bucket"),
-            e.get("dtype"))
+            e.get("dtype"), e.get("mesh"))
 
 
 def entries() -> list:

@@ -64,6 +64,7 @@ _QUICK_FILES = {
     "test_dia.py",
     "test_dia_spmv.py",
     "test_dist.py",
+    "test_fleet.py",
     "test_grid2d.py",
     "test_io.py",
     "test_multigrid.py",
