@@ -642,6 +642,80 @@ def run_cold_start(B: int = 8, n: int = 2048, iters: int = 40) -> dict:
     return out
 
 
+def run_sustained_cg(n: int = 512, B: int = 8, rate: float = 150.0,
+                     duration: float = 1.5, slo_ms: float = 250.0,
+                     seed: int = 23) -> dict:
+    """Sustained-throughput row (ISSUE 11): drive a WARM ``SolveSession``
+    through a fixed seeded Poisson arrival trace (``sparse_tpu.loadgen``)
+    and report what the serving stack holds under open-loop load — the
+    number the async front-end (ROADMAP item 1) will be judged against:
+
+    * ``offered_rps`` vs ``achieved_rps``: the trace's arrival rate vs
+      completed requests per wall second;
+    * ``p50/p95/p99_ms``: end-to-end ticket latency through the real
+      ticket path (submit -> coalesce -> bucketed dispatch -> resolve);
+    * ``slo_miss_rate`` against the session's ``slo_ms`` objective
+      (``p95_under_slo`` is the tracked acceptance bit).
+
+    Warm by construction: the pattern pack and every pow2 bucket program
+    the trace can hit are built before the measured window, so the row
+    measures steady-state serving, not compile tax (``cold_start`` is
+    the row for that). Embedded in the bench session record and lifted
+    by ``scripts/axon_report.py`` onto the ``--compare`` surface as
+    ``sustained_cg.{achieved_rps,p95_ms,slo_miss_rate}``.
+    """
+    import numpy as np
+    import scipy.sparse as sp
+
+    from sparse_tpu import loadgen
+    from sparse_tpu.batch import SolveSession
+
+    rng = np.random.default_rng(seed)
+    e = np.ones(n, dtype=np.float32)
+    base = sp.diags(
+        [-e[:-1], 2.5 * e, -e[:-1]], [-1, 0, 1], format="csr"
+    ).astype(np.float32)
+    mats = []
+    for _ in range(B):
+        Ai = base.copy()
+        Ai.setdiag(2.5 + rng.random(n).astype(np.float32))
+        Ai.sort_indices()
+        mats.append(Ai.tocsr())
+    rhs = rng.standard_normal((B, n)).astype(np.float32)
+    systems = list(zip(mats, rhs))
+
+    ses = SolveSession("cg", batch_max=32, slo_ms=slo_ms)
+    pattern = ses.pattern_of(mats[0])
+    pattern.sell_pack()
+    # warm every bucket the coalescing can produce (pow2 up to batch_max)
+    bkt = 1
+    while bkt <= ses.batch_max:
+        ses._prebuild(pattern, "cg", bkt, np.dtype(np.float32))
+        bkt *= 2
+
+    trace = loadgen.ArrivalTrace.poisson(
+        rate=rate, duration=duration, seed=seed
+    )
+    rep = loadgen.run_load(ses, trace, systems, tol=1e-6)
+    return {
+        "n": n, "rate": rate, "duration_s": duration,
+        "trace": rep.trace,
+        "arrivals": rep.arrivals, "completed": rep.completed,
+        "failed": rep.failed,
+        "offered_rps": rep.offered_rps,
+        "achieved_rps": rep.achieved_rps,
+        "p50_ms": rep.latency_ms["p50"],
+        "p95_ms": rep.latency_ms["p95"],
+        "p99_ms": rep.latency_ms["p99"],
+        "slo_ms": slo_ms,
+        "slo_misses": rep.slo_misses,
+        "slo_miss_rate": rep.slo_miss_rate,
+        "p95_under_slo": rep.latency_ms["p95"] <= slo_ms,
+        "dispatches": rep.dispatches,
+        "wall_s": rep.wall_s,
+    }
+
+
 def run_spmm(n: int = 2000, width: int = 128):
     """SpMM row (VERDICT r3 #7): CSR x dense WIDE B — the MXU-shaped op
     the reference implements as a first-class task family
@@ -940,6 +1014,10 @@ def worker(platform_arg: str) -> None:
             rec["cold_start"] = run_cold_start()
         except Exception:
             traceback.print_exc(file=sys.stderr)
+        try:  # stage 4.8: sustained-throughput loadgen row (ISSUE 11)
+            rec["sustained_cg"] = run_sustained_cg()
+        except Exception:
+            traceback.print_exc(file=sys.stderr)
         print(json.dumps(rec))
         sys.stdout.flush()
         try:  # stage 5: full fused sweep — refines the headline if better
@@ -988,6 +1066,10 @@ def worker(platform_arg: str) -> None:
             traceback.print_exc(file=sys.stderr)
         try:  # vault cold/disk-warm/warm restart row (ISSUE 9)
             rec["cold_start"] = run_cold_start()
+        except Exception:
+            traceback.print_exc(file=sys.stderr)
+        try:  # sustained-throughput loadgen row (ISSUE 11, the CPU lane)
+            rec["sustained_cg"] = run_sustained_cg()
         except Exception:
             traceback.print_exc(file=sys.stderr)
         print(json.dumps(rec))

@@ -40,6 +40,18 @@ when ``--peak-gflops`` / ``--peak-gbs`` ceilings are given);
 session paid — the number ROADMAP item 4 (persistent plan cache) is
 out to kill.
 
+Axon v5 additions (ISSUE 11): ``report["load"]`` rolls up the
+``loadgen.trace`` events (offered vs achieved req/s, latency
+percentiles, SLO-miss rate, the weighted tenant-fairness index) and
+``report["alerts"]`` the ``watchdog.alert``/``watchdog.clear`` chains
+(fired/cleared per rule, unresolved alerts); the bench ``sustained_cg``
+row (achieved req/s at the p95 SLO under a seeded Poisson trace) is
+lifted onto the ``--compare`` surface next to ``batched_cg`` /
+``fleet_batched_cg``. ``--compare`` additionally reports metrics
+present on only ONE side (a baseline from before a new bench row, or a
+row that vanished) as *informational* — listed, never gated: only a
+metric present in BOTH reports can regress.
+
 Axon v4 additions (ISSUE 7): ``report["comm"]`` rolls up the
 ``comm.measured`` events (parallel/comm.py trace-time accounting) per
 site — measured vs analytic-model bytes, divergence %, and the achieved
@@ -173,6 +185,61 @@ def _tickets_rollup(events) -> dict:
         "phase_ms_mean": {
             p: round(phase_tot[p] / phase_n[p], 3) for p in phase_tot
         },
+    }
+
+
+#: the headline fields a loadgen.trace event carries (ISSUE 11)
+_LOAD_FIELDS = ("trace", "arrivals", "completed", "failed", "wall_s",
+                "offered_rps", "achieved_rps", "p50_ms", "p95_ms",
+                "p99_ms", "slo_ms", "slo_miss_rate", "fairness",
+                "dispatches")
+
+
+def _load_rollup(events) -> dict:
+    """Loadgen runs (``loadgen.trace`` events): run count plus the most
+    recent run's headline numbers and per-tenant shares — the
+    throughput/latency/fairness picture of the last load test."""
+    evs = [e for e in events if e.get("kind") == "loadgen.trace"]
+    if not evs:
+        return {"runs": 0}
+    last = max(evs, key=lambda e: e.get("ts", 0))
+    out = {
+        "runs": len(evs),
+        "last": {k: last[k] for k in _LOAD_FIELDS if k in last},
+    }
+    if isinstance(last.get("tenants"), dict):
+        out["last"]["tenants"] = last["tenants"]
+    return out
+
+
+def _alerts_rollup(events) -> dict:
+    """Watchdog alert chains: fired/cleared counts per rule (from the
+    ``watchdog.alert``/``watchdog.clear`` events), rules whose last
+    transition was an unresolved alert, and the worst severity seen."""
+    by_rule: dict = {}
+    for e in events:
+        kind = e.get("kind")
+        if kind not in ("watchdog.alert", "watchdog.clear"):
+            continue
+        r = by_rule.setdefault(str(e.get("rule", "?")), {
+            "fired": 0, "cleared": 0, "severity": None, "last": None,
+        })
+        if kind == "watchdog.alert":
+            r["fired"] += 1
+            r["severity"] = e.get("severity")
+            r["last"] = "alert"
+        else:
+            r["cleared"] += 1
+            r["last"] = "clear"
+    fired = sum(r["fired"] for r in by_rule.values())
+    cleared = sum(r["cleared"] for r in by_rule.values())
+    return {
+        "fired": fired,
+        "cleared": cleared,
+        "by_rule": by_rule,
+        "unresolved": sorted(
+            name for name, r in by_rule.items() if r["last"] == "alert"
+        ),
     }
 
 
@@ -355,6 +422,8 @@ def build_report(records_path: str, bench_paths=(), peak_gflops=None,
 
     tickets = _tickets_rollup(events)
     comm = _comm_rollup(events, peak_ici_gbs)
+    load = _load_rollup(events)
+    alerts = _alerts_rollup(events)
     programs = _programs_rollup(events, peak_gflops, peak_gbs)
     cold_start_s = round(sum(
         (_num(p.get("compile_s")) or 0.0) + (_num(p.get("pack_s")) or 0.0)
@@ -408,6 +477,16 @@ def build_report(records_path: str, bench_paths=(), peak_gflops=None,
         }
     if cold_start_s:
         metrics["cold_start_s"] = {"v": cold_start_s, "hib": False}
+    # the loadgen surface (ISSUE 11): last run's throughput/latency/
+    # fairness numbers ride --compare like every other latency metric
+    if load.get("runs"):
+        ll = load["last"]
+        for key, hib in (("achieved_rps", True), ("p95_ms", False),
+                         ("slo_miss_rate", False), ("fairness", True)):
+            if _num(ll.get(key)) is not None:
+                metrics[f"load.{key}"] = {"v": ll[key], "hib": hib}
+    if alerts["fired"] or alerts["cleared"]:
+        metrics["alerts.fired"] = {"v": alerts["fired"], "hib": False}
     # the bench cold_start row (ISSUE 9): cold vs disk-warm vs warm
     # serving times ride the --compare surface so the vault's warm-
     # restart win is a pinned regression metric, not just a bench line
@@ -420,6 +499,23 @@ def build_report(records_path: str, bench_paths=(), peak_gflops=None,
         for k in ("cold_s", "replay_s", "disk_warm_s", "warm_s"):
             if _num(cold_row.get(k)) is not None:
                 metrics[f"cold_start.{k}"] = {"v": cold_row[k], "hib": False}
+    # the bench sustained_cg row (ISSUE 11): achieved req/s at the p95
+    # SLO under a seeded Poisson trace — the sustained-throughput
+    # regression metric next to batched_cg/fleet_batched_cg
+    sustained_row = None
+    for e in sorted(sessions, key=lambda e: e.get("ts", 0)):
+        rec = e.get("record")
+        if isinstance(rec, dict) and isinstance(
+            rec.get("sustained_cg"), dict
+        ):
+            sustained_row = rec["sustained_cg"]
+    if sustained_row:
+        for k, hib in (("achieved_rps", True), ("offered_rps", True),
+                       ("p95_ms", False), ("slo_miss_rate", False)):
+            if _num(sustained_row.get(k)) is not None:
+                metrics[f"sustained_cg.{k}"] = {
+                    "v": sustained_row[k], "hib": hib,
+                }
     # the bench fleet_batched_cg row (ISSUE 10): mesh-sharded vs single-
     # device serving on the batched_cg workload — warm wall times, the
     # sharded speedup, and the |measured-vs-model| psum divergence all
@@ -474,10 +570,13 @@ def build_report(records_path: str, bench_paths=(), peak_gflops=None,
         "cache": cache,
         "anomalies": anomalies[:100],
         "tickets": tickets,
+        "load": load,
+        "alerts": alerts,
         "programs": programs,
         "cold_start_s": cold_start_s,
         "cold_start_row": cold_row,
         "fleet_row": fleet_row,
+        "sustained_row": sustained_row,
         "bench": bench_rows,
         "metrics": metrics,
     }
@@ -510,6 +609,21 @@ def compare(current: dict, baseline: dict, threshold: float = 0.2) -> list:
                 "delta_pct": round(rel * 100.0, 1),
             })
     return regressions
+
+
+def informational(current: dict, baseline: dict) -> dict:
+    """Metrics present on only one side of a comparison (ISSUE 11
+    satellite): a baseline written before a new bench row exists (e.g.
+    ``sustained_cg.*``) must not make ``--compare`` asymmetric — such
+    metrics are LISTED, never gated. ``new`` = in current only (a row
+    the baseline predates), ``vanished`` = in baseline only (a row this
+    run failed to produce — worth a look, still not a regression)."""
+    cur_m = set(current.get("metrics", {}))
+    base_m = set(baseline.get("metrics", {}))
+    return {
+        "new": sorted(cur_m - base_m),
+        "vanished": sorted(base_m - cur_m),
+    }
 
 
 # ---------------------------------------------------------------------------
@@ -586,6 +700,50 @@ def _print_report(rep: dict) -> None:
                     f"{p}={ph[p]}" for p in _TICKET_PHASES if p in ph
                 )
             )
+    load = rep.get("load") or {}
+    if load.get("runs"):
+        ll = load["last"]
+        print(
+            f"  load ({load['runs']} run(s); last trace "
+            f"{ll.get('trace', '?')!r}):"
+        )
+        print(
+            f"    offered={ll.get('offered_rps')}req/s "
+            f"achieved={ll.get('achieved_rps')}req/s "
+            f"p50={ll.get('p50_ms')}ms p95={ll.get('p95_ms')}ms "
+            f"p99={ll.get('p99_ms')}ms "
+            f"slo_miss_rate={ll.get('slo_miss_rate')} "
+            f"fairness={ll.get('fairness')}"
+        )
+        for tenant, t in sorted((ll.get("tenants") or {}).items()):
+            print(
+                f"    tenant {tenant or '(default)':<12} "
+                f"completed={t.get('completed')} "
+                f"achieved={t.get('achieved_rps')}req/s "
+                f"weight={t.get('weight')}"
+            )
+    al = rep.get("alerts") or {}
+    if al.get("fired") or al.get("cleared"):
+        print(
+            f"  watchdog alerts: fired={al['fired']} "
+            f"cleared={al['cleared']}"
+            + (f" UNRESOLVED={al['unresolved']}" if al["unresolved"]
+               else "")
+        )
+        for rule, r in sorted(al.get("by_rule", {}).items()):
+            print(
+                f"    {rule:<20} fired={r['fired']} "
+                f"cleared={r['cleared']} severity={r.get('severity')}"
+            )
+    srow = rep.get("sustained_row")
+    if srow:
+        print(
+            "  sustained_cg: "
+            f"offered={srow.get('offered_rps')}req/s "
+            f"achieved={srow.get('achieved_rps')}req/s "
+            f"p95={srow.get('p95_ms')}ms (slo {srow.get('slo_ms')}ms) "
+            f"slo_miss_rate={srow.get('slo_miss_rate')}"
+        )
     progs = rep.get("programs") or {}
     if progs:
         print(
@@ -688,6 +846,24 @@ def main(argv) -> int:
                   file=sys.stderr)
             return 2
         regs = compare(rep, baseline, threshold)
+        info = informational(rep, baseline)
+        if not quiet:
+            # one-sided metrics are informational by contract: a
+            # baseline predating a new bench row never gates, and a
+            # vanished row is surfaced without failing the run
+            if info["new"]:
+                print(
+                    f"  {len(info['new'])} metric(s) not in baseline "
+                    "(informational): " + ", ".join(info["new"][:8])
+                    + (" ..." if len(info["new"]) > 8 else "")
+                )
+            if info["vanished"]:
+                print(
+                    f"  {len(info['vanished'])} baseline metric(s) "
+                    "missing from this run (informational): "
+                    + ", ".join(info["vanished"][:8])
+                    + (" ..." if len(info["vanished"]) > 8 else "")
+                )
         if regs:
             print(
                 f"axon_report: {len(regs)} regression(s) vs "

@@ -15,10 +15,15 @@ serving session"):
                states, fault-injection status, uptime
     /session   JSON: queue depth, bucket occupancy, per-session ticket
                states, compiled-program attribution, cold-start budget
+    /alerts    JSON: the SLO watchdog's rule states (firing set, values,
+               thresholds) — a disabled stub when no watchdog runs
 
 ``--once`` starts the server on the requested port (0 = ephemeral),
-self-scrapes all three endpoints, prints a one-line digest per endpoint
-and exits 0 — the hand-run smoke check. In-process serving (the normal
+self-scrapes every endpoint, prints a one-line digest per endpoint
+and exits 0 — the hand-run smoke check. The bound port is always
+printed explicitly: when the requested port is taken, the exporter
+falls back to an ephemeral one (ISSUE 11 satellite — the CI-rerun
+flaky-port fix) and the printed port is the one that actually answers. In-process serving (the normal
 deployment: the process running the SolveSession calls
 ``telemetry.serve()`` itself) needs no CLI; this script exists for
 ad-hoc inspection of a long-lived python -i / notebook session exposing
@@ -73,9 +78,16 @@ def main(argv) -> int:
 
     server = telemetry.serve(port=port, host=host)
     print(f"axon_serve: listening on {server.url} "
-          "(/metrics /healthz /session)")
+          "(/metrics /healthz /session /alerts)")
+    # the actually-bound port, machine-greppable (it differs from the
+    # request when the port was busy and the server fell back)
+    print(
+        f"axon_serve: bound port {server.port}"
+        + (f" (requested {server.requested_port} busy)"
+           if getattr(server, "fallback", False) else "")
+    )
     if once:
-        for ep in ("/metrics", "/healthz", "/session"):
+        for ep in ("/metrics", "/healthz", "/session", "/alerts"):
             body = urllib.request.urlopen(server.url + ep, timeout=5).read()
             if ep == "/metrics":
                 n = sum(

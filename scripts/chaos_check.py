@@ -39,6 +39,15 @@ chains are missing:
    replays the mesh-keyed manifest back to a zero-serving-miss window —
    proving warm restarts survive under distributed serving, not just
    single-device.
+8. **Loadgen + watchdog alerting** (ISSUE 11 acceptance drill) — a
+   seeded Poisson trace (``sparse_tpu.loadgen``) drives a warm
+   ``SolveSession`` while a ``delay:dispatch`` fault clause inflates
+   every dispatch past the session's ``slo_ms``: the SLO watchdog
+   (``telemetry/_watchdog.py``) must fire its ``slo_miss_rate`` alert
+   DURING injection (``watchdog.alert`` event + always-on
+   ``watchdog.alerts`` counter) and emit ``watchdog.clear`` after the
+   faults lift and clean traffic flows — alerting proven end-to-end,
+   not just unit-tested.
 
 Telemetry is pointed at a temp sink (never the committed
 ``results/axon/records.jsonl``). Wired into the quick lane through
@@ -245,6 +254,107 @@ def run(report: dict) -> list:
 
     # -- 7. kill-and-restart under FLEET mode: mesh-keyed manifest ----------
     problems += _fleet_kill_restart(report)
+
+    # -- 8. loadgen traffic + watchdog alert/clear under dispatch delay -----
+    problems += _loadgen_watchdog(report)
+    return problems
+
+
+#: scenario 8's injection/objective geometry: the injected delay must
+#: dominate the SLO, and clean warm solves must sit far under it
+WD_SLO_MS = 100.0
+WD_DELAY_SPEC = "delay:dispatch:ms=150"
+
+
+def _loadgen_watchdog(report: dict) -> list:
+    """Scenario 8: drive loadgen traffic through a warm SolveSession
+    with dispatch-delay injection; the watchdog's ``slo_miss_rate`` rule
+    must alert while the faults are live and clear once clean traffic
+    flows again."""
+    import numpy as np
+
+    from sparse_tpu import loadgen, telemetry as tel
+    from sparse_tpu.batch import SolveSession
+    from sparse_tpu.resilience import faults
+    from sparse_tpu.telemetry import _watchdog
+
+    problems = []
+    tel.reset()
+    rng = np.random.default_rng(31)
+    mats = []
+    for _ in range(4):
+        M = _tridiag(N)
+        M.setdiag(3.0 + rng.random(N))
+        M.sort_indices()
+        mats.append(M.tocsr())
+    rhs = rng.standard_normal((4, N))
+    systems = list(zip(mats, rhs))
+
+    ses = SolveSession("cg", slo_ms=WD_SLO_MS)
+    # warm every pow2 bucket the trace's coalescing can produce, so the
+    # clean phase's latency is solve time, not compile tax
+    pattern = ses.pattern_of(mats[0])
+    pattern.sell_pack()
+    bkt = 1
+    while bkt <= 16:
+        ses._prebuild(pattern, "cg", bkt, np.dtype(np.float64))
+        bkt *= 2
+
+    wd = _watchdog.Watchdog(rules=[
+        _watchdog.slo_miss_rate_rule(trigger=0.5, clear=0.2),
+    ])
+    wd.evaluate()  # prime the windowed-rate snapshots
+
+    trace = loadgen.ArrivalTrace.poisson(rate=40.0, duration=0.5, seed=13)
+    faults.configure(WD_DELAY_SPEC)
+    try:
+        rep_faulted = loadgen.run_load(ses, trace, systems, tol=TOL)
+        # evaluate while the injection is still configured: the alert
+        # must fire DURING the incident, not in the postmortem
+        wd.evaluate()
+        alerted = "slo_miss_rate" in wd.active()
+    finally:
+        faults.clear()
+    rep_clean = loadgen.run_load(ses, trace, systems, tol=TOL)
+    wd.evaluate()
+    kinds = _event_kinds(tel)
+    report["loadgen_watchdog"] = {
+        "faulted": {
+            "slo_miss_rate": rep_faulted.slo_miss_rate,
+            "p95_ms": rep_faulted.latency_ms["p95"],
+            "achieved_rps": rep_faulted.achieved_rps,
+        },
+        "clean": {
+            "slo_miss_rate": rep_clean.slo_miss_rate,
+            "p95_ms": rep_clean.latency_ms["p95"],
+            "achieved_rps": rep_clean.achieved_rps,
+        },
+        "alerted_during_injection": alerted,
+        "active_after_clean": wd.active(),
+        "events": kinds,
+    }
+    if rep_faulted.completed == 0:
+        problems.append("loadgen: faulted run completed no requests")
+    if rep_faulted.slo_miss_rate <= 0.5:
+        problems.append(
+            f"loadgen: injected delay missed too few SLOs "
+            f"(rate={rep_faulted.slo_miss_rate}) — spec drift?"
+        )
+    if kinds.get("fault.injected", 0) == 0:
+        problems.append("loadgen: no fault.injected events from the "
+                        "delay clause")
+    if kinds.get("loadgen.trace", 0) < 2:
+        problems.append("loadgen: missing loadgen.trace run records")
+    if not alerted or kinds.get("watchdog.alert", 0) == 0:
+        problems.append(
+            "watchdog: slo_miss_rate did not alert during injection"
+        )
+    if wd.active() or kinds.get("watchdog.clear", 0) == 0:
+        problems.append(
+            f"watchdog: alert did not clear after faults lifted "
+            f"(active={wd.active()}, clean slo_miss_rate="
+            f"{rep_clean.slo_miss_rate})"
+        )
     return problems
 
 
@@ -600,6 +710,7 @@ def main(argv) -> int:
     if not problems:
         vr = report.get("vault_restart", {})
         fr = report.get("fleet_restart", {})
+        lw = report.get("loadgen_watchdog", {})
         print(
             "chaos check passed: "
             f"{len([k for k in report if k.startswith('solver.')])} solvers "
@@ -610,7 +721,10 @@ def main(argv) -> int:
             f"replayed, {vr.get('delta', {}).get('misses', '?')} serving "
             f"misses), fleet restart warm ({fr.get('replayed', 0)} "
             f"mesh-keyed program(s), {fr.get('delta', {}).get('misses', '?')} "
-            "serving misses)"
+            "serving misses), watchdog alert->clear ok (faulted "
+            f"slo_miss_rate={lw.get('faulted', {}).get('slo_miss_rate', '?')}"
+            " -> clean "
+            f"{lw.get('clean', {}).get('slo_miss_rate', '?')})"
         )
     return 1 if problems else 0
 

@@ -157,13 +157,18 @@ class SolveTicket:
     carries (``telemetry.ticket_scope``); ``phase_ms`` accumulates the
     per-phase latency breakdown (queue/pack/compile/solve/readback)
     across the first dispatch and any requeue, and is what the
-    ``batch.ticket`` terminal event and the Perfetto ticket lane render."""
+    ``batch.ticket`` terminal event and the Perfetto ticket lane render.
+
+    ``tenant`` is the optional caller label fairness rollups group by
+    (ISSUE 11 satellite): it rides the ``batch.ticket`` terminal event
+    and labels the ``batch.ticket_latency`` histogram; ``None`` (the
+    default) keeps the existing metric series names unchanged."""
 
     __slots__ = ("_session", "_out", "t_submit", "state", "error",
                  "deadline_s", "requeued", "solver", "id", "phase_ms",
-                 "t_done", "t_mark")
+                 "t_done", "t_mark", "tenant")
 
-    def __init__(self, session, deadline_s=None):
+    def __init__(self, session, deadline_s=None, tenant=None):
         self._session = session
         self._out = None
         self.t_submit = time.monotonic()
@@ -176,6 +181,7 @@ class SolveTicket:
         self.phase_ms: dict = {}
         self.t_done = None  # set once, at first terminal resolution
         self.t_mark = None  # end of the last phase-accounted dispatch
+        self.tenant = None if tenant is None else str(tenant)
 
     @property
     def done(self) -> bool:
@@ -365,13 +371,19 @@ class SolveSession:
 
     def submit(self, A, b, tol: float = 1e-8, x0=None, maxiter=None,
                pattern: SparsityPattern | None = None,
-               deadline_s: float | None = None) -> SolveTicket:
+               deadline_s: float | None = None,
+               tenant: str | None = None) -> SolveTicket:
         """Queue one system. ``A`` is a CSR-shaped matrix (csr_array /
         scipy) or, with ``pattern=`` given, a bare ``(nnz,)`` value
         vector over that pattern. ``deadline_s`` is a per-ticket wall
         budget measured from submission: a ticket still queued when its
         deadline passes fails with :class:`TicketDeadlineError` instead
-        of dispatching stale work."""
+        of dispatching stale work. ``tenant`` stamps an optional caller
+        label onto the ticket, its ``batch.ticket`` terminal event and
+        the ``batch.ticket_latency`` histogram labels (ISSUE 11: the
+        fairness dimension; ``None`` keeps every existing metric series
+        name unchanged) — it never enters the compiled program or its
+        plan-cache key."""
         if pattern is None:
             pattern = self.pattern_of(A)
             values = np.asarray(A.data if hasattr(A, "data") else A)
@@ -389,7 +401,7 @@ class SolveSession:
             raise ValueError(
                 f"rhs shape {b.shape} != ({pattern.shape[0]},)"
             )
-        t = SolveTicket(self, deadline_s=deadline_s)
+        t = SolveTicket(self, deadline_s=deadline_s, tenant=tenant)
         q = self._pending.setdefault(id(pattern), [])
         q.append(_Request(pattern, values, b, float(tol), x0, maxiter, t))
         _QUEUE_DEPTH.inc()
@@ -604,9 +616,14 @@ class SolveSession:
         t.t_done = time.monotonic()
         latency_s = t.t_done - t.t_submit
         solver = t.solver or self.solver
+        # tenant-labeled series only exist for tenant-tagged tickets:
+        # the default (None) keeps the pre-existing {solver} series names
+        labels = {"solver": solver}
+        if t.tenant is not None:
+            labels["tenant"] = t.tenant
         _metrics.histogram(
             "batch.ticket_latency", help=_TICKET_LATENCY_HELP,
-            solver=solver,
+            **labels,
         ).observe(latency_s)
         slo_miss = self.slo_ms is not None and latency_s * 1e3 > self.slo_ms
         if slo_miss:
@@ -622,6 +639,8 @@ class SolveSession:
                 "latency_ms": round(latency_s * 1e3, 3),
                 "requeued": t.requeued,
             }
+            if t.tenant is not None:
+                fields["tenant"] = t.tenant
             if t.phase_ms:
                 fields["phases"] = {
                     k: round(v, 3) for k, v in t.phase_ms.items()

@@ -1,0 +1,57 @@
+"""sparse_tpu.loadgen — deterministic traffic generation + load reports.
+
+The active half of the Axon observability stack (ISSUE 11): where
+:mod:`sparse_tpu.telemetry` makes a serving session *explainable*, this
+package makes it *measurable under load* — the sustained-throughput
+question ("how many req/s can this session hold at its p95 SLO?") the
+passive instrumentation cannot answer by itself. Legate Sparse ships a
+task-level profiler for exactly this reason (PAPERS.md §1), and
+Ginkgo's batched work reports throughput-under-load, not single-solve
+latency, as the headline (PAPERS.md §2).
+
+Two pieces:
+
+* :class:`ArrivalTrace` (:mod:`._trace`) — seeded, virtual-clock
+  request schedules: Poisson / bursty / uniform / closed-loop clauses,
+  multi-tenant mixes with fairness weights, a strict spec grammar
+  (``"poisson:rate=100,duration=2,seed=0,tenant=a;burst:..."``). No
+  wall-clock randomness anywhere — the same spec replays bit-identically.
+* :func:`run_load` (:mod:`._run`) — pace a trace onto a live
+  :class:`~sparse_tpu.batch.service.SolveSession` through its real
+  ticket path and produce a :class:`LoadReport`: offered vs achieved
+  req/s, p50/p95/p99 ticket latency, SLO-miss rate, queue-depth and
+  device-occupancy time series sampled from the always-on metrics
+  registry, and a weighted per-tenant fairness index
+  (:func:`fairness_index`).
+
+``bench.py``'s ``sustained_cg`` row and ``scripts/chaos_check.py``
+scenario 8 (loadgen + watchdog alerting under fault injection) are the
+CI consumers; docs/telemetry.md "Axon v5" documents the trace grammar
+and the report fields.
+"""
+
+from __future__ import annotations
+
+from ._run import (  # noqa: F401
+    LoadReport,
+    build_report,
+    fairness_index,
+    run_load,
+)
+from ._trace import (  # noqa: F401
+    Arrival,
+    ArrivalTrace,
+    ClosedClause,
+    LoadSpecError,
+)
+
+__all__ = [
+    "Arrival",
+    "ArrivalTrace",
+    "ClosedClause",
+    "LoadReport",
+    "LoadSpecError",
+    "build_report",
+    "fairness_index",
+    "run_load",
+]

@@ -1,0 +1,331 @@
+"""The load runner: drive a ``SolveSession`` through an arrival trace.
+
+This is the only module in :mod:`sparse_tpu.loadgen` that touches the
+wall clock. :func:`run_load` paces the trace's virtual arrival times
+onto ``time.monotonic`` (scaled by ``time_scale``), submits every
+request through the session's REAL ticket path (``submit`` → queue →
+coalesce → bucketed dispatch → terminal resolution, tenant label and
+all), and assembles a :class:`LoadReport`:
+
+* **offered vs achieved req/s** — what the trace asked for vs what the
+  session completed per wall second;
+* **latency percentiles** — p50/p95/p99/max/mean end-to-end ticket
+  latency (submit → resolved, the same number the ``batch.ticket``
+  terminal events and the always-on ``batch.ticket_latency`` histogram
+  carry);
+* **SLO-miss rate** — per-ticket latency against the session's
+  ``slo_ms`` objective;
+* **queue-depth / device-occupancy time series** — sampled from the
+  always-on metrics registry (``batch.queue_depth``,
+  ``fleet.device_occupancy``) while the trace plays, bounded by
+  decimation so a long run cannot grow without bound;
+* **per-tenant fairness** — a weighted Jain index over achieved
+  per-tenant throughput shares (:func:`fairness_index`).
+
+Report construction is a pure function (:func:`build_report`) over the
+collected outcomes, so the rollup math is unit-testable without a
+session or a clock. With telemetry enabled, a completed run emits one
+``loadgen.trace`` event carrying the trace spec and the headline
+numbers — the record ``scripts/axon_report.py``'s ``load`` rollup
+reads.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+
+from ..telemetry import _metrics, _recorder
+from ._trace import ArrivalTrace
+
+__all__ = ["LoadReport", "build_report", "fairness_index", "run_load"]
+
+#: hard cap on the sampled time series; hitting it decimates 2:1 and
+#: doubles the sampling period (bounded memory for arbitrarily long runs)
+_SAMPLE_CAP = 2048
+
+
+def _percentile(sorted_vals: list, q: float) -> float:
+    """Nearest-rank percentile (same convention as axon_report)."""
+    if not sorted_vals:
+        return 0.0
+    idx = min(int(q * (len(sorted_vals) - 1) + 0.5), len(sorted_vals) - 1)
+    return sorted_vals[idx]
+
+
+def fairness_index(shares: dict) -> float:
+    """Jain's fairness index over weighted shares
+    ``x_i = achieved_i / weight_i``: ``(Σx)² / (n·Σx²)`` ∈ (0, 1], 1 =
+    every tenant got throughput proportional to its weight. Empty or
+    all-zero shares read as perfectly fair (nothing was contested)."""
+    xs = [float(v) for v in shares.values()]
+    n = len(xs)
+    if n == 0:
+        return 1.0
+    s, s2 = sum(xs), sum(x * x for x in xs)
+    if s2 <= 0.0:
+        return 1.0
+    return (s * s) / (n * s2)
+
+
+@dataclass
+class LoadReport:
+    """The result of one load run (JSON-friendly via :meth:`as_dict`)."""
+
+    trace: str
+    arrivals: int
+    completed: int
+    failed: int
+    wall_s: float
+    offered_rps: float
+    achieved_rps: float
+    latency_ms: dict
+    slo_ms: float | None
+    slo_misses: int
+    slo_miss_rate: float
+    tenants: dict
+    fairness: float
+    queue_depth: list = field(default_factory=list)
+    device_occupancy: list = field(default_factory=list)
+    dispatches: int = 0
+    requeued: int = 0
+
+    def as_dict(self) -> dict:
+        return {
+            "trace": self.trace,
+            "arrivals": self.arrivals,
+            "completed": self.completed,
+            "failed": self.failed,
+            "wall_s": self.wall_s,
+            "offered_rps": self.offered_rps,
+            "achieved_rps": self.achieved_rps,
+            "latency_ms": dict(self.latency_ms),
+            "slo_ms": self.slo_ms,
+            "slo_misses": self.slo_misses,
+            "slo_miss_rate": self.slo_miss_rate,
+            "tenants": {k: dict(v) for k, v in self.tenants.items()},
+            "fairness": self.fairness,
+            "queue_depth": list(self.queue_depth),
+            "device_occupancy": list(self.device_occupancy),
+            "dispatches": self.dispatches,
+            "requeued": self.requeued,
+        }
+
+
+def build_report(trace: ArrivalTrace, outcomes, wall_s: float,
+                 slo_ms=None, *, time_scale: float = 1.0,
+                 queue_depth=(), device_occupancy=(),
+                 dispatches: int = 0) -> LoadReport:
+    """Pure rollup of a run: ``outcomes`` is a sequence of
+    ``(tenant, latency_s, ok, requeued)`` tuples (what the runner
+    collected from the resolved tickets). Deterministic for
+    deterministic inputs — the trace spec, counts, per-tenant shares
+    and the fairness index never depend on the clock."""
+    wall_s = max(float(wall_s), 1e-9)
+    lats = sorted(o[1] * 1e3 for o in outcomes if o[2])
+    completed = sum(1 for o in outcomes if o[2])
+    failed = len(outcomes) - completed
+    requeued = sum(1 for o in outcomes if o[3])
+    misses = 0
+    if slo_ms is not None:
+        misses = sum(
+            1 for o in outcomes if o[2] and o[1] * 1e3 > float(slo_ms)
+        )
+    per_tenant: dict = {}
+    for tenant, lat_s, ok, _rq in outcomes:
+        t = per_tenant.setdefault(str(tenant), {
+            "arrivals": 0, "completed": 0, "achieved_rps": 0.0,
+            "weight": float(trace.weights.get(tenant, 1.0)),
+        })
+        t["arrivals"] += 1
+        if ok:
+            t["completed"] += 1
+    shares = {}
+    for tenant, t in per_tenant.items():
+        t["achieved_rps"] = round(t["completed"] / wall_s, 3)
+        shares[tenant] = t["completed"] / max(t["weight"], 1e-12)
+    # offered = the trace's virtual rate mapped to the wall (a pure
+    # closed-loop trace has no timed rate: offered == achieved)
+    if trace.duration > 0 and trace.arrivals:
+        offered = len(trace.arrivals) / (trace.duration * time_scale)
+        # closed clauses ride along at their achieved rate
+        closed_n = sum(c.requests for c in trace.closed)
+        if closed_n:
+            offered += closed_n / wall_s
+    else:
+        offered = completed / wall_s
+    return LoadReport(
+        trace=trace.describe(),
+        arrivals=len(outcomes),
+        completed=completed,
+        failed=failed,
+        wall_s=round(wall_s, 4),
+        offered_rps=round(offered, 3),
+        achieved_rps=round(completed / wall_s, 3),
+        latency_ms={
+            "p50": round(_percentile(lats, 0.50), 3),
+            "p95": round(_percentile(lats, 0.95), 3),
+            "p99": round(_percentile(lats, 0.99), 3),
+            "max": round(lats[-1], 3) if lats else 0.0,
+            "mean": round(sum(lats) / len(lats), 3) if lats else 0.0,
+        },
+        slo_ms=None if slo_ms is None else float(slo_ms),
+        slo_misses=misses,
+        slo_miss_rate=round(misses / completed, 6) if completed else 0.0,
+        tenants=per_tenant,
+        fairness=round(fairness_index(shares), 6),
+        queue_depth=list(queue_depth),
+        device_occupancy=list(device_occupancy),
+        dispatches=dispatches,
+        requeued=requeued,
+    )
+
+
+class _Sampler:
+    """Bounded metrics-registry sampler: queue depth + mean device
+    occupancy at ``period_s`` cadence, decimating 2:1 past the cap."""
+
+    def __init__(self, t0: float, period_s: float):
+        self.t0 = t0
+        self.period = max(float(period_s), 1e-4)
+        self.last = -float("inf")
+        self.queue: list = []
+        self.occ: list = []
+        self._gauge = _metrics.gauge("batch.queue_depth")
+
+    def sample(self) -> None:
+        now = time.monotonic()
+        if now - self.last < self.period:
+            return
+        self.last = now
+        t_rel = round(now - self.t0, 4)
+        self.queue.append((t_rel, self._gauge.value))
+        occ = _metrics.label_values("fleet.device_occupancy", "device")
+        vals = [
+            v for v in occ.values()
+            if isinstance(v, (int, float)) and not isinstance(v, bool)
+        ]
+        if vals:
+            self.occ.append((t_rel, round(sum(vals) / len(vals), 4)))
+        if len(self.queue) > _SAMPLE_CAP:
+            self.queue = self.queue[::2]
+            self.occ = self.occ[::2]
+            self.period *= 2.0
+
+
+def run_load(session, trace: ArrivalTrace, systems, *, pattern=None,
+             tol: float = 1e-8, maxiter=None, time_scale: float = 1.0,
+             coalesce_s: float = 0.01, sample_period_s: float = 0.02,
+             record: bool = True) -> LoadReport:
+    """Drive ``session`` through ``trace`` and return the
+    :class:`LoadReport`.
+
+    ``systems`` is a sequence of ``(A, b)`` pairs cycled over arrivals
+    (with ``pattern=`` given, ``(values, b)`` pairs over that shared
+    pattern — skips per-request fingerprinting). Timed arrivals pace by
+    wall clock (virtual seconds × ``time_scale``); while waiting for a
+    far-off arrival the runner flushes queued work once the remaining
+    wait exceeds ``coalesce_s`` (the microbatching window), and always
+    flushes when the queue reaches ``session.batch_max``. Closed-loop
+    clauses run after the timed phase: ``concurrency`` submissions per
+    flush until their request budget completes.
+
+    Every request goes through the real ticket path — per-ticket
+    latency is ``t_done - t_submit`` exactly as the ``batch.ticket``
+    terminal events record it, and the tenant label rides the ticket
+    (``SolveSession.submit(tenant=...)``).
+    """
+    systems = list(systems)
+    if not systems:
+        raise ValueError("run_load needs at least one (A, b) system")
+    scale = float(time_scale)
+    if not (scale > 0):
+        raise ValueError(f"time_scale={time_scale} must be > 0")
+    t0 = time.monotonic()
+    sampler = _Sampler(t0, sample_period_s)
+    entries: list = []  # (tenant, ticket)
+    idx = 0
+    dispatch0 = session.dispatches
+
+    def submit(tenant: str) -> None:
+        nonlocal idx
+        A, b = systems[idx % len(systems)]
+        idx += 1
+        kw = {"tol": tol, "maxiter": maxiter,
+              "tenant": tenant if tenant else None}
+        if pattern is not None:
+            kw["pattern"] = pattern
+        entries.append((tenant, session.submit(A, b, **kw)))
+
+    # -- timed phase -------------------------------------------------------
+    coalesce = max(float(coalesce_s), 1e-4)
+    for a in trace.arrivals:
+        target = t0 + a.t * scale
+        while True:
+            now = time.monotonic()
+            if now >= target:
+                break
+            if session.pending and target - now > coalesce:
+                session.flush()
+                sampler.sample()
+                continue
+            sampler.sample()
+            time.sleep(min(target - now, coalesce))
+        submit(a.tenant)
+        sampler.sample()
+        if session.pending >= session.batch_max:
+            session.flush()
+            sampler.sample()
+    if session.pending:
+        session.flush()
+        sampler.sample()
+
+    # -- closed-loop phase -------------------------------------------------
+    for c in trace.closed:
+        done = 0
+        while done < c.requests:
+            batch = min(c.concurrency, c.requests - done)
+            start = len(entries)
+            for _ in range(batch):
+                submit(c.tenant)
+            session.flush()
+            sampler.sample()
+            for _tenant, tk in entries[start:]:
+                try:
+                    tk.result()
+                except Exception:  # noqa: BLE001 - failures counted below
+                    pass
+            done += batch
+
+    wall_s = time.monotonic() - t0
+    now = time.monotonic()
+    outcomes = []
+    for tenant, tk in entries:
+        end = tk.t_done if tk.t_done is not None else now
+        outcomes.append(
+            (tenant, max(end - tk.t_submit, 0.0), tk.done, tk.requeued)
+        )
+    rep = build_report(
+        trace, outcomes, wall_s, slo_ms=session.slo_ms,
+        time_scale=scale, queue_depth=sampler.queue,
+        device_occupancy=sampler.occ,
+        dispatches=session.dispatches - dispatch0,
+    )
+    if record:
+        _recorder.record(
+            "loadgen.trace", trace=rep.trace, arrivals=rep.arrivals,
+            completed=rep.completed, failed=rep.failed,
+            wall_s=rep.wall_s, offered_rps=rep.offered_rps,
+            achieved_rps=rep.achieved_rps,
+            p50_ms=rep.latency_ms["p50"], p95_ms=rep.latency_ms["p95"],
+            p99_ms=rep.latency_ms["p99"], slo_ms=rep.slo_ms,
+            slo_miss_rate=rep.slo_miss_rate, fairness=rep.fairness,
+            tenants={
+                k: {"completed": v["completed"],
+                    "achieved_rps": v["achieved_rps"],
+                    "weight": v["weight"]}
+                for k, v in rep.tenants.items()
+            },
+            dispatches=rep.dispatches,
+        )
+    return rep

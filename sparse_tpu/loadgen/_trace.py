@@ -1,0 +1,320 @@
+"""Arrival traces: deterministic, seeded request schedules.
+
+A load test is only evidence if it is reproducible, so every schedule
+here is built from a **virtual clock** and a seeded ``numpy`` Generator
+— no wall-clock randomness anywhere in the library. The same spec +
+seed produces the same arrival times, tenants and ordering on every
+machine, every run (``tests/test_loadgen.py`` pins it); the *runner*
+(:mod:`._run`) is the only place virtual time meets ``time.monotonic``.
+
+Spec grammar (mirrors the ``SPARSE_TPU_FAULTS`` clause style —
+``;``-separated clauses, ``key=value`` options, loud errors on typos)::
+
+    pattern:key=value[,key=value...][;pattern:...]
+
+    poisson:rate=100,duration=2,seed=0          # exponential gaps
+    burst:rate=20,burst_rate=400,period=1,duty=0.25,duration=2,seed=0
+    uniform:rate=50,duration=2                  # evenly spaced
+    closed:concurrency=4,requests=64            # completion-driven
+
+Every timed clause accepts ``tenant=`` (a label stamped onto each
+request — the fairness dimension) and ``weight=`` (the tenant's fair
+share weight, default 1). Multiple clauses merge into one trace sorted
+by virtual time — a mixed-pattern multi-tenant schedule is just
+``poisson:...,tenant=a;burst:...,tenant=b``. ``closed`` clauses have no
+virtual timeline (the next arrival is the previous completion); the
+runner executes them after the timed phase.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+__all__ = [
+    "Arrival",
+    "ArrivalTrace",
+    "ClosedClause",
+    "LoadSpecError",
+]
+
+
+class LoadSpecError(ValueError):
+    """A trace spec clause that does not parse/validate (a typo'd load
+    test must fail loudly, not quietly offer the wrong traffic)."""
+
+
+@dataclass(frozen=True)
+class Arrival:
+    """One scheduled request: virtual arrival time (seconds from trace
+    start) and the tenant label it carries ('' = the default tenant)."""
+
+    t: float
+    tenant: str = ""
+
+
+@dataclass(frozen=True)
+class ClosedClause:
+    """A closed-loop traffic source: keep ``concurrency`` requests in
+    flight until ``requests`` have completed (arrivals are driven by
+    completions, not a clock — the saturation-throughput shape)."""
+
+    concurrency: int
+    requests: int
+    tenant: str = ""
+
+
+class ArrivalTrace:
+    """An immutable request schedule: sorted timed arrivals + closed
+    clauses + per-tenant fairness weights. Build via the classmethods
+    (:meth:`poisson`, :meth:`bursty`, :meth:`uniform`,
+    :meth:`closed_loop`), :meth:`parse`, or ``+`` (merge)."""
+
+    __slots__ = ("arrivals", "duration", "closed", "weights", "spec")
+
+    def __init__(self, arrivals=(), duration: float = 0.0, closed=(),
+                 weights=None, spec: str = ""):
+        self.arrivals = tuple(
+            sorted(arrivals, key=lambda a: (a.t, a.tenant))
+        )
+        self.duration = float(duration)
+        self.closed = tuple(closed)
+        self.weights = dict(weights or {})
+        self.spec = spec
+
+    # -- builders ----------------------------------------------------------
+    @classmethod
+    def poisson(cls, rate: float, duration: float, seed: int = 0,
+                tenant: str = "", weight: float = 1.0) -> "ArrivalTrace":
+        """Poisson arrivals at ``rate`` req/s over ``duration`` virtual
+        seconds (i.i.d. exponential gaps from the seeded generator)."""
+        _check_rate(rate, duration)
+        rng = np.random.default_rng(seed)
+        times = []
+        t = float(rng.exponential(1.0 / rate))
+        while t < duration:
+            times.append(t)
+            t += float(rng.exponential(1.0 / rate))
+        spec = _clause("poisson", rate=rate, duration=duration, seed=seed,
+                       tenant=tenant, weight=weight)
+        return cls([Arrival(t, tenant) for t in times], duration,
+                   weights={tenant: float(weight)}, spec=spec)
+
+    @classmethod
+    def bursty(cls, rate: float, burst_rate: float, period: float,
+               duty: float, duration: float, seed: int = 0,
+               tenant: str = "", weight: float = 1.0) -> "ArrivalTrace":
+        """Piecewise-Poisson bursts: ``burst_rate`` during the first
+        ``duty`` fraction of every ``period``-second window, the base
+        ``rate`` otherwise — the flash-crowd shape a p95 SLO actually
+        meets in production."""
+        _check_rate(rate, duration)
+        if not (burst_rate > 0):
+            raise LoadSpecError(f"burst_rate={burst_rate} must be > 0")
+        if not (period > 0):
+            raise LoadSpecError(f"period={period} must be > 0")
+        if not (0.0 < duty < 1.0):
+            raise LoadSpecError(f"duty={duty} outside (0, 1)")
+        rng = np.random.default_rng(seed)
+        times = []
+        # window edges in virtual time; each sub-interval is Poisson at
+        # its own rate, gaps drawn in order so the schedule is one
+        # deterministic stream
+        edges = [0.0]
+        t = 0.0
+        while t < duration:
+            t += period * duty
+            edges.append(min(t, duration))
+            t = min(t + period * (1.0 - duty), duration + period)
+            edges.append(min(t, duration))
+        for i in range(len(edges) - 1):
+            a, b = edges[i], edges[i + 1]
+            if b <= a:
+                continue
+            r = burst_rate if i % 2 == 0 else rate
+            t = a + float(rng.exponential(1.0 / r))
+            while t < b:
+                times.append(t)
+                t += float(rng.exponential(1.0 / r))
+        spec = _clause("burst", rate=rate, burst_rate=burst_rate,
+                       period=period, duty=duty, duration=duration,
+                       seed=seed, tenant=tenant, weight=weight)
+        return cls([Arrival(t, tenant) for t in times], duration,
+                   weights={tenant: float(weight)}, spec=spec)
+
+    @classmethod
+    def uniform(cls, rate: float, duration: float, tenant: str = "",
+                weight: float = 1.0) -> "ArrivalTrace":
+        """Evenly spaced arrivals (no randomness at all): the baseline
+        schedule for isolating queueing effects from arrival noise."""
+        _check_rate(rate, duration)
+        gap = 1.0 / rate
+        times = []
+        k = 1
+        while k * gap < duration:
+            times.append(k * gap)
+            k += 1
+        spec = _clause("uniform", rate=rate, duration=duration,
+                       tenant=tenant, weight=weight)
+        return cls([Arrival(t, tenant) for t in times], duration,
+                   weights={tenant: float(weight)}, spec=spec)
+
+    @classmethod
+    def closed_loop(cls, concurrency: int, requests: int,
+                    tenant: str = "", weight: float = 1.0) -> "ArrivalTrace":
+        """Closed-loop source: ``concurrency`` in flight until
+        ``requests`` complete (no virtual timeline)."""
+        if int(concurrency) < 1 or int(requests) < 1:
+            raise LoadSpecError(
+                f"closed loop needs concurrency >= 1 and requests >= 1 "
+                f"(got {concurrency}, {requests})"
+            )
+        spec = _clause("closed", concurrency=int(concurrency),
+                       requests=int(requests), tenant=tenant, weight=weight)
+        return cls([], 0.0,
+                   closed=[ClosedClause(int(concurrency), int(requests),
+                                        tenant)],
+                   weights={tenant: float(weight)}, spec=spec)
+
+    # -- combination -------------------------------------------------------
+    def __add__(self, other: "ArrivalTrace") -> "ArrivalTrace":
+        if not isinstance(other, ArrivalTrace):
+            return NotImplemented
+        weights = dict(self.weights)
+        weights.update(other.weights)
+        spec = ";".join(s for s in (self.spec, other.spec) if s)
+        return ArrivalTrace(
+            self.arrivals + other.arrivals,
+            max(self.duration, other.duration),
+            closed=self.closed + other.closed,
+            weights=weights, spec=spec,
+        )
+
+    # -- parsing -----------------------------------------------------------
+    @classmethod
+    def parse(cls, spec: str) -> "ArrivalTrace":
+        """Build a trace from the spec grammar (module docstring).
+        Raises :class:`LoadSpecError` on unknown patterns/keys or
+        malformed values."""
+        trace = None
+        for raw in str(spec).split(";"):
+            raw = raw.strip()
+            if not raw:
+                continue
+            head, _, opts = raw.partition(":")
+            pattern = head.strip().lower()
+            if pattern not in _PATTERNS:
+                raise LoadSpecError(
+                    f"clause {raw!r}: unknown pattern {pattern!r} "
+                    f"(one of {sorted(_PATTERNS)})"
+                )
+            builder, keys = _PATTERNS[pattern]
+            kw: dict = {}
+            for opt in opts.split(","):
+                opt = opt.strip()
+                if not opt:
+                    continue
+                if "=" not in opt:
+                    raise LoadSpecError(
+                        f"clause {raw!r}: option {opt!r} is not key=value"
+                    )
+                k, v = (s.strip() for s in opt.split("=", 1))
+                if k not in keys:
+                    raise LoadSpecError(
+                        f"clause {raw!r}: unknown key {k!r} for "
+                        f"{pattern!r} (one of {sorted(keys)})"
+                    )
+                try:
+                    kw[k] = keys[k](v)
+                except ValueError as e:
+                    raise LoadSpecError(
+                        f"clause {raw!r}: bad value for {k!r}: {v!r}"
+                    ) from e
+            try:
+                piece = builder(**kw)
+            except TypeError as e:
+                raise LoadSpecError(f"clause {raw!r}: {e}") from None
+            trace = piece if trace is None else trace + piece
+        if trace is None:
+            raise LoadSpecError(f"empty trace spec {spec!r}")
+        return trace
+
+    def describe(self) -> str:
+        """The canonical spec string (re-parses to an equal trace)."""
+        return self.spec
+
+    # -- views -------------------------------------------------------------
+    def arrival_times(self) -> np.ndarray:
+        return np.asarray([a.t for a in self.arrivals], dtype=np.float64)
+
+    def tenants(self) -> list:
+        seen = {a.tenant for a in self.arrivals}
+        seen.update(c.tenant for c in self.closed)
+        return sorted(seen)
+
+    def counts(self) -> dict:
+        """Scheduled requests per tenant (timed + closed)."""
+        out: dict = {}
+        for a in self.arrivals:
+            out[a.tenant] = out.get(a.tenant, 0) + 1
+        for c in self.closed:
+            out[c.tenant] = out.get(c.tenant, 0) + c.requests
+        return out
+
+    def __len__(self) -> int:
+        return len(self.arrivals) + sum(c.requests for c in self.closed)
+
+    @property
+    def offered_rps(self) -> float:
+        """Timed offered rate in *virtual* req/s (0 for pure closed-loop
+        traces — their offered rate is whatever completes)."""
+        if self.duration <= 0 or not self.arrivals:
+            return 0.0
+        return len(self.arrivals) / self.duration
+
+    def __repr__(self) -> str:
+        return (
+            f"ArrivalTrace({len(self.arrivals)} timed"
+            + (f" + {sum(c.requests for c in self.closed)} closed"
+               if self.closed else "")
+            + f", duration={self.duration:g}s, "
+            f"tenants={self.tenants()})"
+        )
+
+
+def _check_rate(rate, duration) -> None:
+    if not (rate > 0):
+        raise LoadSpecError(f"rate={rate} must be > 0")
+    if not (duration > 0):
+        raise LoadSpecError(f"duration={duration} must be > 0")
+
+
+def _clause(pattern: str, **kw) -> str:
+    parts = []
+    for k, v in kw.items():
+        if k == "tenant" and not v:
+            continue
+        if k == "weight" and float(v) == 1.0:
+            continue
+        parts.append(f"{k}={v:g}" if isinstance(v, float) else f"{k}={v}")
+    return f"{pattern}:" + ",".join(parts)
+
+
+#: pattern -> (builder, {key: coercion}) for :meth:`ArrivalTrace.parse`
+_PATTERNS = {
+    "poisson": (ArrivalTrace.poisson, {
+        "rate": float, "duration": float, "seed": int,
+        "tenant": str, "weight": float,
+    }),
+    "burst": (ArrivalTrace.bursty, {
+        "rate": float, "burst_rate": float, "period": float, "duty": float,
+        "duration": float, "seed": int, "tenant": str, "weight": float,
+    }),
+    "uniform": (ArrivalTrace.uniform, {
+        "rate": float, "duration": float, "tenant": str, "weight": float,
+    }),
+    "closed": (ArrivalTrace.closed_loop, {
+        "concurrency": int, "requests": int, "tenant": str, "weight": float,
+    }),
+}

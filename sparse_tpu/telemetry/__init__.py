@@ -27,8 +27,17 @@ Surface
 * :func:`serve` — the live serving exporter (:mod:`._serve`): a
   daemon-threaded stdlib HTTP server (OFF until called) exposing
   ``/metrics`` (Prometheus text), ``/healthz`` (anomalies, failover
-  latches, fault-injection state) and ``/session`` (queue depth, ticket
-  states, program attribution); ``scripts/axon_serve.py`` is the CLI.
+  latches, fault-injection state), ``/session`` (queue depth, ticket
+  states, program attribution) and ``/alerts`` (the watchdog's rule
+  states); ``scripts/axon_serve.py`` is the CLI.
+* :func:`watchdog` / :func:`watchdog_state` / :func:`stop_watchdog` —
+  the SLO watchdog (:mod:`._watchdog`): declarative rules (SLO-miss
+  rate, anomaly rate, queue saturation, occupancy floor, vault
+  quarantines, failover latches) with hysteresis + cooldown, evaluated
+  on a monotonic tick or on demand, emitting ``watchdog.alert`` /
+  ``watchdog.clear`` events and the always-on
+  ``watchdog.alerts{rule,severity}`` counter.
+  :mod:`sparse_tpu.loadgen` is the traffic source that exercises it.
 * :func:`ticket_scope` / :func:`new_ticket_id` /
   :func:`current_tickets` — request-scoped trace context
   (:mod:`._context`): events recorded inside a scope carry the
@@ -90,6 +99,8 @@ from ._recorder import (  # noqa: F401
 from ._recorder import reset as _reset_recorder
 from ._serve import AxonServer, serve, serving, stop_serving  # noqa: F401
 from ._spans import Span, device_sync, span  # noqa: F401
+from ._watchdog import Rule, Watchdog, stop_watchdog, watchdog  # noqa: F401
+from ._watchdog import state as watchdog_state  # noqa: F401
 from ._summary import summary  # noqa: F401
 from ._trace import export_trace, to_chrome_trace  # noqa: F401
 
@@ -138,7 +149,12 @@ __all__ = [
     "span",
     "Span",
     "stop_serving",
+    "stop_watchdog",
     "summary",
     "ticket_scope",
     "to_chrome_trace",
+    "Rule",
+    "Watchdog",
+    "watchdog",
+    "watchdog_state",
 ]

@@ -205,6 +205,14 @@ def histogram(name: str, /, help=None, **labels) -> Histogram:
     return _get(Histogram, name, labels, help=help)
 
 
+def family(name: str) -> list:
+    """Every metric object registered under ``name`` (all label sets) —
+    the readback windowed consumers (the SLO watchdog) aggregate over,
+    e.g. total ticket count across per-solver/tenant latency histograms."""
+    with _LOCK:
+        return [m for (n, _), m in _REGISTRY.items() if n == name]
+
+
 def label_values(name: str, label: str) -> dict:
     """``{label_value: metric_value}`` over a family — the readback the
     recorder's ``counters()``/``bytes_by_kind()`` use."""

@@ -121,6 +121,17 @@ KINDS: dict[str, frozenset] = {
     # a SolveSession replayed the warm-start manifest on construction:
     # entries read, programs successfully replayed
     "vault.replay": frozenset({"entries", "programs"}),
+    # -- loadgen / watchdog (sparse_tpu.loadgen, telemetry/_watchdog.py) ----
+    # one completed load run: the canonical trace spec, arrival count,
+    # offered/achieved req/s, latency percentiles, SLO-miss rate and the
+    # weighted fairness index — what axon_report's `load` rollup reads
+    "loadgen.trace": frozenset({"trace", "arrivals"}),
+    # a watchdog rule transitioned ok -> firing: the rule name, its
+    # severity, the sampled value and the trigger threshold it breached
+    "watchdog.alert": frozenset({"rule", "severity"}),
+    # the matching firing -> ok transition (hysteresis satisfied), with
+    # the clearing value and how long the alert was active
+    "watchdog.clear": frozenset({"rule"}),
     # -- generic ------------------------------------------------------------
     # one per process per sink file, written before the first event: the
     # controller's identity (process_index/pid/process_count, device

@@ -15,6 +15,16 @@ serving operator scrapes:
 * ``/session`` — the live serving picture as JSON: queue depth, bucket
   occupancy, per-session ticket states (``batch.SolveSession``'s weak
   registry), and the compiled-program attribution table.
+* ``/alerts`` — the SLO watchdog's rule states (:mod:`._watchdog`):
+  per-rule state/value/thresholds, the currently-firing set, tick
+  count. A disabled stub when no watchdog exists; the active set is
+  also summarized on ``/healthz``.
+
+Port robustness (ISSUE 11 satellite): the listener binds with
+``SO_REUSEADDR`` and, when the requested port is already taken (the CI
+rerun race), falls back to an ephemeral port instead of raising —
+``AxonServer.port`` is always the port actually bound, and
+``scripts/axon_serve.py`` prints it.
 
 Bounded overhead by construction: every handler reads in-memory state
 under the registry locks (no device touch, no event emission, no
@@ -30,7 +40,7 @@ import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
-from . import _health, _metrics, _recorder
+from . import _health, _metrics, _recorder, _watchdog
 
 _LOCK = threading.Lock()
 _SERVER = None
@@ -99,7 +109,9 @@ def _healthz() -> dict:
         }
     except Exception:
         pass  # health must answer even mid-teardown
-    degraded = bool(latches) or bool(anomalies)
+    wd = _watchdog.state()
+    active_alerts = list(wd.get("active") or ())
+    degraded = bool(latches) or bool(anomalies) or bool(active_alerts)
     return {
         "status": "degraded" if degraded else "ok",
         "uptime_s": round(time.monotonic() - (_SERVER.t0 if _SERVER else 0), 3)
@@ -108,6 +120,12 @@ def _healthz() -> dict:
         "last_solve_anomalies": anomalies,
         "failover_latches": latches,
         "faults": faults_status,
+        # the watchdog's firing set (ISSUE 11): /alerts has the detail
+        "alerts": {
+            "enabled": bool(wd.get("enabled")),
+            "active": active_alerts,
+            "count": len(active_alerts),
+        },
     }
 
 
@@ -168,10 +186,13 @@ class _Handler(BaseHTTPRequestHandler):
                 self._send_json(_healthz())
             elif path == "/session":
                 self._send_json(_session())
+            elif path == "/alerts":
+                self._send_json(_watchdog.state())
             elif path == "/":
                 self._send(
                     200,
-                    b"sparse_tpu axon exporter: /metrics /healthz /session\n",
+                    b"sparse_tpu axon exporter: "
+                    b"/metrics /healthz /session /alerts\n",
                     "text/plain; charset=utf-8",
                 )
             else:
@@ -185,15 +206,35 @@ class _Handler(BaseHTTPRequestHandler):
                 pass
 
 
+class _Server(ThreadingHTTPServer):
+    # SO_REUSEADDR, explicitly: CI reruns rebind the same port while the
+    # previous listener's socket lingers in TIME_WAIT (ISSUE 11 satellite)
+    allow_reuse_address = True
+    daemon_threads = True
+
+
 class AxonServer:
     """Handle for a running exporter; ``stop()`` (or context-manager
-    exit) shuts the listener down and joins the daemon thread."""
+    exit) shuts the listener down and joins the daemon thread.
+
+    ``port`` is always the port actually bound; when the requested port
+    was taken the listener fell back to an ephemeral one and
+    ``fallback`` is True (``requested_port`` keeps the ask)."""
 
     def __init__(self, host: str, port: int):
-        self._httpd = ThreadingHTTPServer((host, port), _Handler)
-        self._httpd.daemon_threads = True
+        self.requested_port = int(port)
+        try:
+            self._httpd = _Server((host, port), _Handler)
+        except OSError:
+            if not port:
+                raise  # an ephemeral bind failing is a real error
+            # port in use (a parallel test run, a lingering exporter):
+            # serve on an ephemeral port instead of raising — the caller
+            # reads the real port back from the handle
+            self._httpd = _Server((host, 0), _Handler)
         self.host = host
         self.port = int(self._httpd.server_address[1])
+        self.fallback = bool(port) and self.port != self.requested_port
         self.t0 = time.monotonic()
         self._thread = threading.Thread(
             target=self._httpd.serve_forever,

@@ -1,0 +1,469 @@
+"""SLO watchdog: an in-process rule engine over the always-on metrics.
+
+The passive Axon surfaces (events, metrics, reports) answer operator
+questions *when asked*; this module asks them continuously. A
+:class:`Watchdog` evaluates declarative :class:`Rule`\\ s — SLO-miss
+rate, anomaly rate, queue-depth saturation, device-occupancy floor,
+vault quarantines, failover latches — against the always-on metrics
+registry (:mod:`._metrics`) and the resilience latch state, on a
+monotonic tick (``start()`` runs a daemon thread) and on demand
+(``evaluate()``).
+
+Rule semantics (docs/telemetry.md "Axon v5" has the operator table):
+
+* **trigger / clear with hysteresis** — a rule fires when its value
+  breaches ``trigger`` (direction per ``op``) for ``for_ticks``
+  consecutive ticks, and clears only when the value is back on the good
+  side of ``clear`` (a separate, less sensitive threshold) for
+  ``clear_ticks`` ticks — so a level oscillating around the trigger
+  produces ONE alert, not a flap storm.
+* **cooldown** — after a clear, re-alerting is suppressed for
+  ``cooldown_s`` seconds even if the trigger condition returns.
+* **windowed rates** — the ``*_rate`` rule factories read counter
+  *deltas* between ticks (the registry's counters are cumulative), and
+  return ``None`` (skip the tick, streaks untouched) when the
+  denominator didn't move — an idle session never alerts or clears on
+  stale data.
+
+Every alert transition bumps the always-on
+``watchdog.alerts{rule,severity}`` counter and (telemetry enabled)
+emits a ``watchdog.alert`` event; clears emit ``watchdog.clear``. The
+live exporter's ``/alerts`` endpoint (:mod:`._serve`) serves
+:func:`state`, and ``/healthz`` summarizes the active set.
+
+Zero overhead by default: nothing ticks until a :class:`Watchdog` is
+constructed, the engine only READS registry values (no device touch, no
+dispatch-path hook anywhere), and with no watchdog :func:`state` is a
+constant dict — the dispatch path's traces and host-sync counts are
+untouched (pinned alongside the loadgen tests).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from . import _metrics, _recorder
+
+__all__ = [
+    "Rule",
+    "Watchdog",
+    "anomaly_rate_rule",
+    "current",
+    "default_rules",
+    "device_occupancy_rule",
+    "failover_rule",
+    "queue_depth_rule",
+    "slo_miss_rate_rule",
+    "state",
+    "stop_watchdog",
+    "vault_quarantine_rule",
+    "watchdog",
+]
+
+_OPS = (">", "<")
+
+
+class Rule:
+    """One declarative alert rule: a sampled ``value`` callable plus the
+    trigger/clear thresholds and flap-control knobs (module docstring).
+    ``value()`` returning ``None`` skips the tick entirely."""
+
+    __slots__ = ("name", "severity", "value", "trigger", "clear", "op",
+                 "for_ticks", "clear_ticks", "cooldown_s")
+
+    def __init__(self, name: str, value, trigger: float, *,
+                 clear: float | None = None, op: str = ">",
+                 severity: str = "warn", for_ticks: int = 1,
+                 clear_ticks: int = 1, cooldown_s: float = 0.0):
+        if op not in _OPS:
+            raise ValueError(f"op must be one of {_OPS}, got {op!r}")
+        self.name = str(name)
+        self.severity = str(severity)
+        self.value = value
+        self.trigger = float(trigger)
+        self.clear = self.trigger if clear is None else float(clear)
+        self.op = op
+        self.for_ticks = max(int(for_ticks), 1)
+        self.clear_ticks = max(int(clear_ticks), 1)
+        self.cooldown_s = max(float(cooldown_s), 0.0)
+
+    def breached(self, v: float) -> bool:
+        return v > self.trigger if self.op == ">" else v < self.trigger
+
+    def cleared(self, v: float) -> bool:
+        """On the good side of the *clear* threshold (hysteresis: for
+        ``op='>'`` that is ``v <= clear``; for ``op='<'``,
+        ``v >= clear``)."""
+        return not (v > self.clear if self.op == ">" else v < self.clear)
+
+
+class _RuleState:
+    __slots__ = ("rule", "state", "streak", "clear_streak", "since",
+                 "last_value", "alerts", "last_clear")
+
+    def __init__(self, rule: Rule):
+        self.rule = rule
+        self.state = "ok"
+        self.streak = 0
+        self.clear_streak = 0
+        self.since = None  # monotonic instant the current alert began
+        self.last_value = None
+        self.alerts = 0
+        self.last_clear = None
+
+
+class Watchdog:
+    """The rule engine. Construct with a rule list (default:
+    :func:`default_rules`), then either ``start()`` the monotonic tick
+    thread or call ``evaluate()`` on demand (chaos drivers and tests do
+    the latter for determinism)."""
+
+    def __init__(self, rules=None, interval_s: float = 1.0):
+        rules = list(rules) if rules is not None else default_rules()
+        names = [r.name for r in rules]
+        if len(set(names)) != len(names):
+            raise ValueError(f"duplicate rule names in {names}")
+        self.interval_s = max(float(interval_s), 0.01)
+        self.ticks = 0
+        self.t0 = time.monotonic()
+        self._states = {r.name: _RuleState(r) for r in rules}
+        self._lock = threading.Lock()
+        self._thread = None
+        self._stop = threading.Event()
+
+    # -- evaluation --------------------------------------------------------
+    def evaluate(self, now: float | None = None) -> list:
+        """One tick over every rule; returns the transitions this tick
+        caused (``[{"event": "alert"|"clear", "rule": ..., ...}]``).
+        ``now`` (a monotonic instant) is injectable for tests."""
+        now = time.monotonic() if now is None else float(now)
+        transitions = []
+        with self._lock:
+            self.ticks += 1
+            for st in self._states.values():
+                r = st.rule
+                try:
+                    v = r.value()
+                except Exception:  # noqa: BLE001 - a rule never kills the tick
+                    v = None
+                if v is None:
+                    continue
+                v = float(v)
+                st.last_value = v
+                if st.state == "ok":
+                    if r.breached(v):
+                        st.streak += 1
+                        in_cooldown = (
+                            st.last_clear is not None
+                            and now - st.last_clear < r.cooldown_s
+                        )
+                        if st.streak >= r.for_ticks and not in_cooldown:
+                            st.state = "firing"
+                            st.since = now
+                            st.alerts += 1
+                            st.clear_streak = 0
+                            transitions.append(self._alert(r, v))
+                    else:
+                        st.streak = 0
+                else:  # firing
+                    if r.cleared(v):
+                        st.clear_streak += 1
+                        if st.clear_streak >= r.clear_ticks:
+                            active_s = now - (st.since or now)
+                            st.state = "ok"
+                            st.streak = 0
+                            st.clear_streak = 0
+                            st.since = None
+                            st.last_clear = now
+                            transitions.append(
+                                self._clear(r, v, active_s)
+                            )
+                    else:
+                        st.clear_streak = 0
+        return transitions
+
+    def _alert(self, r: Rule, v: float) -> dict:
+        _metrics.counter(
+            "watchdog.alerts",
+            help="watchdog rule alert transitions (rule, severity labels)",
+            rule=r.name, severity=r.severity,
+        ).inc()
+        _recorder.record(
+            "watchdog.alert", rule=r.name, severity=r.severity,
+            value=round(v, 6), trigger=r.trigger, op=r.op,
+        )
+        return {"event": "alert", "rule": r.name, "severity": r.severity,
+                "value": v}
+
+    def _clear(self, r: Rule, v: float, active_s: float) -> dict:
+        _metrics.counter(
+            "watchdog.clears",
+            help="watchdog rule clear transitions",
+            rule=r.name,
+        ).inc()
+        _recorder.record(
+            "watchdog.clear", rule=r.name, value=round(v, 6),
+            active_s=round(active_s, 3),
+        )
+        return {"event": "clear", "rule": r.name, "active_s": active_s}
+
+    # -- views -------------------------------------------------------------
+    def active(self) -> list:
+        """Names of currently-firing rules."""
+        with self._lock:
+            return sorted(
+                n for n, st in self._states.items() if st.state == "firing"
+            )
+
+    def state(self) -> dict:
+        """JSON-friendly engine state (the ``/alerts`` payload)."""
+        now = time.monotonic()
+        with self._lock:
+            rules = []
+            for st in self._states.values():
+                r = st.rule
+                row = {
+                    "name": r.name,
+                    "severity": r.severity,
+                    "state": st.state,
+                    "value": st.last_value,
+                    "trigger": r.trigger,
+                    "clear": r.clear,
+                    "op": r.op,
+                    "alerts": st.alerts,
+                }
+                if st.state == "firing" and st.since is not None:
+                    row["active_s"] = round(now - st.since, 3)
+                rules.append(row)
+            return {
+                "enabled": True,
+                "running": bool(self._thread and self._thread.is_alive()),
+                "interval_s": self.interval_s,
+                "ticks": self.ticks,
+                "active": sorted(
+                    n for n, st in self._states.items()
+                    if st.state == "firing"
+                ),
+                "rules": rules,
+            }
+
+    # -- the monotonic tick thread ----------------------------------------
+    def start(self) -> "Watchdog":
+        """Begin ticking on a daemon thread (idempotent)."""
+        with self._lock:
+            if self._thread is not None and self._thread.is_alive():
+                return self
+            self._stop.clear()
+            self._thread = threading.Thread(
+                target=self._loop, name="sparse-tpu-axon-watchdog",
+                daemon=True,
+            )
+            self._thread.start()
+        return self
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            try:
+                self.evaluate()
+            except Exception:  # noqa: BLE001 - the tick must survive
+                pass
+
+    def stop(self) -> None:
+        self._stop.set()
+        t = self._thread
+        if t is not None:
+            t.join(timeout=5)
+        self._thread = None
+
+
+# ---------------------------------------------------------------------------
+# rule factories (the default vocabulary; thresholds overridable)
+# ---------------------------------------------------------------------------
+def _windowed_rate(read_num, read_den, min_den: int = 1):
+    """A value callable computing per-window ``Δnum/Δden`` from two
+    cumulative readers; ``None`` until the denominator moved by at
+    least ``min_den`` (idle windows neither alert nor clear)."""
+    snap = {"num": None, "den": None}
+
+    def value():
+        num, den = float(read_num()), float(read_den())
+        if snap["num"] is None:
+            snap["num"], snap["den"] = num, den
+            return None
+        dn, dd = num - snap["num"], den - snap["den"]
+        snap["num"], snap["den"] = num, den
+        if dd < min_den:
+            return None
+        return dn / dd
+
+    return value
+
+
+def _windowed_delta(read):
+    """A value callable computing the per-window delta of one cumulative
+    reader (``None`` on the priming tick)."""
+    snap = {"v": None}
+
+    def value():
+        v = float(read())
+        if snap["v"] is None:
+            snap["v"] = v
+            return None
+        dv, snap["v"] = v - snap["v"], v
+        return dv
+
+    return value
+
+
+def slo_miss_rate_rule(trigger: float = 0.5, clear: float = 0.1,
+                       severity: str = "page", min_tickets: int = 1,
+                       **kw) -> Rule:
+    """Fraction of the window's resolved tickets that missed the session
+    SLO (``batch.slo_misses`` over the ``batch.ticket_latency`` family's
+    total observations). The headline serving alert."""
+    return Rule(
+        "slo_miss_rate",
+        _windowed_rate(
+            lambda: _metrics.counter("batch.slo_misses").value,
+            lambda: sum(
+                h.count for h in _metrics.family("batch.ticket_latency")
+            ),
+            min_den=min_tickets,
+        ),
+        trigger, clear=clear, op=">", severity=severity, **kw)
+
+
+def anomaly_rate_rule(trigger: float = 0.0, clear: float = 0.0,
+                      severity: str = "warn", **kw) -> Rule:
+    """Solver anomalies (nonfinite/divergence/stagnation/breakdown)
+    detected this window — any at all is worth an operator's look."""
+    return Rule(
+        "anomaly_rate",
+        _windowed_delta(
+            lambda: _metrics.counter("solver.anomalies").value
+        ),
+        trigger, clear=clear, op=">", severity=severity, **kw)
+
+
+def queue_depth_rule(trigger: float = 512.0, clear: float | None = None,
+                     severity: str = "warn", **kw) -> Rule:
+    """Queued-request depth saturation (the ``batch.queue_depth``
+    gauge): sustained depth past the trigger means arrivals outrun
+    dispatch capacity."""
+    return Rule(
+        "queue_depth",
+        lambda: _metrics.gauge("batch.queue_depth").value,
+        trigger, clear=(trigger / 2.0 if clear is None else clear),
+        op=">", severity=severity, **kw)
+
+
+def device_occupancy_rule(floor: float = 0.25, clear: float = 0.5,
+                          severity: str = "warn", **kw) -> Rule:
+    """Mean per-device real-lane occupancy floor
+    (``fleet.device_occupancy``), evaluated only in windows where
+    dispatches actually advanced — an idle mesh is not an underutilized
+    one."""
+    disp = _windowed_delta(
+        lambda: _metrics.counter("batch.dispatches").value
+    )
+
+    def value():
+        moved = disp()
+        if not moved:  # None (priming) or 0 dispatches this window
+            return None
+        occ = _metrics.label_values("fleet.device_occupancy", "device")
+        vals = [
+            v for v in occ.values()
+            if isinstance(v, (int, float)) and not isinstance(v, bool)
+        ]
+        if not vals:
+            return None
+        return sum(vals) / len(vals)
+
+    return Rule("device_occupancy", value, floor, clear=clear, op="<",
+                severity=severity, **kw)
+
+
+def vault_quarantine_rule(trigger: float = 0.0, severity: str = "warn",
+                          **kw) -> Rule:
+    """Vault artifacts quarantined this window (``vault.quarantined``):
+    disk-tier corruption is being detected — check ``quarantine/``."""
+    return Rule(
+        "vault_quarantine",
+        _windowed_delta(
+            lambda: _metrics.counter("vault.quarantined").value
+        ),
+        trigger, op=">", severity=severity, **kw)
+
+
+def failover_rule(severity: str = "page", **kw) -> Rule:
+    """Latched Pallas→XLA kernel failovers (the resilience registry):
+    fires while any kernel is serving on its fallback formulation and
+    clears when a probe reinstates it."""
+
+    def value():
+        try:
+            from ..resilience import failover
+
+            return float(len(failover.latches()))
+        except Exception:  # noqa: BLE001 - no resilience import yet
+            return None
+
+    return Rule("failover_latched", value, 0.0, op=">",
+                severity=severity, **kw)
+
+
+def default_rules() -> list:
+    """The stock rule set (each factory's defaults; see the rule
+    reference table in docs/telemetry.md)."""
+    return [
+        slo_miss_rate_rule(),
+        anomaly_rate_rule(),
+        queue_depth_rule(),
+        device_occupancy_rule(),
+        vault_quarantine_rule(),
+        failover_rule(),
+    ]
+
+
+# ---------------------------------------------------------------------------
+# the process singleton (what /alerts serves)
+# ---------------------------------------------------------------------------
+_LOCK = threading.Lock()
+_WATCHDOG: Watchdog | None = None
+
+
+def watchdog(rules=None, interval_s: float = 1.0) -> Watchdog:
+    """Get-or-create the process watchdog (``telemetry.watchdog()``).
+    An existing instance is returned as-is — stop it first to change
+    rules. The instance does NOT tick until ``start()``."""
+    global _WATCHDOG
+    with _LOCK:
+        if _WATCHDOG is None:
+            _WATCHDOG = Watchdog(rules=rules, interval_s=interval_s)
+        return _WATCHDOG
+
+
+def current() -> Watchdog | None:
+    """The live process watchdog, or ``None``."""
+    return _WATCHDOG
+
+
+def state() -> dict:
+    """The ``/alerts`` payload: the process watchdog's state, or a
+    disabled stub when none exists."""
+    wd = _WATCHDOG
+    if wd is None:
+        return {"enabled": False, "running": False, "active": [],
+                "rules": []}
+    return wd.state()
+
+
+def stop_watchdog() -> None:
+    """Stop and drop the process watchdog (idempotent)."""
+    global _WATCHDOG
+    with _LOCK:
+        wd, _WATCHDOG = _WATCHDOG, None
+    if wd is not None:
+        wd.stop()

@@ -67,6 +67,7 @@ _QUICK_FILES = {
     "test_fleet.py",
     "test_grid2d.py",
     "test_io.py",
+    "test_loadgen.py",
     "test_multigrid.py",
     "test_plan_cache.py",
     "test_quantum.py",

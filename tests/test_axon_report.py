@@ -332,3 +332,141 @@ def test_axon_serve_bad_usage_exits_2(capsys):
     mod = _load("axon_serve")
     assert mod.main(["--port", "nope"]) == 2
     assert mod.main(["--bogus"]) == 2
+
+
+# -- Axon v5: load/alerts rollups, sustained_cg lift, informational compare ---
+
+
+def _write_v5_records(path, ts0=1700000000.0):
+    """A synthetic Axon v5 session: one loadgen run, one watchdog
+    alert->clear chain plus one unresolved alert, and a bench.session
+    embedding a sustained_cg row."""
+    ts = ts0
+    lines = [
+        {"kind": "loadgen.trace", "ts": ts,
+         "trace": "poisson:rate=150,duration=1.5,seed=23",
+         "arrivals": 220, "completed": 218, "failed": 2, "wall_s": 1.62,
+         "offered_rps": 146.7, "achieved_rps": 134.6, "p50_ms": 18.0,
+         "p95_ms": 42.0, "p99_ms": 88.0, "slo_ms": 250.0,
+         "slo_miss_rate": 0.009, "fairness": 0.98, "dispatches": 40,
+         "tenants": {"a": {"completed": 109, "achieved_rps": 67.3,
+                           "weight": 1.0},
+                     "b": {"completed": 109, "achieved_rps": 67.3,
+                           "weight": 1.0}}},
+        {"kind": "watchdog.alert", "ts": ts + 0.5, "rule": "slo_miss_rate",
+         "severity": "page", "value": 0.8, "trigger": 0.5, "op": ">"},
+        {"kind": "watchdog.clear", "ts": ts + 1.0, "rule": "slo_miss_rate",
+         "value": 0.0, "active_s": 0.5},
+        {"kind": "watchdog.alert", "ts": ts + 1.2, "rule": "queue_depth",
+         "severity": "warn", "value": 900.0, "trigger": 512.0, "op": ">"},
+        {"kind": "bench.session", "ts": ts + 2.0, "status": "cpu",
+         "record": {"metric": "cg_iters_per_s_pde512_cpu", "value": 100.0,
+                    "unit": "iters/s",
+                    "sustained_cg": {"offered_rps": 146.7,
+                                     "achieved_rps": 134.6,
+                                     "p50_ms": 18.0, "p95_ms": 42.0,
+                                     "p99_ms": 88.0, "slo_ms": 250.0,
+                                     "slo_miss_rate": 0.009,
+                                     "p95_under_slo": True}}},
+    ]
+    with open(path, "w") as f:
+        for rec in lines:
+            f.write(json.dumps(rec) + "\n")
+    return path
+
+
+def test_v5_kinds_schema_valid(tmp_path):
+    rec = _write_v5_records(str(tmp_path / "v5.jsonl"))
+    from sparse_tpu import telemetry
+
+    assert telemetry.schema.validate_jsonl(rec) == []
+
+
+def test_report_load_alerts_and_sustained_rollups(tmp_path):
+    rec = _write_v5_records(str(tmp_path / "v5.jsonl"))
+    mod = _load("axon_report")
+    rep = mod.build_report(rec)
+
+    load = rep["load"]
+    assert load["runs"] == 1
+    assert load["last"]["achieved_rps"] == 134.6
+    assert load["last"]["tenants"]["a"]["completed"] == 109
+
+    al = rep["alerts"]
+    assert al["fired"] == 2 and al["cleared"] == 1
+    assert al["by_rule"]["slo_miss_rate"]["last"] == "clear"
+    assert al["unresolved"] == ["queue_depth"]
+
+    assert rep["sustained_row"]["p95_under_slo"] is True
+
+    m = rep["metrics"]
+    assert m["load.achieved_rps"] == {"v": 134.6, "hib": True}
+    assert m["load.p95_ms"]["hib"] is False
+    assert m["load.fairness"]["hib"] is True
+    assert m["alerts.fired"] == {"v": 2, "hib": False}
+    assert m["sustained_cg.achieved_rps"] == {"v": 134.6, "hib": True}
+    assert m["sustained_cg.p95_ms"] == {"v": 42.0, "hib": False}
+    assert m["sustained_cg.slo_miss_rate"]["hib"] is False
+
+    # the CLI renders the new sections and writes them to --json
+    out_json = str(tmp_path / "v5.json")
+    assert mod.main([rec, "--json", out_json, "--quiet"]) == 0
+    dumped = json.load(open(out_json))
+    assert dumped["load"]["runs"] == 1
+    assert dumped["alerts"]["unresolved"] == ["queue_depth"]
+
+
+def test_compare_treats_one_sided_metrics_as_informational(tmp_path, capsys):
+    """ISSUE 11 satellite: a metric missing from the baseline (a new
+    bench row like sustained_cg) is LISTED, never a regression — and a
+    vanished metric is surfaced the same way."""
+    mod = _load("axon_report")
+    base_rec = _write_records(str(tmp_path / "base.jsonl"), [0.010] * 8)
+    base_json = str(tmp_path / "base.json")
+    assert mod.main([base_rec, "--quiet", "--json", base_json]) == 0
+    # the current run gains sustained_cg/load metrics the baseline
+    # predates (plus all the v5 kinds)
+    cur = _write_v5_records(str(tmp_path / "cur.jsonl"))
+    capsys.readouterr()
+    rc = mod.main([cur, "--compare", base_json])
+    out = capsys.readouterr()
+    assert rc == 0, "new-only metrics must not gate"
+    assert "informational" in out.out
+    assert "sustained_cg.achieved_rps" in out.out or "..." in out.out
+    # ...and the reverse direction (baseline has rows this run lost)
+    cur_json = str(tmp_path / "cur.json")
+    assert mod.main([cur, "--quiet", "--json", cur_json]) == 0
+    capsys.readouterr()
+    rc = mod.main([base_rec, "--compare", cur_json])
+    out = capsys.readouterr()
+    assert rc == 0
+    assert "missing from this run (informational)" in out.out
+
+    info = mod.informational(
+        mod.build_report(cur), json.load(open(base_json))
+    )
+    assert "sustained_cg.achieved_rps" in info["new"]
+    assert "span.bench.step.p50_s" in info["vanished"]
+
+
+def test_axon_serve_once_prints_bound_port_on_busy_port(capsys):
+    """ISSUE 11 satellite: a taken port falls back to an ephemeral bind
+    and the CLI prints the port that actually answers."""
+    import socket
+
+    blocker = socket.socket()
+    blocker.bind(("127.0.0.1", 0))
+    blocker.listen(1)
+    busy = blocker.getsockname()[1]
+    try:
+        assert _load("axon_serve").main(["--once", "--port", str(busy)]) == 0
+    finally:
+        blocker.close()
+    out = capsys.readouterr().out
+    assert f"(requested {busy} busy)" in out
+    bound = [
+        ln for ln in out.splitlines()
+        if ln.startswith("axon_serve: bound port ")
+    ]
+    assert bound and str(busy) != bound[0].split()[3]
+    assert "/alerts: " in out

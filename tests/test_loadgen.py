@@ -1,0 +1,283 @@
+"""sparse_tpu.loadgen — deterministic traffic generation + load reports
+(ISSUE 11).
+
+Pins the contract pillars: (a) seeded determinism — the same spec +
+seed produces the identical arrival schedule (virtual clock, no
+wall-clock randomness in-library) and the deterministic report fields
+match run to run; (b) the spec grammar fails loudly on typos; (c) the
+runner drives a real ``SolveSession`` through its actual ticket path
+(tenant labels included) and the report's accounting adds up; (d) the
+weighted fairness index behaves (equal shares = 1, starvation < 1,
+weights normalize); (e) the tenant satellite changes NOTHING on the
+dispatch path — program keys and jaxprs are identical with and without
+a tenant label, and the default metric series names are unchanged.
+"""
+
+import json
+
+import jax
+import numpy as np
+import pytest
+import scipy.sparse as sp
+
+from sparse_tpu import loadgen, telemetry
+from sparse_tpu.batch import SolveSession
+from sparse_tpu.config import settings
+from sparse_tpu.loadgen import ArrivalTrace, LoadSpecError
+
+
+@pytest.fixture
+def tel(tmp_path, monkeypatch):
+    telemetry.reset()
+    monkeypatch.setattr(settings, "telemetry", True)
+    telemetry.configure(str(tmp_path / "records.jsonl"))
+    yield tmp_path / "records.jsonl"
+    telemetry.configure(None)
+    telemetry.reset()
+
+
+def _tridiag(n, seed=0):
+    rng = np.random.default_rng(seed)
+    e = np.ones(n)
+    A = sp.diags([-e[:-1], 3.0 * e, -e[:-1]], [-1, 0, 1], format="csr")
+    A = A.copy()
+    A.setdiag(3.0 + rng.random(n))
+    A.sort_indices()
+    return A
+
+
+def _systems(B=4, n=48):
+    rng = np.random.default_rng(7)
+    mats = [_tridiag(n, seed=s) for s in range(B)]
+    rhs = rng.standard_normal((B, n))
+    return list(zip(mats, rhs))
+
+
+# -- (a) seeded determinism ---------------------------------------------------
+
+
+def test_poisson_trace_deterministic():
+    a = ArrivalTrace.poisson(rate=200.0, duration=1.0, seed=42)
+    b = ArrivalTrace.poisson(rate=200.0, duration=1.0, seed=42)
+    assert np.array_equal(a.arrival_times(), b.arrival_times())
+    assert len(a.arrivals) > 100  # ~200 expected
+    assert all(0 < t.t < 1.0 for t in a.arrivals)
+    c = ArrivalTrace.poisson(rate=200.0, duration=1.0, seed=43)
+    assert not np.array_equal(a.arrival_times(), c.arrival_times())
+
+
+def test_bursty_trace_deterministic_and_denser_in_bursts():
+    kw = dict(rate=20.0, burst_rate=800.0, period=0.5, duty=0.2,
+              duration=2.0, seed=5)
+    a, b = ArrivalTrace.bursty(**kw), ArrivalTrace.bursty(**kw)
+    assert np.array_equal(a.arrival_times(), b.arrival_times())
+    # burst windows are the first 20% of each 0.5s period
+    ts = a.arrival_times()
+    in_burst = sum(1 for t in ts if (t % 0.5) < 0.1)
+    assert in_burst > len(ts) * 0.7  # bursts dominate at 40x the rate
+
+
+def test_uniform_trace_is_evenly_spaced():
+    t = ArrivalTrace.uniform(rate=10.0, duration=1.0)
+    gaps = np.diff(t.arrival_times())
+    assert np.allclose(gaps, 0.1)
+    assert len(t.arrivals) == 9  # k/10 for k=1..9 strictly inside [0,1)
+
+
+def test_merge_is_sorted_and_keeps_tenants_weights():
+    a = ArrivalTrace.poisson(rate=50.0, duration=0.5, seed=1, tenant="a")
+    b = ArrivalTrace.uniform(rate=40.0, duration=0.5, tenant="b",
+                             weight=2.0)
+    m = a + b
+    ts = m.arrival_times()
+    assert np.all(np.diff(ts) >= 0)
+    assert m.tenants() == ["a", "b"]
+    assert m.weights == {"a": 1.0, "b": 2.0}
+    assert m.counts()["b"] == len(b.arrivals)
+    assert m.duration == 0.5
+
+
+# -- (b) the spec grammar -----------------------------------------------------
+
+
+def test_parse_round_trips_through_describe():
+    spec = ("poisson:rate=100,duration=0.5,seed=3,tenant=a;"
+            "burst:rate=10,burst_rate=200,period=0.2,duty=0.25,"
+            "duration=0.5,seed=4,tenant=b,weight=2;"
+            "closed:concurrency=2,requests=6,tenant=c")
+    t = ArrivalTrace.parse(spec)
+    assert t.tenants() == ["a", "b", "c"]
+    assert t.weights["b"] == 2.0
+    assert t.closed[0].concurrency == 2 and t.closed[0].requests == 6
+    t2 = ArrivalTrace.parse(t.describe())
+    assert np.array_equal(t.arrival_times(), t2.arrival_times())
+    assert [a.tenant for a in t.arrivals] == [a.tenant for a in t2.arrivals]
+    assert t2.closed == t.closed
+
+
+def test_parse_rejects_bad_specs():
+    with pytest.raises(LoadSpecError):
+        ArrivalTrace.parse("gaussian:rate=10,duration=1")  # unknown pattern
+    with pytest.raises(LoadSpecError):
+        ArrivalTrace.parse("poisson:rate=10,duration=1,bogus=3")
+    with pytest.raises(LoadSpecError):
+        ArrivalTrace.parse("poisson:rate=-5,duration=1")
+    with pytest.raises(LoadSpecError):
+        ArrivalTrace.parse("poisson:rate")  # not key=value
+    with pytest.raises(LoadSpecError):
+        ArrivalTrace.parse("")  # empty
+    with pytest.raises(LoadSpecError):
+        ArrivalTrace.bursty(rate=1, burst_rate=10, period=0.5, duty=1.5,
+                            duration=1)
+
+
+# -- (d) fairness index -------------------------------------------------------
+
+
+def test_fairness_index_equal_and_starved():
+    assert loadgen.fairness_index({"a": 10, "b": 10}) == pytest.approx(1.0)
+    j = loadgen.fairness_index({"a": 10, "b": 0})
+    assert j == pytest.approx(0.5)
+    assert loadgen.fairness_index({}) == 1.0
+    assert loadgen.fairness_index({"a": 0, "b": 0}) == 1.0
+
+
+def test_build_report_fairness_respects_weights():
+    """A tenant with weight 2 completing 2x the requests IS fair."""
+    tr = (ArrivalTrace.uniform(rate=10, duration=1, tenant="a")
+          + ArrivalTrace.uniform(rate=20, duration=1, tenant="b",
+                                 weight=2.0))
+    outcomes = (
+        [("a", 0.01, True, False)] * 10 + [("b", 0.01, True, False)] * 20
+    )
+    rep = loadgen.build_report(tr, outcomes, wall_s=1.0)
+    assert rep.fairness == pytest.approx(1.0)
+    assert rep.tenants["b"]["weight"] == 2.0
+    # the same completions under equal weights are unfair
+    tr2 = (ArrivalTrace.uniform(rate=10, duration=1, tenant="a")
+           + ArrivalTrace.uniform(rate=20, duration=1, tenant="b"))
+    rep2 = loadgen.build_report(tr2, outcomes, wall_s=1.0)
+    assert rep2.fairness < 0.95
+
+
+def test_build_report_is_pure_and_deterministic():
+    tr = ArrivalTrace.uniform(rate=10, duration=1, tenant="x")
+    outcomes = [("x", 0.002 * (i + 1), True, False) for i in range(9)]
+    r1 = loadgen.build_report(tr, outcomes, wall_s=0.5, slo_ms=10.0)
+    r2 = loadgen.build_report(tr, outcomes, wall_s=0.5, slo_ms=10.0)
+    assert r1.as_dict() == r2.as_dict()
+    assert r1.arrivals == 9 and r1.completed == 9
+    assert r1.offered_rps == pytest.approx(9.0)  # 9 arrivals / 1 virtual s
+    assert r1.achieved_rps == pytest.approx(18.0)  # 9 / 0.5 wall s
+    # latencies 2..18 ms; misses are the 12/14/16/18 ms tickets
+    assert r1.slo_misses == 4
+    assert r1.slo_miss_rate == pytest.approx(4 / 9)
+    assert r1.latency_ms["max"] == pytest.approx(18.0)
+    json.dumps(r1.as_dict())  # JSON-friendly by contract
+
+
+# -- (c) the runner against a real session -----------------------------------
+
+
+def test_run_load_open_loop_smoke():
+    ses = SolveSession("cg", slo_ms=5000.0)
+    trace = ArrivalTrace.poisson(rate=120.0, duration=0.25, seed=9)
+    rep = loadgen.run_load(ses, trace, _systems(), tol=1e-8)
+    assert rep.arrivals == len(trace.arrivals)
+    assert rep.completed == rep.arrivals and rep.failed == 0
+    assert rep.achieved_rps > 0
+    assert rep.latency_ms["p95"] >= rep.latency_ms["p50"] > 0
+    assert rep.dispatches >= 1
+    assert rep.queue_depth, "queue-depth time series must be sampled"
+    assert rep.slo_miss_rate == 0.0  # 5s SLO is unmissable here
+    assert ses.pending == 0
+
+
+def test_run_load_closed_loop_completes_budget():
+    ses = SolveSession("cg")
+    trace = ArrivalTrace.closed_loop(concurrency=3, requests=8,
+                                     tenant="cl")
+    rep = loadgen.run_load(ses, trace, _systems(), tol=1e-8)
+    assert rep.arrivals == 8 and rep.completed == 8
+    assert rep.tenants["cl"]["completed"] == 8
+    # closed-loop offered == achieved by construction
+    assert rep.offered_rps == pytest.approx(rep.achieved_rps)
+
+
+def test_run_load_two_tenants_counts_and_fairness():
+    ses = SolveSession("cg")
+    trace = (
+        ArrivalTrace.poisson(rate=80.0, duration=0.25, seed=1, tenant="a")
+        + ArrivalTrace.poisson(rate=80.0, duration=0.25, seed=2,
+                               tenant="b")
+    )
+    rep = loadgen.run_load(ses, trace, _systems(), tol=1e-8)
+    want = trace.counts()
+    assert rep.tenants["a"]["completed"] == want["a"]
+    assert rep.tenants["b"]["completed"] == want["b"]
+    assert rep.fairness > 0.8  # near-equal seeded rates
+
+
+def test_run_load_emits_schema_valid_trace_event(tel):
+    ses = SolveSession("cg", slo_ms=1000.0)
+    trace = ArrivalTrace.uniform(rate=40.0, duration=0.2, tenant="t")
+    rep = loadgen.run_load(ses, trace, _systems(), tol=1e-8)
+    evs = telemetry.events("loadgen.trace")
+    assert len(evs) == 1
+    ev = evs[0]
+    assert telemetry.schema.validate(ev) == []
+    assert ev["trace"] == trace.describe()
+    assert ev["arrivals"] == rep.arrivals
+    assert ev["achieved_rps"] == rep.achieved_rps
+    assert ev["fairness"] == rep.fairness
+    assert ev["tenants"]["t"]["completed"] == rep.completed
+    # the per-ticket terminal events carry the tenant label
+    tks = telemetry.events("batch.ticket")
+    assert tks and all(e.get("tenant") == "t" for e in tks)
+
+
+def test_run_load_input_validation():
+    ses = SolveSession("cg")
+    trace = ArrivalTrace.uniform(rate=10, duration=0.1)
+    with pytest.raises(ValueError):
+        loadgen.run_load(ses, trace, [])
+    with pytest.raises(ValueError):
+        loadgen.run_load(ses, trace, _systems(), time_scale=0.0)
+
+
+# -- (e) tenant satellite: zero dispatch-path change --------------------------
+
+
+def test_tenant_label_never_touches_program_or_default_series():
+    from sparse_tpu.telemetry import _metrics
+
+    systems = _systems(B=2)
+    ses = SolveSession("cg")
+    t_plain = ses.submit(*systems[0], tol=1e-8)
+    t_tagged = ses.submit(*systems[1], tol=1e-8, tenant="acme")
+    ses.flush()
+    assert t_plain.tenant is None and t_tagged.tenant == "acme"
+    assert t_plain.result() is not None and t_tagged.result() is not None
+    # default tickets keep the pre-existing {solver} series; tagged ones
+    # get their own {solver, tenant} series — existing names unchanged
+    fams = [m.labels for m in _metrics.family("batch.ticket_latency")]
+    assert {"solver": "cg"} in fams
+    assert {"solver": "cg", "tenant": "acme"} in fams
+
+    # the tenant never reaches the compiled program: same key, same jaxpr
+    pat = ses.pattern_of(systems[0][0])
+    B, n = 2, pat.shape[0]
+    args = (
+        np.zeros((B, pat.nnz)), np.zeros((B, n)), np.zeros((B, n)),
+        np.zeros(B), 50,
+    )
+    j = str(jax.make_jaxpr(ses._build_program(pat, B, np.dtype(np.float64)))(
+        *args
+    ))
+    ses2 = SolveSession("cg")
+    j2 = str(
+        jax.make_jaxpr(ses2._build_program(pat, B, np.dtype(np.float64)))(
+            *args
+        )
+    )
+    assert j == j2

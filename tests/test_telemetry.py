@@ -8,6 +8,7 @@ spans no-op under jit and the compiled-loop taps never leak tracers.
 """
 
 import json
+import time
 
 import jax
 import jax.numpy as jnp
@@ -918,3 +919,229 @@ def test_health_anomaly_dedup_across_sequential_solves(tel):
     ]) == 1
     assert M.counter("solver.anomalies.by_reason",
                      reason="nonfinite").value == c0 + 3
+
+
+# -- Axon v5: the SLO watchdog (telemetry/_watchdog.py) ----------------------
+
+
+def _box_rule(name="box", trigger=10.0, **kw):
+    """A rule whose value is a mutable box — deterministic tick fodder."""
+    from sparse_tpu.telemetry import _watchdog
+
+    box = {"v": 0.0}
+    rule = _watchdog.Rule(name, lambda: box["v"], trigger, **kw)
+    return box, rule
+
+
+def test_watchdog_fires_and_clears_with_hysteresis(tel):
+    from sparse_tpu.telemetry import _metrics as M
+    from sparse_tpu.telemetry import _watchdog
+
+    box, rule = _box_rule(trigger=10.0, clear=5.0, severity="page")
+    wd = _watchdog.Watchdog(rules=[rule])
+    c0 = M.counter("watchdog.alerts", rule="box", severity="page").value
+    assert wd.evaluate(now=0.0) == []  # 0 <= trigger: ok
+    box["v"] = 11.0
+    trans = wd.evaluate(now=1.0)
+    assert [t["event"] for t in trans] == ["alert"]
+    assert wd.active() == ["box"]
+    assert M.counter(
+        "watchdog.alerts", rule="box", severity="page"
+    ).value == c0 + 1
+    # hysteresis: back under the trigger but above clear stays firing
+    box["v"] = 7.0
+    assert wd.evaluate(now=2.0) == []
+    assert wd.active() == ["box"]
+    box["v"] = 4.0
+    trans = wd.evaluate(now=3.0)
+    assert [t["event"] for t in trans] == ["clear"]
+    assert wd.active() == []
+    kinds = [e["kind"] for e in telemetry.events()
+             if e["kind"].startswith("watchdog.")]
+    assert kinds == ["watchdog.alert", "watchdog.clear"]
+    alert = telemetry.events("watchdog.alert")[0]
+    assert telemetry.schema.validate(alert) == []
+    assert alert["rule"] == "box" and alert["severity"] == "page"
+    clear = telemetry.events("watchdog.clear")[0]
+    assert telemetry.schema.validate(clear) == []
+    assert clear["active_s"] == pytest.approx(2.0)
+
+
+def test_watchdog_for_ticks_and_cooldown():
+    from sparse_tpu.telemetry import _watchdog
+
+    box, rule = _box_rule(trigger=1.0, for_ticks=2, cooldown_s=10.0)
+    wd = _watchdog.Watchdog(rules=[rule])
+    box["v"] = 5.0
+    assert wd.evaluate(now=0.0) == []  # 1st breach tick: armed only
+    assert wd.evaluate(now=1.0) != []  # 2nd consecutive: alert
+    box["v"] = 0.0
+    assert wd.evaluate(now=2.0) != []  # clear
+    # cooldown: the condition returns immediately but re-alerting is
+    # suppressed until 10s past the clear
+    box["v"] = 5.0
+    assert wd.evaluate(now=3.0) == []
+    assert wd.evaluate(now=4.0) == []
+    assert wd.active() == []
+    trans = wd.evaluate(now=13.0)  # cooldown expired (clear was at t=2)
+    assert [t["event"] for t in trans] == ["alert"]
+    # a flapping value never re-arms mid-streak
+    box["v"] = 0.0
+    wd.evaluate(now=14.0)
+
+
+def test_watchdog_slo_miss_rate_rule_windows():
+    from sparse_tpu.telemetry import _metrics as M
+    from sparse_tpu.telemetry import _watchdog
+
+    rule = _watchdog.slo_miss_rate_rule(trigger=0.5, clear=0.1)
+    wd = _watchdog.Watchdog(rules=[rule])
+    wd.evaluate()  # priming tick: snapshots taken, no value yet
+    assert wd.active() == []
+    # a window where 3 of 4 resolved tickets missed the SLO
+    h = M.histogram("batch.ticket_latency", solver="wdtest")
+    for _ in range(4):
+        h.observe(0.05)
+    M.counter("batch.slo_misses").inc(3)
+    wd.evaluate()
+    assert wd.active() == ["slo_miss_rate"]
+    # idle window (denominator unmoved): no state change either way
+    wd.evaluate()
+    assert wd.active() == ["slo_miss_rate"]
+    # a clean window clears
+    for _ in range(10):
+        h.observe(0.001)
+    wd.evaluate()
+    assert wd.active() == []
+
+
+def test_watchdog_default_rules_construct_and_tick():
+    from sparse_tpu.telemetry import _watchdog
+
+    wd = _watchdog.Watchdog()  # the stock rule set
+    names = {r.name for st in [wd._states] for r in
+             [s.rule for s in st.values()]}
+    assert {"slo_miss_rate", "anomaly_rate", "queue_depth",
+            "device_occupancy", "vault_quarantine",
+            "failover_latched"} <= names
+    wd.evaluate()
+    wd.evaluate()  # two ticks: windowed rules produce values, no crash
+    st = wd.state()
+    assert st["enabled"] and st["ticks"] == 2
+    assert isinstance(st["rules"], list) and len(st["rules"]) == 6
+
+
+def test_watchdog_thread_start_stop():
+    from sparse_tpu.telemetry import _watchdog
+
+    box, rule = _box_rule(trigger=1e18)
+    wd = _watchdog.Watchdog(rules=[rule], interval_s=0.02)
+    wd.start()
+    try:
+        deadline = time.time() + 5.0
+        while wd.ticks < 2 and time.time() < deadline:
+            time.sleep(0.01)
+        assert wd.ticks >= 2
+        assert wd.state()["running"]
+    finally:
+        wd.stop()
+    assert not wd.state()["running"]
+
+
+def test_watchdog_singleton_and_alerts_endpoint_round_trip(tel):
+    """/alerts serves the process watchdog's state; /healthz summarizes
+    the firing set and reports degraded (the ISSUE 11 serve surface)."""
+    import json as _json
+    import urllib.request
+
+    from sparse_tpu.telemetry import _watchdog
+
+    telemetry.stop_watchdog()
+    telemetry.stop_serving()
+    box = {"v": 100.0}
+    wd = telemetry.watchdog(rules=[
+        _watchdog.Rule("rt", lambda: box["v"], 10.0, severity="page"),
+    ])
+    assert telemetry.watchdog(rules=[]) is wd  # get-or-create
+    wd.evaluate()
+    try:
+        server = telemetry.serve(port=0)
+        body = urllib.request.urlopen(
+            server.url + "/alerts", timeout=5
+        ).read()
+        alerts = _json.loads(body)
+        assert alerts["enabled"] and alerts["active"] == ["rt"]
+        (row,) = alerts["rules"]
+        assert row["state"] == "firing" and row["value"] == 100.0
+        hz = _json.loads(urllib.request.urlopen(
+            server.url + "/healthz", timeout=5
+        ).read())
+        assert hz["alerts"]["active"] == ["rt"]
+        assert hz["status"] == "degraded"
+        # clearing the rule restores ok on both surfaces
+        box["v"] = 0.0
+        wd.evaluate()
+        alerts = _json.loads(urllib.request.urlopen(
+            server.url + "/alerts", timeout=5
+        ).read())
+        assert alerts["active"] == []
+        hz = _json.loads(urllib.request.urlopen(
+            server.url + "/healthz", timeout=5
+        ).read())
+        assert hz["alerts"]["active"] == [] and hz["status"] == "ok"
+    finally:
+        telemetry.stop_serving()
+        telemetry.stop_watchdog()
+    assert telemetry.watchdog_state()["enabled"] is False
+
+
+def test_alerts_endpoint_without_watchdog_is_disabled_stub(tel):
+    import json as _json
+    import urllib.request
+
+    telemetry.stop_watchdog()
+    telemetry.stop_serving()
+    try:
+        server = telemetry.serve(port=0)
+        alerts = _json.loads(urllib.request.urlopen(
+            server.url + "/alerts", timeout=5
+        ).read())
+        assert alerts == {"enabled": False, "running": False,
+                          "active": [], "rules": []}
+    finally:
+        telemetry.stop_serving()
+
+
+def test_serve_busy_port_falls_back_to_ephemeral():
+    """ISSUE 11 satellite: a taken port must not raise — the exporter
+    binds an ephemeral port and reports it on the handle."""
+    import socket
+
+    telemetry.stop_serving()
+    blocker = socket.socket()
+    blocker.bind(("127.0.0.1", 0))
+    blocker.listen(1)
+    busy = blocker.getsockname()[1]
+    try:
+        server = telemetry.serve(port=busy)
+        assert server.port != busy and server.port > 0
+        assert server.fallback and server.requested_port == busy
+        import urllib.request
+
+        body = urllib.request.urlopen(server.url + "/", timeout=5).read()
+        assert b"/alerts" in body
+    finally:
+        telemetry.stop_serving()
+        blocker.close()
+
+
+def test_metrics_family_readback():
+    from sparse_tpu.telemetry import _metrics as M
+
+    M.histogram("wd.fam.test", a="1").observe(1.0)
+    M.histogram("wd.fam.test", a="2").observe(2.0)
+    fam = M.family("wd.fam.test")
+    assert len(fam) == 2
+    assert sum(h.count for h in fam) == 2
+    M.remove("wd.fam.test")
+    assert M.family("wd.fam.test") == []
