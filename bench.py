@@ -684,7 +684,10 @@ def run_sustained_cg(n: int = 512, B: int = 8, rate: float = 150.0,
     rhs = rng.standard_normal((B, n)).astype(np.float32)
     systems = list(zip(mats, rhs))
 
-    ses = SolveSession("cg", batch_max=32, slo_ms=slo_ms)
+    # sampled device profiling (ISSUE 12): every 4th dispatch records
+    # its host-vs-device split so the bench row (and axon_report's
+    # programs table) carries MEASURED device time, not just host wall
+    ses = SolveSession("cg", batch_max=32, slo_ms=slo_ms, profile_every=4)
     pattern = ses.pattern_of(mats[0])
     pattern.sell_pack()
     # warm every bucket the coalescing can produce (pow2 up to batch_max)
@@ -697,7 +700,21 @@ def run_sustained_cg(n: int = 512, B: int = 8, rate: float = 150.0,
         rate=rate, duration=duration, seed=seed
     )
     rep = loadgen.run_load(ses, trace, systems, tol=1e-6)
+    # the measured device-time rollup of the sampled dispatches (the
+    # cost table accumulates per-program; aggregate across buckets)
+    dev_ms = dev_n = 0.0
+    try:
+        from sparse_tpu.telemetry import _cost
+
+        for p in _cost.programs().values():
+            if p.get("device_samples"):
+                dev_ms += p["device_ms_total"]
+                dev_n += p["device_samples"]
+    except Exception:
+        traceback.print_exc(file=sys.stderr)
     return {
+        **({"device_ms_mean": round(dev_ms / dev_n, 3),
+            "device_samples": int(dev_n)} if dev_n else {}),
         "n": n, "rate": rate, "duration_s": duration,
         "trace": rep.trace,
         "arrivals": rep.arrivals, "completed": rep.completed,

@@ -1,0 +1,409 @@
+#!/usr/bin/env python
+"""Incident bundle analyzer: rule-based probable-cause diagnosis.
+
+The flight recorder (``sparse_tpu/telemetry/_flight.py``) captures a
+postmortem bundle at the moment a watchdog rule fires; this script turns
+a bundle into a *diagnosis* — the triggering alert joined with the event
+chains in the ring tail against a table of known failure signatures
+(docs/telemetry.md "Axon v6" wires the same table into the operator
+runbook):
+
+* ``slo_miss_rate`` + a ``fault.injected`` ``delay:dispatch`` chain
+  → "injected dispatch delay";
+* latched failovers / ``kernel.failover`` events → "Pallas kernel
+  failed over to XLA";
+* ``vault.quarantine`` events → "vault artifact corruption";
+* ``plan_cache.compile`` events inside the breach window →
+  "compile tax in the serving window"; ... (the ``_DIAGNOSES`` table is
+  the authoritative list).
+
+Usage:
+    python scripts/axon_doctor.py [BUNDLE | INCIDENTS_ROOT] [--json] [--quiet]
+
+With no argument the newest bundle under ``results/axon/incidents/`` is
+analyzed; a root directory resolves to its newest bundle. ``--json``
+prints the machine diagnosis (``probable_cause``, ``evidence``,
+``matches``) — what chaos scenario 9 asserts against.
+
+Exit codes: 0 = diagnosed (including "unknown"), 2 = no bundle found /
+unreadable manifest.
+
+Pure-stdlib on purpose, like ``axon_report.py``: no sparse_tpu import,
+no jax init — a paged operator (or CI) runs it in milliseconds against
+files already on disk.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+DEFAULT_ROOT = os.path.join(REPO, "results", "axon", "incidents")
+
+
+# ---------------------------------------------------------------------------
+# loading
+# ---------------------------------------------------------------------------
+def resolve_bundle(path: str | None) -> str | None:
+    """A bundle dir (has ``incident.json``), or the newest bundle under
+    a root dir; ``None`` when nothing resolves."""
+    path = path or DEFAULT_ROOT
+    if os.path.isfile(os.path.join(path, "incident.json")):
+        return path
+    if not os.path.isdir(path):
+        return None
+    for name in sorted(os.listdir(path), reverse=True):
+        cand = os.path.join(path, name)
+        if os.path.isfile(os.path.join(cand, "incident.json")):
+            return cand
+    return None
+
+
+def load_bundle(bundle: str) -> tuple:
+    """(manifest dict, ring events list); tolerant of partial bundles —
+    a missing/corrupt ring still diagnoses from the manifest alone."""
+    try:
+        manifest = json.load(open(os.path.join(bundle, "incident.json")))
+    except (OSError, json.JSONDecodeError, ValueError):
+        return None, []
+    events = []
+    try:
+        with open(os.path.join(bundle, "ring.jsonl")) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    ev = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                if isinstance(ev, dict) and "kind" in ev:
+                    events.append(ev)
+    except OSError:
+        pass
+    return manifest if isinstance(manifest, dict) else None, events
+
+
+# ---------------------------------------------------------------------------
+# evidence summaries
+# ---------------------------------------------------------------------------
+def _summarize(manifest: dict, events: list) -> dict:
+    """The joined evidence picture every diagnosis rule matches on."""
+    kinds: dict = {}
+    faults_by: dict = {}  # (site, fault) -> count
+    anomaly_reasons: dict = {}
+    failover_kernels = set()
+    quarantine_reasons: dict = {}
+    for e in events:
+        k = str(e.get("kind", "?"))
+        kinds[k] = kinds.get(k, 0) + 1
+        if k == "fault.injected":
+            key = (str(e.get("site", "?")), str(e.get("fault", "?")))
+            faults_by[key] = faults_by.get(key, 0) + 1
+        elif k == "solver.anomaly":
+            r = str(e.get("reason", "?"))
+            anomaly_reasons[r] = anomaly_reasons.get(r, 0) + 1
+        elif k == "kernel.failover":
+            failover_kernels.add(str(e.get("kernel", "?")))
+        elif k == "vault.quarantine":
+            r = str(e.get("reason", "?"))
+            quarantine_reasons[r] = quarantine_reasons.get(r, 0) + 1
+    trans = manifest.get("transition") or {}
+    latches = manifest.get("failover_latches") or {}
+    faults_cfg = manifest.get("faults") or {}
+    return {
+        "rule": str(manifest.get("rule") or trans.get("rule") or ""),
+        "severity": str(trans.get("severity") or ""),
+        "value": trans.get("value"),
+        "trigger": trans.get("trigger"),
+        "kinds": kinds,
+        "faults_by": faults_by,
+        "faults_active": bool(faults_cfg.get("active")),
+        "faults_spec": str(faults_cfg.get("spec") or ""),
+        "anomaly_reasons": anomaly_reasons,
+        "failover_kernels": sorted(failover_kernels),
+        "latches": latches,
+        "quarantine_reasons": quarantine_reasons,
+        "compiles": kinds.get("plan_cache.compile", 0),
+        "deadlines": kinds.get("batch.deadline", 0),
+        "degraded": kinds.get("batch.degraded", 0),
+        "requeues": kinds.get("batch.requeue", 0),
+    }
+
+
+# ---------------------------------------------------------------------------
+# the diagnosis table (ordered: first match is the probable cause)
+# ---------------------------------------------------------------------------
+def _d_injected_delay(s):
+    n = s["faults_by"].get(("dispatch", "delay"), 0)
+    if not n:
+        return None
+    ev = [f"{n} fault.injected event(s) with site=dispatch fault=delay"]
+    if s["faults_spec"]:
+        ev.append(f"fault spec at capture: {s['faults_spec']!r}")
+    return ("injected dispatch delay (resilience.faults "
+            "delay:dispatch clause)", ev,
+            "clear SPARSE_TPU_FAULTS / faults.clear(); latency recovers "
+            "with the next clean dispatches")
+
+
+def _d_injected_drop(s):
+    n = s["faults_by"].get(("dispatch", "drop"), 0)
+    if not n:
+        return None
+    return ("injected dispatch drops (resilience.faults "
+            "drop:dispatch clause)",
+            [f"{n} fault.injected event(s) with site=dispatch fault=drop"],
+            "clear the fault spec; dispatch_attempts retries absorb "
+            "transient drops")
+
+
+def _d_injected_matvec(s):
+    n = sum(v for (site, _f), v in s["faults_by"].items()
+            if site == "matvec")
+    if not n:
+        return None
+    return ("injected matvec corruption (resilience.faults matvec "
+            "clause)",
+            [f"{n} fault.injected event(s) at site=matvec",
+             f"anomalies: {s['anomaly_reasons']}" if s["anomaly_reasons"]
+             else "recovery engine chains expected (solver.retry)"],
+            "clear the fault spec; solve_with_recovery's ladder handles "
+            "live corruption")
+
+
+def _d_injected_io(s):
+    n = sum(v for (site, _f), v in s["faults_by"].items() if site == "io")
+    if not n:
+        return None
+    return ("injected vault io faults (resilience.faults io clause)",
+            [f"{n} fault.injected event(s) at site=io"],
+            "clear the fault spec; verify-then-load quarantines and "
+            "rebuilds")
+
+
+def _d_failover(s):
+    if not s["latches"] and not s["failover_kernels"]:
+        return None
+    ev = []
+    if s["latches"]:
+        ev.append(f"latched failovers at capture: {s['latches']}")
+    if s["failover_kernels"]:
+        ev.append(
+            "kernel.failover event(s) for: "
+            + ", ".join(s["failover_kernels"])
+        )
+    return ("Pallas kernel failed over to the XLA formulation",
+            ev,
+            "results stay correct on the fallback; probe_pallas() "
+            "reinstates after the underlying failure clears "
+            "(docs/resilience.md)")
+
+
+def _d_vault(s):
+    n = s["kinds"].get("vault.quarantine", 0)
+    if not n and s["rule"] != "vault_quarantine":
+        return None
+    ev = [f"{n} vault.quarantine event(s)"]
+    if s["quarantine_reasons"]:
+        ev.append(f"verify failures: {s['quarantine_reasons']}")
+    return ("vault artifact corruption (disk tier quarantining)",
+            ev,
+            "inspect <vault>/quarantine/; rebuilds are automatic, "
+            "recurring checksum failures mean bad storage")
+
+
+def _d_queue(s):
+    if s["rule"] != "queue_depth":
+        return None
+    ev = [f"queue_depth {s['value']} breached trigger {s['trigger']}"]
+    if s["deadlines"]:
+        ev.append(f"{s['deadlines']} batch.deadline expiry event(s)")
+    return ("arrivals outrunning dispatch capacity (queue saturation)",
+            ev,
+            "raise batch_max / add mesh capacity (SPARSE_TPU_FLEET), or "
+            "shed load via per-ticket deadlines")
+
+
+def _d_occupancy(s):
+    if s["rule"] != "device_occupancy":
+        return None
+    return ("mesh underutilized in dispatching windows (occupancy "
+            "floor)",
+            [f"mean occupancy {s['value']} under floor {s['trigger']}"],
+            "traffic too ragged for the bucket geometry: check "
+            "SPARSE_TPU_FLEET_MIN_B and bucket pad waste in "
+            "batch.dispatch events")
+
+
+def _d_degraded(s):
+    if not s["degraded"]:
+        return None
+    return ("compiled bucket path unavailable — serving on per-lane "
+            "eager fallback",
+            [f"{s['degraded']} batch.degraded event(s)"],
+            "check the degradation reasons on the events; eager lanes "
+            "are orders slower than the compiled path")
+
+
+def _d_anomalies(s):
+    if s["rule"] != "anomaly_rate" and not s["anomaly_reasons"]:
+        return None
+    return ("solver anomalies detected "
+            f"({', '.join(sorted(s['anomaly_reasons'])) or 'see rule'})",
+            [f"solver.anomaly reasons: {s['anomaly_reasons']}"],
+            "nonfinite/breakdown lanes requeue automatically; persistent "
+            "stagnation means tol/maxiter or preconditioning "
+            "(docs/resilience.md anomaly table)")
+
+
+def _d_compile_tax(s):
+    if s["rule"] != "slo_miss_rate" or not s["compiles"]:
+        return None
+    return ("compile tax inside the serving window (cold buckets "
+            "breached the SLO)",
+            [f"{s['compiles']} plan_cache.compile event(s) in the ring "
+             "tail alongside the latency breach"],
+            "enable SPARSE_TPU_VAULT warm restart (+ "
+            "SPARSE_TPU_COMPILE_CACHE) or prebuild the traffic's "
+            "buckets")
+
+
+def _d_slo_unattributed(s):
+    if s["rule"] != "slo_miss_rate":
+        return None
+    return ("serving latency breach with no fault/compile evidence in "
+            "the captured window",
+            [f"slo_miss_rate {s['value']} over trigger {s['trigger']}"],
+            "inspect trace.json ticket waterfalls for the slow phase "
+            "(queue wait = capacity, solve = workload shift)")
+
+
+#: ordered (id, matcher) — first hit is THE probable cause, later hits
+#: are reported as secondary matches
+_DIAGNOSES = (
+    ("injected-dispatch-delay", _d_injected_delay),
+    ("injected-dispatch-drop", _d_injected_drop),
+    ("injected-matvec-corruption", _d_injected_matvec),
+    ("injected-io-fault", _d_injected_io),
+    ("pallas-failover", _d_failover),
+    ("vault-corruption", _d_vault),
+    ("queue-saturation", _d_queue),
+    ("occupancy-floor", _d_occupancy),
+    ("degraded-serving", _d_degraded),
+    ("solver-anomalies", _d_anomalies),
+    ("compile-tax", _d_compile_tax),
+    ("slo-breach-unattributed", _d_slo_unattributed),
+)
+
+
+def diagnose(manifest: dict, events: list) -> dict:
+    """The machine diagnosis of one bundle: the first matching signature
+    is ``probable_cause``; every other match lands in ``matches`` (an
+    incident can have several true findings — an injected delay AND the
+    resulting requeues)."""
+    s = _summarize(manifest, events)
+    matches = []
+    for did, fn in _DIAGNOSES:
+        try:
+            hit = fn(s)
+        except Exception:  # noqa: BLE001 - one matcher never kills the run
+            hit = None
+        if hit:
+            cause, evidence, runbook = hit
+            matches.append({
+                "id": did,
+                "cause": cause,
+                "evidence": [e for e in evidence if e],
+                "runbook": runbook,
+            })
+    primary = matches[0] if matches else {
+        "id": "unknown",
+        "cause": "no known failure signature in the captured window",
+        "evidence": [f"ring kinds: {s['kinds']}"],
+        "runbook": "read ring.jsonl / trace.json directly; consider a "
+        "/debug/capture profile while the incident is live",
+    }
+    return {
+        "rule": s["rule"],
+        "severity": s["severity"],
+        "value": s["value"],
+        "trigger": s["trigger"],
+        "cause": primary["id"],
+        "probable_cause": primary["cause"],
+        "evidence": primary["evidence"],
+        "runbook": primary["runbook"],
+        "matches": matches,
+        "events": len(events),
+        "events_by_kind": dict(sorted(s["kinds"].items())),
+    }
+
+
+# ---------------------------------------------------------------------------
+# CLI
+# ---------------------------------------------------------------------------
+def _print_diagnosis(bundle: str, manifest: dict, diag: dict) -> None:
+    proc = manifest.get("process") or {}
+    print(f"axon_doctor: {os.path.basename(bundle)}")
+    print(
+        f"  captured {manifest.get('iso', '?')} by process "
+        f"pi={proc.get('pi', '?')} pid={proc.get('pid', '?')} "
+        f"({manifest.get('reason', '?')})"
+    )
+    if diag["rule"]:
+        print(
+            f"  alert: {diag['rule']} [{diag['severity'] or '?'}] "
+            f"value={diag['value']} trigger={diag['trigger']}"
+        )
+    print(f"  PROBABLE CAUSE [{diag['cause']}]: {diag['probable_cause']}")
+    for e in diag["evidence"]:
+        print(f"    evidence: {e}")
+    print(f"    runbook: {diag['runbook']}")
+    for m in diag["matches"][1:]:
+        print(f"  also [{m['id']}]: {m['cause']}")
+    if diag["events_by_kind"]:
+        print(f"  ring tail ({diag['events']} events):")
+        for k, n in diag["events_by_kind"].items():
+            print(f"    {k:<22} {n}")
+
+
+def main(argv) -> int:
+    if "--help" in argv or "-h" in argv:
+        print(__doc__)
+        return 0
+    args = list(argv)
+    as_json = "--json" in args
+    if as_json:
+        args.remove("--json")
+    quiet = "--quiet" in args
+    if quiet:
+        args.remove("--quiet")
+    bundle = resolve_bundle(args[0] if args else None)
+    if bundle is None:
+        print(
+            f"axon_doctor: no incident bundle under "
+            f"{args[0] if args else DEFAULT_ROOT}",
+            file=sys.stderr,
+        )
+        return 2
+    manifest, events = load_bundle(bundle)
+    if manifest is None:
+        print(
+            f"axon_doctor: unreadable manifest in {bundle}",
+            file=sys.stderr,
+        )
+        return 2
+    diag = diagnose(manifest, events)
+    diag["bundle"] = os.path.basename(bundle)
+    if as_json:
+        print(json.dumps(diag, indent=1, sort_keys=True, default=str))
+    elif not quiet:
+        _print_diagnosis(bundle, manifest, diag)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

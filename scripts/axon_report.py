@@ -12,6 +12,8 @@ Usage:
         [--peak-gflops G] [--peak-gbs B]  # roofline ceilings (optional)
         [--peak-ici-gbs I]             # per-shard interconnect ceiling
         [--quiet]
+    python scripts/axon_report.py --trend [BENCH_r*.json globs]
+        # cross-round bench trend table (no session log needed)
 
 Exit codes: 0 = ok, 1 = regressions found (--compare), 2 = bad usage /
 missing input — so ``axon_report --compare`` gates CI directly.
@@ -51,6 +53,19 @@ lifted onto the ``--compare`` surface next to ``batched_cg`` /
 present on only ONE side (a baseline from before a new bench row, or a
 row that vanished) as *informational* — listed, never gated: only a
 metric present in BOTH reports can regress.
+
+Axon v6 additions (ISSUE 12): the ``programs`` table gains MEASURED
+device-time columns from the sampled timed-dispatch path
+(``SPARSE_TPU_PROFILE_EVERY`` — ``batch.dispatch`` events carrying
+``device_ms``/``host_ms``): per-program ``device_ms_mean`` /
+``host_ms_mean`` / ``device_samples`` and the device-clock achieved
+rate ``achieved_gflops_dev`` next to the host-wall analytic roofline;
+``program.<key>.device_ms_mean`` rides ``--compare``. ``--trend`` joins
+every committed ``BENCH_r*.json`` into a cross-round table
+(``cg_iters_per_s``, ``sustained_cg.achieved_rps``, ``cold_start``
+times, batched/fleet speedups) so the bench trajectory in ROADMAP is
+machine-generated. ``scripts/axon_doctor.py`` is the sibling analyzer
+for incident bundles (``results/axon/incidents/``).
 
 Axon v4 additions (ISSUE 7): ``report["comm"]`` rolls up the
 ``comm.measured`` events (parallel/comm.py trace-time accounting) per
@@ -249,7 +264,14 @@ def _programs_rollup(events, peak_gflops=None, peak_gbs=None) -> dict:
     program) joined with measured ``batch.dispatch`` solve wall time of
     the same program key. Achieved rates use total flops moved over
     total solve seconds; ``--peak-*`` ceilings add percent-of-roofline
-    columns."""
+    columns.
+
+    Axon v6 (ISSUE 12): sampled timed dispatches carry a measured
+    host-vs-device split (``device_ms``/``host_ms`` fields, the
+    ``SPARSE_TPU_PROFILE_EVERY`` path) — those aggregate into
+    ``device_ms_mean``/``host_ms_mean``/``device_samples`` and a
+    device-clock achieved rate (``achieved_gflops_dev``), the *measured*
+    column next to the analytic roofline."""
     programs: dict = {}
     for e in events:
         if e.get("kind") != "plan_cache.compile":
@@ -269,6 +291,17 @@ def _programs_rollup(events, peak_gflops=None, peak_gbs=None) -> dict:
         sm = _num(e.get("solve_ms"))
         if sm is not None:
             p["solve_ms_total"] = round(p["solve_ms_total"] + sm, 3)
+        dm = _num(e.get("device_ms"))
+        if dm is not None:  # a sampled timed dispatch
+            p["device_ms_total"] = round(
+                p.get("device_ms_total", 0.0) + dm, 3
+            )
+            p["device_samples"] = p.get("device_samples", 0) + 1
+            hm = _num(e.get("host_ms"))
+            if hm is not None:
+                p["host_ms_total"] = round(
+                    p.get("host_ms_total", 0.0) + hm, 3
+                )
     for p in programs.values():
         solve_s = p["solve_ms_total"] / 1e3
         flops, nbytes = _num(p.get("flops")), _num(p.get("bytes"))
@@ -289,6 +322,20 @@ def _programs_rollup(events, peak_gflops=None, peak_gbs=None) -> dict:
                     p["pct_peak_gbs"] = round(
                         100.0 * p["achieved_gbs"] / peak_gbs, 2
                     )
+        samples = p.get("device_samples", 0)
+        if samples:
+            p["device_ms_mean"] = round(p["device_ms_total"] / samples, 3)
+            if "host_ms_total" in p:
+                p["host_ms_mean"] = round(
+                    p["host_ms_total"] / samples, 3
+                )
+            if flops and p["device_ms_total"] > 0:
+                # the measured-device-clock rate: flops over the time the
+                # device actually ran (per sampled dispatch), not over
+                # host wall that includes dispatch/trace overhead
+                p["achieved_gflops_dev"] = round(
+                    flops * samples / (p["device_ms_total"] / 1e3) / 1e9, 4
+                )
         if flops and nbytes:
             # arithmetic intensity: which roofline regime the program
             # sits in (SpMV-shaped programs live far left of the ridge)
@@ -511,7 +558,8 @@ def build_report(records_path: str, bench_paths=(), peak_gflops=None,
             sustained_row = rec["sustained_cg"]
     if sustained_row:
         for k, hib in (("achieved_rps", True), ("offered_rps", True),
-                       ("p95_ms", False), ("slo_miss_rate", False)):
+                       ("p95_ms", False), ("slo_miss_rate", False),
+                       ("device_ms_mean", False)):
             if _num(sustained_row.get(k)) is not None:
                 metrics[f"sustained_cg.{k}"] = {
                     "v": sustained_row[k], "hib": hib,
@@ -545,6 +593,12 @@ def build_report(records_path: str, bench_paths=(), peak_gflops=None,
         if _num(p.get("achieved_gflops")) is not None:
             metrics[f"program.{key}.achieved_gflops"] = {
                 "v": p["achieved_gflops"], "hib": True,
+            }
+        # the measured device clock (sampled dispatches): a per-program
+        # device-time regression gates like any latency metric
+        if _num(p.get("device_ms_mean")) is not None:
+            metrics[f"program.{key}.device_ms_mean"] = {
+                "v": p["device_ms_mean"], "hib": False,
             }
     if cache["session"] and _num(cache["session"].get("hit_rate")) is not None:
         metrics["plan_cache.hit_rate"] = {
@@ -580,6 +634,119 @@ def build_report(records_path: str, bench_paths=(), peak_gflops=None,
         "bench": bench_rows,
         "metrics": metrics,
     }
+
+
+# ---------------------------------------------------------------------------
+# bench trend (ISSUE 12 satellite): join BENCH_r*.json across rounds
+# ---------------------------------------------------------------------------
+#: embedded bench rows lifted into the trend table, with headline keys
+_TREND_EMBEDS = (
+    ("sustained_cg", ("achieved_rps", "offered_rps", "p95_ms",
+                      "slo_miss_rate")),
+    ("cold_start", ("cold_s", "replay_s", "disk_warm_s", "warm_s")),
+    ("batched_cg", ("speedup_warm",)),
+    ("fleet_batched_cg", ("speedup_warm",)),
+)
+
+
+def _trend_round(path: str) -> dict:
+    """One committed round artifact (``BENCH_rNN.json``) as a trend row:
+    the ``parsed`` headline metric plus every embedded bench row
+    recoverable from the run's stdout tail (the worker prints its record
+    dict as JSON lines; the last line carrying each embed wins)."""
+    try:
+        data = json.load(open(path))
+    except (OSError, json.JSONDecodeError):
+        return {}
+    if not isinstance(data, dict):
+        return {}
+    row: dict = {"file": os.path.basename(path)}
+    if _num(data.get("n")) is not None:
+        row["round"] = data["n"]
+    parsed = data.get("parsed")
+    if isinstance(parsed, dict) and _num(parsed.get("value")) is not None:
+        row["metric"] = parsed.get("metric")
+        if str(parsed.get("metric", "")).startswith("cg_iters_per_s"):
+            row["cg_iters_per_s"] = parsed["value"]
+    for line in str(data.get("tail") or "").splitlines():
+        line = line.strip()
+        if not (line.startswith("{") and line.endswith("}")):
+            continue
+        try:
+            rec = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        if not isinstance(rec, dict):
+            continue
+        for embed, keys in _TREND_EMBEDS:
+            sub = rec.get(embed)
+            if isinstance(sub, dict):
+                picked = {k: sub[k] for k in keys if _num(sub.get(k))
+                          is not None}
+                if picked:
+                    row[embed] = picked
+    return row
+
+
+def build_trend(paths) -> dict:
+    """The cross-round trend table: one row per ``BENCH_r*.json``
+    (sorted by filename = round order). This is the machine-generated
+    form of ROADMAP's hand-copied bench trajectory — the headline
+    ``cg_iters_per_s`` plus the serving rows (``sustained_cg`` req/s,
+    ``cold_start`` restart times, batched/fleet speedups) per round."""
+    rows = [r for r in (_trend_round(p) for p in sorted(paths)) if r]
+    trend: dict = {"rounds": rows}
+    series: dict = {}
+    for r in rows:
+        if _num(r.get("cg_iters_per_s")) is not None:
+            series.setdefault("cg_iters_per_s", []).append(
+                [r["file"], r["cg_iters_per_s"]]
+            )
+        for embed, keys in _TREND_EMBEDS:
+            sub = r.get(embed)
+            if isinstance(sub, dict):
+                for k in keys:
+                    if _num(sub.get(k)) is not None:
+                        series.setdefault(f"{embed}.{k}", []).append(
+                            [r["file"], sub[k]]
+                        )
+    trend["series"] = series
+    return trend
+
+
+def _print_trend(trend: dict) -> None:
+    rows = trend.get("rounds", [])
+    print(f"axon_report --trend: {len(rows)} bench round(s)")
+    if not rows:
+        return
+    print(
+        f"  {'round':<16} {'cg_iters/s':>10} {'sust req/s':>10} "
+        f"{'p95 ms':>8} {'cold_s':>8} {'warm_s':>8}"
+    )
+    for r in rows:
+        sc = r.get("sustained_cg") or {}
+        cs = r.get("cold_start") or {}
+
+        def cell(v, nd=2):
+            return f"{v:.{nd}f}" if _num(v) is not None else "-"
+
+        print(
+            f"  {r['file']:<16} {cell(r.get('cg_iters_per_s')):>10} "
+            f"{cell(sc.get('achieved_rps')):>10} "
+            f"{cell(sc.get('p95_ms')):>8} {cell(cs.get('cold_s'), 3):>8} "
+            f"{cell(cs.get('warm_s'), 3):>8}"
+        )
+    for name, pts in sorted(trend.get("series", {}).items()):
+        if len(pts) >= 2:
+            first, last = pts[0][1], pts[-1][1]
+            delta = (
+                f"{(last - first) / abs(first) * 100.0:+.1f}%"
+                if first else "n/a"
+            )
+            print(
+                f"  trend {name}: {first} -> {last} ({delta} over "
+                f"{len(pts)} round(s))"
+            )
 
 
 # ---------------------------------------------------------------------------
@@ -760,6 +927,11 @@ def _print_report(rep: dict) -> None:
                 ("pct_peak_gflops", "{}%peakF"),
                 ("pct_peak_gbs", "{}%peakB"),
                 ("flops_per_byte", "AI={}"),
+                # measured device time (sampled timed dispatches)
+                ("device_ms_mean", "dev={}ms"),
+                ("host_ms_mean", "host={}ms"),
+                ("device_samples", "x{}sampled"),
+                ("achieved_gflops_dev", "dev_achieved={}GF/s"),
             ):
                 v = p.get(f)
                 if v is not None:
@@ -803,6 +975,28 @@ def main(argv) -> int:
     bench_args = take("--bench", many=True)
     out_json = take("--json")
     baseline_path = take("--compare")
+    # --trend (ISSUE 12 satellite): the cross-round bench table, no
+    # session log needed — positional args become BENCH_r*.json globs
+    if "--trend" in args:
+        args.remove("--trend")
+        pats = args or [os.path.join(REPO, "BENCH_r*.json")]
+        paths = []
+        for pat in pats:
+            hits = sorted(_glob.glob(pat))
+            paths.extend(hits if hits else [pat])
+        trend = build_trend(paths)
+        if not quiet:
+            _print_trend(trend)
+        if out_json:
+            os.makedirs(
+                os.path.dirname(os.path.abspath(out_json)), exist_ok=True
+            )
+            with open(out_json, "w") as f:
+                json.dump(trend, f, indent=1, sort_keys=True)
+                f.write("\n")
+            if not quiet:
+                print(f"  trend -> {out_json}")
+        return 0 if trend["rounds"] else 2
     try:
         threshold = float(take("--threshold", "0.2"))
         pk_gf = take("--peak-gflops")

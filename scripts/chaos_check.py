@@ -48,6 +48,17 @@ chains are missing:
    ``watchdog.alerts`` counter) and emit ``watchdog.clear`` after the
    faults lift and clean traffic flows — alerting proven end-to-end,
    not just unit-tested.
+9. **Incident flight recorder + doctor** (ISSUE 12 acceptance drill) —
+   the scenario-8 drill with the flight recorder enabled and sampled
+   device profiling on: the ``slo_miss_rate`` alert during
+   ``delay:dispatch`` injection must auto-capture EXACTLY ONE
+   rate-limited postmortem bundle (a second alert inside the window is
+   suppressed, never a second bundle), the bundle must carry the ring
+   tail with the ``fault.injected`` chain, sampled ``batch.dispatch``
+   events must carry the measured ``device_ms`` split, and
+   ``scripts/axon_doctor.py --json`` over the bundle must name
+   "injected dispatch delay" as the probable cause — the alert →
+   evidence → diagnosis loop proven end-to-end.
 
 Telemetry is pointed at a temp sink (never the committed
 ``results/axon/records.jsonl``). Wired into the quick lane through
@@ -257,6 +268,9 @@ def run(report: dict) -> list:
 
     # -- 8. loadgen traffic + watchdog alert/clear under dispatch delay -----
     problems += _loadgen_watchdog(report)
+
+    # -- 9. incident flight recorder: alert -> bundle -> doctor diagnosis ---
+    problems += _incident_flight(report)
     return problems
 
 
@@ -354,6 +368,145 @@ def _loadgen_watchdog(report: dict) -> list:
             f"watchdog: alert did not clear after faults lifted "
             f"(active={wd.active()}, clean slo_miss_rate="
             f"{rep_clean.slo_miss_rate})"
+        )
+    return problems
+
+
+def _incident_flight(report: dict) -> list:
+    """Scenario 9 (ISSUE 12): scenario 8's injection geometry with the
+    flight recorder armed and sampled device profiling on. The watchdog
+    alert during the incident must auto-capture exactly one rate-limited
+    bundle whose ring tail carries the fault chain; the stdlib doctor
+    must then name the injected delay as the probable cause."""
+    import numpy as np
+
+    from sparse_tpu import loadgen, telemetry as tel
+    from sparse_tpu.batch import SolveSession
+    from sparse_tpu.resilience import faults
+    from sparse_tpu.telemetry import _flight, _watchdog
+
+    problems = []
+    tel.reset()
+    rng = np.random.default_rng(41)
+    mats = []
+    for _ in range(4):
+        M = _tridiag(N)
+        M.setdiag(3.0 + rng.random(N))
+        M.sort_indices()
+        mats.append(M.tocsr())
+    rhs = rng.standard_normal((4, N))
+    systems = list(zip(mats, rhs))
+
+    # sampled timed dispatches (profile_every=2): the bundle's ring tail
+    # must show MEASURED device_ms on dispatch events, not just wall
+    ses = SolveSession("cg", slo_ms=WD_SLO_MS, profile_every=2)
+    pattern = ses.pattern_of(mats[0])
+    pattern.sell_pack()
+    bkt = 1
+    while bkt <= 16:
+        ses._prebuild(pattern, "cg", bkt, np.dtype(np.float64))
+        bkt *= 2
+
+    idir = tempfile.mkdtemp(prefix="chaos_incidents_")
+    _flight.stop_flight()
+    fr = _flight.flight(root=idir, min_interval_s=60.0, max_bundles=4)
+    wd = _watchdog.Watchdog(rules=[
+        _watchdog.slo_miss_rate_rule(trigger=0.5, clear=0.2),
+    ])
+    wd.evaluate()  # prime the windowed-rate snapshots
+
+    trace = loadgen.ArrivalTrace.poisson(rate=40.0, duration=0.5, seed=17)
+    faults.configure(WD_DELAY_SPEC)
+    try:
+        loadgen.run_load(ses, trace, systems, tol=TOL)
+        # the alert transition IS the capture trigger: evaluating while
+        # the injection is live must write the bundle
+        wd.evaluate()
+        alerted = "slo_miss_rate" in wd.active()
+        # a second alert inside the rate-limit window must be suppressed
+        # (ONE bundle per incident window, never a disk flood)
+        fr.on_alert({"rule": "slo_miss_rate", "severity": "page",
+                     "value": 1.0, "trigger": 0.5})
+    finally:
+        faults.clear()
+        _flight.stop_flight()
+
+    bundles = sorted(
+        n for n in os.listdir(idir)
+        if os.path.isfile(os.path.join(idir, n, "incident.json"))
+    )
+    report["incident_flight"] = {
+        "alerted": alerted,
+        "bundles": bundles,
+        "captures": fr.captures,
+        "suppressed": fr.suppressed,
+    }
+    if not alerted:
+        problems.append("flight: slo_miss_rate did not alert during "
+                        "injection")
+    if len(bundles) != 1:
+        problems.append(
+            f"flight: expected exactly one rate-limited bundle, found "
+            f"{len(bundles)} ({bundles})"
+        )
+        return problems
+    if fr.suppressed < 1:
+        problems.append("flight: second alert was not counted as "
+                        "suppressed")
+    bundle = os.path.join(idir, bundles[0])
+    ring = [
+        json.loads(ln)
+        for ln in open(os.path.join(bundle, "ring.jsonl"))
+        if ln.strip()
+    ]
+    kinds = {}
+    for ev in ring:
+        kinds[ev.get("kind")] = kinds.get(ev.get("kind"), 0) + 1
+    if kinds.get("fault.injected", 0) == 0:
+        problems.append("flight: bundle ring tail carries no "
+                        "fault.injected chain")
+    sampled = [
+        ev for ev in ring
+        if ev.get("kind") == "batch.dispatch" and "device_ms" in ev
+    ]
+    if not sampled:
+        problems.append("flight: no sampled batch.dispatch event with a "
+                        "measured device_ms split in the bundle")
+    # the stdlib doctor over the bundle: the probable cause must be the
+    # injected dispatch delay, by id and by name
+    doctor = subprocess.run(
+        [sys.executable, os.path.join(HERE, "axon_doctor.py"), bundle,
+         "--json"],
+        capture_output=True, text=True, timeout=60,
+    )
+    diag = None
+    try:
+        diag = json.loads(doctor.stdout)
+    except json.JSONDecodeError:
+        pass
+    if diag is None:
+        problems.append(
+            f"flight: doctor produced no JSON diagnosis "
+            f"(rc={doctor.returncode}, stderr: {doctor.stderr[-200:]!r})"
+        )
+        return problems
+    report["incident_flight"]["diagnosis"] = {
+        "cause": diag.get("cause"),
+        "probable_cause": diag.get("probable_cause"),
+        "rule": diag.get("rule"),
+    }
+    if diag.get("cause") != "injected-dispatch-delay":
+        problems.append(
+            f"flight: doctor named {diag.get('cause')!r}, expected "
+            "'injected-dispatch-delay'"
+        )
+    if "dispatch delay" not in str(diag.get("probable_cause", "")):
+        problems.append("flight: probable_cause text does not name the "
+                        "injected dispatch delay")
+    if diag.get("rule") != "slo_miss_rate":
+        problems.append(
+            f"flight: diagnosis rule {diag.get('rule')!r} != "
+            "'slo_miss_rate'"
         )
     return problems
 
@@ -711,6 +864,7 @@ def main(argv) -> int:
         vr = report.get("vault_restart", {})
         fr = report.get("fleet_restart", {})
         lw = report.get("loadgen_watchdog", {})
+        fl = report.get("incident_flight", {})
         print(
             "chaos check passed: "
             f"{len([k for k in report if k.startswith('solver.')])} solvers "
@@ -724,7 +878,10 @@ def main(argv) -> int:
             "serving misses), watchdog alert->clear ok (faulted "
             f"slo_miss_rate={lw.get('faulted', {}).get('slo_miss_rate', '?')}"
             " -> clean "
-            f"{lw.get('clean', {}).get('slo_miss_rate', '?')})"
+            f"{lw.get('clean', {}).get('slo_miss_rate', '?')}), "
+            f"incident flight ok ({len(fl.get('bundles', []))} bundle, "
+            f"{fl.get('suppressed', '?')} suppressed, doctor cause "
+            f"{fl.get('diagnosis', {}).get('cause', '?')!r})"
         )
     return 1 if problems else 0
 

@@ -20,18 +20,27 @@ without a ``bench.session`` record is kept whole — the window anchor
 lives in the controller-0 log). Run from anywhere:
 ``python scripts/trim_records.py [--dry-run]``. CI/round tooling runs
 it before committing results.
+
+Incident bundles (ISSUE 12 satellite): the flight recorder writes
+postmortem bundle DIRECTORIES under ``results/axon/incidents/``; the
+same bounded-retention policy as the vault quarantine applies — the
+newest ``KEEP_INCIDENTS`` bundles are kept, older ones removed — so
+committed results stay small even after an alert storm.
 """
 
 import glob as _glob
 import json
 import os
+import shutil
 import sys
 
 HERE = os.path.dirname(os.path.abspath(__file__))
 REPO = os.path.dirname(HERE)
 AXON_DIR = os.path.join(HERE, "..", "results", "axon")
 RECORDS = os.path.join(AXON_DIR, "records.jsonl")
+INCIDENTS_DIR = os.path.join(AXON_DIR, "incidents")
 SLACK_S = 120.0  # clock slack around the session window
+KEEP_INCIDENTS = 4  # newest bundles kept by trim_incidents
 
 
 def _roundtrip_ok(kept, original) -> bool:
@@ -166,16 +175,53 @@ def trim(path: str = RECORDS, dry_run: bool = False) -> int:
     return dropped
 
 
+def trim_incidents(root: str = INCIDENTS_DIR, keep: int = KEEP_INCIDENTS,
+                   dry_run: bool = False) -> int:
+    """Prune the incident-bundle directory to the newest ``keep``
+    bundles (ISSUE 12 satellite). A bundle is any subdirectory holding
+    an ``incident.json`` manifest; names carry a timestamp prefix, so a
+    name sort IS a chronological sort. Non-bundle entries (stray files,
+    a manifest-less dir) are left alone — this prunes only what the
+    flight recorder wrote. Returns the number of bundles removed."""
+    try:
+        names = sorted(
+            n for n in os.listdir(root)
+            if os.path.isfile(os.path.join(root, n, "incident.json"))
+        )
+    except OSError:
+        print("trim_records: no incident bundles; nothing to do")
+        return 0
+    doomed = names[: max(len(names) - max(int(keep), 0), 0)]
+    print(
+        f"trim_records: incidents: {len(names)} bundle(s) -> "
+        f"{len(names) - len(doomed)} (removing {len(doomed)}, keep "
+        f"newest {keep})"
+    )
+    if dry_run:
+        return len(doomed)
+    removed = 0
+    for n in doomed:
+        try:
+            shutil.rmtree(os.path.join(root, n))
+            removed += 1
+        except OSError as e:
+            print(f"trim_records: could not remove incidents/{n}: {e}")
+    return removed
+
+
 def trim_all(dry_run: bool = False) -> int:
     """Trim every committed session log — the single-controller
     ``records.jsonl`` plus any per-process ``records.<pid>.jsonl`` the
     multi-controller sink split produced. Merge outputs
-    (``records.merged.jsonl``) are trimmed like any other log."""
+    (``records.merged.jsonl``) are trimmed like any other log. Incident
+    bundles are pruned to the newest ``KEEP_INCIDENTS`` alongside."""
     paths = sorted(_glob.glob(os.path.join(AXON_DIR, "records*.jsonl")))
     if not paths:
         print("trim_records: no session logs; nothing to do")
-        return 0
-    return sum(trim(p, dry_run=dry_run) for p in paths)
+        dropped = 0
+    else:
+        dropped = sum(trim(p, dry_run=dry_run) for p in paths)
+    return dropped + trim_incidents(dry_run=dry_run)
 
 
 if __name__ == "__main__":

@@ -74,7 +74,7 @@ from ..config import settings
 from ..ops import spmv as spmv_ops
 from ..parallel import comm as _comm
 from ..resilience import faults as _faults
-from ..telemetry import _cost, _metrics
+from ..telemetry import _cost, _metrics, _profiler
 from . import bucket as bucketing
 from . import krylov
 from .operator import BatchedCSR, SparsityPattern
@@ -304,6 +304,15 @@ class SolveSession:
         (``SPARSE_TPU_VAULT``); ``False`` always skips. Replay is
         best-effort — a corrupt manifest or artifact degrades to an
         ordinary cold start, never a construction failure.
+    profile_every : sampled timed-dispatch device profiling (ISSUE 12):
+        every Nth dispatched bucket splits its solve wall clock into
+        host (async dispatch) vs device (``block_until_ready``) time,
+        feeding the always-on ``batch.program_device_ms{program}``
+        histogram, the cost table's measured columns and the
+        ``batch.dispatch`` event's ``device_ms``/``host_ms`` fields.
+        Default ``None`` = ``settings.profile_every``
+        (``SPARSE_TPU_PROFILE_EVERY``); 0 = off — no extra timestamps,
+        identical compiled programs either way.
     """
 
     def __init__(self, solver: str = "cg", batch_max: int | None = None,
@@ -313,7 +322,8 @@ class SolveSession:
                  dispatch_attempts: int = 2, slo_ms: float | None = None,
                  warm_start: bool | None = None, fleet=None,
                  fleet_mesh=None, fleet_min_b: int | None = None,
-                 row_shard_min_n: int | None = None):
+                 row_shard_min_n: int | None = None,
+                 profile_every: int | None = None):
         if solver not in _SOLVERS:
             raise ValueError(f"solver must be one of {_SOLVERS}")
         if fallback_solver not in _SOLVERS:
@@ -328,6 +338,17 @@ class SolveSession:
         self.fallback_solver = fallback_solver
         self.dispatch_attempts = max(int(dispatch_attempts), 1)
         self.slo_ms = None if slo_ms is None else float(slo_ms)
+        # sampled timed-dispatch device profiling (ISSUE 12): every Nth
+        # dispatch splits solve wall clock at the dispatch-return
+        # boundary into host vs device time (telemetry/_profiler.py).
+        # 0 (the default env) = off: no extra timestamps, no extra
+        # event fields, and the compiled programs are identical either
+        # way — sampling never enters a trace.
+        self.profile_every = (
+            settings.profile_every if profile_every is None
+            else max(int(profile_every), 0)
+        )
+        self._dispatch_seq = 0
         # mesh-sharded serving tier (ISSUE 10, docs/batching.md): the
         # per-(pattern, bucket) strategy policy. `fleet` may be a mode
         # string ('auto'/'batch'/'row'), True/False, a ready FleetPolicy,
@@ -817,8 +838,20 @@ class SolveSession:
                         mesh=(plan.fingerprint if plan.sharded else None),
                         strategy=(plan.strategy if plan.sharded else None),
                     )
+            # sampled timed dispatch (ISSUE 12): every Nth dispatch
+            # takes ONE extra timestamp at the dispatch-return boundary
+            # so the solve wall clock splits into host (async dispatch)
+            # vs device (block_until_ready wait) time. Off (the
+            # default) takes no timestamp at all; the program and its
+            # plan-cache key are identical either way.
+            self._dispatch_seq += 1
+            sampled = (
+                self.profile_every > 0
+                and self._dispatch_seq % self.profile_every == 0
+            )
             t_solve0 = time.monotonic()
             out = prog(*args)
+            t_dispatched = time.monotonic() if sampled else None
             try:
                 jax.block_until_ready(out)
             except Exception:
@@ -843,6 +876,13 @@ class SolveSession:
             self._solve_degraded(reqs, dt, solver)
             return
         t_read = time.monotonic()
+        profile_ms = None
+        if sampled:
+            profile_ms = (
+                max((t_dispatched - t_solve0) * 1e3, 0.0),  # host
+                max((t_solved - t_dispatched) * 1e3, 0.0),  # device
+            )
+            _profiler.record_device_sample(key, *profile_ms)
         requeue_lanes = []
         for i, r in enumerate(reqs):
             r.ticket._offer(X[i], iters[i], resid2[i], conv[i],
@@ -910,6 +950,11 @@ class SolveSession:
                 plan_cache=cache_d,
                 n=pattern.shape[0], nnz=pattern.nnz,
                 strategy=plan.strategy, S=plan.S,
+                # measured host/device split, sampled dispatches only
+                # (the axon_report programs table's device_ms column)
+                **({"host_ms": round(profile_ms[0], 3),
+                    "device_ms": round(profile_ms[1], 3)}
+                   if profile_ms is not None else {}),
             )
         if requeue_lanes:
             self._requeue(requeue_lanes, dt)

@@ -48,6 +48,15 @@ Flags (all env-overridable):
   SPARSE_TPU_FLEET_MIN_B      - minimum REAL lane count before a bucket batch-shards
                                 across the mesh (default 8; below it the collective
                                 and padding overhead outweighs the parallelism).
+  SPARSE_TPU_FLIGHT           - incident flight recorder (telemetry/_flight.py): a
+                                directory (or '1' for results/axon/incidents) enables
+                                postmortem bundle capture on watchdog alerts. Empty
+                                (default) = off.
+  SPARSE_TPU_FLIGHT_MAX       - max incident bundles retained (default 8; oldest pruned).
+  SPARSE_TPU_PROFILE_EVERY    - sampled timed-dispatch device profiling
+                                (batch/service.py): every Nth dispatch records its
+                                host-vs-device time split. 0 (default) = off, dispatch
+                                path unchanged.
 """
 
 from __future__ import annotations
@@ -213,6 +222,29 @@ class Settings:
     # per-iteration all-converged psum outweigh the parallel matvec.
     fleet_min_b: int = field(
         default_factory=lambda: max(_env_int("SPARSE_TPU_FLEET_MIN_B", 8), 1)
+    )
+    # Incident flight recorder (telemetry/_flight.py): a directory (or a
+    # truthy spelling for the default results/axon/incidents) enables
+    # postmortem bundle capture on watchdog alert transitions. Empty
+    # (default) = off: the alert hook is a single settings check and
+    # nothing ever touches the filesystem.
+    flight: str = field(default_factory=lambda: _env_str("SPARSE_TPU_FLIGHT", ""))
+    # Max incident bundles kept on disk (oldest pruned past it) and the
+    # min seconds between captures (alerts inside the window are counted
+    # as suppressed, not written).
+    flight_max: int = field(
+        default_factory=lambda: max(_env_int("SPARSE_TPU_FLIGHT_MAX", 8), 1)
+    )
+    # Sampled timed-dispatch device profiling (batch/service.py): every
+    # Nth bucket dispatch splits its solve wall clock into host (dispatch
+    # returns) vs device (block_until_ready) time, feeding the always-on
+    # batch.program_device_ms{program} histogram and the batch.dispatch
+    # event's device_ms/host_ms fields. 0 (default) = off: the dispatch
+    # path takes no extra timestamps and emits no extra fields — the
+    # compiled programs are identical either way (sampling is host-side
+    # only and never enters a trace).
+    profile_every: int = field(
+        default_factory=lambda: max(_env_int("SPARSE_TPU_PROFILE_EVERY", 0), 0)
     )
 
 

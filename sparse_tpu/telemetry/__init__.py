@@ -38,6 +38,19 @@ Surface
   ``watchdog.clear`` events and the always-on
   ``watchdog.alerts{rule,severity}`` counter.
   :mod:`sparse_tpu.loadgen` is the traffic source that exercises it.
+* :func:`flight` / :func:`capture_now` / :func:`flight_state` — the
+  incident flight recorder (:mod:`._flight`, ISSUE 12): watchdog alert
+  transitions capture rate-limited, count-bounded postmortem bundles
+  (ring tail + identity, metrics/plan-cache snapshots, watchdog/health/
+  session state, cost table, env/config/mesh fingerprint, Perfetto
+  slice) under ``results/axon/incidents/``; ``scripts/axon_doctor.py``
+  diagnoses a bundle, the exporter serves ``/incidents`` and
+  ``/debug/capture``. Off unless ``SPARSE_TPU_FLIGHT`` is set.
+* :func:`profile_capture` — on-demand ``jax.profiler`` trace window
+  (:mod:`._profiler`); the same module sinks the sampled timed-dispatch
+  host/device split ``batch/service.py`` records under
+  ``SPARSE_TPU_PROFILE_EVERY`` (the measured ``device_ms`` column in
+  ``axon_report``'s roofline table).
 * :func:`ticket_scope` / :func:`new_ticket_id` /
   :func:`current_tickets` — request-scoped trace context
   (:mod:`._context`): events recorded inside a scope carry the
@@ -97,9 +110,24 @@ from ._recorder import (  # noqa: F401
     sink_path,
 )
 from ._recorder import reset as _reset_recorder
+from ._flight import (  # noqa: F401
+    FlightRecorder,
+    capture_now,
+    flight,
+    stop_flight,
+)
+from ._flight import state as flight_state  # noqa: F401
+from ._profiler import capture_trace as profile_capture  # noqa: F401
 from ._serve import AxonServer, serve, serving, stop_serving  # noqa: F401
 from ._spans import Span, device_sync, span  # noqa: F401
-from ._watchdog import Rule, Watchdog, stop_watchdog, watchdog  # noqa: F401
+from ._watchdog import (  # noqa: F401
+    Rule,
+    Watchdog,
+    add_alert_hook,
+    remove_alert_hook,
+    stop_watchdog,
+    watchdog,
+)
 from ._watchdog import state as watchdog_state  # noqa: F401
 from ._summary import summary  # noqa: F401
 from ._trace import export_trace, to_chrome_trace  # noqa: F401
@@ -118,10 +146,12 @@ def reset() -> None:
 
 
 __all__ = [
+    "add_alert_hook",
     "add_bytes",
     "add_span",
     "AxonServer",
     "bytes_by_kind",
+    "capture_now",
     "configure",
     "cost",
     "count",
@@ -132,6 +162,9 @@ __all__ = [
     "enabled",
     "events",
     "export_trace",
+    "flight",
+    "flight_state",
+    "FlightRecorder",
     "flush",
     "health",
     "last_solve_report",
@@ -139,7 +172,9 @@ __all__ = [
     "metrics_text",
     "new_ticket_id",
     "process_identity",
+    "profile_capture",
     "record",
+    "remove_alert_hook",
     "session_info",
     "reset",
     "schema",
@@ -148,6 +183,7 @@ __all__ = [
     "sink_path",
     "span",
     "Span",
+    "stop_flight",
     "stop_serving",
     "stop_watchdog",
     "summary",

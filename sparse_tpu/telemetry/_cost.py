@@ -182,6 +182,32 @@ def record_pack(program: str, pack_s: float, **labels) -> None:
     )
 
 
+def note_device_time(program: str, host_ms: float,
+                     device_ms: float) -> None:
+    """Fold one sampled timed dispatch (ISSUE 12, :mod:`._profiler`)
+    into the program table: measured device/host milliseconds accumulate
+    next to the analytic flops/bytes so ``/session`` and
+    ``axon_report``'s roofline table gain a *measured* ``device_ms``
+    column. A program the table no longer holds (evicted, or compiled by
+    an earlier process) gets a minimal measured-only row."""
+    with _LOCK:
+        p = _PROGRAMS.get(program)
+        if p is None:
+            if len(_PROGRAMS) >= _PROGRAMS_MAX:
+                _PROGRAMS.pop(next(iter(_PROGRAMS)))
+            p = _PROGRAMS[program] = {"program": program}
+        p["device_ms_total"] = round(
+            p.get("device_ms_total", 0.0) + float(device_ms), 6
+        )
+        p["host_ms_total"] = round(
+            p.get("host_ms_total", 0.0) + float(host_ms), 6
+        )
+        p["device_samples"] = p.get("device_samples", 0) + 1
+        p["device_ms_mean"] = round(
+            p["device_ms_total"] / p["device_samples"], 6
+        )
+
+
 def programs() -> dict:
     """Snapshot of the program attribution table
     (``{program: {compile_s, flops, bytes, peak_bytes, ...}}``)."""

@@ -1,0 +1,486 @@
+"""Incident flight recorder: postmortem bundles captured at alert time.
+
+The serving stack alerts live (:mod:`._watchdog` → ``/alerts``) but —
+before this module — captured nothing at the moment of breach: an
+operator paged by ``slo_miss_rate`` had only whatever JSONL happened to
+survive, with the in-memory ring, the metrics levels and the live
+session state all gone by the time anyone looked. The reference stack
+gets exactly this from Legion's task-level profiler (Legate Sparse
+SC'23, PAPERS.md §1); here the :class:`FlightRecorder` closes the loop
+from *alert* to *evidence*: every watchdog ok → firing transition is
+offered to the recorder (the ``_ALERT_HOOKS`` hook point in
+:mod:`._watchdog`), which writes one rate-limited, count-bounded
+**postmortem bundle** under ``results/axon/incidents/<ts>-<rule>/``:
+
+``incident.json``
+    the manifest: the triggering transition (rule, severity, sampled
+    value, threshold), process identity + session clock base, the full
+    watchdog rule state, the health monitor's last solve report,
+    failover latches + fault-injection status, live session stats
+    (``service.sessions_stats()``), the compiled-program cost table
+    (:mod:`._cost`), and an env/config/mesh fingerprint — everything an
+    operator (or ``scripts/axon_doctor.py``) needs to reconstruct the
+    moment of breach.
+``ring.jsonl``
+    the recorder ring tail (newest ``ring_tail`` events), led by this
+    process's ``session.start`` identity record — under the
+    multi-controller sink split each process's bundle carries ITS ring
+    and ITS identity block, same contract as ``records.<pid>.jsonl``.
+``metrics.json``
+    the always-on registry snapshot plus ``plan_cache.stats()``.
+``trace.json``
+    a Perfetto trace slice of the ring tail (``telemetry.export_trace``)
+    — the per-ticket waterfalls of the requests in flight at breach.
+``profile/`` (on-demand captures only)
+    a ``jax.profiler`` trace of a short live window (:mod:`._profiler`).
+
+Discipline (the satellite tests pin all three):
+
+* **Off by default.** Without ``SPARSE_TPU_FLIGHT`` (or an explicit
+  :func:`flight` call) the alert hook is one settings check — no
+  filesystem touch, no allocation, no singleton.
+* **Rate-limited.** Captures inside ``min_interval_s`` of the previous
+  one are counted (``flight.suppressed``) and skipped — a flapping rule
+  or a multi-rule storm produces ONE bundle per window, not a disk
+  flood.
+* **Count-bounded.** At most ``max_bundles`` bundles are retained;
+  writing a new one prunes the oldest (``scripts/trim_records.py``
+  additionally prunes committed results).
+
+``scripts/axon_doctor.py`` is the stdlib-only analyzer over a bundle;
+the live exporter serves :func:`state` on ``/incidents`` and manual
+captures on ``/debug/capture``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import shutil
+import threading
+import time
+
+from ..config import settings
+from . import _metrics, _recorder
+
+__all__ = [
+    "FlightRecorder",
+    "bundles",
+    "capture_now",
+    "current",
+    "flight",
+    "on_alert_transition",
+    "state",
+    "stop_flight",
+]
+
+#: default incidents root: results/axon/incidents next to the repo root
+#: (the same derivation as the recorder's default sink)
+_DEFAULT_ROOT = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+    "results",
+    "axon",
+    "incidents",
+)
+
+#: truthy spellings of SPARSE_TPU_FLIGHT that mean "default root"
+_TRUTHY = ("1", "true", "yes", "on")
+
+_LOCK = threading.Lock()
+_RECORDER: "FlightRecorder | None" = None
+
+_CAPTURES = "flight.captures"
+_SUPPRESSED = _metrics.counter(
+    "flight.suppressed",
+    help="alert transitions whose bundle capture was rate-limited away",
+)
+
+
+def _jsonable(v):
+    try:
+        json.dumps(v)
+        return v
+    except (TypeError, ValueError):
+        return str(v)
+
+
+def _fingerprint() -> dict:
+    """The env/config/mesh identity block of a bundle: which knobs and
+    topology produced the incident. Every probe is best-effort — a
+    fingerprint must never fail a capture."""
+    out: dict = {
+        "env": {
+            k: v for k, v in sorted(os.environ.items())
+            if k.startswith("SPARSE_TPU_")
+            or k in ("JAX_PLATFORMS", "XLA_FLAGS", "JAX_ENABLE_X64")
+        },
+    }
+    try:
+        out["config"] = {
+            f.name: _jsonable(getattr(settings, f.name))
+            for f in dataclasses.fields(settings)
+        }
+    except Exception:
+        pass
+    try:
+        import jax
+
+        out["jax"] = str(jax.__version__)
+        out["backend"] = str(jax.default_backend())
+        out["devices"] = len(jax.devices())
+    except Exception:
+        pass
+    try:
+        from ..parallel import mesh as _mesh
+
+        out["mesh"] = _mesh.mesh_fingerprint(_mesh.get_mesh())
+    except Exception:
+        pass
+    return out
+
+
+class FlightRecorder:
+    """The incident capturer. Construct via :func:`flight` (or directly
+    in tests); :meth:`on_alert` is what the watchdog hook calls,
+    :meth:`capture` the underlying (and on-demand) bundle writer."""
+
+    def __init__(self, root: str | None = None,
+                 max_bundles: int | None = None,
+                 min_interval_s: float = 30.0, ring_tail: int = 512):
+        self.root = root or _DEFAULT_ROOT
+        self.max_bundles = max(
+            int(max_bundles if max_bundles is not None
+                else settings.flight_max), 1,
+        )
+        self.min_interval_s = max(float(min_interval_s), 0.0)
+        self.ring_tail = max(int(ring_tail), 1)
+        self.captures = 0
+        self.suppressed = 0
+        self.last_capture = None  # monotonic instant of the last bundle
+        self.last_bundle = None  # path of the last bundle written
+        self._lock = threading.Lock()
+        self._seq = 0
+
+    # -- the hook entry -----------------------------------------------------
+    def on_alert(self, transition: dict) -> str | None:
+        """Capture a bundle for one alert transition; returns the bundle
+        dir, or ``None`` when rate-limited (counted as suppressed)."""
+        return self.capture(
+            reason="alert",
+            rule=str(transition.get("rule", "?")),
+            transition=transition,
+        )
+
+    # -- capture ------------------------------------------------------------
+    def capture(self, reason: str = "manual", rule: str | None = None,
+                transition: dict | None = None,
+                profile: bool = False,
+                profile_seconds: float = 0.2) -> str | None:
+        """Write one postmortem bundle (module docstring has the
+        layout); returns its directory. Rate limiting applies to every
+        reason — a manual ``/debug/capture`` inside the window is
+        suppressed like an alert storm would be. Every write inside the
+        bundle is individually best-effort: a failing probe shrinks the
+        bundle, never kills the capture (and never the alert that
+        triggered it)."""
+        t0 = time.perf_counter()
+        with self._lock:
+            now = time.monotonic()
+            if (
+                self.last_capture is not None
+                and now - self.last_capture < self.min_interval_s
+            ):
+                self.suppressed += 1
+                _SUPPRESSED.inc()
+                return None
+            self.last_capture = now
+            self._seq += 1
+            seq = self._seq
+        stamp = time.strftime("%Y%m%dT%H%M%S", time.gmtime())
+        name = f"{stamp}.{seq:03d}-{rule or reason}"
+        path = os.path.join(self.root, name)
+        try:
+            os.makedirs(path, exist_ok=True)
+        except OSError:
+            return None  # unwritable root: captures silently unavailable
+        tail = _recorder.events()[-self.ring_tail:]
+        self._write_ring(path, tail)
+        self._write_metrics(path)
+        self._write_trace(path, tail)
+        profile_info = None
+        if profile:
+            from . import _profiler
+
+            profile_info = _profiler.capture_trace(
+                os.path.join(path, "profile"), seconds=profile_seconds,
+            )
+        self._write_manifest(
+            path, reason=reason, rule=rule, transition=transition,
+            events=len(tail), profile=profile_info,
+            captured_ms=round((time.perf_counter() - t0) * 1e3, 3),
+        )
+        with self._lock:
+            self.captures += 1
+            self.last_bundle = path
+        _metrics.counter(
+            _CAPTURES,
+            help="incident bundles written (rule label; 'manual' for "
+            "on-demand captures)",
+            rule=rule or reason,
+        ).inc()
+        _recorder.record(
+            "flight.capture", reason=reason, rule=rule or "",
+            dir=os.path.basename(path), events=len(tail),
+        )
+        self._prune()
+        return path
+
+    # -- bundle pieces (each individually best-effort) ----------------------
+    def _write_ring(self, path: str, tail: list) -> None:
+        try:
+            with open(os.path.join(path, "ring.jsonl"), "w") as f:
+                # lead with the identity record, same contract as a sink
+                # file: a bundle is self-describing about WHICH process
+                # (and which records.<pid>.jsonl) it came from
+                f.write(
+                    json.dumps(
+                        _recorder._session_start_event(),
+                        default=_recorder._jsonable,
+                    ) + "\n"
+                )
+                for ev in tail:
+                    f.write(
+                        json.dumps(ev, default=_recorder._jsonable) + "\n"
+                    )
+        except Exception:
+            pass
+
+    def _write_metrics(self, path: str) -> None:
+        payload: dict = {}
+        try:
+            payload["metrics"] = _metrics.snapshot()
+        except Exception:
+            pass
+        try:
+            from .. import plan_cache
+
+            payload["plan_cache"] = plan_cache.stats()
+        except Exception:
+            pass
+        try:
+            with open(os.path.join(path, "metrics.json"), "w") as f:
+                json.dump(payload, f, indent=1, sort_keys=True, default=str)
+                f.write("\n")
+        except Exception:
+            pass
+
+    def _write_trace(self, path: str, tail: list) -> None:
+        try:
+            from . import _trace
+
+            _trace.export_trace(os.path.join(path, "trace.json"),
+                                events=tail)
+        except Exception:
+            pass
+
+    def _write_manifest(self, path: str, reason: str, rule, transition,
+                        events: int, profile, captured_ms: float) -> None:
+        man: dict = {
+            "schema": 1,
+            "reason": reason,
+            "rule": rule or "",
+            "ts": time.time(),
+            "iso": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+            "events": events,
+            "captured_ms": captured_ms,
+        }
+        if transition:
+            man["transition"] = {
+                k: _jsonable(v) for k, v in transition.items()
+            }
+        if profile:
+            man["profile"] = profile
+        try:
+            man["process"] = dict(_recorder.process_identity())
+            man["session"] = dict(_recorder.session_info())
+        except Exception:
+            pass
+        try:
+            from . import _watchdog
+
+            man["watchdog"] = _watchdog.state()
+        except Exception:
+            pass
+        try:
+            from . import _health
+
+            man["health"] = _health.last_solve_report() or {}
+        except Exception:
+            pass
+        try:
+            from ..resilience import failover, faults
+
+            man["failover_latches"] = failover.latches()
+            man["faults"] = {
+                "active": bool(faults.ACTIVE),
+                "spec": settings.faults,
+                "fires": faults.stats(),
+            }
+        except Exception:
+            pass
+        try:
+            from ..batch import service
+
+            man["sessions"] = service.sessions_stats()
+        except Exception:
+            pass
+        try:
+            from . import _cost
+
+            man["programs"] = _cost.programs()
+        except Exception:
+            pass
+        man["fingerprint"] = _fingerprint()
+        try:
+            with open(os.path.join(path, "incident.json"), "w") as f:
+                json.dump(man, f, indent=1, sort_keys=True, default=str)
+                f.write("\n")
+        except Exception:
+            pass
+
+    def _prune(self) -> None:
+        """Retention bound: keep the newest ``max_bundles`` bundles
+        (names sort chronologically — the stamp.seq prefix)."""
+        try:
+            names = sorted(
+                n for n in os.listdir(self.root)
+                if os.path.isdir(os.path.join(self.root, n))
+            )
+        except OSError:
+            return
+        for n in names[: max(len(names) - self.max_bundles, 0)]:
+            shutil.rmtree(os.path.join(self.root, n), ignore_errors=True)
+
+    # -- views --------------------------------------------------------------
+    def state(self) -> dict:
+        """JSON-friendly recorder state (the ``/incidents`` payload)."""
+        with self._lock:
+            out = {
+                "enabled": True,
+                "root": self.root,
+                "max_bundles": self.max_bundles,
+                "min_interval_s": self.min_interval_s,
+                "captures": self.captures,
+                "suppressed": self.suppressed,
+                "last_bundle": (
+                    os.path.basename(self.last_bundle)
+                    if self.last_bundle else None
+                ),
+            }
+        out["bundles"] = bundles(self.root)
+        return out
+
+
+def bundles(root: str | None = None) -> list:
+    """Headline rows of every bundle under ``root`` (newest first):
+    name, rule, reason, iso timestamp, event count — what ``/incidents``
+    lists and ``axon_doctor --latest`` resolves against."""
+    root = root or _DEFAULT_ROOT
+    rows = []
+    try:
+        names = sorted(os.listdir(root), reverse=True)
+    except OSError:
+        return rows
+    for n in names:
+        man_path = os.path.join(root, n, "incident.json")
+        if not os.path.isfile(man_path):
+            continue
+        row = {"name": n}
+        try:
+            man = json.load(open(man_path))
+            for k in ("rule", "reason", "iso", "ts", "events"):
+                if k in man:
+                    row[k] = man[k]
+        except (OSError, json.JSONDecodeError, ValueError):
+            row["corrupt"] = True
+        rows.append(row)
+    return rows
+
+
+# ---------------------------------------------------------------------------
+# the process singleton (what the watchdog hook and /incidents use)
+# ---------------------------------------------------------------------------
+def _root_from_settings() -> str | None:
+    v = (settings.flight or "").strip()
+    if not v:
+        return None
+    if v.lower() in _TRUTHY:
+        return _DEFAULT_ROOT
+    return v
+
+
+def flight(root: str | None = None, **kw) -> FlightRecorder:
+    """Get-or-create the process flight recorder. An existing instance
+    is returned as-is (``stop_flight()`` first to reconfigure); with no
+    ``root`` the settings resolution applies (default incidents dir)."""
+    global _RECORDER
+    with _LOCK:
+        if _RECORDER is None:
+            _RECORDER = FlightRecorder(
+                root=root or _root_from_settings() or _DEFAULT_ROOT, **kw
+            )
+        return _RECORDER
+
+
+def current() -> FlightRecorder | None:
+    """The live process recorder, or ``None``."""
+    return _RECORDER
+
+
+def stop_flight() -> None:
+    """Drop the process recorder (bundles on disk are untouched)."""
+    global _RECORDER
+    with _LOCK:
+        _RECORDER = None
+
+
+def on_alert_transition(transition: dict) -> str | None:
+    """The watchdog hook target: capture a bundle for one alert
+    transition. Off path (no recorder AND ``SPARSE_TPU_FLIGHT`` unset)
+    is a single settings check — no filesystem, no singleton."""
+    fr = _RECORDER
+    if fr is None:
+        if _root_from_settings() is None:
+            return None  # disabled by default: nothing happens
+        fr = flight()
+    return fr.on_alert(transition)
+
+
+def capture_now(reason: str = "manual", profile: bool = True,
+                profile_seconds: float = 0.2) -> str | None:
+    """On-demand bundle (the ``/debug/capture`` endpoint): same layout
+    as an alert capture plus a ``jax.profiler`` trace of a short live
+    window. Creates the recorder if flight is enabled OR forced by the
+    explicit call (a manual capture is an operator action — it works
+    even when automatic capture is off)."""
+    return flight().capture(
+        reason=reason, profile=profile, profile_seconds=profile_seconds,
+    )
+
+
+def state() -> dict:
+    """The ``/incidents`` payload: recorder state + bundle listing, or a
+    disabled stub (which still lists any bundles already on disk at the
+    settings root, so a restarted exporter can show past incidents)."""
+    fr = _RECORDER
+    if fr is not None:
+        return fr.state()
+    root = _root_from_settings()
+    return {
+        "enabled": False,
+        "root": root,
+        "captures": 0,
+        "suppressed": 0,
+        "bundles": bundles(root) if root else [],
+    }

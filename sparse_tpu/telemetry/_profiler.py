@@ -1,0 +1,127 @@
+"""Measured device-time profiling: jax.profiler capture + dispatch sampling.
+
+Every "achieved vs roofline" number Axon reported before this module was
+host wall-clock over *analytic* ``cost_analysis`` flops — ``jax.profiler``
+existed only in comments (``coverage.py``). Ginkgo's batched-solver work
+(TOMS'22, PAPERS.md §2) shows kernel-level *measured* timing is what
+makes tuning actionable; this module adds the two measured surfaces:
+
+* :func:`capture_trace` — an on-demand ``jax.profiler`` trace of a short
+  live window, written into an incident bundle (``/debug/capture`` →
+  ``profile/`` under the bundle dir). XLA's own profiler data
+  (``*.xplane.pb`` + a Perfetto-openable ``*.trace.json.gz``) — the
+  ground truth under the wall clocks.
+* :func:`record_device_sample` — the always-on sink of the **sampled
+  timed-dispatch path** in ``batch/service.py``: every Nth bucket
+  dispatch (``SPARSE_TPU_PROFILE_EVERY``; 0 = off, the default) splits
+  its solve wall clock at the dispatch-return boundary into *host* time
+  (trace/dispatch overhead until the async call returns) and *device*
+  time (the ``block_until_ready`` wait), feeding the
+  ``batch.program_device_ms{program}`` /
+  ``batch.program_host_ms{program}`` histograms and the cost table's
+  measured columns (:func:`._cost.note_device_time`) — the
+  ``device_ms`` column in ``axon_report``'s roofline table.
+
+Overhead discipline: sampling takes ONE extra ``time.monotonic()`` per
+sampled dispatch and nothing at all when off; it never enters a traced
+program (the compiled bucket programs are byte-identical with sampling
+on or off — pinned by test) and adds no device syncs (the dispatch path
+already blocks on its results).
+"""
+
+from __future__ import annotations
+
+import glob as _glob
+import os
+import threading
+import time
+
+from . import _metrics, _recorder
+
+__all__ = ["capture_trace", "record_device_sample"]
+
+_LOCK = threading.Lock()
+_ACTIVE = False  # jax.profiler allows one trace at a time, process-wide
+
+_CAPTURES = _metrics.counter(
+    "profile.captures",
+    help="on-demand jax.profiler trace captures (ok or failed)",
+)
+
+_DEVICE_MS_HELP = (
+    "measured device time (block_until_ready wait) per sampled bucket "
+    "dispatch, milliseconds"
+)
+_HOST_MS_HELP = (
+    "measured host time (dispatch call until async return) per sampled "
+    "bucket dispatch, milliseconds"
+)
+
+
+def capture_trace(path: str, seconds: float = 0.2,
+                  workload=None) -> dict:
+    """Capture one ``jax.profiler`` trace window into ``path``.
+
+    ``workload`` (a zero-arg callable) runs inside the window when
+    given; otherwise the capture sleeps ``seconds`` so concurrently
+    serving threads' device activity lands in the trace. Returns a
+    JSON-friendly result dict (``ok``, ``dir``, ``files``, ``error``)
+    and never raises — a missing/odd profiler degrades to
+    ``ok=False``. One capture at a time process-wide (jax's own
+    constraint); a concurrent request reports busy instead of crashing
+    the running one."""
+    global _ACTIVE
+    out: dict = {"ok": False, "dir": path, "seconds": float(seconds)}
+    with _LOCK:
+        if _ACTIVE:
+            out["error"] = "a profiler capture is already running"
+            return out
+        _ACTIVE = True
+    t0 = time.perf_counter()
+    try:
+        import jax
+
+        os.makedirs(path, exist_ok=True)
+        jax.profiler.start_trace(path)
+        try:
+            if workload is not None:
+                workload()
+            else:
+                time.sleep(max(float(seconds), 0.0))
+        finally:
+            jax.profiler.stop_trace()
+        files = sorted(
+            os.path.relpath(p, path)
+            for p in _glob.glob(os.path.join(path, "**", "*"),
+                                recursive=True)
+            if os.path.isfile(p)
+        )
+        out["ok"] = True
+        out["files"] = files[:16]
+        out["wall_ms"] = round((time.perf_counter() - t0) * 1e3, 3)
+    except Exception as e:  # noqa: BLE001 - capture is best-effort
+        out["error"] = repr(e)[:200]
+    finally:
+        with _LOCK:
+            _ACTIVE = False
+    _CAPTURES.inc()
+    _recorder.record(
+        "profile.capture", ok=out["ok"], dir=path,
+        **({"error": out["error"]} if "error" in out else {}),
+    )
+    return out
+
+
+def record_device_sample(program: str, host_ms: float,
+                         device_ms: float) -> None:
+    """One sampled timed dispatch: feed the always-on per-program
+    device/host histograms and the cost table's measured columns."""
+    _metrics.histogram(
+        "batch.program_device_ms", help=_DEVICE_MS_HELP, program=program,
+    ).observe(device_ms)
+    _metrics.histogram(
+        "batch.program_host_ms", help=_HOST_MS_HELP, program=program,
+    ).observe(host_ms)
+    from . import _cost
+
+    _cost.note_device_time(program, host_ms, device_ms)

@@ -132,6 +132,15 @@ KINDS: dict[str, frozenset] = {
     # the matching firing -> ok transition (hysteresis satisfied), with
     # the clearing value and how long the alert was active
     "watchdog.clear": frozenset({"rule"}),
+    # -- incident flight recorder (telemetry/_flight.py, ISSUE 12) ----------
+    # one postmortem bundle written: reason is 'alert' (a watchdog
+    # transition captured it) or 'manual' (/debug/capture), rule the
+    # triggering rule name ('' for manual), dir the bundle directory
+    # basename under results/axon/incidents/
+    "flight.capture": frozenset({"reason", "dir"}),
+    # one on-demand jax.profiler trace window (telemetry/_profiler.py):
+    # ok whether the capture landed; failed captures carry `error`
+    "profile.capture": frozenset({"ok", "dir"}),
     # -- generic ------------------------------------------------------------
     # one per process per sink file, written before the first event: the
     # controller's identity (process_index/pid/process_count, device

@@ -19,6 +19,14 @@ serving operator scrapes:
   per-rule state/value/thresholds, the currently-firing set, tick
   count. A disabled stub when no watchdog exists; the active set is
   also summarized on ``/healthz``.
+* ``/incidents`` — the flight recorder's state (:mod:`._flight`):
+  capture/suppression counts plus the on-disk bundle listing
+  (``scripts/axon_doctor.py`` analyzes a bundle). A disabled stub
+  (which still lists pre-existing bundles) when capture is off.
+* ``/debug/capture`` — ISSUE 12: trigger an on-demand postmortem bundle
+  including a short ``jax.profiler`` trace window (:mod:`._profiler`);
+  responds with the bundle name (or the rate-limit refusal). The only
+  endpoint with a side effect — it writes under the incidents root.
 
 Port robustness (ISSUE 11 satellite): the listener binds with
 ``SO_REUSEADDR`` and, when the requested port is already taken (the CI
@@ -36,11 +44,12 @@ is the CLI over this module.
 from __future__ import annotations
 
 import json
+import os
 import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
-from . import _health, _metrics, _recorder, _watchdog
+from . import _flight, _health, _metrics, _recorder, _watchdog
 
 _LOCK = threading.Lock()
 _SERVER = None
@@ -112,6 +121,7 @@ def _healthz() -> dict:
     wd = _watchdog.state()
     active_alerts = list(wd.get("active") or ())
     degraded = bool(latches) or bool(anomalies) or bool(active_alerts)
+    fl = _flight.current()
     return {
         "status": "degraded" if degraded else "ok",
         "uptime_s": round(time.monotonic() - (_SERVER.t0 if _SERVER else 0), 3)
@@ -120,11 +130,24 @@ def _healthz() -> dict:
         "last_solve_anomalies": anomalies,
         "failover_latches": latches,
         "faults": faults_status,
+        # failed best-effort device syncs (ISSUE 12 satellite): nonzero
+        # means a backend errored inside block_until_ready and the
+        # error was swallowed — silent degradation made visible
+        "span_sync_errors": _metrics.counter(
+            "telemetry.span_sync_errors"
+        ).value,
         # the watchdog's firing set (ISSUE 11): /alerts has the detail
         "alerts": {
             "enabled": bool(wd.get("enabled")),
             "active": active_alerts,
             "count": len(active_alerts),
+        },
+        # the flight recorder's headline (ISSUE 12): /incidents has the
+        # bundle listing
+        "incidents": {
+            "enabled": fl is not None,
+            "captures": fl.captures if fl else 0,
+            "suppressed": fl.suppressed if fl else 0,
         },
     }
 
@@ -188,11 +211,28 @@ class _Handler(BaseHTTPRequestHandler):
                 self._send_json(_session())
             elif path == "/alerts":
                 self._send_json(_watchdog.state())
+            elif path == "/incidents":
+                self._send_json(_flight.state())
+            elif path == "/debug/capture":
+                bundle = _flight.capture_now(reason="manual")
+                if bundle is None:
+                    # rate-limited (or unwritable root): say so rather
+                    # than silently returning an empty success
+                    self._send_json(
+                        {"ok": False, "reason": "rate-limited"}, 429
+                    )
+                else:
+                    self._send_json({
+                        "ok": True,
+                        "bundle": os.path.basename(bundle),
+                        "dir": bundle,
+                    })
             elif path == "/":
                 self._send(
                     200,
                     b"sparse_tpu axon exporter: "
-                    b"/metrics /healthz /session /alerts\n",
+                    b"/metrics /healthz /session /alerts /incidents "
+                    b"/debug/capture\n",
                     "text/plain; charset=utf-8",
                 )
             else:

@@ -18,7 +18,17 @@ from __future__ import annotations
 import time
 
 from ..config import settings
-from . import _recorder
+from . import _metrics, _recorder
+
+# Failed best-effort device syncs used to vanish silently (ISSUE 12
+# satellite): a backend erroring inside block_until_ready is exactly the
+# kind of degradation an operator should see. Always-on counter,
+# surfaced on /healthz.
+_SYNC_ERRORS = _metrics.counter(
+    "telemetry.span_sync_errors",
+    help="best-effort device syncs (span exit / device_sync) that "
+    "raised — silent device errors surfacing",
+)
 
 
 class _NullSpan:
@@ -80,7 +90,9 @@ class Span:
 
                 jax.block_until_ready(self._sync)
             except Exception:
-                pass  # sync is best-effort; the wall clock still stands
+                # sync stays best-effort (the wall clock still stands),
+                # but the failure is counted — see _SYNC_ERRORS
+                _SYNC_ERRORS.inc()
         dur = time.perf_counter() - self._t0
         _recorder.add_span(self.name, dur)
         if self.emit:
@@ -129,5 +141,5 @@ def device_sync(value):
 
         jax.block_until_ready(value)
     except Exception:
-        pass
+        _SYNC_ERRORS.inc()
     return value

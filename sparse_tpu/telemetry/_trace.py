@@ -52,6 +52,9 @@ _LANES = (
     (7, "spans", ("span",)),
     (8, "resilience", ("fault.", "checkpoint.", "resilience.")),
     (9, "session", ("session.",)),
+    # incident flight recorder + profiler captures (ISSUE 12): the
+    # postmortem markers render on their own lane, never "other"
+    (12, "incidents", ("flight.", "profile.", "watchdog.", "loadgen.")),
 )
 _TICKETS_PID = 10
 _OTHER_PID = 11

@@ -48,12 +48,14 @@ from . import _metrics, _recorder
 __all__ = [
     "Rule",
     "Watchdog",
+    "add_alert_hook",
     "anomaly_rate_rule",
     "current",
     "default_rules",
     "device_occupancy_rule",
     "failover_rule",
     "queue_depth_rule",
+    "remove_alert_hook",
     "slo_miss_rate_rule",
     "state",
     "stop_watchdog",
@@ -128,7 +130,9 @@ class Watchdog:
         self.ticks = 0
         self.t0 = time.monotonic()
         self._states = {r.name: _RuleState(r) for r in rules}
-        self._lock = threading.Lock()
+        # reentrant: alert hooks (the flight recorder) run inside
+        # evaluate()'s critical section and may read state() back
+        self._lock = threading.RLock()
         self._thread = None
         self._stop = threading.Event()
 
@@ -193,8 +197,19 @@ class Watchdog:
             "watchdog.alert", rule=r.name, severity=r.severity,
             value=round(v, 6), trigger=r.trigger, op=r.op,
         )
-        return {"event": "alert", "rule": r.name, "severity": r.severity,
-                "value": v}
+        t = {"event": "alert", "rule": r.name, "severity": r.severity,
+             "value": v, "trigger": r.trigger, "op": r.op}
+        # the incident hook point (ISSUE 12): every ok -> firing
+        # transition is offered to the registered alert hooks — the
+        # flight recorder's postmortem capture rides this. A hook must
+        # never break the tick (or the dispatch that triggered an
+        # on-demand evaluate), so each one is isolated.
+        for hook in list(_ALERT_HOOKS):
+            try:
+                hook(t)
+            except Exception:  # noqa: BLE001 - hooks never kill an alert
+                pass
+        return t
 
     def _clear(self, r: Rule, v: float, active_s: float) -> dict:
         _metrics.counter(
@@ -275,6 +290,42 @@ class Watchdog:
         if t is not None:
             t.join(timeout=5)
         self._thread = None
+
+
+# ---------------------------------------------------------------------------
+# alert hooks (ISSUE 12): callbacks offered every ok -> firing transition
+# ---------------------------------------------------------------------------
+def _flight_hook(transition: dict) -> None:
+    """The built-in hook: hand the transition to the incident flight
+    recorder (:mod:`._flight`), which decides for itself whether capture
+    is enabled/rate-limited. Imported lazily — the module cycle
+    (_flight reads watchdog state into its bundles) stays one-way at
+    import time."""
+    from . import _flight
+
+    _flight.on_alert_transition(transition)
+
+
+_ALERT_HOOKS: list = [_flight_hook]
+
+
+def add_alert_hook(fn) -> None:
+    """Register a callback invoked (best-effort, exceptions swallowed)
+    on every rule's ok -> firing transition with the transition dict
+    (``{"event": "alert", "rule", "severity", "value", "trigger",
+    "op"}``). Hooks are process-global: every Watchdog instance fires
+    them."""
+    if fn not in _ALERT_HOOKS:
+        _ALERT_HOOKS.append(fn)
+
+
+def remove_alert_hook(fn) -> None:
+    """Unregister a previously added hook (idempotent; the built-in
+    flight hook can be removed too — tests isolating capture do)."""
+    try:
+        _ALERT_HOOKS.remove(fn)
+    except ValueError:
+        pass
 
 
 # ---------------------------------------------------------------------------

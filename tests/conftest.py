@@ -65,6 +65,7 @@ _QUICK_FILES = {
     "test_dia_spmv.py",
     "test_dist.py",
     "test_fleet.py",
+    "test_flight.py",
     "test_grid2d.py",
     "test_io.py",
     "test_loadgen.py",

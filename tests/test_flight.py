@@ -1,0 +1,632 @@
+"""Axon v6 (ISSUE 12): incident flight recorder, alert-triggered
+postmortem bundles, measured device-time profiling, and the doctor.
+
+Pins the PR's contracts:
+
+* **watchdog hook** — alert transitions reach registered hooks; the
+  flight path is rate-limited (one bundle per window), count-bounded
+  (oldest pruned), and OFF by default (no filesystem touch without
+  ``SPARSE_TPU_FLIGHT`` or an explicit recorder);
+* **bundle contents under the multi-process sink split** — a bundle
+  captured by (simulated) process 1 carries THAT process's identity
+  block and ring tail;
+* **sampled device profiling** — ``profile_every`` feeds the always-on
+  ``batch.program_device_ms{program}`` histogram and the
+  ``batch.dispatch`` event's ``device_ms``/``host_ms`` split, while the
+  OFF path leaves dispatch programs (jaxpr) and host-sync counts
+  byte-identical and emits no extra fields;
+* **doctor diagnosis** — the rule+chain signatures name the right
+  probable cause, stdlib-only;
+* **satellites** — span-sync-error counter, incident retention in
+  trim_records, axon_report ``--trend``.
+"""
+
+import importlib.util
+import json
+import os
+
+import numpy as np
+import pytest
+import scipy.sparse as sp
+
+import sparse_tpu  # noqa: F401 - jax config side effects
+from sparse_tpu import telemetry
+from sparse_tpu.batch import SolveSession
+from sparse_tpu.config import settings
+from sparse_tpu.telemetry import _flight, _metrics, _recorder, _watchdog
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load(name):
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(REPO, "scripts", f"{name}.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.fixture
+def tel(tmp_path, monkeypatch):
+    """Telemetry on with an isolated sink; flight singleton isolated."""
+    telemetry.reset()
+    _flight.stop_flight()
+    monkeypatch.setattr(settings, "telemetry", True)
+    telemetry.configure(str(tmp_path / "records.jsonl"))
+    yield tmp_path
+    telemetry.configure(None)
+    _flight.stop_flight()
+    telemetry.reset()
+
+
+def _tridiag(n=48, seed=0):
+    rng = np.random.default_rng(seed)
+    e = np.ones(n)
+    A = sp.diags([-e[:-1], 3.0 * e, -e[:-1]], [-1, 0, 1], format="csr")
+    A.setdiag(3.0 + rng.random(n))
+    A.sort_indices()
+    return A.tocsr()
+
+
+# -- watchdog alert hooks -----------------------------------------------------
+
+
+def test_alert_hook_receives_transitions(tel):
+    got = []
+    _watchdog.add_alert_hook(got.append)
+    try:
+        wd = _watchdog.Watchdog(
+            rules=[_watchdog.Rule("hook_t", lambda: 1.0, 0.5)]
+        )
+        wd.evaluate()
+    finally:
+        _watchdog.remove_alert_hook(got.append)
+    assert len(got) == 1
+    t = got[0]
+    assert t["rule"] == "hook_t" and t["event"] == "alert"
+    assert t["value"] == 1.0 and t["trigger"] == 0.5
+
+
+def test_alert_hook_exception_never_kills_the_tick(tel):
+    def bad(_t):
+        raise RuntimeError("hook crash")
+
+    _watchdog.add_alert_hook(bad)
+    try:
+        wd = _watchdog.Watchdog(
+            rules=[_watchdog.Rule("hook_bad", lambda: 1.0, 0.5)]
+        )
+        trans = wd.evaluate()
+    finally:
+        _watchdog.remove_alert_hook(bad)
+    assert [t["rule"] for t in trans] == ["hook_bad"]
+
+
+def test_flight_disabled_by_default_off_path(tel, monkeypatch):
+    """Without SPARSE_TPU_FLIGHT and without an explicit recorder, an
+    alert transition must not create a singleton, a directory, or any
+    file — the off path is one settings check."""
+    monkeypatch.setattr(settings, "flight", "")
+    _flight.stop_flight()
+    default_root = _flight._DEFAULT_ROOT
+    before = (
+        sorted(os.listdir(default_root))
+        if os.path.isdir(default_root) else None
+    )
+    out = _flight.on_alert_transition(
+        {"rule": "slo_miss_rate", "severity": "page", "value": 1.0}
+    )
+    assert out is None
+    assert _flight.current() is None
+    after = (
+        sorted(os.listdir(default_root))
+        if os.path.isdir(default_root) else None
+    )
+    assert after == before
+    st = _flight.state()
+    assert st["enabled"] is False and st["captures"] == 0
+
+
+def test_flight_env_enables_lazy_singleton(tel, monkeypatch, tmp_path):
+    root = str(tmp_path / "incidents")
+    monkeypatch.setattr(settings, "flight", root)
+    _flight.stop_flight()
+    try:
+        out = _flight.on_alert_transition(
+            {"rule": "queue_depth", "severity": "warn", "value": 600.0,
+             "trigger": 512.0}
+        )
+        assert out is not None and out.startswith(root)
+        assert _flight.current() is not None
+        assert os.path.isfile(os.path.join(out, "incident.json"))
+    finally:
+        _flight.stop_flight()
+
+
+# -- capture semantics: rate limit, bound, contents ---------------------------
+
+
+def test_capture_rate_limit(tel, tmp_path):
+    fr = _flight.FlightRecorder(
+        root=str(tmp_path / "inc"), min_interval_s=120.0,
+    )
+    base = _flight._SUPPRESSED.value
+    b1 = fr.capture(reason="alert", rule="r1")
+    assert b1 is not None
+    assert fr.capture(reason="alert", rule="r1") is None
+    assert fr.capture(reason="manual") is None  # manual limited too
+    assert fr.suppressed == 2
+    assert _flight._SUPPRESSED.value == base + 2
+    names = os.listdir(str(tmp_path / "inc"))
+    assert len(names) == 1
+
+
+def test_capture_bound_prunes_oldest(tel, tmp_path):
+    root = str(tmp_path / "inc")
+    fr = _flight.FlightRecorder(root=root, max_bundles=2,
+                                min_interval_s=0.0)
+    dirs = [fr.capture(reason="alert", rule=f"r{i}") for i in range(4)]
+    assert all(dirs)
+    kept = sorted(os.listdir(root))
+    assert len(kept) == 2
+    # the two NEWEST survive (names sort chronologically)
+    assert kept == sorted(os.path.basename(d) for d in dirs[-2:])
+
+
+def test_bundle_contents_and_event(tel, tmp_path):
+    telemetry.record("fault.injected", site="dispatch", fault="delay",
+                     ms=150)
+    fr = _flight.FlightRecorder(root=str(tmp_path / "inc"),
+                                min_interval_s=0.0)
+    b = fr.capture(
+        reason="alert", rule="slo_miss_rate",
+        transition={"rule": "slo_miss_rate", "severity": "page",
+                    "value": 0.9, "trigger": 0.5},
+    )
+    assert sorted(os.listdir(b)) == [
+        "incident.json", "metrics.json", "ring.jsonl", "trace.json",
+    ]
+    man = json.load(open(os.path.join(b, "incident.json")))
+    assert man["rule"] == "slo_miss_rate"
+    assert man["transition"]["value"] == 0.9
+    assert man["process"]["pid"] == os.getpid()
+    assert "watchdog" in man and "fingerprint" in man
+    assert man["fingerprint"]["config"]["telemetry"] is True
+    ring = [json.loads(ln) for ln in open(os.path.join(b, "ring.jsonl"))]
+    assert ring[0]["kind"] == "session.start"
+    assert any(ev["kind"] == "fault.injected" for ev in ring)
+    mets = json.load(open(os.path.join(b, "metrics.json")))
+    assert "plan_cache" in mets and "metrics" in mets
+    trace = json.load(open(os.path.join(b, "trace.json")))
+    assert "traceEvents" in trace
+    # the capture is itself an event + an always-on counter
+    evs = telemetry.events("flight.capture")
+    assert evs and evs[-1]["rule"] == "slo_miss_rate"
+    assert _metrics.counter(
+        "flight.captures", rule="slo_miss_rate"
+    ).value >= 1
+    # the /incidents listing sees it
+    st = fr.state()
+    assert st["captures"] == 1
+    assert st["bundles"][0]["rule"] == "slo_miss_rate"
+
+
+def test_bundle_carries_split_sink_identity(tel, tmp_path, monkeypatch):
+    """Multi-process sink split (ISSUE 12 satellite): the bundle a
+    simulated process 1 captures must carry THAT process's identity
+    block (pi=1, split sink path) and its own ring tail."""
+    monkeypatch.setenv("SPARSE_TPU_PROCESS_COUNT", "2")
+    monkeypatch.setenv("SPARSE_TPU_PROCESS_INDEX", "1")
+    _recorder.reset_identity()
+    telemetry.configure(str(tmp_path / "records.jsonl"))
+    try:
+        telemetry.record("span", name="p1.work", dur_s=0.01)
+        assert telemetry.sink_path().endswith(
+            f"records.{os.getpid()}.jsonl"
+        )
+        fr = _flight.FlightRecorder(root=str(tmp_path / "inc"),
+                                    min_interval_s=0.0)
+        b = fr.capture(reason="alert", rule="anomaly_rate")
+        man = json.load(open(os.path.join(b, "incident.json")))
+        assert man["process"]["pi"] == 1
+        assert man["process"]["procs"] == 2
+        ring = [
+            json.loads(ln) for ln in open(os.path.join(b, "ring.jsonl"))
+        ]
+        # identity block first, stamped with the split-process identity
+        assert ring[0]["kind"] == "session.start" and ring[0]["pi"] == 1
+        spans = [ev for ev in ring if ev.get("kind") == "span"]
+        assert any(ev.get("name") == "p1.work" for ev in spans)
+        assert all(ev["pi"] == 1 for ev in spans)
+    finally:
+        _recorder.reset_identity()
+
+
+def test_watchdog_alert_auto_captures_once(tel, tmp_path):
+    """The full hook chain: a firing rule writes exactly one bundle
+    through the singleton; the clear does not capture."""
+    _flight.stop_flight()
+    _flight.flight(root=str(tmp_path / "inc"), min_interval_s=0.0)
+    level = {"v": 1.0}
+    try:
+        wd = _watchdog.Watchdog(rules=[
+            _watchdog.Rule("auto_t", lambda: level["v"], 0.5, clear=0.2)
+        ])
+        wd.evaluate()
+        names = os.listdir(str(tmp_path / "inc"))
+        assert len(names) == 1 and names[0].endswith("-auto_t")
+        level["v"] = 0.0
+        wd.evaluate()  # clears; must not capture a second bundle
+        assert len(os.listdir(str(tmp_path / "inc"))) == 1
+    finally:
+        _flight.stop_flight()
+
+
+# -- sampled device-time profiling -------------------------------------------
+
+
+def _mats(n=48, B=3):
+    mats = [_tridiag(n, seed=i) for i in range(B)]
+    rhs = np.random.default_rng(5).standard_normal((B, n))
+    return mats, rhs
+
+
+def test_profile_sampling_records_device_split(tel):
+    mats, rhs = _mats()
+    ses = SolveSession("cg", profile_every=1)
+    ses.solve_many(mats, rhs, tol=1e-8)
+    ev = telemetry.events("batch.dispatch")[-1]
+    assert "device_ms" in ev and "host_ms" in ev
+    assert ev["device_ms"] >= 0.0 and ev["host_ms"] >= 0.0
+    # the split tiles the solve wall (within rounding)
+    assert ev["device_ms"] + ev["host_ms"] <= ev["solve_ms"] + 0.1
+    fam = _metrics.family("batch.program_device_ms")
+    assert any(m.count >= 1 for m in fam)
+    from sparse_tpu.telemetry import _cost
+
+    progs = _cost.programs()
+    key = str(ev["program"])
+    assert progs[key]["device_samples"] >= 1
+    assert progs[key]["device_ms_mean"] >= 0.0
+
+
+def test_profile_every_n_samples_every_nth(tel):
+    mats, rhs = _mats()
+    ses = SolveSession("cg", profile_every=2)
+    for _ in range(4):  # 4 dispatches -> exactly 2 sampled
+        for A, b in zip(mats, rhs):
+            ses.submit(A, b, tol=1e-8)
+        ses.flush()
+    evs = telemetry.events("batch.dispatch")
+    sampled = [e for e in evs if "device_ms" in e]
+    assert len(evs) == 4 and len(sampled) == 2
+
+
+def test_profile_off_is_byte_identical(tel):
+    """The acceptance pin: sampling OFF (default) leaves the dispatch
+    programs (jaxpr), plan-cache keys, host-sync counts and event
+    fields exactly as they were — and ON changes only host-side
+    timing, never the compiled program."""
+    import jax
+
+    mats, rhs = _mats()
+    ses_off = SolveSession("cg")
+    assert ses_off.profile_every == 0  # the default env
+    ses_on = SolveSession("cg", profile_every=1)
+    pat_off = ses_off.pattern_of(mats[0])
+    pat_on = ses_on.pattern_of(mats[0])
+    dt = np.dtype(np.result_type(mats[0].data.dtype, rhs.dtype))
+    prog_off = ses_off._build_program(pat_off, 4, dt)
+    prog_on = ses_on._build_program(pat_on, 4, dt)
+    args = (
+        np.zeros((4, pat_off.nnz), dt), np.zeros((4, 48), dt),
+        np.zeros((4, 48), dt), np.zeros(4), 10,
+    )
+    def jaxpr_of(prog):
+        # two sessions hold distinct (but functionally identical) pack
+        # closures; volatile object addresses in the repr are not
+        # program structure
+        import re
+
+        return re.sub(r"0x[0-9a-f]+", "0x", str(jax.make_jaxpr(prog)(*args)))
+
+    assert jaxpr_of(prog_off) == jaxpr_of(prog_on)
+
+    def syncs_of(ses):
+        base = _metrics.counter(
+            "telemetry.counts", name="host_sync.int"
+        ).value
+        ses.solve_many(mats, rhs, tol=1e-8)
+        return _metrics.counter(
+            "telemetry.counts", name="host_sync.int"
+        ).value - base
+
+    assert syncs_of(ses_off) == syncs_of(ses_on)
+    off_evs = [
+        e for e in telemetry.events("batch.dispatch")
+        if "device_ms" not in e
+    ]
+    assert off_evs  # the off path emitted, without the sampled fields
+    assert all("host_ms" not in e for e in off_evs)
+
+
+def test_profiler_capture_trace(tel, tmp_path):
+    res = telemetry.profile_capture(str(tmp_path / "prof"), seconds=0.01)
+    assert res["ok"] is True
+    assert res["files"]  # xplane/trace artifacts landed
+    evs = telemetry.events("profile.capture")
+    assert evs and evs[-1]["ok"] is True
+
+
+def test_debug_capture_bundle_includes_profile(tel, tmp_path):
+    _flight.stop_flight()
+    _flight.flight(root=str(tmp_path / "inc"), min_interval_s=0.0)
+    try:
+        b = _flight.capture_now(reason="manual", profile=True,
+                                profile_seconds=0.01)
+        assert b is not None
+        man = json.load(open(os.path.join(b, "incident.json")))
+        assert man["reason"] == "manual"
+        assert man["profile"]["ok"] is True
+        assert os.path.isdir(os.path.join(b, "profile"))
+    finally:
+        _flight.stop_flight()
+
+
+# -- serve endpoints ----------------------------------------------------------
+
+
+def test_serve_incidents_and_capture_endpoints(tel, tmp_path):
+    import urllib.request
+
+    _flight.stop_flight()
+    _flight.flight(root=str(tmp_path / "inc"), min_interval_s=0.0)
+    try:
+        with telemetry.serve(port=0) as srv:
+            inc = json.loads(
+                urllib.request.urlopen(
+                    f"{srv.url}/incidents", timeout=10
+                ).read()
+            )
+            assert inc["enabled"] is True and inc["captures"] == 0
+            cap = json.loads(
+                urllib.request.urlopen(
+                    f"{srv.url}/debug/capture", timeout=30
+                ).read()
+            )
+            assert cap["ok"] is True and cap["bundle"]
+            inc2 = json.loads(
+                urllib.request.urlopen(
+                    f"{srv.url}/incidents", timeout=10
+                ).read()
+            )
+            assert inc2["captures"] == 1
+            assert inc2["bundles"][0]["name"] == cap["bundle"]
+            hz = json.loads(
+                urllib.request.urlopen(
+                    f"{srv.url}/healthz", timeout=10
+                ).read()
+            )
+            assert hz["incidents"]["enabled"] is True
+            assert hz["incidents"]["captures"] == 1
+            assert "span_sync_errors" in hz
+    finally:
+        _flight.stop_flight()
+
+
+# -- the doctor ---------------------------------------------------------------
+
+
+def _bundle_with(tmp_path, rule, events, latches=None, faults_cfg=None):
+    b = tmp_path / "inc" / f"20260101T000000.001-{rule}"
+    os.makedirs(b, exist_ok=True)
+    man = {
+        "schema": 1, "reason": "alert", "rule": rule,
+        "ts": 1700000000.0, "iso": "2026-01-01T00:00:00Z",
+        "transition": {"rule": rule, "severity": "page", "value": 1.0,
+                       "trigger": 0.5},
+        "process": {"pi": 0, "pid": 1234},
+        "failover_latches": latches or {},
+        "faults": faults_cfg or {},
+    }
+    with open(b / "incident.json", "w") as f:
+        json.dump(man, f)
+    with open(b / "ring.jsonl", "w") as f:
+        for ev in events:
+            f.write(json.dumps(ev) + "\n")
+    return str(b)
+
+
+def test_doctor_names_injected_delay(tmp_path):
+    doctor = _load("axon_doctor")
+    b = _bundle_with(
+        tmp_path, "slo_miss_rate",
+        [{"kind": "fault.injected", "ts": 1.0, "site": "dispatch",
+          "fault": "delay", "ms": 150},
+         {"kind": "batch.dispatch", "ts": 2.0, "solver": "cg",
+          "batch": 4, "bucket": 4}],
+        faults_cfg={"active": True, "spec": "delay:dispatch:ms=150"},
+    )
+    man, evs = doctor.load_bundle(b)
+    diag = doctor.diagnose(man, evs)
+    assert diag["cause"] == "injected-dispatch-delay"
+    assert "dispatch delay" in diag["probable_cause"]
+    assert diag["rule"] == "slo_miss_rate"
+
+
+def test_doctor_names_failover_and_vault(tmp_path):
+    doctor = _load("axon_doctor")
+    b = _bundle_with(
+        tmp_path, "failover_latched",
+        [{"kind": "kernel.failover", "ts": 1.0, "kernel": "sell_spmv",
+          "error": "boom"}],
+        latches={"sell_spmv": 1},
+    )
+    man, evs = doctor.load_bundle(b)
+    assert doctor.diagnose(man, evs)["cause"] == "pallas-failover"
+    b2 = _bundle_with(
+        tmp_path, "vault_quarantine",
+        [{"kind": "vault.quarantine", "ts": 1.0,
+          "artifact": "sell_pattern", "reason": "checksum"}],
+    )
+    man2, evs2 = doctor.load_bundle(b2)
+    d2 = doctor.diagnose(man2, evs2)
+    assert d2["cause"] == "vault-corruption"
+
+
+def test_doctor_compile_tax_and_unknown(tmp_path):
+    doctor = _load("axon_doctor")
+    b = _bundle_with(
+        tmp_path, "slo_miss_rate",
+        [{"kind": "plan_cache.compile", "ts": 1.0,
+          "program": "batch.cg.B8.<f8"}],
+    )
+    man, evs = doctor.load_bundle(b)
+    assert doctor.diagnose(man, evs)["cause"] == "compile-tax"
+    b2 = _bundle_with(tmp_path, "", [{"kind": "span", "ts": 1.0,
+                                      "name": "x", "dur_s": 0.1}])
+    man2, evs2 = doctor.load_bundle(b2)
+    assert doctor.diagnose(man2, evs2)["cause"] == "unknown"
+
+
+def test_doctor_cli_resolves_newest_and_exits_clean(tel, tmp_path,
+                                                    capsys):
+    doctor = _load("axon_doctor")
+    _bundle_with(
+        tmp_path, "anomaly_rate",
+        [{"kind": "solver.anomaly", "ts": 1.0, "solver": "cg",
+          "reason": "stagnation"}],
+    )
+    root = str(tmp_path / "inc")
+    assert doctor.main([root, "--json"]) == 0
+    out = json.loads(capsys.readouterr().out)
+    assert out["cause"] == "solver-anomalies"
+    assert doctor.main([str(tmp_path / "nope")]) == 2
+
+
+# -- satellites ---------------------------------------------------------------
+
+
+def test_span_sync_errors_counted(tel, monkeypatch):
+    from sparse_tpu.telemetry import _spans
+
+    base = _spans._SYNC_ERRORS.value
+
+    class Boom:
+        pass
+
+    def bad_block(x):
+        raise RuntimeError("device gone")
+
+    import jax
+
+    monkeypatch.setattr(jax, "block_until_ready", bad_block)
+    with telemetry.span("t.sync", sync=Boom()):
+        pass
+    telemetry.device_sync(Boom())
+    assert _spans._SYNC_ERRORS.value == base + 2
+
+
+def test_trim_incidents_keeps_newest(tmp_path):
+    trim = _load("trim_records")
+    root = str(tmp_path / "incidents")
+    for i in range(6):
+        d = os.path.join(root, f"20260101T00000{i}.001-r{i}")
+        os.makedirs(d)
+        with open(os.path.join(d, "incident.json"), "w") as f:
+            json.dump({"rule": f"r{i}"}, f)
+    # a manifest-less dir is not a bundle: never touched
+    os.makedirs(os.path.join(root, "not-a-bundle"))
+    removed = trim.trim_incidents(root=root, keep=2)
+    assert removed == 4
+    kept = sorted(os.listdir(root))
+    assert "not-a-bundle" in kept
+    bundles = [n for n in kept if n != "not-a-bundle"]
+    assert bundles == ["20260101T000004.001-r4", "20260101T000005.001-r5"]
+    assert trim.trim_incidents(root=root, keep=2, dry_run=True) == 0
+
+
+def test_report_trend_joins_bench_rounds(tmp_path):
+    report = _load("axon_report")
+    rows = [
+        (1, 500.0, None), (2, 550.0, 120.5), (3, 600.0, 140.25),
+    ]
+    for n, iters, rps in rows:
+        tail = ""
+        if rps is not None:
+            tail = json.dumps({
+                "metric": f"cg_iters_per_s_pde512_cpu", "value": iters,
+                "sustained_cg": {"achieved_rps": rps, "p95_ms": 20.0,
+                                 "slo_miss_rate": 0.0},
+                "cold_start": {"cold_s": 1.5, "warm_s": 0.1},
+            }) + "\n"
+        with open(tmp_path / f"BENCH_r0{n}.json", "w") as f:
+            json.dump({
+                "n": n, "rc": 0, "tail": tail,
+                "parsed": {"metric": "cg_iters_per_s_pde512_cpu",
+                           "value": iters, "unit": "iters/s"},
+            }, f)
+    trend = report.build_trend(
+        sorted(str(tmp_path / f"BENCH_r0{n}.json") for n, _, _ in rows)
+    )
+    assert len(trend["rounds"]) == 3
+    assert trend["series"]["cg_iters_per_s"] == [
+        ["BENCH_r01.json", 500.0], ["BENCH_r02.json", 550.0],
+        ["BENCH_r03.json", 600.0],
+    ]
+    assert trend["series"]["sustained_cg.achieved_rps"] == [
+        ["BENCH_r02.json", 120.5], ["BENCH_r03.json", 140.25],
+    ]
+    assert trend["rounds"][1]["cold_start"]["warm_s"] == 0.1
+    # the CLI path over the committed rounds always succeeds
+    assert report.main(["--trend", "--quiet"]) == 0
+
+
+def test_report_programs_table_gains_device_column(tmp_path):
+    report = _load("axon_report")
+    path = str(tmp_path / "r.jsonl")
+    with open(path, "w") as f:
+        f.write(json.dumps({
+            "kind": "plan_cache.compile", "ts": 1.0,
+            "program": "batch.cg.B4.<f8", "flops": 1e9, "bytes": 1e8,
+            "compile_s": 0.5,
+        }) + "\n")
+        for i, (dev, host) in enumerate([(2.0, 1.0), (4.0, 3.0)]):
+            f.write(json.dumps({
+                "kind": "batch.dispatch", "ts": 2.0 + i, "solver": "cg",
+                "batch": 4, "bucket": 4, "program": "batch.cg.B4.<f8",
+                "solve_ms": dev + host + 1.0, "device_ms": dev,
+                "host_ms": host,
+            }) + "\n")
+        f.write(json.dumps({
+            "kind": "batch.dispatch", "ts": 9.0, "solver": "cg",
+            "batch": 4, "bucket": 4, "program": "batch.cg.B4.<f8",
+            "solve_ms": 5.0,
+        }) + "\n")
+    rep = report.build_report(path)
+    p = rep["programs"]["batch.cg.B4.<f8"]
+    assert p["solves"] == 3
+    assert p["device_samples"] == 2
+    assert p["device_ms_mean"] == 3.0
+    assert p["host_ms_mean"] == 2.0
+    # device-clock achieved rate: 1e9 flops * 2 samples / 6ms
+    assert p["achieved_gflops_dev"] == pytest.approx(
+        1e9 * 2 / 6e-3 / 1e9, rel=1e-6
+    )
+    assert rep["metrics"]["program.batch.cg.B4.<f8.device_ms_mean"] == {
+        "v": 3.0, "hib": False,
+    }
+
+
+def test_schema_covers_new_kinds(tel):
+    from sparse_tpu.telemetry import schema
+
+    assert not schema.validate({
+        "kind": "flight.capture", "ts": 1.0, "reason": "alert",
+        "rule": "slo_miss_rate", "dir": "x",
+    })
+    assert not schema.validate({
+        "kind": "profile.capture", "ts": 1.0, "ok": True, "dir": "x",
+    })
+    assert schema.validate({"kind": "flight.capture", "ts": 1.0})
