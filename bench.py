@@ -618,8 +618,11 @@ def run_cold_start(B: int = 8, n: int = 2048, iters: int = 40) -> dict:
         # disk-warm: in-process tier gone (the restart), vault retained
         plan_cache.clear()
         t0 = time.perf_counter()
+        # synchronous replay on purpose: this row MEASURES the replay
+        # itself (replay_s); the async warm path is chaos scenario 10's
+        # drill and the pipeline columns of sustained_cg
         ses2 = SolveSession("cg", batch_max=B, conv_test_iters=cti,
-                            warm_start=True)
+                            warm_start=True, warm_async=False)
         out["replay_s"] = time.perf_counter() - t0
         out["replayed_programs"] = ses2.warm_replayed
         out["disk_warm_s"], d_dw = serve(ses2)
@@ -663,12 +666,22 @@ def run_sustained_cg(n: int = 512, B: int = 8, rate: float = 150.0,
     the row for that). Embedded in the bench session record and lifted
     by ``scripts/axon_report.py`` onto the ``--compare`` surface as
     ``sustained_cg.{achieved_rps,p95_ms,slo_miss_rate}``.
+
+    The pipeline comparison (ISSUE 13): a second, deliberately
+    OVERLOADED seeded Poisson trace is played twice through two equally
+    warm sessions — streaming dispatch on (``inflight`` from
+    ``SPARSE_TPU_INFLIGHT``, floor 2) vs off (``inflight=1``, the
+    classic enqueue->block loop) — and the achieved req/s land in the
+    ``pipelined_rps`` / ``sync_rps`` columns with their p95/SLO-miss
+    context; ``pipeline_speedup`` is their ratio, lifted onto the
+    ``--compare`` surface by ``axon_report``.
     """
     import numpy as np
     import scipy.sparse as sp
 
     from sparse_tpu import loadgen
     from sparse_tpu.batch import SolveSession
+    from sparse_tpu.config import settings as _settings
 
     rng = np.random.default_rng(seed)
     e = np.ones(n, dtype=np.float32)
@@ -684,22 +697,44 @@ def run_sustained_cg(n: int = 512, B: int = 8, rate: float = 150.0,
     rhs = rng.standard_normal((B, n)).astype(np.float32)
     systems = list(zip(mats, rhs))
 
+    def warm_session(**kw):
+        ses = SolveSession("cg", batch_max=32, slo_ms=slo_ms, **kw)
+        pattern = ses.pattern_of(mats[0])
+        pattern.sell_pack()
+        # warm every bucket the coalescing can produce (pow2 up to
+        # batch_max)
+        bkt = 1
+        while bkt <= ses.batch_max:
+            ses._prebuild(pattern, "cg", bkt, np.dtype(np.float32))
+            bkt *= 2
+        return ses
+
     # sampled device profiling (ISSUE 12): every 4th dispatch records
     # its host-vs-device split so the bench row (and axon_report's
     # programs table) carries MEASURED device time, not just host wall
-    ses = SolveSession("cg", batch_max=32, slo_ms=slo_ms, profile_every=4)
-    pattern = ses.pattern_of(mats[0])
-    pattern.sell_pack()
-    # warm every bucket the coalescing can produce (pow2 up to batch_max)
-    bkt = 1
-    while bkt <= ses.batch_max:
-        ses._prebuild(pattern, "cg", bkt, np.dtype(np.float32))
-        bkt *= 2
+    ses = warm_session(profile_every=4)
 
     trace = loadgen.ArrivalTrace.poisson(
         rate=rate, duration=duration, seed=seed
     )
     rep = loadgen.run_load(ses, trace, systems, tol=1e-6)
+
+    # -- pipeline on vs off on one overloaded seeded trace (ISSUE 13) --
+    # the offered rate deliberately exceeds the sync path's service
+    # rate, so achieved req/s measures the serving pipeline itself, not
+    # the trace; identical trace + systems + warm state on both sides
+    over = loadgen.ArrivalTrace.poisson(
+        rate=rate * 4.0, duration=max(duration * 0.8, 0.5), seed=seed + 6
+    )
+    window = max(int(_settings.inflight), 2)
+    rep_pipe = loadgen.run_load(
+        warm_session(inflight=window), over, systems, tol=1e-6,
+        pipeline=True,
+    )
+    rep_sync = loadgen.run_load(
+        warm_session(inflight=1), over, systems, tol=1e-6,
+        pipeline=False,
+    )
     # the measured device-time rollup of the sampled dispatches (the
     # cost table accumulates per-program; aggregate across buckets)
     dev_ms = dev_n = 0.0
@@ -730,6 +765,24 @@ def run_sustained_cg(n: int = 512, B: int = 8, rate: float = 150.0,
         "p95_under_slo": rep.latency_ms["p95"] <= slo_ms,
         "dispatches": rep.dispatches,
         "wall_s": rep.wall_s,
+        # the streaming-dispatch comparison (ISSUE 13): same overloaded
+        # seeded trace, pipeline on (SPARSE_TPU_INFLIGHT window) vs off.
+        # host_cores contextualizes the speedup — overlap needs a core
+        # for the host ALONGSIDE the XLA compute pool, so a 1-core
+        # container reads ~1.0 by construction while a real serving
+        # host shows the pack/solve overlap
+        "host_cores": os.cpu_count() or 1,
+        "inflight": window,
+        "pipelined_rps": rep_pipe.achieved_rps,
+        "sync_rps": rep_sync.achieved_rps,
+        "pipeline_speedup": round(
+            rep_pipe.achieved_rps / max(rep_sync.achieved_rps, 1e-9), 3
+        ),
+        "pipelined_p95_ms": rep_pipe.latency_ms["p95"],
+        "sync_p95_ms": rep_sync.latency_ms["p95"],
+        "pipelined_slo_miss_rate": rep_pipe.slo_miss_rate,
+        "sync_slo_miss_rate": rep_sync.slo_miss_rate,
+        "pipelined_inflight_depth": rep_pipe.inflight_depth,
     }
 
 

@@ -559,7 +559,11 @@ def build_report(records_path: str, bench_paths=(), peak_gflops=None,
     if sustained_row:
         for k, hib in (("achieved_rps", True), ("offered_rps", True),
                        ("p95_ms", False), ("slo_miss_rate", False),
-                       ("device_ms_mean", False)):
+                       ("device_ms_mean", False),
+                       # the streaming-dispatch comparison (ISSUE 13):
+                       # same overloaded seeded trace, pipeline on/off
+                       ("pipelined_rps", True), ("sync_rps", True),
+                       ("pipeline_speedup", True)):
             if _num(sustained_row.get(k)) is not None:
                 metrics[f"sustained_cg.{k}"] = {
                     "v": sustained_row[k], "hib": hib,
@@ -642,7 +646,8 @@ def build_report(records_path: str, bench_paths=(), peak_gflops=None,
 #: embedded bench rows lifted into the trend table, with headline keys
 _TREND_EMBEDS = (
     ("sustained_cg", ("achieved_rps", "offered_rps", "p95_ms",
-                      "slo_miss_rate")),
+                      "slo_miss_rate", "pipelined_rps", "sync_rps",
+                      "pipeline_speedup")),
     ("cold_start", ("cold_s", "replay_s", "disk_warm_s", "warm_s")),
     ("batched_cg", ("speedup_warm",)),
     ("fleet_batched_cg", ("speedup_warm",)),
@@ -911,6 +916,15 @@ def _print_report(rep: dict) -> None:
             f"p95={srow.get('p95_ms')}ms (slo {srow.get('slo_ms')}ms) "
             f"slo_miss_rate={srow.get('slo_miss_rate')}"
         )
+        if srow.get("pipeline_speedup") is not None:
+            print(
+                "    pipeline: "
+                f"on={srow.get('pipelined_rps')}req/s "
+                f"off={srow.get('sync_rps')}req/s "
+                f"speedup={srow.get('pipeline_speedup')}x "
+                f"(inflight={srow.get('inflight')}, "
+                f"host_cores={srow.get('host_cores')})"
+            )
     progs = rep.get("programs") or {}
     if progs:
         print(

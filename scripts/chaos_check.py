@@ -59,6 +59,19 @@ chains are missing:
    ``scripts/axon_doctor.py --json`` over the bundle must name
    "injected dispatch delay" as the probable cause — the alert →
    evidence → diagnosis loop proven end-to-end.
+10. **Streaming pipeline restart + admission control** (ISSUE 13
+   acceptance drill) — part A: a pipelined serve child
+   (``SPARSE_TPU_INFLIGHT=4``) SIGKILLs itself with bucket programs
+   genuinely IN FLIGHT (``flush(wait=False)``, no drain); the fresh
+   process constructs with the ASYNC warm replay and submits the
+   backlog immediately — the dispatch path must wait for the replay's
+   programs instead of rebuilding them, serving the backlog with ZERO
+   serving-path builds (plan-cache misses caused by serving
+   dispatches), all lanes converged. Part B: a burst submitted against
+   ``max_queue_depth`` backpressure must emit ``batch.admission``
+   events, drive the ``queue_depth`` watchdog rule to alert DURING the
+   burst and clear after the drain — the admission/alerting loop
+   proven end-to-end, on top of zero gauge drift.
 
 Telemetry is pointed at a temp sink (never the committed
 ``results/axon/records.jsonl``). Wired into the quick lane through
@@ -271,6 +284,9 @@ def run(report: dict) -> list:
 
     # -- 9. incident flight recorder: alert -> bundle -> doctor diagnosis ---
     problems += _incident_flight(report)
+
+    # -- 10. pipeline restart (kill with buckets in flight) + admission -----
+    problems += _pipeline_restart_admission(report)
     return problems
 
 
@@ -787,10 +803,169 @@ def _fleet_kill_restart(report: dict) -> list:
     return problems
 
 
+def _pipeline_restart_admission(report: dict) -> list:
+    """Scenario 10 (ISSUE 13): part A — SIGKILL a pipelined serve child
+    with buckets in flight, then prove the fresh process's ASYNC warm
+    replay races traffic to a zero-serving-build window; part B — a
+    burst under ``max_queue_depth`` emits ``batch.admission`` events
+    and the ``queue_depth`` watchdog alert fires during the burst and
+    clears after the drain."""
+    problems = []
+    # -- part A: kill with buckets in flight; async replay serves ----------
+    vdir = tempfile.mkdtemp(prefix="chaos_vault_pipe_")
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["SPARSE_TPU_VAULT"] = vdir
+    env["SPARSE_TPU_COMPILE_CACHE"] = os.path.join(vdir, "_xla_cache")
+    env["SPARSE_TPU_INFLIGHT"] = "4"
+    env.pop("SPARSE_TPU_FAULTS", None)
+
+    def child(mode):
+        return subprocess.run(
+            [sys.executable, os.path.abspath(__file__),
+             "--vault-child", mode],
+            env=env, capture_output=True, text=True, timeout=300,
+        )
+
+    serve = child("serve-pipe")
+    if "SERVED" not in serve.stdout:
+        problems.append(
+            f"pipeline restart: serve child never served "
+            f"(rc={serve.returncode}, stderr tail: "
+            f"{serve.stderr[-300:]!r})"
+        )
+    elif serve.returncode != -signal.SIGKILL:
+        problems.append(
+            "pipeline restart: serve child was supposed to die by "
+            f"SIGKILL with buckets in flight (rc={serve.returncode})"
+        )
+    warm = child("warm-pipe")
+    out = None
+    for line in warm.stdout.splitlines():
+        if line.startswith("WARM "):
+            try:
+                out = json.loads(line[5:])
+            except json.JSONDecodeError:
+                pass
+    if out is None:
+        problems.append(
+            f"pipeline restart: warm child produced no report "
+            f"(rc={warm.returncode}, stderr tail: {warm.stderr[-300:]!r})"
+        )
+    else:
+        report["pipeline_restart"] = out
+        if out.get("replayed", 0) < 1:
+            problems.append(
+                "pipeline restart: async replay rebuilt no programs"
+            )
+        if out.get("serving_builds", 1) != 0:
+            problems.append(
+                f"pipeline restart: {out.get('serving_builds')} "
+                "program(s) built ON the serving path — traffic racing "
+                "the async replay must wait for it, not rebuild"
+            )
+        if out.get("vault", {}).get("hits", 0) < 1:
+            problems.append(
+                "pipeline restart: no disk-tier hits during replay"
+            )
+        if out.get("drift", 0) != 0:
+            problems.append(
+                f"pipeline restart: queue_depth drift "
+                f"{out.get('drift')} after serving"
+            )
+        bad = [r for r in out.get("resids", [1.0]) if not (r <= 10 * TOL)]
+        if bad:
+            problems.append(
+                f"pipeline restart: {len(bad)} lanes unconverged after "
+                f"racing warm restart (worst ||r||={max(bad):.2e})"
+            )
+
+    # -- part B: burst under max_queue_depth; admission + queue alert ------
+    import numpy as np
+
+    from sparse_tpu import telemetry as tel
+    from sparse_tpu.batch import SolveSession
+    from sparse_tpu.telemetry import _metrics, _watchdog
+
+    tel.reset()
+    rng = np.random.default_rng(51)
+    mats = []
+    for _ in range(4):
+        M = _tridiag(N)
+        M.setdiag(3.0 + rng.random(N))
+        M.sort_indices()
+        mats.append(M.tocsr())
+    rhs = rng.standard_normal((4, N))
+
+    ses = SolveSession("cg", inflight=2, batch_max=4, max_queue_depth=8,
+                       admission="block", warm_start=False)
+    pattern = ses.pattern_of(mats[0])
+    pattern.sell_pack()
+    bkt = 1
+    while bkt <= 4:
+        ses._prebuild(pattern, "cg", bkt, np.dtype(np.float64))
+        bkt *= 2
+    # the queue_depth gauge is process-global: anchor the rule to the
+    # depth THIS scenario adds on top of whatever baseline exists
+    base = float(_metrics.gauge("batch.queue_depth").value)
+    wd = _watchdog.Watchdog(rules=[
+        _watchdog.queue_depth_rule(trigger=base + 4.0, clear=base + 1.0),
+    ])
+    wd.evaluate()
+    alerted = False
+    for i in range(32):
+        ses.submit(mats[i % 4], rhs[i % 4], tol=TOL)
+        if ses._unfinalized >= 6:
+            wd.evaluate()
+            alerted = alerted or "queue_depth" in wd.active()
+    ses.drain()
+    wd.evaluate()
+    cleared = "queue_depth" not in wd.active()
+    kinds = _event_kinds(tel)
+    drift = ses.session_stats()["tickets"]["queue_depth_drift"]
+    report["pipeline_admission"] = {
+        "alerted_during_burst": alerted,
+        "cleared_after_drain": cleared,
+        "admission_events": kinds.get("batch.admission", 0),
+        "inflight_events": kinds.get("batch.inflight", 0),
+        "drift": drift,
+        "tickets": ses.session_stats()["tickets"],
+        "events": kinds,
+    }
+    if kinds.get("batch.admission", 0) < 1:
+        problems.append(
+            "pipeline admission: burst under max_queue_depth emitted no "
+            "batch.admission events"
+        )
+    if not alerted or kinds.get("watchdog.alert", 0) == 0:
+        problems.append(
+            "pipeline admission: queue_depth rule did not alert during "
+            "the burst"
+        )
+    if not cleared or kinds.get("watchdog.clear", 0) == 0:
+        problems.append(
+            "pipeline admission: queue_depth alert did not clear after "
+            "the drain"
+        )
+    if drift != 0:
+        problems.append(
+            f"pipeline admission: queue_depth gauge drift {drift} != 0"
+        )
+    done = ses.session_stats()["tickets"]["done"]
+    if done != 32:
+        problems.append(
+            f"pipeline admission: {done}/32 burst tickets resolved"
+        )
+    return problems
+
+
 def vault_child(mode: str) -> int:
-    """Scenario 6/7 child entry (``--vault-child serve|warm``): reads
-    the vault dir from ``SPARSE_TPU_VAULT`` (and, scenario 7, the fleet
-    mode from ``SPARSE_TPU_FLEET`` on the forced 8-device mesh)."""
+    """Scenario 6/7/10 child entry (``--vault-child
+    serve|warm|serve-pipe|warm-pipe``): reads the vault dir from
+    ``SPARSE_TPU_VAULT`` (scenario 7 adds the fleet mode on the forced
+    8-device mesh; scenario 10's ``-pipe`` modes run the streaming
+    pipeline — the serve child dies with buckets IN FLIGHT and the warm
+    child races traffic against the async replay)."""
     import jax
 
     jax.config.update("jax_enable_x64", True)
@@ -810,7 +985,43 @@ def vault_child(mode: str) -> int:
             ses.submit(A, b, tol=TOL)
         os.kill(os.getpid(), signal.SIGKILL)
         return 1  # unreachable
+    if mode == "serve-pipe":
+        ses = SolveSession("cg", warm_start=False)
+        ses.solve_many(mats, rhs, tol=TOL)
+        print("SERVED", flush=True)
+        # resubmit and dispatch WITHOUT draining: bucket programs are
+        # genuinely in flight on the device at the moment of death
+        for A, b in zip(mats, rhs):
+            ses.submit(A, b, tol=TOL)
+        ses.flush(wait=False)
+        os.kill(os.getpid(), signal.SIGKILL)
+        return 1  # unreachable
+    if mode == "warm-pipe":
+        # async warm replay (the default) racing immediate traffic: the
+        # dispatch path must WAIT for the replay's programs, so the
+        # serving path builds nothing
+        ses = SolveSession("cg", warm_start=True)
+        tickets = [ses.submit(A, b, tol=TOL) for A, b in zip(mats, rhs)]
+        ses.flush(wait=False)
+        X = [t.result()[0] for t in tickets]
+        resids = [
+            float(np.linalg.norm(m @ np.asarray(x) - b))
+            for m, x, b in zip(mats, X, rhs)
+        ]
+        stats = ses.session_stats()
+        print("WARM " + json.dumps({
+            "replayed": ses.warm_replayed,
+            "serving_builds": stats["pipeline"]["serving_builds"],
+            "drift": stats["tickets"]["queue_depth_drift"],
+            "resids": resids,
+            "vault": vault.stats(),
+        }), flush=True)
+        return 0
     ses = SolveSession("cg", warm_start=True)
+    # scenarios 6/7 measure the steady WARM serving window, so join the
+    # (now asynchronous, ISSUE 13) replay before snapshotting — the
+    # replay-vs-traffic race itself is scenario 10's drill
+    _ = ses.warm_replayed
     snap = plan_cache.snapshot()
     X, _iters, _r2 = ses.solve_many(mats, rhs, tol=TOL)
     resids = [
@@ -865,6 +1076,8 @@ def main(argv) -> int:
         fr = report.get("fleet_restart", {})
         lw = report.get("loadgen_watchdog", {})
         fl = report.get("incident_flight", {})
+        pr = report.get("pipeline_restart", {})
+        pa = report.get("pipeline_admission", {})
         print(
             "chaos check passed: "
             f"{len([k for k in report if k.startswith('solver.')])} solvers "
@@ -881,7 +1094,12 @@ def main(argv) -> int:
             f"{lw.get('clean', {}).get('slo_miss_rate', '?')}), "
             f"incident flight ok ({len(fl.get('bundles', []))} bundle, "
             f"{fl.get('suppressed', '?')} suppressed, doctor cause "
-            f"{fl.get('diagnosis', {}).get('cause', '?')!r})"
+            f"{fl.get('diagnosis', {}).get('cause', '?')!r}), "
+            f"pipeline restart ok ({pr.get('replayed', 0)} async-replayed "
+            f"program(s), {pr.get('serving_builds', '?')} serving "
+            f"build(s)), admission burst ok "
+            f"({pa.get('admission_events', 0)} admission event(s), "
+            f"queue alert fired+cleared, drift {pa.get('drift', '?')})"
         )
     return 1 if problems else 0
 

@@ -28,6 +28,7 @@ from .bucket import (  # noqa: F401
     pad_pattern,
     pattern_bucket,
     pow2_ceil,
+    stage_lanes,
 )
 from .krylov import (  # noqa: F401
     BatchedSolveInfo,
@@ -43,16 +44,19 @@ from .operator import (  # noqa: F401
     make_batched_operator,
 )
 from .service import (  # noqa: F401
+    AdmissionError,
     SolveSession,
     SolveTicket,
     TicketDeadlineError,
     TicketError,
     TicketFailedError,
     TicketState,
+    TicketTimeoutError,
     TicketUnresolvedError,
 )
 
 __all__ = [
+    "AdmissionError",
     "BatchedCSR",
     "BatchedDIA",
     "BatchedOperator",
@@ -63,6 +67,7 @@ __all__ = [
     "TicketError",
     "TicketFailedError",
     "TicketState",
+    "TicketTimeoutError",
     "TicketUnresolvedError",
     "SparsityPattern",
     "batched_bicgstab",
@@ -74,4 +79,5 @@ __all__ = [
     "pad_pattern",
     "pattern_bucket",
     "pow2_ceil",
+    "stage_lanes",
 ]

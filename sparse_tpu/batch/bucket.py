@@ -110,6 +110,30 @@ def pad_lanes(values, rhs, tols, bucket: int, x0=None, big_tol=1e30):
     return values, rhs, tols, x0, b
 
 
+def stage_lanes(values, rhs, tols, bucket: int, x0=None, big_tol=1e30):
+    """:func:`pad_lanes` + eager host->device upload of the padded
+    stacks (the streaming-dispatch entry, ISSUE 13).
+
+    ``jax.device_put`` starts the transfers as soon as the pads exist,
+    so by the time the session's pipeline actually *dispatches* the
+    bucket program — possibly while an earlier bucket is still solving
+    on the device — the value stack / rhs / x0 / tolerances are already
+    on (or on their way to) the device. Returns
+    ``(values, rhs, tols, x0, nreal)`` with the first four as device
+    arrays; numerically identical to ``pad_lanes`` + ``jnp.asarray`` at
+    the dispatch site (pinned by the pipeline parity tests).
+    """
+    import jax
+
+    values, rhs, tols, x0, nreal = pad_lanes(
+        values, rhs, tols, bucket, x0=x0, big_tol=big_tol
+    )
+    return (
+        jax.device_put(values), jax.device_put(rhs),
+        jax.device_put(tols), jax.device_put(x0), nreal,
+    )
+
+
 def pattern_bucket(n: int, nnz: int) -> tuple:
     """The pow2 (rows, nnz) bucket of a pattern — the shape key under
     which near-sized patterns can share compiled programs."""

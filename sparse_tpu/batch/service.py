@@ -41,6 +41,29 @@ manifest entries record it (a different-topology restart cold-starts
 cleanly), and ``session_stats()`` reports the mesh shape plus
 per-device lane occupancy.
 
+Streaming dispatch (ISSUE 13, docs/batching.md "Streaming dispatch"):
+the session is a real pipeline, not an enqueue->block loop. Dispatch is
+non-blocking — ``flush(wait=False)`` (and the ``auto_flush`` fast path)
+enqueues bucket programs without ``block_until_ready`` behind a bounded
+in-flight window (``SPARSE_TPU_INFLIGHT``, default 2), so the host
+packs/uploads bucket N+1 (``bucket.stage_lanes``: pad + eager
+``jax.device_put``) while the device solves bucket N; on TPU/GPU the
+bucket programs additionally donate their value-stack/rhs/x0 buffers.
+Readback is deferred: :class:`SolveTicket` is future-style
+(``ready`` / ``result(timeout=)``), and scatter/unpack/terminal
+accounting run lazily when results are awaited, at ``poll()`` (retire
+whatever already finished), or at ``drain()``. Admission control rides
+the same machinery: ``max_queue_depth`` applies backpressure at
+``submit`` (block or reject, ``batch.admission`` events) keyed off the
+``batch.queue_depth`` gauge's depth accounting, per-ticket deadlines
+are re-checked at readback (a lane gone stale in flight never spends a
+requeue past its deadline), and the vault warm replay runs on a
+background thread so a restarted process serves immediately —
+dispatches of a program the replay is still compiling wait for that
+program instead of rebuilding it. ``SPARSE_TPU_INFLIGHT=1`` reproduces
+the classic synchronous path bit-identically (pinned by
+``tests/test_pipeline.py``).
+
 Request-scoped observability (ISSUE 6, Axon v3): every ticket carries a
 process-unique id (``telemetry.new_ticket_id``); each dispatch runs
 inside a :func:`telemetry.ticket_scope` so EVERY event it causes —
@@ -60,9 +83,12 @@ XLA cost/memory analysis land in ``plan_cache.compile`` events.
 
 from __future__ import annotations
 
+import collections
 import enum
+import threading
 import time
 import weakref
+from functools import partial
 
 import jax
 import jax.numpy as jnp
@@ -74,6 +100,7 @@ from ..config import settings
 from ..ops import spmv as spmv_ops
 from ..parallel import comm as _comm
 from ..resilience import faults as _faults
+from ..resilience.policy import deadline_remaining_s
 from ..telemetry import _cost, _metrics, _profiler
 from . import bucket as bucketing
 from . import krylov
@@ -101,6 +128,19 @@ _SLO_MISSES = _metrics.counter(
 )
 _TICKET_LATENCY_HELP = (
     "end-to-end ticket latency in seconds (submit -> resolved)"
+)
+# streaming-dispatch levels (ISSUE 13): bucket programs currently in
+# flight on the device (dispatched, not yet retired) and lanes whose
+# requeue was skipped because their deadline passed while in flight
+_INFLIGHT = _metrics.gauge(
+    "batch.inflight",
+    help="bucket programs dispatched and not yet retired (the streaming "
+    "pipeline's in-flight window depth)",
+)
+_STALE_REQUEUES = _metrics.counter(
+    "batch.stale_requeues",
+    help="unconverged lanes whose requeue was skipped at readback "
+    "because the ticket deadline had already passed",
 )
 
 # live sessions, weakly held: the /session serving endpoint
@@ -141,15 +181,33 @@ class TicketDeadlineError(TicketFailedError):
     """The ticket's deadline passed before its bucket dispatched."""
 
 
+class TicketTimeoutError(TicketError):
+    """``result(timeout=)`` lapsed before the ticket resolved. The
+    ticket stays PENDING — a later ``result()`` (or ``drain()``) still
+    retires it normally; a timeout never loses work."""
+
+
+class AdmissionError(RuntimeError):
+    """``submit`` refused a request: the session's ``max_queue_depth``
+    backpressure threshold was reached under ``admission='reject'``
+    (``batch.admission`` event; docs/batching.md "Streaming dispatch")."""
+
+
 class InjectedDispatchFailure(RuntimeError):
     """A ``drop:dispatch`` fault clause fired (resilience.faults) — the
     injected stand-in for a dispatch lost to a worker/backend failure."""
 
 
 class SolveTicket:
-    """Handle for one submitted system. ``result()`` flushes the session
-    if the request is still queued, then returns ``(x, iters, resid2)``
-    (host numpy scalars/arrays for the lane). Failed tickets raise
+    """Future-style handle for one submitted system. ``result()``
+    dispatches the request if it is still queued, retires its bucket
+    (and any bucket ahead of it in the in-flight window) if it is in
+    flight, then returns ``(x, iters, resid2)`` (host numpy
+    scalars/arrays for the lane). ``result(timeout=s)`` waits at most
+    ``s`` seconds and raises :class:`TicketTimeoutError` (the ticket
+    stays pending and retains its place in the pipeline); ``ready`` is
+    the non-blocking probe — True once the result can be fetched
+    without waiting on the device. Failed tickets raise
     :class:`TicketFailedError` (:class:`TicketDeadlineError` for
     deadline misses) instead of returning garbage.
 
@@ -195,7 +253,7 @@ class SolveTicket:
     def expired(self) -> bool:
         return (
             self.deadline_s is not None
-            and time.monotonic() - self.t_submit >= self.deadline_s
+            and deadline_remaining_s(self.t_submit, self.deadline_s) <= 0
         )
 
     def _offer(self, x, iters, resid2, converged, solver=None):
@@ -225,9 +283,28 @@ class SolveTicket:
         self.state = TicketState.FAILED
         self.error = exc
 
-    def result(self):
+    @property
+    def ready(self) -> bool:
+        """True when ``result()`` would return (or raise) without
+        waiting on the device: the ticket is terminal, or its bucket's
+        in-flight outputs are already materialized. Never blocks and
+        never advances the pipeline."""
+        if self.state is not TicketState.PENDING:
+            return True
+        return self._session._ticket_ready(self)
+
+    def result(self, timeout: float | None = None):
         if self.state is TicketState.PENDING:
-            self._session.flush()
+            self._session._resolve_ticket(self, timeout)
+        if self.state is TicketState.PENDING:
+            if self._session._holds(self):
+                raise TicketTimeoutError(
+                    f"ticket not resolved within {timeout}s (still "
+                    "queued/in flight; result() again to keep waiting)"
+                )
+            raise TicketUnresolvedError(
+                "flush did not resolve this ticket"
+            )
         if self.state is TicketState.FAILED:
             raise (
                 self.error
@@ -245,7 +322,7 @@ class SolveTicket:
     @property
     def converged(self) -> bool:
         if self.state is TicketState.PENDING:
-            self._session.flush()
+            self._session._resolve_ticket(self, None)
         if self._out is None:
             return False
         return self._out[3]
@@ -270,6 +347,119 @@ def _promote(dt: np.dtype) -> np.dtype:
     return dt
 
 
+def donate_argnums() -> tuple:
+    """``donate_argnums`` for the bucket programs' value-stack/rhs/x0
+    arguments (ISSUE 13): on TPU/GPU donation lets XLA recycle the
+    uploaded input HBM for outputs/temps — with streaming dispatch up
+    to ``SPARSE_TPU_INFLIGHT`` buckets hold buffers concurrently, so
+    the recycling halves the transient footprint. CPU has no donation
+    lowering (jax warns per call), so the CPU lane compiles the
+    IDENTICAL program with no donation — jaxprs and results are
+    unchanged either way (docs/batching.md, donation caveats)."""
+    try:
+        backend = jax.default_backend()
+    except Exception:  # noqa: BLE001 - no backend yet: donate nothing
+        return ()
+    return (0, 1, 2) if backend in ("tpu", "gpu", "cuda", "rocm") else ()
+
+
+class _InFlight:
+    """One dispatched-but-not-retired bucket program: everything
+    ``_retire`` needs to scatter results, account phases and decide
+    requeues once the device finishes. Never holds the (possibly
+    donated) input arrays — only the program outputs."""
+
+    __slots__ = ("reqs", "dt", "solver", "allow_requeue", "plan", "key",
+                 "bkt", "nb", "out", "built", "snap", "t0", "t_packed",
+                 "t_solve0", "t_dispatched", "sampled", "_ready")
+
+    def __init__(self, reqs, dt, solver, allow_requeue, plan, key, bkt,
+                 nb, out, built, snap, t0, t_packed, t_solve0,
+                 t_dispatched, sampled):
+        self.reqs, self.dt, self.solver = reqs, dt, solver
+        self.allow_requeue, self.plan, self.key = allow_requeue, plan, key
+        self.bkt, self.nb, self.out = bkt, nb, out
+        self.built, self.snap = built, snap
+        self.t0, self.t_packed, self.t_solve0 = t0, t_packed, t_solve0
+        self.t_dispatched, self.sampled = t_dispatched, sampled
+        self._ready = False
+
+    def is_ready(self) -> bool:
+        """Non-blocking: True when every device output has
+        materialized (host-returning programs — gmres/row — are ready
+        by construction). Latches once True — readiness never
+        regresses, so repeat polls are one attribute read."""
+        if self._ready:
+            return True
+        try:
+            ok = all(
+                l.is_ready() for l in jax.tree_util.tree_leaves(self.out)
+                if hasattr(l, "is_ready")
+            )
+        except Exception:  # noqa: BLE001 - treat odd leaves as ready
+            ok = True
+        self._ready = ok
+        return ok
+
+
+class _WarmReplay:
+    """Background vault warm-start replay (ISSUE 13): ``_prebuild``
+    warm replay runs on this daemon thread so construction returns
+    immediately and the first requests after a restart aren't blocked
+    behind AOT compiles. The dispatch path coordinates through
+    :meth:`wait_for`: a program the manifest plans to replay is waited
+    on (bounded) instead of rebuilt, so the serving window stays at
+    zero plan-cache misses even when traffic races the replay — the
+    chaos scenario-10 contract."""
+
+    def __init__(self, session, planned):
+        self._planned = frozenset(planned)
+        self._cond = threading.Condition()
+        self._done: set = set()
+        self._finished = False
+        self.count = 0
+        self._thread = threading.Thread(
+            target=self._run, args=(session,),
+            name="sparse-tpu-warm-replay", daemon=True,
+        )
+        self._thread.start()
+
+    @property
+    def active(self) -> bool:
+        return not self._finished
+
+    def _run(self, session) -> None:
+        try:
+            self.count = session._replay_manifest(notify=self._mark)
+        except Exception:  # noqa: BLE001 - replay is never a liability
+            pass
+        finally:
+            with self._cond:
+                self._finished = True
+                self._cond.notify_all()
+
+    def _mark(self, key: str) -> None:
+        with self._cond:
+            self._done.add(key)
+            self._cond.notify_all()
+
+    def wait_for(self, key: str, timeout: float = 120.0) -> None:
+        """Block while ``key`` is planned but not yet replayed (bounded;
+        a dead/stuck replay degrades to an ordinary build)."""
+        if key not in self._planned:
+            return
+        deadline = time.monotonic() + float(timeout)
+        with self._cond:
+            while not self._finished and key not in self._done:
+                left = deadline - time.monotonic()
+                if left <= 0:
+                    return
+                self._cond.wait(min(left, 0.25))
+
+    def join(self, timeout: float | None = None) -> None:
+        self._thread.join(timeout)
+
+
 class SolveSession:
     """Queue -> coalesce -> bucket -> dispatch -> scatter.
 
@@ -283,7 +473,28 @@ class SolveSession:
     restart : GMRES restart length (gmres only)
     auto_flush : when set, ``submit`` flushes as soon as a pattern has
         this many queued requests (a latency/throughput knob; None =
-        explicit ``flush()`` only)
+        explicit ``flush()`` only). With a pipelined session
+        (``inflight > 1``) this is the streaming fast path: the
+        auto-flush dispatches WITHOUT waiting (``flush(wait=False)``),
+        so ``submit`` never blocks on a solve
+    inflight : the streaming-dispatch window (ISSUE 13,
+        docs/batching.md "Streaming dispatch"): max bucket programs in
+        flight on the device before dispatch retires (blocks on) the
+        oldest. 1 = the classic synchronous path, bit-identical
+        dispatch/retire interleaving; 2 (the ``SPARSE_TPU_INFLIGHT``
+        default) double-buffers — the host packs/uploads bucket N+1
+        while the device solves bucket N. Compiled programs are
+        identical at every setting. Default ``None`` =
+        ``settings.inflight``
+    max_queue_depth : admission-control threshold (ISSUE 13): max
+        tickets submitted-but-unfinalized (queued + in flight) before
+        ``submit`` applies backpressure — ``admission='block'`` drives
+        the pipeline (retire/dispatch) until below the threshold,
+        ``'reject'`` raises :class:`AdmissionError`; both emit a
+        ``batch.admission`` event and count into the always-on
+        ``batch.admissions{mode}`` counter. None (default) = unbounded
+    admission : 'block' | 'reject' — what ``submit`` does at
+        ``max_queue_depth`` (ignored when that is None)
     requeue : requeue unconverged/nonfinite lanes once into a fallback
         bucket (``fallback_solver`` at a promoted dtype); on by default
     fallback_solver : solver of the requeue bucket (default 'gmres' —
@@ -304,6 +515,15 @@ class SolveSession:
         (``SPARSE_TPU_VAULT``); ``False`` always skips. Replay is
         best-effort — a corrupt manifest or artifact degrades to an
         ordinary cold start, never a construction failure.
+    warm_async : run the warm replay on a background thread (the
+        default; ISSUE 13) so construction returns immediately and
+        first requests aren't blocked behind AOT compiles — dispatches
+        of a program the replay is still building wait for it instead
+        of rebuilding (zero serving-path builds, chaos scenario 10).
+        ``False`` replays synchronously during construction (the
+        pre-pipeline behavior; bench's ``cold_start`` row uses it so
+        ``replay_s`` keeps measuring the replay itself). Reading
+        ``warm_replayed`` joins the thread.
     profile_every : sampled timed-dispatch device profiling (ISSUE 12):
         every Nth dispatched bucket splits its solve wall clock into
         host (async dispatch) vs device (``block_until_ready``) time,
@@ -323,11 +543,17 @@ class SolveSession:
                  warm_start: bool | None = None, fleet=None,
                  fleet_mesh=None, fleet_min_b: int | None = None,
                  row_shard_min_n: int | None = None,
-                 profile_every: int | None = None):
+                 profile_every: int | None = None,
+                 inflight: int | None = None,
+                 max_queue_depth: int | None = None,
+                 admission: str = "block",
+                 warm_async: bool = True):
         if solver not in _SOLVERS:
             raise ValueError(f"solver must be one of {_SOLVERS}")
         if fallback_solver not in _SOLVERS:
             raise ValueError(f"fallback_solver must be one of {_SOLVERS}")
+        if admission not in ("block", "reject"):
+            raise ValueError("admission must be 'block' or 'reject'")
         self.solver = solver
         self.batch_max = int(batch_max or settings.batch_max)
         self.bucket_policy = bucket_policy or settings.batch_bucket
@@ -349,6 +575,27 @@ class SolveSession:
             else max(int(profile_every), 0)
         )
         self._dispatch_seq = 0
+        # streaming-dispatch pipeline (ISSUE 13): the bounded in-flight
+        # window of dispatched-but-not-retired bucket programs, FIFO —
+        # retirement order is dispatch order, so phase accounting and
+        # the requeue path see the same sequencing as the classic
+        # synchronous session
+        self.inflight = max(
+            int(inflight if inflight is not None else settings.inflight), 1
+        )
+        self.max_queue_depth = (
+            None if max_queue_depth is None else max(int(max_queue_depth), 1)
+        )
+        self.admission = admission
+        self._inflight: "collections.deque[_InFlight]" = collections.deque()
+        # tickets submitted and not yet finalized (the session's share
+        # of the batch.queue_depth gauge; the drift assertion in
+        # session_stats checks it against pending + in-flight lanes)
+        self._unfinalized = 0
+        # programs built ON the serving path (a dispatch's plan-cache
+        # miss, warm-replay builds excluded) — chaos scenario 10's
+        # zero-serving-builds evidence
+        self._serving_builds = 0
         # mesh-sharded serving tier (ISSUE 10, docs/batching.md): the
         # per-(pattern, bucket) strategy policy. `fleet` may be a mode
         # string ('auto'/'batch'/'row'), True/False, a ready FleetPolicy,
@@ -376,12 +623,38 @@ class SolveSession:
             from ..utils import enable_compilation_cache
 
             enable_compilation_cache(settings.compile_cache)
-        self.warm_replayed = 0
+        self._warm: _WarmReplay | None = None
+        self._warm_replayed = 0
         from .. import vault
 
         if (vault.enabled() if warm_start is None else warm_start):
             if vault.enabled():
-                self.warm_replayed = self._replay_manifest()
+                if warm_async:
+                    try:
+                        entries = vault.manifest_entries()
+                    except Exception:  # noqa: BLE001 - corrupt manifest
+                        entries = []
+                    planned = set()
+                    for e in entries:
+                        key = self._manifest_plan(e)[0]
+                        if key:
+                            planned.add(key)
+                    self._warm = _WarmReplay(self, planned)
+                else:
+                    self._warm_replayed = self._replay_manifest()
+
+    @property
+    def warm_replayed(self) -> int:
+        """Programs the vault warm replay rebuilt. With the async
+        replay (``warm_async=True``) reading this JOINS the background
+        thread — it is the synchronization point for callers that need
+        the replay finished (tests, the chaos drills' serving-window
+        snapshots)."""
+        if self._warm is not None:
+            self._warm.join()
+            self._warm_replayed = self._warm.count
+            self._warm = None
+        return self._warm_replayed
 
     # -- intake ------------------------------------------------------------
     def pattern_of(self, A) -> SparsityPattern:
@@ -404,7 +677,12 @@ class SolveSession:
         the ``batch.ticket_latency`` histogram labels (ISSUE 11: the
         fairness dimension; ``None`` keeps every existing metric series
         name unchanged) — it never enters the compiled program or its
-        plan-cache key."""
+        plan-cache key.
+
+        With ``max_queue_depth`` set, admission control runs first
+        (after validation): at the bound, ``admission='block'`` drives
+        the pipeline until below it, ``'reject'`` raises
+        :class:`AdmissionError` — the request is never enqueued."""
         if pattern is None:
             pattern = self.pattern_of(A)
             values = np.asarray(A.data if hasattr(A, "data") else A)
@@ -422,13 +700,59 @@ class SolveSession:
             raise ValueError(
                 f"rhs shape {b.shape} != ({pattern.shape[0]},)"
             )
+        if self.max_queue_depth is not None:
+            self._admit()
         t = SolveTicket(self, deadline_s=deadline_s, tenant=tenant)
         q = self._pending.setdefault(id(pattern), [])
         q.append(_Request(pattern, values, b, float(tol), x0, maxiter, t))
         _QUEUE_DEPTH.inc()
+        self._unfinalized += 1
         if self.auto_flush is not None and len(q) >= self.auto_flush:
-            self.flush()
+            # the streaming fast path: a pipelined session auto-flushes
+            # without waiting, so submit never blocks behind a solve
+            self.flush(wait=self.inflight <= 1)
         return t
+
+    def _admit(self) -> None:
+        """Admission control (ISSUE 13): backpressure at ``submit``
+        keyed off the queue-depth accounting. 'reject' raises
+        :class:`AdmissionError`; 'block' drives the pipeline (retire
+        in-flight buckets, dispatch queued work) until the depth drops
+        below ``max_queue_depth``. Both emit one ``batch.admission``
+        event and count into ``batch.admissions{mode}``."""
+        cap = self.max_queue_depth
+        depth = self._unfinalized
+        if depth < cap:
+            return
+        _metrics.counter(
+            "batch.admissions", mode=self.admission,
+            help="submit-time admission-control engagements "
+            "(max_queue_depth reached), by mode",
+        ).inc()
+        if self.admission == "reject":
+            if telemetry.enabled():
+                telemetry.record(
+                    "batch.admission", mode="reject", depth=depth,
+                    max_queue_depth=cap,
+                )
+            raise AdmissionError(
+                f"queue depth {depth} at max_queue_depth={cap} "
+                "(admission='reject')"
+            )
+        t0 = time.monotonic()
+        while self._unfinalized >= cap:
+            if self._inflight:
+                self._retire(self._inflight.popleft())
+            elif self.pending:
+                self._flush_pending()
+            else:
+                break  # nothing left to drive; never deadlock submit
+        if telemetry.enabled():
+            telemetry.record(
+                "batch.admission", mode="block", depth=depth,
+                max_queue_depth=cap,
+                waited_ms=round((time.monotonic() - t0) * 1e3, 3),
+            )
 
     @property
     def pending(self) -> int:
@@ -442,7 +766,16 @@ class SolveSession:
         the stats used to have no device dimension at all) and
         ``device_occupancy`` the per-device real-lane occupancy of the
         most recent dispatch — ``[real/slot]`` per device for sharded
-        buckets, a single entry for the single-device path."""
+        buckets, a single entry for the single-device path.
+
+        ``pipeline`` is the streaming-dispatch view (ISSUE 13): window
+        capacity/depth, admission knobs, serving-path builds and the
+        async-replay state. ``tickets.queue_depth_drift`` is the gauge
+        drift assertion — tickets this session counted into
+        ``batch.queue_depth`` minus what it can actually account for
+        (queued + in flight); anything but 0 means a finalize was
+        missed or double-counted (pinned at 0 by the pipeline tests)."""
+        inflight_lanes = sum(f.nb for f in self._inflight)
         return {
             "solver": self.solver,
             "fallback_solver": self.fallback_solver,
@@ -453,17 +786,76 @@ class SolveSession:
             "dispatches": self.dispatches,
             "mesh": self.fleet.describe(),
             "device_occupancy": list(self._device_occ),
-            "tickets": {"pending": self.pending, **self._ticket_counts},
+            "pipeline": {
+                "inflight": self.inflight,
+                "depth": len(self._inflight),
+                "inflight_lanes": inflight_lanes,
+                "max_queue_depth": self.max_queue_depth,
+                "admission": self.admission,
+                "serving_builds": self._serving_builds,
+                "warm_replaying": (
+                    self._warm is not None and self._warm.active
+                ),
+            },
+            "tickets": {
+                "pending": self.pending,
+                "unfinalized": self._unfinalized,
+                "queue_depth_drift": (
+                    self._unfinalized - (self.pending + inflight_lanes)
+                ),
+                **self._ticket_counts,
+            },
         }
 
-    # -- warm restart (ISSUE 9) --------------------------------------------
-    def _replay_manifest(self) -> int:
+    # -- warm restart (ISSUE 9; async since ISSUE 13) ----------------------
+    def _manifest_plan(self, e: dict):
+        """Parse one warm-start manifest entry into
+        ``(program_key, solver, bucket, dtype, plan, skip_reason)`` —
+        the SINGLE place entry -> plan-cache key resolution lives, so
+        the async replay's planned-key set (what ``_launch`` waits for)
+        and the replay itself can never disagree. ``skip_reason`` is
+        ``None`` for a replayable entry, ``'mesh'`` for a
+        topology-mismatched fleet entry (clean cold start) and
+        ``'malformed'`` otherwise."""
+        solver = e.get("solver")
+        try:
+            bkt = int(e.get("bucket", 0))
+        except (TypeError, ValueError):
+            bkt = 0
+        dtstr = e.get("dtype", "")
+        if solver not in _SOLVERS or bkt < 1 or not dtstr:
+            return None, None, 0, None, None, "malformed"
+        # mesh-keyed entries (the fleet tier) only replay on the SAME
+        # topology: a fingerprint mismatch — restart on a different pod
+        # shape, fleet turned off — skips the entry for a clean cold
+        # start instead of compiling a program this mesh cannot dispatch
+        mesh_fp = e.get("mesh")
+        if mesh_fp:
+            if not (
+                self.fleet.enabled
+                and mesh_fp == self.fleet.fingerprint
+            ):
+                return None, None, 0, None, None, "mesh"
+            plan = self.fleet.plan_for(e.get("strategy", "batch"))
+        else:
+            plan = fleet_mod.FleetPlan("single")
+        try:
+            dt = np.dtype(dtstr)
+        except TypeError:
+            return None, None, 0, None, None, "malformed"
+        key = f"batch.{solver}.B{bkt}.{dt.str}{plan.key_suffix}"
+        return key, solver, bkt, dt, plan, None
+
+    def _replay_manifest(self, notify=None) -> int:
         """Replay the vault's warm-start manifest: for every recorded
         hot (pattern, solver, bucket, dtype) program, load the pattern
         structure + SELL pack from the disk tier and rebuild/compile the
         bucket program ahead of traffic. Returns the number of programs
         replayed; every failure skips its entry (a warm start is an
-        optimization, never a liability)."""
+        optimization, never a liability). ``notify`` (the async replay's
+        hook) is called with each entry's program key once that entry is
+        settled — replayed OR skipped — so a dispatch waiting on the
+        key is unblocked either way."""
         from .. import vault
 
         t0 = time.monotonic()
@@ -471,29 +863,13 @@ class SolveSession:
         replayed = 0
         mesh_skipped = 0
         for e in entries:
+            key = None
             try:
-                solver = e.get("solver")
-                bkt = int(e.get("bucket", 0))
-                dtstr = e.get("dtype", "")
-                if solver not in _SOLVERS or bkt < 1 or not dtstr:
-                    continue
-                # mesh-keyed entries (the fleet tier) only replay on the
-                # SAME topology: a fingerprint mismatch — restart on a
-                # different pod shape, fleet turned off — skips the
-                # entry for a clean cold start instead of compiling a
-                # program this mesh cannot dispatch
-                mesh_fp = e.get("mesh")
-                if mesh_fp:
-                    if not (
-                        self.fleet.enabled
-                        and mesh_fp == self.fleet.fingerprint
-                    ):
+                key, solver, bkt, dt, plan, skip = self._manifest_plan(e)
+                if skip is not None:
+                    if skip == "mesh":
                         mesh_skipped += 1
-                        continue
-                    plan = self.fleet.plan_for(e.get("strategy", "batch"))
-                else:
-                    plan = fleet_mod.FleetPlan("single")
-                dt = np.dtype(dtstr)
+                    continue
                 pat = vault.load_pattern(e.get("pattern", ""))
                 if pat is None:
                     continue
@@ -503,6 +879,9 @@ class SolveSession:
                 replayed += 1
             except Exception:  # noqa: BLE001 - entry isolation
                 continue
+            finally:
+                if notify is not None and key:
+                    notify(key)
         if replayed:
             _metrics.counter("vault.replayed").inc(replayed)
         if telemetry.enabled():
@@ -564,18 +943,59 @@ class SolveSession:
         )
 
     # -- dispatch ----------------------------------------------------------
-    def flush(self) -> int:
+    def flush(self, wait: bool = True) -> int:
         """Dispatch every queued request; returns the number of batches
         dispatched. Groups by (pattern, dtype), splits groups into
         ``batch_max``-sized chunks, pads each chunk to its bucket.
+
+        ``wait=True`` (default, the classic contract) drains the
+        pipeline before returning: every flushed ticket is terminal.
+        ``wait=False`` is the streaming form (ISSUE 13): buckets
+        dispatch through the bounded in-flight window and the call
+        returns with up to ``inflight`` buckets still solving on the
+        device — results arrive through the tickets' future API
+        (``ready`` / ``result()``), ``poll()`` or ``drain()``.
 
         Exception-safe by contract (ISSUE 5 satellite): a bucket whose
         program raises marks only ITS tickets :class:`TicketFailedError`
         (after ``dispatch_attempts`` tries) — every other pending bucket
         still dispatches, and the session stays usable."""
+        dispatched = self._flush_pending()
+        if wait:
+            self.drain()
+        else:
+            self.poll()
+        return dispatched
+
+    def poll(self) -> int:
+        """Retire every in-flight bucket whose device results are
+        already materialized (FIFO — a ready bucket behind a still-
+        running one waits its turn, keeping retirement order equal to
+        dispatch order). Never blocks; returns buckets retired."""
+        n = 0
+        while self._inflight and self._inflight[0].is_ready():
+            self._retire(self._inflight.popleft())
+            n += 1
+        return n
+
+    def drain(self) -> int:
+        """Dispatch anything still queued, then retire EVERY in-flight
+        bucket (blocking); on return all submitted tickets are terminal.
+        Returns the number of buckets retired by this call."""
+        self._flush_pending()
+        n = 0
+        while self._inflight:
+            self._retire(self._inflight.popleft())
+            n += 1
+        return n
+
+    def _flush_pending(self) -> int:
+        """The dispatch half of ``flush``: deadline-check, group,
+        chunk, and enqueue every pending request through the pipeline
+        window. Terminal-by-now tickets (deadline-expired, failed
+        buckets) finalize here; dispatched tickets finalize at retire."""
         dispatched = 0
         pending, self._pending = self._pending, {}
-        _QUEUE_DEPTH.dec(sum(len(q) for q in pending.values()))
         for q in pending.values():
             # per-ticket deadlines: fail stale work instead of solving it
             live, expired = [], []
@@ -591,10 +1011,12 @@ class SolveSession:
                     live.append(r)
             if expired and telemetry.enabled():
                 telemetry.record(
-                    "batch.deadline", solver=self.solver,
+                    "batch.deadline", solver=self.solver, stage="dispatch",
                     lanes=len(expired),
                     tickets=[r.ticket.id for r in expired],
                 )
+            for r in expired:
+                self._finalize_ticket(r.ticket)
             # one group per result dtype so stacked values are homogeneous
             by_dt: dict = {}
             for r in live:
@@ -618,23 +1040,83 @@ class SolveSession:
                         _BUCKET_FAILURES.inc()
                         for r in chunk:
                             r.ticket._fail(err)
-        # every flushed ticket is terminal now (done, failed, or
-        # deadline-expired): emit its batch.ticket terminal event and
-        # feed the latency/SLO surfaces exactly once per ticket
-        for q in pending.values():
-            for r in q:
-                self._finalize_ticket(r.ticket)
+                            self._finalize_ticket(r.ticket)
         return dispatched
+
+    # -- deferred readback (the ticket future API's engine) ----------------
+    def _queued(self, t: SolveTicket) -> bool:
+        return any(
+            r.ticket is t for q in self._pending.values() for r in q
+        )
+
+    def _find_inflight(self, t: SolveTicket):
+        for fl in self._inflight:
+            if any(r.ticket is t for r in fl.reqs):
+                return fl
+        return None
+
+    def _holds(self, t: SolveTicket) -> bool:
+        return self._queued(t) or self._find_inflight(t) is not None
+
+    def _ticket_ready(self, t: SolveTicket) -> bool:
+        fl = self._find_inflight(t)
+        return fl is not None and fl.is_ready()
+
+    def _retire_through(self, fl) -> None:
+        """Retire FIFO from the window head up to and including ``fl``."""
+        while self._inflight:
+            head = self._inflight.popleft()
+            self._retire(head)
+            if head is fl:
+                return
+
+    def _resolve_ticket(self, t: SolveTicket,
+                        timeout: float | None = None) -> None:
+        """Drive the pipeline until ``t`` is terminal — dispatch it if
+        still queued, retire its bucket (and everything ahead) if in
+        flight, follow it through a requeue. With a timeout, poll
+        readiness instead of blocking and return (ticket still PENDING)
+        once the budget lapses."""
+        deadline = (
+            None if timeout is None
+            else time.monotonic() + max(float(timeout), 0.0)
+        )
+        if t.state is TicketState.PENDING and self._pending:
+            # the legacy result() contract: a pending ticket flushes the
+            # session (every queued pattern), just without blocking —
+            # the retire loop below does exactly the waiting needed
+            self._flush_pending()
+        while t.state is TicketState.PENDING:
+            fl = self._find_inflight(t)
+            if fl is None:
+                return  # unresolved/failed: result() raises
+            if deadline is None:
+                self._retire_through(fl)
+            elif fl.is_ready():
+                self._retire_through(fl)
+            elif time.monotonic() >= deadline:
+                return
+            else:
+                time.sleep(2e-4)
 
     def _finalize_ticket(self, t: SolveTicket) -> None:
         """Terminal accounting for one resolved ticket: end-to-end
         latency into the always-on ``batch.ticket_latency`` histogram
         (labeled by the solver that produced the result), SLO-miss
         counting against the session target, and — telemetry on — the
-        ``batch.ticket`` terminal event closing the ticket's trace."""
+        ``batch.ticket`` terminal event closing the ticket's trace.
+
+        Also the queue-depth accounting point (ISSUE 13 satellite): the
+        ``batch.queue_depth`` gauge decrements HERE, once per ticket —
+        never in bulk up front — so an exception mid-flush or a
+        deadline-expired lane can no longer leave the gauge out of sync
+        with reality (``session_stats()['tickets']['queue_depth_drift']``
+        is the assertion)."""
         if t.t_done is not None:
             return  # already finalized (a requeue resolves in-flush)
         t.t_done = time.monotonic()
+        _QUEUE_DEPTH.dec()
+        self._unfinalized -= 1
         latency_s = t.t_done - t.t_submit
         solver = t.solver or self.solver
         # tenant-labeled series only exist for tenant-tagged tickets:
@@ -736,14 +1218,39 @@ class SolveSession:
 
     def _dispatch(self, reqs, dt, solver: str | None = None,
                   allow_requeue: bool = True) -> None:
+        """Enqueue one bucket through the streaming pipeline: launch
+        (pack -> upload -> async program call) under the lanes' ticket
+        scope, admit the dispatch to the bounded in-flight window, and
+        retire the oldest dispatch(es) once the window is full —
+        ``inflight=1`` therefore retires immediately (the classic
+        synchronous interleaving, bit-identical by test)."""
         # every event this dispatch causes — batch.*, kernel.failover,
         # fault.injected, plan_cache.compile — carries the lanes' ticket
         # ids (replace semantics: a requeue re-enters with its own lanes)
         with telemetry.ticket_scope(*(r.ticket.id for r in reqs)):
-            self._dispatch_scoped(reqs, dt, solver, allow_requeue)
+            fl = self._launch(reqs, dt, solver, allow_requeue)
+        if fl is None:
+            return  # degraded at launch; lanes already resolved
+        self._inflight.append(fl)
+        depth = len(self._inflight)
+        _INFLIGHT.set(depth)
+        if telemetry.enabled():
+            telemetry.record(
+                "batch.inflight", depth=depth, capacity=self.inflight,
+                program=fl.key, lanes=fl.nb,
+            )
+        while len(self._inflight) >= self.inflight:
+            self._retire(self._inflight.popleft())
 
-    def _dispatch_scoped(self, reqs, dt, solver: str | None,
-                         allow_requeue: bool) -> None:
+    def _launch(self, reqs, dt, solver: str | None,
+                allow_requeue: bool):
+        """The host half of a dispatch: pack the lane stacks, stage the
+        upload (``bucket.stage_lanes`` — pad + eager ``device_put``),
+        resolve the bucket program (waiting for an in-progress warm
+        replay of the same program instead of rebuilding it), and call
+        it WITHOUT blocking. Returns the :class:`_InFlight` record, or
+        ``None`` when the compiled path was unavailable and the lanes
+        were already resolved on the eager degraded path."""
         solver = solver or self.solver
         t0 = time.monotonic()
         if _faults.ACTIVE:
@@ -779,7 +1286,9 @@ class SolveSession:
                 else np.asarray(r.x0, dtype=dt)
                 for r in reqs
             ])
-        values, rhs, tols, x0, _ = bucketing.pad_lanes(
+        # pad + eager host->device upload: the transfers overlap the
+        # solve of whatever bucket is currently in flight
+        values, rhs, tols, x0, _ = bucketing.stage_lanes(
             values, rhs, tols, bkt, x0=x0
         )
         maxiter = max(
@@ -793,10 +1302,7 @@ class SolveSession:
             # fault-wrapped programs carry the injection callback in
             # their trace: never share cache entries with clean ones
             key += ".faults"
-        args = (
-            jnp.asarray(values), jnp.asarray(rhs), jnp.asarray(x0),
-            jnp.asarray(tols), maxiter,
-        )
+        args = (values, rhs, x0, tols, maxiter)
         t_packed = time.monotonic()
         built: dict = {}
 
@@ -818,7 +1324,14 @@ class SolveSession:
             return prog
 
         try:
+            if self._warm is not None and self._warm.active:
+                # the async replay may already be compiling this very
+                # program: wait for it rather than building twice — the
+                # zero-serving-miss warm restart contract
+                self._warm.wait_for(key)
             prog = plan_cache.get(pattern, key, build)
+            if built:
+                self._serving_builds += 1
             if built and not faulty:
                 # a freshly built bucket program is warm-start state:
                 # note it (and its pattern artifact) in the vault
@@ -841,9 +1354,9 @@ class SolveSession:
             # sampled timed dispatch (ISSUE 12): every Nth dispatch
             # takes ONE extra timestamp at the dispatch-return boundary
             # so the solve wall clock splits into host (async dispatch)
-            # vs device (block_until_ready wait) time. Off (the
-            # default) takes no timestamp at all; the program and its
-            # plan-cache key are identical either way.
+            # vs device (results-ready wait) time. Off (the default)
+            # takes no timestamp at all; the program and its plan-cache
+            # key are identical either way.
             self._dispatch_seq += 1
             sampled = (
                 self.profile_every > 0
@@ -852,47 +1365,106 @@ class SolveSession:
             t_solve0 = time.monotonic()
             out = prog(*args)
             t_dispatched = time.monotonic() if sampled else None
+        except Exception as e:  # noqa: BLE001 - degrade, don't strand
+            self._degrade(reqs, dt, solver, nb, e)
+            return None
+        return _InFlight(
+            reqs, dt, solver, allow_requeue, plan, key, bkt, nb, out,
+            built, snap, t0, t_packed, t_solve0, t_dispatched, sampled,
+        )
+
+    def _degrade(self, reqs, dt, solver, nb, e) -> None:
+        """Graceful degradation (ISSUE 5): the compiled batched path is
+        unavailable (Pallas lowering gone mid-session, plan cache
+        failure, injected program fault) — solve the lanes one by one
+        on the eager path instead of failing the bucket, then finalize
+        them (they never reach a retire)."""
+        _DEGRADED.inc()
+        if telemetry.enabled():
+            telemetry.record(
+                "batch.degraded", solver=solver, reason=repr(e)[:200],
+                lanes=nb,
+            )
+        try:
+            self._solve_degraded(reqs, dt, solver)
+        except Exception as e2:  # noqa: BLE001 - strand nothing
+            for r in reqs:
+                r.ticket._fail(e2)
+        for r in reqs:
+            self._finalize_ticket(r.ticket)
+
+    def _retire(self, fl: _InFlight) -> None:
+        """The deferred-readback half of a dispatch: wait for the
+        bucket's device results, scatter them to the tickets, decide
+        requeues (deadlines re-checked HERE — a lane gone stale in
+        flight never spends a requeue past its deadline), account
+        phases/metrics/events and finalize every lane that isn't
+        continuing into a fallback bucket. Never raises into the
+        caller's flush — any failure degrades or fails this bucket's
+        lanes only."""
+        _INFLIGHT.set(len(self._inflight))
+        with telemetry.ticket_scope(*(r.ticket.id for r in fl.reqs)):
             try:
-                jax.block_until_ready(out)
+                self._retire_scoped(fl)
+            except Exception as e:  # noqa: BLE001 - bucket isolation
+                for r in fl.reqs:
+                    r.ticket._fail(e)
+                    self._finalize_ticket(r.ticket)
+
+    def _retire_scoped(self, fl: _InFlight) -> None:
+        reqs, dt, solver, plan = fl.reqs, fl.dt, fl.solver, fl.plan
+        nb, bkt, key = fl.nb, fl.bkt, fl.key
+        try:
+            try:
+                jax.block_until_ready(fl.out)
             except Exception:
                 pass  # non-jax leaves (ints) — np.asarray blocks below
             t_solved = time.monotonic()
-            X, iters, resid2, conv = out
+            X, iters, resid2, conv = fl.out
             X = np.asarray(X)
             iters = np.asarray(iters)
             resid2 = np.asarray(resid2)
             conv = np.asarray(conv)
         except Exception as e:  # noqa: BLE001 - degrade, don't strand
-            # Graceful degradation (ISSUE 5): the compiled batched path
-            # is unavailable (Pallas lowering gone mid-session, plan
-            # cache failure, injected program fault) — solve the lanes
-            # one by one on the eager path instead of failing the bucket.
-            _DEGRADED.inc()
-            if telemetry.enabled():
-                telemetry.record(
-                    "batch.degraded", solver=solver, reason=repr(e)[:200],
-                    lanes=nb,
-                )
-            self._solve_degraded(reqs, dt, solver)
+            self._degrade(reqs, dt, solver, nb, e)
             return
+        fl.out = None  # release device buffers promptly
         t_read = time.monotonic()
         profile_ms = None
-        if sampled:
+        if fl.sampled:
             profile_ms = (
-                max((t_dispatched - t_solve0) * 1e3, 0.0),  # host
-                max((t_solved - t_dispatched) * 1e3, 0.0),  # device
+                max((fl.t_dispatched - fl.t_solve0) * 1e3, 0.0),  # host
+                max((t_solved - fl.t_dispatched) * 1e3, 0.0),  # device
             )
             _profiler.record_device_sample(key, *profile_ms)
         requeue_lanes = []
+        stale_lanes = []
         for i, r in enumerate(reqs):
             r.ticket._offer(X[i], iters[i], resid2[i], conv[i],
                             solver=solver)
             if (
-                allow_requeue and self.requeue and not r.ticket.requeued
+                fl.allow_requeue and self.requeue and not r.ticket.requeued
                 and (not conv[i] or not np.isfinite(resid2[i]))
             ):
+                # deadline re-check at readback (ISSUE 13): the lane
+                # failed AND its budget lapsed while the bucket was in
+                # flight — keep the (unconverged) result it has rather
+                # than spending a fallback solve past the deadline
+                if deadline_remaining_s(
+                    r.ticket.t_submit, r.ticket.deadline_s
+                ) <= 0:
+                    stale_lanes.append(r)
+                    continue
                 r.ticket.requeued = True
                 requeue_lanes.append(r)
+        if stale_lanes:
+            _STALE_REQUEUES.inc(len(stale_lanes))
+            if telemetry.enabled():
+                telemetry.record(
+                    "batch.deadline", solver=solver, stage="readback",
+                    lanes=len(stale_lanes),
+                    tickets=[r.ticket.id for r in stale_lanes],
+                )
         self.dispatches += 1
         _DISPATCHES.inc()
         # occupancy/waste count against the FINAL bucket (incl. any
@@ -900,19 +1472,24 @@ class SolveSession:
         _BUCKET_OCCUPANCY.observe(nb / bkt)
         _PAD_WASTE.inc(bkt - nb)
         self._fleet_account(
-            plan, solver, dt, nb, bkt, iters, max(t_solved - t_solve0, 0.0)
+            plan, solver, dt, nb, bkt, iters,
+            max(t_solved - fl.t_solve0, 0.0),
         )
         if telemetry.enabled():
             # bucket-level phase wall clocks, accumulated onto each
             # lane's ticket (a requeued lane sums both dispatches).
             # compile_ms is the build's share (pattern pack + AOT
             # compile), which ran inside plan_cache.get — i.e. between
-            # t_packed and t_solve0 — so the phases stay disjoint
+            # t_packed and t_solve0 — so the phases stay disjoint. The
+            # solve phase spans dispatch -> results ready, so with
+            # streaming dispatch it absorbs any in-flight wait and the
+            # phases still tile the end-to-end latency exactly.
             compile_ms = (
-                built.get("compile_s", 0.0) + built.get("pack_s", 0.0)
+                fl.built.get("compile_s", 0.0)
+                + fl.built.get("pack_s", 0.0)
             ) * 1e3
-            pack_ms = max((t_packed - t0) * 1e3, 0.0)
-            solve_ms = max((t_solved - t_solve0) * 1e3, 0.0)
+            pack_ms = max((fl.t_packed - fl.t0) * 1e3, 0.0)
+            solve_ms = max((t_solved - fl.t_solve0) * 1e3, 0.0)
             readback_ms = max((t_read - t_solved) * 1e3, 0.0)
             for r in reqs:
                 ph = r.ticket.phase_ms
@@ -925,7 +1502,7 @@ class SolveSession:
                     else r.ticket.t_submit
                 )
                 ph["queue_ms"] = ph.get("queue_ms", 0.0) + max(
-                    (t0 - base) * 1e3, 0.0
+                    (fl.t0 - base) * 1e3, 0.0
                 )
                 ph["pack_ms"] = ph.get("pack_ms", 0.0) + pack_ms
                 ph["compile_ms"] = ph.get("compile_ms", 0.0) + compile_ms
@@ -933,23 +1510,24 @@ class SolveSession:
                 ph["readback_ms"] = ph.get("readback_ms", 0.0) + readback_ms
                 r.ticket.t_mark = t_read
             q_ms = [
-                (t0 - r.ticket.t_submit) * 1e3 for r in reqs
+                (fl.t0 - r.ticket.t_submit) * 1e3 for r in reqs
             ]
-            cache_d = plan_cache.delta(snap)
+            cache_d = plan_cache.delta(fl.snap)
             telemetry.record(
                 "batch.dispatch", solver=solver, batch=nb,
                 bucket=bkt, pad_waste=bkt - nb,
                 queue_ms_max=round(max(q_ms), 3),
                 queue_ms_mean=round(sum(q_ms) / len(q_ms), 3),
-                dispatch_ms=round((time.monotonic() - t0) * 1e3, 3),
+                dispatch_ms=round((time.monotonic() - fl.t0) * 1e3, 3),
                 solve_ms=round(solve_ms, 3),
                 compile_ms=round(compile_ms, 3),
                 program=key,
                 iters_max=int(iters[:nb].max(initial=0)),
                 iters_mean=float(iters[:nb].mean()) if nb else 0.0,
                 plan_cache=cache_d,
-                n=pattern.shape[0], nnz=pattern.nnz,
+                n=reqs[0].pattern.shape[0], nnz=reqs[0].pattern.nnz,
                 strategy=plan.strategy, S=plan.S,
+                inflight=len(self._inflight),
                 # measured host/device split, sampled dispatches only
                 # (the axon_report programs table's device_ms column)
                 **({"host_ms": round(profile_ms[0], 3),
@@ -958,6 +1536,12 @@ class SolveSession:
             )
         if requeue_lanes:
             self._requeue(requeue_lanes, dt)
+        for r in reqs:
+            if r in requeue_lanes and self._find_inflight(
+                r.ticket
+            ) is not None:
+                continue  # finalizes when the fallback bucket retires
+            self._finalize_ticket(r.ticket)
 
     # -- resilience paths --------------------------------------------------
     def _requeue(self, reqs, dt) -> None:
@@ -1087,7 +1671,11 @@ class SolveSession:
         )
         cti = self.conv_test_iters
 
-        @jax.jit
+        # donated value-stack/rhs/x0 (TPU/GPU only — see donate_argnums):
+        # the staged uploads are consumed exactly once per dispatch, so
+        # XLA recycles their HBM for outputs/temps instead of holding
+        # input + output footprints for every in-flight bucket
+        @partial(jax.jit, donate_argnums=donate_argnums())
         def run(values, rhs, x0, tols, maxiter):
             vals = pack.pack_values(values)
 

@@ -57,6 +57,13 @@ Flags (all env-overridable):
                                 (batch/service.py): every Nth dispatch records its
                                 host-vs-device time split. 0 (default) = off, dispatch
                                 path unchanged.
+  SPARSE_TPU_INFLIGHT         - streaming-dispatch window of the SolveSession pipeline
+                                (batch/service.py): max bucket programs in flight on
+                                the device before dispatch retires the oldest. 1 =
+                                fully synchronous (bit-identical to the classic
+                                enqueue->block path); 2 (default) double-buffers so
+                                the host packs/uploads bucket N+1 while the device
+                                solves bucket N.
 """
 
 from __future__ import annotations
@@ -245,6 +252,17 @@ class Settings:
     # only and never enters a trace).
     profile_every: int = field(
         default_factory=lambda: max(_env_int("SPARSE_TPU_PROFILE_EVERY", 0), 0)
+    )
+    # Streaming-dispatch window (batch/service.py, ISSUE 13): how many
+    # bucket programs may be in flight on the device before dispatch
+    # retires (blocks on) the oldest. 1 = the classic synchronous path,
+    # bit-identical dispatch/retire interleaving to the pre-pipeline
+    # session (pinned by tests/test_pipeline.py); 2 (default) =
+    # double-buffering — the host packs/uploads bucket N+1 while the
+    # device solves bucket N. The compiled programs are identical at
+    # every setting; only host-side scheduling changes.
+    inflight: int = field(
+        default_factory=lambda: max(_env_int("SPARSE_TPU_INFLIGHT", 2), 1)
     )
 
 

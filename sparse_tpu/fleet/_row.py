@@ -17,6 +17,11 @@ Cost shape: the row-block *layout* is rebuilt per dispatch (values
 change per request and ``DistCSR`` bakes them into its shard planes) and
 ``dist_cg`` retraces per call — acceptable because row-sharded traffic
 is by definition rare and enormous (the solve dominates), and honest:
+under streaming dispatch (ISSUE 13) the closure is host-driven, so a
+row "dispatch" completes its solve before returning — the pipeline
+treats it as ready-at-enqueue (its numpy outputs have no deferred
+device exit to wait on) and the deferred-readback API still works
+unchanged over it.
 the program key still takes exactly one plan-cache miss per
 (pattern, mesh), covering the *dispatcher* closure. Collective
 accounting rides ``DistCSR``'s own ledger (``dist.cg`` site), so

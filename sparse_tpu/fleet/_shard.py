@@ -31,6 +31,8 @@ blind spot (docs/telemetry.md).
 
 from __future__ import annotations
 
+from functools import partial
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -143,7 +145,13 @@ def build_batch_program(pattern, bkt: int, dt, solver: str, mesh,
         check_vma=False,
     )
 
-    @jax.jit
+    # sharded programs accept donated inputs too (ISSUE 13): the
+    # value-stack/rhs/x0 shards are consumed once per dispatch, so on
+    # TPU/GPU their HBM recycles exactly like the single-device
+    # program's (no-op on CPU — see batch.service.donate_argnums)
+    from ..batch.service import donate_argnums
+
+    @partial(jax.jit, donate_argnums=donate_argnums())
     def run(values, rhs, x0, tols, maxiter):
         return sharded(values, rhs, x0, tols, jnp.asarray(maxiter))
 

@@ -20,7 +20,15 @@ all), and assembles a :class:`LoadReport`:
   ``fleet.device_occupancy``) while the trace plays, bounded by
   decimation so a long run cannot grow without bound;
 * **per-tenant fairness** — a weighted Jain index over achieved
-  per-tenant throughput shares (:func:`fairness_index`).
+  per-tenant throughput shares (:func:`fairness_index`);
+* **achieved in-flight depth** — with the streaming pipeline
+  (ISSUE 13) the runner drives the session's future API: timed-phase
+  flushes are non-blocking on a pipelined session (``inflight > 1``),
+  the closed-loop phase keeps ``concurrency`` tickets genuinely
+  outstanding and awaits them through ``SolveTicket.result()``, and
+  the report records the max/mean unresolved-ticket depth observed at
+  each await — the honesty check that closed-loop concurrency > 1
+  really overlapped.
 
 Report construction is a pure function (:func:`build_report`) over the
 collected outcomes, so the rollup math is unit-testable without a
@@ -89,6 +97,11 @@ class LoadReport:
     device_occupancy: list = field(default_factory=list)
     dispatches: int = 0
     requeued: int = 0
+    #: achieved in-flight (submitted-but-unresolved ticket) depth,
+    #: sampled at timed-phase flushes and at every closed-loop await:
+    #: {"max": int, "mean": float, "pipelined": bool} — empty when the
+    #: run had no observation points
+    inflight_depth: dict = field(default_factory=dict)
 
     def as_dict(self) -> dict:
         return {
@@ -109,13 +122,15 @@ class LoadReport:
             "device_occupancy": list(self.device_occupancy),
             "dispatches": self.dispatches,
             "requeued": self.requeued,
+            "inflight_depth": dict(self.inflight_depth),
         }
 
 
 def build_report(trace: ArrivalTrace, outcomes, wall_s: float,
                  slo_ms=None, *, time_scale: float = 1.0,
                  queue_depth=(), device_occupancy=(),
-                 dispatches: int = 0) -> LoadReport:
+                 dispatches: int = 0,
+                 inflight_depth: dict | None = None) -> LoadReport:
     """Pure rollup of a run: ``outcomes`` is a sequence of
     ``(tenant, latency_s, ok, requeued)`` tuples (what the runner
     collected from the resolved tickets). Deterministic for
@@ -178,6 +193,7 @@ def build_report(trace: ArrivalTrace, outcomes, wall_s: float,
         device_occupancy=list(device_occupancy),
         dispatches=dispatches,
         requeued=requeued,
+        inflight_depth=dict(inflight_depth or {}),
     )
 
 
@@ -216,7 +232,8 @@ class _Sampler:
 def run_load(session, trace: ArrivalTrace, systems, *, pattern=None,
              tol: float = 1e-8, maxiter=None, time_scale: float = 1.0,
              coalesce_s: float = 0.01, sample_period_s: float = 0.02,
-             record: bool = True) -> LoadReport:
+             record: bool = True,
+             pipeline: bool | None = None) -> LoadReport:
     """Drive ``session`` through ``trace`` and return the
     :class:`LoadReport`.
 
@@ -227,8 +244,19 @@ def run_load(session, trace: ArrivalTrace, systems, *, pattern=None,
     far-off arrival the runner flushes queued work once the remaining
     wait exceeds ``coalesce_s`` (the microbatching window), and always
     flushes when the queue reaches ``session.batch_max``. Closed-loop
-    clauses run after the timed phase: ``concurrency`` submissions per
-    flush until their request budget completes.
+    clauses run after the timed phase: ``concurrency`` tickets are kept
+    outstanding through the future API (await the oldest, top the
+    window back up) until their request budget completes.
+
+    ``pipeline`` selects the streaming-dispatch driving mode
+    (ISSUE 13): ``True`` flushes without waiting
+    (``session.flush(wait=False)``) and retires finished buckets
+    opportunistically (``session.poll()``) while pacing, so the device
+    solves bucket N while the runner packs bucket N+1; ``False`` is the
+    classic blocking flush. Default ``None`` auto-selects from the
+    session's window (pipelined iff ``session.inflight > 1``). Either
+    way the run ends fully drained — every ticket is terminal in the
+    report.
 
     Every request goes through the real ticket path — per-ticket
     latency is ``t_done - t_submit`` exactly as the ``batch.ticket``
@@ -241,11 +269,18 @@ def run_load(session, trace: ArrivalTrace, systems, *, pattern=None,
     scale = float(time_scale)
     if not (scale > 0):
         raise ValueError(f"time_scale={time_scale} must be > 0")
+    pipelined = (
+        getattr(session, "inflight", 1) > 1 if pipeline is None
+        else bool(pipeline)
+    )
     t0 = time.monotonic()
     sampler = _Sampler(t0, sample_period_s)
     entries: list = []  # (tenant, ticket)
     idx = 0
     dispatch0 = session.dispatches
+    depth_max = 0
+    depth_sum = 0
+    depth_n = 0
 
     def submit(tenant: str) -> None:
         nonlocal idx
@@ -257,6 +292,26 @@ def run_load(session, trace: ArrivalTrace, systems, *, pattern=None,
             kw["pattern"] = pattern
         entries.append((tenant, session.submit(A, b, **kw)))
 
+    def note_depth(outstanding: int) -> None:
+        nonlocal depth_max, depth_sum, depth_n
+        depth_max = max(depth_max, outstanding)
+        depth_sum += outstanding
+        depth_n += 1
+
+    first_unresolved = 0
+
+    def note_timed_depth() -> None:
+        # tickets submitted-but-unresolved right now; retirement is
+        # FIFO, so advancing a pointer over the resolved prefix is
+        # O(1) amortized and exact up to out-of-order requeues
+        nonlocal first_unresolved
+        while (
+            first_unresolved < len(entries)
+            and entries[first_unresolved][1].t_done is not None
+        ):
+            first_unresolved += 1
+        note_depth(len(entries) - first_unresolved)
+
     # -- timed phase -------------------------------------------------------
     coalesce = max(float(coalesce_s), 1e-4)
     for a in trace.arrivals:
@@ -266,36 +321,54 @@ def run_load(session, trace: ArrivalTrace, systems, *, pattern=None,
             if now >= target:
                 break
             if session.pending and target - now > coalesce:
-                session.flush()
+                session.flush(wait=not pipelined)
                 sampler.sample()
                 continue
+            if pipelined:
+                session.poll()  # retire whatever already finished
             sampler.sample()
             time.sleep(min(target - now, coalesce))
         submit(a.tenant)
         sampler.sample()
         if session.pending >= session.batch_max:
-            session.flush()
+            session.flush(wait=not pipelined)
+            note_timed_depth()
             sampler.sample()
     if session.pending:
-        session.flush()
+        session.flush(wait=not pipelined)
+        note_timed_depth()
         sampler.sample()
 
-    # -- closed-loop phase -------------------------------------------------
+    # -- closed-loop phase: drive the ticket future API --------------------
     for c in trace.closed:
-        done = 0
-        while done < c.requests:
-            batch = min(c.concurrency, c.requests - done)
-            start = len(entries)
-            for _ in range(batch):
+        submitted = 0
+        outstanding: list = []  # tickets awaiting resolution, FIFO
+        while submitted < c.requests or outstanding:
+            while (
+                submitted < c.requests
+                and len(outstanding) < max(int(c.concurrency), 1)
+            ):
                 submit(c.tenant)
-            session.flush()
+                outstanding.append(entries[-1][1])
+                submitted += 1
+            session.flush(wait=not pipelined)
             sampler.sample()
-            for _tenant, tk in entries[start:]:
-                try:
-                    tk.result()
-                except Exception:  # noqa: BLE001 - failures counted below
-                    pass
-            done += batch
+            # achieved (not just requested) concurrency: unresolved
+            # tickets at the moment of the await
+            note_depth(sum(1 for tk in outstanding if tk.t_done is None))
+            tk = outstanding.pop(0)
+            try:
+                tk.result()
+            except Exception:  # noqa: BLE001 - failures counted below
+                pass
+            sampler.sample()
+
+    # fully drain the pipeline: the report accounts every ticket
+    if hasattr(session, "drain"):
+        session.drain()
+    else:  # pragma: no cover - pre-pipeline session duck-compat
+        session.flush()
+    sampler.sample()
 
     wall_s = time.monotonic() - t0
     now = time.monotonic()
@@ -305,11 +378,19 @@ def run_load(session, trace: ArrivalTrace, systems, *, pattern=None,
         outcomes.append(
             (tenant, max(end - tk.t_submit, 0.0), tk.done, tk.requeued)
         )
+    inflight_depth = {}
+    if depth_n:
+        inflight_depth = {
+            "max": depth_max,
+            "mean": round(depth_sum / depth_n, 3),
+            "pipelined": pipelined,
+        }
     rep = build_report(
         trace, outcomes, wall_s, slo_ms=session.slo_ms,
         time_scale=scale, queue_depth=sampler.queue,
         device_occupancy=sampler.occ,
         dispatches=session.dispatches - dispatch0,
+        inflight_depth=inflight_depth,
     )
     if record:
         _recorder.record(
@@ -327,5 +408,7 @@ def run_load(session, trace: ArrivalTrace, systems, *, pattern=None,
                 for k, v in rep.tenants.items()
             },
             dispatches=rep.dispatches,
+            **({"inflight_depth": rep.inflight_depth}
+               if rep.inflight_depth else {}),
         )
     return rep

@@ -33,6 +33,7 @@ __all__ = [
     "Preempted",
     "RecoveryInfo",
     "RecoveryPolicy",
+    "deadline_remaining_s",
     "failover",
     "faults",
     "policy",
@@ -44,7 +45,7 @@ def __getattr__(name):
     # policy imports linalg (lazily at call time, but keep the package
     # import light and cycle-proof anyway): resolve on first touch
     if name in ("policy", "RecoveryPolicy", "RecoveryInfo",
-                "solve_with_recovery"):
+                "solve_with_recovery", "deadline_remaining_s"):
         import importlib
 
         _policy = importlib.import_module(".policy", __name__)
@@ -53,5 +54,6 @@ def __getattr__(name):
         globals()["RecoveryPolicy"] = _policy.RecoveryPolicy
         globals()["RecoveryInfo"] = _policy.RecoveryInfo
         globals()["solve_with_recovery"] = _policy.solve_with_recovery
+        globals()["deadline_remaining_s"] = _policy.deadline_remaining_s
         return globals()[name]
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

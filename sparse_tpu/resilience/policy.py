@@ -49,6 +49,7 @@ from . import faults
 __all__ = [
     "RecoveryInfo",
     "RecoveryPolicy",
+    "deadline_remaining_s",
     "solve_with_recovery",
 ]
 
@@ -58,6 +59,24 @@ _GIVEUPS = _metrics.counter("resilience.giveups")
 
 #: escalation ladder: where a solver goes when restarting stops helping
 ESCALATION = {"cg": "bicgstab", "bicgstab": "gmres", "gmres": "gmres"}
+
+
+def deadline_remaining_s(t_start: float, deadline_s,
+                         now: float | None = None) -> float:
+    """Seconds left in a wall-clock budget measured from ``t_start``
+    (``time.monotonic`` base); ``inf`` when ``deadline_s`` is ``None``.
+
+    The shared deadline arithmetic of the resilience surfaces: the
+    recovery ladder's between-attempt gate here, and the batch
+    pipeline's per-ticket checks (``batch/service.py``) — which, with
+    streaming dispatch (ISSUE 13), re-evaluate the SAME budget at
+    *readback* as well as at dispatch, so a lane that went stale while
+    its bucket was in flight never spends a requeue's compute past its
+    deadline."""
+    if deadline_s is None:
+        return math.inf
+    now = time.monotonic() if now is None else now
+    return float(deadline_s) - (now - float(t_start))
 
 
 @dataclass
@@ -280,9 +299,7 @@ def solve_with_recovery(
         gave_up = None
         if attempt >= pol.max_attempts:
             gave_up = "attempts"
-        elif pol.deadline_s is not None and (
-            time.monotonic() - t0
-        ) >= pol.deadline_s:
+        elif deadline_remaining_s(t0, pol.deadline_s) <= 0:
             gave_up = "deadline"
         if gave_up:
             _GIVEUPS.inc()

@@ -5,8 +5,11 @@ event stream; without a request id the stream answers "what happened"
 but not "what happened to MY solve". This module is the propagation
 substrate: every submitted system gets a process-unique *ticket id*
 (``new_ticket_id()``), the session enters a :func:`ticket_scope` around
-each dispatch, and the recorder (``_recorder.record``) stamps every
-event emitted inside the scope with the active ids — so a
+each dispatch AND around each deferred retire (streaming dispatch
+splits the two — the launch's pack/compile events and the retire's
+``batch.dispatch``/requeue/terminal events carry the same lanes'
+ids), and the recorder (``_recorder.record``) stamps every event
+emitted inside the scope with the active ids — so a
 ``kernel.failover`` five layers down in a Pallas wrapper carries the
 tickets whose solve it degraded, without any layer in between knowing
 tickets exist.

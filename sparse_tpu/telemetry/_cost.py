@@ -189,7 +189,10 @@ def note_device_time(program: str, host_ms: float,
     next to the analytic flops/bytes so ``/session`` and
     ``axon_report``'s roofline table gain a *measured* ``device_ms``
     column. A program the table no longer holds (evicted, or compiled by
-    an earlier process) gets a minimal measured-only row."""
+    an earlier process) gets a minimal measured-only row. Under
+    streaming dispatch (ISSUE 13) the sample arrives at the bucket's
+    deferred retire and ``device_ms`` is its completion latency at the
+    dispatch-return boundary — see the :mod:`._profiler` docstring."""
     with _LOCK:
         p = _PROGRAMS.get(program)
         if p is None:

@@ -16,11 +16,18 @@ makes tuning actionable; this module adds the two measured surfaces:
   dispatch (``SPARSE_TPU_PROFILE_EVERY``; 0 = off, the default) splits
   its solve wall clock at the dispatch-return boundary into *host* time
   (trace/dispatch overhead until the async call returns) and *device*
-  time (the ``block_until_ready`` wait), feeding the
+  time (async return until the results are ready — observed at the
+  pipeline's retire), feeding the
   ``batch.program_device_ms{program}`` /
   ``batch.program_host_ms{program}`` histograms and the cost table's
   measured columns (:func:`._cost.note_device_time`) — the
-  ``device_ms`` column in ``axon_report``'s roofline table.
+  ``device_ms`` column in ``axon_report``'s roofline table. Under
+  streaming dispatch (ISSUE 13) ``device_ms`` is the dispatch's
+  *completion latency*: with several buckets in flight it includes
+  device queueing behind earlier buckets, which is exactly the number
+  a serving operator needs (time until this bucket's results existed),
+  not a per-kernel device clock — :func:`capture_trace` remains the
+  ground-truth kernel timeline.
 
 Overhead discipline: sampling takes ONE extra ``time.monotonic()`` per
 sampled dispatch and nothing at all when off; it never enters a traced

@@ -83,8 +83,20 @@ KINDS: dict[str, frozenset] = {
     # a bucket degraded to per-lane eager solves (compiled path
     # unavailable); reason carries the triggering error
     "batch.degraded": frozenset({"solver", "reason"}),
-    # tickets failed by their per-ticket deadline before dispatch
+    # tickets hit by their per-ticket deadline: stage 'dispatch' =
+    # failed while still queued (TicketDeadlineError), stage 'readback'
+    # = the streaming pipeline skipped a requeue for lanes whose budget
+    # lapsed while their bucket was in flight (they keep their result)
     "batch.deadline": frozenset({"solver", "lanes"}),
+    # one per bucket admitted to the streaming in-flight window
+    # (ISSUE 13): the window depth after the enqueue, its capacity
+    # (SPARSE_TPU_INFLIGHT), the program key and real lane count
+    "batch.inflight": frozenset({"depth", "capacity"}),
+    # submit-time admission control engaged (max_queue_depth reached):
+    # mode 'reject' (AdmissionError raised) or 'block' (submit drove
+    # the pipeline until below the threshold; waited_ms carries how
+    # long)
+    "batch.admission": frozenset({"mode", "depth"}),
     # the per-ticket TERMINAL event: one per submitted system per flush
     # resolution, carrying the final state ('done' | 'failed'), the
     # end-to-end latency and the per-phase breakdown (queue/pack/compile/

@@ -70,6 +70,7 @@ _QUICK_FILES = {
     "test_io.py",
     "test_loadgen.py",
     "test_multigrid.py",
+    "test_pipeline.py",
     "test_plan_cache.py",
     "test_quantum.py",
     "test_quick_lane.py",
