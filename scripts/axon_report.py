@@ -593,6 +593,24 @@ def build_report(records_path: str, bench_paths=(), peak_gflops=None,
             metrics["fleet_batched_cg.abs_divergence_pct"] = {
                 "v": abs(fleet_row["divergence_pct"]), "hib": False,
             }
+    # the bench precond_cg row (ISSUE 14): end-to-end preconditioned
+    # batched solve time on the ill-conditioned PDE profile — the
+    # iteration-COUNT regression surface (everything else above tracks
+    # per-iteration throughput)
+    precond_row = None
+    for e in sorted(sessions, key=lambda e: e.get("ts", 0)):
+        rec = e.get("record")
+        if isinstance(rec, dict) and isinstance(
+            rec.get("precond_cg"), dict
+        ):
+            precond_row = rec["precond_cg"]
+    if precond_row:
+        for k, hib in (("end_to_end_s", False), ("iters_mean", False),
+                       ("build_s", False), ("speedup", True)):
+            if _num(precond_row.get(k)) is not None:
+                metrics[f"precond_cg.{k}"] = {
+                    "v": precond_row[k], "hib": hib,
+                }
     for key, p in programs.items():
         if _num(p.get("achieved_gflops")) is not None:
             metrics[f"program.{key}.achieved_gflops"] = {
@@ -635,6 +653,7 @@ def build_report(records_path: str, bench_paths=(), peak_gflops=None,
         "cold_start_row": cold_row,
         "fleet_row": fleet_row,
         "sustained_row": sustained_row,
+        "precond_row": precond_row,
         "bench": bench_rows,
         "metrics": metrics,
     }
@@ -651,6 +670,7 @@ _TREND_EMBEDS = (
     ("cold_start", ("cold_s", "replay_s", "disk_warm_s", "warm_s")),
     ("batched_cg", ("speedup_warm",)),
     ("fleet_batched_cg", ("speedup_warm",)),
+    ("precond_cg", ("end_to_end_s", "iters_mean", "build_s", "speedup")),
 )
 
 
@@ -925,6 +945,17 @@ def _print_report(rep: dict) -> None:
                 f"(inflight={srow.get('inflight')}, "
                 f"host_cores={srow.get('host_cores')})"
             )
+    prow = rep.get("precond_row")
+    if prow:
+        print(
+            "  precond_cg: "
+            f"{prow.get('best_kind')} {prow.get('end_to_end_s')}s "
+            f"vs none {(prow.get('none') or {}).get('end_to_end_s')}s "
+            f"(speedup={prow.get('speedup')}x, "
+            f"iters {(prow.get('none') or {}).get('iters_mean')} -> "
+            f"{prow.get('iters_mean')}, build={prow.get('build_s')}s, "
+            f"profile={prow.get('profile')})"
+        )
     progs = rep.get("programs") or {}
     if progs:
         print(

@@ -72,6 +72,19 @@ chains are missing:
    events, drive the ``queue_depth`` watchdog rule to alert DURING the
    burst and clear after the drain — the admission/alerting loop
    proven end-to-end, on top of zero gauge drift.
+11. **Preconditioner chaos** (ISSUE 14 acceptance drill) — part A: a
+   ``nonfinite:precond`` fault clause poisons the preconditioner apply
+   (the operator stays pristine) under ``solve_with_recovery(M=...)``:
+   the ladder must classify the corruption as nonfinite-in-M
+   (``nonfinite_m``), take the DROP-PRECONDITIONER rung (a
+   ``solver.retry`` event with ``action='drop_precond'`` — no solver
+   escalation spent), re-solve clean and converge, with the full
+   ``fault.injected(site=precond) -> solver.retry -> solver.recovered``
+   chain in the log. Part B: a ``bitflip:io`` clause against the
+   ILU(0) symbolic vault artifact: the corrupted read must quarantine
+   and rebuild (``vault.quarantine``), and the rebuilt symbolic
+   structure must factorize to the EXACT factor the pre-corruption
+   artifact produced — disk corruption can never change the numerics.
 
 Telemetry is pointed at a temp sink (never the committed
 ``results/axon/records.jsonl``). Wired into the quick lane through
@@ -287,6 +300,132 @@ def run(report: dict) -> list:
 
     # -- 10. pipeline restart (kill with buckets in flight) + admission -----
     problems += _pipeline_restart_admission(report)
+
+    # -- 11. precond chaos: drop-M rung + ILU artifact io parity ------------
+    problems += _precond_chaos(report)
+    return problems
+
+
+def _precond_chaos(report: dict) -> list:
+    """Scenario 11 (ISSUE 14): corruption scoped INSIDE the
+    preconditioner apply must take the ladder's drop-preconditioner
+    rung (distinctly classified, no solver escalation), and io
+    corruption of the ILU(0) symbolic vault artifact must quarantine +
+    rebuild to bit-identical factors."""
+    import numpy as np
+
+    import sparse_tpu
+    from sparse_tpu import plan_cache, precond, vault
+    from sparse_tpu import telemetry as tel
+    from sparse_tpu.batch.operator import SparsityPattern
+    from sparse_tpu.config import settings
+    from sparse_tpu.precond import ilu as pilu
+    from sparse_tpu.resilience import RecoveryPolicy, faults, \
+        solve_with_recovery
+
+    problems = []
+    S = _tridiag(N, seed=21)
+    A = sparse_tpu.csr_array(S)
+    b = np.random.default_rng(23).standard_normal(N)
+
+    # -- part A: nonfinite scoped inside the M apply => drop rung -----------
+    tel.reset()
+    faults.clear()
+    # unbounded on purpose: the clause only targets the precond site,
+    # so the drop rung REMOVES the corruption source — the clean
+    # re-solve sees no fires, and the classifier probe (which must
+    # observe M misbehaving) always has budget left
+    faults.configure("nonfinite:precond:p=1")
+    try:
+        M = precond.make_M(A, "jacobi")
+        x, info = solve_with_recovery(
+            A, b, solver="cg", tol=TOL, M=M,
+            policy=RecoveryPolicy(max_attempts=MAX_ATTEMPTS),
+        )
+    finally:
+        faults.clear()
+    rnorm = float(np.linalg.norm(S @ np.asarray(x) - b))
+    kinds = _event_kinds(tel)
+    retries = [
+        e for e in tel.events() if e.get("kind") == "solver.retry"
+    ]
+    dropped = [
+        e for e in retries if e.get("action") == "drop_precond"
+    ]
+    report["precond_drop"] = {
+        "converged": bool(info.converged), "attempts": info.attempts,
+        "rnorm": rnorm, "events": kinds,
+        "retry_actions": [
+            (e.get("action"), e.get("reason")) for e in retries
+        ],
+    }
+    if not info.converged or rnorm > 10 * TOL:
+        problems.append(
+            f"precond drop: failed to recover (converged="
+            f"{info.converged}, ||r||={rnorm:.2e})"
+        )
+    if not any(
+        e.get("kind") == "fault.injected" and e.get("site") == "precond"
+        for e in tel.events()
+    ):
+        problems.append("precond drop: no fault.injected at site=precond")
+    if not dropped:
+        problems.append(
+            "precond drop: ladder never took the drop_precond rung"
+        )
+    elif dropped[0].get("reason") != "nonfinite_m":
+        problems.append(
+            "precond drop: corruption in M not classified nonfinite_m "
+            f"(got {dropped[0].get('reason')!r})"
+        )
+    if info.recovered and kinds.get("solver.recovered", 0) == 0:
+        problems.append("precond drop: missing solver.recovered event")
+
+    # -- part B: bitflipped ILU(0) symbolic artifact => quarantine + parity -
+    tel.reset()
+    vdir = tempfile.mkdtemp(prefix="chaos_precond_vault_")
+    old_vault = settings.vault
+    settings.vault = vdir
+    try:
+        plan_cache.clear()
+        vault.reset_stats()
+        pat = SparsityPattern(S.indptr, S.indices, S.shape)
+        sym = pilu.ilu0_symbolic(pat, "ilu0")  # builds + deposits
+        vals = np.asarray(S.data)[None, :]
+        F_ref = np.asarray(pilu.factorize(sym, vals, sweeps=30))
+        # a fresh pattern OBJECT (same content) misses the in-process
+        # tier; the disk read comes back bitflipped and must quarantine
+        plan_cache.clear()
+        faults.configure("bitflip:io:p=1,n=1,seed=5")
+        try:
+            pat2 = SparsityPattern(S.indptr, S.indices, S.shape)
+            sym2 = pilu.ilu0_symbolic(pat2, "ilu0")
+        finally:
+            faults.clear()
+        F_re = np.asarray(pilu.factorize(sym2, vals, sweeps=30))
+        vstats = vault.stats()
+        qdir = vault.quarantine_dir()
+        qfiles = os.listdir(qdir) if os.path.isdir(qdir) else []
+        report["precond_vault_io"] = {
+            "quarantined": int(vstats.get("quarantined", 0)),
+            "quarantine_files": len(qfiles),
+            "factor_max_err": float(np.abs(F_re - F_ref).max()),
+        }
+        if not qfiles and not vstats.get("quarantined", 0):
+            problems.append(
+                "precond vault io: corrupted ilu_symbolic read was not "
+                "quarantined"
+            )
+        if not np.array_equal(F_re, F_ref):
+            problems.append(
+                "precond vault io: rebuilt symbolic factorizes "
+                "differently (max err "
+                f"{float(np.abs(F_re - F_ref).max()):.2e})"
+            )
+    finally:
+        settings.vault = old_vault
+        faults.clear()
+        plan_cache.clear()
     return problems
 
 

@@ -230,11 +230,13 @@ def batched_cg(A, b, x0=None, tol=1e-08, maxiter=None, M=None,
 # BiCGStab
 # ---------------------------------------------------------------------------
 def _bicgstab_loop(matvec, b, X0, tol, maxiter, conv_test_iters,
-                   lane_reduce=None):
+                   Mvec=None, lane_reduce=None):
     """Masked batched BiCGStab core — the recurrences of
     ``linalg.bicgstab`` with per-lane scalars and frozen converged lanes.
     ``lane_reduce`` is the sharded all-converged exit hook (see
-    :func:`_cg_loop`)."""
+    :func:`_cg_loop`). ``Mvec`` right-preconditions the search
+    directions (``p_hat = M p``, ``s_hat = M s``) — ``None`` (the
+    default) traces byte-identically to the unpreconditioned loop."""
     tol2 = tol.astype(jnp.real(b).dtype) ** 2
     B = b.shape[0]
     cti = max(int(conv_test_iters), 1)
@@ -257,16 +259,18 @@ def _bicgstab_loop(matvec, b, X0, tol, maxiter, conv_test_iters,
         Pn = jnp.where(
             k == 0, R, R + beta[:, None] * (P - omega[:, None] * V)
         )
-        Vn = matvec(Pn)
+        Ph = Pn if Mvec is None else Mvec(Pn)
+        Vn = matvec(Ph)
         rv = _bdot(Rt, Vn)
         alpha_n = rho_new / jnp.where(rv == 0, 1, rv)
         S = R - alpha_n[:, None] * Vn
-        T = matvec(S)
+        Sh = S if Mvec is None else Mvec(S)
+        T = matvec(Sh)
         tt = _bdot(T, T)
         omega_n = _bdot(T, S) / jnp.where(tt == 0, 1, tt)
         am = active[:, None]
         X = jnp.where(
-            am, X + alpha_n[:, None] * Pn + omega_n[:, None] * S, X
+            am, X + alpha_n[:, None] * Ph + omega_n[:, None] * Sh, X
         )
         R = jnp.where(am, S - omega_n[:, None] * T, R)
         P = jnp.where(am, Pn, P)
@@ -295,12 +299,16 @@ def _bicgstab_loop(matvec, b, X0, tol, maxiter, conv_test_iters,
     return X, iters, jnp.real(_bdot(R, R)), ~active
 
 
-def batched_bicgstab(A, b, x0=None, tol=1e-08, maxiter=None,
+def batched_bicgstab(A, b, x0=None, tol=1e-08, maxiter=None, M=None,
                      conv_test_iters=25):
-    """Batched BiCGStab; see :func:`batched_cg` for the lane contract."""
+    """Batched BiCGStab; see :func:`batched_cg` for the lane contract.
+    ``M`` right-preconditions (applied to the search directions), so the
+    residual recurrence — and the stopping rule — stay those of the
+    unpreconditioned solver."""
     mv, b, X0, tol, maxiter, _B, n = _prep(A, b, x0, tol, maxiter)
+    Mvec = None if M is None else as_batched_matvec(M)
     X, iters, resid2, conv = _bicgstab_loop(
-        mv, b, X0, tol, maxiter, conv_test_iters
+        mv, b, X0, tol, maxiter, conv_test_iters, Mvec
     )
     info = BatchedSolveInfo(iters, resid2, conv)
     _solve_event("bicgstab", info, n)

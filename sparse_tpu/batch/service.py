@@ -96,6 +96,7 @@ import numpy as np
 
 from .. import fleet as fleet_mod
 from .. import plan_cache, telemetry
+from .. import precond as precond_mod
 from ..config import settings
 from ..ops import spmv as spmv_ops
 from ..parallel import comm as _comm
@@ -329,12 +330,19 @@ class SolveTicket:
 
 
 class _Request:
-    __slots__ = ("pattern", "values", "b", "tol", "x0", "maxiter", "ticket")
+    __slots__ = ("pattern", "values", "b", "tol", "x0", "maxiter", "ticket",
+                 "precond")
 
-    def __init__(self, pattern, values, b, tol, x0, maxiter, ticket):
+    def __init__(self, pattern, values, b, tol, x0, maxiter, ticket,
+                 precond=None):
         self.pattern, self.values, self.b = pattern, values, b
         self.tol, self.x0, self.maxiter = tol, x0, maxiter
         self.ticket = ticket
+        # per-ticket preconditioner override (ISSUE 14): None = the
+        # session policy decides; a canonical kind/'none' forces it.
+        # Joins the flush group key — lanes with different overrides
+        # never share a bucket program.
+        self.precond = precond
 
 
 def _promote(dt: np.dtype) -> np.dtype:
@@ -547,7 +555,9 @@ class SolveSession:
                  inflight: int | None = None,
                  max_queue_depth: int | None = None,
                  admission: str = "block",
-                 warm_async: bool = True):
+                 warm_async: bool = True,
+                 precond=None,
+                 row_precond=None):
         if solver not in _SOLVERS:
             raise ValueError(f"solver must be one of {_SOLVERS}")
         if fallback_solver not in _SOLVERS:
@@ -606,6 +616,17 @@ class SolveSession:
             fleet, mesh=fleet_mesh, min_b=fleet_min_b,
             row_min_n=row_shard_min_n,
         )
+        # batched preconditioner policy (ISSUE 14, docs/preconditioners
+        # .md): resolves SPARSE_TPU_PRECOND / precond= / per-ticket
+        # overrides into a per-(pattern, solver, bucket, dtype) choice
+        # that joins the program key and the vault manifest. Off (the
+        # default env) leaves keys and jaxprs byte-identical.
+        self.precond = precond_mod.PrecondPolicy.resolve(precond)
+        # optional row-shard-lane preconditioner hook: a callable
+        # ``make_M(DistCSR) -> padded M`` (e.g. a multigrid V-cycle via
+        # parallel.multigrid.vcycle_operator) threaded into
+        # fleet.build_row_program
+        self.row_precond = row_precond
         # per-device real-lane occupancy of the most recent dispatch
         # (the /session device dimension; also on the always-on
         # fleet.device_occupancy gauge family)
@@ -666,7 +687,8 @@ class SolveSession:
     def submit(self, A, b, tol: float = 1e-8, x0=None, maxiter=None,
                pattern: SparsityPattern | None = None,
                deadline_s: float | None = None,
-               tenant: str | None = None) -> SolveTicket:
+               tenant: str | None = None,
+               precond: str | None = None) -> SolveTicket:
         """Queue one system. ``A`` is a CSR-shaped matrix (csr_array /
         scipy) or, with ``pattern=`` given, a bare ``(nnz,)`` value
         vector over that pattern. ``deadline_s`` is a per-ticket wall
@@ -678,6 +700,13 @@ class SolveSession:
         fairness dimension; ``None`` keeps every existing metric series
         name unchanged) — it never enters the compiled program or its
         plan-cache key.
+
+        ``precond`` overrides the session's preconditioner policy for
+        this one request (ISSUE 14): a concrete kind ('jacobi' |
+        'bjacobi' | 'ilu0' | 'ic0' | 'cheby' | 'neumann'), 'auto', or
+        'off'. Requests with different overrides never share a bucket
+        (the override joins the flush group key, like the dtype), and
+        the resolved kind joins the bucket program's plan-cache key.
 
         With ``max_queue_depth`` set, admission control runs first
         (after validation): at the bound, ``admission='block'`` drives
@@ -700,11 +729,14 @@ class SolveSession:
             raise ValueError(
                 f"rhs shape {b.shape} != ({pattern.shape[0]},)"
             )
+        if precond is not None:
+            precond = precond_mod.canonical_kind(precond)  # validate early
         if self.max_queue_depth is not None:
             self._admit()
         t = SolveTicket(self, deadline_s=deadline_s, tenant=tenant)
         q = self._pending.setdefault(id(pattern), [])
-        q.append(_Request(pattern, values, b, float(tol), x0, maxiter, t))
+        q.append(_Request(pattern, values, b, float(tol), x0, maxiter, t,
+                          precond=precond))
         _QUEUE_DEPTH.inc()
         self._unfinalized += 1
         if self.auto_flush is not None and len(q) >= self.auto_flush:
@@ -785,6 +817,7 @@ class SolveSession:
             "patterns": len(self._patterns),
             "dispatches": self.dispatches,
             "mesh": self.fleet.describe(),
+            "precond": self.precond.describe(),
             "device_occupancy": list(self._device_occ),
             "pipeline": {
                 "inflight": self.inflight,
@@ -809,14 +842,15 @@ class SolveSession:
 
     # -- warm restart (ISSUE 9; async since ISSUE 13) ----------------------
     def _manifest_plan(self, e: dict):
-        """Parse one warm-start manifest entry into
-        ``(program_key, solver, bucket, dtype, plan, skip_reason)`` —
-        the SINGLE place entry -> plan-cache key resolution lives, so
-        the async replay's planned-key set (what ``_launch`` waits for)
-        and the replay itself can never disagree. ``skip_reason`` is
+        """Parse one warm-start manifest entry into ``(program_key,
+        solver, bucket, dtype, plan, precond, skip_reason)`` — the
+        SINGLE place entry -> plan-cache key resolution lives, so the
+        async replay's planned-key set (what ``_launch`` waits for) and
+        the replay itself can never disagree. ``skip_reason`` is
         ``None`` for a replayable entry, ``'mesh'`` for a
         topology-mismatched fleet entry (clean cold start) and
-        ``'malformed'`` otherwise."""
+        ``'malformed'`` otherwise. ``precond`` is the entry's recorded
+        kind ('none' when absent — pre-precond manifests stay valid)."""
         solver = e.get("solver")
         try:
             bkt = int(e.get("bucket", 0))
@@ -824,7 +858,13 @@ class SolveSession:
             bkt = 0
         dtstr = e.get("dtype", "")
         if solver not in _SOLVERS or bkt < 1 or not dtstr:
-            return None, None, 0, None, None, "malformed"
+            return None, None, 0, None, None, precond_mod.NONE, "malformed"
+        try:
+            mkind = precond_mod.canonical_kind(
+                e.get("precond"), allow_auto=False
+            )
+        except ValueError:
+            return None, None, 0, None, None, precond_mod.NONE, "malformed"
         # mesh-keyed entries (the fleet tier) only replay on the SAME
         # topology: a fingerprint mismatch — restart on a different pod
         # shape, fleet turned off — skips the entry for a clean cold
@@ -835,16 +875,19 @@ class SolveSession:
                 self.fleet.enabled
                 and mesh_fp == self.fleet.fingerprint
             ):
-                return None, None, 0, None, None, "mesh"
+                return None, None, 0, None, None, precond_mod.NONE, "mesh"
             plan = self.fleet.plan_for(e.get("strategy", "batch"))
         else:
             plan = fleet_mod.FleetPlan("single")
         try:
             dt = np.dtype(dtstr)
         except TypeError:
-            return None, None, 0, None, None, "malformed"
-        key = f"batch.{solver}.B{bkt}.{dt.str}{plan.key_suffix}"
-        return key, solver, bkt, dt, plan, None
+            return None, None, 0, None, None, precond_mod.NONE, "malformed"
+        key = (
+            f"batch.{solver}.B{bkt}.{dt.str}{plan.key_suffix}"
+            f"{precond_mod.key_suffix(mkind)}"
+        )
+        return key, solver, bkt, dt, plan, mkind, None
 
     def _replay_manifest(self, notify=None) -> int:
         """Replay the vault's warm-start manifest: for every recorded
@@ -865,7 +908,8 @@ class SolveSession:
         for e in entries:
             key = None
             try:
-                key, solver, bkt, dt, plan, skip = self._manifest_plan(e)
+                (key, solver, bkt, dt, plan, mkind,
+                 skip) = self._manifest_plan(e)
                 if skip is not None:
                     if skip == "mesh":
                         mesh_skipped += 1
@@ -875,7 +919,8 @@ class SolveSession:
                     continue
                 pat = self._patterns.setdefault(pat.fingerprint, pat)
                 pat.sell_pack()  # disk-tier hit (or rebuild + deposit)
-                self._prebuild(pat, solver, bkt, dt, plan=plan)
+                self._prebuild(pat, solver, bkt, dt, plan=plan,
+                               precond=mkind)
                 replayed += 1
             except Exception:  # noqa: BLE001 - entry isolation
                 continue
@@ -893,16 +938,21 @@ class SolveSession:
         return replayed
 
     def _prebuild(self, pattern: SparsityPattern, solver: str, bkt: int,
-                  dt, plan=None) -> None:
+                  dt, plan=None,
+                  precond: str = precond_mod.NONE) -> None:
         """Build (and AOT-compile, via the usual cost attribution) one
         bucket program outside any dispatch — argument shapes/dtypes
         mirror ``_dispatch`` exactly (including the fleet strategy's
-        mesh-fingerprinted key), so the first real dispatch of this
-        bucket is a plan-cache hit into a warm executable."""
+        mesh-fingerprinted key and the resolved precond suffix), so the
+        first real dispatch of this bucket is a plan-cache hit into a
+        warm executable."""
         dt = np.dtype(dt)
         if plan is None:
             plan = fleet_mod.FleetPlan("single")
-        key = f"batch.{solver}.B{bkt}.{dt.str}{plan.key_suffix}"
+        key = (
+            f"batch.{solver}.B{bkt}.{dt.str}{plan.key_suffix}"
+            f"{precond_mod.key_suffix(precond)}"
+        )
         n = pattern.shape[0]
         # the same conversion pipeline as a real dispatch (np stacks ->
         # jnp.asarray), so trace signatures match under any x64 setting
@@ -917,11 +967,13 @@ class SolveSession:
         def build():
             tb = time.perf_counter()
             fn = self._build_program(pattern, bkt, dt, solver=solver,
-                                     plan=plan)
+                                     plan=plan, precond=precond)
             prog, _info = _cost.attribute(
                 key, fn, args, pack_s=time.perf_counter() - tb,
                 solver=solver, bucket=bkt, dtype=dt.str,
                 n=n, nnz=pattern.nnz, warm_start=True,
+                **({"precond": precond}
+                   if precond != precond_mod.NONE else {}),
             )
             return prog
 
@@ -1017,18 +1069,24 @@ class SolveSession:
                 )
             for r in expired:
                 self._finalize_ticket(r.ticket)
-            # one group per result dtype so stacked values are homogeneous
+            # one group per (result dtype, precond override) so stacked
+            # values are homogeneous and every lane of a bucket shares
+            # one preconditioner choice
             by_dt: dict = {}
             for r in live:
                 dt = np.result_type(r.values.dtype, r.b.dtype)
-                by_dt.setdefault(np.dtype(dt), []).append(r)
-            for dt, reqs in sorted(by_dt.items(), key=lambda kv: kv[0].str):
+                by_dt.setdefault(
+                    (np.dtype(dt), r.precond or ""), []
+                ).append(r)
+            for (dt, pov), reqs in sorted(
+                by_dt.items(), key=lambda kv: (kv[0][0].str, kv[0][1])
+            ):
                 for lo in range(0, len(reqs), self.batch_max):
                     chunk = reqs[lo:lo + self.batch_max]
                     err = None
                     for _attempt in range(self.dispatch_attempts):
                         try:
-                            self._dispatch(chunk, dt)
+                            self._dispatch(chunk, dt, precond=pov or None)
                             dispatched += 1
                             err = None
                             break
@@ -1217,7 +1275,8 @@ class SolveSession:
             )
 
     def _dispatch(self, reqs, dt, solver: str | None = None,
-                  allow_requeue: bool = True) -> None:
+                  allow_requeue: bool = True,
+                  precond: str | None = None) -> None:
         """Enqueue one bucket through the streaming pipeline: launch
         (pack -> upload -> async program call) under the lanes' ticket
         scope, admit the dispatch to the bounded in-flight window, and
@@ -1228,7 +1287,7 @@ class SolveSession:
         # fault.injected, plan_cache.compile — carries the lanes' ticket
         # ids (replace semantics: a requeue re-enters with its own lanes)
         with telemetry.ticket_scope(*(r.ticket.id for r in reqs)):
-            fl = self._launch(reqs, dt, solver, allow_requeue)
+            fl = self._launch(reqs, dt, solver, allow_requeue, precond)
         if fl is None:
             return  # degraded at launch; lanes already resolved
         self._inflight.append(fl)
@@ -1243,7 +1302,7 @@ class SolveSession:
             self._retire(self._inflight.popleft())
 
     def _launch(self, reqs, dt, solver: str | None,
-                allow_requeue: bool):
+                allow_requeue: bool, precond: str | None = None):
         """The host half of a dispatch: pack the lane stacks, stage the
         upload (``bucket.stage_lanes`` — pad + eager ``device_put``),
         resolve the bucket program (waiting for an in-progress warm
@@ -1296,8 +1355,19 @@ class SolveSession:
             for r in reqs
         )
         snap = plan_cache.snapshot()
-        faulty = _faults.ACTIVE and _faults.targets("matvec")
-        key = f"batch.{solver}.B{bkt}.{np.dtype(dt).str}{plan.key_suffix}"
+        # the resolved per-(pattern, solver, bucket, dtype) precond kind
+        # (ISSUE 14): per-ticket override first, else the session
+        # policy; joins the program key so 'none' keys stay historic
+        mkind = self.precond.decide(
+            pattern, solver, bkt, dt, override=precond
+        )
+        faulty = _faults.ACTIVE and (
+            _faults.targets("matvec") or _faults.targets("precond")
+        )
+        key = (
+            f"batch.{solver}.B{bkt}.{np.dtype(dt).str}{plan.key_suffix}"
+            f"{precond_mod.key_suffix(mkind)}"
+        )
         if faulty:
             # fault-wrapped programs carry the injection callback in
             # their trace: never share cache entries with clean ones
@@ -1313,12 +1383,15 @@ class SolveSession:
             # as the miss itself)
             tb = time.perf_counter()
             fn = self._build_program(pattern, bkt, np.dtype(dt),
-                                     solver=solver, plan=plan)
+                                     solver=solver, plan=plan,
+                                     precond=mkind)
             prog, info = _cost.attribute(
                 key, fn, args,
                 pack_s=time.perf_counter() - tb,
                 solver=solver, bucket=bkt, dtype=np.dtype(dt).str,
                 n=pattern.shape[0], nnz=pattern.nnz,
+                **({"precond": mkind}
+                   if mkind != precond_mod.NONE else {}),
             )
             built.update(info)
             return prog
@@ -1344,12 +1417,17 @@ class SolveSession:
                     # row programs are rebuilt per dispatch (no compiled
                     # artifact worth replaying); batch-sharded programs
                     # note the mesh fingerprint so only a same-topology
-                    # restart replays them
+                    # restart replays them; preconditioned programs note
+                    # their resolved kind (ISSUE 14) so the replay
+                    # rebuilds the SAME keyed program, symbolic maps
+                    # loading from their vault artifacts
                     vault.note_program(
                         pattern, solver=solver, bucket=bkt,
                         dtype=np.dtype(dt).str,
                         mesh=(plan.fingerprint if plan.sharded else None),
                         strategy=(plan.strategy if plan.sharded else None),
+                        precond=(mkind if mkind != precond_mod.NONE
+                                 else None),
                     )
             # sampled timed dispatch (ISSUE 12): every Nth dispatch
             # takes ONE extra timestamp at the dispatch-return boundary
@@ -1357,6 +1435,14 @@ class SolveSession:
             # vs device (results-ready wait) time. Off (the default)
             # takes no timestamp at all; the program and its plan-cache
             # key are identical either way.
+            if mkind != precond_mod.NONE and telemetry.enabled():
+                # the host-side record that this dispatch's program
+                # factorizes/applies M in-trace (the numeric build is
+                # compiled into the bucket program)
+                telemetry.record(
+                    "precond.apply", precond=mkind, lanes=nb,
+                    solver=solver, bucket=bkt,
+                )
             self._dispatch_seq += 1
             sampled = (
                 self.profile_every > 0
@@ -1561,14 +1647,19 @@ class SolveSession:
                 tickets=[r.ticket.id for r in reqs],
             )
         # fresh maxiter budget: the lane may have failed BECAUSE the
-        # caller's budget was too small for the requested solver
+        # caller's budget was too small for the requested solver.
+        # The fallback bucket also DROPS the preconditioner (ISSUE 14,
+        # the session-level drop rung of docs/resilience.md): a
+        # nonfinite lane may owe its corruption to M's factorization —
+        # the safer re-solve must not reuse it.
         fb = [
-            _Request(r.pattern, r.values, r.b, r.tol, None, None, r.ticket)
+            _Request(r.pattern, r.values, r.b, r.tol, None, None, r.ticket,
+                     precond="off")
             for r in reqs
         ]
         try:
             self._dispatch(fb, fb_dt, solver=self.fallback_solver,
-                           allow_requeue=False)
+                           allow_requeue=False, precond="off")
         except Exception:  # noqa: BLE001 - first results already stand
             # the requeue is best-effort: every lane already holds its
             # first (unconverged) result, which result() returns
@@ -1631,7 +1722,8 @@ class SolveSession:
             r.ticket._fail(e)
 
     def _build_program(self, pattern: SparsityPattern, bkt: int, dt,
-                       solver: str | None = None, plan=None):
+                       solver: str | None = None, plan=None,
+                       precond: str = precond_mod.NONE):
         """The per-bucket compiled program: pattern pack + masked solver
         loop under ONE ``jax.jit`` whose arguments are the value stack,
         rhs, x0 and tolerances — so same-bucket dispatches with fresh
@@ -1643,24 +1735,39 @@ class SolveSession:
         with the psum all-converged exit (gmres shards its inputs and
         lets GSPMD partition the host-driven cycle), 'row' wraps
         ``DistCSR``/``dist_cg`` in a B=1 bucket signature. 'single' (or
-        ``None``) is byte-identical to the classic path."""
+        ``None``) is byte-identical to the classic path.
+
+        ``precond`` is the resolved preconditioner kind (ISSUE 14):
+        pattern-level maps build HERE on the host (plan-cached,
+        vault-persisted), the numeric factorization compiles INTO the
+        program from its ``values`` argument, so every dispatch
+        factorizes fresh coefficients on device. 'none' leaves the
+        program byte-identical to the historic unpreconditioned one."""
         solver = solver or self.solver
         if plan is not None and plan.strategy == "row":
             return fleet_mod.build_row_program(
                 pattern, dt, plan.mesh,
                 conv_test_iters=self.conv_test_iters,
+                make_M=self.row_precond,
             )
+        mfac = (
+            None if precond == precond_mod.NONE
+            else self.precond.factory(pattern, precond)
+        )
         if plan is not None and plan.strategy == "batch":
             return fleet_mod.build_batch_program(
                 pattern, bkt, dt, solver, plan.mesh,
                 self.conv_test_iters,
                 gmres_inner=(
-                    self._build_gmres_program(pattern, bkt, dt)
+                    self._build_gmres_program(pattern, bkt, dt,
+                                              precond=precond)
                     if solver == "gmres" else None
                 ),
+                m_factory=mfac,
             )
         if solver == "gmres":
-            return self._build_gmres_program(pattern, bkt, dt)
+            return self._build_gmres_program(pattern, bkt, dt,
+                                             precond=precond)
         pack = pattern.sell_pack()
         idx_slabs, pos, zero_rows = (
             pack.idx_slabs, pack.pos, pack.plan.zero_rows
@@ -1684,23 +1791,36 @@ class SolveSession:
                     idx_slabs, vals, pos, X, zero_rows
                 )
 
-            return loop(krylov._maybe_faulty_mv(mv), rhs, x0, tols,
-                        maxiter, cti)
+            fmv = krylov._maybe_faulty_mv(mv)
+            # batched numeric factorization from THIS dispatch's value
+            # stack (ISSUE 14) — pattern maps are closure constants
+            Mvec = None if mfac is None else mfac(values, fmv)
+            return loop(fmv, rhs, x0, tols, maxiter, cti, Mvec=Mvec)
 
         return run
 
-    def _build_gmres_program(self, pattern, bkt, dt):
+    def _build_gmres_program(self, pattern, bkt, dt,
+                             precond: str = precond_mod.NONE):
         """GMRES keeps its host-driven outer restart loop, so the bucket
         'program' is a closure dispatching :func:`krylov.batched_gmres`
         over a pattern-packed operator — restart cycles still compile
         once per bucket (the jitted cycle is rebuilt per dispatch; the
-        XLA executable comes from jax's compile cache)."""
+        XLA executable comes from jax's compile cache). ``precond``
+        resolves to a left preconditioner of the batched cycle."""
         restart = self.restart
 
         restart_eff = restart or min(20, pattern.shape[0])
+        mfac = (
+            None if precond == precond_mod.NONE
+            else self.precond.factory(pattern, precond)
+        )
 
         def run(values, rhs, x0, tols, maxiter):
             op = BatchedCSR(pattern, values)
+            M = (
+                None if mfac is None
+                else mfac(jnp.asarray(values), op.matvec)
+            )
             # batched_gmres takes a scalar-or-(B,) relative tol; the
             # session's per-lane ABSOLUTE targets ride the atol floor.
             # Its maxiter counts OUTER restarts; bound inner work by the
@@ -1708,7 +1828,7 @@ class SolveSession:
             outer = max(-(-int(maxiter) // restart_eff), 1)
             X, info = krylov.batched_gmres(
                 op, rhs, x0=x0, tol=0.0, atol=tols, restart=restart_eff,
-                maxiter=outer,
+                maxiter=outer, M=M,
             )
             return X, info.iters, info.resid2, info.converged
 

@@ -64,6 +64,16 @@ Flags (all env-overridable):
                                 enqueue->block path); 2 (default) double-buffers so
                                 the host packs/uploads bucket N+1 while the device
                                 solves bucket N.
+  SPARSE_TPU_PRECOND          - batched preconditioner policy (sparse_tpu.precond):
+                                '' / 'off' (default) = none, 'auto' = pick per
+                                (pattern, solver, bucket, dtype), or force 'jacobi' |
+                                'bjacobi' | 'ilu0' | 'ic0' | 'cheby' | 'neumann'.
+  SPARSE_TPU_PRECOND_BLOCK    - block-Jacobi block size (default 4).
+  SPARSE_TPU_PRECOND_SWEEPS   - Chow-Patel sweeps of the batched ILU(0)/IC(0)
+                                numeric factorization (default 3).
+  SPARSE_TPU_PRECOND_TRI_SWEEPS - Jacobi-Richardson sweeps of the batched
+                                triangular apply (default 4).
+  SPARSE_TPU_PRECOND_DEGREE   - polynomial preconditioner degree (default 4).
 """
 
 from __future__ import annotations
@@ -263,6 +273,38 @@ class Settings:
     # every setting; only host-side scheduling changes.
     inflight: int = field(
         default_factory=lambda: max(_env_int("SPARSE_TPU_INFLIGHT", 2), 1)
+    )
+    # Batched preconditioner policy (sparse_tpu.precond, ISSUE 14):
+    # '' / 'off' = none (the historic unpreconditioned path, program
+    # keys and jaxprs unchanged); 'auto' picks per (pattern, solver,
+    # bucket, dtype); or force one kind: 'jacobi' | 'bjacobi' | 'ilu0' |
+    # 'ic0' | 'cheby' | 'neumann'. Per-session (SolveSession(precond=))
+    # and per-ticket (submit(precond=)) overrides win over the env.
+    precond: str = field(
+        default_factory=lambda: _env_str("SPARSE_TPU_PRECOND", "")
+    )
+    # Block size of the pattern-shared block-Jacobi factors (diagonal
+    # blocks extracted once per SparsityPattern, batched dense inverses
+    # over the (B, blocks, bs, bs) stack).
+    precond_block: int = field(
+        default_factory=lambda: max(_env_int("SPARSE_TPU_PRECOND_BLOCK", 4), 1)
+    )
+    # Chow-Patel fixed-point sweeps of the batched ILU(0)/IC(0) numeric
+    # factorization (data-independent count: the factorization stays one
+    # straight-line jit program).
+    precond_sweeps: int = field(
+        default_factory=lambda: max(_env_int("SPARSE_TPU_PRECOND_SWEEPS", 3), 1)
+    )
+    # Jacobi-Richardson sweeps of the batched triangular application
+    # (approximate L/U solves with no data-dependent control flow).
+    precond_tri_sweeps: int = field(
+        default_factory=lambda: max(
+            _env_int("SPARSE_TPU_PRECOND_TRI_SWEEPS", 4), 1
+        )
+    )
+    # Degree of the polynomial (Chebyshev/Neumann) preconditioners.
+    precond_degree: int = field(
+        default_factory=lambda: max(_env_int("SPARSE_TPU_PRECOND_DEGREE", 4), 1)
     )
 
 

@@ -58,7 +58,8 @@ def _host_spmv(pattern, vals, x):
     return np.bincount(seg, weights=prod, minlength=m)
 
 
-def build_row_program(pattern, dt, mesh, conv_test_iters: int = 25):
+def build_row_program(pattern, dt, mesh, conv_test_iters: int = 25,
+                      make_M=None):
     """One row-sharded B=1 bucket program over ``pattern``.
 
     The returned ``run`` is a host closure (never jitted at this level —
@@ -67,6 +68,14 @@ def build_row_program(pattern, dt, mesh, conv_test_iters: int = 25):
     compiled distributed CG to the lane's ABSOLUTE tolerance (the
     session contract: ``||r|| < tol``), and returns numpy lane stacks
     shaped exactly like a batch program's output.
+
+    ``make_M`` (ISSUE 14 satellite) hooks a preconditioner into the
+    distributed solve: a callable ``make_M(DistCSR) -> M`` invoked per
+    dispatch after the row-block layout exists, returning anything
+    ``dist_cg`` accepts as ``M`` — a padded-vector callable or a
+    LinearOperator-shaped object (e.g. a multigrid V-cycle via
+    :func:`sparse_tpu.parallel.multigrid.vcycle_operator`). Best-effort:
+    a failing hook falls back to the unpreconditioned solve.
     """
     from ..parallel.dist import dist_cg, shard_csr
 
@@ -87,10 +96,16 @@ def build_row_program(pattern, dt, mesh, conv_test_iters: int = 25):
         A = _HostCSR(pattern.indptr, pattern.indices, values[0],
                      pattern.shape)
         D = shard_csr(A, mesh=mesh, axis=axis, balanced=True)
+        M = None
+        if make_M is not None:
+            try:
+                M = make_M(D)
+            except Exception:  # noqa: BLE001 - best-effort hook
+                M = None
         xp, iters, _conv = dist_cg(
             D, rhs[0], x0=(x0[0] if np.any(x0) else None),
             tol=0.0, atol=float(tols[0]), maxiter=int(maxiter),
-            conv_test_iters=cti,
+            conv_test_iters=cti, M=M,
         )
         x = D.unpad_vector(xp).astype(dt, copy=False)
         r = rhs[0] - _host_spmv(pattern, values[0], x)

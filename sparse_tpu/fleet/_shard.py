@@ -75,7 +75,8 @@ def shard_inputs(mesh, *arrays):
 
 
 def build_batch_program(pattern, bkt: int, dt, solver: str, mesh,
-                        conv_test_iters: int, gmres_inner=None):
+                        conv_test_iters: int, gmres_inner=None,
+                        m_factory=None):
     """The mesh-sharded analog of ``SolveSession._build_program``: one
     compiled program whose arguments are the bucket's ``(B, nnz)`` value
     stack, ``(B, n)`` rhs/x0, per-lane tolerances and maxiter, with the
@@ -85,6 +86,17 @@ def build_batch_program(pattern, bkt: int, dt, solver: str, mesh,
     cg/bicgstab run under ``shard_map`` with the global psum exit;
     gmres wraps ``gmres_inner`` (the session's host-driven closure) with
     input sharding and lets GSPMD partition the cycle.
+
+    ``m_factory`` is the resolved preconditioner's numeric factory
+    (ISSUE 14, :mod:`sparse_tpu.precond`): its pattern-level maps are
+    closure constants — REPLICATED across the mesh exactly like the
+    SELL pattern plan — and the numeric factorization runs inside the
+    ``shard_map`` body over each device's LOCAL ``(B/S, nnz)`` value
+    shard. Preconditioning is lane-local (diag/block extraction,
+    fixed-sweep factorization sweeps, triangular sweeps are all
+    per-lane), so it adds ZERO collectives to the sharded program and
+    per-lane iterates stay bit-identical to the single-device
+    preconditioned program.
     """
     from ..batch import krylov
 
@@ -133,8 +145,12 @@ def build_batch_program(pattern, bkt: int, dt, solver: str, mesh,
                 idx_slabs, vals, pos, X, zero_rows
             )
 
+        fmv = krylov._maybe_faulty_mv(mv)
+        # lane-local numeric factorization from this shard's value
+        # stack; the factory's maps ride in as replicated constants
+        Mvec = None if m_factory is None else m_factory(values, fmv)
         return loop(
-            krylov._maybe_faulty_mv(mv), rhs, x0, tols, maxiter, cti,
+            fmv, rhs, x0, tols, maxiter, cti, Mvec=Mvec,
             lane_reduce=lane_reduce,
         )
 

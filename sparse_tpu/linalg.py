@@ -995,7 +995,16 @@ def gmres(
     try:
         # warm host-side format dispatch (e.g. csr_array._maybe_dia) with
         # one eager matvec so the traced cycle sees pure jnp paths
-        M.matvec(b - A.matvec(x))
+        r0 = b - A.matvec(x)
+        # warm a non-identity preconditioner EAGERLY as well, aligned
+        # with cg's warm-up (ISSUE 14 satellite): M's layout detection
+        # (_maybe_dia/_maybe_ell) host-syncs on first use and is skipped
+        # inside a trace, so an M first applied inside the first
+        # compiled cycle would silently take its slowest kernel path for
+        # the whole solve — the host-sync-count test in
+        # tests/test_precond.py pins that no M syncs land per cycle
+        if not isinstance(M, IdentityOperator):
+            M.matvec(r0)
         cycle = _make_gmres_cycle(A, M, restart, jnp.dtype(b.dtype))
         total_iters = 0
         for _outer in range(maxiter):

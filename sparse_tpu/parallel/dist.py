@@ -845,7 +845,16 @@ def make_dist_cg(
     """
     if maxiter is None:
         maxiter = A.shape[0] * 10
-    precond = M if M is not None else (lambda r: r)
+    # M may be a padded-vector callable (the historic contract) or a
+    # LinearOperator-shaped object (ISSUE 14: e.g. a multigrid V-cycle
+    # promoted via parallel.multigrid.vcycle_operator) — resolve to the
+    # traceable apply either way
+    if M is None:
+        precond = lambda r: r  # noqa: E731 - identity, traced away
+    elif hasattr(M, "matvec"):
+        precond = M.matvec
+    else:
+        precond = M
 
     @jax.jit
     def run(bp, xp):

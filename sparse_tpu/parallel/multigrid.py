@@ -29,7 +29,31 @@ __all__ = [
     "make_replicated_tail",
     "tail_crossover",
     "hierarchy_comm_per_cycle",
+    "vcycle_operator",
 ]
+
+
+def vcycle_operator(cycle, m_pad: int, dtype=None):
+    """Promote a V-cycle apply (:func:`make_dist_vcycle`'s return) to a
+    :class:`~sparse_tpu.linalg.LinearOperator` on the padded sharded
+    vector space (ISSUE 14 satellite).
+
+    ``dist_cg`` accepts either form; the operator view is what the
+    fleet's row-shard lane threads through
+    ``SolveSession(row_precond=...)`` /
+    :func:`sparse_tpu.fleet.build_row_program` — the hook builds the
+    hierarchy per layout, wraps its cycle here, and the distributed CG
+    preconditions on it with the V-cycle compiled INTO the while_loop
+    (no per-level launches, no host round trips)."""
+    import numpy as _np
+
+    from ..linalg import LinearOperator
+
+    m_pad = int(m_pad)
+    return LinearOperator(
+        (m_pad, m_pad), matvec=cycle,
+        dtype=_np.dtype(dtype if dtype is not None else _np.float64),
+    )
 
 
 def hierarchy_comm_per_cycle(ops) -> dict:

@@ -1,0 +1,194 @@
+"""Pattern-shared batched Jacobi preconditioners (point and block).
+
+The Ginkgo batched recipe (PAPERS.md §2) split into the repo's
+prepare/execute idiom: everything that depends only on the
+*sparsity pattern* — which nnz position holds each row's diagonal,
+which positions fall inside each diagonal block — is computed ONCE per
+:class:`~sparse_tpu.batch.operator.SparsityPattern` on the host, lives
+in :mod:`sparse_tpu.plan_cache` (vault-persisted, so a warm restart
+skips it), and enters the compiled bucket programs as replicated
+closure constants. The *numeric* half — extracting the diagonal /
+blocks from a ``(B, nnz)`` value stack and inverting the small dense
+blocks — is pure batched jnp executed inside the jitted program, so
+every dispatch factorizes its fresh coefficients at device speed with
+no host round trip.
+
+* **Point Jacobi** (``jacobi``): ``M r = r / diag(A)`` per lane — one
+  gather through the pattern's diagonal position map plus a broadcast
+  multiply per application.
+* **Block Jacobi** (``bjacobi``): the diagonal ``bs x bs`` blocks
+  gather through a pattern-shared ``(blocks, bs, bs)`` source map into
+  a ``(B, blocks, bs, bs)`` stack, invert with one batched
+  ``jnp.linalg.inv``, and apply as a batched block matmul. Rows past
+  ``n`` (the ragged last block) and structurally missing diagonal
+  entries are patched with identity on the host map, so the inverses
+  are well-defined for any pattern.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from .. import plan_cache
+from ..utils import commit_to_exec_device, host_scope
+
+
+def _pattern_rows(pattern) -> np.ndarray:
+    counts = pattern.indptr[1:] - pattern.indptr[:-1]
+    return np.repeat(np.arange(pattern.shape[0], dtype=np.int64), counts)
+
+
+def diag_map(pattern):
+    """Per-pattern diagonal position map, via the plan cache: device
+    arrays ``(dpos (n,), has (n,))`` where ``values[:, dpos]`` gathers
+    each row's diagonal entry (``has`` False where the pattern has no
+    structural diagonal — those rows precondition as identity)."""
+
+    def build():
+        import time
+
+        from . import _build_event
+
+        t0 = time.perf_counter()
+        with host_scope():
+            n = pattern.shape[0]
+            rows = _pattern_rows(pattern)
+            cols = pattern.indices.astype(np.int64)
+            dpos = np.full(n, -1, dtype=np.int64)
+            on_diag = rows == cols
+            dpos[rows[on_diag]] = np.nonzero(on_diag)[0]
+            has = dpos >= 0
+        out = commit_to_exec_device((
+            jnp.asarray(np.maximum(dpos, 0).astype(np.int32)),
+            jnp.asarray(has),
+        ))
+        _build_event("jacobi", pattern, time.perf_counter() - t0,
+                     stage="diag_map")
+        return out
+
+    def vault_key():
+        from ..vault import _codecs
+
+        return _codecs.digest("preconddiag", pattern.fingerprint[2])
+
+    return plan_cache.get(
+        pattern, "precond.diag", build,
+        vault_kind="precond_diag", vault_key=vault_key,
+    )
+
+
+def _safe_recip(d):
+    one = jnp.ones((), dtype=d.dtype)
+    return jnp.where(d == 0, one, one / jnp.where(d == 0, one, d))
+
+
+def diag_of(pattern, values):
+    """``(B, n)`` diagonal stack of a ``(B, nnz)`` value stack (1 where
+    the pattern has no diagonal entry) — jit-safe given a warm map."""
+    dpos, has = diag_map(pattern)
+    d = values[..., dpos]
+    return jnp.where(has, d, jnp.ones((), dtype=values.dtype))
+
+
+def jacobi_factory(pattern):
+    """Point-Jacobi numeric factory: ``factory(values, matvec) -> Mvec``
+    with ``Mvec(R) = R / diag(A)`` per lane. The map build (host) runs
+    here, once per pattern; the returned factory is pure jnp."""
+    diag_map(pattern)  # host build outside any trace
+
+    def factory(values, matvec=None):
+        dinv = _safe_recip(diag_of(pattern, values))
+
+        def Mvec(R):
+            return R * dinv
+
+        return Mvec
+
+    return factory
+
+
+def block_map(pattern, bs: int):
+    """Pattern-shared block extraction map for ``bs x bs`` diagonal
+    blocks, via the plan cache (vault-persisted): device arrays
+    ``(src (nb, bs, bs) int32, fix (nb, bs, bs))`` where ``src`` holds
+    the nnz position feeding each in-block slot (0 where absent — the
+    gathered value is masked by ``src >= 0`` pre-clip) and ``fix`` adds
+    identity at padded rows (beyond ``n``) and structurally missing
+    diagonal slots so every block inverts."""
+    bs = int(bs)
+
+    def build():
+        import time
+
+        from . import _build_event
+
+        t0 = time.perf_counter()
+        with host_scope():
+            n = pattern.shape[0]
+            nb = -(-n // bs)
+            rows = _pattern_rows(pattern)
+            cols = pattern.indices.astype(np.int64)
+            inblk = (rows // bs) == (cols // bs)
+            src = np.full((nb, bs, bs), -1, dtype=np.int64)
+            r, c, p = rows[inblk], cols[inblk], np.nonzero(inblk)[0]
+            src[r // bs, r % bs, c % bs] = p
+            fix = np.zeros((nb, bs, bs), dtype=np.float64)
+            # identity at ragged pad rows and missing structural diagonals
+            flat = np.arange(nb * bs)
+            missing = (flat >= n) | (src[flat // bs, flat % bs, flat % bs] < 0)
+            fix[flat[missing] // bs, flat[missing] % bs, flat[missing] % bs] = 1.0
+        out = commit_to_exec_device((
+            jnp.asarray(src.astype(np.int32)), jnp.asarray(fix),
+        ))
+        _build_event("bjacobi", pattern, time.perf_counter() - t0,
+                     stage="block_map", bs=bs)
+        return out
+
+    def vault_key():
+        from ..vault import _codecs
+
+        return _codecs.digest("precondblk", pattern.fingerprint[2], bs)
+
+    return plan_cache.get(
+        pattern, f"precond.block.{bs}", build,
+        vault_kind="precond_block", vault_key=vault_key,
+    )
+
+
+def bjacobi_factory(pattern, bs: int | None = None):
+    """Block-Jacobi numeric factory over ``bs x bs`` diagonal blocks:
+    gathers the block stack from the value stack through the
+    pattern-shared map, inverts it batched, and applies as a batched
+    block matmul. ``factory(values, matvec) -> Mvec``."""
+    from ..config import settings
+
+    n = pattern.shape[0]
+    bs = max(min(int(bs or settings.precond_block), max(n, 1)), 1)
+    if bs == 1:
+        return jacobi_factory(pattern)
+    block_map(pattern, bs)  # host build outside any trace
+    nb = -(-n // bs)
+    n_pad = nb * bs
+
+    def factory(values, matvec=None):
+        src, fix = block_map(pattern, bs)
+        gathered = jnp.where(
+            src >= 0,
+            values[..., jnp.maximum(src, 0)],
+            jnp.zeros((), dtype=values.dtype),
+        )  # (B, nb, bs, bs)
+        blocks = gathered + fix.astype(values.dtype)
+        inv = jnp.linalg.inv(blocks)
+
+        def Mvec(R):
+            B = R.shape[0]
+            Rp = jnp.pad(R, ((0, 0), (0, n_pad - n)))
+            Z = jnp.einsum(
+                "bkij,bkj->bki", inv, Rp.reshape(B, nb, bs)
+            )
+            return Z.reshape(B, n_pad)[:, :n]
+
+        return Mvec
+
+    return factory

@@ -1,0 +1,209 @@
+"""PrecondPolicy: which preconditioner a bucket program gets.
+
+The resolution ladder (most specific wins):
+
+1. per-ticket override (``SolveSession.submit(precond=...)``) — lanes
+   with different overrides never share a bucket (the group key carries
+   the override, like the dtype);
+2. per-session (``SolveSession(precond=...)``);
+3. the environment (``SPARSE_TPU_PRECOND`` — '' / 'off' keeps every
+   historic program key and jaxpr byte-identical).
+
+A resolved choice is per ``(pattern, solver, bucket, dtype)`` — the
+same axes as the bucket programs themselves — and joins the program's
+plan-cache key (``.M<kind>`` suffix; absent for 'none', so
+unpreconditioned keys are unchanged) and the vault warm-start manifest
+(back-compatible ``_entry_key`` extension, like Fleet's ``mesh``).
+
+``auto`` picks by solver and pattern shape: block-Jacobi for CG
+(the SPD serving shape the bench targets), point Jacobi for
+BiCGStab/GMRES, none for non-square patterns. Kinds that cannot apply
+to a pattern (IC(0) on a structurally asymmetric pattern) degrade one
+rung (to point Jacobi) with a ``coverage.fallback`` breadcrumb rather
+than failing the dispatch.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .. import telemetry
+from ..config import settings
+
+#: the forceable kinds (the SPARSE_TPU_PRECOND grammar minus auto/off)
+KINDS = ("jacobi", "bjacobi", "ilu0", "ic0", "cheby", "neumann")
+
+NONE = "none"
+
+_OFF = ("", "0", "off", "false", "no", "none")
+
+
+def canonical_kind(kind, allow_auto: bool = True) -> str:
+    """Normalize a kind spelling; raises on unknown values (a typo'd
+    ``SPARSE_TPU_PRECOND`` must not silently serve unpreconditioned)."""
+    s = str("" if kind is None else kind).strip().lower()
+    if s in _OFF:
+        return NONE
+    if s == "auto":
+        if not allow_auto:
+            raise ValueError("'auto' is not a concrete preconditioner kind")
+        return "auto"
+    if s not in KINDS:
+        raise ValueError(
+            f"precond kind {kind!r} not one of {('off', 'auto') + KINDS}"
+        )
+    return s
+
+
+def key_suffix(kind: str | None) -> str:
+    """What a resolved kind contributes to the bucket-program plan-cache
+    key — empty for 'none' so unpreconditioned keys, programs and vault
+    manifests are byte-compatible with every earlier release."""
+    if not kind or kind == NONE:
+        return ""
+    return f".M{kind}"
+
+
+class PrecondPolicy:
+    """Per-session preconditioner selector (constructed by
+    ``SolveSession``; also usable standalone).
+
+    Parameters
+    ----------
+    mode : '' / 'off' | 'auto' | one of :data:`KINDS`. ``None`` =
+        ``settings.precond`` (``SPARSE_TPU_PRECOND``).
+    block_size / sweeps / tri_sweeps / degree : knob overrides for the
+        respective factories (defaults from settings).
+    """
+
+    def __init__(self, mode=None, block_size: int | None = None,
+                 sweeps: int | None = None, tri_sweeps: int | None = None,
+                 degree: int | None = None):
+        self.mode = canonical_kind(
+            settings.precond if mode is None else mode
+        )
+        self.block_size = block_size
+        self.sweeps = sweeps
+        self.tri_sweeps = tri_sweeps
+        self.degree = degree
+        # resolved (id(pattern), solver, bucket, dtype, override) -> kind
+        self._decisions: dict = {}
+
+    @classmethod
+    def resolve(cls, precond=None, **knobs) -> "PrecondPolicy":
+        """The ``SolveSession`` constructor hook: ``precond`` may be a
+        ready policy, a kind/mode string, ``True`` (= 'auto'),
+        ``False`` (= off regardless of env), or ``None`` (= env)."""
+        if isinstance(precond, cls):
+            return precond
+        if precond is True:
+            precond = "auto"
+        elif precond is False:
+            precond = NONE
+        return cls(precond, **knobs)
+
+    @property
+    def enabled(self) -> bool:
+        return self.mode != NONE
+
+    def decide(self, pattern, solver: str, bucket: int, dtype,
+               override=None) -> str:
+        """Resolved concrete kind for one bucket program (cached per
+        (pattern, solver, bucket, dtype, override))."""
+        ov = None if override is None else canonical_kind(override)
+        key = (id(pattern), solver, int(bucket), np.dtype(dtype).str, ov)
+        hit = self._decisions.get(key)
+        if hit is not None:
+            return hit
+        kind = ov if ov is not None else self.mode
+        if kind == "auto":
+            kind = self._auto(pattern, solver)
+        kind = self._validate(pattern, kind)
+        self._decisions[key] = kind
+        return kind
+
+    def _auto(self, pattern, solver: str) -> str:
+        if pattern.shape[0] != pattern.shape[1] or pattern.nnz == 0:
+            return NONE
+        return "bjacobi" if solver == "cg" else "jacobi"
+
+    def _validate(self, pattern, kind: str) -> str:
+        """Degrade kinds the pattern cannot support (breadcrumbed, never
+        a dispatch failure)."""
+        if kind == NONE:
+            return kind
+        if pattern.shape[0] != pattern.shape[1] or pattern.nnz == 0:
+            self._fallback(kind, NONE, "non-square-or-empty pattern")
+            return NONE
+        if kind == "ic0":
+            from .ilu import ilu0_symbolic
+
+            sym = ilu0_symbolic(pattern, "ic0")
+            if not sym.symmetric:
+                self._fallback(kind, "jacobi", "asymmetric pattern")
+                return "jacobi"
+        return kind
+
+    @staticmethod
+    def _fallback(kind: str, to: str, reason: str) -> None:
+        if telemetry.enabled():
+            telemetry.record(
+                "coverage.fallback", op=f"precond.{kind}", reason=reason,
+                to=to,
+            )
+
+    def factory(self, pattern, kind: str):
+        """The numeric factory for a resolved kind (``None`` for
+        'none'): host-side pattern work (plan-cached, vault-persisted)
+        happens here; the returned ``factory(values, matvec) -> Mvec``
+        is pure jnp. When a fault clause targets the ``precond`` site
+        the returned apply is corruption-wrapped (resilience.faults) —
+        absent otherwise, so clean traces are byte-identical."""
+        from ..resilience import faults as _faults
+
+        if kind is None or kind == NONE:
+            return None
+        if kind == "jacobi":
+            from .jacobi import jacobi_factory
+
+            base = jacobi_factory(pattern)
+        elif kind == "bjacobi":
+            from .jacobi import bjacobi_factory
+
+            base = bjacobi_factory(pattern, bs=self.block_size)
+        elif kind in ("ilu0", "ic0"):
+            from .ilu import ilu_factory
+
+            base = ilu_factory(
+                pattern, kind, sweeps=self.sweeps,
+                tri_sweeps=self.tri_sweeps,
+            )
+        elif kind == "cheby":
+            from .poly import cheby_factory
+
+            base = cheby_factory(pattern, degree=self.degree)
+        elif kind == "neumann":
+            from .poly import neumann_factory
+
+            base = neumann_factory(pattern, degree=self.degree)
+        else:  # pragma: no cover - canonical_kind guards
+            raise ValueError(f"unknown precond kind {kind!r}")
+
+        if not (_faults.ACTIVE and _faults.targets("precond")):
+            return base
+
+        def faulty(values, matvec=None):
+            return _faults.wrap_precond(base(values, matvec))
+
+        return faulty
+
+    def describe(self) -> dict:
+        """JSON-friendly block for ``session_stats()``."""
+        return {
+            "mode": self.mode,
+            "enabled": self.enabled,
+            "block_size": self.block_size or settings.precond_block,
+            "sweeps": self.sweeps or settings.precond_sweeps,
+            "tri_sweeps": self.tri_sweeps or settings.precond_tri_sweeps,
+            "degree": self.degree or settings.precond_degree,
+        }

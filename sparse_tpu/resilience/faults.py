@@ -74,11 +74,18 @@ __all__ = [
     "suspended",
     "targets",
     "wrap_batched_matvec",
+    "wrap_precond",
 ]
 
 #: site -> admissible faults (the grammar's type table)
 SITES = {
     "matvec": ("nonfinite", "inf", "bitflip"),
+    # preconditioner application (sparse_tpu.precond): same corruption
+    # grammar as matvec, but the wrapper installs INSIDE the M apply —
+    # so the chaos drills can corrupt the preconditioner while the
+    # operator stays pristine (the recovery ladder's drop-preconditioner
+    # rung, docs/resilience.md)
+    "precond": ("nonfinite", "inf", "bitflip"),
     "pallas": ("fail",),
     "dispatch": ("drop", "delay"),
     "chunk": ("preempt",),
@@ -369,6 +376,20 @@ def wrap_batched_matvec(mv):
 
     faulty_mv._fault_wrapped = True
     return faulty_mv
+
+
+def wrap_precond(mvec):
+    """Wrap a preconditioner apply (batched ``(B, n) -> (B, n)``, or
+    unbatched ``(n,) -> (n,)``) with output corruption — the hook
+    :mod:`sparse_tpu.precond` installs when a ``precond`` clause is
+    active. Distinct from the matvec site so a drill can poison M while
+    A stays pristine."""
+
+    def faulty_apply(R):
+        return corrupt_traced(mvec(R), site="precond")
+
+    faulty_apply._fault_wrapped = True
+    return faulty_apply
 
 
 def should_fail_pallas(kernel: str) -> bool:

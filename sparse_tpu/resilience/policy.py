@@ -10,13 +10,21 @@ interpolation-restart resilience for Krylov methods):
 verdict         action
 ==============  =========================================================
 stagnation      restart the same solver from the current (best) iterate;
-                a second stagnation escalates down the solver ladder
-                (cg -> bicgstab -> gmres)
+                a second stagnation first DROPS the preconditioner when
+                one is wired (the cheap rung — a bad M is a far more
+                common stall than a solver mismatch), then escalates
+                down the solver ladder (cg -> bicgstab -> gmres)
 breakdown       BiCGStab rho/omega breakdown (detected by the health
                 monitor's breakdown tap; silently ``where``-guarded in
                 the recurrence itself): escalate straight to GMRES
-nonfinite       roll back to the last ``CheckpointManager`` state when
-                one is wired, else clean re-solve from zero
+nonfinite       with a preconditioner wired, probe M on a pristine
+                finite vector first: M producing nonfinites is
+                classified DISTINCTLY (``nonfinite_m``, ISSUE 14) and
+                the ladder drops M before anything else — corruption
+                inside the preconditioner apply must not cost a solver
+                escalation. Otherwise roll back to the last
+                ``CheckpointManager`` state when one is wired, else
+                clean re-solve from zero
 preempt         injected/real preemption at a chunk boundary: resume
                 from checkpoint/best iterate
 ==============  =========================================================
@@ -144,6 +152,21 @@ def _verify(op, b_np, x, target: float):
     return rnorm, finite, rnorm <= target
 
 
+def _m_nonfinite(M, b_np) -> bool:
+    """Probe whether the preconditioner ITSELF emits nonfinites on a
+    pristine finite input (faults stay ACTIVE — an injected
+    ``nonfinite:precond`` clause should show here). The distinct
+    nonfinite-in-M classifier of the drop-preconditioner rung."""
+    from .. import linalg
+    from ..utils import asjnp
+
+    try:
+        out = np.asarray(linalg.make_linear_operator(M).matvec(asjnp(b_np)))
+        return not bool(np.isfinite(out).all())
+    except Exception:  # noqa: BLE001 - an M that raises is also bad
+        return True
+
+
 def _health_reasons() -> set:
     """Anomaly reasons of the most recent solve (empty when telemetry is
     off — the engine then falls back to residual-only classification)."""
@@ -235,6 +258,7 @@ def solve_with_recovery(
     t0 = time.monotonic()
     cur_solver = solver
     cur_x0 = x0
+    cur_M = M  # dropped (set None) by the drop-preconditioner rung
     attempt_maxiter = maxiter
     seg = None  # None until the first nonfinite/preempt verdict
     restarts_used = 0
@@ -251,7 +275,7 @@ def solve_with_recovery(
         try:
             x, iters = _run_attempt(
                 cur_solver, A, asjnp(b), cur_x0, tol, target,
-                attempt_maxiter, restart, M,
+                attempt_maxiter, restart, cur_M,
             )
             iters_total += int(iters)
             rnorm, finite, ok = _verify(op, b_np, x, verify_target)
@@ -289,7 +313,16 @@ def solve_with_recovery(
             # view: breakdown is only visible through the monitor's tap)
             verdicts = _health_reasons()
             if not finite:
-                reason = "nonfinite"
+                # nonfinite-in-M is classified DISTINCTLY (ISSUE 14):
+                # probe the preconditioner on a pristine finite vector
+                # (faults stay active — an injected precond clause shows
+                # here) so the ladder can drop M instead of burning a
+                # rollback + solver escalation on corruption the
+                # operator never produced
+                if cur_M is not None and _m_nonfinite(cur_M, b_np):
+                    reason = "nonfinite_m"
+                else:
+                    reason = "nonfinite"
             elif "breakdown" in verdicts:
                 reason = "breakdown"
             else:
@@ -320,11 +353,19 @@ def solve_with_recovery(
 
         # -- ladder ---------------------------------------------------------
         improved = (
-            reason not in ("nonfinite", "preempt")
+            reason not in ("nonfinite", "nonfinite_m", "preempt")
             and math.isfinite(best_rnorm)
             and best_rnorm < prev_best * (1.0 - 1e-3)
         )
-        if reason == "breakdown":
+        if reason == "nonfinite_m":
+            # the drop-preconditioner rung (ISSUE 14): the corruption
+            # came from M's apply, so dropping it IS the fix — resume
+            # from the best finite iterate, no solver escalation, no
+            # segmented advance
+            action = "drop_precond"
+            cur_M = None
+            cur_x0 = best_x  # None => clean re-solve from zero
+        elif reason == "breakdown":
             action = "escalate"
             cur_solver = "gmres"
             cur_x0 = best_x
@@ -365,6 +406,15 @@ def solve_with_recovery(
             elif restarts_used < pol.restart_first:
                 action = "restart"
                 restarts_used += 1
+            elif cur_M is not None:
+                # drop-preconditioner rung BEFORE solver escalation
+                # (ISSUE 14): a stalling preconditioned solve sheds M
+                # first — cheaper than a solver change, and a bad M is
+                # the likelier stall — with a fresh restart budget for
+                # the unpreconditioned configuration
+                action = "drop_precond"
+                cur_M = None
+                restarts_used = 0
             else:
                 action = "escalate"
                 cur_solver = pol.next_solver(cur_solver)

@@ -110,6 +110,17 @@ KINDS: dict[str, frozenset] = {
     # per-device detail of one sharded dispatch: real lanes this device
     # served out of its bucket_lanes-slot block (occupancy numerator)
     "fleet.shard": frozenset({"device", "lanes"}),
+    # -- preconditioners (sparse_tpu.precond, ISSUE 14) ---------------------
+    # one pattern-level preconditioner build (diag/block extraction map,
+    # ILU(0)/IC(0) symbolic factorization): precond is the kind,
+    # build_ms the host wall clock — cadence is exactly one per
+    # (pattern, kind) per vault (the plan-cache build closure)
+    "precond.build": frozenset({"precond", "n"}),
+    # one preconditioned bucket dispatch: the resolved kind actually
+    # applied inside the compiled program, with the lane count (numeric
+    # factorization happens in-program, so this is the host-side record
+    # that it ran)
+    "precond.apply": frozenset({"precond", "lanes"}),
     # -- plan cache (sparse_tpu.plan_cache / telemetry/_cost.py) ------------
     # one per compiled (or host-packed) plan-cached program: wall-clock
     # compile/pack seconds plus XLA cost/memory analysis when available

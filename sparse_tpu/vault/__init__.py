@@ -114,7 +114,8 @@ def load_pattern(key: str):
 
 def note_program(pattern, solver: str, bucket: int, dtype: str,
                  mesh: str | None = None,
-                 strategy: str | None = None) -> None:
+                 strategy: str | None = None,
+                 precond: str | None = None) -> None:
     """Record one freshly built bucket program in the warm-start
     manifest (and ensure its pattern artifact exists). Best-effort.
 
@@ -123,7 +124,14 @@ def note_program(pattern, solver: str, bucket: int, dtype: str,
     process whose serving mesh carries the SAME fingerprint — a restart
     on a different topology skips it (clean cold start) instead of
     compiling a program the new mesh cannot dispatch. ``None`` (the
-    default) marks a single-device program, replayable anywhere."""
+    default) marks a single-device program, replayable anywhere.
+
+    ``precond`` is the program's resolved preconditioner kind
+    (ISSUE 14): recorded so the replay rebuilds the SAME precond-keyed
+    program — its pattern-level maps load from their own vault artifact
+    kinds, so a warm restart pays zero symbolic factorizations. ``None``
+    (the default) marks an unpreconditioned program (pre-precond
+    manifests stay valid)."""
     if not _store.enabled():
         return
     try:
@@ -139,6 +147,8 @@ def note_program(pattern, solver: str, bucket: int, dtype: str,
         if mesh:
             entry["mesh"] = str(mesh)
             entry["strategy"] = str(strategy or "batch")
+        if precond:
+            entry["precond"] = str(precond)
         _manifest.note(entry)
     except Exception:
         return

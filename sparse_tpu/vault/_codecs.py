@@ -25,6 +25,11 @@ Registered kinds:
                       autotuned row tile, packed plane buffer) — the
                       tile choice persists across sessions, so a warm
                       restart also skips the autotune probe.
+* ``precond_diag`` / ``precond_block`` / ``ilu_symbolic`` — the
+                      pattern-level preconditioner maps and symbolic
+                      factorizations (``sparse_tpu.precond``, ISSUE 14):
+                      structure-only, one artifact per (pattern, knobs),
+                      so warm restarts skip every symbolic build.
 """
 
 from __future__ import annotations
@@ -206,7 +211,57 @@ def _dec_prepared_dia(meta, arrays):
     return PreparedDia.from_parts(plan, planes)
 
 
+# -- precond maps (sparse_tpu.precond, ISSUE 14) ----------------------------
+# Pattern-level preconditioner artifacts: the diagonal position map
+# (point Jacobi), the block extraction map (block Jacobi) and the
+# ILU(0)/IC(0) symbolic dependency closure. All structure-only (keyed on
+# the pattern fingerprint plus the variant/block knobs), so one artifact
+# serves every value stack and dtype over the pattern.
+def _enc_precond_diag(pack):
+    dpos, has = pack
+    return {"dtype": "structure"}, {
+        "dpos": np.asarray(dpos), "has": np.asarray(has),
+    }
+
+
+def _dec_precond_diag(meta, arrays):
+    return _commit([arrays["dpos"], arrays["has"]])
+
+
+def _enc_precond_block(pack):
+    src, fix = pack
+    return {"dtype": "structure"}, {
+        "src": np.asarray(src), "fix": np.asarray(fix),
+    }
+
+
+def _dec_precond_block(meta, arrays):
+    return _commit([arrays["src"], arrays["fix"]])
+
+
+_ILU_FIELDS = ("dep_a", "dep_b", "dep_mask", "udiag", "udiag_ok", "lower",
+               "isdiag", "upper", "tpos", "dpos", "has_diag")
+
+
+def _enc_ilu_symbolic(sym):
+    meta = {"variant": sym.variant, "symmetric": bool(sym.symmetric),
+            "dtype": "structure"}
+    return meta, {f: np.asarray(getattr(sym, f)) for f in _ILU_FIELDS}
+
+
+def _dec_ilu_symbolic(meta, arrays):
+    from ..precond.ilu import IluSymbolic
+
+    committed = _commit([arrays[f] for f in _ILU_FIELDS])
+    return IluSymbolic(
+        str(meta["variant"]), *committed, bool(meta["symmetric"])
+    )
+
+
 register("pattern", _enc_pattern, _dec_pattern)
 register("sell_pattern", _enc_sell_pattern, _dec_sell_pattern)
 register("prepared_csr", _enc_prepared_csr, _dec_prepared_csr)
 register("prepared_dia", _enc_prepared_dia, _dec_prepared_dia)
+register("precond_diag", _enc_precond_diag, _dec_precond_diag)
+register("precond_block", _enc_precond_block, _dec_precond_block)
+register("ilu_symbolic", _enc_ilu_symbolic, _dec_ilu_symbolic)

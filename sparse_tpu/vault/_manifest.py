@@ -52,9 +52,12 @@ def _entry_key(e: dict) -> tuple:
     # `mesh` is the fleet tier's topology fingerprint (ISSUE 10): the
     # same (pattern, solver, bucket, dtype) program compiled for a
     # different mesh is a DIFFERENT executable and must dedup separately
-    # (absent == single-device, so pre-fleet manifests stay valid)
+    # (absent == single-device, so pre-fleet manifests stay valid).
+    # `precond` (ISSUE 14) extends the key the same back-compatible way:
+    # absent == unpreconditioned, and a precond-keyed program dedups
+    # apart from its unpreconditioned sibling.
     return (e.get("pattern"), e.get("solver"), e.get("bucket"),
-            e.get("dtype"), e.get("mesh"))
+            e.get("dtype"), e.get("mesh"), e.get("precond"))
 
 
 def entries() -> list:

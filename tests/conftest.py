@@ -72,6 +72,7 @@ _QUICK_FILES = {
     "test_multigrid.py",
     "test_pipeline.py",
     "test_plan_cache.py",
+    "test_precond.py",
     "test_quantum.py",
     "test_quick_lane.py",
     "test_resilience.py",

@@ -1,0 +1,657 @@
+"""Precond subsystem (ISSUE 14): pattern-shared batched preconditioners.
+
+The load-bearing contracts:
+
+* **Factor correctness** — point/block Jacobi match direct diagonal /
+  block solves (ragged last block included); the fixed-sweep Chow-Patel
+  ILU(0) reproduces the exact reference factorization at high sweep
+  counts; IC(0) factors satisfy ``L L^T = A`` on the pattern.
+* **B=1 parity with a non-identity M** — the batched preconditioned
+  paths (`batch/krylov.py` cg/gmres ``M=``) reproduce the unbatched
+  preconditioned ``linalg.cg``/``gmres`` at machine eps for
+  f32/f64/c128, and frozen converged lanes stay bit-stable under a
+  non-identity M (the satellite coverage gap).
+* **Policy/keys** — SPARSE_TPU_PRECOND / per-session / per-ticket
+  resolution, precond-suffixed program keys, exactly ONE symbolic
+  factorization per (pattern, bucket), vault round-trip + quarantine of
+  the ``ilu_symbolic`` artifact, and a precond-keyed warm restart at
+  zero plan-cache misses — including the mesh/fleet path.
+* **Resilience** — the recovery ladder's drop-preconditioner rung:
+  ``nonfinite:precond`` injection classifies as ``nonfinite_m`` and
+  drops M without a solver escalation; a stalling preconditioned solve
+  sheds M before escalating.
+* **GMRES warm-up** — a non-identity M warms eagerly before the first
+  compiled cycle (aligned with cg), pinned by call accounting and the
+  host-sync count.
+
+Runs on the conftest-forced 8-device virtual CPU mesh.
+"""
+
+import numpy as np
+import pytest
+import scipy.sparse as sp
+
+import sparse_tpu
+from sparse_tpu import linalg, plan_cache, precond, telemetry, utils, vault
+from sparse_tpu.batch import BatchedCSR, SolveSession, SparsityPattern
+from sparse_tpu.batch.krylov import batched_bicgstab, batched_cg, batched_gmres
+from sparse_tpu.config import settings
+from sparse_tpu.precond import ilu as pilu
+from sparse_tpu.resilience import faults
+from sparse_tpu.resilience.policy import RecoveryPolicy, solve_with_recovery
+from sparse_tpu.telemetry import _cost, _metrics
+
+
+@pytest.fixture(autouse=True)
+def _clean_state(tmp_path):
+    faults.clear()
+    old_vault = settings.vault
+    old_tel = settings.telemetry
+    old_precond = settings.precond
+    settings.vault = ""
+    telemetry.configure(str(tmp_path / "records.jsonl"))
+    telemetry.reset()
+    plan_cache.clear()
+    yield
+    faults.clear()
+    settings.vault = old_vault
+    settings.telemetry = old_tel
+    settings.precond = old_precond
+    telemetry.configure(None)
+    telemetry.reset()
+    plan_cache.clear()
+
+
+def _spd(n=32, seed=3, density=0.15, dtype=np.float64):
+    """Random SPD CSR with a full structural diagonal."""
+    A = sp.random(n, n, density=density, random_state=seed, format="csr")
+    A = A + A.T + sp.eye(n) * (np.abs(A).sum(axis=1).max() + 1.0)
+    A = A.tocsr().astype(dtype)
+    A.sort_indices()
+    return A
+
+
+def _vardiag(n=48, seed=0, dtype=np.float64, spread=3.0):
+    """SPD tridiagonal with a wildly varying diagonal — the shape
+    Jacobi-family preconditioners visibly help."""
+    rng = np.random.default_rng(seed)
+    e = np.ones(n)
+    A = sp.diags([-e[:-1], 2.0 * e, -e[:-1]], [-1, 0, 1], format="csr")
+    A = A.copy()
+    A.setdiag(2.0 + np.exp(rng.normal(0, spread, n)))
+    A = A.tocsr().astype(dtype)
+    A.sort_indices()
+    return A
+
+
+def _pattern(A):
+    return SparsityPattern(A.indptr, A.indices, A.shape)
+
+
+# ---------------------------------------------------------------------------
+# factor correctness
+# ---------------------------------------------------------------------------
+def test_jacobi_apply_is_diag_scaling():
+    A = _spd(24, seed=1)
+    pat = _pattern(A)
+    vals = np.asarray(A.data)[None, :]
+    M = precond.make_factory(pat, "jacobi")(vals, None)
+    r = np.random.default_rng(0).standard_normal((1, 24))
+    np.testing.assert_allclose(
+        np.asarray(M(r))[0], r[0] / A.diagonal(), rtol=1e-12
+    )
+
+
+@pytest.mark.parametrize("n", [24, 26])  # 26: ragged last block at bs=4
+def test_bjacobi_apply_matches_block_solve(n):
+    A = _spd(n, seed=2)
+    pat = _pattern(A)
+    vals = np.asarray(A.data)[None, :]
+    M = precond.make_factory(pat, "bjacobi")(vals, None)
+    r = np.random.default_rng(1).standard_normal((1, n))
+    z = np.asarray(M(r))[0]
+    bs = settings.precond_block
+    ref = np.zeros(n)
+    for k in range(0, n, bs):
+        hi = min(k + bs, n)
+        blk = A[k:hi, k:hi].toarray()
+        ref[k:hi] = np.linalg.solve(blk, r[0][k:hi])
+    np.testing.assert_allclose(z, ref, rtol=1e-10, atol=1e-10)
+
+
+def test_ilu0_factor_matches_reference():
+    A = _spd(28, seed=5)
+    pat = _pattern(A)
+    sym = pilu.ilu0_symbolic(pat, "ilu0")
+    F = np.asarray(
+        pilu.factorize(sym, np.asarray(A.data)[None, :], sweeps=40)
+    )[0]
+    Fref = pilu.ilu0_reference(A.indptr, A.indices, A.data)
+    np.testing.assert_allclose(F, Fref, rtol=1e-12, atol=1e-12)
+
+
+def test_ic0_factor_llt_matches_on_pattern():
+    A = _spd(24, seed=6)
+    pat = _pattern(A)
+    sym = pilu.ilu0_symbolic(pat, "ic0")
+    assert sym.symmetric
+    F = np.asarray(
+        pilu.factorize(sym, np.asarray(A.data)[None, :], sweeps=40)
+    )[0]
+    n = A.shape[0]
+    rows = np.repeat(np.arange(n), np.diff(A.indptr))
+    cols = A.indices
+    L = np.zeros((n, n))
+    low = rows >= cols
+    L[rows[low], cols[low]] = F[low]
+    R = L @ L.T
+    for i, j in zip(rows, cols):
+        assert abs(R[i, j] - A[i, j]) < 1e-10
+
+
+def test_ic0_asymmetric_pattern_falls_back_to_jacobi():
+    # structurally asymmetric: one extra strict-upper entry whose
+    # transpose slot is absent
+    A = _spd(16, seed=7).tolil()
+    dense = A.toarray()
+    i, j = next(
+        (i, j) for i in range(16) for j in range(16)
+        if i < j and dense[i, j] == 0 and dense[j, i] == 0
+    )
+    A[i, j] = 0.1
+    A = A.tocsr()
+    A.sort_indices()
+    pat = _pattern(A)
+    pol = precond.PrecondPolicy("ic0")
+    kind = pol.decide(pat, "cg", 1, np.float64)
+    assert kind == "jacobi"
+
+
+@pytest.mark.parametrize("kind", ["ilu0", "ic0", "cheby", "neumann"])
+def test_kinds_reduce_or_match_cg_iterations(kind):
+    A = _vardiag(32, seed=4, spread=2.0)
+    pat = _pattern(A)
+    vals = np.asarray(A.data)[None, :]
+    op = BatchedCSR(pat, vals)
+    b = np.random.default_rng(2).standard_normal((1, 32))
+    _, info0 = batched_cg(op, b, tol=1e-9, maxiter=2000, conv_test_iters=5)
+    # small sweep counts keep the unrolled apply graph (and its compile
+    # on the 1-core CI host) cheap; correctness is sweep-independent
+    pol = precond.PrecondPolicy(kind, sweeps=2, tri_sweeps=2, degree=3)
+    Mv = pol.factory(pat, kind)(op.values, op.matvec)
+    X, info = batched_cg(op, b, tol=1e-9, maxiter=2000, conv_test_iters=5,
+                         M=Mv)
+    assert bool(np.asarray(info.converged)[0])
+    assert int(np.asarray(info.iters)[0]) <= int(np.asarray(info0.iters)[0])
+    r = b[0] - np.asarray(A @ np.asarray(X)[0])
+    assert np.linalg.norm(r) < 1e-8 * 10
+
+
+# ---------------------------------------------------------------------------
+# B=1 parity with a non-identity M (the satellite coverage gap)
+# ---------------------------------------------------------------------------
+@pytest.mark.parametrize("dtype", [np.float32, np.float64, np.complex128])
+def test_b1_cg_parity_with_M(dtype):
+    A = _vardiag(32, seed=11, spread=2.0).astype(dtype)
+    if np.dtype(dtype).kind == "c":
+        A = (A + 0j).tocsr()
+    pat = _pattern(A)
+    vals = np.asarray(A.data)[None, :]
+    b = np.random.default_rng(3).standard_normal(32).astype(dtype)
+    tol = 1e-5 if dtype == np.float32 else 1e-11
+    Mv = precond.make_factory(pat, "jacobi")(vals, None)
+    Xb, info = batched_cg(BatchedCSR(pat, vals), b[None, :], tol=tol,
+                          maxiter=1500, M=Mv)
+    Mu = precond.make_M(sparse_tpu.csr_array(A), "jacobi")
+    xu, iu = linalg.cg(sparse_tpu.csr_array(A), b, tol=tol, maxiter=1500,
+                       M=Mu)
+    assert int(np.asarray(info.iters)[0]) == iu
+    np.testing.assert_allclose(
+        np.asarray(Xb)[0], np.asarray(xu),
+        rtol=1e-4 if dtype == np.float32 else 1e-11,
+        atol=1e-5 if dtype == np.float32 else 1e-11,
+    )
+    assert bool(np.asarray(info.converged)[0])
+
+
+@pytest.mark.parametrize("dtype", [np.float32, np.float64, np.complex128])
+def test_b1_gmres_parity_with_M(dtype):
+    A = _vardiag(32, seed=12, spread=2.0).astype(dtype)
+    if np.dtype(dtype).kind == "c":
+        A = (A + 0j).tocsr()
+    pat = _pattern(A)
+    vals = np.asarray(A.data)[None, :]
+    b = np.random.default_rng(4).standard_normal(32).astype(dtype)
+    tol = 1e-5 if dtype == np.float32 else 1e-10
+    Mv = precond.make_factory(pat, "jacobi")(vals, None)
+    Xb, info = batched_gmres(BatchedCSR(pat, vals), b[None, :], tol=tol,
+                             restart=8, M=Mv)
+    Mu = precond.make_M(sparse_tpu.csr_array(A), "jacobi")
+    xu, iu = linalg.gmres(sparse_tpu.csr_array(A), b, tol=tol, restart=8,
+                          M=Mu)
+    assert int(np.asarray(info.iters)[0]) == iu
+    np.testing.assert_allclose(
+        np.asarray(Xb)[0], np.asarray(xu),
+        rtol=1e-4 if dtype == np.float32 else 1e-9,
+        atol=1e-4 if dtype == np.float32 else 1e-9,
+    )
+
+
+def test_b1_bicgstab_preconditioned_converges_faster():
+    A = _vardiag(40, seed=13)
+    pat = _pattern(A)
+    vals = np.asarray(A.data)[None, :]
+    op = BatchedCSR(pat, vals)
+    b = np.random.default_rng(5).standard_normal((1, 40))
+    _, info0 = batched_bicgstab(op, b, tol=1e-9, maxiter=2000,
+                                conv_test_iters=1)
+    Mv = precond.make_factory(pat, "jacobi")(vals, None)
+    X, info = batched_bicgstab(op, b, tol=1e-9, maxiter=2000,
+                               conv_test_iters=1, M=Mv)
+    assert bool(np.asarray(info.converged)[0])
+    assert int(np.asarray(info.iters)[0]) < int(np.asarray(info0.iters)[0])
+    r = b[0] - np.asarray(A @ np.asarray(X)[0])
+    assert np.linalg.norm(r) < 1e-8
+
+
+def test_frozen_lane_bit_stable_under_M():
+    """A lane that converges early (loose tol) must freeze bit-stable
+    while its preconditioned neighbors keep iterating."""
+    A = _vardiag(32, seed=14, spread=2.0)
+    pat = _pattern(A)
+    B = 3
+    vals = np.repeat(np.asarray(A.data)[None, :], B, axis=0)
+    rng = np.random.default_rng(6)
+    rhs = rng.standard_normal((B, 32))
+    op = BatchedCSR(pat, vals)
+    Mv = precond.make_factory(pat, "jacobi")(vals, op.matvec)
+    tols = np.array([1e-2, 1e-10, 1e-10])
+    X, info = batched_cg(op, rhs, tol=tols, maxiter=1500, M=Mv,
+                         conv_test_iters=5)
+    # solo B=1 solve of the loose lane at the same tol: bit-stable freeze
+    op1 = BatchedCSR(pat, vals[:1])
+    Mv1 = precond.make_factory(pat, "jacobi")(vals[:1], op1.matvec)
+    X1, info1 = batched_cg(op1, rhs[:1], tol=1e-2, maxiter=1500, M=Mv1,
+                           conv_test_iters=5)
+    assert int(np.asarray(info.iters)[0]) == int(np.asarray(info1.iters)[0])
+    np.testing.assert_array_equal(np.asarray(X)[0], np.asarray(X1)[0])
+    assert np.asarray(info.converged).all()
+
+
+# ---------------------------------------------------------------------------
+# gmres warm-up alignment (satellite)
+# ---------------------------------------------------------------------------
+def test_gmres_warms_noniidentity_M_eagerly():
+    n = 40
+    A = _vardiag(n, seed=15)
+    b = np.random.default_rng(7).standard_normal(n)
+    dinv = 1.0 / A.diagonal()
+    calls = {"eager": 0, "traced": 0}
+
+    def mv(r):
+        if utils.in_trace():
+            calls["traced"] += 1
+        else:
+            calls["eager"] += 1
+        import jax.numpy as jnp
+
+        return r * jnp.asarray(dinv)
+
+    M = linalg.LinearOperator((n, n), matvec=mv, dtype=np.dtype(np.float64))
+    linalg.HOST_SYNCS = 0
+    x, iters = linalg.gmres(sparse_tpu.csr_array(A), b, tol=1e-9, M=M,
+                            restart=20)
+    # warmed exactly once, eagerly, BEFORE the first compiled cycle —
+    # every later apply is a trace-time call inside the jitted cycle,
+    # never a per-iteration host call
+    assert calls["eager"] == 1
+    assert calls["traced"] >= 1
+    cycles = max(-(-iters // 20), 1)
+    # one packed fetch per restart cycle (+1 final): M adds NO syncs
+    assert linalg.HOST_SYNCS <= cycles + 1
+    r = b - np.asarray(A @ np.asarray(x))
+    assert np.linalg.norm(r) <= 1e-9 * np.linalg.norm(b) * 10
+
+
+# ---------------------------------------------------------------------------
+# policy resolution, program keys, build cadence
+# ---------------------------------------------------------------------------
+def test_canonical_kind_round_trip():
+    assert precond.canonical_kind("") == "none"
+    assert precond.canonical_kind("off") == "none"
+    assert precond.canonical_kind(None) == "none"
+    assert precond.canonical_kind("BJACOBI") == "bjacobi"
+    assert precond.canonical_kind("auto") == "auto"
+    with pytest.raises(ValueError):
+        precond.canonical_kind("ilu7")
+    with pytest.raises(ValueError):
+        precond.canonical_kind("auto", allow_auto=False)
+
+
+def test_key_suffix_backcompat():
+    assert precond.key_suffix("none") == ""
+    assert precond.key_suffix(None) == ""
+    assert precond.key_suffix("ilu0") == ".Milu0"
+
+
+def test_policy_auto_and_env():
+    A = _spd(16, seed=8)
+    pat = _pattern(A)
+    pol = precond.PrecondPolicy("auto")
+    assert pol.decide(pat, "cg", 4, np.float64) == "bjacobi"
+    assert pol.decide(pat, "gmres", 4, np.float64) == "jacobi"
+    # env resolution + per-call override
+    settings.precond = "jacobi"
+    pol2 = precond.PrecondPolicy()
+    assert pol2.mode == "jacobi"
+    assert pol2.decide(pat, "cg", 4, np.float64, override="off") == "none"
+    settings.precond = ""
+    with pytest.raises(ValueError):
+        precond.PrecondPolicy("bogus")
+
+
+def test_session_program_keys_and_per_ticket_override():
+    A = _vardiag(32, seed=16, spread=2.0)
+    b = np.random.default_rng(8).standard_normal(32)
+    _cost.reset()
+    ses = SolveSession("cg", warm_start=False, precond="bjacobi")
+    t1 = ses.submit(A, b, tol=1e-8, maxiter=2000)
+    t2 = ses.submit(A, b, tol=1e-8, maxiter=2000, precond="off")
+    t3 = ses.submit(A, b, tol=1e-8, maxiter=2000, precond="jacobi")
+    ses.flush()
+    for t in (t1, t2, t3):
+        x, iters, r2 = t.result()
+        assert np.sqrt(r2) <= 1e-8 * 1.01
+    keys = set(_cost.programs())
+    assert "batch.cg.B1.<f8.Mbjacobi" in keys
+    assert "batch.cg.B1.<f8" in keys  # the 'off' override: historic key
+    assert "batch.cg.B1.<f8.Mjacobi" in keys
+    # the preconditioned lanes actually solved with fewer iterations
+    assert t1.result()[1] < t2.result()[1]
+
+
+def test_one_symbolic_build_per_pattern_and_bucket():
+    A = _vardiag(32, seed=17, spread=2.0)
+    mats = [A.copy() for _ in range(4)]
+    for i, m in enumerate(mats):
+        m.setdiag(m.diagonal() + 0.01 * i)
+    rhs = np.random.default_rng(9).standard_normal((4, 32))
+    before = int(_metrics.counter("precond.builds", kind="ilu0").value)
+    ses = SolveSession("cg", warm_start=False, precond="ilu0")
+    ses.precond.sweeps = 2
+    ses.precond.tri_sweeps = 2
+    ses.solve_many(mats, rhs, tol=1e-8, maxiter=2000)
+    snap = plan_cache.snapshot()
+    ses.solve_many(mats, rhs, tol=1e-8, maxiter=2000)  # warm flush
+    d = plan_cache.delta(snap)
+    after = int(_metrics.counter("precond.builds", kind="ilu0").value)
+    assert after - before == 1  # ONE symbolic factorization, ever
+    assert d["misses"] == 0  # warm flush: program + maps all hit
+
+
+def test_precond_apply_and_build_events():
+    settings.telemetry = True
+    A = _vardiag(32, seed=18)
+    ses = SolveSession("cg", warm_start=False, precond="jacobi")
+    t = ses.submit(A, np.ones(32), tol=1e-8, maxiter=2000)
+    ses.flush()
+    t.result()
+    kinds = [e.get("kind") for e in telemetry.events()]
+    assert "precond.apply" in kinds
+    builds = [e for e in telemetry.events()
+              if e.get("kind") == "precond.build"]
+    assert builds and builds[0]["precond"] == "jacobi"
+    # schema: both kinds validate
+    for e in telemetry.events():
+        if e.get("kind", "").startswith("precond."):
+            assert not telemetry.schema.validate(e)
+
+
+def test_requeue_fallback_drops_preconditioner():
+    """The session's drop rung: the fallback bucket runs without M
+    (its program key carries no .M suffix)."""
+    A = _vardiag(32, seed=19)
+    _cost.reset()
+    # never-converging lane: absurd tol with tiny maxiter forces the
+    # requeue into the gmres fallback bucket
+    ses = SolveSession("cg", warm_start=False, precond="jacobi",
+                       fallback_solver="gmres")
+    t = ses.submit(A, np.ones(32), tol=1e-30, maxiter=3)
+    ses.flush()
+    keys = set(_cost.programs())
+    assert "batch.cg.B1.<f8.Mjacobi" in keys
+    fb = [k for k in keys if k.startswith("batch.gmres.B1")]
+    assert fb and all(".M" not in k for k in fb)
+
+
+# ---------------------------------------------------------------------------
+# vault: artifacts, quarantine, warm restart (single + fleet)
+# ---------------------------------------------------------------------------
+def test_ilu_symbolic_vault_round_trip_and_quarantine(tmp_path):
+    settings.vault = str(tmp_path / "vault")
+    A = _spd(24, seed=20)
+    S1 = SparsityPattern(A.indptr, A.indices, A.shape)
+    sym = pilu.ilu0_symbolic(S1, "ilu0")
+    vals = np.asarray(A.data)[None, :]
+    F1 = np.asarray(pilu.factorize(sym, vals, sweeps=20))
+    # fresh object, same content: in-process miss -> verified disk hit
+    snap = plan_cache.snapshot()
+    S2 = SparsityPattern(A.indptr, A.indices, A.shape)
+    sym2 = pilu.ilu0_symbolic(S2, "ilu0")
+    d = plan_cache.delta(snap)
+    assert d["disk_hits"] == 1 and d["misses"] == 0
+    np.testing.assert_array_equal(
+        np.asarray(pilu.factorize(sym2, vals, sweeps=20)), F1
+    )
+    # corrupted read: quarantine + rebuild to identical factors
+    plan_cache.clear()
+    vault.reset_stats()
+    faults.configure("bitflip:io:p=1,n=1,seed=3")
+    try:
+        S3 = SparsityPattern(A.indptr, A.indices, A.shape)
+        sym3 = pilu.ilu0_symbolic(S3, "ilu0")
+    finally:
+        faults.clear()
+    assert vault.stats()["quarantined"] >= 1
+    np.testing.assert_array_equal(
+        np.asarray(pilu.factorize(sym3, vals, sweeps=20)), F1
+    )
+
+
+def test_warm_restart_replays_precond_keyed_program(tmp_path):
+    settings.vault = str(tmp_path / "vault")
+    A = _vardiag(32, seed=21, spread=2.0)
+    mats = [A.copy() for _ in range(4)]
+    rhs = np.random.default_rng(10).standard_normal((4, 32))
+    ses = SolveSession("cg", warm_start=False, precond="bjacobi")
+    X, _, _ = ses.solve_many(mats, rhs, tol=1e-9, maxiter=2000)
+    ents = vault.manifest_entries()
+    assert any(e.get("precond") == "bjacobi" for e in ents)
+    # the restart: in-process tier gone, vault retained
+    plan_cache.clear()
+    ses2 = SolveSession("cg", warm_start=True, warm_async=False,
+                        precond="bjacobi")
+    assert ses2.warm_replayed >= 1
+    snap = plan_cache.snapshot()
+    X2, _, _ = ses2.solve_many(mats, rhs, tol=1e-9, maxiter=2000)
+    d = plan_cache.delta(snap)
+    assert d["misses"] == 0  # zero-build warm serving window
+    np.testing.assert_array_equal(X, X2)
+
+
+def test_fleet_precond_parity_and_mesh_manifest(tmp_path):
+    """Batch-sharded preconditioned programs: bit-identical lanes vs
+    single-device, and the manifest entry carries BOTH the mesh
+    fingerprint and the precond kind (the mesh/fleet warm path)."""
+    settings.vault = str(tmp_path / "vault")
+    A = _vardiag(48, seed=22, spread=2.0)
+    rng = np.random.default_rng(11)
+    mats = []
+    for _ in range(8):
+        m = A.copy()
+        m.setdiag(A.diagonal() + 0.1 * rng.random(48))
+        m.sort_indices()
+        mats.append(m.tocsr())
+    rhs = rng.standard_normal((8, 48))
+    ses_f = SolveSession("cg", warm_start=False, fleet="batch",
+                         precond="bjacobi")
+    Xf, itf, _ = ses_f.solve_many(mats, rhs, tol=1e-10, maxiter=2500)
+    ses_s = SolveSession("cg", warm_start=False, fleet=False,
+                         precond="bjacobi")
+    Xs, its, _ = ses_s.solve_many(mats, rhs, tol=1e-10, maxiter=2500)
+    np.testing.assert_array_equal(Xf, Xs)  # bit-identical lanes
+    assert (itf == its).all()
+    ents = vault.manifest_entries()
+    mesh_ent = [e for e in ents if e.get("mesh")]
+    assert mesh_ent and mesh_ent[-1].get("precond") == "bjacobi"
+    # same-topology restart replays the mesh+precond-keyed program
+    plan_cache.clear()
+    ses3 = SolveSession("cg", warm_start=True, warm_async=False,
+                        fleet="batch", precond="bjacobi")
+    assert ses3.warm_replayed >= 1
+    snap = plan_cache.snapshot()
+    X3, _, _ = ses3.solve_many(mats, rhs, tol=1e-10, maxiter=2500)
+    assert plan_cache.delta(snap)["misses"] == 0
+    np.testing.assert_array_equal(Xf, X3)
+
+
+# ---------------------------------------------------------------------------
+# resilience: the drop-preconditioner rung
+# ---------------------------------------------------------------------------
+def test_recovery_drops_M_on_nonfinite_m():
+    settings.telemetry = True
+    A = sparse_tpu.csr_array(_spd(32, seed=23))
+    b = np.random.default_rng(12).standard_normal(32)
+    faults.configure("nonfinite:precond:p=1")
+    try:
+        M = precond.make_M(A, "jacobi")
+        x, info = solve_with_recovery(A, b, solver="cg", tol=1e-8, M=M)
+    finally:
+        faults.clear()
+    assert info.converged and info.recovered
+    evs = list(telemetry.events())
+    assert any(e.get("kind") == "fault.injected"
+               and e.get("site") == "precond" for e in evs)
+    retries = [e for e in evs if e.get("kind") == "solver.retry"]
+    assert any(e.get("action") == "drop_precond"
+               and e.get("reason") == "nonfinite_m" for e in retries)
+    # the rung never spent a solver escalation
+    assert info.solver == "cg"
+
+
+def test_recovery_stagnation_drop_rung_before_escalation():
+    settings.telemetry = True
+    n = 48
+    A = sparse_tpu.csr_array(_spd(n, seed=24))
+    b = np.random.default_rng(13).standard_normal(n)
+    # a degenerate (finite) M that zeroes every search direction: CG
+    # makes NO progress preconditioned, so the ladder must classify
+    # stagnation and shed M — the plain re-solve then converges
+    def badmv(r):
+        import jax.numpy as jnp
+
+        return jnp.zeros_like(r)
+
+    M = linalg.LinearOperator((n, n), matvec=badmv,
+                              dtype=np.dtype(np.float64))
+    x, info = solve_with_recovery(
+        A, b, solver="cg", tol=1e-9, maxiter=40, M=M,
+        policy=RecoveryPolicy(max_attempts=6, restart_first=1),
+    )
+    retries = [e for e in telemetry.events()
+               if e.get("kind") == "solver.retry"]
+    actions = [e.get("action") for e in retries]
+    assert "drop_precond" in actions
+    # the drop rung fires BEFORE any solver escalation
+    if "escalate" in actions:
+        assert actions.index("drop_precond") < actions.index("escalate")
+
+
+# ---------------------------------------------------------------------------
+# multigrid V-cycle as M for the row-shard lane (satellite)
+# ---------------------------------------------------------------------------
+def _gmg_2d(g):
+    """Two-level GMG on the 2-D Poisson grid model: 5-point fine
+    operator, bilinear transfer as a 1-D kron."""
+    from sparse_tpu.models.poisson import laplacian_2d_csr_host
+
+    a = laplacian_2d_csr_host(g)
+    A0 = sp.csr_matrix(
+        (np.asarray(a.data), np.asarray(a.indices), np.asarray(a.indptr)),
+        shape=a.shape,
+    )
+    gc = g // 2
+    i = np.arange(gc)
+    rows = np.concatenate([2 * i, np.maximum(2 * i - 1, 0),
+                           np.minimum(2 * i + 1, g - 1)])
+    cols = np.concatenate([i, i, i])
+    vals = np.concatenate([np.ones(gc), np.full(gc, 0.5),
+                           np.full(gc, 0.5)])
+    P1 = sp.coo_matrix((vals, (rows, cols)), shape=(g, gc)).tocsr()
+    P = sp.kron(P1, P1).tocsr()
+    R = (P.T * 0.25).tocsr()
+    A1 = (R @ A0 @ P).tocsr()
+    return A0, A1, R, P
+
+
+def test_vcycle_operator_preconditions_dist_cg_on_gmg_grid():
+    from sparse_tpu.parallel.dist import dist_cg
+    from sparse_tpu.parallel.mesh import get_mesh
+    from sparse_tpu.parallel.multigrid import (
+        make_dist_vcycle,
+        shard_hierarchy,
+        vcycle_operator,
+    )
+
+    g = 16
+    A0, A1, R, P = _gmg_2d(g)
+    mesh = get_mesh(4)
+    ops, _ = shard_hierarchy(
+        [sparse_tpu.csr_array(A0), sparse_tpu.csr_array(A1)],
+        [(sparse_tpu.csr_array(R), sparse_tpu.csr_array(P))], mesh,
+    )
+    weights = []
+    for Ad, lvA in ((ops[0][0], A0), (ops[1][0], A1)):
+        D = np.asarray(lvA.diagonal())
+        weights.append((2.0 / 3.0) / (Ad.pad_out_vector(D - 1.0) + 1.0))
+    cycle = make_dist_vcycle(ops, weights,
+                             coarse_apply=lambda rp: weights[-1] * rp)
+    A0d = ops[0][0]
+    Mop = vcycle_operator(cycle, A0d.m_pad, dtype=np.float64)
+    b = np.ones(g * g)
+    _, it_plain, conv_p = dist_cg(A0d, b, tol=1e-8, maxiter=600,
+                                  conv_test_iters=5)
+    xp, it_pre, conv_m = dist_cg(A0d, b, tol=1e-8, maxiter=600,
+                                 conv_test_iters=5, M=Mop)
+    assert conv_p and conv_m
+    x = A0d.unpad_vector(xp)
+    assert np.linalg.norm(np.asarray(A0 @ x) - b) < 1e-5
+    assert it_pre < it_plain  # the LinearOperator form actually helps
+
+
+def test_row_program_make_M_hook():
+    from sparse_tpu.fleet import build_row_program, fleet_mesh
+    from sparse_tpu.parallel.multigrid import vcycle_operator
+
+    g = 8
+    A0, _, _, _ = _gmg_2d(g)
+    pat = SparsityPattern(A0.indptr, A0.indices, A0.shape)
+    mesh = fleet_mesh(4)
+    made = {}
+
+    def make_M(D):
+        # a padded Jacobi smoother through the LinearOperator wrapper —
+        # the same promotion path a V-cycle hook uses
+        Dw = 1.0 / (D.pad_out_vector(np.asarray(A0.diagonal()) - 1.0) + 1.0)
+        made["m_pad"] = D.m_pad
+        return vcycle_operator(lambda rp: Dw * rp, D.m_pad)
+
+    run = build_row_program(pat, np.float64, mesh, make_M=make_M)
+    b = np.ones(g * g)
+    X, iters, resid2, conv = run(
+        np.asarray(A0.data)[None, :], b[None, :],
+        np.zeros((1, g * g)), np.asarray([1e-9]), 2000,
+    )
+    assert made["m_pad"] > 0
+    assert bool(conv[0])
+    assert np.linalg.norm(np.asarray(A0 @ X[0]) - b) < 1e-7
