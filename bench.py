@@ -811,6 +811,130 @@ def run_precond_cg(B: int = 16, g: int = 32, tol: float = 1e-6,
     return out
 
 
+def run_mixed_cg(B: int = 16, g: int = 512, tol_rel: float = 1e-3) -> dict:
+    """Mixed-precision row (ISSUE 15): end-to-end batched solve time on
+    the pde512 banded profile — exact f64 vs f32+IR vs bf16-storage IR
+    at MATCHING achieved relative residual, plus the values-bytes-moved
+    column. The win condition (acceptance): >= 1.5x end-to-end for
+    f32+IR over exact f64 on the CPU lane.
+
+    Tracked numbers:
+
+    * ``exact_s`` / ``f32ir_s`` / ``bf16ir_s``: wall per warm batched
+      solve of the same B-lane stack (programs compiled outside the
+      window via a 3-iteration warm-up call of the SAME jitted program
+      — ``maxiter`` is a traced argument).
+    * ``speedup`` (exact/f32ir; acceptance >= 1.5) and
+      ``speedup_bf16`` (exact/bf16ir).
+    * ``values_bytes_per_iter``: value-plane bytes streamed per inner
+      iteration across the batch — ``D * N * itemsize * B``; the
+      ``bytes_ratio_*`` columns pin the 2x (f32) / 4x (bf16) storage
+      reduction vs f64.
+    * matching-tolerance honesty: every variant's achieved max relative
+      residual is recorded and must be <= ``tol_rel``; the IR outer
+      loop verifies in f64, so reduced storage never relaxes the
+      contract.
+
+    All variants share the masked batched loop cores and the DIA
+    matvec (``ops.dia_spmv.dia_spmv_xla``; ``acc_dtype=f32`` widens the
+    bf16 planes at the multiply) — the same formulation the pde512
+    headline rides, so the delta is precision, not kernel choice.
+    """
+    import numpy as np
+
+    from sparse_tpu import mixed
+    from sparse_tpu.batch import krylov
+    from sparse_tpu.config import settings
+    from sparse_tpu.models.poisson import poisson_cg_state_dia
+    from sparse_tpu.ops.dia_spmv import dia_spmv_xla
+
+    import jax
+    import jax.numpy as jnp
+
+    N = g * g
+    offsets = (-g, -1, 0, 1, g)
+    state64, _ = poisson_cg_state_dia(g, dtype=jnp.float64)
+    planes64 = state64[0]
+    rng = np.random.default_rng(41)
+    rhs = jnp.asarray(rng.standard_normal((B, N)))
+    tols = jnp.full((B,), tol_rel) * jnp.linalg.norm(rhs, axis=-1)
+    inner = settings.ir_inner or min(N, 4000)
+    outer_cap = settings.ir_outer
+
+    def mk(planes, acc=None):
+        def mv(X):
+            return jax.vmap(
+                lambda v: dia_spmv_xla(planes, offsets, v, (N, N),
+                                       acc_dtype=acc)
+            )(X)
+
+        return mv
+
+    mv64 = mk(planes64)
+    variants = {
+        "exact": jax.jit(
+            lambda rhs, tols, mi: krylov._cg_loop(
+                mv64, rhs, jnp.zeros_like(rhs), tols, mi, 25
+            )
+        ),
+    }
+    for policy, planes, acc in (
+        ("f32ir", planes64.astype(jnp.float32), None),
+        ("bf16ir", planes64.astype(jnp.bfloat16), jnp.float32),
+    ):
+        mvl = mk(planes, acc)
+        variants[policy] = jax.jit(
+            lambda rhs, tols, mi, mvl=mvl, policy=policy: mixed.ir_loop(
+                mv64, mvl, rhs, jnp.zeros_like(rhs), tols, mi, 25,
+                inner, outer_cap, mixed.default_eta(policy), jnp.float32,
+            )
+        )
+
+    itemsize = {"exact": 8, "f32ir": 4, "bf16ir": 2}
+    out = {"B": B, "n": N, "profile": f"pde{g}_dia_f64", "tol_rel": tol_rel,
+           "inner_iters": inner}
+    rhs_h = np.asarray(rhs)
+    rnorms = np.linalg.norm(rhs_h, axis=-1)
+    for tag, fn in variants.items():
+        jax.block_until_ready(fn(rhs, tols, 3))  # compile outside the window
+        t0 = time.perf_counter()
+        res = fn(rhs, tols, 40 * N)
+        jax.block_until_ready(res)
+        dt = time.perf_counter() - t0
+        X, iters = res[0], res[1]
+        conv = np.asarray(res[3])
+        R = np.asarray(mv64(X)) - rhs_h
+        rel = float((np.linalg.norm(R, axis=-1) / rnorms).max())
+        row = {
+            "end_to_end_s": round(dt, 3),
+            "iters_mean": round(float(np.asarray(iters).mean()), 1),
+            "achieved_rel_resid": rel,
+            "converged": bool(conv.all()) and rel <= tol_rel * 1.01,
+            "values_bytes_per_iter": len(offsets) * N * itemsize[tag] * B,
+        }
+        if tag != "exact":
+            row["ir_outer"] = int(np.asarray(res[4]))
+        out[tag] = row
+        out[f"{tag}_s"] = row["end_to_end_s"]
+    e, f, bf = out["exact"], out["f32ir"], out["bf16ir"]
+    if f["converged"]:
+        out["speedup"] = round(
+            e["end_to_end_s"] / max(f["end_to_end_s"], 1e-9), 2
+        )
+        out["win_1_5x"] = bool(out["speedup"] >= 1.5)
+    if bf["converged"]:
+        out["speedup_bf16"] = round(
+            e["end_to_end_s"] / max(bf["end_to_end_s"], 1e-9), 2
+        )
+    out["bytes_ratio_f32"] = round(
+        e["values_bytes_per_iter"] / f["values_bytes_per_iter"], 2
+    )
+    out["bytes_ratio_bf16"] = round(
+        e["values_bytes_per_iter"] / bf["values_bytes_per_iter"], 2
+    )
+    return out
+
+
 def run_sustained_cg(n: int = 512, B: int = 8, rate: float = 150.0,
                      duration: float = 1.5, slo_ms: float = 250.0,
                      seed: int = 23) -> dict:
@@ -1258,6 +1382,10 @@ def worker(platform_arg: str) -> None:
             rec["precond_cg"] = run_precond_cg()
         except Exception:
             traceback.print_exc(file=sys.stderr)
+        try:  # stage 4.10: mixed-precision row (ISSUE 15)
+            rec["mixed_cg"] = run_mixed_cg()
+        except Exception:
+            traceback.print_exc(file=sys.stderr)
         print(json.dumps(rec))
         sys.stdout.flush()
         try:  # stage 5: full fused sweep — refines the headline if better
@@ -1314,6 +1442,10 @@ def worker(platform_arg: str) -> None:
             traceback.print_exc(file=sys.stderr)
         try:  # batched preconditioner row (ISSUE 14, the CPU lane)
             rec["precond_cg"] = run_precond_cg()
+        except Exception:
+            traceback.print_exc(file=sys.stderr)
+        try:  # mixed-precision row (ISSUE 15, the CPU lane)
+            rec["mixed_cg"] = run_mixed_cg()
         except Exception:
             traceback.print_exc(file=sys.stderr)
         print(json.dumps(rec))

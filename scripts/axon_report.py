@@ -611,6 +611,22 @@ def build_report(records_path: str, bench_paths=(), peak_gflops=None,
                 metrics[f"precond_cg.{k}"] = {
                     "v": precond_row[k], "hib": hib,
                 }
+    # the bench mixed_cg row (ISSUE 15): end-to-end mixed-precision
+    # batched solve time on the pde512 banded profile — the PRECISION
+    # regression surface (f32+IR / bf16-storage vs exact f64 at
+    # matching achieved residual, plus the values-bytes column)
+    mixed_row = None
+    for e in sorted(sessions, key=lambda e: e.get("ts", 0)):
+        rec = e.get("record")
+        if isinstance(rec, dict) and isinstance(rec.get("mixed_cg"), dict):
+            mixed_row = rec["mixed_cg"]
+    if mixed_row:
+        for k, hib in (("exact_s", False), ("f32ir_s", False),
+                       ("bf16ir_s", False), ("speedup", True),
+                       ("speedup_bf16", True),
+                       ("bytes_ratio_bf16", True)):
+            if _num(mixed_row.get(k)) is not None:
+                metrics[f"mixed_cg.{k}"] = {"v": mixed_row[k], "hib": hib}
     for key, p in programs.items():
         if _num(p.get("achieved_gflops")) is not None:
             metrics[f"program.{key}.achieved_gflops"] = {
@@ -654,6 +670,7 @@ def build_report(records_path: str, bench_paths=(), peak_gflops=None,
         "fleet_row": fleet_row,
         "sustained_row": sustained_row,
         "precond_row": precond_row,
+        "mixed_row": mixed_row,
         "bench": bench_rows,
         "metrics": metrics,
     }
@@ -671,6 +688,8 @@ _TREND_EMBEDS = (
     ("batched_cg", ("speedup_warm",)),
     ("fleet_batched_cg", ("speedup_warm",)),
     ("precond_cg", ("end_to_end_s", "iters_mean", "build_s", "speedup")),
+    ("mixed_cg", ("exact_s", "f32ir_s", "bf16ir_s", "speedup",
+                  "bytes_ratio_bf16")),
 )
 
 
@@ -955,6 +974,16 @@ def _print_report(rep: dict) -> None:
             f"iters {(prow.get('none') or {}).get('iters_mean')} -> "
             f"{prow.get('iters_mean')}, build={prow.get('build_s')}s, "
             f"profile={prow.get('profile')})"
+        )
+    mrow = rep.get("mixed_row")
+    if mrow:
+        print(
+            "  mixed_cg: "
+            f"f32ir {mrow.get('f32ir_s')}s vs exact "
+            f"{mrow.get('exact_s')}s (speedup={mrow.get('speedup')}x, "
+            f"bf16ir {mrow.get('bf16ir_s')}s, values-bytes f64/bf16="
+            f"{mrow.get('bytes_ratio_bf16')}x, "
+            f"profile={mrow.get('profile')})"
         )
     progs = rep.get("programs") or {}
     if progs:

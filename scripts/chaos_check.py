@@ -85,6 +85,18 @@ chains are missing:
    and rebuild (``vault.quarantine``), and the rebuilt symbolic
    structure must factorize to the EXACT factor the pre-corruption
    artifact produced — disk corruption can never change the numerics.
+12. **Mixed-precision chaos** (ISSUE 15 acceptance drill) — part A: a
+   bounded ``nonfinite:matvec`` clause corrupts the reduced-precision
+   (``dtype_policy='f32ir'``) bucket's inner f32 sweep: the anomaly
+   detectors fire (``solver.anomaly``), the session takes the
+   PROMOTE_DTYPE rung — a ``mixed.promote`` event plus a
+   ``batch.requeue`` with ``action='promote_dtype'``, the group pinned
+   to 'exact' (``mixed.promotions`` counter) — and the ticket still
+   converges through the exact re-solve AHEAD of any solver
+   escalation. Part B: the reduced-precision program's manifest entry
+   carries its ``dtype_policy``; after clean traffic, a fresh process
+   replays the precision-KEYED (``.Pf32ir``-suffixed) program and
+   serves the mixed fast path at ZERO plan-cache misses.
 
 Telemetry is pointed at a temp sink (never the committed
 ``results/axon/records.jsonl``). Wired into the quick lane through
@@ -303,6 +315,134 @@ def run(report: dict) -> list:
 
     # -- 11. precond chaos: drop-M rung + ILU artifact io parity ------------
     problems += _precond_chaos(report)
+
+    # -- 12. mixed-precision chaos: promote_dtype rung + precision-keyed
+    #        warm restart ---------------------------------------------------
+    problems += _mixed_chaos(report)
+    return problems
+
+
+def _mixed_chaos(report: dict) -> list:
+    """Scenario 12 (ISSUE 15): matvec corruption scoped into the inner
+    f32 sweep of a reduced-precision bucket must take the promote_dtype
+    rung — anomaly detected, lanes requeued at 'exact', ticket still
+    converged — and a warm restart must replay the precision-keyed
+    program at zero serving misses."""
+    import shutil
+
+    import numpy as np
+
+    from sparse_tpu import plan_cache, vault
+    from sparse_tpu import telemetry as tel
+    from sparse_tpu.batch import SolveSession
+    from sparse_tpu.config import settings
+    from sparse_tpu.resilience import faults
+    from sparse_tpu.telemetry import _metrics
+
+    problems = []
+    S = _tridiag(N, seed=31)
+    import sparse_tpu
+
+    A = sparse_tpu.csr_array(S)
+    b = np.random.default_rng(33).standard_normal(N)
+
+    # -- part A: inner-sweep corruption => promote_dtype rung ---------------
+    tel.reset()
+    faults.clear()
+    plan_cache.clear()
+    # bounded clause: the injection budget exhausts during the reduced
+    # bucket's inner sweep, so the promoted exact re-solve runs clean
+    faults.configure("nonfinite:matvec:p=1,n=6,seed=13")
+
+    def _promos():
+        # the IR loop's divergence safeguard may classify the corrupted
+        # lane as unconverged (finite best iterate) rather than
+        # nonfinite — both are the injected anomaly
+        return sum(
+            float(_metrics.counter("mixed.promotions", reason=r).value)
+            for r in ("nonfinite", "unconverged")
+        )
+
+    promo0 = _promos()
+    try:
+        ses = SolveSession("cg", warm_start=False, dtype_policy="f32ir")
+        t = ses.submit(A, b, tol=TOL, maxiter=20 * N)
+        ses.flush()
+        x, _iters, r2 = t.result()
+    finally:
+        faults.clear()
+    rnorm = float(np.linalg.norm(S @ np.asarray(x) - b))
+    kinds = _event_kinds(tel)
+    promos = _promos() - promo0
+    requeue_actions = [
+        e.get("action") for e in tel.events()
+        if e.get("kind") == "batch.requeue"
+    ]
+    report["mixed_promote"] = {
+        "converged": bool(t.converged), "rnorm": rnorm,
+        "promoted": bool(t.promoted), "promotions": promos,
+        "requeue_actions": requeue_actions, "events": kinds,
+    }
+    if not t.converged or rnorm > 10 * TOL:
+        problems.append(
+            f"mixed: promoted solve failed (converged={t.converged}, "
+            f"||r||={rnorm:.2e})"
+        )
+    if kinds.get("fault.injected", 0) == 0:
+        problems.append("mixed: no fault.injected events — spec drift?")
+    if kinds.get("solver.anomaly", 0) == 0:
+        problems.append("mixed: anomaly detector never fired on the "
+                        "corrupted inner sweep")
+    if kinds.get("mixed.promote", 0) == 0 or promos < 1:
+        problems.append("mixed: promote_dtype rung never fired")
+    if "promote_dtype" not in requeue_actions:
+        problems.append("mixed: no batch.requeue with "
+                        "action='promote_dtype'")
+    if not t.promoted:
+        problems.append("mixed: ticket not marked promoted")
+
+    # -- part B: precision-keyed warm restart at zero serving misses --------
+    vdir = tempfile.mkdtemp(prefix="chaos_mixed_vault_")
+    old_vault = settings.vault
+    try:
+        settings.vault = vdir
+        plan_cache.clear()
+        ses1 = SolveSession("cg", warm_start=False, dtype_policy="f32ir")
+        t1 = ses1.submit(A, b, tol=TOL, maxiter=20 * N)
+        ses1.flush()
+        t1.result()
+        entries = vault.manifest_entries()
+        keyed = [e for e in entries if e.get("dtype_policy") == "f32ir"]
+        # the restart: in-process tier cleared, vault retained
+        plan_cache.clear()
+        ses2 = SolveSession("cg", warm_start=True, warm_async=False,
+                            dtype_policy="f32ir")
+        replayed = ses2.warm_replayed
+        snap = plan_cache.snapshot()
+        t2 = ses2.submit(A, b, tol=TOL, maxiter=20 * N)
+        ses2.flush()
+        x2, _i2, _r2 = t2.result()
+        d = plan_cache.delta(snap)
+        rnorm2 = float(np.linalg.norm(S @ np.asarray(x2) - b))
+        report["mixed_warm_restart"] = {
+            "manifest_keyed": len(keyed), "replayed": replayed,
+            "serving_misses": d["misses"], "rnorm": rnorm2,
+        }
+        if not keyed:
+            problems.append("mixed: manifest entry lost its dtype_policy")
+        if replayed < 1:
+            problems.append("mixed: warm replay rebuilt no precision-"
+                            "keyed program")
+        if d["misses"] != 0:
+            problems.append(
+                f"mixed: warm restart served with {d['misses']} plan-"
+                "cache misses (expected zero)"
+            )
+        if not t2.converged or rnorm2 > 10 * TOL:
+            problems.append("mixed: warm-restart solve failed")
+    finally:
+        settings.vault = old_vault
+        shutil.rmtree(vdir, ignore_errors=True)
     return problems
 
 
@@ -1217,6 +1357,8 @@ def main(argv) -> int:
         fl = report.get("incident_flight", {})
         pr = report.get("pipeline_restart", {})
         pa = report.get("pipeline_admission", {})
+        mp = report.get("mixed_promote", {})
+        mw = report.get("mixed_warm_restart", {})
         print(
             "chaos check passed: "
             f"{len([k for k in report if k.startswith('solver.')])} solvers "
@@ -1238,7 +1380,11 @@ def main(argv) -> int:
             f"program(s), {pr.get('serving_builds', '?')} serving "
             f"build(s)), admission burst ok "
             f"({pa.get('admission_events', 0)} admission event(s), "
-            f"queue alert fired+cleared, drift {pa.get('drift', '?')})"
+            f"queue alert fired+cleared, drift {pa.get('drift', '?')}), "
+            f"mixed promote_dtype ok ({mp.get('promotions', 0):.0f} "
+            "promotion(s), converged at exact), mixed warm restart "
+            f"({mw.get('replayed', 0)} precision-keyed program(s), "
+            f"{mw.get('serving_misses', '?')} serving misses)"
         )
     return 1 if problems else 0
 

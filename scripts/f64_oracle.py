@@ -1,4 +1,4 @@
-"""f32-vs-f64 accuracy comparison for the PDE/CG headline (VERDICT r2 #6).
+"""f32-vs-f64-vs-IR accuracy oracle for the PDE/CG headline (VERDICT r2 #6).
 
 The headline benchmark runs the 6000^2 5-point Poisson CG in f32 on TPU and
 compares throughput against the reference's f64 V100 number. This script
@@ -9,11 +9,21 @@ dtypes on CPU and reports, per grid size:
   - true relative residual ||b - A x_300|| / ||b|| for f32 and f64
   - relative iterate distance ||x_f32 - x_300_f64|| / ||x_f64||
   - relative error vs the sampled ground-truth xtrue for both
+  - the MIXED-PRECISION columns (ISSUE 15): the `ir` solver — f32 (and
+    bf16-storage) inner Krylov sweeps under the f64 iterative-refinement
+    outer loop (sparse_tpu.mixed) — driven to the SAME absolute residual
+    target the plain f64 run achieved, with its refinement sweep count.
+    This is the pinned oracle for the serving stack's `f32ir`/`bf16ir`
+    dtype policies: reduced-precision storage, f64-verified accuracy.
 
 The fused Pallas CG used for the TPU headline computes the same recurrence as
 this step loop (residual parity asserted in tests/test_cg_fused.py and
 measured identical at 6000^2 on hardware, BENCH_NOTES.md r2 sweep: rho
 0.001092 for both), so the step loop stands in for it here.
+
+``tests/test_mixed.py`` imports :func:`run` and pins the per-size table's
+accuracy claims in CI (the satellite contract: the table lives in a test
+fixture, not just BENCH_NOTES.md).
 
 Usage: python scripts/f64_oracle.py [n ...]   (default: 512 2000 6000)
 Prints one JSON line per size; paste the table into BENCH_NOTES.md.
@@ -42,7 +52,7 @@ from sparse_tpu.ops.dia_spmv import dia_spmv_xla
 ITERS = 300
 
 
-def run(n: int) -> dict:
+def run(n: int, ir_policies=("f32ir", "bf16ir")) -> dict:
     N = n * n
     offsets = (-n, -1, 0, 1, n)
     out = {"n": n, "iters": ITERS}
@@ -70,6 +80,37 @@ def run(n: int) -> dict:
     out["rel_iterate_dist_f32_vs_f64"] = float(
         np.linalg.norm(sols["f32"] - sols["f64"]) / np.linalg.norm(sols["f64"])
     )
+
+    # the IR columns (ISSUE 15): drive the mixed-precision solver to the
+    # SAME absolute residual the plain f64 run achieved — matching
+    # achieved tolerance, reduced-precision inner sweeps
+    from sparse_tpu.mixed import ir_solve
+
+    bnorm = float(jnp.linalg.norm(b64))
+    target = max(out["rel_resid_f64"], 1e-14) * bnorm
+
+    def mk(planes):
+        def mv(X):
+            return jax.vmap(
+                lambda v: dia_spmv_xla(planes, offsets, v, (N, N))
+            )(X)
+
+        return mv
+
+    for policy in ir_policies:
+        low_dt = jnp.float32 if policy == "f32ir" else jnp.bfloat16
+        x_ir, info = ir_solve(
+            (mk(planes64), mk(planes64.astype(low_dt))), b64,
+            tol=target, maxiter=6 * ITERS, policy=policy,
+        )
+        resid = dia_spmv_xla(planes64, offsets, x_ir.astype(jnp.float64),
+                             (N, N)) - b64
+        out[f"rel_resid_{policy}"] = float(
+            jnp.linalg.norm(resid) / jnp.linalg.norm(b64)
+        )
+        out[f"{policy}_converged"] = bool(np.asarray(info.converged).all())
+        out[f"{policy}_inner_iters"] = int(np.asarray(info.iters).max())
+        out[f"{policy}_outer"] = int(info.outer)
     return out
 
 

@@ -299,6 +299,21 @@ def _bicgstab_loop(matvec, b, X0, tol, maxiter, conv_test_iters,
     return X, iters, jnp.real(_bdot(R, R)), ~active
 
 
+def batched_ir(A, b, x0=None, tol=1e-08, maxiter=None, M=None,
+               conv_test_iters=25, policy="f32ir", **kwargs):
+    """Batched mixed-precision iterative refinement (ISSUE 15): inner
+    reduced-precision CG sweeps under an f64 residual-and-correct outer
+    loop, per-lane freeze masks at both levels — the first-class ``ir``
+    solver of :mod:`sparse_tpu.mixed`. Same lane contract as
+    :func:`batched_cg` (absolute per-lane ``||r|| < tol``, evaluated in
+    f64); the returned info additionally carries ``info.outer``."""
+    from ..mixed import ir_solve
+
+    return ir_solve(A, b, x0=x0, tol=tol, maxiter=maxiter, M=M,
+                    conv_test_iters=conv_test_iters, policy=policy,
+                    **kwargs)
+
+
 def batched_bicgstab(A, b, x0=None, tol=1e-08, maxiter=None, M=None,
                      conv_test_iters=25):
     """Batched BiCGStab; see :func:`batched_cg` for the lane contract.

@@ -95,6 +95,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from .. import fleet as fleet_mod
+from .. import mixed as mixed_mod
 from .. import plan_cache, telemetry
 from .. import precond as precond_mod
 from ..config import settings
@@ -225,7 +226,7 @@ class SolveTicket:
 
     __slots__ = ("_session", "_out", "t_submit", "state", "error",
                  "deadline_s", "requeued", "solver", "id", "phase_ms",
-                 "t_done", "t_mark", "tenant")
+                 "t_done", "t_mark", "tenant", "promoted", "dtype_policy")
 
     def __init__(self, session, deadline_s=None, tenant=None):
         self._session = session
@@ -241,6 +242,12 @@ class SolveTicket:
         self.t_done = None  # set once, at first terminal resolution
         self.t_mark = None  # end of the last phase-accounted dispatch
         self.tenant = None if tenant is None else str(tenant)
+        # mixed precision (ISSUE 15): whether the promote_dtype rung
+        # already re-solved this lane at 'exact', and the reduced
+        # policy the lane last dispatched under (None = exact — keeps
+        # metric series names and event fields unchanged)
+        self.promoted = False
+        self.dtype_policy = None
 
     @property
     def done(self) -> bool:
@@ -331,10 +338,10 @@ class SolveTicket:
 
 class _Request:
     __slots__ = ("pattern", "values", "b", "tol", "x0", "maxiter", "ticket",
-                 "precond")
+                 "precond", "dtype_policy")
 
     def __init__(self, pattern, values, b, tol, x0, maxiter, ticket,
-                 precond=None):
+                 precond=None, dtype_policy=None):
         self.pattern, self.values, self.b = pattern, values, b
         self.tol, self.x0, self.maxiter = tol, x0, maxiter
         self.ticket = ticket
@@ -343,6 +350,10 @@ class _Request:
         # Joins the flush group key — lanes with different overrides
         # never share a bucket program.
         self.precond = precond
+        # per-ticket dtype-policy override (ISSUE 15): same contract —
+        # None = session policy, a canonical policy/'exact' forces it,
+        # and it joins the flush group key like the precond override.
+        self.dtype_policy = dtype_policy
 
 
 def _promote(dt: np.dtype) -> np.dtype:
@@ -371,6 +382,55 @@ def donate_argnums() -> tuple:
     return (0, 1, 2) if backend in ("tpu", "gpu", "cuda", "rocm") else ()
 
 
+def _build_ir_program(pack, mixed: dict, solver: str, cti: int, mfac):
+    """The reduced-precision bucket program (ISSUE 15): values downcast
+    once inside the program — f64 planes for the outer residual, the
+    policy's storage-width planes for the inner sweep (wide-accumulating
+    matvec via ``acc_dtype``) — around the fused iterative-refinement
+    loop (:func:`sparse_tpu.mixed.ir_loop`). Same argument signature as
+    the exact bucket programs; one extra output (the refinement sweep
+    count)."""
+    idx_slabs, pos, zero_rows = (
+        pack.idx_slabs, pack.pos, pack.plan.zero_rows
+    )
+    storage_dt, compute_dt = mixed_mod.inner_dtypes(mixed["policy"])
+    sdt = jnp.dtype(storage_dt)
+    cdt = jnp.dtype(compute_dt)
+    wdt = jnp.dtype(mixed_mod.outer_dtype())
+    inner_iters = int(mixed["inner_iters"])
+    max_outer = int(mixed["max_outer"])
+    eta = float(mixed["eta"])
+
+    @partial(jax.jit, donate_argnums=donate_argnums())
+    def run(values, rhs, x0, tols, maxiter):
+        req_dt = values.dtype
+        vals_w = pack.pack_values(values.astype(wdt))
+        vals_l = pack.pack_values(values.astype(sdt))
+
+        def mv_wide(X):
+            return spmv_ops.csr_spmv_sell_batched(
+                idx_slabs, vals_w, pos, X, zero_rows
+            )
+
+        def mv_low(X):
+            return spmv_ops.csr_spmv_sell_batched(
+                idx_slabs, vals_l, pos, X, zero_rows, acc_dtype=cdt
+            )
+
+        fmv_low = krylov._maybe_faulty_mv(mv_low)
+        # batched numeric factorization at the COMPUTE dtype (ISSUE 15:
+        # the preconditioner follows the storage policy, its application
+        # widened consistently with the inner sweep)
+        Mvec = None if mfac is None else mfac(values.astype(cdt), fmv_low)
+        X, iters, resid2, conv, outer = mixed_mod.ir_loop(
+            mv_wide, fmv_low, rhs, x0, tols, maxiter, cti,
+            inner_iters, max_outer, eta, cdt, Mvec=Mvec, solver=solver,
+        )
+        return X.astype(req_dt), iters, resid2, conv, outer
+
+    return run
+
+
 class _InFlight:
     """One dispatched-but-not-retired bucket program: everything
     ``_retire`` needs to scatter results, account phases and decide
@@ -379,17 +439,20 @@ class _InFlight:
 
     __slots__ = ("reqs", "dt", "solver", "allow_requeue", "plan", "key",
                  "bkt", "nb", "out", "built", "snap", "t0", "t_packed",
-                 "t_solve0", "t_dispatched", "sampled", "_ready")
+                 "t_solve0", "t_dispatched", "sampled", "policy", "_ready")
 
     def __init__(self, reqs, dt, solver, allow_requeue, plan, key, bkt,
                  nb, out, built, snap, t0, t_packed, t_solve0,
-                 t_dispatched, sampled):
+                 t_dispatched, sampled, policy=mixed_mod.EXACT):
         self.reqs, self.dt, self.solver = reqs, dt, solver
         self.allow_requeue, self.plan, self.key = allow_requeue, plan, key
         self.bkt, self.nb, self.out = bkt, nb, out
         self.built, self.snap = built, snap
         self.t0, self.t_packed, self.t_solve0 = t0, t_packed, t_solve0
         self.t_dispatched, self.sampled = t_dispatched, sampled
+        # the resolved dtype policy this bucket ran under (ISSUE 15):
+        # 'exact' or a reduced policy — the promote rung keys off it
+        self.policy = policy
         self._ready = False
 
     def is_ready(self) -> bool:
@@ -557,7 +620,8 @@ class SolveSession:
                  admission: str = "block",
                  warm_async: bool = True,
                  precond=None,
-                 row_precond=None):
+                 row_precond=None,
+                 dtype_policy=None):
         if solver not in _SOLVERS:
             raise ValueError(f"solver must be one of {_SOLVERS}")
         if fallback_solver not in _SOLVERS:
@@ -622,6 +686,13 @@ class SolveSession:
         # that joins the program key and the vault manifest. Off (the
         # default env) leaves keys and jaxprs byte-identical.
         self.precond = precond_mod.PrecondPolicy.resolve(precond)
+        # mixed-precision serving policy (ISSUE 15, docs/performance.md
+        # "Mixed precision"): resolves SPARSE_TPU_DTYPE / dtype_policy=
+        # / per-ticket overrides into a per-(pattern, solver, bucket,
+        # dtype) precision choice that joins the program key (.P suffix)
+        # and the vault manifest. 'exact' (the default env) leaves keys,
+        # jaxprs and numerics byte-identical.
+        self.dtype_policy = mixed_mod.DtypePolicy.resolve(dtype_policy)
         # optional row-shard-lane preconditioner hook: a callable
         # ``make_M(DistCSR) -> padded M`` (e.g. a multigrid V-cycle via
         # parallel.multigrid.vcycle_operator) threaded into
@@ -688,7 +759,8 @@ class SolveSession:
                pattern: SparsityPattern | None = None,
                deadline_s: float | None = None,
                tenant: str | None = None,
-               precond: str | None = None) -> SolveTicket:
+               precond: str | None = None,
+               dtype_policy: str | None = None) -> SolveTicket:
         """Queue one system. ``A`` is a CSR-shaped matrix (csr_array /
         scipy) or, with ``pattern=`` given, a bare ``(nnz,)`` value
         vector over that pattern. ``deadline_s`` is a per-ticket wall
@@ -707,6 +779,12 @@ class SolveSession:
         'off'. Requests with different overrides never share a bucket
         (the override joins the flush group key, like the dtype), and
         the resolved kind joins the bucket program's plan-cache key.
+
+        ``dtype_policy`` overrides the session's mixed-precision policy
+        for this one request (ISSUE 15): 'exact', 'auto', 'f32ir' or
+        'bf16ir' — same grouping/keying contract as ``precond`` (the
+        resolved policy joins the program key as a ``.P`` suffix;
+        'exact' keeps the historic key).
 
         With ``max_queue_depth`` set, admission control runs first
         (after validation): at the bound, ``admission='block'`` drives
@@ -731,12 +809,14 @@ class SolveSession:
             )
         if precond is not None:
             precond = precond_mod.canonical_kind(precond)  # validate early
+        if dtype_policy is not None:
+            dtype_policy = mixed_mod.canonical_policy(dtype_policy)
         if self.max_queue_depth is not None:
             self._admit()
         t = SolveTicket(self, deadline_s=deadline_s, tenant=tenant)
         q = self._pending.setdefault(id(pattern), [])
         q.append(_Request(pattern, values, b, float(tol), x0, maxiter, t,
-                          precond=precond))
+                          precond=precond, dtype_policy=dtype_policy))
         _QUEUE_DEPTH.inc()
         self._unfinalized += 1
         if self.auto_flush is not None and len(q) >= self.auto_flush:
@@ -818,6 +898,7 @@ class SolveSession:
             "dispatches": self.dispatches,
             "mesh": self.fleet.describe(),
             "precond": self.precond.describe(),
+            "dtype_policy": self.dtype_policy.describe(),
             "device_occupancy": list(self._device_occ),
             "pipeline": {
                 "inflight": self.inflight,
@@ -843,14 +924,18 @@ class SolveSession:
     # -- warm restart (ISSUE 9; async since ISSUE 13) ----------------------
     def _manifest_plan(self, e: dict):
         """Parse one warm-start manifest entry into ``(program_key,
-        solver, bucket, dtype, plan, precond, skip_reason)`` — the
-        SINGLE place entry -> plan-cache key resolution lives, so the
-        async replay's planned-key set (what ``_launch`` waits for) and
-        the replay itself can never disagree. ``skip_reason`` is
-        ``None`` for a replayable entry, ``'mesh'`` for a
-        topology-mismatched fleet entry (clean cold start) and
+        solver, bucket, dtype, plan, precond, dtype_policy,
+        skip_reason)`` — the SINGLE place entry -> plan-cache key
+        resolution lives, so the async replay's planned-key set (what
+        ``_launch`` waits for) and the replay itself can never disagree.
+        ``skip_reason`` is ``None`` for a replayable entry, ``'mesh'``
+        for a topology-mismatched fleet entry (clean cold start) and
         ``'malformed'`` otherwise. ``precond`` is the entry's recorded
-        kind ('none' when absent — pre-precond manifests stay valid)."""
+        kind ('none' when absent — pre-precond manifests stay valid);
+        ``dtype_policy`` the recorded precision policy ('exact' when
+        absent — pre-mixed manifests stay valid, ISSUE 15)."""
+        _bad = (None, None, 0, None, None, precond_mod.NONE,
+                mixed_mod.EXACT, "malformed")
         solver = e.get("solver")
         try:
             bkt = int(e.get("bucket", 0))
@@ -858,13 +943,19 @@ class SolveSession:
             bkt = 0
         dtstr = e.get("dtype", "")
         if solver not in _SOLVERS or bkt < 1 or not dtstr:
-            return None, None, 0, None, None, precond_mod.NONE, "malformed"
+            return _bad
         try:
             mkind = precond_mod.canonical_kind(
                 e.get("precond"), allow_auto=False
             )
         except ValueError:
-            return None, None, 0, None, None, precond_mod.NONE, "malformed"
+            return _bad
+        try:
+            dpol = mixed_mod.canonical_policy(
+                e.get("dtype_policy"), allow_auto=False
+            )
+        except ValueError:
+            return _bad
         # mesh-keyed entries (the fleet tier) only replay on the SAME
         # topology: a fingerprint mismatch — restart on a different pod
         # shape, fleet turned off — skips the entry for a clean cold
@@ -875,19 +966,21 @@ class SolveSession:
                 self.fleet.enabled
                 and mesh_fp == self.fleet.fingerprint
             ):
-                return None, None, 0, None, None, precond_mod.NONE, "mesh"
+                return (None, None, 0, None, None, precond_mod.NONE,
+                        mixed_mod.EXACT, "mesh")
             plan = self.fleet.plan_for(e.get("strategy", "batch"))
         else:
             plan = fleet_mod.FleetPlan("single")
         try:
             dt = np.dtype(dtstr)
         except TypeError:
-            return None, None, 0, None, None, precond_mod.NONE, "malformed"
+            return _bad
         key = (
             f"batch.{solver}.B{bkt}.{dt.str}{plan.key_suffix}"
             f"{precond_mod.key_suffix(mkind)}"
+            f"{mixed_mod.key_suffix(dpol)}"
         )
-        return key, solver, bkt, dt, plan, mkind, None
+        return key, solver, bkt, dt, plan, mkind, dpol, None
 
     def _replay_manifest(self, notify=None) -> int:
         """Replay the vault's warm-start manifest: for every recorded
@@ -908,7 +1001,7 @@ class SolveSession:
         for e in entries:
             key = None
             try:
-                (key, solver, bkt, dt, plan, mkind,
+                (key, solver, bkt, dt, plan, mkind, dpol,
                  skip) = self._manifest_plan(e)
                 if skip is not None:
                     if skip == "mesh":
@@ -920,7 +1013,7 @@ class SolveSession:
                 pat = self._patterns.setdefault(pat.fingerprint, pat)
                 pat.sell_pack()  # disk-tier hit (or rebuild + deposit)
                 self._prebuild(pat, solver, bkt, dt, plan=plan,
-                               precond=mkind)
+                               precond=mkind, dtype_policy=dpol)
                 replayed += 1
             except Exception:  # noqa: BLE001 - entry isolation
                 continue
@@ -939,19 +1032,21 @@ class SolveSession:
 
     def _prebuild(self, pattern: SparsityPattern, solver: str, bkt: int,
                   dt, plan=None,
-                  precond: str = precond_mod.NONE) -> None:
+                  precond: str = precond_mod.NONE,
+                  dtype_policy: str = mixed_mod.EXACT) -> None:
         """Build (and AOT-compile, via the usual cost attribution) one
         bucket program outside any dispatch — argument shapes/dtypes
         mirror ``_dispatch`` exactly (including the fleet strategy's
-        mesh-fingerprinted key and the resolved precond suffix), so the
-        first real dispatch of this bucket is a plan-cache hit into a
-        warm executable."""
+        mesh-fingerprinted key, the resolved precond suffix and the
+        dtype-policy suffix), so the first real dispatch of this bucket
+        is a plan-cache hit into a warm executable."""
         dt = np.dtype(dt)
         if plan is None:
             plan = fleet_mod.FleetPlan("single")
         key = (
             f"batch.{solver}.B{bkt}.{dt.str}{plan.key_suffix}"
             f"{precond_mod.key_suffix(precond)}"
+            f"{mixed_mod.key_suffix(dtype_policy)}"
         )
         n = pattern.shape[0]
         # the same conversion pipeline as a real dispatch (np stacks ->
@@ -967,13 +1062,16 @@ class SolveSession:
         def build():
             tb = time.perf_counter()
             fn = self._build_program(pattern, bkt, dt, solver=solver,
-                                     plan=plan, precond=precond)
+                                     plan=plan, precond=precond,
+                                     dtype_policy=dtype_policy)
             prog, _info = _cost.attribute(
                 key, fn, args, pack_s=time.perf_counter() - tb,
                 solver=solver, bucket=bkt, dtype=dt.str,
                 n=n, nnz=pattern.nnz, warm_start=True,
                 **({"precond": precond}
                    if precond != precond_mod.NONE else {}),
+                **({"dtype_policy": dtype_policy}
+                   if dtype_policy != mixed_mod.EXACT else {}),
             )
             return prog
 
@@ -1069,24 +1167,27 @@ class SolveSession:
                 )
             for r in expired:
                 self._finalize_ticket(r.ticket)
-            # one group per (result dtype, precond override) so stacked
-            # values are homogeneous and every lane of a bucket shares
-            # one preconditioner choice
+            # one group per (result dtype, precond override, dtype-policy
+            # override) so stacked values are homogeneous and every lane
+            # of a bucket shares one preconditioner + precision choice
             by_dt: dict = {}
             for r in live:
                 dt = np.result_type(r.values.dtype, r.b.dtype)
                 by_dt.setdefault(
-                    (np.dtype(dt), r.precond or ""), []
+                    (np.dtype(dt), r.precond or "", r.dtype_policy or ""),
+                    [],
                 ).append(r)
-            for (dt, pov), reqs in sorted(
-                by_dt.items(), key=lambda kv: (kv[0][0].str, kv[0][1])
+            for (dt, pov, dpov), reqs in sorted(
+                by_dt.items(),
+                key=lambda kv: (kv[0][0].str, kv[0][1], kv[0][2]),
             ):
                 for lo in range(0, len(reqs), self.batch_max):
                     chunk = reqs[lo:lo + self.batch_max]
                     err = None
                     for _attempt in range(self.dispatch_attempts):
                         try:
-                            self._dispatch(chunk, dt, precond=pov or None)
+                            self._dispatch(chunk, dt, precond=pov or None,
+                                           dtype_policy=dpov or None)
                             dispatched += 1
                             err = None
                             break
@@ -1182,6 +1283,10 @@ class SolveSession:
         labels = {"solver": solver}
         if t.tenant is not None:
             labels["tenant"] = t.tenant
+        if t.dtype_policy is not None:
+            # reduced-precision lanes only (ISSUE 15): the default
+            # 'exact' path keeps the pre-existing series names
+            labels["dtype_policy"] = t.dtype_policy
         _metrics.histogram(
             "batch.ticket_latency", help=_TICKET_LATENCY_HELP,
             **labels,
@@ -1202,6 +1307,9 @@ class SolveSession:
             }
             if t.tenant is not None:
                 fields["tenant"] = t.tenant
+            if t.dtype_policy is not None:
+                fields["dtype_policy"] = t.dtype_policy
+                fields["promoted"] = t.promoted
             if t.phase_ms:
                 fields["phases"] = {
                     k: round(v, 3) for k, v in t.phase_ms.items()
@@ -1218,7 +1326,7 @@ class SolveSession:
             telemetry.record("batch.ticket", **fields)
 
     def _fleet_account(self, plan, solver, dt, nb, bkt, iters,
-                       solve_s) -> None:
+                       solve_s, policy=mixed_mod.EXACT) -> None:
         """Post-dispatch fleet accounting (ISSUE 10): per-device lane
         occupancy (session stats + always-on gauges), the batch-sharded
         program's measured-collective commit (the per-iteration
@@ -1245,7 +1353,14 @@ class SolveSession:
             return
         led = None
         execs = 0
-        if plan.strategy == "batch" and solver != "gmres":
+        if (
+            plan.strategy == "batch" and solver != "gmres"
+            and policy == mixed_mod.EXACT
+        ):
+            # reduced-precision programs run psums in BOTH loop levels
+            # (outer sweeps + inner sweeps), so the iters-based execution
+            # count below would under-account them — their ledgers stay
+            # uncommitted rather than committing wrong bytes
             # the while-condition psum ran (global iterations + 1)
             # times; global iterations == the slowest lane's freeze
             # step (pad lanes freeze at the first test point, so the
@@ -1276,7 +1391,8 @@ class SolveSession:
 
     def _dispatch(self, reqs, dt, solver: str | None = None,
                   allow_requeue: bool = True,
-                  precond: str | None = None) -> None:
+                  precond: str | None = None,
+                  dtype_policy: str | None = None) -> None:
         """Enqueue one bucket through the streaming pipeline: launch
         (pack -> upload -> async program call) under the lanes' ticket
         scope, admit the dispatch to the bounded in-flight window, and
@@ -1287,7 +1403,8 @@ class SolveSession:
         # fault.injected, plan_cache.compile — carries the lanes' ticket
         # ids (replace semantics: a requeue re-enters with its own lanes)
         with telemetry.ticket_scope(*(r.ticket.id for r in reqs)):
-            fl = self._launch(reqs, dt, solver, allow_requeue, precond)
+            fl = self._launch(reqs, dt, solver, allow_requeue, precond,
+                              dtype_policy)
         if fl is None:
             return  # degraded at launch; lanes already resolved
         self._inflight.append(fl)
@@ -1302,7 +1419,8 @@ class SolveSession:
             self._retire(self._inflight.popleft())
 
     def _launch(self, reqs, dt, solver: str | None,
-                allow_requeue: bool, precond: str | None = None):
+                allow_requeue: bool, precond: str | None = None,
+                dtype_policy: str | None = None):
         """The host half of a dispatch: pack the lane stacks, stage the
         upload (``bucket.stage_lanes`` — pad + eager ``device_put``),
         resolve the bucket program (waiting for an in-progress warm
@@ -1361,12 +1479,28 @@ class SolveSession:
         mkind = self.precond.decide(
             pattern, solver, bkt, dt, override=precond
         )
+        # the resolved dtype policy (ISSUE 15): override > session >
+        # env, with the promote rung's pinned groups forcing 'exact'.
+        # Row-sharded plans always solve exact — dist_cg has no fused
+        # IR form (breadcrumbed like any other policy degradation).
+        pol = self.dtype_policy.decide(
+            pattern, solver, bkt, dt, override=dtype_policy
+        )
+        if pol != mixed_mod.EXACT and plan.strategy == "row":
+            mixed_mod.DtypePolicy._fallback(pol, "row-sharded plan")
+            pol = mixed_mod.EXACT
+        if pol != mixed_mod.EXACT:
+            # stamp the reduced policy on the lanes (sticky across a
+            # later promote_dtype requeue, so the terminal event still
+            # records that the ticket rode the mixed path)
+            for r in reqs:
+                r.ticket.dtype_policy = pol
         faulty = _faults.ACTIVE and (
             _faults.targets("matvec") or _faults.targets("precond")
         )
         key = (
             f"batch.{solver}.B{bkt}.{np.dtype(dt).str}{plan.key_suffix}"
-            f"{precond_mod.key_suffix(mkind)}"
+            f"{precond_mod.key_suffix(mkind)}{mixed_mod.key_suffix(pol)}"
         )
         if faulty:
             # fault-wrapped programs carry the injection callback in
@@ -1384,7 +1518,7 @@ class SolveSession:
             tb = time.perf_counter()
             fn = self._build_program(pattern, bkt, np.dtype(dt),
                                      solver=solver, plan=plan,
-                                     precond=mkind)
+                                     precond=mkind, dtype_policy=pol)
             prog, info = _cost.attribute(
                 key, fn, args,
                 pack_s=time.perf_counter() - tb,
@@ -1392,6 +1526,8 @@ class SolveSession:
                 n=pattern.shape[0], nnz=pattern.nnz,
                 **({"precond": mkind}
                    if mkind != precond_mod.NONE else {}),
+                **({"dtype_policy": pol}
+                   if pol != mixed_mod.EXACT else {}),
             )
             built.update(info)
             return prog
@@ -1428,6 +1564,8 @@ class SolveSession:
                         strategy=(plan.strategy if plan.sharded else None),
                         precond=(mkind if mkind != precond_mod.NONE
                                  else None),
+                        dtype_policy=(pol if pol != mixed_mod.EXACT
+                                      else None),
                     )
             # sampled timed dispatch (ISSUE 12): every Nth dispatch
             # takes ONE extra timestamp at the dispatch-return boundary
@@ -1457,6 +1595,7 @@ class SolveSession:
         return _InFlight(
             reqs, dt, solver, allow_requeue, plan, key, bkt, nb, out,
             built, snap, t0, t_packed, t_solve0, t_dispatched, sampled,
+            policy=pol,
         )
 
     def _degrade(self, reqs, dt, solver, nb, e) -> None:
@@ -1506,7 +1645,14 @@ class SolveSession:
             except Exception:
                 pass  # non-jax leaves (ints) — np.asarray blocks below
             t_solved = time.monotonic()
-            X, iters, resid2, conv = fl.out
+            # IR bucket programs (ISSUE 15) return a 5th output: the
+            # shared refinement-sweep count
+            if len(fl.out) == 5:
+                X, iters, resid2, conv, ir_outer = fl.out
+                ir_outer = int(np.asarray(ir_outer))
+            else:
+                X, iters, resid2, conv = fl.out
+                ir_outer = None
             X = np.asarray(X)
             iters = np.asarray(iters)
             resid2 = np.asarray(resid2)
@@ -1515,6 +1661,12 @@ class SolveSession:
             self._degrade(reqs, dt, solver, nb, e)
             return
         fl.out = None  # release device buffers promptly
+        if ir_outer is not None:
+            _metrics.counter(
+                "mixed.ir_outer_iters",
+                help="iterative-refinement outer sweeps across all IR "
+                "solves",
+            ).inc(ir_outer)
         t_read = time.monotonic()
         profile_ms = None
         if fl.sampled:
@@ -1524,13 +1676,16 @@ class SolveSession:
             )
             _profiler.record_device_sample(key, *profile_ms)
         requeue_lanes = []
+        promote_lanes = []
+        promote_nonfinite = False
         stale_lanes = []
         for i, r in enumerate(reqs):
             r.ticket._offer(X[i], iters[i], resid2[i], conv[i],
                             solver=solver)
-            if (
-                fl.allow_requeue and self.requeue and not r.ticket.requeued
-                and (not conv[i] or not np.isfinite(resid2[i]))
+            failed = not conv[i] or not np.isfinite(resid2[i])
+            if fl.allow_requeue and self.requeue and failed and (
+                not r.ticket.requeued
+                or (fl.policy != mixed_mod.EXACT and not r.ticket.promoted)
             ):
                 # deadline re-check at readback (ISSUE 13): the lane
                 # failed AND its budget lapsed while the bucket was in
@@ -1541,8 +1696,19 @@ class SolveSession:
                 ) <= 0:
                     stale_lanes.append(r)
                     continue
-                r.ticket.requeued = True
-                requeue_lanes.append(r)
+                if fl.policy != mixed_mod.EXACT and not r.ticket.promoted:
+                    # the promote_dtype rung (ISSUE 15): an anomalous
+                    # reduced-precision lane re-solves at 'exact' FIRST
+                    # — same solver, one rung AHEAD of the classic
+                    # solver-escalation requeue (which stays available
+                    # if the exact re-solve fails too)
+                    r.ticket.promoted = True
+                    if not np.isfinite(resid2[i]):
+                        promote_nonfinite = True
+                    promote_lanes.append(r)
+                else:
+                    r.ticket.requeued = True
+                    requeue_lanes.append(r)
         if stale_lanes:
             _STALE_REQUEUES.inc(len(stale_lanes))
             if telemetry.enabled():
@@ -1559,7 +1725,7 @@ class SolveSession:
         _PAD_WASTE.inc(bkt - nb)
         self._fleet_account(
             plan, solver, dt, nb, bkt, iters,
-            max(t_solved - fl.t_solve0, 0.0),
+            max(t_solved - fl.t_solve0, 0.0), policy=fl.policy,
         )
         if telemetry.enabled():
             # bucket-level phase wall clocks, accumulated onto each
@@ -1619,17 +1785,70 @@ class SolveSession:
                 **({"host_ms": round(profile_ms[0], 3),
                     "device_ms": round(profile_ms[1], 3)}
                    if profile_ms is not None else {}),
+                # reduced-precision dispatches only (ISSUE 15): the
+                # default 'exact' path keeps the event byte-identical
+                **({"dtype_policy": fl.policy,
+                    "ir_outer": ir_outer}
+                   if fl.policy != mixed_mod.EXACT else {}),
+            )
+        if promote_lanes:
+            self._promote_requeue(
+                promote_lanes, fl,
+                reason="nonfinite" if promote_nonfinite else "unconverged",
             )
         if requeue_lanes:
             self._requeue(requeue_lanes, dt)
         for r in reqs:
-            if r in requeue_lanes and self._find_inflight(
-                r.ticket
-            ) is not None:
+            if (r in requeue_lanes or r in promote_lanes) and (
+                self._find_inflight(r.ticket) is not None
+            ):
                 continue  # finalizes when the fallback bucket retires
             self._finalize_ticket(r.ticket)
 
     # -- resilience paths --------------------------------------------------
+    def _promote_requeue(self, reqs, fl, reason: str) -> None:
+        """The promote_dtype rung (ISSUE 15, docs/resilience.md): an
+        anomalous reduced-precision bucket re-solves its failed lanes
+        at ``'exact'`` — same solver, same preconditioner — and the
+        whole (pattern, solver, bucket, dtype) group is pinned to
+        'exact' for the rest of the session (the health-monitor
+        escalation riding the existing requeue machinery). The classic
+        solver-escalation rung stays available BEHIND it: an exact
+        re-solve that still fails takes the gmres-at-promoted-dtype
+        fallback like any other lane."""
+        pattern = reqs[0].pattern
+        self.dtype_policy.promote(
+            pattern, fl.solver, fl.bkt, fl.dt, reason=reason
+        )
+        _REQUEUES.inc(len(reqs))
+        if telemetry.enabled():
+            telemetry.record(
+                "mixed.promote", reason=reason, lanes=len(reqs),
+                solver=fl.solver, bucket=fl.bkt, from_policy=fl.policy,
+                program=fl.key, tickets=[r.ticket.id for r in reqs],
+            )
+            telemetry.record(
+                "batch.requeue", solver=fl.solver, lanes=len(reqs),
+                from_solver=fl.solver, action="promote_dtype",
+                dtype=np.dtype(fl.dt).str,
+                tickets=[r.ticket.id for r in reqs],
+            )
+        fb = [
+            _Request(r.pattern, r.values, r.b, r.tol, None, None,
+                     r.ticket, precond=r.precond,
+                     dtype_policy=mixed_mod.EXACT)
+            for r in reqs
+        ]
+        try:
+            self._dispatch(fb, fl.dt, solver=fl.solver,
+                           allow_requeue=fl.allow_requeue,
+                           precond=reqs[0].precond,
+                           dtype_policy=mixed_mod.EXACT)
+        except Exception:  # noqa: BLE001 - first results already stand
+            # best-effort like the classic rung: every lane already
+            # holds its first (unconverged) result
+            pass
+
     def _requeue(self, reqs, dt) -> None:
         """Failed-lane requeue: one fallback bucket under the safer
         solver/dtype; the fallback result only replaces a lane's first
@@ -1723,7 +1942,8 @@ class SolveSession:
 
     def _build_program(self, pattern: SparsityPattern, bkt: int, dt,
                        solver: str | None = None, plan=None,
-                       precond: str = precond_mod.NONE):
+                       precond: str = precond_mod.NONE,
+                       dtype_policy: str = mixed_mod.EXACT):
         """The per-bucket compiled program: pattern pack + masked solver
         loop under ONE ``jax.jit`` whose arguments are the value stack,
         rhs, x0 and tolerances — so same-bucket dispatches with fresh
@@ -1742,7 +1962,17 @@ class SolveSession:
         vault-persisted), the numeric factorization compiles INTO the
         program from its ``values`` argument, so every dispatch
         factorizes fresh coefficients on device. 'none' leaves the
-        program byte-identical to the historic unpreconditioned one."""
+        program byte-identical to the historic unpreconditioned one.
+
+        ``dtype_policy`` is the resolved precision policy (ISSUE 15):
+        a reduced policy ('f32ir' | 'bf16ir') swaps the solver loop for
+        the fused iterative-refinement program — values downcast to the
+        storage dtype INSIDE the program (one elementwise op; the inner
+        sweep's packed planes and vectors then carry the narrow dtype
+        with wide accumulation), the f64 outer loop verifies and
+        corrects, and the program returns a 5th output (the refinement
+        sweep count). 'exact' leaves every program byte-identical to
+        the historic one."""
         solver = solver or self.solver
         if plan is not None and plan.strategy == "row":
             return fleet_mod.build_row_program(
@@ -1754,6 +1984,14 @@ class SolveSession:
             None if precond == precond_mod.NONE
             else self.precond.factory(pattern, precond)
         )
+        mixed = None
+        if dtype_policy != mixed_mod.EXACT:
+            mixed = dict(
+                policy=dtype_policy,
+                **self.dtype_policy.ir_knobs(
+                    dtype_policy, pattern.shape[0], self.conv_test_iters
+                ),
+            )
         if plan is not None and plan.strategy == "batch":
             return fleet_mod.build_batch_program(
                 pattern, bkt, dt, solver, plan.mesh,
@@ -1764,6 +2002,7 @@ class SolveSession:
                     if solver == "gmres" else None
                 ),
                 m_factory=mfac,
+                mixed=mixed,
             )
         if solver == "gmres":
             return self._build_gmres_program(pattern, bkt, dt,
@@ -1772,6 +2011,10 @@ class SolveSession:
         idx_slabs, pos, zero_rows = (
             pack.idx_slabs, pack.pos, pack.plan.zero_rows
         )
+        if mixed is not None:
+            return _build_ir_program(
+                pack, mixed, solver, self.conv_test_iters, mfac
+            )
         loop = (
             krylov._cg_loop if solver == "cg"
             else krylov._bicgstab_loop

@@ -74,6 +74,18 @@ Flags (all env-overridable):
   SPARSE_TPU_PRECOND_TRI_SWEEPS - Jacobi-Richardson sweeps of the batched
                                 triangular apply (default 4).
   SPARSE_TPU_PRECOND_DEGREE   - polynomial preconditioner degree (default 4).
+  SPARSE_TPU_DTYPE            - mixed-precision serving policy (sparse_tpu.mixed):
+                                '' / 'exact' (default) = solve at the request dtype
+                                (historic keys/jaxprs byte-identical); 'auto' = f32
+                                Krylov + f64 iterative refinement for f64 cg/bicgstab
+                                buckets; or force 'f32ir' | 'bf16ir' (bf16 value
+                                storage, f32 accumulation, f64 refinement).
+  SPARSE_TPU_IR_INNER         - inner Krylov iterations per refinement sweep
+                                (default 0 = auto: max(8 * conv_test_iters, 200)).
+  SPARSE_TPU_IR_OUTER         - max f64 refinement sweeps per solve (default 25;
+                                a static while_loop bound, so one compiled program).
+  SPARSE_TPU_IR_ETA           - inner residual-reduction target per sweep
+                                (default 0 = per-policy: 1e-4 f32ir, 1e-2 bf16ir).
 """
 
 from __future__ import annotations
@@ -96,6 +108,13 @@ def _env_str(name: str, default: str) -> str:
 def _env_int(name: str, default: int) -> int:
     try:
         return int(os.environ.get(name, default))
+    except ValueError:
+        return default
+
+
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, default))
     except ValueError:
         return default
 
@@ -305,6 +324,29 @@ class Settings:
     # Degree of the polynomial (Chebyshev/Neumann) preconditioners.
     precond_degree: int = field(
         default_factory=lambda: max(_env_int("SPARSE_TPU_PRECOND_DEGREE", 4), 1)
+    )
+    # Mixed-precision serving policy (sparse_tpu.mixed, ISSUE 15):
+    # '' / 'exact' = solve at the request dtype (the historic path,
+    # program keys and jaxprs unchanged); 'auto' = f32 Krylov + f64
+    # iterative refinement for f64 cg/bicgstab buckets; or force one
+    # reduced policy: 'f32ir' | 'bf16ir'. Per-session
+    # (SolveSession(dtype_policy=)) and per-ticket
+    # (submit(dtype_policy=)) overrides win over the env.
+    dtype_policy: str = field(
+        default_factory=lambda: _env_str("SPARSE_TPU_DTYPE", "")
+    )
+    # IR loop geometry (mixed/ir.py): inner Krylov iterations per
+    # refinement sweep (0 = auto from conv_test_iters), the static
+    # max refinement sweeps, and the per-sweep inner residual-reduction
+    # target eta (0 = per-policy default).
+    ir_inner: int = field(
+        default_factory=lambda: max(_env_int("SPARSE_TPU_IR_INNER", 0), 0)
+    )
+    ir_outer: int = field(
+        default_factory=lambda: max(_env_int("SPARSE_TPU_IR_OUTER", 25), 1)
+    )
+    ir_eta: float = field(
+        default_factory=lambda: max(_env_float("SPARSE_TPU_IR_ETA", 0.0), 0.0)
     )
 
 

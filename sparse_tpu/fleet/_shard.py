@@ -76,7 +76,7 @@ def shard_inputs(mesh, *arrays):
 
 def build_batch_program(pattern, bkt: int, dt, solver: str, mesh,
                         conv_test_iters: int, gmres_inner=None,
-                        m_factory=None):
+                        m_factory=None, mixed=None):
     """The mesh-sharded analog of ``SolveSession._build_program``: one
     compiled program whose arguments are the bucket's ``(B, nnz)`` value
     stack, ``(B, n)`` rhs/x0, per-lane tolerances and maxiter, with the
@@ -97,6 +97,15 @@ def build_batch_program(pattern, bkt: int, dt, solver: str, mesh,
     per-lane), so it adds ZERO collectives to the sharded program and
     per-lane iterates stay bit-identical to the single-device
     preconditioned program.
+
+    ``mixed`` is the resolved reduced-precision policy's knob dict
+    (ISSUE 15, ``{'policy', 'inner_iters', 'max_outer', 'eta'}``):
+    when given, the shard_map body runs the fused iterative-refinement
+    loop instead — each device downcasts its LOCAL value shard, the
+    all-converged psum exit threads through BOTH the f64 outer loop and
+    the reduced inner sweeps (every shard runs the same global sweep
+    schedule, frozen lanes bit-stable), and the program returns the
+    refinement sweep count as a 5th (replicated) output.
     """
     from ..batch import krylov
 
@@ -137,27 +146,69 @@ def build_batch_program(pattern, bkt: int, dt, solver: str, mesh,
         )
         return n_active > 0
 
-    def body(values, rhs, x0, tols, maxiter):
-        vals = pack.pack_values(values)
+    if mixed is not None:
+        from .. import mixed as mixed_mod
 
-        def mv(X):
-            return spmv_ops.csr_spmv_sell_batched(
-                idx_slabs, vals, pos, X, zero_rows
+        storage_dt, compute_dt = mixed_mod.inner_dtypes(mixed["policy"])
+        sdt = jnp.dtype(storage_dt)
+        cdt = jnp.dtype(compute_dt)
+        wdt = jnp.dtype(mixed_mod.outer_dtype())
+        inner_iters = int(mixed["inner_iters"])
+        max_outer = int(mixed["max_outer"])
+        eta = float(mixed["eta"])
+
+        def body(values, rhs, x0, tols, maxiter):
+            req_dt = values.dtype
+            vals_w = pack.pack_values(values.astype(wdt))
+            vals_l = pack.pack_values(values.astype(sdt))
+
+            def mv_wide(X):
+                return spmv_ops.csr_spmv_sell_batched(
+                    idx_slabs, vals_w, pos, X, zero_rows
+                )
+
+            def mv_low(X):
+                return spmv_ops.csr_spmv_sell_batched(
+                    idx_slabs, vals_l, pos, X, zero_rows, acc_dtype=cdt
+                )
+
+            fmv_low = krylov._maybe_faulty_mv(mv_low)
+            Mvec = (
+                None if m_factory is None
+                else m_factory(values.astype(cdt), fmv_low)
+            )
+            X, iters, resid2, conv, outer = mixed_mod.ir_loop(
+                mv_wide, fmv_low, rhs, x0, tols, maxiter, cti,
+                inner_iters, max_outer, eta, cdt, Mvec=Mvec,
+                solver=solver, lane_reduce=lane_reduce,
+            )
+            return X.astype(req_dt), iters, resid2, conv, outer
+
+        out_specs = (P(axis), P(axis), P(axis), P(axis), P())
+    else:
+        def body(values, rhs, x0, tols, maxiter):
+            vals = pack.pack_values(values)
+
+            def mv(X):
+                return spmv_ops.csr_spmv_sell_batched(
+                    idx_slabs, vals, pos, X, zero_rows
+                )
+
+            fmv = krylov._maybe_faulty_mv(mv)
+            # lane-local numeric factorization from this shard's value
+            # stack; the factory's maps ride in as replicated constants
+            Mvec = None if m_factory is None else m_factory(values, fmv)
+            return loop(
+                fmv, rhs, x0, tols, maxiter, cti, Mvec=Mvec,
+                lane_reduce=lane_reduce,
             )
 
-        fmv = krylov._maybe_faulty_mv(mv)
-        # lane-local numeric factorization from this shard's value
-        # stack; the factory's maps ride in as replicated constants
-        Mvec = None if m_factory is None else m_factory(values, fmv)
-        return loop(
-            fmv, rhs, x0, tols, maxiter, cti, Mvec=Mvec,
-            lane_reduce=lane_reduce,
-        )
+        out_specs = (P(axis), P(axis), P(axis), P(axis))
 
     sharded = shard_map(
         body, mesh=mesh,
         in_specs=(P(axis), P(axis), P(axis), P(axis), P()),
-        out_specs=(P(axis), P(axis), P(axis), P(axis)),
+        out_specs=out_specs,
         check_vma=False,
     )
 

@@ -157,7 +157,13 @@ def _kernel_a(offsets: tuple, TM: int, B: int, win: int, D: int, m_pad: int):
             mid = pw[B : B + TM]
             pnew_ref[:] = mid
             q_ref[:] = acc
-            pq_ref[0, 0] += jnp.sum(mid * acc)
+            # the <p, q> partial reduces at pq_ref's dtype — with the
+            # acc_dtype split (ISSUE 15) the recurrence scalars stay
+            # wide even when the vector planes are narrow; a no-op
+            # convert when the dtypes match
+            pq_ref[0, 0] += jnp.sum(
+                mid.astype(pq_ref.dtype) * acc.astype(pq_ref.dtype)
+            )
 
         def halo():
             pnew_ref[:] = jnp.zeros((TM,), pnew_ref.dtype)
@@ -194,7 +200,9 @@ def _kernel_b():
         r_new = r_ref[:] - alpha * q_ref[:]
         xo_ref[:] = x_ref[:] + alpha * p_ref[:]
         ro_ref[:] = r_new
-        rr_ref[0, 0] += jnp.sum(r_new * r_new)
+        # <r, r> reduces at rr_ref's dtype (the acc_dtype split)
+        rr = r_new.astype(rr_ref.dtype)
+        rr_ref[0, 0] += jnp.sum(rr * rr)
 
     return kernel
 
@@ -293,8 +301,11 @@ def _kernel_cgcg(offsets: tuple, TM: int, B: int, win: int, D: int, m_pad: int):
             po_ref[:] = p_new
             so_ref[:] = s_new[B : B + TM]
             wo_ref[:] = acc
-            dots_ref[0, 0] += jnp.sum(r_mid * r_mid)
-            dots_ref[0, 1] += jnp.sum(acc * r_mid)
+            # both recurrence dot partials reduce at dots_ref's dtype
+            # (the acc_dtype split — ISSUE 15)
+            r_wide = r_mid.astype(dots_ref.dtype)
+            dots_ref[0, 0] += jnp.sum(r_wide * r_wide)
+            dots_ref[0, 1] += jnp.sum(acc.astype(dots_ref.dtype) * r_wide)
 
         def halo():
             z = jnp.zeros((TM,), xo_ref.dtype)
@@ -323,11 +334,12 @@ def _kernel_cgcg(offsets: tuple, TM: int, B: int, win: int, D: int, m_pad: int):
 
 @partial(
     jax.jit,
-    static_argnames=("offsets", "m", "iters", "tile", "plane_dtype", "interpret"),
+    static_argnames=("offsets", "m", "iters", "tile", "plane_dtype",
+                     "interpret", "acc_dtype"),
 )
 def cg_dia_fused_onepass(
     data, offsets: tuple, b, x0, m: int, iters: int = 300, tile: int = 16384,
-    plane_dtype=None, interpret: bool = False
+    plane_dtype=None, interpret: bool = False, acc_dtype=None
 ):
     """``iters`` Chronopoulos-Gear CG iterations — ONE fused pass each.
 
@@ -340,9 +352,16 @@ def cg_dia_fused_onepass(
     Slightly weaker numerically than two-pass CG (classic s-step result);
     the bench checks residual parity before preferring it.
 
+    ``acc_dtype`` splits the recurrence scalars from the vector dtype
+    (ISSUE 15): the rho/mu dot partials reduce — and the beta/alpha
+    recurrence runs — at ``acc_dtype`` while vectors and plane streams
+    stay at ``dt``/``plane_dtype``. ``None`` = historic single-dtype
+    behavior, byte-identical.
+
     Returns (x, r, rho).
     """
     dt = jnp.result_type(data.dtype, b.dtype)
+    adt = jnp.dtype(acc_dtype) if acc_dtype is not None else dt
     TM, B, G = _plan(m, offsets, tile=tile)
     win = TM + 2 * B
     m_pad = G * TM
@@ -363,7 +382,7 @@ def cg_dia_fused_onepass(
         ]
         + [pl.BlockSpec((1, 2), lambda gg: (0, 0), memory_space=pltpu.SMEM)],
         out_shape=[jax.ShapeDtypeStruct((L,), dt) for _ in range(5)]
-        + [jax.ShapeDtypeStruct((1, 2), dt)],
+        + [jax.ShapeDtypeStruct((1, 2), adt)],
         scratch_shapes=(
             [
                 pltpu.VMEM((win,), dt),
@@ -402,28 +421,30 @@ def cg_dia_fused_onepass(
     rp0 = _pad_vec(r0, TM, G)
     w0 = dia_spmv_xla(data.astype(dt), offsets, r0, (m, m))
     wp0 = _pad_vec(w0, TM, G)
-    rho0 = jnp.vdot(r0, r0).real.astype(dt)
-    mu0 = jnp.vdot(w0, r0).real.astype(dt)
+    rho0 = jnp.vdot(r0, r0).real.astype(adt)
+    mu0 = jnp.vdot(w0, r0).real.astype(adt)
     z = jnp.zeros((L,), dt)
 
     def body(j, state):
         xp, rp, pp, sp, wp, rho, mu, rho_prev, alpha_prev = state
         # Converged-state guards: once rho hits exact zero every later
         # alpha/beta must collapse to 0 (not NaN) so the frozen x survives
-        # the remaining fixed iterations.
-        beta = jnp.where(rho_prev == 0, 0.0, rho / jnp.where(rho_prev == 0, 1, rho_prev)).astype(dt)
+        # the remaining fixed iterations. The scalar recurrence runs at
+        # adt (the acc_dtype split); only the SMEM kernel inputs cast
+        # down to the vector dtype.
+        beta = jnp.where(rho_prev == 0, 0.0, rho / jnp.where(rho_prev == 0, 1, rho_prev)).astype(adt)
         ratio = jnp.where(alpha_prev == 0, 0.0, beta / jnp.where(alpha_prev == 0, 1, alpha_prev))
         denom = mu - ratio * rho
-        alpha = jnp.where(denom == 0, 0.0, rho / jnp.where(denom == 0, 1, denom)).astype(dt)
-        ab = jnp.stack([beta, alpha]).reshape(1, 2)
+        alpha = jnp.where(denom == 0, 0.0, rho / jnp.where(denom == 0, 1, denom)).astype(adt)
+        ab = jnp.stack([beta.astype(dt), alpha.astype(dt)]).reshape(1, 2)
         xp2, rp2, pp2, sp2, wp2, dots = kern(ab, rp, wp, sp, pp, xp, planes_row)
-        alpha_next = jnp.where(alpha == 0, 1.0, alpha).astype(dt)
+        alpha_next = jnp.where(alpha == 0, 1.0, alpha).astype(adt)
         return (
             xp2, rp2, pp2, sp2, wp2,
             dots[0, 0], dots[0, 1], rho, alpha_next,
         )
 
-    state = (xp, rp0, z, z, wp0, rho0, mu0, jnp.zeros((), dt), jnp.ones((), dt))
+    state = (xp, rp0, z, z, wp0, rho0, mu0, jnp.zeros((), adt), jnp.ones((), adt))
     xp, rp, _, _, _, rho, _, _, _ = jax.lax.fori_loop(0, iters, body, state)
     return _unpad_vec(xp, m, TM), _unpad_vec(rp, m, TM), rho
 
@@ -432,13 +453,13 @@ def cg_dia_fused_onepass(
     jax.jit,
     static_argnames=(
         "offsets", "m", "iters", "tile", "plane_dtype", "interpret",
-        "return_state",
+        "return_state", "acc_dtype",
     ),
 )
 def cg_dia_fused(
     data, offsets: tuple, b, x0, m: int, iters: int = 300, tile: int = 16384,
     plane_dtype=None, interpret: bool = False, state=None,
-    return_state: bool = False,
+    return_state: bool = False, acc_dtype=None,
 ):
     """``iters`` fixed CG iterations on the DIA matrix (throughput mode).
 
@@ -452,8 +473,16 @@ def cg_dia_fused(
     (``linalg.cg``'s fused fast path) can run in conv-test-sized chunks
     with one host rho fetch per chunk — identical iterates to one long
     run, no CG restart between chunks.
+
+    ``acc_dtype`` is the recurrence-scalar split (ISSUE 15): the
+    <p, q> / <r, r> dot partials reduce — and rho/beta/alpha carry —
+    at ``acc_dtype`` while vectors stream at ``dt`` (and planes at
+    ``plane_dtype``). ``None`` = historic single-dtype behavior,
+    byte-identical; callers threading ``state`` must keep the same
+    ``acc_dtype`` across chunks (the rho entries carry it).
     """
     dt = jnp.result_type(data.dtype, b.dtype)
+    adt = jnp.dtype(acc_dtype) if acc_dtype is not None else dt
     TM, B, G = _plan(m, offsets, tile=tile)
     win = TM + 2 * B
     m_pad = G * TM
@@ -486,7 +515,7 @@ def cg_dia_fused(
         out_shape=[
             jax.ShapeDtypeStruct((L,), dt),
             jax.ShapeDtypeStruct((L,), dt),
-            jax.ShapeDtypeStruct((1, 1), dt),
+            jax.ShapeDtypeStruct((1, 1), adt),
         ],
         scratch_shapes=(
             [
@@ -522,7 +551,7 @@ def cg_dia_fused(
         out_shape=[
             jax.ShapeDtypeStruct((L,), dt),
             jax.ShapeDtypeStruct((L,), dt),
-            jax.ShapeDtypeStruct((1, 1), dt),
+            jax.ShapeDtypeStruct((1, 1), adt),
         ],
         interpret=interpret,
     )
@@ -537,14 +566,16 @@ def cg_dia_fused(
                 data.astype(dt), offsets, x0.astype(dt), (m, m)
             )
             rp0 = _pad_vec(r0, TM, G)
-        rho0 = jnp.vdot(rp0, rp0).real.astype(dt)
+        rho0 = jnp.vdot(rp0, rp0).real.astype(adt)
         pp0 = jnp.zeros_like(bp)
-        state = (xp, rp0, pp0, jnp.zeros((), dt), rho0)
+        state = (xp, rp0, pp0, jnp.zeros((), adt), rho0)
 
     def body(_, state):
         xp, rp, pp, rho_prev, rho = state
-        beta = jnp.where(rho_prev == 0, 0.0, rho / jnp.where(rho_prev == 0, 1, rho_prev)).astype(dt)
-        pnew, q, pq = kA(beta.reshape(1, 1), rp, pp, planes_row)
+        # the scalar recurrence runs at adt (the acc_dtype split); only
+        # the SMEM kernel inputs cast down to the vector dtype
+        beta = jnp.where(rho_prev == 0, 0.0, rho / jnp.where(rho_prev == 0, 1, rho_prev)).astype(adt)
+        pnew, q, pq = kA(beta.astype(dt).reshape(1, 1), rp, pp, planes_row)
         alpha = rho / jnp.where(pq[0, 0] == 0, 1, pq[0, 0])
         xp2, rp2, rr = kB(alpha.reshape(1, 1).astype(dt), xp, pnew, rp, q)
         return xp2, rp2, pnew, rho, rr[0, 0]

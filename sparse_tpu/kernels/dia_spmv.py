@@ -124,18 +124,26 @@ def dia_pad_x(x, plan: DiaPlan):
     return jax.lax.dynamic_update_slice(out, x[:ncap], (plan.B,))
 
 
-@partial(jax.jit, static_argnames=("plan", "interpret"))
-def dia_spmv_packed(planes_flat, x_padded, plan: DiaPlan, interpret: bool = False):
+@partial(jax.jit, static_argnames=("plan", "interpret", "acc_dtype"))
+def dia_spmv_packed(planes_flat, x_padded, plan: DiaPlan, interpret: bool = False,
+                    acc_dtype=None):
     """y = A @ x from the prepared layout; returns the [m_pad] padded y.
 
     ``planes_flat`` from :func:`dia_pack`, ``x_padded`` from
     :func:`dia_pad_x` — keep both resident across calls (solvers keep their
     vectors in padded coordinates and never repack).
+
+    The plane stream already supports reduced-width storage
+    (:func:`plane_stream_dtype` — bf16 planes halve matrix traffic and
+    widen at the accumulate); ``acc_dtype`` additionally pins the
+    accumulator/output dtype ABOVE the natural result type (ISSUE 15:
+    bf16 planes + bf16 x still reduce in f32). ``None`` = historic
+    result-type behavior, byte-identical.
     """
     TM, B, G, D = plan.TM, plan.B, plan.G, plan.D
     win = TM + 2 * B
     m_pad = G * TM
-    out_dt = jnp.result_type(planes_flat.dtype, x_padded.dtype)
+    out_dt = acc_dtype or jnp.result_type(planes_flat.dtype, x_padded.dtype)
     # direct callers may hand us 2-byte planes with a misaligned TM; the
     # pack-time guard in PreparedDia avoids this per-call cast on hot paths
     safe_dt = plane_stream_dtype(planes_flat.dtype, out_dt, TM)

@@ -205,15 +205,22 @@ def sell_pack(indptr, indices, data, shape, C=None, sigma=None, max_slabs=None,
 # ---------------------------------------------------------------------------
 
 
-@partial(jax.jit, static_argnames=("K", "TM", "interpret"))
-def _sell_slab_pallas(idx_t, val_t, x, K: int, TM: int, interpret: bool = False):
+@partial(jax.jit, static_argnames=("K", "TM", "interpret", "acc_dtype"))
+def _sell_slab_pallas(idx_t, val_t, x, K: int, TM: int, interpret: bool = False,
+                      acc_dtype=None):
     R = idx_t.shape[1]
-    out_dt = jnp.result_type(val_t.dtype, x.dtype)
+    out_dt = acc_dtype or jnp.result_type(val_t.dtype, x.dtype)
 
     def kernel(x_ref, idx_ref, val_ref, y_ref):
         acc = jnp.zeros((TM,), dtype=out_dt)
         for k in range(K):  # static per slab: plane loads unroll
-            acc = acc + val_ref[k, :] * x_ref[idx_ref[k, :]]
+            # value planes load at their storage width; the in-register
+            # convert widens the product to the accumulation dtype
+            # (a no-op when acc_dtype is None — ISSUE 15)
+            acc = acc + (
+                val_ref[k, :].astype(out_dt)
+                * x_ref[idx_ref[k, :]].astype(out_dt)
+            )
         y_ref[:] = acc
 
     return pl.pallas_call(
@@ -230,22 +237,28 @@ def _sell_slab_pallas(idx_t, val_t, x, K: int, TM: int, interpret: bool = False)
     )(x, idx_t, val_t)
 
 
-def sell_spmv_pallas(plan: SellPlan, slabs, pos, x, interpret=None):
+def sell_spmv_pallas(plan: SellPlan, slabs, pos, x, interpret=None,
+                     acc_dtype=None):
     """y = A @ x via the per-slab Pallas row-block kernel (+ XLA glue for
     the concat/pos-gather). ``interpret=None`` auto-selects interpret mode
     off-TPU like ``dia_spmv.py``. Raises when Mosaic cannot lower the
     in-VMEM gather — callers go through :class:`PreparedCSR`, which fails
-    over to the XLA formulation once and remembers."""
+    over to the XLA formulation once and remembers. ``acc_dtype`` is the
+    storage/accumulation split (ISSUE 15): narrow value planes, wide
+    in-register accumulation."""
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
-    out_dt = jnp.result_type(slabs[0][1].dtype if slabs else x.dtype, x.dtype)
+    out_dt = acc_dtype or jnp.result_type(
+        slabs[0][1].dtype if slabs else x.dtype, x.dtype
+    )
     parts = []
     for (idx_t, val_t), (K, R, _) in zip(slabs, plan.slab_meta):
         TM = ROW_ALIGN  # rows are ROW_ALIGN-padded, so this always divides
         while TM * 2 <= 1024 and R % (TM * 2) == 0:
             TM *= 2
         parts.append(
-            _sell_slab_pallas(idx_t, val_t, x, K, TM, interpret).astype(out_dt)
+            _sell_slab_pallas(idx_t, val_t, x, K, TM, interpret,
+                              acc_dtype=acc_dtype).astype(out_dt)
         )
     if plan.zero_rows:
         parts.append(jnp.zeros((plan.zero_rows,), dtype=out_dt))
@@ -255,21 +268,26 @@ def sell_spmv_pallas(plan: SellPlan, slabs, pos, x, interpret=None):
     return packed[pos]
 
 
-@partial(jax.jit, static_argnames=("K", "TM", "interpret"))
+@partial(jax.jit, static_argnames=("K", "TM", "interpret", "acc_dtype"))
 def _sell_slab_pallas_batched(idx_t, val_bt, X, K: int, TM: int,
-                              interpret: bool = False):
+                              interpret: bool = False, acc_dtype=None):
     """Batched form of :func:`_sell_slab_pallas`: the grid gains a leading
     batch dimension, the shared ``[K, R]`` index planes stay resident while
     value planes ``[B, K, R]`` and per-lane x vectors ``[B, n]`` stream one
     lane at a time — the whole same-pattern stack runs as one kernel launch
-    instead of B dispatches."""
+    instead of B dispatches. ``acc_dtype`` widens the per-plane products
+    in-register (ISSUE 15) while the value planes stream at storage
+    width."""
     B, _, R = val_bt.shape
-    out_dt = jnp.result_type(val_bt.dtype, X.dtype)
+    out_dt = acc_dtype or jnp.result_type(val_bt.dtype, X.dtype)
 
     def kernel(x_ref, idx_ref, val_ref, y_ref):
         acc = jnp.zeros((TM,), dtype=out_dt)
         for k in range(K):  # static per slab: plane loads unroll
-            acc = acc + val_ref[0, k, :] * x_ref[0, idx_ref[k, :]]
+            acc = acc + (
+                val_ref[0, k, :].astype(out_dt)
+                * x_ref[0, idx_ref[k, :]].astype(out_dt)
+            )
         y_ref[0, :] = acc
 
     return pl.pallas_call(
@@ -293,18 +311,19 @@ def _sell_slab_pallas_batched(idx_t, val_bt, X, K: int, TM: int,
 
 
 def sell_spmv_pallas_batched(plan: SellPlan, idx_slabs, val_slabs, pos, X,
-                             interpret=None):
+                             interpret=None, acc_dtype=None):
     """Y = A_b @ x_b per lane via the batch-grid Pallas row-block kernel.
 
     ``idx_slabs`` are the shared pattern index planes, ``val_slabs`` the
     stacked ``[B, K, R]`` value planes (``sparse_tpu.batch.operator`` packs
     them through the pattern's source maps), ``X`` is ``[B, n]``. Same
     failover contract as :func:`sell_spmv_pallas` — callers catch the
-    Mosaic lowering error once and fall back to the XLA formulation."""
+    Mosaic lowering error once and fall back to the XLA formulation.
+    ``acc_dtype`` is the storage/accumulation split (ISSUE 15)."""
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     B = X.shape[0]
-    out_dt = jnp.result_type(
+    out_dt = acc_dtype or jnp.result_type(
         val_slabs[0].dtype if val_slabs else X.dtype, X.dtype
     )
     parts = []
@@ -313,7 +332,8 @@ def sell_spmv_pallas_batched(plan: SellPlan, idx_slabs, val_slabs, pos, X,
         while TM * 2 <= 1024 and R % (TM * 2) == 0:
             TM *= 2
         parts.append(
-            _sell_slab_pallas_batched(idx_t, val_bt, X, K, TM, interpret)
+            _sell_slab_pallas_batched(idx_t, val_bt, X, K, TM, interpret,
+                                      acc_dtype=acc_dtype)
             .astype(out_dt)
         )
     if plan.zero_rows:

@@ -769,6 +769,38 @@ def batched_gmres(A, b, **kwargs):
     return _impl(A, b, **kwargs)
 
 
+def batched_ir(A, b, **kwargs):
+    """Batched mixed-precision iterative refinement; see
+    :func:`sparse_tpu.batch.krylov.batched_ir`."""
+    from .batch.krylov import batched_ir as _impl
+
+    return _impl(A, b, **kwargs)
+
+
+# ---------------------------------------------------------------------------
+# Mixed-precision iterative refinement (sparse_tpu.mixed, ISSUE 15)
+# ---------------------------------------------------------------------------
+def ir(A, b, x0=None, tol=1e-08, maxiter=None, M=None, policy="f32ir",
+       conv_test_iters=25, **kwargs):
+    """Mixed-precision solve: reduced-precision Krylov sweeps inside an
+    f64 iterative-refinement outer loop (``sparse_tpu.mixed.ir_solve``).
+
+    ``policy`` picks the inner storage/compute width: ``'f32ir'`` (f32
+    sweep, the serving fast path) or ``'bf16ir'`` (bfloat16 value
+    storage with f32 accumulation — well-conditioned operators only,
+    docs/performance.md "Mixed precision"). Stopping rule matches
+    :func:`cg`: absolute ``||r|| < tol``, evaluated in f64 — the
+    verification is built into every solve. Returns ``(x, iters)`` with
+    ``iters`` the total inner iterations (the unbatched-driver
+    convention)."""
+    from .mixed import ir_solve
+
+    x, info = ir_solve(A, b, x0=x0, tol=tol, maxiter=maxiter, M=M,
+                       policy=policy, conv_test_iters=conv_test_iters,
+                       **kwargs)
+    return x, int(np.asarray(info.iters).max(initial=0))
+
+
 # ---------------------------------------------------------------------------
 # CGS (linalg.py:570)
 # ---------------------------------------------------------------------------
@@ -2805,6 +2837,8 @@ __all__ = [
     "bicg",
     "bicgstab",
     "gmres",
+    "ir",
+    "batched_ir",
     "lsqr",
     "eigsh",
     "spsolve",

@@ -1,0 +1,37 @@
+"""Mixed precision as the fast path (ISSUE 15, ROADMAP item 2).
+
+Two halves:
+
+* :mod:`~sparse_tpu.mixed.policy` — :class:`DtypePolicy`, the
+  per-(pattern, solver, bucket, dtype) precision selector
+  (``SPARSE_TPU_DTYPE`` / ``SolveSession(dtype_policy=)`` /
+  ``submit(dtype_policy=)``), its ``.P<policy>`` program-key suffix
+  ('exact' keeps historic keys byte-identical) and the promote rung the
+  health-monitor escalation rides.
+* :mod:`~sparse_tpu.mixed.ir` — the batched f64 iterative-refinement
+  outer loop over reduced-precision inner Krylov sweeps, compiled as
+  one fixed-shape bucket program, plus the one-shot :func:`ir_solve`
+  entry point (``linalg.ir`` / ``batch.krylov.batched_ir`` wrap it).
+
+See docs/performance.md "Mixed precision" for the policy table and the
+accuracy contract.
+"""
+
+from .ir import ir_loop, ir_solve  # noqa: F401
+from .policy import (  # noqa: F401
+    EXACT,
+    IR_SOLVERS,
+    POLICIES,
+    DtypePolicy,
+    canonical_policy,
+    default_eta,
+    inner_dtypes,
+    key_suffix,
+    outer_dtype,
+)
+
+__all__ = [
+    "DtypePolicy", "EXACT", "IR_SOLVERS", "POLICIES", "canonical_policy",
+    "default_eta", "inner_dtypes", "ir_loop", "ir_solve", "key_suffix",
+    "outer_dtype",
+]

@@ -1,0 +1,298 @@
+"""Batched mixed-precision iterative refinement: the ``ir`` solver.
+
+The classic three-precision scheme (Wilkinson; the Ginkgo batched line,
+PAPERS.md §2) as ONE fixed-shape compiled program per bucket:
+
+    repeat (outer, f64):
+        R = b - A x                      # wide residual, wide values
+        freeze lanes with ||R|| < tol    # per-lane masks, bit-stable
+        solve A d = R / ||R||  (inner)   # reduced storage/compute
+        x += ||R|| * d                   # wide correction
+
+The inner solve is the SAME masked batched Krylov loop the exact
+bucket programs run (:func:`sparse_tpu.batch.krylov._cg_loop` /
+``_bicgstab_loop``) — at the policy's storage/compute dtypes, with a
+fixed per-sweep iteration budget and a constant absolute tolerance
+``eta`` (the residual is scaled to unit norm before the downcast, so
+f32/bf16 dynamic range is never the limit). Lanes frozen by the outer
+loop enter the inner sweep with an instant-converge tolerance (the pad
+lane trick from :mod:`sparse_tpu.batch.bucket`), so a finished lane's
+iterate is bit-stable while its neighbors refine.
+
+Everything is ``lax.while_loop`` over fixed shapes: the whole
+refinement — outer residuals, downcasts, inner sweeps, corrections —
+compiles into one bucket program, so the serving dispatch/caching/vault
+machinery see it exactly like any other solver loop.
+
+Accuracy contract (docs/performance.md "Mixed precision"): IR converges
+to the f64-accurate solution while ``cond(A) * eps_storage < 1`` —
+always true for f32 storage on anything CG itself can solve, true for
+bf16 storage only on well-conditioned (or strongly preconditioned)
+operators. ``scripts/f64_oracle.py`` is the pinned oracle; the outer
+loop's per-lane f64 residual test is the verification built into every
+solve.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .policy import EXACT, default_eta, inner_dtypes, outer_dtype
+
+#: instant-converge inner tolerance for outer-frozen lanes (the pad-lane
+#: contract of batch.bucket: any residual passes at the first test)
+BIG_TOL = 1e30
+
+
+def ir_loop(matvec_wide, matvec_low, b, X0, tol, maxiter,
+            conv_test_iters, inner_iters: int, max_outer: int,
+            eta: float, inner_dt, Mvec=None, solver: str = "cg",
+            lane_reduce=None):
+    """Masked batched iterative-refinement core (pure jnp, jit-safe).
+
+    ``matvec_wide`` applies A at f64 (the outer residual), ``matvec_low``
+    at the policy's reduced storage/compute dtypes (the inner sweep).
+    ``b``/``X0`` are ``(B, n)``; ``tol`` is the per-lane ABSOLUTE
+    residual-norm target (the same contract as the exact loops).
+    ``maxiter`` bounds total inner iterations per lane; ``max_outer``
+    statically bounds refinement sweeps. ``Mvec`` right-preconditions
+    the inner sweep at the inner dtype. ``lane_reduce`` is the
+    mesh-sharded all-converged exit hook (see ``krylov._cg_loop``) and
+    is threaded into BOTH the outer loop's exit and the inner sweeps.
+
+    Returns ``(X, iters, resid2, converged, outer)``: per-lane total
+    inner iterations, final f64 squared residual norms, convergence
+    flags, and the shared outer sweep count (a ``()`` int32 — the
+    ``mixed.ir_outer_iters`` evidence).
+
+    Divergence safeguard: refinement contracts only while
+    ``cond(A) * eps_storage < 1``; outside that regime (bf16 storage on
+    an ill-conditioned operator) the corrections GROW the residual. The
+    loop therefore keeps each lane's best-so-far iterate and freezes a
+    lane whose f64 residual stops improving — it returns the best
+    iterate, reported unconverged, instead of a diverged one. On the
+    serving path that unconverged flag is exactly what trips the
+    promote_dtype requeue rung.
+    """
+    from ..batch import krylov
+
+    wdt = outer_dtype()
+    idt = jnp.dtype(inner_dt)
+    rdt = jnp.zeros((), idt).real.dtype  # inner tolerance dtype
+    bw = jnp.asarray(b).astype(wdt)
+    Xw = jnp.asarray(X0).astype(wdt)
+    B = bw.shape[0]
+    tol2 = jnp.broadcast_to(jnp.asarray(tol, wdt), (B,)) ** 2
+    inner_loop = (
+        krylov._cg_loop if solver == "cg" else krylov._bicgstab_loop
+    )
+    any_active = jnp.any if lane_reduce is None else lane_reduce
+    eta_t = jnp.asarray(eta, rdt)
+
+    def body(st):
+        Xw, Xb, rb2, active, iters, outer = st
+        R = bw - matvec_wide(Xw)
+        rn2 = jnp.real(krylov._bdot(R, R))
+        # accept-if-better: the best iterate/residual pair is what the
+        # loop ultimately returns
+        improved = rn2 < rb2
+        am_i = (active & improved)[:, None]
+        Xb = jnp.where(am_i, Xw, Xb)
+        rb2 = jnp.where(active & improved, rn2, rb2)
+        active = active & ~(rb2 < tol2)
+        # divergence/stagnation freeze: no f64 progress this sweep —
+        # the reduced-precision correction is not contracting
+        active = active & improved
+        nrm = jnp.sqrt(rn2)
+        nrm_safe = jnp.where(nrm == 0, 1.0, nrm)
+        # unit-norm downcast: the inner sweep always sees an O(1)
+        # right-hand side, so reduced dynamic range never underflows
+        Rs = (R / nrm_safe[:, None]).astype(idt)
+        # adaptive inner target: stop the sweep at the OUTER target
+        # (with a 2x safety margin for the downcast error) when that is
+        # looser than eta — the last sweep never over-solves a digit
+        # the caller didn't ask for
+        need = (0.5 * jnp.sqrt(tol2) / nrm_safe).astype(rdt)
+        in_tol = jnp.maximum(eta_t, need)
+        in_tol = jnp.where(active, in_tol, jnp.asarray(BIG_TOL, rdt))
+        D, it_in, _r2, _cv = inner_loop(
+            matvec_low, Rs, jnp.zeros_like(Rs), in_tol,
+            inner_iters, conv_test_iters, Mvec=Mvec,
+            lane_reduce=lane_reduce,
+        )
+        dw = D.astype(wdt) * nrm[:, None]
+        am = active[:, None]
+        Xw = jnp.where(am, Xw + dw, Xw)
+        iters = iters + jnp.where(active, it_in, 0)
+        # budget freeze: a lane out of total inner budget stops
+        # correcting (it keeps its best iterate, reported unconverged)
+        active = active & (iters < maxiter)
+        return Xw, Xb, rb2, active, iters, outer + 1
+
+    def cond(st):
+        active, outer = st[3], st[5]
+        return (outer < max_outer) & any_active(active)
+
+    st = (
+        Xw,
+        Xw,
+        jnp.full((B,), jnp.inf, wdt),
+        jnp.ones((B,), bool),
+        jnp.zeros((B,), jnp.int32),
+        jnp.zeros((), jnp.int32),
+    )
+    Xw, Xb, rb2, _active, iters, outer = jax.lax.while_loop(cond, body, st)
+    # final accept-if-better over the last (un-evaluated) correction
+    Rf = bw - matvec_wide(Xw)
+    rnf = jnp.real(krylov._bdot(Rf, Rf))
+    better = rnf < rb2
+    X_out = jnp.where(better[:, None], Xw, Xb)
+    r2_out = jnp.where(better, rnf, rb2)
+    return X_out, iters, r2_out, r2_out < tol2, outer
+
+
+def _shared_csr_matvecs(A, storage_dt):
+    """``(mv_wide, mv_low)`` for ONE host CSR matrix shared by every
+    lane: f64 values for the outer residual, policy-storage values for
+    the inner sweep, both through the jit-safe segment SpMV (explicit
+    ``acc_dtype`` widening on the reduced side)."""
+    from ..ops import spmv as spmv_ops
+    from ..utils import asjnp
+
+    if hasattr(A, "tocsr") and not hasattr(A, "indptr"):
+        A = A.tocsr()
+    indptr = asjnp(np.asarray(A.indptr))
+    indices = asjnp(np.asarray(A.indices))
+    data = np.asarray(A.data)
+    if np.dtype(data.dtype).kind == "c":
+        raise ValueError("iterative refinement is real-arithmetic; "
+                         "complex operators solve under policy 'exact'")
+    m = int(A.shape[0])
+    vals_w = jnp.asarray(data.astype(np.float64))
+    vals_l = jnp.asarray(data.astype(np.float32)).astype(
+        jnp.dtype(storage_dt)
+    )
+    _storage, compute_dt = (storage_dt, np.float32)
+
+    def mk(vals, acc_dt):
+        def mv(X):
+            return jax.vmap(
+                lambda x: spmv_ops.csr_spmv_segment(
+                    indptr, indices, vals, x, m, acc_dtype=acc_dt
+                )
+            )(X)
+
+        return mv
+
+    return mk(vals_w, None), mk(vals_l, compute_dt)
+
+
+def _operator_matvecs(A, policy: str):
+    """``(matvec_wide, matvec_low)``: a csr_array/scipy matrix (shared
+    by all lanes), a :class:`~sparse_tpu.batch.operator.BatchedCSR`
+    (per-lane values, downcast through ``with_values``), or an explicit
+    ``(A_wide, A_low)`` pair of callables/batched operators for callers
+    that build the two precisions themselves (the f64_oracle's DIA
+    planes)."""
+    from ..batch.operator import BatchedCSR, as_batched_matvec
+
+    storage_dt, _compute_dt = inner_dtypes(policy)
+    if isinstance(A, tuple) and len(A) == 2:
+        wide, low = A
+        return as_batched_matvec(wide), as_batched_matvec(low)
+    if isinstance(A, BatchedCSR):
+        try:
+            # pack the pattern EAGERLY (host context): the traced
+            # matvec's kernel choice then never depends on whether an
+            # earlier call already packed — repeat solves are
+            # bit-reproducible kernel-wise
+            A.pattern.sell_pack()
+        except Exception:  # noqa: BLE001 - segment path still works
+            pass
+        wdt = outer_dtype()
+        return (
+            A.with_values(A.values.astype(wdt)).matvec,
+            A.with_values(A.values.astype(jnp.dtype(storage_dt))).matvec,
+        )
+    if hasattr(A, "indptr") or hasattr(A, "tocsr"):
+        return _shared_csr_matvecs(A, storage_dt)
+    raise TypeError(
+        f"cannot build mixed-precision matvecs from {type(A).__name__}; "
+        "pass a CSR matrix, a BatchedCSR, or an (A_wide, A_low) pair"
+    )
+
+
+def ir_solve(A, b, x0=None, tol=1e-8, maxiter=None, M=None,
+             policy: str = "f32ir", conv_test_iters: int = 25,
+             inner_iters: int | None = None, max_outer: int | None = None,
+             eta: float | None = None, solver: str = "cg"):
+    """One-shot (B=1 or batched) mixed-precision IR solve.
+
+    ``A`` is a csr_array/scipy matrix (downcast internally), a
+    ``BatchedCSR`` stack, or an explicit ``(A_wide, A_low)`` pair of
+    batched matvecs; ``b`` is ``(n,)`` or ``(B, n)``. Absolute
+    ``||r|| < tol`` per lane, tested in f64 — the same stopping
+    contract as :func:`sparse_tpu.linalg.cg`.
+
+    Returns ``(X, info)`` with
+    :class:`~sparse_tpu.batch.krylov.BatchedSolveInfo` extended by
+    ``info.outer`` (refinement sweeps). 1-D ``b`` returns 1-D ``x``.
+    """
+    from ..batch import krylov
+    from ..config import settings
+    from ..telemetry import _metrics
+
+    policy = str(policy)
+    if policy == EXACT:
+        raise ValueError("ir_solve needs a reduced policy ('f32ir' | "
+                         "'bf16ir'); exact solves go through linalg.cg")
+    if solver not in ("cg", "bicgstab"):
+        raise ValueError("ir wraps 'cg' or 'bicgstab' inner sweeps")
+    mv_w, mv_l = _operator_matvecs(A, policy)
+    b = jnp.asarray(b)
+    if jnp.dtype(b.dtype).kind == "c":
+        raise ValueError("iterative refinement is real-arithmetic; "
+                         "complex systems solve under policy 'exact'")
+    squeeze = b.ndim == 1
+    if squeeze:
+        b = b[None, :]
+    _B, n = b.shape
+    if maxiter is None:
+        maxiter = n * 10
+    X0 = (
+        jnp.zeros(b.shape, outer_dtype()) if x0 is None
+        else jnp.asarray(x0).astype(outer_dtype())
+    )
+    if X0.ndim == 1:
+        X0 = X0[None, :]
+    _storage_dt, compute_dt = inner_dtypes(policy)
+    if inner_iters is None:
+        inner_iters = settings.ir_inner or max(
+            8 * conv_test_iters, min(int(n), 4000)
+        )
+    if max_outer is None:
+        max_outer = settings.ir_outer
+    if eta is None:
+        eta = default_eta(policy)
+    Mvec = None
+    if M is not None:
+        from ..batch.operator import as_batched_matvec
+
+        Mvec = as_batched_matvec(M)
+    X, iters, rn2, conv, outer = ir_loop(
+        mv_w, mv_l, b, X0, tol, int(maxiter), int(conv_test_iters),
+        int(inner_iters), int(max_outer), float(eta), compute_dt,
+        Mvec=Mvec, solver=solver,
+    )
+    _metrics.counter(
+        "mixed.ir_outer_iters",
+        help="iterative-refinement outer sweeps across all IR solves",
+    ).inc(int(outer))
+    info = krylov.BatchedSolveInfo(iters, rn2, conv)
+    info.outer = int(outer)
+    krylov._solve_event("ir", info, n)
+    if squeeze:
+        return X[0], info
+    return X, info

@@ -1,0 +1,282 @@
+"""DtypePolicy: which precision a bucket program solves at.
+
+Mixed precision as the fast path (ISSUE 15, ROADMAP item 2): instead of
+solving end-to-end at the request dtype — and only *promoting* precision
+as a resilience fallback — the serving stack can run the Krylov sweep at
+a REDUCED storage/compute precision and recover full accuracy through an
+f64 iterative-refinement outer loop (:mod:`sparse_tpu.mixed.ir`). The
+policy object resolves which buckets get that treatment.
+
+The resolution ladder (most specific wins), mirroring
+:class:`~sparse_tpu.precond.policy.PrecondPolicy`:
+
+1. per-ticket override (``SolveSession.submit(dtype_policy=...)``) —
+   lanes with different overrides never share a bucket (the flush group
+   key carries the override, like the dtype and the precond override);
+2. per-session (``SolveSession(dtype_policy=...)``);
+3. the environment (``SPARSE_TPU_DTYPE`` — '' / 'exact' keeps every
+   historic program key, jaxpr and numeric byte-identical).
+
+Policies:
+
+``exact``
+    Solve at the request dtype (the historic path; no key suffix).
+``f32ir``
+    Inner Krylov sweep stored AND computed in f32, outer f64
+    residual-and-correct loop. The serving default under ``auto`` for
+    f64 requests: half the HBM traffic per inner iteration, full f64
+    accuracy from the refinement loop.
+``bf16ir``
+    Values stored in bfloat16 (quarter traffic vs f64), inner compute
+    accumulates in f32 (``acc_dtype`` widening in the SELL/DIA
+    kernels), outer f64 refinement. Accuracy contract: iterative
+    refinement contracts only while ``cond(A) * 2**-8 < 1`` (bf16 has
+    an 8-bit mantissa), so this policy is for well-conditioned or
+    strongly preconditioned operators — ``auto`` never picks it.
+
+A resolved choice is per ``(pattern, solver, bucket, dtype)`` — the
+bucket-program axes — and joins the program's plan-cache key
+(``.P<policy>`` suffix; absent for 'exact', so historic keys are
+unchanged) and the vault warm-start manifest (back-compatible
+``dtype_policy`` field, like Fleet's ``mesh`` and Precond's
+``precond``).
+
+Policies that cannot apply degrade to ``exact`` with a
+``coverage.fallback`` breadcrumb rather than failing the dispatch:
+complex request dtypes (the IR loop is real-arithmetic), gmres buckets
+(its host-driven restart cycle has no fused refinement form), x64
+disabled (no f64 outer loop to refine in), and non-square patterns.
+
+The promote rung (the health-monitor escalation, docs/resilience.md):
+:meth:`DtypePolicy.promote` pins a (pattern, solver, bucket, dtype)
+group to ``exact`` for the rest of the session — the serving loop calls
+it when a reduced-precision bucket comes back anomalous (nonfinite or
+unconverged lanes), right before requeueing those lanes at ``exact``
+(``action=promote_dtype``, ahead of the classic solver-escalation
+rung). Promotions count into the always-on ``mixed.promotions{reason}``
+metric.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .. import telemetry
+from ..config import settings
+from ..telemetry import _metrics
+
+#: the forceable reduced-precision policies (the SPARSE_TPU_DTYPE
+#: grammar minus auto/exact)
+POLICIES = ("f32ir", "bf16ir")
+
+EXACT = "exact"
+
+_EXACT_SPELLINGS = ("", "0", "off", "false", "no", "none", "exact")
+
+#: solvers the fused IR loop wraps (pure-jnp inner loops; gmres's
+#: host-driven restart cycle degrades to exact)
+IR_SOLVERS = ("cg", "bicgstab")
+
+
+def canonical_policy(policy, allow_auto: bool = True) -> str:
+    """Normalize a policy spelling; raises on unknown values (a typo'd
+    ``SPARSE_TPU_DTYPE`` must not silently serve at reduced precision —
+    or silently fail to)."""
+    s = str("" if policy is None else policy).strip().lower()
+    if s in _EXACT_SPELLINGS:
+        return EXACT
+    if s == "auto":
+        if not allow_auto:
+            raise ValueError("'auto' is not a concrete dtype policy")
+        return "auto"
+    if s not in POLICIES:
+        raise ValueError(
+            f"dtype policy {policy!r} not one of "
+            f"{('exact', 'auto') + POLICIES}"
+        )
+    return s
+
+
+def key_suffix(policy: str | None) -> str:
+    """What a resolved policy contributes to the bucket-program
+    plan-cache key — empty for 'exact' so historic keys, programs and
+    vault manifests are byte-compatible with every earlier release."""
+    if not policy or policy == EXACT:
+        return ""
+    return f".P{policy}"
+
+
+def inner_dtypes(policy: str) -> tuple:
+    """``(storage_dtype, compute_dtype)`` of the inner Krylov sweep: the
+    width the packed value planes upload/stream at, and the width the
+    sweep's vectors and recurrence scalars carry (the ``acc_dtype`` the
+    kernels widen chunk-reductions to)."""
+    if policy == "f32ir":
+        return np.dtype(np.float32), np.dtype(np.float32)
+    if policy == "bf16ir":
+        import jax.numpy as jnp
+
+        return np.dtype(jnp.bfloat16), np.dtype(np.float32)
+    raise ValueError(f"policy {policy!r} has no reduced inner dtypes")
+
+
+def outer_dtype() -> np.dtype:
+    """The refinement loop's residual/correction dtype (always f64 —
+    the whole point of the outer loop)."""
+    return np.dtype(np.float64)
+
+
+def default_eta(policy: str) -> float:
+    """Per-sweep inner residual-reduction target: how far the inner
+    sweep pushes the (scaled, unit-norm) correction residual before the
+    outer loop re-evaluates in f64. Bounded by the inner precision —
+    f32 can earn ~4 digits per sweep, bf16 storage ~2."""
+    if settings.ir_eta > 0:
+        return settings.ir_eta
+    return 1e-4 if policy == "f32ir" else 1e-2
+
+
+class DtypePolicy:
+    """Per-session precision selector (constructed by ``SolveSession``;
+    also usable standalone).
+
+    Parameters
+    ----------
+    mode : '' / 'exact' | 'auto' | 'f32ir' | 'bf16ir'. ``None`` =
+        ``settings.dtype_policy`` (``SPARSE_TPU_DTYPE``).
+    inner_iters / max_outer / eta : IR-loop knob overrides
+        (defaults from settings / :func:`default_eta`).
+    """
+
+    def __init__(self, mode=None, inner_iters: int | None = None,
+                 max_outer: int | None = None, eta: float | None = None):
+        self.mode = canonical_policy(
+            settings.dtype_policy if mode is None else mode
+        )
+        self.inner_iters = inner_iters
+        self.max_outer = max_outer
+        self.eta = eta
+        # resolved (id(pattern), solver, bucket, dtype, override) -> policy
+        self._decisions: dict = {}
+        # groups the promote rung pinned to exact (health-monitor
+        # escalation; never un-promotes within a session)
+        self._promoted: set = set()
+
+    @classmethod
+    def resolve(cls, policy=None, **knobs) -> "DtypePolicy":
+        """The ``SolveSession`` constructor hook: ``policy`` may be a
+        ready policy object, a mode string, ``True`` (= 'auto'),
+        ``False`` (= exact regardless of env), or ``None`` (= env)."""
+        if isinstance(policy, cls):
+            return policy
+        if policy is True:
+            policy = "auto"
+        elif policy is False:
+            policy = EXACT
+        return cls(policy, **knobs)
+
+    @property
+    def enabled(self) -> bool:
+        return self.mode != EXACT
+
+    @staticmethod
+    def _group(pattern, solver: str, bucket: int, dtype) -> tuple:
+        return (id(pattern), solver, int(bucket), np.dtype(dtype).str)
+
+    def decide(self, pattern, solver: str, bucket: int, dtype,
+               override=None) -> str:
+        """Resolved concrete policy for one bucket program (cached per
+        (pattern, solver, bucket, dtype, override)); a promoted group
+        always resolves to 'exact'."""
+        group = self._group(pattern, solver, bucket, dtype)
+        if group in self._promoted:
+            return EXACT
+        ov = None if override is None else canonical_policy(override)
+        key = group + (ov,)
+        hit = self._decisions.get(key)
+        if hit is not None:
+            return hit
+        policy = ov if ov is not None else self.mode
+        if policy == "auto":
+            policy = self._auto(solver, dtype)
+        policy = self._validate(pattern, solver, dtype, policy)
+        self._decisions[key] = policy
+        return policy
+
+    def promote(self, pattern, solver: str, bucket: int, dtype,
+                reason: str = "anomaly") -> None:
+        """Pin one bucket group to 'exact' (the health-monitor
+        escalation rung): every later dispatch of this (pattern,
+        solver, bucket, dtype) solves at the request dtype. Counts
+        into the always-on ``mixed.promotions{reason}`` metric."""
+        self._promoted.add(self._group(pattern, solver, bucket, dtype))
+        _metrics.counter(
+            "mixed.promotions", reason=reason,
+            help="reduced-precision bucket groups escalated to the "
+            "'exact' dtype policy, by anomaly reason",
+        ).inc()
+
+    def _auto(self, solver: str, dtype) -> str:
+        """f32+IR for f64 requests on the fused-loop solvers; everything
+        else exact. bf16 storage is opt-in only (see the module
+        docstring's accuracy contract)."""
+        if solver in IR_SOLVERS and np.dtype(dtype) == np.float64:
+            return "f32ir"
+        return EXACT
+
+    def _validate(self, pattern, solver: str, dtype, policy: str) -> str:
+        """Degrade policies the bucket cannot support (breadcrumbed,
+        never a dispatch failure)."""
+        if policy == EXACT:
+            return policy
+        dt = np.dtype(dtype)
+        if dt.kind == "c":
+            self._fallback(policy, "complex request dtype")
+            return EXACT
+        if solver not in IR_SOLVERS:
+            self._fallback(policy, f"solver {solver} has no fused IR loop")
+            return EXACT
+        if pattern is not None and pattern.shape[0] != pattern.shape[1]:
+            self._fallback(policy, "non-square pattern")
+            return EXACT
+        import jax
+
+        if not jax.config.jax_enable_x64:
+            self._fallback(policy, "x64 disabled: no f64 outer loop")
+            return EXACT
+        return policy
+
+    @staticmethod
+    def _fallback(policy: str, reason: str) -> None:
+        if telemetry.enabled():
+            telemetry.record(
+                "coverage.fallback", op=f"mixed.{policy}", reason=reason,
+                to=EXACT,
+            )
+
+    def ir_knobs(self, policy: str, n: int, conv_test_iters: int) -> dict:
+        """The IR loop's static knobs for one bucket program."""
+        inner = self.inner_iters or settings.ir_inner
+        if inner <= 0:
+            # auto: scale the per-sweep budget with the system — a
+            # too-small budget forces restart churn (each restart
+            # throws away the Krylov space), while a generous one costs
+            # nothing (the sweep exits on its inner tolerance). Capped
+            # so a stalling sweep cannot burn unbounded work.
+            inner = max(8 * int(conv_test_iters), min(int(n), 4000))
+        return {
+            "inner_iters": int(inner),
+            "max_outer": int(self.max_outer or settings.ir_outer),
+            "eta": float(self.eta if self.eta is not None
+                         else default_eta(policy)),
+        }
+
+    def describe(self) -> dict:
+        """JSON-friendly block for ``session_stats()``."""
+        return {
+            "mode": self.mode,
+            "enabled": self.enabled,
+            "promoted_groups": len(self._promoted),
+            "inner_iters": self.inner_iters or settings.ir_inner,
+            "max_outer": self.max_outer or settings.ir_outer,
+        }

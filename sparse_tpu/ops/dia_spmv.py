@@ -22,14 +22,22 @@ import jax.numpy as jnp
 import numpy as np
 
 
-@partial(jax.jit, static_argnames=("offsets", "shape"))
-def dia_spmv_xla(data, offsets: tuple, x, shape: tuple):
+@partial(jax.jit, static_argnames=("offsets", "shape", "acc_dtype"))
+def dia_spmv_xla(data, offsets: tuple, x, shape: tuple, acc_dtype=None):
     """y = A @ x with A in DIA layout (scipy convention: data[k, j] holds
     A[j - o_k, j]). ``offsets`` is a static tuple, so every slice below is a
-    static-shape op and the whole SpMV fuses into one XLA pass."""
+    static-shape op and the whole SpMV fuses into one XLA pass.
+
+    ``acc_dtype`` is the storage/accumulation split (ISSUE 15): bf16/f32
+    diagonal planes widen at the multiply so the shifted adds accumulate
+    at ``acc_dtype`` while HBM moves the narrow planes. ``None`` (the
+    default) keeps the historic result-type behavior byte-identical."""
     m, n = shape
     D = len(offsets)
-    prod = data * x[None, :n]  # [D, n]
+    if acc_dtype is not None:
+        prod = data.astype(acc_dtype) * x[None, :n].astype(acc_dtype)
+    else:
+        prod = data * x[None, :n]  # [D, n]
     B = max(max((abs(int(o)) for o in offsets), default=0), max(m - n, 0))
     padded = jnp.pad(prod, ((0, 0), (B, B + max(m - n, 0))))
     y = jnp.zeros((m,), dtype=prod.dtype)

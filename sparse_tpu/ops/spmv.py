@@ -19,13 +19,24 @@ import numpy as np
 from .coords import expand_rows
 
 
-def csr_spmv_segment(indptr, indices, data, x, m: int):
-    """y = A @ x via gather + sorted segment-sum. General path, any row profile."""
+def csr_spmv_segment(indptr, indices, data, x, m: int, acc_dtype=None):
+    """y = A @ x via gather + sorted segment-sum. General path, any row profile.
+
+    ``acc_dtype`` is the mixed-precision widening hook (ISSUE 15): with
+    reduced-width values (bf16/f32 storage), products and the segment
+    reduction accumulate at ``acc_dtype`` instead of the storage dtype —
+    the converts fuse into the gather consumers, so HBM still moves
+    half-width values while the arithmetic stays wide. ``None`` (the
+    default) keeps the historic result-type behavior byte-identical."""
     nnz = data.shape[0]
+    out_dt = acc_dtype or jnp.result_type(data.dtype, x.dtype)
     if nnz == 0:
-        return jnp.zeros((m,), dtype=jnp.result_type(data.dtype, x.dtype))
+        return jnp.zeros((m,), dtype=out_dt)
     rows = expand_rows(indptr, nnz)
-    prod = data * x[indices]
+    if acc_dtype is not None:
+        prod = data.astype(out_dt) * x[indices].astype(out_dt)
+    else:
+        prod = data * x[indices]
     return jax.ops.segment_sum(prod, rows, num_segments=m, indices_are_sorted=True)
 
 
@@ -61,29 +72,41 @@ def csr_spmv_ell(ell_indices, ell_data, x):
     return jax.lax.fori_loop(0, k, body, acc0)
 
 
-def _sell_slab_spmv(idx_t, val_t, x):
+def _sell_slab_spmv(idx_t, val_t, x, acc_dtype=None):
     """y_slab = A_slab @ x on one SELL slab: [K, R] plane-major index/value
     planes (rows of equal padded width K). Same gather-shaped op as
     :func:`csr_spmv_ell`, stored plane-major so each plane is a contiguous
-    1-D gather; small K unrolls, large K runs under ``fori_loop``."""
+    1-D gather; small K unrolls, large K runs under ``fori_loop``.
+
+    ``acc_dtype`` widens every plane product before the accumulate
+    (ISSUE 15): value planes stream at their storage width (bf16/f32),
+    the per-row reduction runs at ``acc_dtype``. ``None`` = historic
+    result-type accumulation, byte-identical."""
     K = idx_t.shape[0]
-    out_dt = jnp.result_type(val_t.dtype, x.dtype)
+    out_dt = acc_dtype or jnp.result_type(val_t.dtype, x.dtype)
     if K == 0:
         return jnp.zeros((idx_t.shape[1],), dtype=out_dt)
+
+    def plane(kk):
+        if acc_dtype is not None:
+            return val_t[kk].astype(out_dt) * x[idx_t[kk]].astype(out_dt)
+        return val_t[kk] * x[idx_t[kk]]
+
     if K <= ELL_UNROLL_MAX:
-        acc = val_t[0] * x[idx_t[0]]
+        acc = plane(0)
         for kk in range(1, K):
-            acc = acc + val_t[kk] * x[idx_t[kk]]
+            acc = acc + plane(kk)
         return acc.astype(out_dt)
 
     def body(kk, acc):
-        return acc + val_t[kk] * x[idx_t[kk]]
+        return acc + plane(kk)
 
     acc0 = jnp.zeros((idx_t.shape[1],), dtype=out_dt)
     return jax.lax.fori_loop(0, K, body, acc0)
 
 
-def csr_spmv_sell(slabs, pos, x, zero_rows: int, out_dtype=None):
+def csr_spmv_sell(slabs, pos, x, zero_rows: int, out_dtype=None,
+                  acc_dtype=None):
     """y = A @ x on the SELL-C-sigma layout (see ``kernels.sell_spmv``).
 
     ``slabs`` is a static tuple of plane-major ``(idx_t, val_t)`` pairs
@@ -97,10 +120,13 @@ def csr_spmv_sell(slabs, pos, x, zero_rows: int, out_dtype=None):
     row-block variant lives in ``sparse_tpu.kernels.sell_spmv``.
     """
     x = jnp.asarray(x)  # numpy x would fail the fori-loop gather branch
-    out_dt = out_dtype or jnp.result_type(
+    out_dt = out_dtype or acc_dtype or jnp.result_type(
         slabs[0][1].dtype if slabs else x.dtype, x.dtype
     )
-    parts = [_sell_slab_spmv(it, vt, x).astype(out_dt) for it, vt in slabs]
+    parts = [
+        _sell_slab_spmv(it, vt, x, acc_dtype=acc_dtype).astype(out_dt)
+        for it, vt in slabs
+    ]
     if zero_rows:
         parts.append(jnp.zeros((zero_rows,), dtype=out_dt))
     if not parts:  # empty matrix: pos is empty too
@@ -145,18 +171,24 @@ def csr_spmm_sell(slabs, pos, B, zero_rows: int, out_dtype=None):
 
 
 def csr_spmv_sell_batched(idx_slabs, val_slabs, pos, X, zero_rows: int,
-                          out_dtype=None):
+                          out_dtype=None, acc_dtype=None):
     """Y[b] = A_b @ X[b] on the SELL layout with one SHARED sparsity
     pattern: ``idx_slabs`` (and ``pos``/``zero_rows``) are pattern state
     packed once, ``val_slabs`` is a tuple of stacked ``[B, K, R]`` value
     planes — the vmap-compatible XLA path of the batched subsystem
     (``sparse_tpu.batch``). Every lane rides the same contiguous 1-D
-    gathers as :func:`csr_spmv_sell`; XLA batches them for free."""
+    gathers as :func:`csr_spmv_sell`; XLA batches them for free.
+
+    ``acc_dtype`` is the storage/accumulation split (ISSUE 15): value
+    planes may be stored bf16/f32 while every plane product and the
+    per-row reduction run at ``acc_dtype`` — the mixed-precision inner
+    sweep's matvec."""
     X = jnp.asarray(X)
 
     def one(vts, x):
         return csr_spmv_sell(
-            tuple(zip(idx_slabs, vts)), pos, x, zero_rows, out_dtype
+            tuple(zip(idx_slabs, vts)), pos, x, zero_rows, out_dtype,
+            acc_dtype=acc_dtype,
         )
 
     return jax.vmap(one)(tuple(val_slabs), X)

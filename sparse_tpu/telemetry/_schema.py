@@ -121,6 +121,16 @@ KINDS: dict[str, frozenset] = {
     # factorization happens in-program, so this is the host-side record
     # that it ran)
     "precond.apply": frozenset({"precond", "lanes"}),
+    # -- mixed precision (sparse_tpu.mixed, ISSUE 15) -----------------------
+    # the promote_dtype rung fired: an anomalous reduced-precision
+    # bucket pinned its (pattern, solver, bucket, dtype) group to
+    # 'exact' and requeued the failed lanes at full precision; reason
+    # is 'nonfinite' | 'unconverged', from_policy the reduced policy
+    # the bucket ran under. Pairs with a batch.requeue event carrying
+    # action='promote_dtype'. Counts into the always-on
+    # mixed.promotions{reason} metric; IR sweep totals ride the
+    # always-on mixed.ir_outer_iters counter.
+    "mixed.promote": frozenset({"reason", "lanes"}),
     # -- plan cache (sparse_tpu.plan_cache / telemetry/_cost.py) ------------
     # one per compiled (or host-packed) plan-cached program: wall-clock
     # compile/pack seconds plus XLA cost/memory analysis when available

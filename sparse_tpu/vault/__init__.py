@@ -115,7 +115,8 @@ def load_pattern(key: str):
 def note_program(pattern, solver: str, bucket: int, dtype: str,
                  mesh: str | None = None,
                  strategy: str | None = None,
-                 precond: str | None = None) -> None:
+                 precond: str | None = None,
+                 dtype_policy: str | None = None) -> None:
     """Record one freshly built bucket program in the warm-start
     manifest (and ensure its pattern artifact exists). Best-effort.
 
@@ -131,7 +132,14 @@ def note_program(pattern, solver: str, bucket: int, dtype: str,
     program — its pattern-level maps load from their own vault artifact
     kinds, so a warm restart pays zero symbolic factorizations. ``None``
     (the default) marks an unpreconditioned program (pre-precond
-    manifests stay valid)."""
+    manifests stay valid).
+
+    ``dtype_policy`` is the program's resolved mixed-precision policy
+    (ISSUE 15): recorded so the replay rebuilds the SAME
+    precision-keyed (``.P``-suffixed) program and a warm restart serves
+    the reduced-precision fast path at zero plan-cache misses. ``None``
+    (the default) marks an exact program (pre-mixed manifests stay
+    valid)."""
     if not _store.enabled():
         return
     try:
@@ -149,6 +157,8 @@ def note_program(pattern, solver: str, bucket: int, dtype: str,
             entry["strategy"] = str(strategy or "batch")
         if precond:
             entry["precond"] = str(precond)
+        if dtype_policy:
+            entry["dtype_policy"] = str(dtype_policy)
         _manifest.note(entry)
     except Exception:
         return

@@ -53,11 +53,13 @@ def _entry_key(e: dict) -> tuple:
     # same (pattern, solver, bucket, dtype) program compiled for a
     # different mesh is a DIFFERENT executable and must dedup separately
     # (absent == single-device, so pre-fleet manifests stay valid).
-    # `precond` (ISSUE 14) extends the key the same back-compatible way:
-    # absent == unpreconditioned, and a precond-keyed program dedups
-    # apart from its unpreconditioned sibling.
+    # `precond` (ISSUE 14) and `dtype_policy` (ISSUE 15) extend the key
+    # the same back-compatible way: absent == unpreconditioned / exact,
+    # and a precond- or precision-keyed program dedups apart from its
+    # plain sibling.
     return (e.get("pattern"), e.get("solver"), e.get("bucket"),
-            e.get("dtype"), e.get("mesh"), e.get("precond"))
+            e.get("dtype"), e.get("mesh"), e.get("precond"),
+            e.get("dtype_policy"))
 
 
 def entries() -> list:

@@ -69,6 +69,7 @@ _QUICK_FILES = {
     "test_grid2d.py",
     "test_io.py",
     "test_loadgen.py",
+    "test_mixed.py",
     "test_multigrid.py",
     "test_pipeline.py",
     "test_plan_cache.py",
