@@ -935,6 +935,193 @@ def run_mixed_cg(B: int = 16, g: int = 512, tol_rel: float = 1e-3) -> dict:
     return out
 
 
+def run_auto_cg(B: int = 8, tol: float = 1e-6) -> dict:
+    """Autopilot policy-tuning row (ISSUE 16): the online tuner vs every
+    hand-picked static policy, per profile, at MATCHING tolerance.
+
+    Three profiles with different best arms — the case for closing the
+    telemetry->configuration loop is exactly that no single static
+    config wins everywhere:
+
+    * ``pde_well``: low-contrast variable-coefficient PDE (sigma=0.5) —
+      preconditioning is mostly overhead here;
+    * ``pde_ill``: high-contrast PDE (sigma=3) — Jacobi-family precond
+      is a large win, compounded by reduced-precision inner loops;
+    * ``skewed_general``: power-law-degree SPD general matrix.
+
+    Tracked numbers, per profile:
+
+    * ``static.<arm>.end_to_end_s``: warm steady-state wall per flush
+      for each candidate pinned statically (the tuner's whole grid,
+      including the ``precond_dtype=storage`` compounding arm);
+    * ``auto.end_to_end_s`` / ``auto.arm``: the converged tuner's
+      pinned steady state and which arm it chose;
+    * ``regret``: auto / best-static wall ratio (1.0 == perfect pick);
+      ``auto_matches_best`` allows a 20% wall-noise band OR an exact
+      arm match — acceptance is "auto >= best static per profile";
+    * ``beats_global_static``: auto strictly under the SINGLE global
+      default (the unpreconditioned exact arm) — must hold on >= 1
+      profile.
+
+    Plus a ``restart`` drill: a fresh tuner over the retained vault
+    restores the pde_ill decision and serves tuned from the FIRST
+    request (zero trials spent re-exploring).
+    """
+    import shutil
+    import tempfile
+
+    import numpy as np
+
+    from sparse_tpu import autopilot, plan_cache
+    from sparse_tpu.batch import SolveSession
+    from sparse_tpu.config import settings
+
+    def lanes_from(A, B):
+        # B lanes sharing one sparsity pattern: per-lane diagonal scale
+        # (keeps SPD, keeps the fingerprint — the batch path's contract)
+        d = A.diagonal()
+        mats = []
+        for i in range(B):
+            Ai = A.copy()
+            Ai.setdiag(d * (1.0 + 0.15 * i / max(B - 1, 1)))
+            Ai.sort_indices()
+            mats.append(Ai)
+        return mats
+
+    # distinct grid sizes: the tuner keys groups by PATTERN fingerprint,
+    # so same-size well/ill profiles would share one group (the second
+    # would restore the first's decision instead of tuning its own)
+    profiles = {
+        "pde_well": [_pde2d_varcoef(24, seed=200 + i, sigma=0.5)
+                     for i in range(B)],
+        "pde_ill": [_pde2d_varcoef(32, seed=300 + i, sigma=3.0)
+                    for i in range(B)],
+        "skewed_general": lanes_from(
+            skewed_degree_csr(1500).astype(np.float64), B),
+    }
+    rng = np.random.default_rng(53)
+    out = {"B": B, "tol": tol,
+           "grid": [autopilot.arm_id(s) for s in autopilot.DEFAULT_GRID]}
+    vdir = tempfile.mkdtemp(prefix="stpu_bench_auto_")
+    old_vault = settings.vault
+    try:
+        settings.vault = vdir
+        restart_args = None
+        for name, mats in profiles.items():
+            n = mats[0].shape[0]
+            rhs = rng.standard_normal((B, n))
+            maxiter = 60 * n
+            row = {"n": n}
+
+            def timed(ses, reps=3, mats=mats, rhs=rhs, maxiter=maxiter):
+                best = its = ok = None
+                for _ in range(reps):
+                    t0 = time.perf_counter()
+                    _X, it, r2 = ses.solve_many(mats, rhs, tol=tol,
+                                                maxiter=maxiter)
+                    dt = time.perf_counter() - t0
+                    if best is None or dt < best:
+                        best = dt
+                        its = float(it.mean())
+                        ok = bool((np.sqrt(r2) <= tol * 1.5).all())
+                return best, its, ok
+
+            statics = {}
+            for spec in autopilot.DEFAULT_GRID:
+                arm = autopilot.arm_id(spec)
+                plan_cache.clear()
+                ses = SolveSession("cg", batch_max=B, warm_start=False,
+                                   precond=spec.get("precond"),
+                                   dtype_policy=spec.get("dtype_policy"),
+                                   precond_dtype=spec.get("precond_dtype"))
+                timed(ses, reps=1)  # compile outside the window
+                t_s, it_s, ok_s = timed(ses)
+                statics[arm] = {"end_to_end_s": round(t_s, 4),
+                                "iters_mean": round(it_s, 1),
+                                "converged": ok_s}
+            conv = {a: r for a, r in statics.items() if r["converged"]}
+            best_arm = min(conv, key=lambda a: conv[a]["end_to_end_s"])
+            row["static"] = statics
+            row["best_static"] = best_arm
+            row["best_static_s"] = statics[best_arm]["end_to_end_s"]
+
+            # the tuner under full exploration pressure (epsilon=1, one
+            # trial per arm per halving round): converge, then measure
+            # the PINNED steady state on the same warm programs
+            plan_cache.clear()
+            ap = autopilot.Autopilot(grid=autopilot.DEFAULT_GRID,
+                                     epsilon=1.0, trials=1)
+            ses = SolveSession("cg", batch_max=B, warm_start=False,
+                               autopilot=ap)
+            flushes = 0
+            gr = {}
+            for _ in range(60):
+                ses.solve_many(mats, rhs, tol=tol, maxiter=maxiter)
+                flushes += 1
+                groups = list(ses.session_stats().get(
+                    "autopilot", {}).get("groups", {}).values())
+                if groups and all(x["phase"] == "converged"
+                                  for x in groups):
+                    gr = groups[0]
+                    break
+            t_a, it_a, ok_a = timed(ses)
+            row["auto"] = {"end_to_end_s": round(t_a, 4),
+                           "iters_mean": round(it_a, 1),
+                           "converged": ok_a,
+                           "arm": gr.get("arm"),
+                           "phase": gr.get("phase", "exploring"),
+                           "trials": gr.get("trials"),
+                           "tuning_flushes": flushes}
+            row["regret"] = round(t_a / max(row["best_static_s"], 1e-9), 3)
+            row["auto_matches_best"] = bool(
+                gr.get("arm") == best_arm or row["regret"] <= 1.2)
+            row["beats_global_static"] = bool(
+                ok_a and statics["static"]["converged"]
+                and t_a < statics["static"]["end_to_end_s"])
+            out[name] = row
+            if name == "pde_ill":
+                restart_args = (mats, rhs, maxiter)
+
+        out["auto_matches_best_all"] = all(
+            out[p]["auto_matches_best"] for p in profiles)
+        out["beats_global_static_any"] = any(
+            out[p]["beats_global_static"] for p in profiles)
+        out["win"] = bool(out["auto_matches_best_all"]
+                          and out["beats_global_static_any"])
+        # trend/report scalars: worst pick quality across profiles and
+        # the headline auto-vs-global-default win on the ill profile
+        out["regret_worst"] = max(out[p]["regret"] for p in profiles)
+        ill = out["pde_ill"]
+        if ill["static"]["static"]["converged"]:
+            out["ill_speedup_vs_global"] = round(
+                ill["static"]["static"]["end_to_end_s"]
+                / max(ill["auto"]["end_to_end_s"], 1e-9), 2)
+
+        # restart drill: fresh process (in-process tier cleared, vault
+        # retained, NEW tuner) — tuned from the first request
+        if restart_args is not None:
+            mats, rhs, maxiter = restart_args
+            plan_cache.clear()
+            ap2 = autopilot.Autopilot(grid=autopilot.DEFAULT_GRID)
+            ses2 = SolveSession("cg", batch_max=B, warm_start=True,
+                                warm_async=False, autopilot=ap2)
+            ses2.solve_many(mats, rhs, tol=tol, maxiter=maxiter)
+            groups = list(ses2.session_stats().get(
+                "autopilot", {}).get("groups", {}).values())
+            g2 = groups[0] if groups else {}
+            out["restart"] = {
+                "restored": bool(g2.get("restored")),
+                "arm": g2.get("arm"),
+                "replayed": ses2.warm_replayed,
+                "tuned_from_first_request": bool(
+                    g2.get("restored") and g2.get("phase") == "converged"),
+            }
+    finally:
+        settings.vault = old_vault
+        shutil.rmtree(vdir, ignore_errors=True)
+    return out
+
+
 def run_sustained_cg(n: int = 512, B: int = 8, rate: float = 150.0,
                      duration: float = 1.5, slo_ms: float = 250.0,
                      seed: int = 23) -> dict:
@@ -1386,6 +1573,10 @@ def worker(platform_arg: str) -> None:
             rec["mixed_cg"] = run_mixed_cg()
         except Exception:
             traceback.print_exc(file=sys.stderr)
+        try:  # stage 4.11: autopilot policy-tuning row (ISSUE 16)
+            rec["auto_cg"] = run_auto_cg()
+        except Exception:
+            traceback.print_exc(file=sys.stderr)
         print(json.dumps(rec))
         sys.stdout.flush()
         try:  # stage 5: full fused sweep — refines the headline if better
@@ -1446,6 +1637,10 @@ def worker(platform_arg: str) -> None:
             traceback.print_exc(file=sys.stderr)
         try:  # mixed-precision row (ISSUE 15, the CPU lane)
             rec["mixed_cg"] = run_mixed_cg()
+        except Exception:
+            traceback.print_exc(file=sys.stderr)
+        try:  # autopilot policy-tuning row (ISSUE 16, the CPU lane)
+            rec["auto_cg"] = run_auto_cg()
         except Exception:
             traceback.print_exc(file=sys.stderr)
         print(json.dumps(rec))

@@ -258,6 +258,70 @@ def _alerts_rollup(events) -> dict:
     }
 
 
+def _autopilot_rollup(events) -> dict:
+    """The policy-tuner table (ISSUE 16): per tuning group, the chosen
+    arm (last ``autopilot.converge``/``autopilot.restore`` wins), how
+    it was pinned (tuned online vs restored from the vault), trial
+    counts, per-arm measured score medians from ``autopilot.trial``
+    events, and the measured REGRET of the chosen arm vs the
+    best-scoring candidate (1.0 == picked the fastest measured arm;
+    ``None`` while a group is still exploring). ``reopens``/``aborts``
+    count the loop's churn — drift re-explorations and SLO-guard
+    kills."""
+    groups: dict = {}
+    for e in events:
+        kind = str(e.get("kind", ""))
+        if not kind.startswith("autopilot."):
+            continue
+        g = groups.setdefault(str(e.get("group", "?")), {
+            "chosen": None, "chosen_score_ms": None, "source": None,
+            "trials": 0, "arms": {}, "converges": 0, "restores": 0,
+            "reopens": 0, "aborts": 0,
+        })
+        if kind == "autopilot.trial":
+            g["trials"] += 1
+            if _num(e.get("score_ms")) is not None:
+                g["arms"].setdefault(str(e.get("arm", "?")), []).append(
+                    float(e["score_ms"])
+                )
+        elif kind == "autopilot.converge":
+            g["converges"] += 1
+            g["chosen"] = e.get("arm")
+            g["chosen_score_ms"] = _num(e.get("score_ms"))
+            g["source"] = "tuned"
+        elif kind == "autopilot.restore":
+            g["restores"] += 1
+            g["chosen"] = e.get("arm")
+            g["chosen_score_ms"] = _num(e.get("score_ms"))
+            g["source"] = "restored"
+        elif kind == "autopilot.reopen":
+            g["reopens"] += 1
+        elif kind == "autopilot.abort":
+            g["aborts"] += 1
+    for g in groups.values():
+        meds = {
+            arm: round(_percentile(sorted(scores), 0.50), 4)
+            for arm, scores in sorted(g["arms"].items())
+        }
+        g["arms"] = meds
+        best = min(meds.values()) if meds else None
+        sc = g["chosen_score_ms"]
+        g["regret"] = (
+            round(sc / best, 3)
+            if best is not None and sc is not None and best > 0 else None
+        )
+    return {
+        "n_groups": len(groups),
+        "converged": sum(
+            1 for g in groups.values() if g["chosen"] is not None
+        ),
+        "trials": sum(g["trials"] for g in groups.values()),
+        "reopens": sum(g["reopens"] for g in groups.values()),
+        "aborts": sum(g["aborts"] for g in groups.values()),
+        "groups": groups,
+    }
+
+
 def _programs_rollup(events, peak_gflops=None, peak_gbs=None) -> dict:
     """The achieved-vs-roofline table: ``plan_cache.compile``
     attribution (compile wall-clock, XLA flops/bytes/peak HBM per
@@ -471,6 +535,7 @@ def build_report(records_path: str, bench_paths=(), peak_gflops=None,
     comm = _comm_rollup(events, peak_ici_gbs)
     load = _load_rollup(events)
     alerts = _alerts_rollup(events)
+    auto = _autopilot_rollup(events)
     programs = _programs_rollup(events, peak_gflops, peak_gbs)
     cold_start_s = round(sum(
         (_num(p.get("compile_s")) or 0.0) + (_num(p.get("pack_s")) or 0.0)
@@ -627,6 +692,20 @@ def build_report(records_path: str, bench_paths=(), peak_gflops=None,
                        ("bytes_ratio_bf16", True)):
             if _num(mixed_row.get(k)) is not None:
                 metrics[f"mixed_cg.{k}"] = {"v": mixed_row[k], "hib": hib}
+    # the bench auto_cg row (ISSUE 16): the online policy tuner's pick
+    # quality (worst regret vs the best measured static across
+    # profiles) and its headline win over the single global default —
+    # informational vs older baselines, gated once both sides carry it
+    auto_row = None
+    for e in sorted(sessions, key=lambda e: e.get("ts", 0)):
+        rec = e.get("record")
+        if isinstance(rec, dict) and isinstance(rec.get("auto_cg"), dict):
+            auto_row = rec["auto_cg"]
+    if auto_row:
+        for k, hib in (("regret_worst", False),
+                       ("ill_speedup_vs_global", True)):
+            if _num(auto_row.get(k)) is not None:
+                metrics[f"auto_cg.{k}"] = {"v": auto_row[k], "hib": hib}
     for key, p in programs.items():
         if _num(p.get("achieved_gflops")) is not None:
             metrics[f"program.{key}.achieved_gflops"] = {
@@ -671,6 +750,8 @@ def build_report(records_path: str, bench_paths=(), peak_gflops=None,
         "sustained_row": sustained_row,
         "precond_row": precond_row,
         "mixed_row": mixed_row,
+        "auto_row": auto_row,
+        "autopilot": auto,
         "bench": bench_rows,
         "metrics": metrics,
     }
@@ -690,6 +771,7 @@ _TREND_EMBEDS = (
     ("precond_cg", ("end_to_end_s", "iters_mean", "build_s", "speedup")),
     ("mixed_cg", ("exact_s", "f32ir_s", "bf16ir_s", "speedup",
                   "bytes_ratio_bf16")),
+    ("auto_cg", ("regret_worst", "ill_speedup_vs_global")),
 )
 
 
@@ -985,6 +1067,30 @@ def _print_report(rep: dict) -> None:
             f"{mrow.get('bytes_ratio_bf16')}x, "
             f"profile={mrow.get('profile')})"
         )
+    arow = rep.get("auto_row")
+    if arow:
+        print(
+            "  auto_cg: worst regret vs best static "
+            f"{arow.get('regret_worst')}, "
+            f"{arow.get('ill_speedup_vs_global')}x vs the global default "
+            f"on pde_ill (win={arow.get('win')})"
+        )
+    auto = rep.get("autopilot") or {}
+    if auto.get("n_groups"):
+        print(
+            f"  autopilot: {auto['converged']}/{auto['n_groups']} "
+            f"group(s) converged, {auto['trials']} trial(s), "
+            f"{auto['reopens']} reopen(s), {auto['aborts']} "
+            "SLO abort(s)"
+        )
+        for gid, g in sorted(auto["groups"].items()):
+            chosen = g.get("chosen") or "exploring"
+            print(
+                f"    {gid:<36} {chosen} "
+                f"[{g.get('source') or '-'}] trials={g['trials']} "
+                f"score={g.get('chosen_score_ms')}ms "
+                f"regret={g.get('regret')}"
+            )
     progs = rep.get("programs") or {}
     if progs:
         print(

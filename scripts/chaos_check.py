@@ -97,6 +97,19 @@ chains are missing:
    carries its ``dtype_policy``; after clean traffic, a fresh process
    replays the precision-KEYED (``.Pf32ir``-suffixed) program and
    serves the mixed fast path at ZERO plan-cache misses.
+13. **Autopilot regression** (ISSUE 16 acceptance drill) — the online
+   policy tuner converges on live traffic, then the drill PLANTS a
+   regression: a bad reduced-precision decision forced over every
+   group with an optimistic score (``force_decision``). Pinned
+   observations must strike the ``autopilot.drift_strikes`` counter,
+   the ``autopilot_drift`` watchdog rule must alert and re-open
+   exploration through the process-global hook (``autopilot.reopen``
+   with a ``watchdog:`` reason), the group must re-converge from
+   fresh measurements (a second ``autopilot.converge``), every lane
+   stays converged throughout, and the re-converged decision artifact
+   must survive a vault restart — a FRESH tuner restores it
+   (``autopilot.restore``) and serves tuned from the first request
+   with zero trials.
 
 Telemetry is pointed at a temp sink (never the committed
 ``results/axon/records.jsonl``). Wired into the quick lane through
@@ -319,6 +332,182 @@ def run(report: dict) -> list:
     # -- 12. mixed-precision chaos: promote_dtype rung + precision-keyed
     #        warm restart ---------------------------------------------------
     problems += _mixed_chaos(report)
+
+    # -- 13. autopilot regression: drift -> watchdog reopen -> re-converge --
+    problems += _autopilot_chaos(report)
+    return problems
+
+
+def _autopilot_chaos(report: dict) -> list:
+    """Scenario 13 (ISSUE 16): a planted policy regression mid-run. The
+    tuner converges on live traffic, the drill then forces a bad
+    (reduced-precision) decision with an optimistic planted score — the
+    'environment changed under the pinned policy' shape. Pinned
+    observations must strike the ``autopilot.drift_strikes`` counter,
+    the :func:`drift_rule` watchdog alert must re-open exploration
+    through the process-global hook (``autopilot.reopen`` with a
+    ``watchdog:`` reason), the group must CONVERGE BACK from fresh
+    measurements, and the re-converged decision artifact must survive a
+    vault restart (a fresh tuner serves it from the first request)."""
+    import shutil
+
+    import numpy as np
+
+    from sparse_tpu import autopilot, plan_cache
+    from sparse_tpu import telemetry as tel
+    from sparse_tpu.batch import SolveSession
+    from sparse_tpu.config import settings
+    from sparse_tpu.resilience import faults
+    from sparse_tpu.telemetry import _metrics, _watchdog
+
+    problems = []
+    tel.reset()
+    faults.clear()
+    vdir = tempfile.mkdtemp(prefix="chaos_autopilot_vault_")
+    old_vault = settings.vault
+    settings.vault = vdir
+    try:
+        plan_cache.clear()
+        rng = np.random.default_rng(61)
+        mats = []
+        for _ in range(4):
+            M = _tridiag(N)
+            M.setdiag(3.0 + rng.random(N))
+            M.sort_indices()
+            mats.append(M.tocsr())
+        rhs = rng.standard_normal((4, N))
+
+        ap = autopilot.Autopilot(grid=({}, {"precond": "jacobi"}),
+                                 epsilon=1.0, trials=1, drift=2.0)
+        ses = SolveSession("cg", warm_start=False, autopilot=ap)
+
+        def group():
+            groups = list(ses.session_stats().get(
+                "autopilot", {}).get("groups", {}).values())
+            return groups[0] if groups else {}
+
+        def serve(times=1):
+            worst = 0.0
+            for _ in range(times):
+                X, _i, _r2 = ses.solve_many(mats, rhs, tol=TOL)
+                worst = max(worst, max(
+                    float(np.linalg.norm(m @ x - b))
+                    for m, x, b in zip(mats, X, rhs)))
+            return worst
+
+        # phase 1: converge on live traffic
+        for flushes in range(1, 31):
+            worst = serve()
+            if group().get("phase") == "converged":
+                break
+        g1 = group()
+        if g1.get("phase") != "converged":
+            problems.append("autopilot: tuner never converged on clean "
+                            f"traffic ({flushes} flushes)")
+            return problems
+        arm1, score1 = g1["arm"], g1["score_ms"]
+
+        # the drift watchdog primes BEFORE the regression (windowed
+        # delta: first tick snapshots, later ticks see new strikes)
+        wd = _watchdog.Watchdog(rules=[autopilot.drift_rule()],
+                                interval_s=0.0)
+        wd.evaluate()
+        quiet = wd.evaluate()
+        if any(t.get("rule") == "autopilot_drift" for t in quiet):
+            problems.append("autopilot: drift rule fired before the "
+                            "planted regression")
+
+        # phase 2: plant the regression — a reduced-precision arm pinned
+        # with a score real traffic cannot meet (belief vs reality)
+        strikes0 = float(_metrics.counter("autopilot.drift_strikes").value)
+        ap.force_decision({"dtype_policy": "f32ir"},
+                          score=max(score1, 1e-3) / 4.0)
+        worst = max(worst, serve(times=3))
+        strikes = float(
+            _metrics.counter("autopilot.drift_strikes").value) - strikes0
+        transitions = wd.evaluate()
+        alerted = any(
+            t.get("event") == "alert" and t.get("rule") == "autopilot_drift"
+            for t in transitions)
+        g2 = group()
+
+        # phase 3: converge back from fresh measurements
+        for reflushes in range(1, 31):
+            worst = max(worst, serve())
+            if group().get("phase") == "converged":
+                break
+        g3 = group()
+
+        kinds = _event_kinds(tel)
+        reopen_reasons = [
+            e.get("reason") for e in tel.events()
+            if e.get("kind") == "autopilot.reopen"
+        ]
+        report["autopilot_chaos"] = {
+            "converged_arm": arm1, "score_ms": score1,
+            "drift_strikes": strikes, "alerted": alerted,
+            "reopened_phase": g2.get("phase"),
+            "reopen_reasons": reopen_reasons,
+            "reconverged": g3, "worst_resid": worst, "events": kinds,
+        }
+        if strikes < 1:
+            problems.append("autopilot: planted regression produced no "
+                            "drift strikes")
+        if not alerted:
+            problems.append("autopilot: drift watchdog rule never alerted")
+        if g2.get("phase") != "exploring":
+            problems.append("autopilot: watchdog alert did not re-open "
+                            f"exploration (phase={g2.get('phase')!r})")
+        if kinds.get("autopilot.reopen", 0) < 1 or not any(
+                str(r).startswith("watchdog:") for r in reopen_reasons):
+            problems.append("autopilot: no autopilot.reopen event with a "
+                            "watchdog: reason")
+        if kinds.get("autopilot.converge", 0) < 2:
+            problems.append("autopilot: no second autopilot.converge "
+                            "after the reopen")
+        if g3.get("phase") != "converged":
+            problems.append("autopilot: tuner never re-converged after "
+                            f"the regression ({reflushes} flushes)")
+        if worst > 10 * TOL:
+            problems.append(f"autopilot: a lane went unconverged during "
+                            f"the drill (worst ||r||={worst:.2e})")
+
+        # phase 4: the re-converged decision survives a vault restart —
+        # fresh process (tier 1 cleared, NEW tuner), tuned immediately
+        plan_cache.clear()
+        ap2 = autopilot.Autopilot(grid=({}, {"precond": "jacobi"}),
+                                  epsilon=1.0, trials=1, drift=2.0)
+        ses = SolveSession("cg", warm_start=True, warm_async=False,
+                           autopilot=ap2)
+        worst2 = serve()
+        gr = group()
+        restored_events = _event_kinds(tel).get("autopilot.restore", 0)
+        report["autopilot_chaos"]["restart"] = {
+            "restored": gr.get("restored"), "arm": gr.get("arm"),
+            "trials": gr.get("trials"), "replayed": ses.warm_replayed,
+            "restore_events": restored_events, "worst_resid": worst2,
+        }
+        if not gr.get("restored") or gr.get("phase") != "converged":
+            problems.append("autopilot: decision artifact did not survive "
+                            "the vault restart")
+        if gr.get("arm") != g3.get("arm"):
+            problems.append(
+                f"autopilot: restart restored arm {gr.get('arm')!r}, "
+                f"expected the re-converged {g3.get('arm')!r}")
+        if gr.get("trials", 1) != 0:
+            problems.append("autopilot: restored group spent trials "
+                            "re-exploring (expected tuned-from-first-"
+                            "request)")
+        if restored_events < 1:
+            problems.append("autopilot: no autopilot.restore event on the "
+                            "restart")
+        if worst2 > 10 * TOL:
+            problems.append("autopilot: restart traffic unconverged "
+                            f"(worst ||r||={worst2:.2e})")
+    finally:
+        settings.vault = old_vault
+        plan_cache.clear()
+    shutil.rmtree(vdir, ignore_errors=True)
     return problems
 
 
@@ -1359,6 +1548,7 @@ def main(argv) -> int:
         pa = report.get("pipeline_admission", {})
         mp = report.get("mixed_promote", {})
         mw = report.get("mixed_warm_restart", {})
+        ac = report.get("autopilot_chaos", {})
         print(
             "chaos check passed: "
             f"{len([k for k in report if k.startswith('solver.')])} solvers "
@@ -1384,7 +1574,11 @@ def main(argv) -> int:
             f"mixed promote_dtype ok ({mp.get('promotions', 0):.0f} "
             "promotion(s), converged at exact), mixed warm restart "
             f"({mw.get('replayed', 0)} precision-keyed program(s), "
-            f"{mw.get('serving_misses', '?')} serving misses)"
+            f"{mw.get('serving_misses', '?')} serving misses), "
+            f"autopilot drift->reopen->reconverge ok "
+            f"({ac.get('drift_strikes', 0):.0f} strike(s), re-pinned "
+            f"{ac.get('reconverged', {}).get('arm', '?')!r}, restart "
+            f"restored={ac.get('restart', {}).get('restored', '?')})"
         )
     return 1 if problems else 0
 

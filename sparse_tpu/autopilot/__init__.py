@@ -1,0 +1,27 @@
+"""Autopilot: online per-(pattern, bucket, SLO class) policy tuning
+(ISSUE 16) — see :mod:`.policy` for the full story. Public surface:
+
+* :class:`Autopilot` — the trial scheduler (``SolveSession(autopilot=)``
+  or ``SPARSE_TPU_AUTOPILOT=1``).
+* :class:`PolicyDecision` — one pinned tuning outcome.
+* :data:`DEFAULT_GRID` / :func:`arm_id` / :func:`slo_class` /
+  :func:`grid_fingerprint` — the candidate-grid vocabulary.
+* :func:`drift_rule` — the watchdog rule that turns drift strikes into
+  an alert (whose transition re-opens exploration).
+"""
+
+from .policy import (  # noqa: F401
+    ARM_KEYS,
+    DEFAULT_GRID,
+    Autopilot,
+    PolicyDecision,
+    arm_id,
+    drift_rule,
+    grid_fingerprint,
+    slo_class,
+)
+
+__all__ = [
+    "ARM_KEYS", "DEFAULT_GRID", "Autopilot", "PolicyDecision", "arm_id",
+    "drift_rule", "grid_fingerprint", "slo_class",
+]

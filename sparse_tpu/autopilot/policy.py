@@ -1,0 +1,660 @@
+"""Autopilot: the online policy tuner that closes the telemetry ->
+configuration loop (ISSUE 16, ROADMAP item 2).
+
+PRs 13-15 gave every request a policy vector — solver x precond kind x
+dtype policy x precond storage dtype x inflight depth — and Axon
+measures every choice, yet every knob was still a static env/config
+value. This module turns the measurements back into configuration: a
+per-(pattern, bucket, SLO class) trial scheduler runs *cheap measured
+experiments* over a declared candidate grid on live traffic, converges
+to a pinned :class:`PolicyDecision`, persists it as a vault artifact so
+a restarted process serves tuned from the first request, and re-opens
+exploration when the watchdog flags drift or the mixed-precision
+promote rate spikes.
+
+Scheduling (deterministic — no RNG, so runs replay exactly):
+
+* **Bounded epsilon-greedy**: during exploration only every
+  ``round(1/epsilon)``-th dispatch of a group is an experiment; the
+  rest serve the incumbent (best arm so far), so exploration cost is a
+  bounded fraction of traffic and a tenant's p95 rides the incumbent.
+* **Successive halving**: experiments cycle round-robin over the
+  surviving arms; once every survivor has ``trials`` fresh
+  observations the worst half (by median score) is eliminated. One
+  survivor = convergence.
+* **SLO guard**: an experimental observation slower than
+  ``slo_factor x slo_ms`` aborts its arm immediately (``autopilot.
+  abort``) — a bad candidate costs at most one over-budget dispatch
+  per group, never a tail.
+
+Scoring uses Axon's measured numbers for the dispatch: the sampled
+``device_ms`` when the profiler took one, else the solve wall clock,
+per real lane; unconverged or promoted buckets score infinitely bad.
+
+Drift reopening (the loop stays closed *after* convergence):
+
+* every incumbent observation worse than ``drift x`` the pinned
+  decision's score counts a strike into the always-on
+  ``autopilot.drift_strikes`` counter — :func:`drift_rule` packages
+  that counter as a watchdog rule, and any watchdog alert transition
+  re-opens every converged group (``autopilot.reopen``);
+* a ``mixed.promote`` under a pinned reduced-precision arm re-opens
+  its group directly (the promote listener on
+  :class:`sparse_tpu.mixed.DtypePolicy`);
+* SLO breaches under the pinned decision count into
+  ``autopilot.slo_breaches`` (another watchdog-visible series).
+
+Persistence: decisions are vault artifacts (kind
+``autopilot_policy``), keyed by content — pattern fingerprint, solver,
+bucket, dtype, SLO class, mesh fingerprint and the *grid fingerprint*
+(a changed candidate grid invalidates stored decisions). The tuned
+bucket programs themselves replay through the ordinary warm-start
+manifest (``note_program`` records the arm's precond/dtype-policy/
+precond-dtype key parts), so a restart is tuned AND compiled from the
+first request.
+"""
+
+from __future__ import annotations
+
+import json
+import weakref
+
+import numpy as np
+
+from .. import telemetry
+from ..config import settings
+from ..telemetry import _metrics
+
+#: the default candidate grid for f64 CG serving traffic: the session's
+#: static policy as the control arm, the two Jacobi preconditioners,
+#: the f32 iterative-refinement fast path, the precond x mixed
+#: combination, and the compounding arm that ALSO factorizes/applies
+#: the preconditioner in the reduced storage dtype (ISSUE 16's
+#: explicitly-open work — today the two wins don't multiply).
+DEFAULT_GRID = (
+    {},
+    {"precond": "jacobi"},
+    {"precond": "bjacobi"},
+    {"dtype_policy": "f32ir"},
+    {"precond": "bjacobi", "dtype_policy": "f32ir"},
+    {"precond": "bjacobi", "dtype_policy": "f32ir",
+     "precond_dtype": "storage"},
+)
+
+#: arm-spec keys the trial scheduler understands (anything else is a
+#: declaration error, raised at construction — a typo'd grid must not
+#: silently explore nothing)
+ARM_KEYS = ("solver", "precond", "dtype_policy", "precond_dtype",
+            "inflight")
+
+_OFF = ("", "0", "off", "false", "no", "none")
+
+
+def slo_class(slo_ms) -> str:
+    """Tenant SLO class of a session latency objective: the grouping
+    axis that keeps a latency-sensitive tenant's tuning separate from
+    batch traffic over the same pattern (their optimal arms differ —
+    exploration budgets too)."""
+    if slo_ms is None:
+        return "none"
+    s = float(slo_ms)
+    if s <= 100.0:
+        return "tight"
+    if s <= 1000.0:
+        return "standard"
+    return "relaxed"
+
+
+def arm_id(spec: dict) -> str:
+    """Stable human-readable arm label (telemetry / report join key):
+    ``'static'`` for the empty control arm, else the non-default parts
+    joined in declaration order."""
+    parts = [
+        f"{k}={spec[k]}" for k in ARM_KEYS if spec.get(k) not in (None, "")
+    ]
+    return "+".join(parts) if parts else "static"
+
+
+def _canonical_spec(spec: dict) -> dict:
+    """Validate one candidate arm at declaration time."""
+    from .. import mixed as mixed_mod
+    from .. import precond as precond_mod
+
+    out = {}
+    for k, v in dict(spec).items():
+        if k not in ARM_KEYS:
+            raise ValueError(
+                f"unknown arm key {k!r} (must be one of {ARM_KEYS})"
+            )
+        if v in (None, ""):
+            continue
+        if k == "precond":
+            v = precond_mod.canonical_kind(v)
+        elif k == "dtype_policy":
+            v = mixed_mod.canonical_policy(v)
+        elif k == "precond_dtype":
+            v = precond_mod.canonical_precond_dtype(v)
+        elif k == "inflight":
+            v = max(int(v), 1)
+        elif k == "solver":
+            v = str(v)
+        out[k] = v
+    return out
+
+
+def grid_fingerprint(grid) -> str:
+    """Content fingerprint of a candidate grid — part of every
+    decision's vault key, so a changed grid can never serve a stale
+    decision."""
+    from ..vault import _codecs
+
+    return _codecs.digest(
+        "apgrid", json.dumps([dict(sorted(g.items())) for g in grid],
+                             sort_keys=True),
+    )
+
+
+class PolicyDecision:
+    """One pinned tuning outcome: the winning arm, its measured score
+    (ms per lane, lower better) and how much evidence backed it."""
+
+    __slots__ = ("spec", "score", "trials", "restored")
+
+    def __init__(self, spec: dict, score: float, trials: int,
+                 restored: bool = False):
+        self.spec = dict(spec)
+        self.score = float(score)
+        self.trials = int(trials)
+        self.restored = bool(restored)
+
+    @property
+    def arm(self) -> str:
+        return arm_id(self.spec)
+
+    def to_meta(self) -> dict:
+        return {"spec": dict(self.spec), "score": self.score,
+                "trials": self.trials}
+
+    @classmethod
+    def from_meta(cls, meta: dict) -> "PolicyDecision":
+        return cls(dict(meta["spec"]), float(meta["score"]),
+                   int(meta["trials"]), restored=True)
+
+
+class _Arm:
+    __slots__ = ("spec", "scores", "dead")
+
+    def __init__(self, spec: dict):
+        self.spec = spec
+        self.scores: list = []
+        self.dead = False
+
+    def median(self) -> float:
+        if not self.scores:
+            return float("inf")
+        return float(np.median(self.scores))
+
+
+class _Group:
+    """Per-(pattern, solver, bucket, dtype, SLO class) tuning state."""
+
+    __slots__ = ("gid", "arms", "decision", "seq", "next_arm", "round",
+                 "strikes", "vault_key", "noted")
+
+    def __init__(self, gid: str, grid):
+        self.gid = gid
+        self.arms = [_Arm(dict(g)) for g in grid]
+        self.decision: PolicyDecision | None = None
+        self.seq = 0  # dispatch counter (the deterministic epsilon clock)
+        self.next_arm = 0  # round-robin cursor over live arms
+        self.round = 0  # successive-halving rounds completed
+        self.strikes = 0  # consecutive drifted incumbent observations
+        self.vault_key: str | None = None
+        self.noted = False
+
+    def live(self) -> list:
+        return [a for a in self.arms if not a.dead]
+
+    def best(self) -> _Arm:
+        live = self.live() or self.arms
+        return min(live, key=lambda a: a.median())
+
+
+# -- module-level drift plumbing (process-global, like watchdog hooks) ------
+_LIVE: "weakref.WeakSet" = weakref.WeakSet()
+_HOOKED = {"watchdog": False, "promote": False}
+
+
+def _on_alert(transition: dict) -> None:
+    """The watchdog drift hook: ANY rule's ok -> firing transition
+    re-opens exploration in every live autopilot (drift in the serving
+    system invalidates what was measured before it)."""
+    for ap in list(_LIVE):
+        ap.reopen_all(reason=f"watchdog:{transition.get('rule', '?')}")
+
+
+def _on_promote(**kw) -> None:
+    """The mixed-precision promote listener: a promote rung firing
+    means a reduced-precision policy went anomalous — any group pinned
+    to a reduced arm re-opens (its measurements predate the anomaly)."""
+    for ap in list(_LIVE):
+        ap.reopen_reduced(reason=f"promote:{kw.get('reason', '?')}")
+
+
+def _install_hooks() -> None:
+    if not _HOOKED["watchdog"]:
+        from ..telemetry import _watchdog
+
+        _watchdog.add_alert_hook(_on_alert)
+        _HOOKED["watchdog"] = True
+    if not _HOOKED["promote"]:
+        from ..mixed import policy as mixed_policy
+
+        mixed_policy.add_promote_listener(_on_promote)
+        _HOOKED["promote"] = True
+
+
+def drift_rule(threshold: int = 1):
+    """A watchdog rule over the always-on ``autopilot.drift_strikes``
+    counter: fires when at least ``threshold`` strikes land in one
+    evaluation window — the wiring that makes drift reopening an
+    *alerting* path (flight-recorder capture and all) instead of a
+    silent internal transition. Add it to a Watchdog's rule list; the
+    alert transition itself re-opens exploration through the
+    process-global hook."""
+    from ..telemetry import _watchdog
+
+    counter = _metrics.counter(
+        "autopilot.drift_strikes",
+        help="incumbent observations slower than drift x the pinned "
+        "decision score",
+    )
+    value = _watchdog._windowed_delta(lambda: counter.value)
+    return _watchdog.Rule(
+        "autopilot_drift", value, trigger=float(threshold) - 0.5,
+        op=">", severity="warn", clear=0.0,
+    )
+
+
+class Autopilot:
+    """The per-session (shareable) trial scheduler.
+
+    Parameters
+    ----------
+    grid : candidate arm specs (dicts over :data:`ARM_KEYS`); default
+        :data:`DEFAULT_GRID`.
+    epsilon : bounded exploration fraction — during exploration one in
+        ``round(1/epsilon)`` dispatches is an experiment (default
+        ``settings.autopilot_epsilon``).
+    trials : observations per arm per successive-halving round
+        (default ``settings.autopilot_trials``).
+    slo_factor : the SLO guard — an experiment slower than
+        ``slo_factor x slo_ms`` aborts its arm (default
+        ``settings.autopilot_slo_factor``).
+    drift : incumbent regression factor that counts a drift strike
+        (default ``settings.autopilot_drift``).
+    """
+
+    def __init__(self, grid=None, epsilon: float | None = None,
+                 trials: int | None = None,
+                 slo_factor: float | None = None,
+                 drift: float | None = None):
+        grid = DEFAULT_GRID if grid is None else tuple(grid)
+        self.grid = tuple(_canonical_spec(g) for g in grid)
+        if not self.grid:
+            raise ValueError("autopilot grid must declare at least one arm")
+        eps = float(
+            settings.autopilot_epsilon if epsilon is None else epsilon
+        )
+        self.period = max(int(round(1.0 / max(min(eps, 1.0), 1e-3))), 1)
+        self.trials = max(
+            int(settings.autopilot_trials if trials is None else trials), 1
+        )
+        self.slo_factor = float(
+            settings.autopilot_slo_factor if slo_factor is None
+            else slo_factor
+        )
+        self.drift = float(
+            settings.autopilot_drift if drift is None else drift
+        )
+        self._grid_fp: str | None = None
+        self._groups: dict = {}
+        _LIVE.add(self)
+        _install_hooks()
+
+    @classmethod
+    def resolve(cls, autopilot=None):
+        """The ``SolveSession`` constructor hook: ``autopilot`` may be
+        a ready :class:`Autopilot`, ``True`` / a truthy mode string
+        (= default grid), ``False`` (= off regardless of env), or
+        ``None`` (= ``SPARSE_TPU_AUTOPILOT``). Returns ``None`` when
+        off — the session then carries no tuner and every code path is
+        byte-identical to pre-autopilot behavior."""
+        if isinstance(autopilot, cls):
+            return autopilot
+        if autopilot is None:
+            autopilot = settings.autopilot
+        if autopilot is False:
+            return None
+        if autopilot is True:
+            return cls()
+        if str(autopilot).strip().lower() in _OFF:
+            return None
+        return cls()
+
+    # -- group resolution ---------------------------------------------------
+    def _gid(self, pattern, solver: str, bucket: int, dtype,
+             slo_ms) -> str:
+        return (
+            f"{pattern.fingerprint[2][:12]}.{solver}.B{int(bucket)}."
+            f"{np.dtype(dtype).str}.{slo_class(slo_ms)}"
+        )
+
+    def _grid_fingerprint(self) -> str:
+        if self._grid_fp is None:
+            self._grid_fp = grid_fingerprint(self.grid)
+        return self._grid_fp
+
+    def _group(self, pattern, solver: str, bucket: int, dtype,
+               slo_ms, mesh_fp: str | None = None) -> _Group:
+        gid = self._gid(pattern, solver, bucket, dtype, slo_ms)
+        g = self._groups.get(gid)
+        if g is not None:
+            return g
+        g = _Group(gid, self.grid)
+        self._groups[gid] = g
+        self._restore(g, pattern, solver, bucket, dtype, slo_ms, mesh_fp)
+        return g
+
+    def _restore(self, g: _Group, pattern, solver, bucket, dtype,
+                 slo_ms, mesh_fp) -> None:
+        """First-touch vault lookup: a persisted decision (same
+        pattern/bucket/SLO class/mesh/grid) serves tuned from the
+        first request — zero exploration after a restart."""
+        from .. import vault
+        from ..vault import _codecs
+
+        if not vault.enabled():
+            return
+        try:
+            g.vault_key = _codecs.digest(
+                "appolicy", pattern.fingerprint[2], solver, int(bucket),
+                np.dtype(dtype).str, slo_class(slo_ms), mesh_fp or "",
+                self._grid_fingerprint(),
+            )
+            meta = vault.fetch("autopilot_policy", g.vault_key)
+        except Exception:  # noqa: BLE001 - restore is never a liability
+            return
+        if not isinstance(meta, dict) or "spec" not in meta:
+            return
+        try:
+            dec = PolicyDecision.from_meta(meta)
+            dec.spec = _canonical_spec(dec.spec)  # re-validate stored spec
+        except Exception:  # noqa: BLE001 - stale/corrupt meta: explore
+            return
+        g.decision = dec
+        _metrics.counter(
+            "autopilot.decisions", source="restored",
+            help="policy decisions pinned, by source (tuned = converged "
+            "online, restored = vault warm start)",
+        ).inc()
+        if telemetry.enabled():
+            telemetry.record(
+                "autopilot.restore", group=g.gid, arm=dec.arm,
+                score_ms=round(dec.score, 4), trials=dec.trials,
+            )
+
+    # -- the serving-path hook ----------------------------------------------
+    def assign(self, pattern, solver: str, bucket: int, dtype,
+               slo_ms=None, mesh_fp: str | None = None):
+        """Pick the policy arm for one dispatch. Returns ``(spec,
+        token)``: ``spec`` the arm's override dict (empty = session
+        statics) and ``token`` the observation handle
+        :meth:`observe` settles — ``None`` token when the dispatch is
+        not an experiment (incumbent/pinned traffic still observes,
+        for drift detection, via a distinct token kind)."""
+        g = self._group(pattern, solver, bucket, dtype, slo_ms, mesh_fp)
+        g.seq += 1
+        if g.decision is not None:
+            return dict(g.decision.spec), (g.gid, "pinned", None, slo_ms)
+        live = g.live()
+        if not live:  # every arm SLO-aborted: serve the control arm
+            return {}, None
+        explore = len(live) > 1 and (g.seq - 1) % self.period == 0
+        if not explore:
+            best = g.best()
+            return dict(best.spec), (g.gid, "incumbent", None, slo_ms)
+        # round-robin over live arms, least-observed first so each
+        # halving round fills evenly
+        arm = min(
+            live,
+            key=lambda a: (len(a.scores), self._arm_index(g, a)),
+        )
+        return dict(arm.spec), (g.gid, "trial", self._arm_index(g, arm),
+                                slo_ms)
+
+    def _arm_index(self, g: _Group, arm: _Arm) -> int:
+        return g.arms.index(arm)
+
+    def observe(self, token, solve_ms: float, device_ms=None,
+                iters_mean: float = 0.0, lanes: int = 1,
+                converged: float = 1.0, promoted: bool = False) -> None:
+        """Settle one dispatch's measurement against its token. The
+        score is measured milliseconds per real lane — ``device_ms``
+        when the sampled profiler took one, else the solve wall clock —
+        with unconverged/promoted buckets scored infinitely bad (a
+        fast wrong answer must never win)."""
+        if token is None:
+            return
+        gid, kind, arm_idx, slo_ms = token
+        g = self._groups.get(gid)
+        if g is None:
+            return
+        ms = float(device_ms if device_ms is not None else solve_ms)
+        score = (
+            float("inf") if (promoted or converged < 1.0)
+            else ms / max(int(lanes), 1)
+        )
+        if kind == "pinned":
+            self._observe_pinned(g, score, promoted)
+            return
+        if kind == "incumbent" or g.decision is not None:
+            return  # converged while this dispatch was in flight
+        arm = g.arms[arm_idx]
+        if arm.dead:
+            return
+        arm.scores.append(score)
+        _metrics.counter(
+            "autopilot.trials",
+            help="measured policy experiments scheduled by the autopilot",
+        ).inc()
+        if telemetry.enabled():
+            telemetry.record(
+                "autopilot.trial", group=gid, arm=arm_id(arm.spec),
+                score_ms=None if score == float("inf")
+                else round(score, 4),
+                solve_ms=round(float(solve_ms), 4),
+                iters_mean=round(float(iters_mean), 3), lanes=int(lanes),
+            )
+        # SLO guard: a candidate blowing the tenant's budget dies NOW
+        if (slo_ms is not None and arm.spec
+                and ms > self.slo_factor * float(slo_ms)):
+            arm.dead = True
+            _metrics.counter(
+                "autopilot.slo_breaches",
+                help="experiments (or pinned dispatches) over the "
+                "SLO-guard budget",
+            ).inc()
+            if telemetry.enabled():
+                telemetry.record(
+                    "autopilot.abort", group=gid, arm=arm_id(arm.spec),
+                    reason="slo_guard", ms=round(ms, 4),
+                    budget_ms=round(self.slo_factor * float(slo_ms), 4),
+                )
+        self._maybe_halve(g)
+
+    def _observe_pinned(self, g: _Group, score: float,
+                        promoted: bool) -> None:
+        """Drift detection on pinned traffic: strikes accumulate into
+        the watchdog-visible counter; a promote under a reduced pinned
+        arm re-opens directly (see also the module promote listener,
+        which covers promotes the session attributes elsewhere)."""
+        dec = g.decision
+        if dec is None:
+            return
+        if promoted and self._reduced(dec.spec):
+            self._reopen(g, reason="promote")
+            return
+        if score > self.drift * max(dec.score, 1e-9):
+            g.strikes += 1
+            _metrics.counter(
+                "autopilot.drift_strikes",
+                help="incumbent observations slower than drift x the "
+                "pinned decision score",
+            ).inc()
+        else:
+            g.strikes = 0
+
+    @staticmethod
+    def _reduced(spec: dict) -> bool:
+        from .. import mixed as mixed_mod
+
+        pol = spec.get("dtype_policy")
+        return bool(pol) and pol != mixed_mod.EXACT
+
+    def _maybe_halve(self, g: _Group) -> None:
+        live = g.live()
+        if len(live) <= 1:
+            self._converge(g)
+            return
+        need = self.trials * (g.round + 1)
+        if any(len(a.scores) < need for a in live):
+            return
+        # eliminate the worst half (keep ceil(k/2)), then either keep
+        # exploring the survivors or converge on the last one standing
+        ranked = sorted(live, key=lambda a: a.median())
+        keep = max((len(live) + 1) // 2, 1)
+        for a in ranked[keep:]:
+            a.dead = True
+        g.round += 1
+        if len(g.live()) <= 1:
+            self._converge(g)
+
+    def _converge(self, g: _Group) -> None:
+        live = g.live()
+        arm = live[0] if live else g.best()
+        score = arm.median()
+        if score == float("inf"):
+            # nothing measured finite (every arm aborted/unconverged):
+            # pin the control arm at a neutral score
+            arm = g.arms[0]
+            score = arm.median() if arm.scores else 0.0
+        g.decision = PolicyDecision(
+            arm.spec, score, sum(len(a.scores) for a in g.arms),
+        )
+        g.strikes = 0
+        _metrics.counter("autopilot.decisions", source="tuned").inc()
+        if telemetry.enabled():
+            telemetry.record(
+                "autopilot.converge", group=g.gid, arm=g.decision.arm,
+                score_ms=round(g.decision.score, 4),
+                trials=g.decision.trials, rounds=g.round,
+            )
+        self._persist(g)
+
+    def _persist(self, g: _Group) -> None:
+        from .. import vault
+
+        if g.vault_key is None or g.decision is None:
+            return
+        try:
+            vault.deposit("autopilot_policy", g.vault_key,
+                          g.decision.to_meta())
+        except Exception:  # noqa: BLE001 - persistence is best-effort
+            pass
+
+    # -- drift reopening ----------------------------------------------------
+    def _reopen(self, g: _Group, reason: str) -> None:
+        if g.decision is None:
+            return
+        g.decision = None
+        g.strikes = 0
+        g.round = 0
+        g.seq = 0
+        for a in g.arms:
+            a.scores = []
+            a.dead = False
+        _metrics.counter(
+            "autopilot.reopens", reason=reason.split(":", 1)[0],
+            help="converged groups re-opened for exploration, by reason",
+        ).inc()
+        if telemetry.enabled():
+            telemetry.record("autopilot.reopen", group=g.gid,
+                             reason=reason)
+
+    def reopen_all(self, reason: str = "manual") -> None:
+        """Re-open exploration in every converged group (the watchdog
+        alert hook's entry point; also a drill surface)."""
+        for g in list(self._groups.values()):
+            self._reopen(g, reason)
+
+    def reopen_reduced(self, reason: str = "promote") -> None:
+        """Re-open every group pinned to a reduced-precision arm (the
+        mixed promote listener's entry point)."""
+        for g in list(self._groups.values()):
+            if g.decision is not None and self._reduced(g.decision.spec):
+                self._reopen(g, reason)
+
+    def force_decision(self, spec: dict, score: float | None = None) -> None:
+        """Chaos-drill surface (scenario 13): overwrite every group's
+        pinned decision with ``spec`` — keeping each group's measured
+        score so drift detection judges the forced arm against the
+        honest baseline. Groups still exploring converge-by-fiat."""
+        spec = _canonical_spec(spec)
+        for g in self._groups.values():
+            base = (
+                g.decision.score if g.decision is not None
+                else g.best().median()
+            )
+            if score is not None:
+                base = float(score)
+            if not np.isfinite(base):
+                base = 1e-6
+            g.decision = PolicyDecision(spec, base, 0)
+            g.strikes = 0
+
+    # -- introspection ------------------------------------------------------
+    def describe(self) -> dict:
+        """JSON-friendly block for ``session_stats()`` / the report."""
+        groups = {}
+        for gid, g in self._groups.items():
+            groups[gid] = {
+                "phase": "converged" if g.decision is not None
+                else "exploring",
+                "arm": None if g.decision is None else g.decision.arm,
+                "score_ms": None if g.decision is None
+                else round(g.decision.score, 4),
+                "restored": bool(g.decision is not None
+                                 and g.decision.restored),
+                "trials": sum(len(a.scores) for a in g.arms),
+                "live_arms": len(g.live()),
+                "rounds": g.round,
+            }
+        return {
+            "arms": [arm_id(s) for s in self.grid],
+            "period": self.period,
+            "trials_per_round": self.trials,
+            "slo_factor": self.slo_factor,
+            "drift": self.drift,
+            "groups": groups,
+        }
+
+    def decision_for(self, pattern, solver: str, bucket: int, dtype,
+                     slo_ms=None):
+        """The pinned :class:`PolicyDecision` for one group, or
+        ``None`` while it is still exploring (test/report surface —
+        never creates a group)."""
+        g = self._groups.get(
+            self._gid(pattern, solver, bucket, dtype, slo_ms)
+        )
+        return None if g is None else g.decision

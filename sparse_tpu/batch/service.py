@@ -94,6 +94,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from .. import autopilot as autopilot_mod
 from .. import fleet as fleet_mod
 from .. import mixed as mixed_mod
 from .. import plan_cache, telemetry
@@ -338,10 +339,10 @@ class SolveTicket:
 
 class _Request:
     __slots__ = ("pattern", "values", "b", "tol", "x0", "maxiter", "ticket",
-                 "precond", "dtype_policy")
+                 "precond", "dtype_policy", "precond_dtype")
 
     def __init__(self, pattern, values, b, tol, x0, maxiter, ticket,
-                 precond=None, dtype_policy=None):
+                 precond=None, dtype_policy=None, precond_dtype=None):
         self.pattern, self.values, self.b = pattern, values, b
         self.tol, self.x0, self.maxiter = tol, x0, maxiter
         self.ticket = ticket
@@ -354,6 +355,10 @@ class _Request:
         # None = session policy, a canonical policy/'exact' forces it,
         # and it joins the flush group key like the precond override.
         self.dtype_policy = dtype_policy
+        # per-ticket precond storage-dtype override (ISSUE 16): None =
+        # session/env, 'compute'/'storage' forces; same grouping/keying
+        # contract as the two overrides above.
+        self.precond_dtype = precond_dtype
 
 
 def _promote(dt: np.dtype) -> np.dtype:
@@ -382,14 +387,23 @@ def donate_argnums() -> tuple:
     return (0, 1, 2) if backend in ("tpu", "gpu", "cuda", "rocm") else ()
 
 
-def _build_ir_program(pack, mixed: dict, solver: str, cti: int, mfac):
+def _build_ir_program(pack, mixed: dict, solver: str, cti: int, mfac,
+                      precond_dtype: str = "compute"):
     """The reduced-precision bucket program (ISSUE 15): values downcast
     once inside the program — f64 planes for the outer residual, the
     policy's storage-width planes for the inner sweep (wide-accumulating
     matvec via ``acc_dtype``) — around the fused iterative-refinement
     loop (:func:`sparse_tpu.mixed.ir_loop`). Same argument signature as
     the exact bucket programs; one extra output (the refinement sweep
-    count)."""
+    count).
+
+    ``precond_dtype='storage'`` (ISSUE 16) hands the preconditioner
+    factory the STORAGE-width value stack instead of the compute-width
+    one — paired with a storage-dtype factory (``PrecondPolicy.factory``
+    with ``storage_dtype``/``acc_dtype``) the factors then live at the
+    reduced width with wide accumulation, compounding the mixed path's
+    memory-traffic win into M. 'compute' is byte-identical to the
+    historic program."""
     idx_slabs, pos, zero_rows = (
         pack.idx_slabs, pack.pos, pack.plan.zero_rows
     )
@@ -418,10 +432,13 @@ def _build_ir_program(pack, mixed: dict, solver: str, cti: int, mfac):
             )
 
         fmv_low = krylov._maybe_faulty_mv(mv_low)
-        # batched numeric factorization at the COMPUTE dtype (ISSUE 15:
-        # the preconditioner follows the storage policy, its application
-        # widened consistently with the inner sweep)
-        Mvec = None if mfac is None else mfac(values.astype(cdt), fmv_low)
+        # batched numeric factorization (ISSUE 15/16): the factory sees
+        # the COMPUTE-width values by default (application widened
+        # consistently with the inner sweep); under precond_dtype=
+        # 'storage' it sees the STORAGE-width stack and the storage-
+        # dtype factory keeps the factors narrow with wide accumulation
+        mdt = sdt if precond_dtype == "storage" else cdt
+        Mvec = None if mfac is None else mfac(values.astype(mdt), fmv_low)
         X, iters, resid2, conv, outer = mixed_mod.ir_loop(
             mv_wide, fmv_low, rhs, x0, tols, maxiter, cti,
             inner_iters, max_outer, eta, cdt, Mvec=Mvec, solver=solver,
@@ -439,11 +456,12 @@ class _InFlight:
 
     __slots__ = ("reqs", "dt", "solver", "allow_requeue", "plan", "key",
                  "bkt", "nb", "out", "built", "snap", "t0", "t_packed",
-                 "t_solve0", "t_dispatched", "sampled", "policy", "_ready")
+                 "t_solve0", "t_dispatched", "sampled", "policy", "auto",
+                 "_ready")
 
     def __init__(self, reqs, dt, solver, allow_requeue, plan, key, bkt,
                  nb, out, built, snap, t0, t_packed, t_solve0,
-                 t_dispatched, sampled, policy=mixed_mod.EXACT):
+                 t_dispatched, sampled, policy=mixed_mod.EXACT, auto=None):
         self.reqs, self.dt, self.solver = reqs, dt, solver
         self.allow_requeue, self.plan, self.key = allow_requeue, plan, key
         self.bkt, self.nb, self.out = bkt, nb, out
@@ -453,6 +471,10 @@ class _InFlight:
         # the resolved dtype policy this bucket ran under (ISSUE 15):
         # 'exact' or a reduced policy — the promote rung keys off it
         self.policy = policy
+        # the autopilot observation token (ISSUE 16): retire settles the
+        # dispatch's measured score against it; None when the tuner is
+        # off or this dispatch bypassed it (requeues, explicit overrides)
+        self.auto = auto
         self._ready = False
 
     def is_ready(self) -> bool:
@@ -621,7 +643,9 @@ class SolveSession:
                  warm_async: bool = True,
                  precond=None,
                  row_precond=None,
-                 dtype_policy=None):
+                 dtype_policy=None,
+                 precond_dtype=None,
+                 autopilot=None):
         if solver not in _SOLVERS:
             raise ValueError(f"solver must be one of {_SOLVERS}")
         if fallback_solver not in _SOLVERS:
@@ -693,6 +717,21 @@ class SolveSession:
         # and the vault manifest. 'exact' (the default env) leaves keys,
         # jaxprs and numerics byte-identical.
         self.dtype_policy = mixed_mod.DtypePolicy.resolve(dtype_policy)
+        # precond storage dtype (ISSUE 16): 'compute' (the default env)
+        # factorizes/stores M at the inner sweep's compute dtype —
+        # byte-identical keys and jaxprs; 'storage' is the compounding
+        # arm (reduced-width factors, wide accumulation, '.W' key
+        # suffix). A typo'd env raises HERE, not mid-dispatch.
+        self.precond_dtype = precond_mod.canonical_precond_dtype(
+            settings.precond_dtype if precond_dtype is None
+            else precond_dtype
+        )
+        # online policy tuner (ISSUE 16, docs/autopilot.md): None when
+        # off (the default env) — the session then carries no tuner
+        # object and every dispatch path is byte-identical to
+        # pre-autopilot behavior. `autopilot` may be a ready Autopilot,
+        # True/a mode string, False, or None = SPARSE_TPU_AUTOPILOT.
+        self.autopilot = autopilot_mod.Autopilot.resolve(autopilot)
         # optional row-shard-lane preconditioner hook: a callable
         # ``make_M(DistCSR) -> padded M`` (e.g. a multigrid V-cycle via
         # parallel.multigrid.vcycle_operator) threaded into
@@ -760,7 +799,8 @@ class SolveSession:
                deadline_s: float | None = None,
                tenant: str | None = None,
                precond: str | None = None,
-               dtype_policy: str | None = None) -> SolveTicket:
+               dtype_policy: str | None = None,
+               precond_dtype: str | None = None) -> SolveTicket:
         """Queue one system. ``A`` is a CSR-shaped matrix (csr_array /
         scipy) or, with ``pattern=`` given, a bare ``(nnz,)`` value
         vector over that pattern. ``deadline_s`` is a per-ticket wall
@@ -785,6 +825,13 @@ class SolveSession:
         'bf16ir' — same grouping/keying contract as ``precond`` (the
         resolved policy joins the program key as a ``.P`` suffix;
         'exact' keeps the historic key).
+
+        ``precond_dtype`` overrides the session's preconditioner
+        storage dtype for this one request (ISSUE 16): 'compute' or
+        'storage' — same grouping/keying contract again ('storage'
+        joins the key as a ``.W`` suffix; it only takes effect on a
+        reduced-precision bucket with a Jacobi/ILU preconditioner and
+        degrades to 'compute' with a breadcrumb elsewhere).
 
         With ``max_queue_depth`` set, admission control runs first
         (after validation): at the bound, ``admission='block'`` drives
@@ -811,12 +858,17 @@ class SolveSession:
             precond = precond_mod.canonical_kind(precond)  # validate early
         if dtype_policy is not None:
             dtype_policy = mixed_mod.canonical_policy(dtype_policy)
+        if precond_dtype is not None:
+            precond_dtype = precond_mod.canonical_precond_dtype(
+                precond_dtype
+            )
         if self.max_queue_depth is not None:
             self._admit()
         t = SolveTicket(self, deadline_s=deadline_s, tenant=tenant)
         q = self._pending.setdefault(id(pattern), [])
         q.append(_Request(pattern, values, b, float(tol), x0, maxiter, t,
-                          precond=precond, dtype_policy=dtype_policy))
+                          precond=precond, dtype_policy=dtype_policy,
+                          precond_dtype=precond_dtype))
         _QUEUE_DEPTH.inc()
         self._unfinalized += 1
         if self.auto_flush is not None and len(q) >= self.auto_flush:
@@ -899,6 +951,8 @@ class SolveSession:
             "mesh": self.fleet.describe(),
             "precond": self.precond.describe(),
             "dtype_policy": self.dtype_policy.describe(),
+            **({"autopilot": self.autopilot.describe()}
+               if self.autopilot is not None else {}),
             "device_occupancy": list(self._device_occ),
             "pipeline": {
                 "inflight": self.inflight,
@@ -925,17 +979,20 @@ class SolveSession:
     def _manifest_plan(self, e: dict):
         """Parse one warm-start manifest entry into ``(program_key,
         solver, bucket, dtype, plan, precond, dtype_policy,
-        skip_reason)`` — the SINGLE place entry -> plan-cache key
-        resolution lives, so the async replay's planned-key set (what
-        ``_launch`` waits for) and the replay itself can never disagree.
-        ``skip_reason`` is ``None`` for a replayable entry, ``'mesh'``
-        for a topology-mismatched fleet entry (clean cold start) and
-        ``'malformed'`` otherwise. ``precond`` is the entry's recorded
-        kind ('none' when absent — pre-precond manifests stay valid);
-        ``dtype_policy`` the recorded precision policy ('exact' when
-        absent — pre-mixed manifests stay valid, ISSUE 15)."""
+        precond_dtype, skip_reason)`` — the SINGLE place entry ->
+        plan-cache key resolution lives, so the async replay's
+        planned-key set (what ``_launch`` waits for) and the replay
+        itself can never disagree. ``skip_reason`` is ``None`` for a
+        replayable entry, ``'mesh'`` for a topology-mismatched fleet
+        entry (clean cold start) and ``'malformed'`` otherwise.
+        ``precond`` is the entry's recorded kind ('none' when absent —
+        pre-precond manifests stay valid); ``dtype_policy`` the
+        recorded precision policy ('exact' when absent — pre-mixed
+        manifests stay valid, ISSUE 15); ``precond_dtype`` the recorded
+        factor storage dtype ('compute' when absent — pre-autopilot
+        manifests stay valid, ISSUE 16)."""
         _bad = (None, None, 0, None, None, precond_mod.NONE,
-                mixed_mod.EXACT, "malformed")
+                mixed_mod.EXACT, "compute", "malformed")
         solver = e.get("solver")
         try:
             bkt = int(e.get("bucket", 0))
@@ -956,6 +1013,12 @@ class SolveSession:
             )
         except ValueError:
             return _bad
+        try:
+            pdt = precond_mod.canonical_precond_dtype(
+                e.get("precond_dtype")
+            )
+        except ValueError:
+            return _bad
         # mesh-keyed entries (the fleet tier) only replay on the SAME
         # topology: a fingerprint mismatch — restart on a different pod
         # shape, fleet turned off — skips the entry for a clean cold
@@ -967,7 +1030,7 @@ class SolveSession:
                 and mesh_fp == self.fleet.fingerprint
             ):
                 return (None, None, 0, None, None, precond_mod.NONE,
-                        mixed_mod.EXACT, "mesh")
+                        mixed_mod.EXACT, "compute", "mesh")
             plan = self.fleet.plan_for(e.get("strategy", "batch"))
         else:
             plan = fleet_mod.FleetPlan("single")
@@ -979,8 +1042,9 @@ class SolveSession:
             f"batch.{solver}.B{bkt}.{dt.str}{plan.key_suffix}"
             f"{precond_mod.key_suffix(mkind)}"
             f"{mixed_mod.key_suffix(dpol)}"
+            f"{precond_mod.dtype_suffix(pdt)}"
         )
-        return key, solver, bkt, dt, plan, mkind, dpol, None
+        return key, solver, bkt, dt, plan, mkind, dpol, pdt, None
 
     def _replay_manifest(self, notify=None) -> int:
         """Replay the vault's warm-start manifest: for every recorded
@@ -1001,7 +1065,7 @@ class SolveSession:
         for e in entries:
             key = None
             try:
-                (key, solver, bkt, dt, plan, mkind, dpol,
+                (key, solver, bkt, dt, plan, mkind, dpol, pdt,
                  skip) = self._manifest_plan(e)
                 if skip is not None:
                     if skip == "mesh":
@@ -1013,7 +1077,8 @@ class SolveSession:
                 pat = self._patterns.setdefault(pat.fingerprint, pat)
                 pat.sell_pack()  # disk-tier hit (or rebuild + deposit)
                 self._prebuild(pat, solver, bkt, dt, plan=plan,
-                               precond=mkind, dtype_policy=dpol)
+                               precond=mkind, dtype_policy=dpol,
+                               precond_dtype=pdt)
                 replayed += 1
             except Exception:  # noqa: BLE001 - entry isolation
                 continue
@@ -1033,7 +1098,8 @@ class SolveSession:
     def _prebuild(self, pattern: SparsityPattern, solver: str, bkt: int,
                   dt, plan=None,
                   precond: str = precond_mod.NONE,
-                  dtype_policy: str = mixed_mod.EXACT) -> None:
+                  dtype_policy: str = mixed_mod.EXACT,
+                  precond_dtype: str = "compute") -> None:
         """Build (and AOT-compile, via the usual cost attribution) one
         bucket program outside any dispatch — argument shapes/dtypes
         mirror ``_dispatch`` exactly (including the fleet strategy's
@@ -1047,6 +1113,7 @@ class SolveSession:
             f"batch.{solver}.B{bkt}.{dt.str}{plan.key_suffix}"
             f"{precond_mod.key_suffix(precond)}"
             f"{mixed_mod.key_suffix(dtype_policy)}"
+            f"{precond_mod.dtype_suffix(precond_dtype)}"
         )
         n = pattern.shape[0]
         # the same conversion pipeline as a real dispatch (np stacks ->
@@ -1063,7 +1130,8 @@ class SolveSession:
             tb = time.perf_counter()
             fn = self._build_program(pattern, bkt, dt, solver=solver,
                                      plan=plan, precond=precond,
-                                     dtype_policy=dtype_policy)
+                                     dtype_policy=dtype_policy,
+                                     precond_dtype=precond_dtype)
             prog, _info = _cost.attribute(
                 key, fn, args, pack_s=time.perf_counter() - tb,
                 solver=solver, bucket=bkt, dtype=dt.str,
@@ -1072,6 +1140,8 @@ class SolveSession:
                    if precond != precond_mod.NONE else {}),
                 **({"dtype_policy": dtype_policy}
                    if dtype_policy != mixed_mod.EXACT else {}),
+                **({"precond_dtype": precond_dtype}
+                   if precond_dtype != "compute" else {}),
             )
             return prog
 
@@ -1168,18 +1238,21 @@ class SolveSession:
             for r in expired:
                 self._finalize_ticket(r.ticket)
             # one group per (result dtype, precond override, dtype-policy
-            # override) so stacked values are homogeneous and every lane
-            # of a bucket shares one preconditioner + precision choice
+            # override, precond-dtype override) so stacked values are
+            # homogeneous and every lane of a bucket shares one
+            # preconditioner + precision + factor-storage choice
             by_dt: dict = {}
             for r in live:
                 dt = np.result_type(r.values.dtype, r.b.dtype)
                 by_dt.setdefault(
-                    (np.dtype(dt), r.precond or "", r.dtype_policy or ""),
+                    (np.dtype(dt), r.precond or "", r.dtype_policy or "",
+                     r.precond_dtype or ""),
                     [],
                 ).append(r)
-            for (dt, pov, dpov), reqs in sorted(
+            for (dt, pov, dpov, wov), reqs in sorted(
                 by_dt.items(),
-                key=lambda kv: (kv[0][0].str, kv[0][1], kv[0][2]),
+                key=lambda kv: (kv[0][0].str, kv[0][1], kv[0][2],
+                                kv[0][3]),
             ):
                 for lo in range(0, len(reqs), self.batch_max):
                     chunk = reqs[lo:lo + self.batch_max]
@@ -1187,7 +1260,8 @@ class SolveSession:
                     for _attempt in range(self.dispatch_attempts):
                         try:
                             self._dispatch(chunk, dt, precond=pov or None,
-                                           dtype_policy=dpov or None)
+                                           dtype_policy=dpov or None,
+                                           precond_dtype=wov or None)
                             dispatched += 1
                             err = None
                             break
@@ -1392,7 +1466,8 @@ class SolveSession:
     def _dispatch(self, reqs, dt, solver: str | None = None,
                   allow_requeue: bool = True,
                   precond: str | None = None,
-                  dtype_policy: str | None = None) -> None:
+                  dtype_policy: str | None = None,
+                  precond_dtype: str | None = None) -> None:
         """Enqueue one bucket through the streaming pipeline: launch
         (pack -> upload -> async program call) under the lanes' ticket
         scope, admit the dispatch to the bounded in-flight window, and
@@ -1404,7 +1479,7 @@ class SolveSession:
         # ids (replace semantics: a requeue re-enters with its own lanes)
         with telemetry.ticket_scope(*(r.ticket.id for r in reqs)):
             fl = self._launch(reqs, dt, solver, allow_requeue, precond,
-                              dtype_policy)
+                              dtype_policy, precond_dtype)
         if fl is None:
             return  # degraded at launch; lanes already resolved
         self._inflight.append(fl)
@@ -1420,7 +1495,8 @@ class SolveSession:
 
     def _launch(self, reqs, dt, solver: str | None,
                 allow_requeue: bool, precond: str | None = None,
-                dtype_policy: str | None = None):
+                dtype_policy: str | None = None,
+                precond_dtype: str | None = None):
         """The host half of a dispatch: pack the lane stacks, stage the
         upload (``bucket.stage_lanes`` — pad + eager ``device_put``),
         resolve the bucket program (waiting for an in-progress warm
@@ -1428,7 +1504,6 @@ class SolveSession:
         it WITHOUT blocking. Returns the :class:`_InFlight` record, or
         ``None`` when the compiled path was unavailable and the lanes
         were already resolved on the eager degraded path."""
-        solver = solver or self.solver
         t0 = time.monotonic()
         if _faults.ACTIVE:
             for act in _faults.dispatch_actions():
@@ -1440,6 +1515,38 @@ class SolveSession:
                     time.sleep(act[1] / 1e3)
         pattern = reqs[0].pattern
         nb = len(reqs)
+        # autopilot hook (ISSUE 16, docs/autopilot.md): an otherwise
+        # untouched serving dispatch — no explicit solver (requeues and
+        # fallbacks carry one), no per-ticket/flush-group overrides —
+        # asks the tuner which policy arm to run. The arm's parts then
+        # flow through the SAME resolution below as real overrides, so
+        # the tuner can only pick configurations a caller could have
+        # asked for. `auto` is the observation token retire settles.
+        auto = None
+        if (
+            self.autopilot is not None and allow_requeue
+            and solver is None and precond is None
+            and dtype_policy is None and precond_dtype is None
+        ):
+            gbkt = bucketing.bucket_batch(
+                nb, policy=self.bucket_policy, batch_max=self.batch_max
+            )
+            spec, auto = self.autopilot.assign(
+                pattern, self.solver, gbkt, np.dtype(dt),
+                slo_ms=self.slo_ms,
+                mesh_fp=(self.fleet.fingerprint if self.fleet.enabled
+                         else None),
+            )
+            solver = spec.get("solver")
+            precond = spec.get("precond")
+            dtype_policy = spec.get("dtype_policy")
+            precond_dtype = spec.get("precond_dtype")
+            if "inflight" in spec:
+                # the pipeline-depth arm: takes effect as the admission
+                # bound of this and later dispatches (a session knob,
+                # not a program property — no key impact)
+                self.inflight = max(int(spec["inflight"]), 1)
+        solver = solver or self.solver
         # fleet strategy first, bucket second: a batch-sharded bucket
         # must round up to a mesh multiple (mesh-pad lanes carry the
         # same instant-converge contract as ordinary pad lanes and are
@@ -1489,6 +1596,32 @@ class SolveSession:
         if pol != mixed_mod.EXACT and plan.strategy == "row":
             mixed_mod.DtypePolicy._fallback(pol, "row-sharded plan")
             pol = mixed_mod.EXACT
+        # the resolved precond storage dtype (ISSUE 16): override >
+        # session/env. 'storage' only means anything on a reduced-
+        # precision bucket whose preconditioner factorizes values
+        # (Jacobi/ILU families) — everywhere else it degrades to
+        # 'compute' with a breadcrumb, keeping the key suffix empty.
+        pdt = (
+            self.precond_dtype if precond_dtype is None
+            else precond_mod.canonical_precond_dtype(precond_dtype)
+        )
+        if pdt != "compute":
+            reason = None
+            if pol == mixed_mod.EXACT:
+                reason = "exact dtype policy: no reduced storage width"
+            elif mkind == precond_mod.NONE:
+                reason = "no preconditioner"
+            elif mkind in ("cheby", "neumann"):
+                reason = f"{mkind} applies A itself: no stored factors"
+            elif plan.strategy == "row":
+                reason = "row-sharded plan"
+            if reason is not None:
+                if telemetry.enabled():
+                    telemetry.record(
+                        "coverage.fallback", op="precond.storage",
+                        reason=reason, to="compute",
+                    )
+                pdt = "compute"
         if pol != mixed_mod.EXACT:
             # stamp the reduced policy on the lanes (sticky across a
             # later promote_dtype requeue, so the terminal event still
@@ -1501,6 +1634,7 @@ class SolveSession:
         key = (
             f"batch.{solver}.B{bkt}.{np.dtype(dt).str}{plan.key_suffix}"
             f"{precond_mod.key_suffix(mkind)}{mixed_mod.key_suffix(pol)}"
+            f"{precond_mod.dtype_suffix(pdt)}"
         )
         if faulty:
             # fault-wrapped programs carry the injection callback in
@@ -1518,7 +1652,8 @@ class SolveSession:
             tb = time.perf_counter()
             fn = self._build_program(pattern, bkt, np.dtype(dt),
                                      solver=solver, plan=plan,
-                                     precond=mkind, dtype_policy=pol)
+                                     precond=mkind, dtype_policy=pol,
+                                     precond_dtype=pdt)
             prog, info = _cost.attribute(
                 key, fn, args,
                 pack_s=time.perf_counter() - tb,
@@ -1528,6 +1663,8 @@ class SolveSession:
                    if mkind != precond_mod.NONE else {}),
                 **({"dtype_policy": pol}
                    if pol != mixed_mod.EXACT else {}),
+                **({"precond_dtype": pdt}
+                   if pdt != "compute" else {}),
             )
             built.update(info)
             return prog
@@ -1566,6 +1703,8 @@ class SolveSession:
                                  else None),
                         dtype_policy=(pol if pol != mixed_mod.EXACT
                                       else None),
+                        precond_dtype=(pdt if pdt != "compute"
+                                       else None),
                     )
             # sampled timed dispatch (ISSUE 12): every Nth dispatch
             # takes ONE extra timestamp at the dispatch-return boundary
@@ -1595,7 +1734,7 @@ class SolveSession:
         return _InFlight(
             reqs, dt, solver, allow_requeue, plan, key, bkt, nb, out,
             built, snap, t0, t_packed, t_solve0, t_dispatched, sampled,
-            policy=pol,
+            policy=pol, auto=auto,
         )
 
     def _degrade(self, reqs, dt, solver, nb, e) -> None:
@@ -1727,6 +1866,25 @@ class SolveSession:
             plan, solver, dt, nb, bkt, iters,
             max(t_solved - fl.t_solve0, 0.0), policy=fl.policy,
         )
+        if fl.auto is not None and self.autopilot is not None:
+            # settle the dispatch's measurement against its autopilot
+            # token (ISSUE 16): the sampled device split when the
+            # profiler took one, else the solve wall clock; a bucket
+            # that promoted or left lanes unconverged scores as a
+            # failure regardless of speed
+            try:
+                self.autopilot.observe(
+                    fl.auto,
+                    solve_ms=max((t_solved - fl.t_solve0) * 1e3, 0.0),
+                    device_ms=(profile_ms[1] if profile_ms is not None
+                               else None),
+                    iters_mean=float(iters[:nb].mean()) if nb else 0.0,
+                    lanes=nb,
+                    converged=float(conv[:nb].mean()) if nb else 1.0,
+                    promoted=bool(promote_lanes),
+                )
+            except Exception:  # noqa: BLE001 - tuning never breaks serving
+                pass
         if telemetry.enabled():
             # bucket-level phase wall clocks, accumulated onto each
             # lane's ticket (a requeued lane sums both dispatches).
@@ -1943,7 +2101,8 @@ class SolveSession:
     def _build_program(self, pattern: SparsityPattern, bkt: int, dt,
                        solver: str | None = None, plan=None,
                        precond: str = precond_mod.NONE,
-                       dtype_policy: str = mixed_mod.EXACT):
+                       dtype_policy: str = mixed_mod.EXACT,
+                       precond_dtype: str = "compute"):
         """The per-bucket compiled program: pattern pack + masked solver
         loop under ONE ``jax.jit`` whose arguments are the value stack,
         rhs, x0 and tolerances — so same-bucket dispatches with fresh
@@ -1972,7 +2131,15 @@ class SolveSession:
         with wide accumulation), the f64 outer loop verifies and
         corrects, and the program returns a 5th output (the refinement
         sweep count). 'exact' leaves every program byte-identical to
-        the historic one."""
+        the historic one.
+
+        ``precond_dtype`` (ISSUE 16): 'storage' on a reduced-precision
+        program builds the preconditioner factory with the policy's
+        ``storage_dtype``/``acc_dtype`` — factors factorized wide,
+        STORED at the reduced width, applied with wide accumulation —
+        so M's memory traffic compounds with the value planes' ('.W'
+        key suffix). 'compute' (the default, and the forced value
+        everywhere the combination can't apply) changes nothing."""
         solver = solver or self.solver
         if plan is not None and plan.strategy == "row":
             return fleet_mod.build_row_program(
@@ -1980,10 +2147,16 @@ class SolveSession:
                 conv_test_iters=self.conv_test_iters,
                 make_M=self.row_precond,
             )
-        mfac = (
-            None if precond == precond_mod.NONE
-            else self.precond.factory(pattern, precond)
-        )
+        if precond == precond_mod.NONE:
+            mfac = None
+        elif (precond_dtype == "storage"
+              and dtype_policy != mixed_mod.EXACT):
+            m_sdt, m_adt = mixed_mod.inner_dtypes(dtype_policy)
+            mfac = self.precond.factory(
+                pattern, precond, storage_dtype=m_sdt, acc_dtype=m_adt
+            )
+        else:
+            mfac = self.precond.factory(pattern, precond)
         mixed = None
         if dtype_policy != mixed_mod.EXACT:
             mixed = dict(
@@ -2013,7 +2186,8 @@ class SolveSession:
         )
         if mixed is not None:
             return _build_ir_program(
-                pack, mixed, solver, self.conv_test_iters, mfac
+                pack, mixed, solver, self.conv_test_iters, mfac,
+                precond_dtype=precond_dtype,
             )
         loop = (
             krylov._cg_loop if solver == "cg"

@@ -86,6 +86,30 @@ Flags (all env-overridable):
                                 a static while_loop bound, so one compiled program).
   SPARSE_TPU_IR_ETA           - inner residual-reduction target per sweep
                                 (default 0 = per-policy: 1e-4 f32ir, 1e-2 bf16ir).
+  SPARSE_TPU_PRECOND_DTYPE    - precond storage dtype under a reduced dtype policy
+                                (sparse_tpu.precond, ISSUE 16): '' / 'compute'
+                                (default) factorizes/stores M at the inner sweep's
+                                compute dtype (historic keys/jaxprs byte-identical);
+                                'storage' stores the factors at the policy's reduced
+                                storage dtype with wide accumulation (the precond x
+                                mixed compounding arm; '.W' program-key suffix).
+  SPARSE_TPU_AUTOPILOT        - online policy tuner (sparse_tpu.autopilot): any
+                                truthy spelling enables per-(pattern, bucket, SLO
+                                class) trial scheduling over the default candidate
+                                grid. Empty (default) = off, with program keys,
+                                manifests and numerics byte-identical to pre-
+                                autopilot behavior.
+  SPARSE_TPU_AUTOPILOT_EPSILON - bounded exploration fraction: one in
+                                round(1/epsilon) dispatches of an exploring group
+                                is a measured experiment (default 0.25).
+  SPARSE_TPU_AUTOPILOT_TRIALS - observations per arm per successive-halving round
+                                (default 2).
+  SPARSE_TPU_AUTOPILOT_SLO_FACTOR - SLO guard: an experiment slower than
+                                factor * slo_ms aborts its arm immediately
+                                (default 1.5).
+  SPARSE_TPU_AUTOPILOT_DRIFT  - drift threshold: a pinned-arm observation slower
+                                than factor * the decision score counts a drift
+                                strike into autopilot.drift_strikes (default 2.0).
 """
 
 from __future__ import annotations
@@ -347,6 +371,55 @@ class Settings:
     )
     ir_eta: float = field(
         default_factory=lambda: max(_env_float("SPARSE_TPU_IR_ETA", 0.0), 0.0)
+    )
+    # Precond storage dtype under a reduced dtype policy (ISSUE 16):
+    # '' / 'compute' = the historic behavior (M factorized/stored at the
+    # inner sweep's compute dtype, program keys unchanged); 'storage' =
+    # factors stored at the policy's reduced storage dtype with wide
+    # accumulation — the precond x mixed compounding arm ('.W' key
+    # suffix). Only meaningful on reduced-precision buckets with a
+    # Jacobi/ILU preconditioner; degrades to 'compute' elsewhere.
+    precond_dtype: str = field(
+        default_factory=lambda: _env_str("SPARSE_TPU_PRECOND_DTYPE", "")
+    )
+    # Online policy tuner (sparse_tpu.autopilot, ISSUE 16): any truthy
+    # spelling enables per-(pattern, bucket, SLO class) trial
+    # scheduling over the default candidate grid. '' (default) = off:
+    # no tuner object exists, every dispatch path, program key,
+    # manifest and numeric is byte-identical to pre-autopilot behavior.
+    autopilot: str = field(
+        default_factory=lambda: _env_str("SPARSE_TPU_AUTOPILOT", "")
+    )
+    # Bounded exploration fraction: during exploration one in
+    # round(1/epsilon) dispatches of a group is a measured experiment;
+    # the rest serve the incumbent (best arm so far).
+    autopilot_epsilon: float = field(
+        default_factory=lambda: min(
+            max(_env_float("SPARSE_TPU_AUTOPILOT_EPSILON", 0.25), 1e-3), 1.0
+        )
+    )
+    # Observations per arm per successive-halving round (the trial
+    # budget: rounds * trials experiments per surviving arm).
+    autopilot_trials: int = field(
+        default_factory=lambda: max(
+            _env_int("SPARSE_TPU_AUTOPILOT_TRIALS", 2), 1
+        )
+    )
+    # SLO guard: an experimental observation slower than
+    # factor * slo_ms aborts its arm immediately — exploration never
+    # blows a tenant's p95 by more than one bounded dispatch.
+    autopilot_slo_factor: float = field(
+        default_factory=lambda: max(
+            _env_float("SPARSE_TPU_AUTOPILOT_SLO_FACTOR", 1.5), 1.0
+        )
+    )
+    # Drift threshold: a pinned-arm observation slower than
+    # factor * the decision's measured score counts a strike into the
+    # watchdog-visible autopilot.drift_strikes counter.
+    autopilot_drift: float = field(
+        default_factory=lambda: max(
+            _env_float("SPARSE_TPU_AUTOPILOT_DRIFT", 2.0), 1.0
+        )
     )
 
 

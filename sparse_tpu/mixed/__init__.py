@@ -23,15 +23,18 @@ from .policy import (  # noqa: F401
     IR_SOLVERS,
     POLICIES,
     DtypePolicy,
+    add_promote_listener,
     canonical_policy,
     default_eta,
     inner_dtypes,
     key_suffix,
     outer_dtype,
+    remove_promote_listener,
 )
 
 __all__ = [
-    "DtypePolicy", "EXACT", "IR_SOLVERS", "POLICIES", "canonical_policy",
-    "default_eta", "inner_dtypes", "ir_loop", "ir_solve", "key_suffix",
-    "outer_dtype",
+    "DtypePolicy", "EXACT", "IR_SOLVERS", "POLICIES",
+    "add_promote_listener", "canonical_policy", "default_eta",
+    "inner_dtypes", "ir_loop", "ir_solve", "key_suffix", "outer_dtype",
+    "remove_promote_listener",
 ]

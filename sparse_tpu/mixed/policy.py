@@ -77,6 +77,27 @@ _EXACT_SPELLINGS = ("", "0", "off", "false", "no", "none", "exact")
 #: host-driven restart cycle degrades to exact)
 IR_SOLVERS = ("cg", "bicgstab")
 
+# process-global promote listeners (ISSUE 16): callbacks offered every
+# promote-rung firing — the autopilot's promote-spike drift signal.
+# Same contract as the watchdog alert hooks: best-effort, exceptions
+# swallowed, every DtypePolicy instance fires them.
+_PROMOTE_LISTENERS: list = []
+
+
+def add_promote_listener(fn) -> None:
+    """Register a callback invoked on every :meth:`DtypePolicy.promote`
+    with keyword fields ``solver``/``bucket``/``dtype``/``reason``."""
+    if fn not in _PROMOTE_LISTENERS:
+        _PROMOTE_LISTENERS.append(fn)
+
+
+def remove_promote_listener(fn) -> None:
+    """Unregister a previously added listener (idempotent)."""
+    try:
+        _PROMOTE_LISTENERS.remove(fn)
+    except ValueError:
+        pass
+
 
 def canonical_policy(policy, allow_auto: bool = True) -> str:
     """Normalize a policy spelling; raises on unknown values (a typo'd
@@ -215,6 +236,12 @@ class DtypePolicy:
             help="reduced-precision bucket groups escalated to the "
             "'exact' dtype policy, by anomaly reason",
         ).inc()
+        for fn in list(_PROMOTE_LISTENERS):
+            try:
+                fn(solver=solver, bucket=int(bucket),
+                   dtype=np.dtype(dtype).str, reason=reason)
+            except Exception:  # noqa: BLE001 - listeners never break serving
+                pass
 
     def _auto(self, solver: str, dtype) -> str:
         """f32+IR for f64 requests on the fused-loop solvers; everything

@@ -50,18 +50,22 @@ from .jacobi import (  # noqa: F401
 from .policy import (  # noqa: F401
     KINDS,
     NONE,
+    PRECOND_DTYPES,
     PrecondPolicy,
     canonical_kind,
+    canonical_precond_dtype,
+    dtype_suffix,
     key_suffix,
 )
 from .poly import cheby_factory, estimate_lmax, neumann_factory  # noqa: F401
 
 __all__ = [
-    "KINDS", "NONE", "PrecondPolicy", "bjacobi_factory", "block_map",
-    "canonical_kind", "cheby_factory", "diag_map", "diag_of",
-    "estimate_lmax", "factorize", "ilu0_reference", "ilu0_symbolic",
-    "ilu_factory", "jacobi_factory", "key_suffix", "make_M",
-    "make_factory", "neumann_factory",
+    "KINDS", "NONE", "PRECOND_DTYPES", "PrecondPolicy",
+    "bjacobi_factory", "block_map", "canonical_kind",
+    "canonical_precond_dtype", "cheby_factory", "diag_map", "diag_of",
+    "dtype_suffix", "estimate_lmax", "factorize", "ilu0_reference",
+    "ilu0_symbolic", "ilu_factory", "jacobi_factory", "key_suffix",
+    "make_M", "make_factory", "neumann_factory",
 ]
 
 # always-on build accounting (telemetry/_metrics.py): one count per

@@ -253,29 +253,42 @@ def ilu0_reference(indptr, indices, vals):
     return F
 
 
-def make_apply(pattern, sym: IluSymbolic, F, tri_sweeps: int):
+def make_apply(pattern, sym: IluSymbolic, F, tri_sweeps: int,
+               acc_dtype=None):
     """Batched triangular application ``Mvec(R) ~= (LU)^{-1} R`` (ilu0)
     or ``(L L^H)^{-1} R`` (ic0) via fixed Jacobi–Richardson sweeps —
     each sweep ONE batched SpMV through the pattern's shared SELL plan,
-    no data-dependent control flow. Returns the jit-safe ``Mvec``."""
+    no data-dependent control flow. Returns the jit-safe ``Mvec``.
+
+    ``acc_dtype`` (ISSUE 16): when the factor stack ``F`` is stored at
+    a reduced dtype, the sweep SpMVs accumulate at ``acc_dtype`` (the
+    same widening the inner Krylov matvec carries) and the diagonal
+    reciprocals are computed wide — so a bf16-stored factor costs bf16
+    streaming and f32 math. ``None`` (default) is byte-identical to
+    the historic apply."""
     from ..ops import spmv as spmv_ops
 
     pack = pattern.sell_pack()
     idx_slabs, pos, zero_rows = pack.idx_slabs, pack.pos, pack.plan.zero_rows
     K = max(int(tri_sweeps), 1)
     zero = jnp.zeros((), dtype=F.dtype)
+    adt = None if acc_dtype is None else jnp.dtype(acc_dtype)
 
     def spmv(vals_packed, X):
         return spmv_ops.csr_spmv_sell_batched(
-            idx_slabs, vals_packed, pos, X, zero_rows
+            idx_slabs, vals_packed, pos, X, zero_rows,
+            **({} if adt is None else {"acc_dtype": adt}),
         )
+
+    def _wide(x):
+        return x if adt is None else x.astype(adt)
 
     if sym.variant == "ilu0":
         Ls = pack.pack_values(jnp.where(sym.lower, F, zero))
         Us = pack.pack_values(jnp.where(sym.upper, F, zero))
-        ud = jnp.where(sym.has_diag, F[..., sym.dpos],
-                       jnp.ones((), dtype=F.dtype))
-        ud_inv = jnp.ones((), dtype=F.dtype) / _safe(ud)
+        ud = _wide(jnp.where(sym.has_diag, F[..., sym.dpos],
+                             jnp.ones((), dtype=F.dtype)))
+        ud_inv = jnp.ones((), dtype=ud.dtype) / _safe(ud)
 
         def Mvec(R):
             y = R
@@ -294,9 +307,9 @@ def make_apply(pattern, sym: IluSymbolic, F, tri_sweeps: int):
     Lts = pack.pack_values(
         jnp.where(sym.upper, jnp.conj(F[..., sym.tpos]), zero)
     )
-    ld = jnp.where(sym.has_diag, F[..., sym.dpos],
-                   jnp.ones((), dtype=F.dtype))
-    ld_inv = jnp.ones((), dtype=F.dtype) / _safe(ld)
+    ld = _wide(jnp.where(sym.has_diag, F[..., sym.dpos],
+                         jnp.ones((), dtype=F.dtype)))
+    ld_inv = jnp.ones((), dtype=ld.dtype) / _safe(ld)
     ld_inv_h = jnp.conj(ld_inv)
 
     def Mvec(R):
@@ -312,11 +325,19 @@ def make_apply(pattern, sym: IluSymbolic, F, tri_sweeps: int):
 
 
 def ilu_factory(pattern, variant: str = "ilu0", sweeps: int | None = None,
-                tri_sweeps: int | None = None):
+                tri_sweeps: int | None = None, storage_dtype=None,
+                acc_dtype=None):
     """The service-facing numeric factory: symbolic build (cached/
     vaulted) happens HERE, on the host; the returned
     ``factory(values, matvec) -> Mvec`` is pure jnp and runs inside the
-    compiled bucket programs."""
+    compiled bucket programs.
+
+    ``storage_dtype`` / ``acc_dtype`` (ISSUE 16): the Chow–Patel
+    fixed point runs at ``acc_dtype`` (its convergence needs the
+    bits), the factor stack is STORED at ``storage_dtype``, and the
+    triangular sweeps widen back through the SpMV's ``acc_dtype`` —
+    narrow streaming, wide math. ``None`` (default) is byte-identical
+    to the historic factory."""
     from ..config import settings
 
     sym = ilu0_symbolic(pattern, variant)
@@ -325,9 +346,14 @@ def ilu_factory(pattern, variant: str = "ilu0", sweeps: int | None = None,
     tri = int(
         tri_sweeps if tri_sweeps is not None else settings.precond_tri_sweeps
     )
+    sdt = None if storage_dtype is None else jnp.dtype(storage_dtype)
+    adt = None if acc_dtype is None else jnp.dtype(acc_dtype)
 
     def factory(values, matvec=None):
-        F = factorize(sym, values, sweeps)
-        return make_apply(pattern, sym, F, tri)
+        a = values if adt is None else values.astype(adt)
+        F = factorize(sym, a, sweeps)
+        if sdt is not None:
+            F = F.astype(sdt)
+        return make_apply(pattern, sym, F, tri, acc_dtype=adt)
 
     return factory

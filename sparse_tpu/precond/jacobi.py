@@ -91,14 +91,27 @@ def diag_of(pattern, values):
     return jnp.where(has, d, jnp.ones((), dtype=values.dtype))
 
 
-def jacobi_factory(pattern):
+def jacobi_factory(pattern, storage_dtype=None, acc_dtype=None):
     """Point-Jacobi numeric factory: ``factory(values, matvec) -> Mvec``
     with ``Mvec(R) = R / diag(A)`` per lane. The map build (host) runs
-    here, once per pattern; the returned factory is pure jnp."""
+    here, once per pattern; the returned factory is pure jnp.
+
+    ``storage_dtype`` / ``acc_dtype`` (ISSUE 16): the reciprocal is
+    computed at ``acc_dtype`` and STORED at ``storage_dtype`` — the
+    apply's multiply widens back through jnp promotion, so a bf16
+    factor under an f32 sweep costs bf16 memory traffic and f32 math.
+    ``None`` (default) is byte-identical to the historic factory."""
     diag_map(pattern)  # host build outside any trace
+    sdt = None if storage_dtype is None else jnp.dtype(storage_dtype)
+    adt = None if acc_dtype is None else jnp.dtype(acc_dtype)
 
     def factory(values, matvec=None):
-        dinv = _safe_recip(diag_of(pattern, values))
+        d = diag_of(pattern, values)
+        if adt is not None:
+            d = d.astype(adt)
+        dinv = _safe_recip(d)
+        if sdt is not None:
+            dinv = dinv.astype(sdt)
 
         def Mvec(R):
             return R * dinv
@@ -156,20 +169,31 @@ def block_map(pattern, bs: int):
     )
 
 
-def bjacobi_factory(pattern, bs: int | None = None):
+def bjacobi_factory(pattern, bs: int | None = None, storage_dtype=None,
+                    acc_dtype=None):
     """Block-Jacobi numeric factory over ``bs x bs`` diagonal blocks:
     gathers the block stack from the value stack through the
     pattern-shared map, inverts it batched, and applies as a batched
-    block matmul. ``factory(values, matvec) -> Mvec``."""
+    block matmul. ``factory(values, matvec) -> Mvec``.
+
+    ``storage_dtype`` / ``acc_dtype`` (ISSUE 16): the block inversion
+    runs at ``acc_dtype`` (a bf16 ``linalg.inv`` would lose the
+    factorization's whole point), the inverse STACK is stored at
+    ``storage_dtype``, and the apply einsum accumulates at
+    ``acc_dtype`` — narrow memory, wide math. ``None`` (default) is
+    byte-identical to the historic factory."""
     from ..config import settings
 
     n = pattern.shape[0]
     bs = max(min(int(bs or settings.precond_block), max(n, 1)), 1)
     if bs == 1:
-        return jacobi_factory(pattern)
+        return jacobi_factory(pattern, storage_dtype=storage_dtype,
+                              acc_dtype=acc_dtype)
     block_map(pattern, bs)  # host build outside any trace
     nb = -(-n // bs)
     n_pad = nb * bs
+    sdt = None if storage_dtype is None else jnp.dtype(storage_dtype)
+    adt = None if acc_dtype is None else jnp.dtype(acc_dtype)
 
     def factory(values, matvec=None):
         src, fix = block_map(pattern, bs)
@@ -179,13 +203,19 @@ def bjacobi_factory(pattern, bs: int | None = None):
             jnp.zeros((), dtype=values.dtype),
         )  # (B, nb, bs, bs)
         blocks = gathered + fix.astype(values.dtype)
+        if adt is not None:
+            blocks = blocks.astype(adt)
         inv = jnp.linalg.inv(blocks)
+        if sdt is not None:
+            inv = inv.astype(sdt)
 
         def Mvec(R):
             B = R.shape[0]
             Rp = jnp.pad(R, ((0, 0), (0, n_pad - n)))
             Z = jnp.einsum(
-                "bkij,bkj->bki", inv, Rp.reshape(B, nb, bs)
+                "bkij,bkj->bki", inv, Rp.reshape(B, nb, bs),
+                **({} if adt is None
+                   else {"preferred_element_type": adt}),
             )
             return Z.reshape(B, n_pad)[:, :n]
 

@@ -64,6 +64,38 @@ def key_suffix(kind: str | None) -> str:
     return f".M{kind}"
 
 
+#: the precond storage-dtype grammar (ISSUE 16): 'compute' factorizes
+#: and stores M at the inner sweep's COMPUTE dtype (the historic
+#: reduced-precision behavior — and the only behavior on exact
+#: buckets); 'storage' factorizes wide but STORES the factors at the
+#: policy's reduced storage dtype, applications widened back through
+#: ``acc_dtype`` — the precond x mixed compounding arm.
+PRECOND_DTYPES = ("compute", "storage")
+
+
+def canonical_precond_dtype(value) -> str:
+    """Normalize a precond storage-dtype spelling; raises on unknown
+    values (a typo'd ``SPARSE_TPU_PRECOND_DTYPE`` must not silently
+    serve the wrong memory footprint)."""
+    s = str("" if value is None else value).strip().lower()
+    if s in _OFF or s == "compute":
+        return "compute"
+    if s == "storage":
+        return "storage"
+    raise ValueError(
+        f"precond dtype {value!r} not one of {('compute', 'storage')}"
+    )
+
+
+def dtype_suffix(precond_dtype: str | None) -> str:
+    """What a resolved precond storage dtype contributes to the
+    bucket-program plan-cache key — empty for 'compute' (the historic
+    behavior) so every pre-existing key stays byte-identical."""
+    if not precond_dtype or precond_dtype == "compute":
+        return ""
+    return f".W{precond_dtype}"
+
+
 class PrecondPolicy:
     """Per-session preconditioner selector (constructed by
     ``SolveSession``; also usable standalone).
@@ -152,31 +184,44 @@ class PrecondPolicy:
                 to=to,
             )
 
-    def factory(self, pattern, kind: str):
+    def factory(self, pattern, kind: str, storage_dtype=None,
+                acc_dtype=None):
         """The numeric factory for a resolved kind (``None`` for
         'none'): host-side pattern work (plan-cached, vault-persisted)
         happens here; the returned ``factory(values, matvec) -> Mvec``
         is pure jnp. When a fault clause targets the ``precond`` site
         the returned apply is corruption-wrapped (resilience.faults) —
-        absent otherwise, so clean traces are byte-identical."""
+        absent otherwise, so clean traces are byte-identical.
+
+        ``storage_dtype`` / ``acc_dtype`` (ISSUE 16, the precond x
+        mixed compounding): when set, the Jacobi/ILU factories store
+        their factors at ``storage_dtype`` and widen factorization
+        math and applications to ``acc_dtype`` — the same
+        storage-narrow/accumulate-wide contract the SELL/DIA kernels
+        carry. ``None`` (the default) is byte-identical to the
+        historic factories."""
         from ..resilience import faults as _faults
 
         if kind is None or kind == NONE:
             return None
+        dtk = (
+            {} if storage_dtype is None
+            else {"storage_dtype": storage_dtype, "acc_dtype": acc_dtype}
+        )
         if kind == "jacobi":
             from .jacobi import jacobi_factory
 
-            base = jacobi_factory(pattern)
+            base = jacobi_factory(pattern, **dtk)
         elif kind == "bjacobi":
             from .jacobi import bjacobi_factory
 
-            base = bjacobi_factory(pattern, bs=self.block_size)
+            base = bjacobi_factory(pattern, bs=self.block_size, **dtk)
         elif kind in ("ilu0", "ic0"):
             from .ilu import ilu_factory
 
             base = ilu_factory(
                 pattern, kind, sweeps=self.sweeps,
-                tri_sweeps=self.tri_sweeps,
+                tri_sweeps=self.tri_sweeps, **dtk,
             )
         elif kind == "cheby":
             from .poly import cheby_factory

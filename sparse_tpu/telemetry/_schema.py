@@ -174,6 +174,23 @@ KINDS: dict[str, frozenset] = {
     # one on-demand jax.profiler trace window (telemetry/_profiler.py):
     # ok whether the capture landed; failed captures carry `error`
     "profile.capture": frozenset({"ok", "dir"}),
+    # -- autopilot (sparse_tpu.autopilot, ISSUE 16) -------------------------
+    # one measured experiment: the tuner dispatched `arm`'s candidate
+    # spec for group `group` and scored the retired ticket batch
+    "autopilot.trial": frozenset({"group", "arm"}),
+    # an arm eliminated mid-schedule (SLO-guard breach or a halving
+    # round's worst half) — reason says which
+    "autopilot.abort": frozenset({"group", "arm", "reason"}),
+    # a group converged: exploration closed, `arm` is the pinned
+    # PolicyDecision (persisted as an `autopilot_policy` vault artifact)
+    "autopilot.converge": frozenset({"group", "arm"}),
+    # exploration re-opened on a pinned group: reason is the drift
+    # signal ('watchdog:<rule>', 'promote:<reason>', 'drift', or a
+    # chaos-drill tag)
+    "autopilot.reopen": frozenset({"group", "reason"}),
+    # a restart restored a persisted decision from the vault — the
+    # group serves tuned from its first request, zero trials
+    "autopilot.restore": frozenset({"group", "arm"}),
     # -- generic ------------------------------------------------------------
     # one per process per sink file, written before the first event: the
     # controller's identity (process_index/pid/process_count, device

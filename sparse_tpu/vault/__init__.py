@@ -116,7 +116,8 @@ def note_program(pattern, solver: str, bucket: int, dtype: str,
                  mesh: str | None = None,
                  strategy: str | None = None,
                  precond: str | None = None,
-                 dtype_policy: str | None = None) -> None:
+                 dtype_policy: str | None = None,
+                 precond_dtype: str | None = None) -> None:
     """Record one freshly built bucket program in the warm-start
     manifest (and ensure its pattern artifact exists). Best-effort.
 
@@ -139,7 +140,13 @@ def note_program(pattern, solver: str, bucket: int, dtype: str,
     precision-keyed (``.P``-suffixed) program and a warm restart serves
     the reduced-precision fast path at zero plan-cache misses. ``None``
     (the default) marks an exact program (pre-mixed manifests stay
-    valid)."""
+    valid).
+
+    ``precond_dtype`` is the program's resolved preconditioner storage
+    dtype (ISSUE 16): ``'storage'`` marks the compounding arm whose
+    factors live at the reduced storage dtype (``.W``-suffixed key);
+    ``None`` (the default) marks compute-dtype factors (pre-autopilot
+    manifests stay valid)."""
     if not _store.enabled():
         return
     try:
@@ -159,6 +166,8 @@ def note_program(pattern, solver: str, bucket: int, dtype: str,
             entry["precond"] = str(precond)
         if dtype_policy:
             entry["dtype_policy"] = str(dtype_policy)
+        if precond_dtype:
+            entry["precond_dtype"] = str(precond_dtype)
         _manifest.note(entry)
     except Exception:
         return

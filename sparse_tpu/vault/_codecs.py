@@ -30,6 +30,13 @@ Registered kinds:
                       factorizations (``sparse_tpu.precond``, ISSUE 14):
                       structure-only, one artifact per (pattern, knobs),
                       so warm restarts skip every symbolic build.
+* ``autopilot_policy`` — a converged autopilot :class:`PolicyDecision`
+                      (``sparse_tpu.autopilot``, ISSUE 16): pure-meta
+                      (no arrays), keyed by (pattern fingerprint,
+                      solver, bucket, dtype, SLO class, mesh
+                      fingerprint, candidate-grid fingerprint), so a
+                      restart serves the tuned policy from the first
+                      request instead of re-exploring.
 """
 
 from __future__ import annotations
@@ -258,6 +265,14 @@ def _dec_ilu_symbolic(meta, arrays):
     )
 
 
+def _enc_autopilot_policy(obj):
+    return dict(obj), {}
+
+
+def _dec_autopilot_policy(meta, arrays):
+    return dict(meta)
+
+
 register("pattern", _enc_pattern, _dec_pattern)
 register("sell_pattern", _enc_sell_pattern, _dec_sell_pattern)
 register("prepared_csr", _enc_prepared_csr, _dec_prepared_csr)
@@ -265,3 +280,4 @@ register("prepared_dia", _enc_prepared_dia, _dec_prepared_dia)
 register("precond_diag", _enc_precond_diag, _dec_precond_diag)
 register("precond_block", _enc_precond_block, _dec_precond_block)
 register("ilu_symbolic", _enc_ilu_symbolic, _dec_ilu_symbolic)
+register("autopilot_policy", _enc_autopilot_policy, _dec_autopilot_policy)

@@ -53,13 +53,14 @@ def _entry_key(e: dict) -> tuple:
     # same (pattern, solver, bucket, dtype) program compiled for a
     # different mesh is a DIFFERENT executable and must dedup separately
     # (absent == single-device, so pre-fleet manifests stay valid).
-    # `precond` (ISSUE 14) and `dtype_policy` (ISSUE 15) extend the key
-    # the same back-compatible way: absent == unpreconditioned / exact,
-    # and a precond- or precision-keyed program dedups apart from its
-    # plain sibling.
+    # `precond` (ISSUE 14), `dtype_policy` (ISSUE 15) and
+    # `precond_dtype` (ISSUE 16) extend the key the same back-compatible
+    # way: absent == unpreconditioned / exact / compute-dtype factors,
+    # and a precond-, precision- or storage-factor-keyed program dedups
+    # apart from its plain sibling.
     return (e.get("pattern"), e.get("solver"), e.get("bucket"),
             e.get("dtype"), e.get("mesh"), e.get("precond"),
-            e.get("dtype_policy"))
+            e.get("dtype_policy"), e.get("precond_dtype"))
 
 
 def entries() -> list:

@@ -1,0 +1,347 @@
+"""Autopilot: the online policy tuner (ISSUE 16).
+
+The load-bearing contracts:
+
+* **Convergence** — the bounded epsilon-greedy / successive-halving
+  scheduler pins the measurably better arm (synthetic two-arm race and
+  a real end-to-end serving session).
+* **Persistence** — a converged decision deposits an
+  ``autopilot_policy`` vault artifact; a fresh tuner over the same
+  (pattern, bucket, SLO class, mesh, grid) restores it on first touch
+  (``autopilot.restore``) and serves tuned with zero trials.
+* **SLO guard** — a trial observation over ``slo_factor x slo_ms``
+  kills its arm immediately (``autopilot.abort``).
+* **Drift** — incumbent observations worse than ``drift x`` the pinned
+  score strike the watchdog-visible ``autopilot.drift_strikes``
+  counter; a :func:`drift_rule` alert transition re-opens exploration
+  through the process-global hook (``autopilot.reopen``).
+* **Default off** — ``SPARSE_TPU_AUTOPILOT=''`` leaves the session
+  tuner-less: program keys, results and manifests byte-identical to
+  pre-autopilot behavior. The storage-dtype compounding arm keys as a
+  ``.W`` suffix and converges end to end.
+"""
+
+import numpy as np
+import pytest
+import scipy.sparse as sp
+
+from sparse_tpu import autopilot, plan_cache, telemetry, vault
+from sparse_tpu.batch import SolveSession, SparsityPattern
+from sparse_tpu.config import settings
+from sparse_tpu.resilience import faults
+from sparse_tpu.telemetry import _cost, _metrics, _watchdog
+
+
+@pytest.fixture(autouse=True)
+def _clean_state(tmp_path):
+    faults.clear()
+    old = (settings.vault, settings.telemetry, settings.autopilot,
+           settings.precond_dtype, settings.dtype_policy)
+    settings.vault = ""
+    settings.autopilot = ""
+    settings.precond_dtype = ""
+    telemetry.configure(str(tmp_path / "records.jsonl"))
+    telemetry.reset()
+    plan_cache.clear()
+    yield
+    faults.clear()
+    (settings.vault, settings.telemetry, settings.autopilot,
+     settings.precond_dtype, settings.dtype_policy) = old
+    telemetry.configure(None)
+
+
+def _tridiag(n=32, seed=0, diag=4.0):
+    rng = np.random.default_rng(seed)
+    e = np.ones(n)
+    A = sp.diags([-e[:-1], diag * e, -e[:-1]], [-1, 0, 1], format="csr")
+    A.setdiag(diag + rng.random(n))
+    A = A.tocsr()
+    A.sort_indices()
+    return A
+
+
+def _pattern(A):
+    return SparsityPattern(A.indptr, A.indices, A.shape)
+
+
+def _drive(ap, pattern, scores, bucket=4, dtype=np.float64, slo_ms=None,
+           rounds=40):
+    """Drive assign/observe with synthetic per-arm latencies until the
+    group converges (or ``rounds`` runs out). ``scores`` maps arm_id ->
+    milliseconds."""
+    for _ in range(rounds):
+        spec, token = ap.assign(pattern, "cg", bucket, dtype,
+                                slo_ms=slo_ms)
+        if token is None:
+            break
+        ap.observe(token, solve_ms=scores[autopilot.arm_id(spec)],
+                   lanes=1)
+        if ap.decision_for(pattern, "cg", bucket, dtype,
+                           slo_ms=slo_ms) is not None:
+            break
+    return ap.decision_for(pattern, "cg", bucket, dtype, slo_ms=slo_ms)
+
+
+# ---------------------------------------------------------------------------
+# scheduler mechanics (synthetic observations — no solves)
+# ---------------------------------------------------------------------------
+def test_two_arm_convergence_picks_the_faster_arm():
+    settings.telemetry = True
+    ap = autopilot.Autopilot(
+        grid=({}, {"precond": "jacobi"}), epsilon=1.0, trials=2,
+    )
+    pat = _pattern(_tridiag(seed=1))
+    dec = _drive(ap, pat, {"static": 5.0, "precond=jacobi": 1.0})
+    assert dec is not None
+    assert dec.spec == {"precond": "jacobi"}
+    assert dec.score == pytest.approx(1.0)
+    kinds = [e.get("kind") for e in telemetry.events()]
+    assert "autopilot.trial" in kinds and "autopilot.converge" in kinds
+    # pinned traffic now serves the decision (token kind 'pinned')
+    spec, token = ap.assign(pat, "cg", 4, np.float64)
+    assert spec == {"precond": "jacobi"} and token[1] == "pinned"
+
+
+def test_epsilon_bounds_exploration_to_the_declared_fraction():
+    ap = autopilot.Autopilot(
+        grid=({}, {"precond": "jacobi"}), epsilon=0.25, trials=2,
+    )
+    pat = _pattern(_tridiag(seed=2))
+    kinds = []
+    for _ in range(8):
+        _spec, token = ap.assign(pat, "cg", 4, np.float64)
+        kinds.append(token[1])
+    # period = 4: exactly one trial per 4 dispatches while exploring
+    assert kinds.count("trial") == 2
+    assert kinds.count("incumbent") == 6
+
+
+def test_slo_guard_aborts_a_budget_blowing_arm():
+    settings.telemetry = True
+    ap = autopilot.Autopilot(
+        grid=({}, {"precond": "jacobi"}, {"precond": "bjacobi"}),
+        epsilon=1.0, trials=2, slo_factor=1.5,
+    )
+    pat = _pattern(_tridiag(seed=3))
+    # bjacobi blows the 10ms SLO budget (> 1.5 x 10); the others race on
+    dec = _drive(
+        ap, pat,
+        {"static": 5.0, "precond=jacobi": 2.0, "precond=bjacobi": 100.0},
+        slo_ms=10.0,
+    )
+    assert dec is not None and dec.spec == {"precond": "jacobi"}
+    aborts = [e for e in telemetry.events()
+              if e.get("kind") == "autopilot.abort"]
+    assert aborts and aborts[0]["reason"] == "slo_guard"
+    assert aborts[0]["arm"] == "precond=bjacobi"
+
+
+def test_unconverged_trials_never_win():
+    ap = autopilot.Autopilot(
+        grid=({}, {"precond": "jacobi"}), epsilon=1.0, trials=2,
+    )
+    pat = _pattern(_tridiag(seed=4))
+    for _ in range(40):
+        spec, token = ap.assign(pat, "cg", 4, np.float64)
+        fast_but_wrong = spec == {"precond": "jacobi"}
+        ap.observe(token, solve_ms=0.1 if fast_but_wrong else 5.0,
+                   converged=0.5 if fast_but_wrong else 1.0)
+        dec = ap.decision_for(pat, "cg", 4, np.float64)
+        if dec is not None:
+            break
+    assert dec is not None and dec.spec == {}
+
+
+def test_drift_strikes_and_watchdog_reopen():
+    settings.telemetry = True
+    ap = autopilot.Autopilot(
+        grid=({}, {"precond": "jacobi"}), epsilon=1.0, trials=2, drift=2.0,
+    )
+    pat = _pattern(_tridiag(seed=5))
+    dec = _drive(ap, pat, {"static": 5.0, "precond=jacobi": 1.0})
+    assert dec is not None
+    wd = _watchdog.Watchdog([autopilot.drift_rule()], interval_s=0.01)
+    wd.evaluate()  # priming tick (windowed delta)
+    assert wd.evaluate() == []  # no strikes yet: quiet
+    # pinned traffic regresses past drift x the decision score
+    for _ in range(3):
+        _spec, token = ap.assign(pat, "cg", 4, np.float64)
+        assert token[1] == "pinned"
+        ap.observe(token, solve_ms=50.0)
+    transitions = wd.evaluate()
+    assert any(t["rule"] == "autopilot_drift" for t in transitions)
+    # the alert hook re-opened exploration in every live autopilot
+    assert ap.decision_for(pat, "cg", 4, np.float64) is None
+    reopens = [e for e in telemetry.events()
+               if e.get("kind") == "autopilot.reopen"]
+    assert reopens and reopens[-1]["reason"].startswith("watchdog:")
+    # and the group converges again from fresh measurements
+    dec2 = _drive(ap, pat, {"static": 5.0, "precond=jacobi": 1.0})
+    assert dec2 is not None and dec2.spec == {"precond": "jacobi"}
+
+
+def test_vault_persistence_round_trip(tmp_path):
+    settings.vault = str(tmp_path / "vault")
+    settings.telemetry = True
+    pat = _pattern(_tridiag(seed=6))
+    ap = autopilot.Autopilot(
+        grid=({}, {"precond": "jacobi"}), epsilon=1.0, trials=2,
+    )
+    dec = _drive(ap, pat, {"static": 5.0, "precond=jacobi": 1.0})
+    assert dec is not None and not dec.restored
+    # a fresh tuner (the restarted process) restores on first touch:
+    # tuned from the first request, zero trials
+    ap2 = autopilot.Autopilot(
+        grid=({}, {"precond": "jacobi"}), epsilon=1.0, trials=2,
+    )
+    spec, token = ap2.assign(pat, "cg", 4, np.float64)
+    assert spec == {"precond": "jacobi"} and token[1] == "pinned"
+    dec2 = ap2.decision_for(pat, "cg", 4, np.float64)
+    assert dec2.restored and dec2.spec == dec.spec
+    assert [e for e in telemetry.events()
+            if e.get("kind") == "autopilot.restore"]
+    # a different grid is a different vault key: no stale restore
+    ap3 = autopilot.Autopilot(
+        grid=({}, {"precond": "bjacobi"}), epsilon=1.0, trials=2,
+    )
+    _spec, token3 = ap3.assign(pat, "cg", 4, np.float64)
+    assert token3 is None or token3[1] != "pinned"
+
+
+def test_grid_validation_rejects_typos():
+    with pytest.raises(ValueError):
+        autopilot.Autopilot(grid=({"precnd": "jacobi"},))
+    with pytest.raises(ValueError):
+        autopilot.Autopilot(grid=({"precond": "jacoby"},))
+    with pytest.raises(ValueError):
+        autopilot.Autopilot(grid=())
+
+
+def test_slo_class_boundaries():
+    assert autopilot.slo_class(None) == "none"
+    assert autopilot.slo_class(50) == "tight"
+    assert autopilot.slo_class(500) == "standard"
+    assert autopilot.slo_class(5000) == "relaxed"
+
+
+# ---------------------------------------------------------------------------
+# serving integration
+# ---------------------------------------------------------------------------
+def test_default_off_is_bit_identical():
+    """No tuner object, historic program keys, identical results."""
+    A = _tridiag(32, seed=7)
+    b = np.random.default_rng(8).standard_normal(32)
+    _cost.reset()
+    ses = SolveSession("cg", warm_start=False)
+    assert ses.autopilot is None
+    assert "autopilot" not in ses.session_stats()
+    t = ses.submit(A, b, tol=1e-9, maxiter=2000)
+    ses.flush()
+    x, i, r = t.result()
+    ses2 = SolveSession("cg", warm_start=False, autopilot=False)
+    t2 = ses2.submit(A, b, tol=1e-9, maxiter=2000)
+    ses2.flush()
+    x2, i2, r2 = t2.result()
+    assert np.array_equal(np.asarray(x), np.asarray(x2))
+    assert i == i2 and r == r2
+    # one shared historic key — no autopilot, no .W anywhere
+    assert set(_cost.programs()) == {"batch.cg.B1.<f8"}
+
+
+def test_session_end_to_end_convergence_and_stats():
+    A = _tridiag(32, seed=9)
+    rng = np.random.default_rng(10)
+    bs = [rng.random(32) for _ in range(4)]
+    ap = autopilot.Autopilot(
+        grid=({}, {"precond": "jacobi"}), epsilon=1.0, trials=1,
+    )
+    ses = SolveSession("cg", warm_start=False, autopilot=ap)
+    for _ in range(12):
+        tks = [ses.submit(A, b, tol=1e-9, maxiter=2000) for b in bs]
+        ses.flush()
+        for t, b in zip(tks, bs):
+            x, _i, _r = t.result()
+            assert np.linalg.norm(A @ np.asarray(x) - b) <= 1e-7
+    blk = ses.session_stats()["autopilot"]
+    assert blk["arms"] == ["static", "precond=jacobi"]
+    groups = list(blk["groups"].values())
+    assert groups and groups[0]["phase"] == "converged"
+    assert groups[0]["trials"] >= 2
+
+
+def test_storage_precond_dtype_keys_and_converges():
+    """The compounding arm (ISSUE 16): reduced-width factors under the
+    f32 IR loop — '.W' program key, converged f64-accurate results."""
+    A = _tridiag(48, seed=11)
+    rng = np.random.default_rng(12)
+    bs = [rng.random(48) for _ in range(4)]
+    _cost.reset()
+    ses = SolveSession("cg", warm_start=False)
+    tks = [ses.submit(A, b, tol=1e-8, maxiter=4000, precond="bjacobi",
+                      dtype_policy="f32ir", precond_dtype="storage")
+           for b in bs]
+    ses.flush()
+    for t, b in zip(tks, bs):
+        x, _i, _r = t.result()
+        assert t.converged
+        assert np.linalg.norm(A @ np.asarray(x) - b) <= 1e-6
+    assert "batch.cg.B4.<f8.Mbjacobi.Pf32ir.Wstorage" in set(
+        _cost.programs()
+    )
+
+
+def test_storage_precond_dtype_degrades_outside_reduced_buckets():
+    """'storage' without a reduced dtype policy (or without stored
+    factors) falls back to 'compute' with a breadcrumb — the key stays
+    historic."""
+    A = _tridiag(32, seed=13)
+    settings.telemetry = True
+    _cost.reset()
+    ses = SolveSession("cg", warm_start=False)
+    t = ses.submit(A, np.ones(32), tol=1e-9, maxiter=2000,
+                   precond="jacobi", precond_dtype="storage")
+    ses.flush()
+    t.result()
+    keys = set(_cost.programs())
+    assert "batch.cg.B1.<f8.Mjacobi" in keys
+    assert not any(".W" in k for k in keys)
+    fb = [e for e in telemetry.events()
+          if e.get("kind") == "coverage.fallback"
+          and e.get("op") == "precond.storage"]
+    assert fb and fb[0]["to"] == "compute"
+
+
+def test_manifest_records_precond_dtype_and_replays(tmp_path):
+    settings.vault = str(tmp_path / "vault")
+    A = _tridiag(48, seed=14)
+    b = np.random.default_rng(15).standard_normal(48)
+    ses = SolveSession("cg", warm_start=False)
+    t = ses.submit(A, b, tol=1e-8, maxiter=4000, precond="bjacobi",
+                   dtype_policy="f32ir", precond_dtype="storage")
+    ses.flush()
+    t.result()
+    entries = vault.manifest_entries()
+    assert any(e.get("precond_dtype") == "storage" for e in entries)
+    plan_cache.clear()
+    ses2 = SolveSession("cg", warm_start=True, warm_async=False)
+    assert ses2.warm_replayed >= 1
+    snap = plan_cache.snapshot()
+    t2 = ses2.submit(A, b, tol=1e-8, maxiter=4000, precond="bjacobi",
+                     dtype_policy="f32ir", precond_dtype="storage")
+    ses2.flush()
+    t2.result()
+    assert plan_cache.delta(snap)["misses"] == 0
+
+
+def test_schema_kinds_registered():
+    from sparse_tpu.telemetry import _schema
+
+    for kind in ("autopilot.trial", "autopilot.abort",
+                 "autopilot.converge", "autopilot.reopen",
+                 "autopilot.restore"):
+        assert kind in _schema.KINDS
+    assert _schema.validate(
+        {"kind": "autopilot.reopen", "ts": 1.0, "group": "g",
+         "reason": "drift"}
+    ) == []
+    assert _schema.validate({"kind": "autopilot.reopen", "ts": 1.0})

@@ -446,7 +446,11 @@ def test_session_auto_flush_and_mixed_tols():
     t0 = ses.submit(mats[0], rhs[0], tol=1e-4, maxiter=100)
     assert not t0.done
     t1 = ses.submit(mats[1], rhs[1], tol=1e-12, maxiter=400)
-    # auto_flush fired on the second submit
+    # auto_flush fired on the second submit: both lanes dispatched (the
+    # pipelined fast path launches without waiting, so retirement is
+    # only guaranteed once a result is demanded — not at submit return)
+    assert all(not q for q in ses._pending.values())
+    t0.result(), t1.result()
     assert t0.done and t1.done
     _x0, it0, r0 = t0.result()
     _x1, it1, r1 = t1.result()

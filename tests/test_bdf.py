@@ -37,10 +37,13 @@ def test_bdf_robertson_matches_scipy():
     np.testing.assert_allclose(np.asarray(sol.y)[:, -1], ref.y[:, -1],
                                rtol=1e-6)
     # stiffness sanity: an explicit method at the same tolerance needs
-    # far more RHS evaluations than BDF on this problem
-    rk = solve_ivp(_rober, (0, 100.0), np.array([1.0, 0, 0]),
+    # far more RHS evaluations than BDF on this problem. A tenth of the
+    # span suffices — RK45's step size is pinned by the fast transient,
+    # so its nfev scales ~linearly with span — and spares the runner
+    # the other 90 stiff time units.
+    rk = solve_ivp(_rober, (0, 10.0), np.array([1.0, 0, 0]),
                    method="RK45", rtol=1e-6, atol=1e-9)
-    assert sol.nfev < rk.nfev / 5
+    assert sol.nfev < rk.nfev / 2
 
 
 def test_bdf_linear_sparse_jacobian():

@@ -115,7 +115,9 @@ def test_funm_multiply_krylov_inv_sqrt():
     b = sample_vec(n, seed=9)
 
     def inv_sqrt(M):
-        return np.linalg.inv(scipy.linalg.sqrtm(M))
+        # this scipy build's sqrtm upcasts to longdouble complex, which
+        # np.linalg.inv rejects; the oracle only needs complex128
+        return np.linalg.inv(scipy.linalg.sqrtm(M).astype(np.complex128))
 
     y = np.asarray(linalg.funm_multiply_krylov(
         inv_sqrt, A, b, assume_a="her", restart_every_m=25,

@@ -1,8 +1,8 @@
 """Library-wide operator plan cache: counters, eviction, solver reuse.
 
 The acceptance instrument of ISSUE 2's prepare/execute split: one miss at
-prepare, hits for every subsequent matvec of a solve (>= 99% over a
-100-iteration CG), entries dying with their operator, LRU bounded, and a
+prepare, hits for every subsequent matvec of a solve (>= 98% over a
+50-iteration CG), entries dying with their operator, LRU bounded, and a
 disable switch that changes performance only — never results.
 """
 
@@ -117,22 +117,23 @@ def _skewed_spd(m=400, seed=5):
 
 
 def test_cg_100_iters_hit_rate(monkeypatch):
-    """The headline contract: a 100-iteration CG solve prepares once and
-    reuses the plan for every matvec — >= 99% hit rate (1 miss at
-    prepare). Host loop (per-iteration eager matvecs) via callback."""
+    """The headline contract: a long host-loop CG solve prepares once and
+    reuses the plan for every matvec — exactly 1 miss (at prepare), hits
+    for the rest. 50 eager per-iteration matvecs (via callback) pin the
+    same asymptote 100 did at half the dispatch cost."""
     monkeypatch.setattr(settings, "spmv_mode", "sell")
     s = _skewed_spd()
     A = sparse_tpu.csr_array(s)
     b = np.random.default_rng(0).standard_normal(s.shape[0])
     plan_cache.reset_stats()
     x, iters = linalg.cg(
-        A, b, maxiter=100, tol=1e-30, conv_test_iters=200,
+        A, b, maxiter=50, tol=1e-30, conv_test_iters=200,
         callback=lambda _x: None,
     )
-    assert iters == 100
+    assert iters == 50
     st = plan_cache.stats()
     assert st["misses"] == 1
-    assert st["hit_rate"] >= 0.99
+    assert st["hit_rate"] >= 0.98
     # and the solve is still a solve
     np.testing.assert_allclose(np.asarray(A @ x), b, rtol=1e-4, atol=1e-5)
 
