@@ -1122,6 +1122,116 @@ def run_auto_cg(B: int = 8, tol: float = 1e-6) -> dict:
     return out
 
 
+def run_ingest(n: int = 20000, row_nnz: int = 8, seed: int = 31) -> dict:
+    """Ingest data-plane row (ISSUE 18): rows/s through the sharded
+    samplesort COO->CSR, and through FULL cold onboarding (sort ->
+    pattern -> SELL pack -> bucket prebuild -> first solve) vs the
+    dedup-hit path of a structural re-arrival.
+
+    Tracked numbers:
+
+    * ``sort.rows_per_s`` / ``sort.entries_per_s``: the distributed
+      samplesort alone (second run, setup warm);
+    * ``cold.onboard_ms`` / ``cold.rows_per_s``: submit -> ticket-ready
+      wall for an unseen pattern (the whole data plane, compiles
+      included), plus its first-solve latency and the plan-cache misses
+      the onboarding spent;
+    * ``dedup.onboard_ms`` / ``dedup.rows_per_s``: the same structure
+      re-arriving with new values — fingerprint hit, values grafted,
+      ZERO new plan-cache misses (``dedup.plan_misses`` is the
+      acceptance number), plus the first-solve latency on the grafted
+      CSR;
+    * ``dedup.speedup``: cold/dedup onboarding wall ratio;
+    * ``win``: dedup onboarded faster than cold AND spent zero misses.
+    """
+    import time as _time
+
+    import numpy as np
+
+    from sparse_tpu import plan_cache
+    from sparse_tpu.batch import SolveSession
+    from sparse_tpu.ingest import ingest_coo_to_csr
+    from sparse_tpu.parallel.mesh import get_mesh
+
+    rng = np.random.default_rng(seed)
+    k = n * row_nnz
+    r = rng.integers(0, n, size=k)
+    c = rng.integers(0, n, size=k)
+    v = 0.05 * rng.standard_normal(k)
+    d = np.arange(n)
+    rows = np.concatenate([d, r, c])
+    cols = np.concatenate([d, c, r])
+    vals = np.concatenate([np.full(n, 4.0 * row_nnz), v, v])
+    shape = (n, n)
+    out = {"rows": n, "entries": int(rows.shape[0]),
+           "shards": int(get_mesh(None).devices.size)}
+
+    # -- the sort alone (second run: sharding/compile setup warm) ----------
+    ingest_coo_to_csr(rows, cols, vals, shape)
+    t0 = _time.perf_counter()
+    ingest_coo_to_csr(rows, cols, vals, shape)
+    sort_s = max(_time.perf_counter() - t0, 1e-9)
+    out["sort"] = {
+        "wall_s": round(sort_s, 4),
+        "rows_per_s": round(n / sort_s, 1),
+        "entries_per_s": round(rows.shape[0] / sort_s, 1),
+    }
+
+    # -- cold onboarding: the whole data plane, compiles included ----------
+    ses = SolveSession("cg")
+    b = np.ones(n)
+    try:
+        snap = plan_cache.snapshot()
+        t1 = ses.ingest((rows, cols, vals, shape), wait=True, timeout=600.0)
+        cold_misses = plan_cache.delta(snap)["misses"]
+        res1 = t1.result()
+        t0 = _time.perf_counter()
+        tk = ses.submit(res1["csr"], b, tol=1e-6)
+        ses.drain()
+        tk.result()
+        cold_solve_ms = (_time.perf_counter() - t0) * 1e3
+        out["cold"] = {
+            "onboard_ms": t1.wall_ms,
+            "rows_per_s": round(n / (t1.wall_ms / 1e3), 1),
+            "first_solve_ms": round(cold_solve_ms, 3),
+            "plan_misses": int(cold_misses),
+        }
+
+        # -- dedup-hit re-arrival: same structure, new values --------------
+        snap = plan_cache.snapshot()
+        t2 = ses.ingest((rows, cols, vals * 1.25, shape), wait=True,
+                        timeout=600.0)
+        res2 = t2.result()
+        t0 = _time.perf_counter()
+        tk = ses.submit(res2["csr"], b, tol=1e-6)
+        ses.drain()
+        tk.result()
+        dedup_solve_ms = (_time.perf_counter() - t0) * 1e3
+        dedup_misses = plan_cache.delta(snap)["misses"]
+        out["dedup"] = {
+            "onboard_ms": t2.wall_ms,
+            "rows_per_s": round(n / (t2.wall_ms / 1e3), 1),
+            "first_solve_ms": round(dedup_solve_ms, 3),
+            "plan_misses": int(dedup_misses),
+            "hit": bool(res2["dedup"]),
+            "speedup": round(t1.wall_ms / max(t2.wall_ms, 1e-9), 2),
+        }
+        out["win"] = bool(
+            res2["dedup"] and dedup_misses == 0
+            and t2.wall_ms < t1.wall_ms
+        )
+        # flat headline keys: what axon_report lifts into metrics/trend
+        out["sort_rows_per_s"] = out["sort"]["rows_per_s"]
+        out["cold_onboard_ms"] = out["cold"]["onboard_ms"]
+        out["dedup_onboard_ms"] = out["dedup"]["onboard_ms"]
+        out["dedup_speedup"] = out["dedup"]["speedup"]
+        out["dedup_plan_misses"] = out["dedup"]["plan_misses"]
+    finally:
+        if ses._onboarder is not None:
+            ses._onboarder.close()
+    return out
+
+
 def run_sustained_cg(n: int = 512, B: int = 8, rate: float = 150.0,
                      duration: float = 1.5, slo_ms: float = 250.0,
                      seed: int = 23) -> dict:
@@ -1577,6 +1687,10 @@ def worker(platform_arg: str) -> None:
             rec["auto_cg"] = run_auto_cg()
         except Exception:
             traceback.print_exc(file=sys.stderr)
+        try:  # stage 4.12: ingest data-plane row (ISSUE 18)
+            rec["ingest"] = run_ingest()
+        except Exception:
+            traceback.print_exc(file=sys.stderr)
         print(json.dumps(rec))
         sys.stdout.flush()
         try:  # stage 5: full fused sweep — refines the headline if better
@@ -1641,6 +1755,10 @@ def worker(platform_arg: str) -> None:
             traceback.print_exc(file=sys.stderr)
         try:  # autopilot policy-tuning row (ISSUE 16, the CPU lane)
             rec["auto_cg"] = run_auto_cg()
+        except Exception:
+            traceback.print_exc(file=sys.stderr)
+        try:  # ingest data-plane row (ISSUE 18, the CPU lane)
+            rec["ingest"] = run_ingest()
         except Exception:
             traceback.print_exc(file=sys.stderr)
         print(json.dumps(rec))

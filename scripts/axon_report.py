@@ -706,6 +706,22 @@ def build_report(records_path: str, bench_paths=(), peak_gflops=None,
                        ("ill_speedup_vs_global", True)):
             if _num(auto_row.get(k)) is not None:
                 metrics[f"auto_cg.{k}"] = {"v": auto_row[k], "hib": hib}
+    # the bench ingest row (ISSUE 18): the streaming ingestion data
+    # plane — rows/s through the sharded samplesort, cold-onboarding
+    # wall vs the dedup-hit re-arrival (whose plan_misses must stay 0)
+    ingest_row = None
+    for e in sorted(sessions, key=lambda e: e.get("ts", 0)):
+        rec = e.get("record")
+        if isinstance(rec, dict) and isinstance(rec.get("ingest"), dict):
+            ingest_row = rec["ingest"]
+    if ingest_row:
+        for k, hib in (("sort_rows_per_s", True),
+                       ("cold_onboard_ms", False),
+                       ("dedup_onboard_ms", False),
+                       ("dedup_speedup", True),
+                       ("dedup_plan_misses", False)):
+            if _num(ingest_row.get(k)) is not None:
+                metrics[f"ingest.{k}"] = {"v": ingest_row[k], "hib": hib}
     for key, p in programs.items():
         if _num(p.get("achieved_gflops")) is not None:
             metrics[f"program.{key}.achieved_gflops"] = {
@@ -751,6 +767,7 @@ def build_report(records_path: str, bench_paths=(), peak_gflops=None,
         "precond_row": precond_row,
         "mixed_row": mixed_row,
         "auto_row": auto_row,
+        "ingest_row": ingest_row,
         "autopilot": auto,
         "bench": bench_rows,
         "metrics": metrics,
@@ -772,6 +789,8 @@ _TREND_EMBEDS = (
     ("mixed_cg", ("exact_s", "f32ir_s", "bf16ir_s", "speedup",
                   "bytes_ratio_bf16")),
     ("auto_cg", ("regret_worst", "ill_speedup_vs_global")),
+    ("ingest", ("sort_rows_per_s", "cold_onboard_ms", "dedup_onboard_ms",
+                "dedup_speedup", "dedup_plan_misses")),
 )
 
 
@@ -1074,6 +1093,17 @@ def _print_report(rep: dict) -> None:
             f"{arow.get('regret_worst')}, "
             f"{arow.get('ill_speedup_vs_global')}x vs the global default "
             f"on pde_ill (win={arow.get('win')})"
+        )
+    irow = rep.get("ingest_row")
+    if irow:
+        print(
+            "  ingest: "
+            f"sort {irow.get('sort_rows_per_s')}rows/s "
+            f"({irow.get('shards')} shard(s)), cold onboard "
+            f"{irow.get('cold_onboard_ms')}ms vs dedup "
+            f"{irow.get('dedup_onboard_ms')}ms "
+            f"(speedup={irow.get('dedup_speedup')}x, dedup plan misses="
+            f"{irow.get('dedup_plan_misses')}, win={irow.get('win')})"
         )
     auto = rep.get("autopilot") or {}
     if auto.get("n_groups"):

@@ -110,6 +110,18 @@ chains are missing:
    must survive a vault restart — a FRESH tuner restores it
    (``autopilot.restore``) and serves tuned from the first request
    with zero trials.
+14. **Ingest chaos** (ISSUE 18 acceptance drill) — part A: a seeded
+   loadgen trace with a nonzero unseen-pattern ``ingest`` arrival rate
+   drives a warm ``SolveSession`` while ``truncate:io`` faults tear the
+   onboarder's vault writes: the solve p95 must hold within the SLO
+   through background onboarding, every arrival still onboards
+   (latency reported separately), a torn pattern artifact quarantines
+   on read-back and a fresh session rebuilds it to the IDENTICAL
+   fingerprint. Part B: an ingest child SIGKILLs itself
+   mid-onboarding; a genuinely fresh process replays the vaulted
+   fingerprint index, dedups the re-arrival of the onboarded
+   structure, and serves its first solve at ZERO plan-cache misses —
+   dedup proven restart-surviving, not just in-process.
 
 Telemetry is pointed at a temp sink (never the committed
 ``results/axon/records.jsonl``). Wired into the quick lane through
@@ -120,7 +132,8 @@ Usage:
     python scripts/chaos_check.py [--json]
 
 (``--vault-child serve|warm`` is the internal entry point of scenario
-6's subprocesses — it reads ``SPARSE_TPU_VAULT`` from the env.)
+6's subprocesses — it reads ``SPARSE_TPU_VAULT`` from the env; the
+``-pipe`` and ``ingest-`` modes are scenarios 10 and 14's children.)
 """
 
 from __future__ import annotations
@@ -335,6 +348,10 @@ def run(report: dict) -> list:
 
     # -- 13. autopilot regression: drift -> watchdog reopen -> re-converge --
     problems += _autopilot_chaos(report)
+
+    # -- 14. ingest chaos: io faults + kill mid-onboarding ------------------
+    problems += _ingest_chaos(report)
+    problems += _ingest_kill_restart(report)
     return problems
 
 
@@ -1092,6 +1109,215 @@ def _vault_io_chaos(report: dict) -> list:
     return problems
 
 
+def _ingest_arrival(seed, n=32):
+    """Deterministic SPD-profile COO arrival (shared by scenario 14's
+    parent and subprocess children — same seed => same structure)."""
+    import numpy as np
+
+    rng = np.random.default_rng(seed)
+    k = 2 * n
+    r = rng.integers(0, n, size=k)
+    c = rng.integers(0, n, size=k)
+    v = 0.1 * rng.standard_normal(k)
+    d = np.arange(n)
+    rows = np.concatenate([d, r, c])
+    cols = np.concatenate([d, c, r])
+    vals = np.concatenate([np.full(n, float(n)), v, v])
+    return rows, cols, vals, (n, n)
+
+
+def _ingest_chaos(report: dict) -> list:
+    """Scenario 14 part A (ISSUE 18): a seeded loadgen trace mixes
+    steady solve traffic with unseen-pattern ``ingest`` arrivals while
+    ``truncate:io`` faults tear the vault writes the onboarder makes —
+    the solve p95 must hold within the SLO THROUGH onboarding (the PR's
+    acceptance criterion), every arrival must still onboard, and a torn
+    pattern artifact must quarantine on read-back and rebuild to the
+    identical fingerprint from a fresh session (rebuild parity)."""
+    import numpy as np
+
+    from sparse_tpu import plan_cache, telemetry as tel, vault
+    from sparse_tpu.batch import SolveSession
+    from sparse_tpu.config import settings
+    from sparse_tpu.ingest import structure_key
+    from sparse_tpu.loadgen import ArrivalTrace, run_load
+    from sparse_tpu.resilience import faults
+
+    problems = []
+    tel.reset()
+    vdir = tempfile.mkdtemp(prefix="chaos_ingest_")
+    old_vault = settings.vault
+    settings.vault = vdir
+    SLO = 2000.0
+    ses = ses2 = None
+    try:
+        mats, rhs = _vault_traffic()
+        ses = SolveSession("cg", slo_ms=SLO, warm_start=False)
+        ses.solve_many(mats, rhs, tol=TOL)  # prewarm the serving set
+        trace = ArrivalTrace.parse(
+            "poisson:rate=30,duration=0.4,seed=4;"
+            "ingest:rate=5,duration=0.4,seed=2,size=20"
+        )
+        faults.configure("truncate:io:p=0.3,seed=9")
+        try:
+            rep = run_load(ses, trace, list(zip(mats, rhs)), tol=TOL,
+                           record=False)
+        finally:
+            faults.clear()
+        kinds = _event_kinds(tel)
+        onboard = rep.onboard
+        report["ingest_chaos"] = {
+            "p95_ms": rep.latency_ms["p95"], "slo_ms": SLO,
+            "slo_miss_rate": rep.slo_miss_rate, "onboard": onboard,
+            "events": {k: v for k, v in kinds.items()
+                       if k.startswith(("ingest.", "fault."))},
+        }
+        if rep.latency_ms["p95"] > SLO or rep.slo_miss_rate > 0:
+            problems.append(
+                f"ingest chaos: solve p95 {rep.latency_ms['p95']:.1f}ms "
+                f"breached the {SLO:.0f}ms SLO while onboarding ran — "
+                "background ingestion leaked onto the serving path"
+            )
+        if onboard.get("completed", 0) < 1 or onboard.get("failed", 0):
+            problems.append(
+                f"ingest chaos: onboarding under io faults did not "
+                f"complete cleanly ({onboard})"
+            )
+        if onboard.get("latency_ms", {}).get("p95", 0.0) <= 0.0:
+            problems.append(
+                "ingest chaos: no separate onboarding latency recorded"
+            )
+        for kind in ("ingest.arrive", "ingest.sort", "ingest.dedup",
+                     "ingest.onboard"):
+            if kinds.get(kind, 0) == 0:
+                problems.append(f"ingest chaos: no {kind} events")
+
+        # torn-write drill: tear the cold onboard's vault writes, prove
+        # quarantine on read-back + fingerprint-identical rebuild
+        src = _ingest_arrival(seed=101)
+        faults.configure("truncate:io:p=1")  # every onboard write torn
+        try:
+            t1 = ses.ingest(src, wait=True, timeout=240.0)
+        finally:
+            faults.clear()
+        skey = structure_key(src[0], src[1], src[3])
+        pkey = ses._onboarder.index.lookup(skey)
+        base_q = vault.stats()["quarantined"]
+        torn = vault.load_pattern(pkey) if pkey else None
+        quarantined = vault.stats()["quarantined"] > base_q
+        ses2 = SolveSession("cg", warm_start=False)
+        t2 = ses2.ingest(src, wait=True, timeout=240.0)
+        rebuilt = vault.load_pattern(pkey) if pkey else None
+        report["ingest_chaos"]["torn"] = {
+            "quarantined": bool(quarantined),
+            "torn_read": torn is not None,
+            "rebuild_fp_match": bool(
+                t2.pattern is not None and t1.pattern is not None
+                and t2.pattern.fingerprint == t1.pattern.fingerprint
+            ),
+            "restored": rebuilt is not None,
+        }
+        if pkey is None:
+            problems.append("ingest chaos: onboard noted no pattern key")
+        if not quarantined and torn is not None:
+            problems.append(
+                "ingest chaos: torn pattern artifact served without "
+                "quarantine"
+            )
+        if t2.state != "ready" or t2.pattern.fingerprint != \
+                t1.pattern.fingerprint:
+            problems.append(
+                "ingest chaos: rebuild after torn artifact lost parity "
+                f"(state={t2.state})"
+            )
+        if rebuilt is None or rebuilt.fingerprint != t1.pattern.fingerprint:
+            problems.append(
+                "ingest chaos: re-onboard did not restore the vaulted "
+                "pattern artifact"
+            )
+    finally:
+        for s in (ses, ses2):
+            if s is not None and s._onboarder is not None:
+                s._onboarder.close()
+        settings.vault = old_vault
+        faults.clear()
+        plan_cache.clear()
+    return problems
+
+
+def _ingest_kill_restart(report: dict) -> list:
+    """Scenario 14 part B: an ingest child onboards one arrival into a
+    fresh vault, then SIGKILLs itself mid-second-onboarding (partial
+    artifacts on disk); a genuinely fresh process must replay the
+    vaulted fingerprint index, dedup the re-arrival of the first
+    structure, and serve its first solve at ZERO plan-cache misses —
+    the restart-surviving half of the dedup acceptance criterion."""
+    problems = []
+    vdir = tempfile.mkdtemp(prefix="chaos_ingest_kr_")
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["SPARSE_TPU_VAULT"] = vdir
+    env["SPARSE_TPU_COMPILE_CACHE"] = os.path.join(vdir, "_xla_cache")
+    env.pop("SPARSE_TPU_FAULTS", None)
+
+    def child(mode):
+        return subprocess.run(
+            [sys.executable, os.path.abspath(__file__),
+             "--vault-child", mode],
+            env=env, capture_output=True, text=True, timeout=300,
+        )
+
+    serve = child("ingest-serve")
+    if "SERVED" not in serve.stdout:
+        problems.append(
+            f"ingest restart: serve child never onboarded "
+            f"(rc={serve.returncode}, stderr tail: "
+            f"{serve.stderr[-300:]!r})"
+        )
+    elif serve.returncode != -signal.SIGKILL:
+        problems.append(
+            "ingest restart: serve child was supposed to die by SIGKILL "
+            f"mid-onboarding (rc={serve.returncode})"
+        )
+    warm = child("ingest-warm")
+    out = None
+    for line in warm.stdout.splitlines():
+        if line.startswith("WARM "):
+            try:
+                out = json.loads(line[5:])
+            except json.JSONDecodeError:
+                pass
+    if out is None:
+        problems.append(
+            f"ingest restart: warm child produced no report "
+            f"(rc={warm.returncode}, stderr tail: {warm.stderr[-300:]!r})"
+        )
+        return problems
+    report["ingest_restart"] = out
+    if out.get("index_entries", 0) < 1:
+        problems.append(
+            "ingest restart: fresh process replayed no fingerprint index"
+        )
+    if not out.get("dedup", False):
+        problems.append(
+            "ingest restart: re-arrival of a vaulted structure was not "
+            "deduped across the restart"
+        )
+    d = out.get("delta", {})
+    if d.get("misses", 1) != 0:
+        problems.append(
+            f"ingest restart: deduped re-arrival cost "
+            f"{d.get('misses')} plan-cache miss(es) — its first solve "
+            "must be a pure hit"
+        )
+    if not (out.get("resid", 1.0) <= 1e-6):
+        problems.append(
+            f"ingest restart: deduped solve wrong "
+            f"(||r||={out.get('resid'):.2e})"
+        )
+    return problems
+
+
 #: scenario 6's traffic shape (shared by parent assertions and children)
 VAULT_B = 4
 VAULT_N = 64
@@ -1443,6 +1669,51 @@ def vault_child(mode: str) -> int:
     from sparse_tpu.batch import SolveSession
 
     mats, rhs = _vault_traffic()
+    if mode == "ingest-serve":
+        # scenario 14B serve child: onboard one arrival cleanly (vault
+        # gets the pattern + fingerprint index), then die by SIGKILL
+        # mid-second-onboarding — partial artifacts are the point
+        import time
+
+        ses = SolveSession("cg", warm_start=False)
+        t = ses.ingest(_ingest_arrival(seed=101), wait=True, timeout=240.0)
+        if t.state != "ready":
+            return 1
+        print("SERVED", flush=True)
+        ses.ingest(_ingest_arrival(seed=202))  # background, never waits
+        time.sleep(0.05)  # let the worker get INTO the onboard
+        os.kill(os.getpid(), signal.SIGKILL)
+        return 1  # unreachable
+    if mode == "ingest-warm":
+        # scenario 14B warm child: a fresh process replays the vaulted
+        # fingerprint index; the re-arrival dedups and its first solve
+        # is a pure plan-cache hit (zero misses)
+        import scipy.sparse as sp
+
+        ses = SolveSession("cg", warm_start=True)
+        _ = ses.warm_replayed  # join the async replay before snapshot
+        src = _ingest_arrival(seed=101)
+        snap = plan_cache.snapshot()
+        t = ses.ingest(src, wait=True, timeout=240.0)
+        out = t.result()
+        n = src[3][0]
+        b = np.ones(n)
+        tk = ses.submit(out["csr"], b, tol=TOL)
+        ses.drain()
+        x = np.asarray(tk.result()[0])
+        A = sp.csr_matrix(
+            (np.asarray(out["csr"].data), np.asarray(out["csr"].indices),
+             np.asarray(out["csr"].indptr)), shape=src[3],
+        )
+        print("WARM " + json.dumps({
+            "dedup": bool(out["dedup"]),
+            "delta": plan_cache.delta(snap),
+            "index_entries": len(ses._onboarder.index),
+            "replayed": ses.warm_replayed,
+            "resid": float(np.linalg.norm(A @ x - b)),
+            "vault": vault.stats(),
+        }), flush=True)
+        return 0
     if mode == "serve":
         ses = SolveSession("cg", warm_start=False)
         ses.solve_many(mats, rhs, tol=TOL)
@@ -1549,6 +1820,8 @@ def main(argv) -> int:
         mp = report.get("mixed_promote", {})
         mw = report.get("mixed_warm_restart", {})
         ac = report.get("autopilot_chaos", {})
+        ig = report.get("ingest_chaos", {})
+        ir = report.get("ingest_restart", {})
         print(
             "chaos check passed: "
             f"{len([k for k in report if k.startswith('solver.')])} solvers "
@@ -1578,7 +1851,14 @@ def main(argv) -> int:
             f"autopilot drift->reopen->reconverge ok "
             f"({ac.get('drift_strikes', 0):.0f} strike(s), re-pinned "
             f"{ac.get('reconverged', {}).get('arm', '?')!r}, restart "
-            f"restored={ac.get('restart', {}).get('restored', '?')})"
+            f"restored={ac.get('restart', {}).get('restored', '?')}), "
+            f"ingest chaos ok (solve p95 "
+            f"{ig.get('p95_ms', '?')}ms under SLO through "
+            f"{ig.get('onboard', {}).get('completed', 0)} onboard(s), "
+            f"torn artifact quarantined="
+            f"{ig.get('torn', {}).get('quarantined', '?')}, restart dedup="
+            f"{ir.get('dedup', '?')} at "
+            f"{ir.get('delta', {}).get('misses', '?')} serving misses)"
         )
     return 1 if problems else 0
 

@@ -72,6 +72,6 @@ dok_matrix = dok_array
 bsr_matrix = bsr_array
 lil_matrix = lil_array
 
-from . import batch, csgraph, integrate, io, linalg, mixed, plan_cache, quantum, resilience, spatial, telemetry  # noqa: F401,E402
+from . import batch, csgraph, ingest, integrate, io, linalg, mixed, plan_cache, quantum, resilience, spatial, telemetry  # noqa: F401,E402
 
 from .coverage import coverage_report, track_provenance  # noqa: F401,E402

@@ -756,6 +756,10 @@ class SolveSession:
             enable_compilation_cache(settings.compile_cache)
         self._warm: _WarmReplay | None = None
         self._warm_replayed = 0
+        # background ingest onboarder (ISSUE 18): created lazily on the
+        # first ingest() call — a session that never ingests carries no
+        # worker thread and no queue
+        self._onboarder = None
         from .. import vault
 
         if (vault.enabled() if warm_start is None else warm_start):
@@ -793,6 +797,35 @@ class SolveSession:
         object => same plan-cache entries across callers."""
         p = SparsityPattern.from_csr(A)
         return self._patterns.setdefault(p.fingerprint, p)
+
+    def ingest(self, source, *, bucket: int = 1, dtype=np.float64,
+               num_shards: int | None = None, wait: bool = False,
+               timeout: float | None = None):
+        """Queue one arriving matrix for background onboarding
+        (ISSUE 18): parse -> fingerprint dedup -> sharded samplesort
+        COO->CSR -> SELL pack + bucket prebuild + vault persistence,
+        all on the bounded onboarder worker, never on the serving path.
+
+        ``source`` is a MatrixMarket path, anything COO/CSR-shaped, or
+        a raw ``(rows, cols, vals, shape)`` tuple. Returns an
+        :class:`~sparse_tpu.ingest.IngestTicket` immediately (admission
+        permitting — at ``SPARSE_TPU_INGEST_DEPTH`` queued arrivals the
+        configured admission mode blocks or rejects); ``wait=True``
+        blocks for the outcome first. ``bucket``/``dtype`` shape the
+        program a cold pattern gets prebuilt ahead of its first solve.
+        A dedup hit rides the existing pattern object: its first solve
+        is a pure plan-cache hit — zero new compiles."""
+        from ..ingest.onboard import Onboarder
+
+        ob = self._onboarder
+        if ob is None:
+            ob = self._onboarder = Onboarder(self)
+        t = ob.submit(
+            source, bucket=bucket, dtype=dtype, num_shards=num_shards
+        )
+        if wait:
+            t.result(timeout=timeout)
+        return t
 
     def submit(self, A, b, tol: float = 1e-8, x0=None, maxiter=None,
                pattern: SparsityPattern | None = None,
@@ -973,6 +1006,8 @@ class SolveSession:
                 ),
                 **self._ticket_counts,
             },
+            **({"ingest": self._onboarder.stats()}
+               if self._onboarder is not None else {}),
         }
 
     # -- warm restart (ISSUE 9; async since ISSUE 13) ----------------------

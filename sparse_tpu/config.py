@@ -110,6 +110,14 @@ Flags (all env-overridable):
   SPARSE_TPU_AUTOPILOT_DRIFT  - drift threshold: a pinned-arm observation slower
                                 than factor * the decision score counts a drift
                                 strike into autopilot.drift_strikes (default 2.0).
+  SPARSE_TPU_INGEST_DEPTH     - streaming ingestion data plane (sparse_tpu.ingest):
+                                max arrivals queued on the background onboarder
+                                before admission control engages (default 16).
+  SPARSE_TPU_INGEST_ADMISSION - 'block' (default) backpressures the submitter at
+                                the bound; 'reject' raises IngestAdmissionError.
+  SPARSE_TPU_INGEST_RETRIES   - onboarding attempts per arrival beyond the first
+                                before its ticket fails (default 1); serving is
+                                unaffected while the background worker retries.
 """
 
 from __future__ import annotations
@@ -420,6 +428,27 @@ class Settings:
         default_factory=lambda: max(
             _env_float("SPARSE_TPU_AUTOPILOT_DRIFT", 2.0), 1.0
         )
+    )
+
+    # -- streaming ingestion data plane (sparse_tpu.ingest, ISSUE 18) ------
+    # Onboarding admission bound: max arrivals queued on the background
+    # onboarder before admission control engages (the ingest analog of
+    # SPARSE_TPU_BATCH_MAX's queue depth role on the solve pipeline).
+    ingest_depth: int = field(
+        default_factory=lambda: max(_env_int("SPARSE_TPU_INGEST_DEPTH", 16), 1)
+    )
+    # What happens AT the bound: 'block' (default) backpressures the
+    # submitting thread until the worker frees a slot; 'reject' raises
+    # IngestAdmissionError immediately (load-shedding posture).
+    ingest_admission: str = field(
+        default_factory=lambda: _env_str("SPARSE_TPU_INGEST_ADMISSION", "block")
+    )
+    # Onboarding attempts per arrival beyond the first: a failed parse/
+    # sort/onboard (io faults, torn vault artifacts) retries this many
+    # times before the ticket fails — serving is never affected either
+    # way (the worker owns every retry).
+    ingest_retries: int = field(
+        default_factory=lambda: max(_env_int("SPARSE_TPU_INGEST_RETRIES", 1), 0)
     )
 
 

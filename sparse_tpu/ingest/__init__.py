@@ -1,0 +1,77 @@
+"""sparse_tpu.ingest — the streaming matrix ingestion data plane (ISSUE 18).
+
+Every pattern the serving stack handled before this subsystem was
+constructed in-process; production traffic means unseen matrices
+arriving constantly. This package reproduces the reference's canonical
+init path — ``mmread -> distributed samplesort COO->CSR -> nnz-balanced
+partitions`` (SURVEY §3.1, §2c-3; Legate Sparse SC'23 §1 builds its
+whole runtime around this dependent-partitioning ingest) — as a
+*serving-tier* pipeline riding the async machinery of ISSUE 13. Three
+pieces:
+
+* :mod:`.sort` — mesh-sharded bucketed ``all_to_all`` samplesort
+  COO->CSR (``ingest_coo_to_csr``): local sort -> sample gather ->
+  splitters -> ragged exchange -> merge (SURVEY §7's SORT_BY_KEY
+  translation), accounted through :mod:`sparse_tpu.parallel.comm`
+  SiteLedgers, with a single-device ``jax.lax.sort`` fast path for
+  arrivals too small to shard, plus :func:`~.sort.balance` — the
+  reference's ``balance()`` analog — producing nnz-balanced row
+  partitions for skewed arrivals.
+* :mod:`.fingerprint` — structure-only content keys
+  (:func:`~.fingerprint.structure_key`) that dedup arrivals onto
+  existing :class:`~sparse_tpu.batch.operator.SparsityPattern` objects:
+  a hit means zero new compiles — the whole program-key chain (SELL
+  packs, precond symbolics, bucket programs, autopilot decisions) is
+  already warm behind the existing pattern object the plan cache keys
+  on. The :class:`~.fingerprint.FingerprintIndex` persists
+  ``structure key -> vault pattern key`` as a vault artifact, so dedup
+  survives restarts: a fresh process recognizes a re-arrival before it
+  has ever seen the matrix in-memory.
+* :mod:`.onboard` — a background onboarding queue
+  (:class:`~.onboard.Onboarder`, a bounded worker thread generalizing
+  the warm-replay machinery) running the expensive pattern work —
+  parse, sort, SELL pack, bucket prebuild, vault persistence — off the
+  serving path. Exposed as
+  :meth:`SolveSession.ingest(coo_or_path, ...) -> IngestTicket
+  <sparse_tpu.batch.service.SolveSession.ingest>` with future-style
+  ``ready``/``result()``, block/reject admission control
+  (``SPARSE_TPU_INGEST_DEPTH`` / ``SPARSE_TPU_INGEST_ADMISSION``) and
+  ``ingest.*`` telemetry kinds + counters so the watchdog, flight
+  recorder and axon_report see onboarding as a first-class phase.
+
+CI consumers: ``tests/test_ingest.py`` (quick lane), ``bench.py``'s
+``ingest`` row (rows/s through sort->CSR->first-solve, dedup-hit vs
+cold-pattern columns), and ``scripts/chaos_check.py`` scenario 14
+(io faults + SIGKILL mid-onboarding). docs/ingest.md documents the
+pipeline stages, fingerprint semantics and the onboarding lifecycle.
+"""
+
+from __future__ import annotations
+
+from .fingerprint import (  # noqa: F401
+    FingerprintIndex,
+    structure_key,
+)
+from .onboard import (  # noqa: F401
+    IngestAdmissionError,
+    IngestError,
+    IngestTicket,
+    Onboarder,
+)
+from .sort import (  # noqa: F401
+    balance,
+    balance_stats,
+    ingest_coo_to_csr,
+)
+
+__all__ = [
+    "FingerprintIndex",
+    "IngestAdmissionError",
+    "IngestError",
+    "IngestTicket",
+    "Onboarder",
+    "balance",
+    "balance_stats",
+    "ingest_coo_to_csr",
+    "structure_key",
+]

@@ -1,0 +1,149 @@
+"""Structure-only arrival fingerprints + the persisted dedup index.
+
+The dedup contract: two arrivals with the same *structure* (shape +
+sorted, duplicate-collapsed coordinate set) must produce the same key,
+regardless of value dtype, entry order, or duplicate coordinates in the
+raw COO stream. The key is bit-identical to the sha1 half of
+:attr:`SparsityPattern.fingerprint
+<sparse_tpu.batch.operator.SparsityPattern.fingerprint>` — the hash the
+:class:`~sparse_tpu.batch.service.SolveSession` already coalesces
+same-pattern requests on — so an ingest-path hit and a solve-path hit
+land on the SAME canonical pattern object, and with it the same
+plan-cache identity key, SELL pack, precond symbolics, bucket programs
+and autopilot decisions. Hit ⇒ zero new compiles.
+
+:class:`FingerprintIndex` persists ``structure key -> vault pattern
+key`` as a single pure-meta vault artifact (kind ``ingest_fpindex``),
+so dedup survives restarts: a fresh process recognizes a re-arrival it
+has never held in memory, loads the pattern structure from the vault,
+and serves it over programs the warm-start manifest already replayed.
+Best-effort like every vault write — a missing/corrupt index degrades
+to a cold onboard, never an error.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import threading
+
+import numpy as np
+
+INDEX_KIND = "ingest_fpindex"
+INDEX_KEY = "fpindex"
+
+
+def canonicalize_coo(rows, cols, vals, shape):
+    """Host-side canonical form of a raw COO arrival: lexicographically
+    (row, col)-sorted with duplicate coordinates summed — the same
+    collapse rule as :func:`parallel.sort.coo_to_csr_distributed
+    <sparse_tpu.parallel.sort.coo_to_csr_distributed>`, so the structure
+    key computed here matches the pattern that conversion builds.
+    Returns ``(rows, cols, vals)`` (``vals`` is ``None`` in, ``None``
+    out — the structure-only path never touches values)."""
+    rows = np.asarray(rows, dtype=np.int64).reshape(-1)
+    cols = np.asarray(cols, dtype=np.int64).reshape(-1)
+    m, n = int(shape[0]), int(shape[1])
+    if rows.shape[0] and (
+        rows.min() < 0 or rows.max() >= m or cols.min() < 0 or cols.max() >= n
+    ):
+        raise ValueError(
+            f"coordinate outside {m}x{n} shape "
+            f"(rows in [{rows.min() if rows.size else 0}, "
+            f"{rows.max() if rows.size else 0}])"
+        )
+    order = np.lexsort((cols, rows))
+    rows, cols = rows[order], cols[order]
+    if rows.shape[0]:
+        is_new = np.concatenate(
+            [[True], (rows[1:] != rows[:-1]) | (cols[1:] != cols[:-1])]
+        )
+    else:
+        is_new = np.zeros((0,), dtype=bool)
+    if vals is not None:
+        vals = np.asarray(vals).reshape(-1)[order]
+        if rows.shape[0] and not is_new.all():
+            seg = np.cumsum(is_new) - 1
+            uvals = np.zeros(int(seg[-1]) + 1, dtype=vals.dtype)
+            np.add.at(uvals, seg, vals)
+            vals = uvals
+    return rows[is_new], cols[is_new], vals
+
+
+def structure_key(rows, cols, shape, *, canonical: bool = False) -> str:
+    """Structure-only content key of a COO arrival: the sha1 hex of the
+    canonical CSR structure (shape + indptr + indices), computed WITHOUT
+    building values or a pattern object. Equals
+    ``SparsityPattern.fingerprint[2]`` of the pattern
+    :func:`~sparse_tpu.ingest.sort.ingest_coo_to_csr` would assemble
+    from the same coordinates. ``canonical=True`` skips the
+    canonicalization (the caller already holds sorted, deduped
+    coordinates — the onboarder's path)."""
+    if not canonical:
+        rows, cols, _ = canonicalize_coo(rows, cols, None, shape)
+    rows = np.asarray(rows, dtype=np.int64).reshape(-1)
+    cols = np.asarray(cols, dtype=np.int64).reshape(-1)
+    m, n = int(shape[0]), int(shape[1])
+    indptr = np.zeros(m + 1, dtype=np.int64)
+    np.add.at(indptr, rows + 1, 1)
+    indptr = np.cumsum(indptr)
+    h = hashlib.sha1()
+    h.update(np.int64(m).tobytes())
+    h.update(np.int64(n).tobytes())
+    h.update(indptr.astype(np.int64).tobytes())
+    h.update(cols.astype(np.int64).tobytes())
+    return h.hexdigest()
+
+
+class FingerprintIndex:
+    """The restart-surviving half of dedup: an in-memory ``structure key
+    -> vault pattern key`` map mirrored into one vault artifact.
+
+    Thread-safe (the onboarder worker notes entries while the serving
+    thread looks arrivals up). Every mutation re-deposits the full map —
+    the index is tiny (two hex strings per distinct pattern ever seen)
+    and the vault write is atomic-rename, so a crash mid-note leaves
+    the previous consistent index, never a torn one."""
+
+    def __init__(self, autoload: bool = True):
+        self._map: dict[str, str] = {}
+        self._lock = threading.Lock()
+        if autoload:
+            self.load()
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._map)
+
+    def load(self) -> int:
+        """Merge the vaulted index into memory (fresh-process replay);
+        returns entries loaded. A disabled vault or corrupt artifact
+        loads nothing — dedup degrades to in-memory only."""
+        from .. import vault
+
+        obj = vault.fetch(INDEX_KIND, INDEX_KEY)
+        if not isinstance(obj, dict):
+            return 0
+        loaded = {str(k): str(v) for k, v in obj.items()}
+        with self._lock:
+            self._map.update(loaded)
+        return len(loaded)
+
+    def lookup(self, key: str) -> str | None:
+        """Vault pattern key for a structure key, or ``None`` (cold)."""
+        with self._lock:
+            return self._map.get(key)
+
+    def note(self, key: str, pattern_key: str) -> None:
+        """Record one onboarded structure and persist the index."""
+        from .. import vault
+
+        with self._lock:
+            if self._map.get(key) == pattern_key:
+                return
+            self._map[key] = pattern_key
+            snapshot = dict(self._map)
+        vault.deposit(INDEX_KIND, INDEX_KEY, snapshot)
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return dict(self._map)

@@ -1,0 +1,392 @@
+"""Background pattern onboarding: the control plane of the ingest tier.
+
+Everything expensive about an unseen matrix — parsing, the distributed
+sort, the SELL pack, bucket-program compiles, vault persistence — runs
+on ONE bounded daemon worker (:class:`Onboarder`, generalizing the
+warm-replay thread of ISSUE 13) so the serving path never blocks on an
+arrival. The serving-side handle is :class:`IngestTicket`: future-style
+``ready``/``wait()``/``result()``, mirroring the solve tickets.
+
+Lifecycle of one arrival (every transition is an ``ingest.onboard``
+event; ``docs/ingest.md`` has the full state diagram)::
+
+    queued -> parsing -> (dedup hit)  -> ready
+                      -> (cold)      -> sorting -> onboarding -> ready
+                      -> failed (after bounded retries)
+
+* **dedup hit** (``ingest.dedup`` ``hit=True``): the arrival's
+  structure key matches a pattern this session (or, through the vaulted
+  :class:`~sparse_tpu.ingest.fingerprint.FingerprintIndex`, a previous
+  process) already onboarded. The canonical values are grafted straight
+  onto the existing pattern's CSR structure — no device sort, no pack,
+  no compile: the first solve of the re-arrival is a pure plan-cache
+  hit.
+* **cold**: samplesort COO->CSR
+  (:func:`~sparse_tpu.ingest.sort.ingest_coo_to_csr`), pattern
+  registration into the session's coalescing map (the same
+  ``setdefault`` the solve path races through, so
+  onboard-vs-first-solve races converge on one canonical object), SELL
+  pack, requested bucket prebuild, vault pattern + manifest note, and a
+  fingerprint-index note so the NEXT process dedups this structure too.
+
+Admission control mirrors the solve pipeline's: at
+``max_depth`` queued arrivals, ``admission='block'`` waits for room and
+``'reject'`` raises :class:`IngestAdmissionError` — backpressure is
+explicit either way (``SPARSE_TPU_INGEST_DEPTH`` /
+``SPARSE_TPU_INGEST_ADMISSION``).
+"""
+
+from __future__ import annotations
+
+import itertools
+import os
+import threading
+import time
+
+import numpy as np
+
+from .. import telemetry
+from ..config import settings
+from ..telemetry import _metrics
+from . import fingerprint as fp_mod
+from .sort import ingest_coo_to_csr
+
+_ARRIVALS = _metrics.counter("ingest.arrivals")
+_ONBOARDED = _metrics.counter("ingest.onboarded")
+_DEDUP_HITS = _metrics.counter("ingest.dedup_hits")
+_FAILED = _metrics.counter("ingest.failed")
+_RETRIES = _metrics.counter("ingest.retries")
+_QUEUE_DEPTH = _metrics.gauge("ingest.queue_depth")
+
+_ids = itertools.count(1)
+
+
+class IngestError(RuntimeError):
+    """An arrival that could not be onboarded (after retries)."""
+
+
+class IngestAdmissionError(IngestError):
+    """Rejected at the onboarding admission bound
+    (``admission='reject'`` with ``max_depth`` arrivals queued)."""
+
+
+class IngestTicket:
+    """Future-style handle for one arrival moving through onboarding."""
+
+    __slots__ = ("id", "source", "state", "dedup", "pattern", "csr",
+                 "error", "submitted_s", "wall_ms", "_event")
+
+    def __init__(self, source: str):
+        self.id = f"g{next(_ids)}"
+        self.source = source
+        self.state = "queued"
+        self.dedup: bool | None = None
+        self.pattern = None
+        self.csr = None
+        self.error: Exception | None = None
+        self.submitted_s = time.monotonic()
+        self.wall_ms: float | None = None
+        self._event = threading.Event()
+
+    @property
+    def ready(self) -> bool:
+        """Terminal (``ready`` or ``failed``) — never blocks."""
+        return self._event.is_set()
+
+    def wait(self, timeout: float | None = None) -> bool:
+        """Block until terminal (or timeout); True iff terminal."""
+        return self._event.wait(timeout)
+
+    def result(self, timeout: float | None = None) -> dict:
+        """Block for the onboarding outcome: ``{pattern, csr, dedup,
+        wall_ms, state}``. Raises :class:`IngestError` on failure or
+        ``TimeoutError`` when the deadline passes first."""
+        if not self._event.wait(timeout):
+            raise TimeoutError(
+                f"ingest ticket {self.id} not onboarded within {timeout}s"
+            )
+        if self.error is not None:
+            raise self.error
+        return {
+            "pattern": self.pattern, "csr": self.csr, "dedup": self.dedup,
+            "wall_ms": self.wall_ms, "state": self.state,
+        }
+
+    def _finish(self, state: str) -> None:
+        self.state = state
+        self.wall_ms = round((time.monotonic() - self.submitted_s) * 1e3, 3)
+        self._event.set()
+
+
+def _as_coo(source):
+    """Resolve one ingest source to host ``(rows, cols, vals, shape,
+    kind)``: a MatrixMarket path, anything COO/CSR-shaped, or a raw
+    ``(rows, cols, vals, shape)`` tuple."""
+    if isinstance(source, (str, os.PathLike)):
+        from ..io import read_coo_host
+
+        rows, cols, vals, shape = read_coo_host(source)
+        return rows, cols, vals, shape, "path"
+    if isinstance(source, tuple) and len(source) == 4:
+        rows, cols, vals, shape = source
+        return (np.asarray(rows), np.asarray(cols), np.asarray(vals),
+                (int(shape[0]), int(shape[1])), "coo")
+    if hasattr(source, "row") and hasattr(source, "col"):
+        return (np.asarray(source.row), np.asarray(source.col),
+                np.asarray(source.data), source.shape, "coo")
+    if hasattr(source, "tocoo"):
+        c = source.tocoo()
+        return (np.asarray(c.row), np.asarray(c.col), np.asarray(c.data),
+                c.shape, "csr")
+    raise TypeError(
+        f"cannot ingest {type(source).__name__}: expected a MatrixMarket "
+        "path, a COO/CSR-shaped array, or (rows, cols, vals, shape)"
+    )
+
+
+class Onboarder:
+    """Bounded background onboarding queue bound to one SolveSession."""
+
+    def __init__(self, session, max_depth: int | None = None,
+                 admission: str | None = None,
+                 retries: int | None = None):
+        self.session = session
+        self.max_depth = max(
+            int(max_depth if max_depth is not None
+                else settings.ingest_depth), 1,
+        )
+        self.admission = (
+            admission if admission is not None else settings.ingest_admission
+        )
+        if self.admission not in ("block", "reject"):
+            raise ValueError(
+                f"admission must be 'block' or 'reject', "
+                f"got {self.admission!r}"
+            )
+        self.retries = max(
+            int(retries if retries is not None else settings.ingest_retries),
+            0,
+        )
+        self.index = fp_mod.FingerprintIndex()
+        self._queue: list = []
+        self._cond = threading.Condition()
+        self._active = 0
+        self._closed = False
+        self._counts = {"onboarded": 0, "dedup_hits": 0, "failed": 0,
+                        "retries": 0}
+        self._thread = threading.Thread(
+            target=self._worker, name="sparse-tpu-onboard", daemon=True
+        )
+        self._thread.start()
+
+    # -- serving-side API ---------------------------------------------------
+    def submit(self, source, *, bucket: int = 1, dtype=np.float64,
+               num_shards: int | None = None) -> IngestTicket:
+        """Queue one arrival; returns its ticket immediately (admission
+        permitting). ``bucket``/``dtype`` shape the prebuilt program a
+        cold pattern gets ahead of its first solve."""
+        label = (
+            os.fspath(source) if isinstance(source, (str, os.PathLike))
+            else type(source).__name__
+        )
+        ticket = IngestTicket(label)
+        with self._cond:
+            if self._closed:
+                raise IngestError("onboarder is closed")
+            while len(self._queue) >= self.max_depth:
+                if self.admission == "reject":
+                    _metrics.counter(
+                        "ingest.admissions", mode="reject"
+                    ).inc()
+                    raise IngestAdmissionError(
+                        f"ingest queue at max_depth={self.max_depth} "
+                        f"(admission='reject')"
+                    )
+                _metrics.counter("ingest.admissions", mode="block").inc()
+                self._cond.wait(0.05)
+                if self._closed:
+                    raise IngestError("onboarder is closed")
+            self._queue.append(
+                (ticket, source, int(bucket), np.dtype(dtype), num_shards)
+            )
+            depth = len(self._queue)
+            self._cond.notify_all()
+        _ARRIVALS.inc()
+        _QUEUE_DEPTH.set(depth)
+        if telemetry.enabled():
+            telemetry.record(
+                "ingest.arrive", ticket=ticket.id, source=label,
+                queue_depth=depth,
+            )
+        return ticket
+
+    def drain(self, timeout: float = 120.0) -> bool:
+        """Block until the queue is empty and the worker idle."""
+        deadline = time.monotonic() + timeout
+        with self._cond:
+            while self._queue or self._active:
+                left = deadline - time.monotonic()
+                if left <= 0:
+                    return False
+                self._cond.wait(min(left, 0.1))
+        return True
+
+    def close(self, timeout: float = 10.0) -> None:
+        """Stop accepting arrivals and join the worker (queued items
+        still complete)."""
+        with self._cond:
+            self._closed = True
+            self._cond.notify_all()
+        self._thread.join(timeout)
+
+    def stats(self) -> dict:
+        with self._cond:
+            return {
+                "queued": len(self._queue),
+                "active": self._active,
+                "max_depth": self.max_depth,
+                "admission": self.admission,
+                "index_entries": len(self.index),
+                **self._counts,
+            }
+
+    # -- worker -------------------------------------------------------------
+    def _worker(self) -> None:
+        while True:
+            with self._cond:
+                while not self._queue and not self._closed:
+                    self._cond.wait(0.25)
+                if not self._queue:
+                    if self._closed:
+                        return
+                    continue
+                item = self._queue.pop(0)
+                self._active = 1
+                _QUEUE_DEPTH.set(len(self._queue))
+            try:
+                self._process(*item)
+            finally:
+                with self._cond:
+                    self._active = 0
+                    self._cond.notify_all()
+
+    def _process(self, ticket, source, bucket, dtype, num_shards) -> None:
+        last_err = None
+        for attempt in range(self.retries + 1):
+            if attempt:
+                with self._cond:
+                    self._counts["retries"] += 1
+                _RETRIES.inc()
+                if telemetry.enabled():
+                    telemetry.record(
+                        "ingest.onboard", ticket=ticket.id, state="retry",
+                        wall_ms=round(
+                            (time.monotonic() - ticket.submitted_s) * 1e3, 3
+                        ),
+                    )
+            try:
+                self._onboard(ticket, source, bucket, dtype, num_shards)
+                return
+            except Exception as e:  # noqa: BLE001 - arrival isolation
+                last_err = e
+        ticket.error = IngestError(
+            f"ingest {ticket.id} ({ticket.source}) failed after "
+            f"{self.retries + 1} attempts: {last_err}"
+        )
+        ticket.error.__cause__ = last_err
+        with self._cond:
+            self._counts["failed"] += 1
+        _FAILED.inc()
+        ticket._finish("failed")
+        if telemetry.enabled():
+            telemetry.record(
+                "ingest.onboard", ticket=ticket.id, state="failed",
+                wall_ms=ticket.wall_ms,
+            )
+
+    def _onboard(self, ticket, source, bucket, dtype, num_shards) -> None:
+        from ..batch.operator import SparsityPattern
+
+        ticket.state = "parsing"
+        rows, cols, vals, shape, _kind = _as_coo(source)
+        crows, ccols, cvals = fp_mod.canonicalize_coo(rows, cols, vals, shape)
+        skey = fp_mod.structure_key(crows, ccols, shape, canonical=True)
+        fp = ((int(shape[0]), int(shape[1])), int(crows.shape[0]), skey)
+
+        pattern = self.session._patterns.get(fp)
+        if pattern is None:
+            # restart-surviving dedup: a previous process may have
+            # onboarded this structure — the vaulted index knows
+            pkey = self.index.lookup(skey)
+            if pkey is not None:
+                from .. import vault
+
+                pat = vault.load_pattern(pkey)
+                if pat is not None and pat.fingerprint == fp:
+                    pattern = self.session._patterns.setdefault(
+                        pat.fingerprint, pat
+                    )
+        hit = pattern is not None
+        if telemetry.enabled():
+            telemetry.record(
+                "ingest.dedup", ticket=ticket.id, hit=bool(hit),
+                fingerprint=skey[:12],
+            )
+        if hit:
+            # structure equality means the canonical value order IS the
+            # pattern's nnz order: graft values, skip sort/pack/compile
+            import sparse_tpu
+
+            with self._cond:
+                self._counts["dedup_hits"] += 1
+            _DEDUP_HITS.inc()
+            ticket.pattern = pattern
+            ticket.csr = sparse_tpu.csr_array.from_parts(
+                cvals, pattern.indices, pattern.indptr, pattern.shape
+            )
+            ticket.dedup = True
+            ticket._finish("ready")
+            if telemetry.enabled():
+                telemetry.record(
+                    "ingest.onboard", ticket=ticket.id, state="ready",
+                    wall_ms=ticket.wall_ms,
+                )
+            return
+
+        # cold pattern: the full data plane
+        ticket.state = "sorting"
+        csr = ingest_coo_to_csr(crows, ccols, cvals, shape, num_shards)
+        ticket.state = "onboarding"
+        pat = SparsityPattern.from_csr(csr)
+        pattern = self.session._patterns.setdefault(pat.fingerprint, pat)
+        pattern.sell_pack()
+        try:
+            self.session._prebuild(pattern, self.session.solver,
+                                   int(bucket), dtype)
+        except Exception:  # noqa: BLE001 - prebuild is an optimization
+            pass
+        from .. import vault
+
+        pkey = None
+        if vault.enabled():
+            pkey = vault.store_pattern(pattern)
+            vault.note_program(
+                pattern, self.session.solver, int(bucket), np.dtype(dtype).str
+            )
+        if pkey is None:
+            from ..vault import _codecs
+
+            pkey = _codecs.pattern_key(pattern)
+        self.index.note(skey, pkey)
+        with self._cond:
+            self._counts["onboarded"] += 1
+        _ONBOARDED.inc()
+        ticket.pattern = pattern
+        ticket.csr = csr
+        ticket.dedup = False
+        ticket._finish("ready")
+        if telemetry.enabled():
+            telemetry.record(
+                "ingest.onboard", ticket=ticket.id, state="ready",
+                wall_ms=ticket.wall_ms,
+            )

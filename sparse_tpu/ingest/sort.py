@@ -1,0 +1,174 @@
+"""Ingest-tier COO->CSR: sharded samplesort + nnz-balanced partitions.
+
+The data-plane half of the ingest pipeline (SURVEY §3.1): an arriving
+coordinate stream becomes a canonical CSR through a distributed sort.
+Two routes, chosen by the serving mesh:
+
+* **mesh route** — :func:`parallel.sort.coo_to_csr_distributed
+  <sparse_tpu.parallel.sort.coo_to_csr_distributed>`: the reference's
+  samplesort shape (local sort -> regular-sample allgather -> splitter
+  selection -> ``jax.lax.ragged_all_to_all`` bucket exchange -> merge,
+  SURVEY §7's SORT_BY_KEY translation), with every collective accounted
+  through the ``sort.sample1``/``sort.sample2`` SiteLedgers of
+  :mod:`sparse_tpu.parallel.comm` and an odd-even transposition
+  fallback when heavy duplicate keys break the regular-sampling bucket
+  bound.
+* **single-device fast path** — one ``jax.lax.sort`` over the fused
+  ``row*n + col`` key (no shard_map, no collectives, no ledger
+  traffic): the right shape for arrivals too small to shard, and the
+  only shape on a single-device mesh.
+
+Either route collapses duplicate coordinates (summing values — the
+reference's SORTED_COORDS_TO_COUNTS discipline) and returns a
+:class:`~sparse_tpu.csr.csr_array`.
+
+:func:`balance` is the reference's ``balance()`` analog (SURVEY §2c-3):
+an nnz-balanced row partition for skewed arrivals, where the uniform
+``m/S`` row split the mesh would otherwise use puts one shard behind a
+handful of dense rows. It is a *partition map* (S+1 row boundaries),
+the ingest-side input to row-sharded placement — :func:`balance_stats`
+quantifies how skewed the uniform split would have been.
+"""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+
+from .. import telemetry
+
+
+def _dedup_sorted(srows, scols, svals, shape):
+    """Collapse duplicate (row, col) pairs of a lex-sorted stream (sum)
+    and assemble the CSR — the SORTED_COORDS_TO_COUNTS + nnz_to_pos
+    scan shared by both sort routes."""
+    import sparse_tpu
+
+    m = int(shape[0])
+    if srows.shape[0]:
+        is_new = np.concatenate(
+            [[True], (srows[1:] != srows[:-1]) | (scols[1:] != scols[:-1])]
+        )
+        seg = np.cumsum(is_new) - 1
+        uvals = np.zeros(int(seg[-1]) + 1, dtype=svals.dtype)
+        np.add.at(uvals, seg, svals)
+        urows = srows[is_new]
+        ucols = scols[is_new]
+    else:
+        urows, ucols, uvals = srows, scols, svals
+    indptr = np.zeros(m + 1, dtype=np.int64)
+    np.add.at(indptr, urows + 1, 1)
+    indptr = np.cumsum(indptr)
+    return sparse_tpu.csr_array.from_parts(
+        uvals, ucols, indptr, (m, int(shape[1]))
+    )
+
+
+def _sort_single_device(rows, cols, vals, shape):
+    """The single-device fast path: one ``jax.lax.sort`` over the fused
+    key (requires ``m*n`` within int32 — the caller routes wider shapes
+    through the two-pass distributed radix composition)."""
+    import jax
+    import jax.numpy as jnp
+
+    n = int(shape[1])
+    keys = np.asarray(rows, np.int32) * np.int32(n) + np.asarray(
+        cols, np.int32
+    )
+    sk, sv = jax.lax.sort(
+        (jnp.asarray(keys), jnp.asarray(vals)), num_keys=1, is_stable=True
+    )
+    sk = np.asarray(sk).astype(np.int64)
+    svals = np.asarray(sv)
+    return sk // n, sk % n, svals
+
+
+def ingest_coo_to_csr(rows, cols, vals, shape, num_shards: int | None = None):
+    """Canonical ingest conversion: raw COO arrays (host) -> CSR.
+
+    ``num_shards=None`` uses the default mesh; ``1`` (or a single-device
+    mesh, or ``settings.force_serial``) takes the ``jax.lax.sort`` fast
+    path. Duplicate coordinates sum. Emits one ``ingest.sort`` event
+    per call (rows/nnz/shards/wall_ms and which route ran); the mesh
+    route's collective volume additionally lands in the measured-comm
+    ``comm.sort`` events its SiteLedgers commit.
+    """
+    from ..config import settings
+    from ..parallel.mesh import get_mesh
+    from ..parallel.sort import coo_to_csr_distributed
+
+    rows = np.asarray(rows).reshape(-1)
+    cols = np.asarray(cols).reshape(-1)
+    vals = np.asarray(vals).reshape(-1)
+    if not (rows.shape[0] == cols.shape[0] == vals.shape[0]):
+        raise ValueError(
+            f"COO arrays disagree: {rows.shape[0]} rows, "
+            f"{cols.shape[0]} cols, {vals.shape[0]} vals"
+        )
+    m, n = int(shape[0]), int(shape[1])
+    if settings.force_serial:
+        num_shards = 1
+    S = int(get_mesh(num_shards).devices.size)
+    t0 = time.monotonic()
+    fast = S == 1 and m * n <= np.iinfo(np.int32).max
+    if fast:
+        srows, scols, svals = _sort_single_device(rows, cols, vals, shape)
+        out = _dedup_sorted(srows, scols, svals, (m, n))
+    else:
+        out = coo_to_csr_distributed(rows, cols, vals, (m, n), S)
+    if telemetry.enabled():
+        telemetry.record(
+            "ingest.sort", rows=m, nnz=int(out.nnz), shards=S,
+            entries=int(rows.shape[0]), fast_path=bool(fast),
+            wall_ms=round((time.monotonic() - t0) * 1e3, 3),
+        )
+    return out
+
+
+def balance(indptr, num_shards: int) -> np.ndarray:
+    """nnz-balanced row partition: S+1 monotone row boundaries so each
+    shard's ``[bounds[s], bounds[s+1])`` row slab carries ~``nnz/S``
+    nonzeros — the reference's ``balance()`` (SURVEY §2c-3), which
+    re-splits by prefix-nnz instead of row count so skewed arrivals
+    (a few dense rows) don't serialize on one shard."""
+    indptr = np.asarray(indptr, dtype=np.int64).reshape(-1)
+    S = int(num_shards)
+    if S < 1:
+        raise ValueError(f"num_shards must be >= 1, got {num_shards}")
+    m = indptr.shape[0] - 1
+    if m < 0:
+        raise ValueError("indptr must have at least one entry")
+    nnz = int(indptr[-1])
+    targets = (np.arange(1, S, dtype=np.float64) * nnz) / S
+    cuts = np.searchsorted(indptr, targets, side="left")
+    bounds = np.concatenate([[0], cuts, [m]]).astype(np.int64)
+    # monotone + in-range even for degenerate inputs (nnz=0, S > m)
+    return np.maximum.accumulate(np.clip(bounds, 0, m))
+
+
+def balance_stats(indptr, num_shards: int) -> dict:
+    """How much :func:`balance` helps THIS row profile: per-shard nnz
+    under the balanced partition vs the uniform ``m/S`` row split, and
+    their imbalance ratios (max shard nnz / mean — 1.0 is perfect)."""
+    indptr = np.asarray(indptr, dtype=np.int64).reshape(-1)
+    S = int(num_shards)
+    m = indptr.shape[0] - 1
+    nnz = int(indptr[-1])
+    bal = balance(indptr, S)
+    bal_nnz = np.diff(indptr[bal])
+    uni = np.clip(
+        np.round(np.arange(S + 1) * m / S).astype(np.int64), 0, m
+    )
+    uni_nnz = np.diff(indptr[uni])
+    mean = max(nnz / S, 1e-12)
+    return {
+        "shards": S,
+        "rows": m,
+        "nnz": nnz,
+        "bounds": bal.tolist(),
+        "balanced_nnz": bal_nnz.tolist(),
+        "uniform_nnz": uni_nnz.tolist(),
+        "balanced_imbalance": float(bal_nnz.max() / mean) if S else 1.0,
+        "uniform_imbalance": float(uni_nnz.max() / mean) if S else 1.0,
+    }

@@ -4,8 +4,16 @@ Reference analog: ``sparse/io.py:24-63`` (mmread via the single-task C++ parser
 READ_MTX_TO_COO, ``src/sparse/io/mtx_to_coo.cc:44-145``, with symmetry expansion
 and unbound outputs + scalar futures for m/n/nnz). Here: a vectorized
 numpy-based parser on the host (file IO is host work either way), producing a
-device-resident ``coo_array``. A native (C) accelerated reader is planned in
-``src/`` for large files. Also adds ``mmwrite`` (the reference is read-only).
+device-resident ``coo_array``. Also adds ``mmwrite`` (the reference is
+read-only) and — for the streaming ingestion data plane (ISSUE 18) — a
+chunked coordinate-body parser: :func:`stream_coo` yields bounded host
+chunks (symmetry already expanded per chunk) so a large file never needs
+a whole-body materialization before the distributed sort, and
+:func:`read_coo_host` assembles those chunks into the raw host COO the
+ingest path (``SolveSession.ingest`` / ``sparse_tpu.ingest``) consumes.
+Parity against ``scipy.io.mmread`` is pinned in ``tests/test_ingest.py``
+(the SURVEY §3.2 oracle drill), including symmetric-expansion and
+pattern-only files.
 """
 
 from __future__ import annotations
@@ -127,6 +135,136 @@ def mmread(path) -> coo_array:
             cols = np.concatenate([cols, c2])
             vals = np.concatenate([vals, v2])
     return coo_array((asjnp(vals), (rows, cols)), shape=(m, n))
+
+
+def _expand_symmetry(rows, cols, vals, symmetry: str):
+    """Mirror the off-diagonal entries per the header's symmetry class —
+    per-entry work, so it applies chunk-by-chunk on the streaming path."""
+    if symmetry == "general":
+        return rows, cols, vals
+    off = rows != cols
+    r2, c2 = cols[off], rows[off]
+    if symmetry == "skew-symmetric":
+        v2 = -vals[off]
+    elif symmetry == "hermitian":
+        v2 = np.conjugate(vals[off])
+    else:
+        v2 = vals[off]
+    return (
+        np.concatenate([rows, r2]),
+        np.concatenate([cols, c2]),
+        np.concatenate([vals, v2]),
+    )
+
+
+def _parse_chunk(lines, field: str):
+    """Parse one block of coordinate-body lines (native tokenizer when
+    available, loadtxt fallback) — the unit of :func:`stream_coo`."""
+    from . import native
+
+    count = len(lines)
+    blob = "".join(lines)
+    kind = {"pattern": 0, "complex": 2}.get(field, 1)
+    if count and native.lib() is not None:
+        parsed = native.parse_mtx_body(blob.encode(), count, kind)
+        if parsed is not None:
+            rows, cols, re, im = parsed
+            vals = re + 1j * im if field == "complex" else re
+            return rows, cols, vals
+        raise ValueError(
+            f"MatrixMarket chunk does not contain exactly {count} entries"
+        )
+    import io as _io
+
+    body = np.loadtxt(_io.StringIO(blob), ndmin=2) if count else np.zeros(
+        (0, 3)
+    )
+    if body.shape[0] != count:
+        raise ValueError(f"expected {count} entries, found {body.shape[0]}")
+    rows = body[:, 0].astype(np.int64) - 1
+    cols = body[:, 1].astype(np.int64) - 1
+    if field == "pattern":
+        vals = np.ones((count,), dtype=np.float64)
+    elif field == "complex":
+        vals = body[:, 2] + 1j * body[:, 3]
+    else:
+        vals = body[:, 2]
+    return rows, cols, vals
+
+
+def stream_coo(path, chunk_nnz: int = 1 << 20):
+    """Stream-parse a coordinate MatrixMarket file: yields host
+    ``(rows, cols, vals)`` chunks of at most ``2 * chunk_nnz`` entries
+    (symmetry expansion can double a chunk), never holding more than one
+    chunk's lines in memory — the ingest data plane's large-file entry
+    (ISSUE 18). Raises on ``array``-format files (no streaming win for a
+    dense body — use :func:`mmread`)."""
+    chunk_nnz = max(int(chunk_nnz), 1)
+    with open(path, "r") as f:
+        fmt, field, symmetry = _parse_header(f.readline())
+        if fmt != "coordinate":
+            raise ValueError(
+                "stream_coo streams coordinate files only; use mmread for "
+                f"'{fmt}' format"
+            )
+        line = f.readline()
+        while line.startswith("%"):
+            line = f.readline()
+        dims = line.split()
+        m, n, nnz = int(dims[0]), int(dims[1]), int(dims[2])
+        yield (m, n), nnz  # header first: shape + declared nnz
+        seen = 0
+        while seen < nnz:
+            lines = []
+            while len(lines) < chunk_nnz:
+                ln = f.readline()
+                if not ln:
+                    break
+                if ln.strip() and not ln.startswith("%"):
+                    lines.append(ln)
+            if not lines:
+                break
+            rows, cols, vals = _parse_chunk(lines, field)
+            seen += len(lines)
+            if seen > nnz:
+                raise ValueError(
+                    f"MatrixMarket body holds more than the declared "
+                    f"{nnz} entries"
+                )
+            yield _expand_symmetry(rows, cols, vals, symmetry)
+        if seen != nnz:
+            raise ValueError(f"expected {nnz} entries, found {seen}")
+
+
+def read_coo_host(path, chunk_nnz: int = 1 << 20):
+    """Raw host COO of any MatrixMarket file — the ingest path's source
+    resolver: coordinate files stream through :func:`stream_coo`
+    (bounded parse memory), array files fall back to :func:`mmread`'s
+    dense decoder. Returns ``(rows, cols, vals, shape)`` with symmetry
+    expanded and duplicates preserved (the downstream sort collapses
+    them)."""
+    with open(path, "r") as f:
+        fmt, _field, _symmetry = _parse_header(f.readline())
+    if fmt != "coordinate":
+        c = mmread(path)
+        return (
+            np.asarray(c.row), np.asarray(c.col), np.asarray(c.data), c.shape
+        )
+    it = stream_coo(path, chunk_nnz=chunk_nnz)
+    shape, _nnz = next(it)
+    rs, cs, vs = [], [], []
+    for rows, cols, vals in it:
+        rs.append(rows)
+        cs.append(cols)
+        vs.append(vals)
+    if rs:
+        return (
+            np.concatenate(rs), np.concatenate(cs), np.concatenate(vs), shape
+        )
+    return (
+        np.zeros((0,), np.int64), np.zeros((0,), np.int64),
+        np.zeros((0,), np.float64), shape,
+    )
 
 
 def mmwrite(path, A, comment: str = "", precision: int = 16) -> None:

@@ -102,6 +102,12 @@ class LoadReport:
     #: {"max": int, "mean": float, "pipelined": bool} — empty when the
     #: run had no observation points
     inflight_depth: dict = field(default_factory=dict)
+    #: background-onboarding rollup for traces with ``ingest`` clauses
+    #: (ISSUE 18): arrival count, dedup hits, failures and onboarding
+    #: latency percentiles — reported SEPARATELY from the solve
+    #: latency_ms so onboarding cost can never masquerade as (or hide
+    #: in) the serving p95. Empty when the trace had no ingest arrivals.
+    onboard: dict = field(default_factory=dict)
 
     def as_dict(self) -> dict:
         return {
@@ -123,6 +129,7 @@ class LoadReport:
             "dispatches": self.dispatches,
             "requeued": self.requeued,
             "inflight_depth": dict(self.inflight_depth),
+            "onboard": dict(self.onboard),
         }
 
 
@@ -130,12 +137,16 @@ def build_report(trace: ArrivalTrace, outcomes, wall_s: float,
                  slo_ms=None, *, time_scale: float = 1.0,
                  queue_depth=(), device_occupancy=(),
                  dispatches: int = 0,
-                 inflight_depth: dict | None = None) -> LoadReport:
+                 inflight_depth: dict | None = None,
+                 onboard=(), onboard_rejected: int = 0) -> LoadReport:
     """Pure rollup of a run: ``outcomes`` is a sequence of
     ``(tenant, latency_s, ok, requeued)`` tuples (what the runner
-    collected from the resolved tickets). Deterministic for
-    deterministic inputs — the trace spec, counts, per-tenant shares
-    and the fairness index never depend on the clock."""
+    collected from the resolved tickets), ``onboard`` a sequence of
+    ``(wall_ms, ok, dedup)`` tuples from the resolved ingest tickets
+    (``onboard_rejected`` counts admission-rejected submissions that
+    never got a ticket). Deterministic for deterministic inputs — the
+    trace spec, counts, per-tenant shares and the fairness index never
+    depend on the clock."""
     wall_s = max(float(wall_s), 1e-9)
     lats = sorted(o[1] * 1e3 for o in outcomes if o[2])
     completed = sum(1 for o in outcomes if o[2])
@@ -159,10 +170,33 @@ def build_report(trace: ArrivalTrace, outcomes, wall_s: float,
     for tenant, t in per_tenant.items():
         t["achieved_rps"] = round(t["completed"] / wall_s, 3)
         shares[tenant] = t["completed"] / max(t["weight"], 1e-12)
+    onb: dict = {}
+    onboard = list(onboard)
+    if onboard or onboard_rejected:
+        olats = sorted(w for w, ok, _d in onboard if ok and w is not None)
+        ocomp = sum(1 for _w, ok, _d in onboard if ok)
+        onb = {
+            "arrivals": len(onboard) + int(onboard_rejected),
+            "completed": ocomp,
+            "failed": len(onboard) - ocomp + int(onboard_rejected),
+            "dedup_hits": sum(1 for _w, ok, d in onboard if ok and d),
+            "latency_ms": {
+                "p50": round(_percentile(olats, 0.50), 3),
+                "p95": round(_percentile(olats, 0.95), 3),
+                "p99": round(_percentile(olats, 0.99), 3),
+                "max": round(olats[-1], 3) if olats else 0.0,
+                "mean": round(sum(olats) / len(olats), 3) if olats else 0.0,
+            },
+        }
     # offered = the trace's virtual rate mapped to the wall (a pure
-    # closed-loop trace has no timed rate: offered == achieved)
-    if trace.duration > 0 and trace.arrivals:
-        offered = len(trace.arrivals) / (trace.duration * time_scale)
+    # closed-loop trace has no timed rate: offered == achieved); ingest
+    # arrivals ride the background onboarding plane, not the solve path,
+    # so they never count toward the solve offered/achieved rates
+    solve_arrivals = sum(
+        1 for a in trace.arrivals if getattr(a, "kind", "solve") != "ingest"
+    )
+    if trace.duration > 0 and solve_arrivals:
+        offered = solve_arrivals / (trace.duration * time_scale)
         # closed clauses ride along at their achieved rate
         closed_n = sum(c.requests for c in trace.closed)
         if closed_n:
@@ -194,6 +228,7 @@ def build_report(trace: ArrivalTrace, outcomes, wall_s: float,
         dispatches=dispatches,
         requeued=requeued,
         inflight_depth=dict(inflight_depth or {}),
+        onboard=onb,
     )
 
 
@@ -229,11 +264,33 @@ class _Sampler:
             self.period *= 2.0
 
 
+def _default_ingest_source(index: int, size: int):
+    """Distinct unseen-structure COO per ingest arrival: an ``n×n``
+    diagonally-dominant profile with ``3n`` random off-diagonals, seeded
+    by the arrival index so a seeded trace replays the same sequence of
+    (whp unique) sparsity structures."""
+    import numpy as np
+
+    n = max(int(size), 2)
+    rng = np.random.default_rng(0x1A9E57 + 7919 * index)
+    k = min(3 * n, n * n - n)
+    r = rng.integers(0, n, size=k)
+    c = rng.integers(0, n, size=k)
+    d = np.arange(n)
+    rows = np.concatenate([d, r])
+    cols = np.concatenate([d, c])
+    vals = np.concatenate(
+        [np.full(n, float(n)), 0.1 * rng.standard_normal(k)]
+    )
+    return rows, cols, vals, (n, n)
+
+
 def run_load(session, trace: ArrivalTrace, systems, *, pattern=None,
              tol: float = 1e-8, maxiter=None, time_scale: float = 1.0,
              coalesce_s: float = 0.01, sample_period_s: float = 0.02,
              record: bool = True,
-             pipeline: bool | None = None) -> LoadReport:
+             pipeline: bool | None = None,
+             ingest_source=None) -> LoadReport:
     """Drive ``session`` through ``trace`` and return the
     :class:`LoadReport`.
 
@@ -262,6 +319,15 @@ def run_load(session, trace: ArrivalTrace, systems, *, pattern=None,
     latency is ``t_done - t_submit`` exactly as the ``batch.ticket``
     terminal events record it, and the tenant label rides the ticket
     (``SolveSession.submit(tenant=...)``).
+
+    Arrivals with ``kind == "ingest"`` (the trace grammar's ``ingest``
+    clause, ISSUE 18) route through ``session.ingest`` instead of the
+    solve path: each submits a distinct unseen-structure COO
+    (``ingest_source(index, size)`` — default a seeded random profile
+    sized by the clause's ``size=``) and the report rolls onboarding
+    latency (submit → ticket ready, background work included) into
+    ``report.onboard`` — SEPARATE from the solve ``latency_ms``, so the
+    serving p95 is measured while onboarding runs, never diluted by it.
     """
     systems = list(systems)
     if not systems:
@@ -273,6 +339,9 @@ def run_load(session, trace: ArrivalTrace, systems, *, pattern=None,
         getattr(session, "inflight", 1) > 1 if pipeline is None
         else bool(pipeline)
     )
+    ingest_src = ingest_source or _default_ingest_source
+    ingest_tickets: list = []  # IngestTickets in submit order
+    ingest_rejected = 0
     t0 = time.monotonic()
     sampler = _Sampler(t0, sample_period_s)
     entries: list = []  # (tenant, ticket)
@@ -328,7 +397,18 @@ def run_load(session, trace: ArrivalTrace, systems, *, pattern=None,
                 session.poll()  # retire whatever already finished
             sampler.sample()
             time.sleep(min(target - now, coalesce))
-        submit(a.tenant)
+        if getattr(a, "kind", "solve") == "ingest":
+            # background onboarding plane: never a solve ticket, never
+            # a flush — the Onboarder's worker thread does the rest
+            try:
+                ingest_tickets.append(session.ingest(
+                    ingest_src(len(ingest_tickets) + ingest_rejected,
+                               a.size)
+                ))
+            except Exception:  # noqa: BLE001 - admission-reject counted
+                ingest_rejected += 1
+        else:
+            submit(a.tenant)
         sampler.sample()
         if session.pending >= session.batch_max:
             session.flush(wait=not pipelined)
@@ -371,6 +451,13 @@ def run_load(session, trace: ArrivalTrace, systems, *, pattern=None,
     sampler.sample()
 
     wall_s = time.monotonic() - t0
+    # onboarding completes AFTER the solve wall is closed: waiting on
+    # background tickets here cannot inflate achieved_rps or the solve
+    # percentiles (each ticket's wall_ms was stamped when it finished)
+    if ingest_tickets:
+        deadline = time.monotonic() + 120.0
+        for tk in ingest_tickets:
+            tk.wait(timeout=max(deadline - time.monotonic(), 0.0))
     now = time.monotonic()
     outcomes = []
     for tenant, tk in entries:
@@ -391,6 +478,11 @@ def run_load(session, trace: ArrivalTrace, systems, *, pattern=None,
         device_occupancy=sampler.occ,
         dispatches=session.dispatches - dispatch0,
         inflight_depth=inflight_depth,
+        onboard=[
+            (tk.wall_ms, tk.state == "ready", bool(tk.dedup))
+            for tk in ingest_tickets
+        ],
+        onboard_rejected=ingest_rejected,
     )
     if record:
         _recorder.record(
@@ -410,5 +502,6 @@ def run_load(session, trace: ArrivalTrace, systems, *, pattern=None,
             dispatches=rep.dispatches,
             **({"inflight_depth": rep.inflight_depth}
                if rep.inflight_depth else {}),
+            **({"onboard": rep.onboard} if rep.onboard else {}),
         )
     return rep

@@ -16,6 +16,7 @@ Spec grammar (mirrors the ``SPARSE_TPU_FAULTS`` clause style —
     burst:rate=20,burst_rate=400,period=1,duty=0.25,duration=2,seed=0
     uniform:rate=50,duration=2                  # evenly spaced
     closed:concurrency=4,requests=64            # completion-driven
+    ingest:rate=2,duration=2,seed=0,size=48     # unseen-pattern arrivals
 
 Every timed clause accepts ``tenant=`` (a label stamped onto each
 request — the fairness dimension) and ``weight=`` (the tenant's fair
@@ -24,6 +25,15 @@ by virtual time — a mixed-pattern multi-tenant schedule is just
 ``poisson:...,tenant=a;burst:...,tenant=b``. ``closed`` clauses have no
 virtual timeline (the next arrival is the previous completion); the
 runner executes them after the timed phase.
+
+``ingest`` clauses (ISSUE 18) schedule *unseen-pattern matrix
+arrivals* riding the same Poisson process: each arrival carries
+``kind='ingest'`` and a ``size`` profile (matrix dimension) instead of
+a solve, and the runner routes it through
+``SolveSession.ingest`` — so one trace mixes serving traffic with the
+onboarding traffic that must never disturb it. Ingest arrivals are
+excluded from the solve latency/fairness rollups; their onboarding
+latency percentiles report separately (``LoadReport.onboard``).
 """
 
 from __future__ import annotations
@@ -48,10 +58,15 @@ class LoadSpecError(ValueError):
 @dataclass(frozen=True)
 class Arrival:
     """One scheduled request: virtual arrival time (seconds from trace
-    start) and the tenant label it carries ('' = the default tenant)."""
+    start) and the tenant label it carries ('' = the default tenant).
+    ``kind`` is ``'solve'`` (classic) or ``'ingest'`` (an
+    unseen-pattern matrix arrival, ISSUE 18); ``size`` is the ingest
+    clause's matrix-dimension profile (0 for solves)."""
 
     t: float
     tenant: str = ""
+    kind: str = "solve"
+    size: int = 0
 
 
 @dataclass(frozen=True)
@@ -159,6 +174,34 @@ class ArrivalTrace:
                        tenant=tenant, weight=weight)
         return cls([Arrival(t, tenant) for t in times], duration,
                    weights={tenant: float(weight)}, spec=spec)
+
+    @classmethod
+    def ingest_arrivals(cls, rate: float, duration: float, seed: int = 0,
+                        size: int = 48, tenant: str = "ingest",
+                        weight: float = 1.0) -> "ArrivalTrace":
+        """Unseen-pattern matrix arrivals (ISSUE 18): Poisson at
+        ``rate`` arrivals/s over ``duration`` virtual seconds, each
+        arrival an ``kind='ingest'`` event sized by the ``size``
+        profile (matrix dimension). The runner materializes every
+        arrival as a DISTINCT seeded matrix structure — the unseen-
+        pattern stream the onboarding pipeline must absorb without
+        disturbing the solve p95."""
+        _check_rate(rate, duration)
+        if int(size) < 2:
+            raise LoadSpecError(f"size={size} must be >= 2")
+        rng = np.random.default_rng(seed)
+        times = []
+        t = float(rng.exponential(1.0 / rate))
+        while t < duration:
+            times.append(t)
+            t += float(rng.exponential(1.0 / rate))
+        spec = _clause("ingest", rate=rate, duration=duration, seed=seed,
+                       size=int(size), tenant=tenant, weight=weight)
+        return cls(
+            [Arrival(t, tenant, kind="ingest", size=int(size))
+             for t in times],
+            duration, weights={tenant: float(weight)}, spec=spec,
+        )
 
     @classmethod
     def closed_loop(cls, concurrency: int, requests: int,
@@ -316,5 +359,9 @@ _PATTERNS = {
     }),
     "closed": (ArrivalTrace.closed_loop, {
         "concurrency": int, "requests": int, "tenant": str, "weight": float,
+    }),
+    "ingest": (ArrivalTrace.ingest_arrivals, {
+        "rate": float, "duration": float, "seed": int, "size": int,
+        "tenant": str, "weight": float,
     }),
 }

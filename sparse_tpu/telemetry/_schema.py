@@ -191,6 +191,26 @@ KINDS: dict[str, frozenset] = {
     # a restart restored a persisted decision from the vault — the
     # group serves tuned from its first request, zero trials
     "autopilot.restore": frozenset({"group", "arm"}),
+    # -- ingest (sparse_tpu.ingest, ISSUE 18) -------------------------------
+    # one arrival admitted onto the background onboarding queue: the
+    # ticket id, a source label (path / array type) and the queue depth
+    # at admission (the backpressure signal)
+    "ingest.arrive": frozenset({"ticket", "source", "queue_depth"}),
+    # one COO->CSR sort pass of the ingest data plane: matrix rows,
+    # deduped nnz, raw entries in, mesh shards, which route ran
+    # (fast_path = single-device jax.lax.sort; otherwise the sharded
+    # samplesort whose collective volume lands in comm.sort) and wall ms
+    "ingest.sort": frozenset(
+        {"rows", "nnz", "shards", "entries", "fast_path", "wall_ms"}
+    ),
+    # the fingerprint decision for one arrival: hit=True dedups onto an
+    # existing pattern (zero new compiles — the whole program-key chain
+    # is already warm); fingerprint is the structure key's short prefix
+    "ingest.dedup": frozenset({"ticket", "hit", "fingerprint"}),
+    # one onboarding lifecycle transition: state is 'retry' (an attempt
+    # failed, the bounded worker goes again), 'ready' (terminal ok) or
+    # 'failed' (terminal, after retries); wall_ms measures from arrival
+    "ingest.onboard": frozenset({"ticket", "state", "wall_ms"}),
     # -- generic ------------------------------------------------------------
     # one per process per sink file, written before the first event: the
     # controller's identity (process_index/pid/process_count, device

@@ -37,6 +37,12 @@ Registered kinds:
                       fingerprint, candidate-grid fingerprint), so a
                       restart serves the tuned policy from the first
                       request instead of re-exploring.
+* ``ingest_fpindex`` — the ingest dedup index
+                      (``sparse_tpu.ingest.fingerprint``, ISSUE 18):
+                      pure-meta ``structure key -> pattern key`` map
+                      under the single well-known key ``fpindex``, so a
+                      fresh process recognizes a re-arriving matrix
+                      structure before ever holding it in memory.
 """
 
 from __future__ import annotations
@@ -273,6 +279,14 @@ def _dec_autopilot_policy(meta, arrays):
     return dict(meta)
 
 
+def _enc_ingest_fpindex(obj):
+    return {str(k): str(v) for k, v in dict(obj).items()}, {}
+
+
+def _dec_ingest_fpindex(meta, arrays):
+    return {str(k): str(v) for k, v in dict(meta).items()}
+
+
 register("pattern", _enc_pattern, _dec_pattern)
 register("sell_pattern", _enc_sell_pattern, _dec_sell_pattern)
 register("prepared_csr", _enc_prepared_csr, _dec_prepared_csr)
@@ -281,3 +295,4 @@ register("precond_diag", _enc_precond_diag, _dec_precond_diag)
 register("precond_block", _enc_precond_block, _dec_precond_block)
 register("ilu_symbolic", _enc_ilu_symbolic, _dec_ilu_symbolic)
 register("autopilot_policy", _enc_autopilot_policy, _dec_autopilot_policy)
+register("ingest_fpindex", _enc_ingest_fpindex, _dec_ingest_fpindex)
