@@ -1,0 +1,222 @@
+#!/usr/bin/env python
+"""Terminal sparkline dashboard over the Axon v7 history segments.
+
+Usage:
+    python scripts/axon_dash.py [--root DIR] [--window 300] [--res 0]
+                                [--series SUBSTR[,SUBSTR...]]
+                                [--limit 40] [--interval 2] [--once]
+
+Renders the continuous-telemetry history store
+(``telemetry/_history.py`` — ``SPARSE_TPU_HISTORY=1``) as one unicode
+sparkline row per metric series: name, spark of the window, last value,
+min/max. Pure stdlib and **reads the on-disk segments directly** (no
+sparse_tpu import): it works on a live session's directory, after the
+process died, and on a directory copied off another machine. The live
+exporter's ``/dash`` page is the in-process variant of the same board.
+
+    --root      segments directory (default: SPARSE_TPU_HISTORY_DIR,
+                else results/axon/history next to this repo)
+    --window    seconds of history to show (default 300)
+    --res       resolution: 0 = raw samples, 10/60 = rollups (the
+                min/max/mean/last rollup plots its mean) (default 0)
+    --series    comma-separated substring filters (default: the serving
+                headline series; pass '' for everything)
+    --limit     max rows (default 40)
+    --interval  refresh period in seconds (default 2)
+    --once      render one frame and exit (the smoke-test mode)
+
+Exit codes: 0 = rendered (even an empty directory renders a header),
+2 = bad usage.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+DEFAULT_ROOT = os.path.join(REPO, "results", "axon", "history")
+
+SPARK = "▁▂▃▄▅▆▇█"
+#: default headline filters — the serving-path series an operator
+#: watches first (same set as the exporter's /dash)
+DEFAULT_SERIES = (
+    "batch.ticket_latency",
+    "batch.slo_misses",
+    "batch.queue_depth",
+    "batch.dispatches",
+    "usage.",
+)
+
+
+def read_segments(root: str, res: int | None = None) -> list:
+    """Parse every committed segment under ``root`` (stdlib mirror of
+    ``_history.read_segments``): skips files whose header line is not a
+    v1 ``history.segment``, keeps the intact prefix of a torn tail,
+    returns points sorted by (t, r)."""
+    points = []
+    try:
+        names = sorted(
+            n for n in os.listdir(root)
+            if n.startswith("seg-") and n.endswith(".jsonl")
+        )
+    except OSError:
+        return points
+    for name in names:
+        try:
+            with open(os.path.join(root, name)) as f:
+                lines = f.read().splitlines()
+        except OSError:
+            continue
+        if not lines:
+            continue
+        try:
+            head = json.loads(lines[0])
+        except (json.JSONDecodeError, ValueError):
+            continue
+        if head.get("kind") != "history.segment" or head.get("format") != 1:
+            continue
+        session = head.get("session")
+        for ln in lines[1:]:
+            try:
+                p = json.loads(ln)
+            except (json.JSONDecodeError, ValueError):
+                break  # torn tail: keep the intact prefix
+            if not isinstance(p, dict) or "t" not in p or "s" not in p:
+                break
+            if res is not None and p.get("r", 0) != res:
+                continue
+            p["session"] = session
+            points.append(p)
+    points.sort(key=lambda p: (p.get("t", 0.0), p.get("r", 0)))
+    return points
+
+
+def sparkline(values: list) -> str:
+    vals = [float(v) for v in values if isinstance(v, (int, float))]
+    if not vals:
+        return ""
+    lo, hi = min(vals), max(vals)
+    span = hi - lo
+    if span <= 0:
+        return SPARK[0] * len(vals)
+    return "".join(
+        SPARK[min(int((v - lo) / span * (len(SPARK) - 1) + 0.5),
+                  len(SPARK) - 1)]
+        for v in vals
+    )
+
+
+def _fmt(v: float) -> str:
+    if isinstance(v, float) and v != int(v):
+        return f"{v:.4g}"
+    return str(int(v)) if isinstance(v, (int, float)) else str(v)
+
+
+def render(root: str, window_s: float, res: int, filters: tuple,
+           limit: int, width: int = 60) -> str:
+    """One frame: header + a sparkline row per matching series."""
+    points = read_segments(root, res=res)
+    now = points[-1]["t"] if points else time.time()
+    points = [p for p in points if p["t"] >= now - window_s]
+    sessions = sorted({p.get("session") for p in points if p.get("session")})
+    keys = sorted({k for p in points for k in p.get("s", {})})
+    if filters:
+        keys = [k for k in keys if any(s in k for s in filters)] or keys
+    lines = [
+        f"axon dash · {root}",
+        f"window {int(window_s)}s · res {res} · {len(points)} points · "
+        f"{len(sessions)} session(s) · "
+        + time.strftime("%H:%M:%S", time.localtime(now)),
+        "",
+    ]
+    if not points:
+        lines.append("(no history points — is SPARSE_TPU_HISTORY set and "
+                     "a session running?)")
+        return "\n".join(lines) + "\n"
+    name_w = min(max((len(k) for k in keys[:limit]), default=10), 42)
+    for k in keys[:limit]:
+        series = []
+        for p in points:
+            v = p["s"].get(k)
+            if isinstance(v, list):  # rollup [min,max,mean,last] -> mean
+                v = v[2] if len(v) == 4 else None
+            if isinstance(v, (int, float)):
+                series.append(v)
+        if not series:
+            continue
+        tail = series[-width:]
+        lines.append(
+            f"{k[:name_w]:<{name_w}} {sparkline(tail):<{width}} "
+            f"last={_fmt(series[-1])} min={_fmt(min(series))} "
+            f"max={_fmt(max(series))}"
+        )
+    dropped = len(keys) - limit
+    if dropped > 0:
+        lines.append(f"... {dropped} more series (--limit to raise, "
+                     "--series to filter)")
+    return "\n".join(lines) + "\n"
+
+
+def main(argv) -> int:
+    if "--help" in argv or "-h" in argv:
+        print(__doc__)
+        return 0
+    args = list(argv)
+    once = "--once" in args
+    if once:
+        args.remove("--once")
+
+    def take(flag, default):
+        if flag in args:
+            i = args.index(flag)
+            if i + 1 >= len(args):
+                print(f"axon_dash: {flag} needs a value", file=sys.stderr)
+                raise SystemExit(2)
+            v = args[i + 1]
+            del args[i:i + 2]
+            return v
+        return default
+
+    root = take("--root", os.environ.get("SPARSE_TPU_HISTORY_DIR")
+                or DEFAULT_ROOT)
+    try:
+        window_s = float(take("--window", "300"))
+        res = int(take("--res", "0"))
+        limit = int(take("--limit", "40"))
+        interval = float(take("--interval", "2"))
+    except ValueError:
+        print("axon_dash: --window/--res/--limit/--interval must be "
+              "numeric", file=sys.stderr)
+        return 2
+    if res not in (0, 10, 60):
+        print("axon_dash: --res must be 0, 10 or 60", file=sys.stderr)
+        return 2
+    series = take("--series", None)
+    filters = (
+        tuple(s for s in series.split(",") if s) if series is not None
+        else DEFAULT_SERIES
+    )
+    if args:
+        print(f"axon_dash: unknown arguments {args}", file=sys.stderr)
+        return 2
+
+    if once:
+        sys.stdout.write(render(root, window_s, res, filters, limit))
+        return 0
+    try:
+        while True:
+            frame = render(root, window_s, res, filters, limit)
+            sys.stdout.write("\x1b[2J\x1b[H" + frame)
+            sys.stdout.flush()
+            time.sleep(interval)
+    except KeyboardInterrupt:
+        print()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main(sys.argv[1:]))

@@ -10,6 +10,9 @@ runbook):
 
 * ``slo_miss_rate`` + a ``fault.injected`` ``delay:dispatch`` chain
   → "injected dispatch delay";
+* ``slo_fast_burn``/``slo_slow_burn`` (the v7 error-budget watchdog)
+  → "error budget burning at Nx", joined with the bundle's embedded
+  ``history.json`` window to say when the misses *started*;
 * latched failovers / ``kernel.failover`` events → "Pallas kernel
   failed over to XLA";
 * ``vault.quarantine`` events → "vault artifact corruption";
@@ -38,6 +41,7 @@ from __future__ import annotations
 import json
 import os
 import sys
+import time
 
 HERE = os.path.dirname(os.path.abspath(__file__))
 REPO = os.path.dirname(HERE)
@@ -87,16 +91,53 @@ def load_bundle(bundle: str) -> tuple:
     return manifest if isinstance(manifest, dict) else None, events
 
 
+def load_history(bundle: str) -> dict | None:
+    """The bundle's embedded ``history.json`` time-series window (only
+    present when the v7 history sampler was live at capture)."""
+    try:
+        h = json.load(open(os.path.join(bundle, "history.json")))
+        return h if isinstance(h, dict) else None
+    except (OSError, json.JSONDecodeError, ValueError):
+        return None
+
+
+def _burn_onset(history: dict | None) -> float | None:
+    """When the SLO misses *started* accumulating inside the bundle's
+    history window: the first raw sample whose ``batch.slo_misses``
+    counter moved off the window's base value. None without a usable
+    series — the diagnosis degrades to alert-time evidence only."""
+    if not history:
+        return None
+    series = []
+    for p in history.get("points", []):
+        if p.get("r", 0) != 0:
+            continue
+        v = (p.get("s") or {}).get("batch.slo_misses")
+        if isinstance(v, (int, float)) and isinstance(
+            p.get("t"), (int, float)
+        ):
+            series.append((p["t"], v))
+    if len(series) < 2:
+        return None
+    base = series[0][1]
+    for t, v in series:
+        if v > base:
+            return t
+    return None
+
+
 # ---------------------------------------------------------------------------
 # evidence summaries
 # ---------------------------------------------------------------------------
-def _summarize(manifest: dict, events: list) -> dict:
+def _summarize(manifest: dict, events: list,
+               history: dict | None = None) -> dict:
     """The joined evidence picture every diagnosis rule matches on."""
     kinds: dict = {}
     faults_by: dict = {}  # (site, fault) -> count
     anomaly_reasons: dict = {}
     failover_kernels = set()
     quarantine_reasons: dict = {}
+    burn_tenant = None
     for e in events:
         k = str(e.get("kind", "?"))
         kinds[k] = kinds.get(k, 0) + 1
@@ -111,6 +152,8 @@ def _summarize(manifest: dict, events: list) -> dict:
         elif k == "vault.quarantine":
             r = str(e.get("reason", "?"))
             quarantine_reasons[r] = quarantine_reasons.get(r, 0) + 1
+        elif k == "budget.burn":
+            burn_tenant = e.get("tenant")  # latest wins
     trans = manifest.get("transition") or {}
     latches = manifest.get("failover_latches") or {}
     faults_cfg = manifest.get("faults") or {}
@@ -131,6 +174,9 @@ def _summarize(manifest: dict, events: list) -> dict:
         "deadlines": kinds.get("batch.deadline", 0),
         "degraded": kinds.get("batch.degraded", 0),
         "requeues": kinds.get("batch.requeue", 0),
+        "burn_tenant": burn_tenant,
+        "burn_onset_t": _burn_onset(history),
+        "capture_ts": manifest.get("ts"),
     }
 
 
@@ -216,6 +262,36 @@ def _d_vault(s):
             "recurring checksum failures mean bad storage")
 
 
+def _d_burn(s):
+    if s["rule"] not in ("slo_fast_burn", "slo_slow_burn"):
+        return None
+    speed = "fast" if s["rule"] == "slo_fast_burn" else "slow"
+    ev = [
+        f"error budget burning at {s['value']}x the sustainable rate "
+        f"({speed} windows, page/warn trigger {s['trigger']}x)"
+    ]
+    if s["burn_tenant"]:
+        ev.append(f"worst tenant at breach: {s['burn_tenant']!r}")
+    onset = s["burn_onset_t"]
+    if isinstance(onset, (int, float)):
+        iso = time.strftime("%H:%M:%SZ", time.gmtime(onset))
+        ago = (
+            f" ({s['capture_ts'] - onset:.0f}s before capture)"
+            if isinstance(s["capture_ts"], (int, float)) else ""
+        )
+        ev.append(
+            f"history window: SLO misses started accumulating at "
+            f"{iso}{ago}"
+        )
+    return (f"SLO error budget {speed}-burning — misses are consuming "
+            "the budget faster than the objective sustains",
+            ev,
+            "fast burn pages (minutes to exhaustion), slow burn warns "
+            "(days): find the onset in the bundle's history.json, then "
+            "the cause in the secondary matches below "
+            "(docs/telemetry.md 'Axon v7')")
+
+
 def _d_queue(s):
     if s["rule"] != "queue_depth":
         return None
@@ -261,7 +337,9 @@ def _d_anomalies(s):
 
 
 def _d_compile_tax(s):
-    if s["rule"] != "slo_miss_rate" or not s["compiles"]:
+    if s["rule"] not in (
+        "slo_miss_rate", "slo_fast_burn", "slo_slow_burn"
+    ) or not s["compiles"]:
         return None
     return ("compile tax inside the serving window (cold buckets "
             "breached the SLO)",
@@ -291,6 +369,7 @@ _DIAGNOSES = (
     ("injected-io-fault", _d_injected_io),
     ("pallas-failover", _d_failover),
     ("vault-corruption", _d_vault),
+    ("slo-error-budget-burn", _d_burn),
     ("queue-saturation", _d_queue),
     ("occupancy-floor", _d_occupancy),
     ("degraded-serving", _d_degraded),
@@ -300,12 +379,13 @@ _DIAGNOSES = (
 )
 
 
-def diagnose(manifest: dict, events: list) -> dict:
+def diagnose(manifest: dict, events: list,
+             history: dict | None = None) -> dict:
     """The machine diagnosis of one bundle: the first matching signature
     is ``probable_cause``; every other match lands in ``matches`` (an
     incident can have several true findings — an injected delay AND the
     resulting requeues)."""
-    s = _summarize(manifest, events)
+    s = _summarize(manifest, events, history)
     matches = []
     for did, fn in _DIAGNOSES:
         try:
@@ -396,7 +476,7 @@ def main(argv) -> int:
             file=sys.stderr,
         )
         return 2
-    diag = diagnose(manifest, events)
+    diag = diagnose(manifest, events, load_history(bundle))
     diag["bundle"] = os.path.basename(bundle)
     if as_json:
         print(json.dumps(diag, indent=1, sort_keys=True, default=str))

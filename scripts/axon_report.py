@@ -14,6 +14,9 @@ Usage:
         [--quiet]
     python scripts/axon_report.py --trend [BENCH_r*.json globs]
         # cross-round bench trend table (no session log needed)
+    python scripts/axon_report.py --history [SEGMENTS_DIR]
+        # join the v7 history segments across restarts: sessions, span,
+        # and the SLO-miss incident window (results/axon/history)
 
 Exit codes: 0 = ok, 1 = regressions found (--compare), 2 = bad usage /
 missing input — so ``axon_report --compare`` gates CI directly.
@@ -67,6 +70,17 @@ times, batched/fleet speedups) so the bench trajectory in ROADMAP is
 machine-generated. ``scripts/axon_doctor.py`` is the sibling analyzer
 for incident bundles (``results/axon/incidents/``).
 
+Axon v7 additions (ISSUE 19): ``report["usage"]`` rolls up per-tenant
+usage metering from the ``batch.ticket``/``ingest.ticket`` terminal
+events plus sampled-dispatch device time; ``report["budget"]``
+recomputes the SLO error-budget burn rates offline (same objective and
+multi-window math as ``telemetry/_budget.py``, reimplemented inline —
+this script never imports sparse_tpu) and lifts
+``budget.fast_burn_max`` / ``budget.slow_burn_max`` /
+``usage.device_ms_total`` onto the ``--compare`` surface. ``--history``
+joins the on-disk history segments across process restarts and prints
+the SLO-miss incident window.
+
 Axon v4 additions (ISSUE 7): ``report["comm"]`` rolls up the
 ``comm.measured`` events (parallel/comm.py trace-time accounting) per
 site — measured vs analytic-model bytes, divergence %, and the achieved
@@ -78,14 +92,23 @@ fails the regression gate like any latency regression would.
 
 from __future__ import annotations
 
+import bisect
 import glob as _glob
 import json
 import os
 import sys
+import time
 
 HERE = os.path.dirname(os.path.abspath(__file__))
 REPO = os.path.dirname(HERE)
 DEFAULT_RECORDS = os.path.join(REPO, "results", "axon", "records.jsonl")
+DEFAULT_HISTORY = os.path.join(REPO, "results", "axon", "history")
+
+#: the SLO objective and burn windows (seconds) — must mirror
+#: telemetry/_budget.py (this script recomputes the math inline)
+_OBJECTIVE = 0.99
+_FAST_WINDOWS = (300.0, 3600.0)
+_SLOW_WINDOWS = (21600.0, 259200.0)
 
 
 # ---------------------------------------------------------------------------
@@ -449,6 +472,115 @@ def _comm_rollup(events, peak_ici_gbs=None) -> dict:
     return sites
 
 
+def _usage_rollup(events) -> dict:
+    """Per-tenant usage metering from the session log (Axon v7): the
+    offline mirror of ``telemetry._budget.usage_stats()`` — solve and
+    ingest ticket counts + SLO misses per tenant (from the terminal
+    events; ``'-'`` is the untagged pseudo-tenant) and the session's
+    total sampled device time (``batch.dispatch`` timed dispatches,
+    which carry no tenant at event level)."""
+    tenants: dict = {}
+    device_ms_total = 0.0
+
+    def row(tenant):
+        return tenants.setdefault(str(tenant) if tenant else "-", {})
+
+    def bump(r, field, n=1):
+        r[field] = r.get(field, 0) + n
+
+    for e in events:
+        k = e.get("kind")
+        if k == "batch.ticket":
+            r = row(e.get("tenant"))
+            bump(r, "tickets")
+            if e.get("slo_miss"):
+                bump(r, "slo_misses")
+        elif k == "ingest.ticket":
+            bump(row(e.get("tenant")), "ingest")
+        elif k == "batch.dispatch":
+            dm = _num(e.get("device_ms"))
+            if dm is not None:
+                device_ms_total += dm
+    out: dict = {"tenants": tenants} if tenants else {}
+    if device_ms_total:
+        out["device_ms_total"] = round(device_ms_total, 3)
+    return out
+
+
+def _burn_max(stream, windows, objective, min_total: int = 10):
+    """Worst multi-window burn rate over one (ts, miss) stream: at every
+    event instant, burn(W) = miss_rate over the trailing W seconds
+    scaled by 1/(1-objective); the pair guards against stale spikes by
+    taking the MIN across both windows (both must burn — the same
+    semantics as ``_budget.Engine.worst_burn``), and the rollup keeps
+    the max over time. Windows holding fewer than ``min_total`` tickets
+    are not scored (one early missed ticket is not a 100x burn — the
+    low-traffic discount every burn-rate alert applies). None when no
+    window ever reaches ``min_total``."""
+    if not stream:
+        return None
+    stream = sorted(stream)
+    ts = [t for t, _ in stream]
+    prefix = [0]
+    for _, miss in stream:
+        prefix.append(prefix[-1] + miss)
+    denom = 1.0 - objective
+    worst = None
+    for i, t in enumerate(ts):
+        pair = []
+        for w in windows:
+            j = bisect.bisect_left(ts, t - w, 0, i + 1)
+            total = (i + 1) - j
+            if total < min_total:
+                break
+            pair.append((prefix[i + 1] - prefix[j]) / total / denom)
+        if len(pair) == len(windows):
+            burn = min(pair)
+            if worst is None or burn > worst:
+                worst = burn
+    return round(worst, 4) if worst is not None else None
+
+
+def _budget_rollup(events, objective: float = _OBJECTIVE) -> dict:
+    """The offline error-budget picture (Axon v7): per-tenant (plus the
+    ``''`` aggregate) worst fast- and slow-window burn rates recomputed
+    from the ``batch.ticket`` terminal events. Empty dict when the log
+    has no SLO-tracked tickets (no ``slo_miss`` fields — a session run
+    without ``slo_ms`` has no budget to burn)."""
+    streams: dict = {}
+    tracked = False
+    for e in events:
+        if e.get("kind") != "batch.ticket":
+            continue
+        ts = _num(e.get("ts"))
+        if ts is None or "slo_miss" not in e:
+            continue
+        tracked = True
+        miss = 1 if e.get("slo_miss") else 0
+        keys = [""]
+        if e.get("tenant"):
+            keys.append(str(e["tenant"]))
+        for key in keys:
+            streams.setdefault(key, []).append((ts, miss))
+    if not tracked:
+        return {}
+    tenants = {}
+    for key, stream in sorted(streams.items()):
+        tenants[key] = {
+            "tickets": len(stream),
+            "misses": sum(m for _, m in stream),
+            "fast_burn_max": _burn_max(stream, _FAST_WINDOWS, objective),
+            "slow_burn_max": _burn_max(stream, _SLOW_WINDOWS, objective),
+        }
+    agg = tenants.get("", {})
+    return {
+        "objective": objective,
+        "fast_burn_max": agg.get("fast_burn_max"),
+        "slow_burn_max": agg.get("slow_burn_max"),
+        "tenants": tenants,
+    }
+
+
 def build_report(records_path: str, bench_paths=(), peak_gflops=None,
                  peak_gbs=None, peak_ici_gbs=None) -> dict:
     """The whole analysis as one JSON-serializable dict (see module
@@ -532,6 +664,8 @@ def build_report(records_path: str, bench_paths=(), peak_gflops=None,
     ]
 
     tickets = _tickets_rollup(events)
+    usage = _usage_rollup(events)
+    budget = _budget_rollup(events)
     comm = _comm_rollup(events, peak_ici_gbs)
     load = _load_rollup(events)
     alerts = _alerts_rollup(events)
@@ -599,6 +733,15 @@ def build_report(records_path: str, bench_paths=(), peak_gflops=None,
                 metrics[f"load.{key}"] = {"v": ll[key], "hib": hib}
     if alerts["fired"] or alerts["cleared"]:
         metrics["alerts.fired"] = {"v": alerts["fired"], "hib": False}
+    # the v7 budget/usage surface: worst burn rates recomputed offline
+    # and the session's sampled device time gate like latency metrics
+    for k in ("fast_burn_max", "slow_burn_max"):
+        if _num(budget.get(k)) is not None:
+            metrics[f"budget.{k}"] = {"v": budget[k], "hib": False}
+    if _num(usage.get("device_ms_total")) is not None:
+        metrics["usage.device_ms_total"] = {
+            "v": usage["device_ms_total"], "hib": False,
+        }
     # the bench cold_start row (ISSUE 9): cold vs disk-warm vs warm
     # serving times ride the --compare surface so the vault's warm-
     # restart win is a pinned regression metric, not just a bench line
@@ -628,7 +771,10 @@ def build_report(records_path: str, bench_paths=(), peak_gflops=None,
                        # the streaming-dispatch comparison (ISSUE 13):
                        # same overloaded seeded trace, pipeline on/off
                        ("pipelined_rps", True), ("sync_rps", True),
-                       ("pipeline_speedup", True)):
+                       ("pipeline_speedup", True),
+                       # the v7 history sampler's measured tax on the
+                       # same trace (acceptance bound: < 2%)
+                       ("history_overhead_pct", False)):
             if _num(sustained_row.get(k)) is not None:
                 metrics[f"sustained_cg.{k}"] = {
                     "v": sustained_row[k], "hib": hib,
@@ -757,6 +903,8 @@ def build_report(records_path: str, bench_paths=(), peak_gflops=None,
         "cache": cache,
         "anomalies": anomalies[:100],
         "tickets": tickets,
+        "usage": usage,
+        "budget": budget,
         "load": load,
         "alerts": alerts,
         "programs": programs,
@@ -781,7 +929,7 @@ def build_report(records_path: str, bench_paths=(), peak_gflops=None,
 _TREND_EMBEDS = (
     ("sustained_cg", ("achieved_rps", "offered_rps", "p95_ms",
                       "slo_miss_rate", "pipelined_rps", "sync_rps",
-                      "pipeline_speedup")),
+                      "pipeline_speedup", "history_overhead_pct")),
     ("cold_start", ("cold_s", "replay_s", "disk_warm_s", "warm_s")),
     ("batched_cg", ("speedup_warm",)),
     ("fleet_batched_cg", ("speedup_warm",)),
@@ -892,6 +1040,94 @@ def _print_trend(trend: dict) -> None:
                 f"  trend {name}: {first} -> {last} ({delta} over "
                 f"{len(pts)} round(s))"
             )
+
+
+# ---------------------------------------------------------------------------
+# history join (Axon v7): segments across restarts -> incident window
+# ---------------------------------------------------------------------------
+def build_history(root: str) -> dict:
+    """Join every committed history segment under ``root`` (the v7
+    sampler's on-disk tier — ``scripts/axon_dash.py`` owns the stdlib
+    segment parser) into one cross-restart summary: per-session spans
+    plus the SLO-miss *incident window* — the interval over which the
+    ``batch.slo_misses`` counter was actually moving."""
+    sys.path.insert(0, HERE)
+    import axon_dash
+
+    points = axon_dash.read_segments(root, res=0)
+    out: dict = {"root": root, "points": len(points)}
+    if not points:
+        return out
+    sessions: dict = {}
+    miss_series = []
+    for p in points:
+        s = sessions.setdefault(str(p.get("session")), {
+            "first": p["t"], "last": p["t"], "points": 0,
+        })
+        s["first"] = min(s["first"], p["t"])
+        s["last"] = max(s["last"], p["t"])
+        s["points"] += 1
+        v = (p.get("s") or {}).get("batch.slo_misses")
+        if isinstance(v, (int, float)):
+            miss_series.append((p["t"], v))
+    out["sessions"] = sessions
+    out["span_s"] = round(points[-1]["t"] - points[0]["t"], 3)
+    # the incident window: first and last instants the miss counter
+    # moved (per session — counters reset at process restart, so only
+    # same-session deltas count as movement)
+    incident = None
+    prev = {}
+    by_session: dict = {}
+    for p in points:
+        v = (p.get("s") or {}).get("batch.slo_misses")
+        if not isinstance(v, (int, float)):
+            continue
+        sess = str(p.get("session"))
+        last = prev.get(sess)
+        prev[sess] = v
+        if last is None or v <= last:
+            continue
+        if incident is None:
+            incident = {"start": p["t"], "end": p["t"], "misses": 0}
+        incident["end"] = p["t"]
+        incident["misses"] += v - last
+        by_session[sess] = by_session.get(sess, 0) + (v - last)
+    if incident:
+        incident["duration_s"] = round(
+            incident["end"] - incident["start"], 3
+        )
+        incident["misses"] = round(incident["misses"], 3)
+        if by_session:
+            incident["by_session"] = by_session
+        out["incident"] = incident
+    return out
+
+
+def _print_history(h: dict) -> None:
+    print(f"axon_report --history: {h['root']} — {h['points']} point(s)")
+    if not h["points"]:
+        print("  (no segments — is SPARSE_TPU_HISTORY set?)")
+        return
+    print(f"  span {h['span_s']}s across {len(h['sessions'])} session(s):")
+    for name, s in sorted(h["sessions"].items(),
+                          key=lambda kv: kv[1]["first"]):
+        print(
+            f"    {name:<20} {s['points']:>6} point(s)  "
+            + time.strftime("%H:%M:%S", time.localtime(s["first"]))
+            + " -> "
+            + time.strftime("%H:%M:%S", time.localtime(s["last"]))
+        )
+    inc = h.get("incident")
+    if inc:
+        print(
+            "  incident window: "
+            + time.strftime("%H:%M:%S", time.localtime(inc["start"]))
+            + " -> "
+            + time.strftime("%H:%M:%S", time.localtime(inc["end"]))
+            + f" ({inc['duration_s']}s, {inc['misses']} SLO miss(es))"
+        )
+    else:
+        print("  no SLO misses in the recorded window")
 
 
 # ---------------------------------------------------------------------------
@@ -1011,6 +1247,31 @@ def _print_report(rep: dict) -> None:
                 + " ".join(
                     f"{p}={ph[p]}" for p in _TICKET_PHASES if p in ph
                 )
+            )
+    usage = rep.get("usage") or {}
+    if usage.get("tenants") or usage.get("device_ms_total"):
+        bits = []
+        if usage.get("device_ms_total") is not None:
+            bits.append(f"sampled device_ms={usage['device_ms_total']}")
+        print("  usage (per-tenant metering)"
+              + (": " + " ".join(bits) if bits else ":"))
+        for tenant, r in sorted((usage.get("tenants") or {}).items()):
+            cols = " ".join(f"{k}={v}" for k, v in sorted(r.items()))
+            print(f"    {tenant or '(default)':<14} {cols}")
+    budget = rep.get("budget") or {}
+    if budget:
+        print(
+            f"  budget (objective {budget.get('objective')}): "
+            f"fast_burn_max={budget.get('fast_burn_max')} "
+            f"slow_burn_max={budget.get('slow_burn_max')}"
+        )
+        for tenant, r in sorted((budget.get("tenants") or {}).items()):
+            if tenant == "":
+                continue  # the aggregate is the headline line above
+            print(
+                f"    tenant {tenant:<12} tickets={r['tickets']} "
+                f"misses={r['misses']} fast={r['fast_burn_max']} "
+                f"slow={r['slow_burn_max']}"
             )
     load = rep.get("load") or {}
     if load.get("runs"):
@@ -1207,6 +1468,23 @@ def main(argv) -> int:
             if not quiet:
                 print(f"  trend -> {out_json}")
         return 0 if trend["rounds"] else 2
+    # --history (ISSUE 19): join the v7 on-disk history segments across
+    # restarts — positional arg is the segments dir
+    if "--history" in args:
+        args.remove("--history")
+        hist = build_history(args[0] if args else DEFAULT_HISTORY)
+        if not quiet:
+            _print_history(hist)
+        if out_json:
+            os.makedirs(
+                os.path.dirname(os.path.abspath(out_json)), exist_ok=True
+            )
+            with open(out_json, "w") as f:
+                json.dump(hist, f, indent=1, sort_keys=True)
+                f.write("\n")
+            if not quiet:
+                print(f"  history -> {out_json}")
+        return 0 if hist["points"] else 2
     try:
         threshold = float(take("--threshold", "0.2"))
         pk_gf = take("--peak-gflops")

@@ -352,6 +352,172 @@ def run(report: dict) -> list:
     # -- 14. ingest chaos: io faults + kill mid-onboarding ------------------
     problems += _ingest_chaos(report)
     problems += _ingest_kill_restart(report)
+
+    # -- 15. error-budget burn: fast-burn alert -> history-carrying bundle --
+    problems += _budget_burn(report)
+    return problems
+
+
+def _budget_burn(report: dict) -> list:
+    """Scenario 15 (ISSUE 19): scenario 8's injection geometry against
+    the v7 error-budget watchdog with the history sampler live. Under
+    injected dispatch delays the ``slo_fast_burn`` rule (windows
+    compressed from 5m/1h to fractions of a second) must page while the
+    faults are live and clear once clean traffic has rolled the short
+    window over; the alert's flight bundle must embed the history
+    window, the stdlib doctor must name the burn signature, and
+    ``axon_report --history`` over the sampler's segments must show the
+    incident window from a fresh process."""
+    import numpy as np
+
+    from sparse_tpu import loadgen, telemetry as tel
+    from sparse_tpu.batch import SolveSession
+    from sparse_tpu.resilience import faults
+    from sparse_tpu.telemetry import _budget, _flight, _history, _watchdog
+
+    problems = []
+    tel.reset()
+    rng = np.random.default_rng(53)
+    mats = []
+    for _ in range(4):
+        M = _tridiag(N)
+        M.setdiag(3.0 + rng.random(N))
+        M.sort_indices()
+        mats.append(M.tocsr())
+    rhs = rng.standard_normal((4, N))
+    systems = list(zip(mats, rhs))
+
+    ses = SolveSession("cg", slo_ms=WD_SLO_MS)
+    pattern = ses.pattern_of(mats[0])
+    pattern.sell_pack()
+    bkt = 1
+    while bkt <= 16:
+        ses._prebuild(pattern, "cg", bkt, np.dtype(np.float64))
+        bkt *= 2
+
+    hdir = tempfile.mkdtemp(prefix="chaos_history_")
+    idir = tempfile.mkdtemp(prefix="chaos_incidents_")
+    _history.stop()
+    _history.start(root=hdir, interval_s=0.05)
+    _flight.stop_flight()
+    _flight.flight(root=idir, min_interval_s=60.0, max_bundles=4)
+    # a fresh engine, its 5m/1h geometry compressed to fractions of a
+    # second so the drill's faulted/clean phases ARE the windows
+    eng = _budget.Engine()
+    wd = _watchdog.Watchdog(rules=[
+        _budget.fast_burn_rule(windows=(0.5, 2.0), engine=eng),
+    ])
+    wd.evaluate()  # prime: first engine sample, rule skips (no pair yet)
+
+    trace = loadgen.ArrivalTrace.poisson(rate=40.0, duration=0.5, seed=19)
+    faults.configure(WD_DELAY_SPEC)
+    try:
+        loadgen.run_load(ses, trace, systems, tol=TOL)
+        # evaluate while the injection is live: every ticket of the
+        # faulted run missed, so both compressed windows burn far past
+        # the 14.4 trigger and the page fires DURING the incident
+        wd.evaluate()
+        alerted = "slo_fast_burn" in wd.active()
+    finally:
+        faults.clear()
+    # clean traffic rolls the short window past the incident: the
+    # post-fault delta (sampled at each evaluation) is miss-free, the
+    # min-across-pair drops under clear
+    cleared = False
+    for _ in range(3):
+        loadgen.run_load(ses, trace, systems, tol=TOL)
+        wd.evaluate()
+        if "slo_fast_burn" not in wd.active():
+            cleared = True
+            break
+    _history.stop()
+    _flight.stop_flight()
+
+    kinds = _event_kinds(tel)
+    bundles = sorted(
+        n for n in os.listdir(idir)
+        if os.path.isfile(os.path.join(idir, n, "incident.json"))
+    )
+    segs = _history.read_segments(hdir)
+    report["budget_burn"] = {
+        "alerted_during_injection": alerted,
+        "cleared_after_clean": cleared,
+        "bundles": bundles,
+        "history_points": len(segs),
+        "events": kinds,
+    }
+    if not alerted:
+        problems.append("budget: slo_fast_burn did not page during "
+                        "injection")
+    if not cleared:
+        problems.append(
+            f"budget: fast burn did not clear after clean traffic "
+            f"(active={wd.active()})"
+        )
+    if kinds.get("budget.burn", 0) == 0:
+        problems.append("budget: no budget.burn breadcrumb event")
+    if not segs:
+        problems.append("budget: history sampler committed no segments")
+    if len(bundles) != 1:
+        problems.append(
+            f"budget: expected one alert bundle, found {len(bundles)}"
+        )
+        return problems
+    bundle = os.path.join(idir, bundles[0])
+    try:
+        hist = json.load(open(os.path.join(bundle, "history.json")))
+        hpoints = len(hist.get("points", []))
+    except (OSError, json.JSONDecodeError, ValueError):
+        hpoints = None
+    report["budget_burn"]["bundle_history_points"] = hpoints
+    if not hpoints:
+        problems.append("budget: bundle carries no history.json window")
+    # the stdlib doctor: the injected delay stays the probable cause,
+    # and the burn signature must be named among the matches
+    doctor = subprocess.run(
+        [sys.executable, os.path.join(HERE, "axon_doctor.py"), bundle,
+         "--json"],
+        capture_output=True, text=True, timeout=60,
+    )
+    try:
+        diag = json.loads(doctor.stdout)
+    except json.JSONDecodeError:
+        diag = None
+    if diag is None:
+        problems.append(
+            f"budget: doctor produced no JSON diagnosis "
+            f"(rc={doctor.returncode}, stderr: {doctor.stderr[-200:]!r})"
+        )
+        return problems
+    match_ids = [m.get("id") for m in diag.get("matches", [])]
+    report["budget_burn"]["diagnosis"] = {
+        "rule": diag.get("rule"), "cause": diag.get("cause"),
+        "matches": match_ids,
+    }
+    if diag.get("rule") != "slo_fast_burn":
+        problems.append(
+            f"budget: diagnosis rule {diag.get('rule')!r} != "
+            "'slo_fast_burn'"
+        )
+    if "slo-error-budget-burn" not in match_ids:
+        problems.append("budget: doctor did not name the burn signature")
+    # a FRESH process joins the committed segments and reports the
+    # incident window (the cross-restart read path)
+    rep_hist = subprocess.run(
+        [sys.executable, os.path.join(HERE, "axon_report.py"),
+         "--history", hdir],
+        capture_output=True, text=True, timeout=60,
+    )
+    report["budget_burn"]["report_history_rc"] = rep_hist.returncode
+    if rep_hist.returncode != 0:
+        problems.append(
+            f"budget: axon_report --history failed "
+            f"(rc={rep_hist.returncode}, stderr: "
+            f"{rep_hist.stderr[-200:]!r})"
+        )
+    elif "incident window" not in rep_hist.stdout:
+        problems.append("budget: axon_report --history did not show the "
+                        "incident window")
     return problems
 
 
@@ -1822,6 +1988,7 @@ def main(argv) -> int:
         ac = report.get("autopilot_chaos", {})
         ig = report.get("ingest_chaos", {})
         ir = report.get("ingest_restart", {})
+        bb = report.get("budget_burn", {})
         print(
             "chaos check passed: "
             f"{len([k for k in report if k.startswith('solver.')])} solvers "
@@ -1858,7 +2025,11 @@ def main(argv) -> int:
             f"torn artifact quarantined="
             f"{ig.get('torn', {}).get('quarantined', '?')}, restart dedup="
             f"{ir.get('dedup', '?')} at "
-            f"{ir.get('delta', {}).get('misses', '?')} serving misses)"
+            f"{ir.get('delta', {}).get('misses', '?')} serving misses), "
+            f"error-budget burn page->clear ok "
+            f"({bb.get('bundle_history_points', '?')} history point(s) in "
+            f"the bundle, doctor rule "
+            f"{bb.get('diagnosis', {}).get('rule', '?')!r})"
         )
     return 1 if problems else 0
 

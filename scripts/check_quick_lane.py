@@ -39,6 +39,7 @@ MANIFEST = os.path.join(TESTS, "quick_lane_manifest.json")
 # these by path): a rename/deletion must fail here, not at collection
 # time inside an importlib call with a cryptic spec error.
 _REQUIRED_SCRIPTS = (
+    "axon_dash.py",
     "axon_doctor.py",
     "axon_merge.py",
     "axon_report.py",

@@ -26,6 +26,13 @@ postmortem bundle DIRECTORIES under ``results/axon/incidents/``; the
 same bounded-retention policy as the vault quarantine applies — the
 newest ``KEEP_INCIDENTS`` bundles are kept, older ones removed — so
 committed results stay small even after an alert storm.
+
+History segments (Axon v7 satellite): the continuous-telemetry sampler
+writes append-only ``seg-*.jsonl`` segments under
+``results/axon/history/``; ``trim_history`` keeps only the NEWEST
+session's segments (the sampler's own byte-cap GC bounds a live
+session; this bounds what survives across sessions into a commit) and
+empties the ``quarantine/`` subdirectory of corrupt segments.
 """
 
 import glob as _glob
@@ -39,6 +46,7 @@ REPO = os.path.dirname(HERE)
 AXON_DIR = os.path.join(HERE, "..", "results", "axon")
 RECORDS = os.path.join(AXON_DIR, "records.jsonl")
 INCIDENTS_DIR = os.path.join(AXON_DIR, "incidents")
+HISTORY_DIR = os.path.join(AXON_DIR, "history")
 SLACK_S = 120.0  # clock slack around the session window
 KEEP_INCIDENTS = 4  # newest bundles kept by trim_incidents
 
@@ -209,19 +217,71 @@ def trim_incidents(root: str = INCIDENTS_DIR, keep: int = KEEP_INCIDENTS,
     return removed
 
 
+def trim_history(root: str = HISTORY_DIR, dry_run: bool = False) -> int:
+    """Keep only the newest session's history segments (Axon v7
+    satellite). Segment names (``seg-<epoch_ms>-<seq>.jsonl``) sort
+    chronologically; the session owning the newest segment survives,
+    every older session's segments go, and quarantined corrupt segments
+    (``quarantine/``) are emptied. The live sampler's byte-cap GC
+    bounds a running session — this bounds the committed residue.
+    Returns the number of files removed."""
+
+    def _session_of(name):
+        try:
+            with open(os.path.join(root, name)) as f:
+                head = json.loads(f.readline())
+            if head.get("kind") == "history.segment":
+                return head.get("session")
+        except (OSError, json.JSONDecodeError, ValueError):
+            pass
+        return None
+
+    try:
+        names = sorted(
+            n for n in os.listdir(root)
+            if n.startswith("seg-") and n.endswith(".jsonl")
+        )
+    except OSError:
+        print("trim_records: no history segments; nothing to do")
+        return 0
+    quarantined = sorted(_glob.glob(os.path.join(root, "quarantine", "*")))
+    keep_session = _session_of(names[-1]) if names else None
+    doomed = [
+        n for n in names
+        if keep_session is None or _session_of(n) != keep_session
+    ]
+    print(
+        f"trim_records: history: {len(names)} segment(s) -> "
+        f"{len(names) - len(doomed)} (removing {len(doomed)} from older "
+        f"sessions, {len(quarantined)} quarantined)"
+    )
+    if dry_run:
+        return len(doomed) + len(quarantined)
+    removed = 0
+    for path in [os.path.join(root, n) for n in doomed] + quarantined:
+        try:
+            os.remove(path)
+            removed += 1
+        except OSError as e:
+            print(f"trim_records: could not remove {path}: {e}")
+    return removed
+
+
 def trim_all(dry_run: bool = False) -> int:
     """Trim every committed session log — the single-controller
     ``records.jsonl`` plus any per-process ``records.<pid>.jsonl`` the
     multi-controller sink split produced. Merge outputs
     (``records.merged.jsonl``) are trimmed like any other log. Incident
-    bundles are pruned to the newest ``KEEP_INCIDENTS`` alongside."""
+    bundles are pruned to the newest ``KEEP_INCIDENTS`` and history
+    segments to the newest session alongside."""
     paths = sorted(_glob.glob(os.path.join(AXON_DIR, "records*.jsonl")))
     if not paths:
         print("trim_records: no session logs; nothing to do")
         dropped = 0
     else:
         dropped = sum(trim(p, dry_run=dry_run) for p in paths)
-    return dropped + trim_incidents(dry_run=dry_run)
+    return (dropped + trim_incidents(dry_run=dry_run)
+            + trim_history(dry_run=dry_run))
 
 
 if __name__ == "__main__":

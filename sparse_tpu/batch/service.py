@@ -104,7 +104,7 @@ from ..ops import spmv as spmv_ops
 from ..parallel import comm as _comm
 from ..resilience import faults as _faults
 from ..resilience.policy import deadline_remaining_s
-from ..telemetry import _cost, _metrics, _profiler
+from ..telemetry import _budget, _cost, _history, _metrics, _profiler
 from . import bucket as bucketing
 from . import krylov
 from .operator import BatchedCSR, SparsityPattern
@@ -747,6 +747,11 @@ class SolveSession:
         # terminal-state tallies for the /session serving endpoint
         self._ticket_counts = {"done": 0, "failed": 0, "slo_miss": 0}
         _SESSIONS.add(self)
+        # continuous-telemetry history (Axon v7): auto-start the metrics
+        # sampler when SPARSE_TPU_HISTORY is set — a single attribute
+        # check when off, so the disabled serving path stays
+        # byte-identical (pinned by tests/test_history.py)
+        _history.maybe_start()
         # serving-path persistent XLA compile cache (ISSUE 9 satellite):
         # env-gated so bucket-program executables survive restarts
         # alongside the vault's packed artifacts
@@ -799,8 +804,8 @@ class SolveSession:
         return self._patterns.setdefault(p.fingerprint, p)
 
     def ingest(self, source, *, bucket: int = 1, dtype=np.float64,
-               num_shards: int | None = None, wait: bool = False,
-               timeout: float | None = None):
+               num_shards: int | None = None, tenant: str | None = None,
+               wait: bool = False, timeout: float | None = None):
         """Queue one arriving matrix for background onboarding
         (ISSUE 18): parse -> fingerprint dedup -> sharded samplesort
         COO->CSR -> SELL pack + bucket prebuild + vault persistence,
@@ -814,14 +819,16 @@ class SolveSession:
         blocks for the outcome first. ``bucket``/``dtype`` shape the
         program a cold pattern gets prebuilt ahead of its first solve.
         A dedup hit rides the existing pattern object: its first solve
-        is a pure plan-cache hit — zero new compiles."""
+        is a pure plan-cache hit — zero new compiles. ``tenant``
+        attributes the onboarding in the v7 ``usage.*`` metering."""
         from ..ingest.onboard import Onboarder
 
         ob = self._onboarder
         if ob is None:
             ob = self._onboarder = Onboarder(self)
         t = ob.submit(
-            source, bucket=bucket, dtype=dtype, num_shards=num_shards
+            source, bucket=bucket, dtype=dtype, num_shards=num_shards,
+            tenant=tenant,
         )
         if wait:
             t.result(timeout=timeout)
@@ -1008,6 +1015,11 @@ class SolveSession:
             },
             **({"ingest": self._onboarder.stats()}
                if self._onboarder is not None else {}),
+            # per-tenant usage metering rollup (Axon v7): process-wide
+            # (the usage.* families are always-on and global); present
+            # only once something was metered, so pre-v7 consumers of
+            # this dict see no new key on idle sessions
+            **({"usage": u} if (u := _budget.usage_stats()) else {}),
         }
 
     # -- warm restart (ISSUE 9; async since ISSUE 13) ----------------------
@@ -1406,6 +1418,23 @@ class SolveSession:
             self._ticket_counts["slo_miss"] += 1
         state = "done" if t.done else "failed"
         self._ticket_counts[state] += 1
+        # per-tenant usage metering (Axon v7): solve counts and SLO
+        # misses attributed to the ticket's tenant label ('-' for
+        # untagged tickets). Always-on — these are the denominators/
+        # numerators the budget engine's per-tenant burn rates read.
+        tenant = t.tenant or "-"
+        _metrics.counter(
+            "usage.tickets",
+            help="resolved tickets per tenant (the usage metering and "
+            "per-tenant burn-rate denominator)",
+            tenant=tenant, state=state,
+        ).inc()
+        if slo_miss:
+            _metrics.counter(
+                "usage.slo_misses",
+                help="SLO-missing tickets per tenant",
+                tenant=tenant,
+            ).inc()
         if telemetry.enabled():
             fields = {
                 "ticket": t.id,
@@ -1435,7 +1464,8 @@ class SolveSession:
             telemetry.record("batch.ticket", **fields)
 
     def _fleet_account(self, plan, solver, dt, nb, bkt, iters,
-                       solve_s, policy=mixed_mod.EXACT) -> None:
+                       solve_s, policy=mixed_mod.EXACT,
+                       tenants=None) -> None:
         """Post-dispatch fleet accounting (ISSUE 10): per-device lane
         occupancy (session stats + always-on gauges), the batch-sharded
         program's measured-collective commit (the per-iteration
@@ -1478,6 +1508,21 @@ class SolveSession:
             execs = int(np.asarray(iters).max(initial=0)) + 1
             if led.entries:
                 led.commit(execs, S)
+                # per-tenant usage metering (Axon v7): the dispatch's
+                # modeled collective volume split evenly across its
+                # real lanes and attributed per tenant
+                if tenants:
+                    share = fleet_mod.batch_comm_model_bytes(
+                        S, execs - 1
+                    ) / len(tenants)
+                    for tn in tenants:
+                        _metrics.counter(
+                            "usage.collective_bytes",
+                            help="modeled collective bytes attributed "
+                            "per tenant (even split across each "
+                            "sharded dispatch's real lanes)",
+                            tenant=tn or "-",
+                        ).add(share)
         if not telemetry.enabled():
             return
         telemetry.record(
@@ -1897,9 +1942,33 @@ class SolveSession:
         # mesh-multiple rounding); pad lanes are excluded by construction
         _BUCKET_OCCUPANCY.observe(nb / bkt)
         _PAD_WASTE.inc(bkt - nb)
+        # per-tenant usage metering (Axon v7): lanes dispatched and —
+        # sampled dispatches — the measured device-ms split per lane,
+        # attributed to each lane's tenant. Rides the existing retire
+        # path: no new timestamps, no device touch.
+        device_share = (
+            profile_ms[1] / nb if profile_ms is not None and nb else None
+        )
+        for r in reqs:
+            tenant = r.ticket.tenant or "-"
+            _metrics.counter(
+                "usage.lanes",
+                help="real lanes dispatched per tenant (requeues count "
+                "again — the work actually done)",
+                tenant=tenant,
+            ).inc()
+            if device_share is not None:
+                _metrics.counter(
+                    "usage.device_ms",
+                    help="sampled device milliseconds attributed per "
+                    "tenant (even split of each sampled dispatch's "
+                    "device time across its real lanes)",
+                    tenant=tenant,
+                ).add(device_share)
         self._fleet_account(
             plan, solver, dt, nb, bkt, iters,
             max(t_solved - fl.t_solve0, 0.0), policy=fl.policy,
+            tenants=[r.ticket.tenant for r in reqs],
         )
         if fl.auto is not None and self.autopilot is not None:
             # settle the dispatch's measurement against its autopilot

@@ -110,6 +110,17 @@ Flags (all env-overridable):
   SPARSE_TPU_AUTOPILOT_DRIFT  - drift threshold: a pinned-arm observation slower
                                 than factor * the decision score counts a drift
                                 strike into autopilot.drift_strikes (default 2.0).
+  SPARSE_TPU_HISTORY          - continuous telemetry history store (telemetry/_history.py,
+                                Axon v7): a directory (or '1' for results/axon/history)
+                                enables the background sampler that scrapes the always-on
+                                metrics registry into in-memory rings + on-disk segments.
+                                Empty (default) = off: no thread, no filesystem touch,
+                                program keys/jaxprs byte-identical.
+  SPARSE_TPU_HISTORY_DIR      - segment directory override (wins over a path given in
+                                SPARSE_TPU_HISTORY).
+  SPARSE_TPU_HISTORY_CAP_MB   - committed-segment retention budget in MB (default 64);
+                                oldest segments are deleted past it.
+  SPARSE_TPU_HISTORY_INTERVAL - sampler scrape period in seconds (default 1.0).
   SPARSE_TPU_INGEST_DEPTH     - streaming ingestion data plane (sparse_tpu.ingest):
                                 max arrivals queued on the background onboarder
                                 before admission control engages (default 16).
@@ -427,6 +438,31 @@ class Settings:
     autopilot_drift: float = field(
         default_factory=lambda: max(
             _env_float("SPARSE_TPU_AUTOPILOT_DRIFT", 2.0), 1.0
+        )
+    )
+
+    # -- continuous telemetry history (telemetry/_history.py, Axon v7) -----
+    # A directory (or a truthy spelling for the default
+    # results/axon/history) enables the background metrics sampler:
+    # bounded in-memory rings + append-only on-disk segments with
+    # multi-resolution rollups. Empty (default) = off: no sampler
+    # thread exists, nothing touches the filesystem, and every serving
+    # path is byte-identical (the gate is one attribute check).
+    history: str = field(default_factory=lambda: _env_str("SPARSE_TPU_HISTORY", ""))
+    # Segment directory override (wins over a path spelled in
+    # SPARSE_TPU_HISTORY itself).
+    history_dir: str = field(
+        default_factory=lambda: _env_str("SPARSE_TPU_HISTORY_DIR", "")
+    )
+    # Committed-segment retention budget (MB): the rotation-time GC
+    # deletes oldest-first past it.
+    history_cap_mb: int = field(
+        default_factory=lambda: max(_env_int("SPARSE_TPU_HISTORY_CAP_MB", 64), 1)
+    )
+    # Sampler scrape period (seconds).
+    history_interval: float = field(
+        default_factory=lambda: max(
+            _env_float("SPARSE_TPU_HISTORY_INTERVAL", 1.0), 0.01
         )
     )
 

@@ -57,6 +57,12 @@ _DEDUP_HITS = _metrics.counter("ingest.dedup_hits")
 _FAILED = _metrics.counter("ingest.failed")
 _RETRIES = _metrics.counter("ingest.retries")
 _QUEUE_DEPTH = _metrics.gauge("ingest.queue_depth")
+# end-to-end onboarding latency (Axon v7 satellite), mirroring
+# batch.ticket_latency: submit -> terminal, labeled by terminal state
+_TICKET_LATENCY_HELP = (
+    "end-to-end ingest onboarding latency in seconds (submit -> "
+    "ready/failed)"
+)
 
 _ids = itertools.count(1)
 
@@ -74,11 +80,12 @@ class IngestTicket:
     """Future-style handle for one arrival moving through onboarding."""
 
     __slots__ = ("id", "source", "state", "dedup", "pattern", "csr",
-                 "error", "submitted_s", "wall_ms", "_event")
+                 "error", "submitted_s", "wall_ms", "tenant", "_event")
 
-    def __init__(self, source: str):
+    def __init__(self, source: str, tenant: str | None = None):
         self.id = f"g{next(_ids)}"
         self.source = source
+        self.tenant = tenant
         self.state = "queued"
         self.dedup: bool | None = None
         self.pattern = None
@@ -181,15 +188,17 @@ class Onboarder:
 
     # -- serving-side API ---------------------------------------------------
     def submit(self, source, *, bucket: int = 1, dtype=np.float64,
-               num_shards: int | None = None) -> IngestTicket:
+               num_shards: int | None = None,
+               tenant: str | None = None) -> IngestTicket:
         """Queue one arrival; returns its ticket immediately (admission
         permitting). ``bucket``/``dtype`` shape the prebuilt program a
-        cold pattern gets ahead of its first solve."""
+        cold pattern gets ahead of its first solve; ``tenant`` attributes
+        the onboarding work in the v7 ``usage.*`` metering families."""
         label = (
             os.fspath(source) if isinstance(source, (str, os.PathLike))
             else type(source).__name__
         )
-        ticket = IngestTicket(label)
+        ticket = IngestTicket(label, tenant=tenant)
         with self._cond:
             if self._closed:
                 raise IngestError("onboarder is closed")
@@ -264,11 +273,39 @@ class Onboarder:
                 self._active = 1
                 _QUEUE_DEPTH.set(len(self._queue))
             try:
-                self._process(*item)
+                # ticket-scope the whole onboarding so nested events
+                # (comm.sort, vault.store, plan_cache.*) carry the
+                # originating ingest ticket id, mirroring the solve path
+                with telemetry.ticket_scope(item[0].id):
+                    self._process(*item)
             finally:
                 with self._cond:
                     self._active = 0
                     self._cond.notify_all()
+
+    def _finalize(self, ticket, state: str) -> None:
+        """Terminal bookkeeping shared by ready/failed: stamp the
+        ticket, observe the always-on latency histogram, meter the
+        tenant's arrival and emit the ``ingest.ticket`` terminal event
+        (the ingest mirror of ``batch.ticket``)."""
+        ticket._finish(state)
+        labels = {"state": state}
+        if ticket.tenant:
+            labels["tenant"] = ticket.tenant
+        _metrics.histogram(
+            "ingest.ticket_latency", help=_TICKET_LATENCY_HELP, **labels
+        ).observe(ticket.wall_ms / 1e3)
+        _metrics.counter(
+            "usage.ingest",
+            help="ingest arrivals resolved, per tenant (v7 metering)",
+            tenant=ticket.tenant or "-", state=state,
+        ).inc()
+        if telemetry.enabled():
+            telemetry.record(
+                "ingest.ticket", ticket=ticket.id, state=state,
+                latency_ms=ticket.wall_ms,
+                **({"tenant": ticket.tenant} if ticket.tenant else {}),
+            )
 
     def _process(self, ticket, source, bucket, dtype, num_shards) -> None:
         last_err = None
@@ -297,7 +334,7 @@ class Onboarder:
         with self._cond:
             self._counts["failed"] += 1
         _FAILED.inc()
-        ticket._finish("failed")
+        self._finalize(ticket, "failed")
         if telemetry.enabled():
             telemetry.record(
                 "ingest.onboard", ticket=ticket.id, state="failed",
@@ -345,7 +382,7 @@ class Onboarder:
                 cvals, pattern.indices, pattern.indptr, pattern.shape
             )
             ticket.dedup = True
-            ticket._finish("ready")
+            self._finalize(ticket, "ready")
             if telemetry.enabled():
                 telemetry.record(
                     "ingest.onboard", ticket=ticket.id, state="ready",
@@ -384,7 +421,7 @@ class Onboarder:
         ticket.pattern = pattern
         ticket.csr = csr
         ticket.dedup = False
-        ticket._finish("ready")
+        self._finalize(ticket, "ready")
         if telemetry.enabled():
             telemetry.record(
                 "ingest.onboard", ticket=ticket.id, state="ready",

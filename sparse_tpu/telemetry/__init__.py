@@ -51,6 +51,21 @@ Surface
   host/device split ``batch/service.py`` records under
   ``SPARSE_TPU_PROFILE_EVERY`` (the measured ``device_ms`` column in
   ``axon_report``'s roofline table).
+* :mod:`history <._history>` / :func:`start_history` /
+  :func:`history_window` — the continuous-telemetry history store
+  (Axon v7): a daemon sampler scraping the always-on registry into
+  bounded in-memory rings (raw + 10x/60x min/max/mean/last rollups)
+  and atomic, byte-capped on-disk segments under
+  ``results/axon/history/``; off (zero overhead) unless
+  ``SPARSE_TPU_HISTORY`` is set. ``scripts/axon_dash.py`` renders the
+  segments; the exporter serves a live ``/dash``.
+* :mod:`budget <._budget>` — the SLO error-budget engine (Axon v7):
+  per-(tenant) windowed burn rates over the ticket-latency/SLO-miss
+  families, the multi-window burn-rate watchdog rules
+  (``slo_fast_burn`` pages on 5m/1h, ``slo_slow_burn`` warns on
+  6h/3d — replacing the v5 instantaneous ``slo_miss_rate`` in
+  :func:`~._watchdog.default_rules`), the per-tenant ``usage.*``
+  metering rollup and the exporter's ``/budget`` payload.
 * :func:`ticket_scope` / :func:`new_ticket_id` /
   :func:`current_tickets` — request-scoped trace context
   (:mod:`._context`): events recorded inside a scope carry the
@@ -82,8 +97,10 @@ cache has counted that way since PR 2).
 
 from __future__ import annotations
 
+from . import _budget as budget  # noqa: F401
 from . import _cost as cost  # noqa: F401
 from . import _health as health  # noqa: F401
+from . import _history as history  # noqa: F401
 from . import _metrics as metrics  # noqa: F401
 from . import _schema as schema  # noqa: F401
 from ._context import (  # noqa: F401
@@ -117,6 +134,11 @@ from ._flight import (  # noqa: F401
     stop_flight,
 )
 from ._flight import state as flight_state  # noqa: F401
+from ._history import Sampler  # noqa: F401
+from ._history import start as start_history  # noqa: F401
+from ._history import stop as stop_history  # noqa: F401
+from ._history import state as history_state  # noqa: F401
+from ._history import window as history_window  # noqa: F401
 from ._profiler import capture_trace as profile_capture  # noqa: F401
 from ._serve import AxonServer, serve, serving, stop_serving  # noqa: F401
 from ._spans import Span, device_sync, span  # noqa: F401
@@ -150,6 +172,13 @@ __all__ = [
     "add_bytes",
     "add_span",
     "AxonServer",
+    "budget",
+    "history",
+    "history_state",
+    "history_window",
+    "Sampler",
+    "start_history",
+    "stop_history",
     "bytes_by_kind",
     "capture_now",
     "configure",

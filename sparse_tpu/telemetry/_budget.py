@@ -1,0 +1,352 @@
+"""SLO error-budget engine (Axon v7): multi-window burn-rate alerting.
+
+The v5 watchdog's ``slo_miss_rate`` rule is an instantaneous-window
+threshold: one bad tick's worth of tickets can page, and a slow leak
+that never crosses the per-window threshold never does. This module
+replaces it with the SRE error-budget formulation: a serving
+**objective** (e.g. "99% of tickets within the session's slo_ms")
+allots an error budget (1 - objective); the **burn rate** over a window
+is how many times faster than allotted that budget is being consumed::
+
+    burn(W) = miss_rate(W) / (1 - objective)
+
+Stock rules follow the standard multi-window pairing — a rule fires
+only when BOTH its short and long window burn past the threshold (the
+short window makes it responsive, the long window blip-proof):
+
+* ``slo_fast_burn`` — 5 m & 1 h windows, burn > 14.4 (2% of a 30-day
+  budget in one hour), severity ``page``.
+* ``slo_slow_burn`` — 6 h & 3 d windows, burn > 1.0 (budget-neutral
+  line), severity ``warn``.
+
+Per-tenant evaluation (the v7 watchdog satellite): each rule's value is
+the WORST (tenant, window-pair) burn — a single tenant's breach can no
+longer hide inside a healthy aggregate. The aggregate rides the
+``batch.slo_misses`` / ``batch.ticket_latency`` families; per-tenant
+numbers ride the v7 ``usage.tickets{tenant}`` /
+``usage.slo_misses{tenant}`` metering counters (``batch/service.py``).
+
+The :class:`Engine` keeps its own bounded sample ring (one cumulative
+(miss, total) snapshot per tenant per evaluation) so burn windows work
+with or without the history store; windows shorter than the available
+ring use the partial window (a fresh process alerts on what it can
+see rather than staying blind for 5 minutes). ``budget.burn`` events
+(rate-limited per rule+tenant) record breaches into the session log;
+``/budget`` on ``telemetry.serve()`` serves :func:`state`.
+
+Zero new overhead on the serving path: the engine only READS registry
+values, sampling happens inside watchdog evaluation (or on demand), and
+no budget object exists until a rule or ``state()`` asks for one.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+import time
+
+from . import _metrics, _recorder
+from ._watchdog import Rule
+
+_LOCK = threading.Lock()
+_ENGINE = None
+
+#: default serving objective: 99% of tickets inside the SLO
+DEFAULT_OBJECTIVE = 0.99
+#: stock window geometry (seconds) and thresholds (burn multiples)
+FAST_WINDOWS = (300.0, 3600.0)
+SLOW_WINDOWS = (21600.0, 259200.0)
+FAST_BURN = 14.4
+SLOW_BURN = 1.0
+#: engine sample ring depth (at 1 Hz evaluation ~ 4.5 h of lookback)
+RING_DEPTH = 16384
+#: min seconds between budget.burn events per (rule, tenant)
+EVENT_INTERVAL_S = 30.0
+
+#: the aggregate pseudo-tenant label
+AGGREGATE = ""
+
+
+def _read_counts() -> dict:
+    """Cumulative ``{tenant: (misses, total)}`` from the always-on
+    registry. ``""`` is the aggregate over every ticket; named tenants
+    come from the v7 usage metering families (only tickets submitted
+    with a tenant label appear there)."""
+    total = sum(h.count for h in _metrics.family("batch.ticket_latency"))
+    miss = _metrics.counter("batch.slo_misses").value
+    counts = {AGGREGATE: [float(miss), float(total)]}
+    for m in _metrics.family("usage.tickets"):
+        tenant = m.labels.get("tenant")
+        if not tenant or tenant == "-":
+            continue
+        c = counts.setdefault(tenant, [0.0, 0.0])
+        c[1] += float(m.value)
+    for m in _metrics.family("usage.slo_misses"):
+        tenant = m.labels.get("tenant")
+        if not tenant or tenant == "-":
+            continue
+        c = counts.setdefault(tenant, [0.0, 0.0])
+        c[0] += float(m.value)
+    return {t: (c[0], c[1]) for t, c in counts.items()}
+
+
+class Engine:
+    """Windowed burn-rate math over a bounded ring of cumulative
+    samples. ``sample(now)`` appends one reading; ``burn(window_s,
+    now)`` returns ``{tenant: burn}`` for every tenant with traffic in
+    the window. ``now`` and the count reader are injectable (tests
+    drive hand-computed fixtures through both)."""
+
+    def __init__(self, objective: float = DEFAULT_OBJECTIVE,
+                 read_counts=_read_counts, ring_depth: int = RING_DEPTH):
+        if not 0.0 < objective < 1.0:
+            raise ValueError(f"objective must be in (0, 1), got {objective}")
+        self.objective = float(objective)
+        self.budget_rate = 1.0 - self.objective
+        self._read = read_counts
+        self._ring: collections.deque = collections.deque(maxlen=ring_depth)
+        self._lock = threading.Lock()
+        self._last_event: dict = {}
+
+    def sample(self, now: float | None = None) -> None:
+        """Append one cumulative snapshot (at most one per distinct
+        ``now`` — rules sharing the engine in one tick don't double-
+        sample)."""
+        now = time.monotonic() if now is None else float(now)
+        counts = self._read()
+        with self._lock:
+            if self._ring and self._ring[-1][0] >= now:
+                return
+            self._ring.append((now, counts))
+
+    def burn(self, window_s: float, now: float | None = None) -> dict:
+        """Per-tenant burn rate over the trailing ``window_s``: the
+        miss-rate delta between now's sample and the oldest sample
+        inside the window (or the ring's oldest — partial windows are
+        legal), divided by the budget rate. Tenants whose ticket count
+        didn't move in the window are omitted (idle ≠ healthy ≠
+        burning)."""
+        now = time.monotonic() if now is None else float(now)
+        with self._lock:
+            if len(self._ring) < 2:
+                return {}
+            newest = self._ring[-1]
+            cutoff = now - float(window_s)
+            base = self._ring[0]
+            for s in self._ring:
+                if s[0] >= cutoff:
+                    break
+                base = s
+        out = {}
+        for tenant, (m1, t1) in newest[1].items():
+            m0, t0 = base[1].get(tenant, (0.0, 0.0))
+            dt = t1 - t0
+            if dt <= 0:
+                continue
+            out[tenant] = ((m1 - m0) / dt) / self.budget_rate
+        return out
+
+    def worst_burn(self, windows, now: float | None = None):
+        """The multi-window reading: per tenant, the MIN burn across the
+        window pair (both must breach for the pair to read high);
+        returns ``(burn, tenant)`` for the worst tenant, or ``(None,
+        None)`` when no tenant had traffic in every window."""
+        now = time.monotonic() if now is None else float(now)
+        per: dict = {}
+        for w in windows:
+            for tenant, b in self.burn(w, now=now).items():
+                per.setdefault(tenant, []).append(b)
+        worst, who = None, None
+        nwin = len(tuple(windows))
+        for tenant, bs in per.items():
+            if len(bs) < nwin:
+                continue
+            b = min(bs)
+            if worst is None or b > worst:
+                worst, who = b, tenant
+        return worst, who
+
+    def report(self, now: float | None = None) -> dict:
+        """The ``/budget`` payload body: per-window per-tenant burns
+        plus budget-remaining arithmetic over the ring's span."""
+        now = time.monotonic() if now is None else float(now)
+        self.sample(now)
+        windows = {}
+        for w in sorted(set(FAST_WINDOWS + SLOW_WINDOWS)):
+            windows[str(int(w))] = {
+                t: round(b, 4) for t, b in self.burn(w, now=now).items()
+            }
+        with self._lock:
+            span = (
+                self._ring[-1][0] - self._ring[0][0]
+                if len(self._ring) > 1 else 0.0
+            )
+            counts = dict(self._ring[-1][1]) if self._ring else {}
+        tenants = {}
+        for t, (m, n) in counts.items():
+            allowed = n * self.budget_rate
+            tenants[t or "aggregate"] = {
+                "tickets": int(n),
+                "slo_misses": int(m),
+                "budget_allowed": round(allowed, 3),
+                "budget_remaining": round(allowed - m, 3),
+            }
+        return {
+            "objective": self.objective,
+            "budget_rate": round(self.budget_rate, 6),
+            "span_s": round(span, 3),
+            "samples": len(self._ring),
+            "burn": windows,
+            "tenants": tenants,
+        }
+
+    def total_tickets(self) -> float:
+        """Aggregate cumulative ticket count at the newest sample."""
+        with self._lock:
+            if not self._ring:
+                return 0.0
+            return self._ring[-1][1].get(AGGREGATE, (0.0, 0.0))[1]
+
+    def _maybe_event(self, rule: str, tenant, burn: float,
+                    windows) -> None:
+        """Rate-limited ``budget.burn`` breadcrumb into the session log
+        (telemetry on): WHEN the budget started burning, per tenant."""
+        key = (rule, tenant)
+        now = time.monotonic()
+        last = self._last_event.get(key)
+        if last is not None and now - last < EVENT_INTERVAL_S:
+            return
+        self._last_event[key] = now
+        _recorder.record(
+            "budget.burn", rule=rule, tenant=tenant or "aggregate",
+            burn=round(burn, 4), windows=[int(w) for w in windows],
+            objective=self.objective,
+        )
+
+
+# ---------------------------------------------------------------------------
+# the stock burn-rate rules (what default_rules() installs)
+# ---------------------------------------------------------------------------
+def burn_rule(name: str, windows, trigger: float, *,
+              clear: float | None = None, severity: str = "warn",
+              engine: Engine | None = None, min_tickets: int = 1,
+              **kw) -> Rule:
+    """A multi-window burn-rate :class:`Rule`: fires when the worst
+    (tenant, window-pair) burn exceeds ``trigger`` in EVERY window of
+    ``windows``. ``engine`` defaults to the process engine; explicit
+    ``windows`` let chaos drills compress 5m/1h geometry into
+    seconds."""
+    windows = tuple(float(w) for w in windows)
+    clear = trigger / 2.0 if clear is None else float(clear)
+
+    def value():
+        eng = engine if engine is not None else _engine()
+        eng.sample()
+        if eng.total_tickets() < min_tickets:
+            return None
+        burn, tenant = eng.worst_burn(windows)
+        if burn is None:
+            return None
+        if burn > trigger:
+            eng._maybe_event(name, tenant, burn, windows)
+        return burn
+
+    return Rule(name, value, trigger, clear=clear, op=">",
+                severity=severity, **kw)
+
+
+def fast_burn_rule(windows=FAST_WINDOWS, trigger: float = FAST_BURN,
+                   severity: str = "page", **kw) -> Rule:
+    """The paging rule: short/long = 5 m / 1 h, burn > 14.4 — a fast
+    leak that would exhaust a 30-day budget's 2% within the hour."""
+    return burn_rule("slo_fast_burn", windows, trigger,
+                     severity=severity, **kw)
+
+
+def slow_burn_rule(windows=SLOW_WINDOWS, trigger: float = SLOW_BURN,
+                   severity: str = "warn", **kw) -> Rule:
+    """The ticket-queue rule: short/long = 6 h / 3 d, burn > 1.0 — the
+    budget is being consumed faster than allotted, sustained."""
+    return burn_rule("slo_slow_burn", windows, trigger,
+                     severity=severity, **kw)
+
+
+def default_rules(engine: Engine | None = None) -> list:
+    """The stock budget rule pair (what ``_watchdog.default_rules``
+    installs in place of the v5 instantaneous ``slo_miss_rate``)."""
+    return [
+        fast_burn_rule(engine=engine),
+        slow_burn_rule(engine=engine),
+    ]
+
+
+# ---------------------------------------------------------------------------
+# usage metering readback (batch/service.py writes the families)
+# ---------------------------------------------------------------------------
+def usage_stats() -> dict:
+    """Per-tenant rollup of the always-on ``usage.*`` families — the
+    ``session_stats()['usage']`` block and part of ``/budget``. Empty
+    dict when nothing was metered (pre-v7 consumers see no new key)."""
+    tenants: dict = {}
+
+    def _acc(metric_name, field, count_attr="value"):
+        for m in _metrics.family(metric_name):
+            tenant = m.labels.get("tenant", "-") or "-"
+            row = tenants.setdefault(tenant, {})
+            row[field] = row.get(field, 0) + getattr(m, count_attr)
+
+    _acc("usage.tickets", "tickets")
+    _acc("usage.slo_misses", "slo_misses")
+    _acc("usage.lanes", "lanes")
+    _acc("usage.device_ms", "device_ms")
+    _acc("usage.collective_bytes", "collective_bytes")
+    _acc("usage.ingest", "ingest")
+    for row in tenants.values():
+        if "device_ms" in row:
+            row["device_ms"] = round(row["device_ms"], 3)
+    return tenants
+
+
+# ---------------------------------------------------------------------------
+# the process singleton (what /budget serves)
+# ---------------------------------------------------------------------------
+def _engine() -> Engine:
+    global _ENGINE
+    with _LOCK:
+        if _ENGINE is None:
+            _ENGINE = Engine()
+        return _ENGINE
+
+
+def engine(objective: float | None = None) -> Engine:
+    """Get-or-create the process budget engine. An existing engine is
+    returned as-is; pass ``objective`` before first use to change it."""
+    global _ENGINE
+    with _LOCK:
+        if _ENGINE is None:
+            _ENGINE = Engine(
+                objective=DEFAULT_OBJECTIVE if objective is None
+                else objective
+            )
+        return _ENGINE
+
+
+def reset() -> None:
+    """Drop the process engine (tests; a fresh engine re-reads the
+    registry from its current cumulative values)."""
+    global _ENGINE
+    with _LOCK:
+        _ENGINE = None
+
+
+def state() -> dict:
+    """The ``/budget`` payload: engine report + usage metering rollup
+    (a disabled-shaped stub when no engine has ever been touched —
+    reading must not allocate one on a box that never served)."""
+    eng = _ENGINE
+    usage = usage_stats()
+    if eng is None:
+        return {"enabled": False, "usage": usage}
+    out = {"enabled": True, "usage": usage}
+    out.update(eng.report())
+    return out

@@ -31,6 +31,11 @@ offered to the recorder (the ``_ALERT_HOOKS`` hook point in
 ``trace.json``
     a Perfetto trace slice of the ring tail (``telemetry.export_trace``)
     — the per-ticket waterfalls of the requests in flight at breach.
+``history.json``
+    when the v7 history sampler (:mod:`._history`) is running: the last
+    ten minutes of metric time series (raw + rollups) ending at the
+    breach — how ``axon_doctor`` says when a regression *started*, not
+    just that it fired.
 ``profile/`` (on-demand captures only)
     a ``jax.profiler`` trace of a short live window (:mod:`._profiler`).
 
@@ -208,6 +213,7 @@ class FlightRecorder:
         self._write_ring(path, tail)
         self._write_metrics(path)
         self._write_trace(path, tail)
+        self._write_history(path)
         profile_info = None
         if profile:
             from . import _profiler
@@ -281,6 +287,32 @@ class FlightRecorder:
 
             _trace.export_trace(os.path.join(path, "trace.json"),
                                 events=tail)
+        except Exception:
+            pass
+
+    def _write_history(self, path: str) -> None:
+        """The pre-incident time-series window: when the v7 history
+        sampler is live, embed its last ten minutes (raw + rollups) so a
+        bundle shows when the regression started, not just that it
+        fired. Absent sampler -> absent file (no stub)."""
+        try:
+            from . import _history
+
+            sampler = _history.current()
+            if sampler is None:
+                return
+            sampler.flush()
+            points = sampler.window(seconds=600.0)
+            payload = {
+                "schema": 1,
+                "interval_s": sampler.interval_s,
+                "window_s": 600.0,
+                "points": points,
+                "state": sampler.state(),
+            }
+            with open(os.path.join(path, "history.json"), "w") as f:
+                json.dump(payload, f, default=str)
+                f.write("\n")
         except Exception:
             pass
 

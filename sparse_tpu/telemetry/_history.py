@@ -1,0 +1,537 @@
+"""Time-series history store (Axon v7): the metrics registry over time.
+
+Every other Axon surface observes an *instant* — ``metrics_text()`` is a
+live snapshot, the watchdog fires on current values, ``axon_report``
+reads one session log. This module adds the time dimension: a
+low-overhead daemon :class:`Sampler` periodically scrapes the always-on
+registry (:func:`._metrics.snapshot`) into
+
+* **bounded in-memory rings** at three resolutions — raw samples at the
+  scrape interval plus 10x and 60x rollups carrying per-series
+  ``[min, max, mean, last]`` — the windows ``/dash``, the flight
+  recorder and the budget engine read without touching disk; and
+* **append-only on-disk segments** under ``results/axon/history/`` —
+  each committed segment is written ATOMICALLY (per-process tmp name +
+  fsync + ``os.replace``, the vault's idiom) so a crash can tear at
+  most the not-yet-committed tail of the active segment, never a
+  committed file. Loading is verify-then-load: a segment whose header
+  is missing/alien is moved into ``quarantine/`` and skipped
+  (degrade, don't die); a torn trailing line is dropped and the valid
+  prefix kept. Retention is byte-capped: rotation-time GC deletes
+  oldest segments past ``history_cap_mb``.
+
+Zero overhead when off (the default): :func:`maybe_start` is a single
+``settings.history`` attribute check, no thread exists, nothing touches
+the filesystem, and program keys / jaxprs / host-sync counts are
+byte-identical (pinned by ``tests/test_history.py``). The sampler never
+runs on a serving thread — scraping happens on its own daemon thread,
+reads registry values under the registry lock only, and touches no
+device.
+
+Segment format (version 1): JSONL. Line 1 is the header::
+
+    {"kind": "history.segment", "format": 1, "session": ..., "epoch": ...,
+     "interval_s": ...}
+
+Every following line is one point::
+
+    {"t": <epoch seconds>, "r": 0,  "s": {"<name{labels}>": <value>, ...}}
+    {"t": <bucket start>,  "r": 10, "s": {"<name>": [min, max, mean, last]}}
+
+Histogram series flatten into ``<name>:count`` / ``<name>:sum`` scalar
+series so every stored value is a number. ``r`` is the rollup factor in
+sampler intervals (0 = raw). Restart join: segments are named
+``seg-<epoch_ms>-<seq>.jsonl`` so a lexicographic sort is chronological
+across sessions; :func:`read_segments` joins them (``axon_report
+--history`` and ``scripts/axon_dash.py`` are the consumers).
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import os
+import threading
+import time
+
+from ..config import settings
+from . import _metrics, _recorder
+
+_LOCK = threading.Lock()
+_SAMPLER = None
+
+#: segment format version (bump on incompatible layout changes)
+FORMAT = 1
+#: truthy spellings of SPARSE_TPU_HISTORY selecting the default root
+_TRUTHY = ("1", "true", "yes", "on")
+#: default root: results/axon/history next to the repo root
+_DEFAULT_ROOT = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+    "results",
+    "axon",
+    "history",
+)
+#: committed-segment size target: the active buffer rotates past this
+SEGMENT_MAX_BYTES = 256 * 1024
+#: atomic checkpoint cadence: the active segment is re-committed every
+#: N points, so a crash loses at most N samples
+CHECKPOINT_EVERY = 10
+#: in-memory ring depths per resolution (raw keeps ~10 min at 1 s)
+RING_DEPTH = {0: 600, 10: 360, 60: 240}
+#: rollup factors (in sampler intervals)
+ROLLUPS = (10, 60)
+
+
+def root_from_settings() -> str | None:
+    """The history root implied by settings, or ``None`` when off:
+    ``SPARSE_TPU_HISTORY`` is either a truthy spelling (default root) or
+    itself a directory path; ``SPARSE_TPU_HISTORY_DIR`` wins."""
+    v = (settings.history or "").strip()
+    if not v:
+        return None
+    override = (settings.history_dir or "").strip()
+    if override:
+        return override
+    if v.lower() in _TRUTHY:
+        return _DEFAULT_ROOT
+    return v
+
+
+def flatten(snap: dict) -> dict:
+    """Flatten a :func:`._metrics.snapshot` into all-scalar series:
+    histogram entries become ``<key>:count`` / ``<key>:sum``."""
+    out = {}
+    for k, v in snap.items():
+        if isinstance(v, dict):
+            out[k + ":count"] = v.get("count", 0)
+            out[k + ":sum"] = v.get("sum", 0.0)
+        elif isinstance(v, (int, float)) and not isinstance(v, bool):
+            out[k] = v
+    return out
+
+
+class _Bucket:
+    """One open rollup bucket: per-series [min, max, sum, n, last]."""
+
+    __slots__ = ("start", "series")
+
+    def __init__(self, start: float):
+        self.start = start
+        self.series: dict = {}
+
+    def add(self, flat: dict) -> None:
+        for k, v in flat.items():
+            s = self.series.get(k)
+            if s is None:
+                self.series[k] = [v, v, v, 1, v]
+            else:
+                if v < s[0]:
+                    s[0] = v
+                if v > s[1]:
+                    s[1] = v
+                s[2] += v
+                s[3] += 1
+                s[4] = v
+
+    def point(self, r: int) -> dict:
+        return {
+            "t": round(self.start, 3),
+            "r": r,
+            "s": {
+                k: [s[0], s[1], round(s[2] / s[3], 9), s[4]]
+                for k, s in self.series.items()
+            },
+        }
+
+
+class Sampler:
+    """The history sampler: scrape thread + rings + segment writer.
+
+    Construct via :func:`start` (the module singleton) or directly in
+    tests; ``observe(now, flat)`` is the deterministic test seam the
+    thread's ``_sample_once`` also goes through."""
+
+    def __init__(self, root: str, interval_s: float | None = None,
+                 cap_mb: int | None = None,
+                 segment_max_bytes: int = SEGMENT_MAX_BYTES):
+        self.root = str(root)
+        self.interval_s = float(
+            interval_s if interval_s is not None else settings.history_interval
+        )
+        self.cap_bytes = int(
+            (cap_mb if cap_mb is not None else settings.history_cap_mb)
+            * 1024 * 1024
+        )
+        self.segment_max_bytes = int(segment_max_bytes)
+        self.session = _recorder.session_info()["session"]
+        self._lock = threading.RLock()
+        self._rings: dict = {
+            res: _ring_deque(depth) for res, depth in RING_DEPTH.items()
+        }
+        self._buckets: dict = {}  # rollup factor -> open _Bucket
+        # the active segment: header + committed-so-far point lines,
+        # re-written atomically every CHECKPOINT_EVERY points
+        self._seq = 0
+        self._seg_lines: list = []
+        self._seg_bytes = 0
+        self._seg_path = None
+        self._uncheckpointed = 0
+        # stats (the /dash + state() surface)
+        self.samples = 0
+        self.rotations = 0
+        self.gc_evicted = 0
+        self.write_errors = 0
+        self._thread = None
+        self._stop = threading.Event()
+        self._open_segment()
+
+    # -- segment lifecycle -------------------------------------------------
+    def _header(self) -> dict:
+        return {
+            "kind": "history.segment",
+            "format": FORMAT,
+            "session": self.session,
+            "epoch": round(time.time(), 3),
+            "interval_s": self.interval_s,
+        }
+
+    def _open_segment(self) -> None:
+        self._seq += 1
+        stamp = int(time.time() * 1000)
+        self._seg_path = os.path.join(
+            self.root, f"seg-{stamp:013d}-{self._seq:04d}.jsonl"
+        )
+        hdr = json.dumps(self._header())
+        self._seg_lines = [hdr]
+        self._seg_bytes = len(hdr) + 1
+        self._uncheckpointed = 0
+
+    def _commit(self) -> None:
+        """Atomically (re)write the active segment: tmp + fsync +
+        os.replace — a crash mid-commit leaves the previous committed
+        content intact, never a torn file."""
+        path = self._seg_path
+        try:
+            os.makedirs(self.root, exist_ok=True)
+            tmp = f"{path}.tmp.{os.getpid()}"
+            with open(tmp, "w") as f:
+                f.write("\n".join(self._seg_lines) + "\n")
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, path)
+            self._uncheckpointed = 0
+        except OSError:
+            self.write_errors += 1
+
+    def _rotate(self) -> None:
+        self._commit()
+        self.rotations += 1
+        self._gc()
+        self._open_segment()
+
+    def _gc(self) -> None:
+        """Byte-capped retention: delete oldest committed segments past
+        the budget (name sort is chronological by construction)."""
+        try:
+            segs = []
+            for f in sorted(os.listdir(self.root)):
+                if not (f.startswith("seg-") and f.endswith(".jsonl")):
+                    continue
+                path = os.path.join(self.root, f)
+                try:
+                    segs.append((path, os.path.getsize(path)))
+                except OSError:
+                    pass
+            total = sum(sz for _, sz in segs)
+            for path, sz in segs:
+                if total <= self.cap_bytes:
+                    break
+                if path == self._seg_path:
+                    continue  # never evict the active segment
+                try:
+                    os.remove(path)
+                    total -= sz
+                    self.gc_evicted += 1
+                except OSError:
+                    pass
+        except OSError:
+            pass
+
+    def _append_point(self, point: dict) -> None:
+        line = json.dumps(point)
+        self._seg_lines.append(line)
+        self._seg_bytes += len(line) + 1
+        self._uncheckpointed += 1
+        if self._seg_bytes >= self.segment_max_bytes:
+            self._rotate()
+        elif self._uncheckpointed >= CHECKPOINT_EVERY:
+            self._commit()
+
+    # -- sampling ----------------------------------------------------------
+    def observe(self, now: float, flat: dict) -> None:
+        """Ingest one flattened sample at wall-clock ``now`` — the
+        deterministic seam the scrape thread and tests share."""
+        with self._lock:
+            self.samples += 1
+            raw = {"t": round(now, 3), "r": 0, "s": flat}
+            self._rings[0].append(raw)
+            self._append_point(raw)
+            for r in ROLLUPS:
+                width = r * self.interval_s
+                start = (now // width) * width
+                bkt = self._buckets.get(r)
+                if bkt is not None and bkt.start != start:
+                    pt = bkt.point(r)
+                    self._rings[r].append(pt)
+                    self._append_point(pt)
+                    bkt = None
+                if bkt is None:
+                    bkt = self._buckets[r] = _Bucket(start)
+                bkt.add(flat)
+
+    def _sample_once(self) -> None:
+        self.observe(time.time(), flatten(_metrics.snapshot()))
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            try:
+                self._sample_once()
+            except Exception:  # noqa: BLE001 - the scrape must survive
+                pass
+
+    def start(self) -> "Sampler":
+        """Begin scraping on a daemon thread (idempotent)."""
+        with self._lock:
+            if self._thread is not None and self._thread.is_alive():
+                return self
+            self._stop.clear()
+            self._thread = threading.Thread(
+                target=self._loop, name="sparse-tpu-axon-history",
+                daemon=True,
+            )
+            self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        """Stop the thread, flush open rollup buckets and commit the
+        active segment."""
+        self._stop.set()
+        t = self._thread
+        if t is not None:
+            t.join(timeout=5)
+        self._thread = None
+        with self._lock:
+            for r in ROLLUPS:
+                bkt = self._buckets.pop(r, None)
+                if bkt is not None and bkt.series:
+                    pt = bkt.point(r)
+                    self._rings[r].append(pt)
+                    line = json.dumps(pt)
+                    self._seg_lines.append(line)
+                    self._seg_bytes += len(line) + 1
+            self._commit()
+
+    def flush(self) -> None:
+        """Commit the active segment now (the flight recorder calls this
+        before embedding a window so the disk view is current)."""
+        with self._lock:
+            self._commit()
+
+    # -- views -------------------------------------------------------------
+    def window(self, seconds: float = 300.0, res: int = 0) -> list:
+        """Recent in-memory points at resolution ``res`` (a rollup
+        factor: 0 raw, 10, 60) covering the last ``seconds``."""
+        cutoff = time.time() - float(seconds)
+        with self._lock:
+            ring = self._rings.get(int(res))
+            if ring is None:
+                return []
+            return [p for p in ring if p["t"] >= cutoff]
+
+    def state(self) -> dict:
+        with self._lock:
+            return {
+                "enabled": True,
+                "running": bool(self._thread and self._thread.is_alive()),
+                "root": self.root,
+                "interval_s": self.interval_s,
+                "cap_mb": round(self.cap_bytes / (1024 * 1024), 3),
+                "session": self.session,
+                "samples": self.samples,
+                "rotations": self.rotations,
+                "gc_evicted": self.gc_evicted,
+                "write_errors": self.write_errors,
+                "ring_depths": {
+                    str(r): len(ring) for r, ring in self._rings.items()
+                },
+            }
+
+
+def _ring_deque(depth: int):
+    return collections.deque(maxlen=depth)
+
+
+# ---------------------------------------------------------------------------
+# reading committed segments back (verify-then-load; restart join)
+# ---------------------------------------------------------------------------
+def _quarantine(root: str, fname: str) -> None:
+    """Move an unverifiable segment aside (degrade, don't die) and count
+    it on the always-on registry."""
+    try:
+        qdir = os.path.join(root, "quarantine")
+        os.makedirs(qdir, exist_ok=True)
+        os.replace(
+            os.path.join(root, fname), os.path.join(qdir, fname)
+        )
+    except OSError:
+        pass
+    _metrics.counter(
+        "history.quarantined",
+        help="history segments that failed verify-then-load and were "
+        "moved into quarantine/",
+    ).inc()
+
+
+def read_segments(root: str | None = None, res: int | None = None) -> list:
+    """Join every committed segment under ``root`` into one time-ordered
+    point list (the restart-join read: segments from prior sessions sort
+    before the current one by name). Each point gains a ``session``
+    field from its segment header.
+
+    Verify-then-load: a segment whose first line is not a format-1
+    ``history.segment`` header is quarantined and skipped; an
+    undecodable point line ends that segment's read (torn tail — the
+    valid prefix is kept). ``res`` filters to one resolution."""
+    root = root or root_from_settings() or _DEFAULT_ROOT
+    points: list = []
+    try:
+        segs = sorted(
+            f for f in os.listdir(root)
+            if f.startswith("seg-") and f.endswith(".jsonl")
+        )
+    except OSError:
+        return points
+    for fname in segs:
+        try:
+            with open(os.path.join(root, fname)) as f:
+                lines = f.read().splitlines()
+        except OSError:
+            continue
+        try:
+            hdr = json.loads(lines[0]) if lines else None
+        except (json.JSONDecodeError, ValueError):
+            hdr = None
+        if (
+            not isinstance(hdr, dict)
+            or hdr.get("kind") != "history.segment"
+            or hdr.get("format") != FORMAT
+        ):
+            _quarantine(root, fname)
+            continue
+        session = hdr.get("session")
+        for line in lines[1:]:
+            if not line.strip():
+                continue
+            try:
+                p = json.loads(line)
+            except json.JSONDecodeError:
+                _metrics.counter(
+                    "history.truncated",
+                    help="history segments whose tail was torn; the "
+                    "valid prefix was kept",
+                ).inc()
+                break  # torn tail: keep the prefix, drop the rest
+            if not isinstance(p, dict) or "t" not in p:
+                break
+            if res is not None and p.get("r", 0) != res:
+                continue
+            p["session"] = session
+            points.append(p)
+    points.sort(key=lambda p: (p["t"], p.get("r", 0)))
+    return points
+
+
+def segments_state(root: str | None = None) -> dict:
+    """On-disk listing for tooling: segment names, sizes, sessions."""
+    root = root or root_from_settings() or _DEFAULT_ROOT
+    segs = []
+    try:
+        names = sorted(
+            f for f in os.listdir(root)
+            if f.startswith("seg-") and f.endswith(".jsonl")
+        )
+    except OSError:
+        names = []
+    for f in names:
+        path = os.path.join(root, f)
+        try:
+            segs.append({"name": f, "bytes": os.path.getsize(path)})
+        except OSError:
+            pass
+    return {"root": root, "segments": segs,
+            "total_bytes": sum(s["bytes"] for s in segs)}
+
+
+# ---------------------------------------------------------------------------
+# the process singleton
+# ---------------------------------------------------------------------------
+def enabled() -> bool:
+    """True when the settings gate is on (one attribute check — the
+    zero-overhead discipline's whole cost on the disabled path)."""
+    return bool(settings.history)
+
+
+def current() -> Sampler | None:
+    """The live sampler, or ``None``."""
+    return _SAMPLER
+
+
+def start(root: str | None = None, interval_s: float | None = None,
+          cap_mb: int | None = None) -> Sampler:
+    """Get-or-create the process sampler and begin scraping. Explicit
+    arguments win over settings (tests, bench's overhead probe)."""
+    global _SAMPLER
+    with _LOCK:
+        if _SAMPLER is None:
+            _SAMPLER = Sampler(
+                root or root_from_settings() or _DEFAULT_ROOT,
+                interval_s=interval_s, cap_mb=cap_mb,
+            )
+        return _SAMPLER.start()
+
+
+def maybe_start() -> Sampler | None:
+    """Start the sampler iff the settings gate is on — the serving
+    path's auto-enable hook (``SolveSession.__init__``). One attribute
+    check when off."""
+    if not settings.history:
+        return None
+    return start()
+
+
+def stop() -> None:
+    """Stop and drop the process sampler (idempotent); flushes the
+    active segment."""
+    global _SAMPLER
+    with _LOCK:
+        smp, _SAMPLER = _SAMPLER, None
+    if smp is not None:
+        smp.stop()
+
+
+def state() -> dict:
+    """The sampler's diagnostics (the ``/dash`` JSON block), or a
+    disabled stub."""
+    smp = _SAMPLER
+    if smp is None:
+        return {"enabled": False, "running": False}
+    return smp.state()
+
+
+def window(seconds: float = 300.0, res: int = 0) -> list:
+    """Recent in-memory points from the live sampler (empty when off) —
+    what the flight recorder embeds and ``/dash`` renders."""
+    smp = _SAMPLER
+    if smp is None:
+        return []
+    return smp.window(seconds=seconds, res=res)

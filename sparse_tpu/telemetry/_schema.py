@@ -211,6 +211,19 @@ KINDS: dict[str, frozenset] = {
     # failed, the bounded worker goes again), 'ready' (terminal ok) or
     # 'failed' (terminal, after retries); wall_ms measures from arrival
     "ingest.onboard": frozenset({"ticket", "state", "wall_ms"}),
+    # the per-arrival TERMINAL event (Axon v7 satellite), mirroring
+    # batch.ticket: one per submitted arrival at resolution, carrying
+    # the final state ('ready' | 'failed'), the end-to-end onboarding
+    # latency and — tenant-tagged arrivals — the tenant label. The
+    # always-on ingest.ticket_latency{state} histogram carries the same
+    # latencies.
+    "ingest.ticket": frozenset({"ticket", "state", "latency_ms"}),
+    # -- SLO error budgets (telemetry/_budget.py, Axon v7) ------------------
+    # a burn-rate rule's window pair read past its trigger for a tenant
+    # ('aggregate' = every ticket): rate-limited breadcrumb recording
+    # WHEN the budget started burning (the watchdog.alert that may
+    # follow carries the hysteresis-filtered transition)
+    "budget.burn": frozenset({"rule", "tenant", "burn"}),
     # -- generic ------------------------------------------------------------
     # one per process per sink file, written before the first event: the
     # controller's identity (process_index/pid/process_count, device

@@ -23,6 +23,13 @@ serving operator scrapes:
   capture/suppression counts plus the on-disk bundle listing
   (``scripts/axon_doctor.py`` analyzes a bundle). A disabled stub
   (which still lists pre-existing bundles) when capture is off.
+* ``/budget`` — the SLO error-budget engine's state (:mod:`._budget`,
+  Axon v7): per-window per-tenant burn rates, budget-remaining
+  arithmetic and the per-tenant usage metering rollup.
+* ``/dash`` — a self-refreshing HTML sparkline board over the history
+  sampler's in-memory rings (:mod:`._history`, Axon v7); a disabled
+  stub when no sampler is live. ``scripts/axon_dash.py`` is the
+  terminal rendering of the same data from on-disk segments.
 * ``/debug/capture`` — ISSUE 12: trigger an on-demand postmortem bundle
   including a short ``jax.profiler`` trace window (:mod:`._profiler`);
   responds with the bundle name (or the rate-limit refusal). The only
@@ -49,7 +56,7 @@ import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
-from . import _flight, _health, _metrics, _recorder, _watchdog
+from . import _budget, _flight, _health, _history, _metrics, _recorder, _watchdog
 
 _LOCK = threading.Lock()
 _SERVER = None
@@ -179,6 +186,77 @@ def _session() -> dict:
     }
 
 
+_SPARK = "▁▂▃▄▅▆▇█"
+#: the /dash headline series (substring match against flattened keys)
+_DASH_SERIES = (
+    "batch.ticket_latency",
+    "batch.slo_misses",
+    "batch.queue_depth",
+    "batch.dispatches",
+    "usage.",
+)
+
+
+def _sparkline(values: list) -> str:
+    """Unicode block sparkline of a numeric series (shared shape with
+    scripts/axon_dash.py's renderer)."""
+    vals = [float(v) for v in values if isinstance(v, (int, float))]
+    if not vals:
+        return ""
+    lo, hi = min(vals), max(vals)
+    span = hi - lo
+    if span <= 0:
+        return _SPARK[0] * len(vals)
+    return "".join(
+        _SPARK[min(int((v - lo) / span * (len(_SPARK) - 1) + 0.5),
+                   len(_SPARK) - 1)]
+        for v in vals
+    )
+
+
+def _dash_html() -> str:
+    """The /dash page (Axon v7): a self-refreshing stdlib-rendered
+    sparkline board over the history sampler's in-memory raw ring. A
+    disabled stub when no sampler is live — the page itself never
+    starts one."""
+    st = _history.state()
+    head = (
+        "<!doctype html><html><head><meta charset='utf-8'>"
+        "<meta http-equiv='refresh' content='2'>"
+        "<title>axon dash</title><style>body{font-family:monospace;"
+        "background:#111;color:#ddd;padding:1em}td{padding:0 .6em}"
+        ".spark{color:#6cf}</style></head><body><h3>axon /dash</h3>"
+    )
+    if not st.get("enabled"):
+        return (
+            head + "<p>history sampler off — set SPARSE_TPU_HISTORY "
+            "(or telemetry._history.start()) to enable.</p></body></html>"
+        )
+    points = _history.window(seconds=300.0, res=0)
+    rows = []
+    if points:
+        keys = sorted(points[-1].get("s", {}))
+        shown = [
+            k for k in keys if any(s in k for s in _DASH_SERIES)
+        ] or keys[:24]
+        for k in shown[:40]:
+            series = [p["s"].get(k) for p in points if k in p.get("s", {})]
+            if not series:
+                continue
+            rows.append(
+                f"<tr><td>{k}</td>"
+                f"<td class='spark'>{_sparkline(series[-60:])}</td>"
+                f"<td>{series[-1]}</td></tr>"
+            )
+    body = (
+        f"<p>session {st.get('session')} · {st.get('samples')} samples · "
+        f"{len(points)} pts in window · root {st.get('root')}</p>"
+        "<table><tr><th>series</th><th>last 5 min</th><th>now</th></tr>"
+        + "".join(rows) + "</table></body></html>"
+    )
+    return head + body
+
+
 class _Handler(BaseHTTPRequestHandler):
     # the exporter is a metrics surface, not an access log
     def log_message(self, fmt, *args):  # noqa: A003 - stdlib signature
@@ -213,6 +291,13 @@ class _Handler(BaseHTTPRequestHandler):
                 self._send_json(_watchdog.state())
             elif path == "/incidents":
                 self._send_json(_flight.state())
+            elif path == "/budget":
+                self._send_json(_budget.state())
+            elif path == "/dash":
+                self._send(
+                    200, _dash_html().encode(),
+                    "text/html; charset=utf-8",
+                )
             elif path == "/debug/capture":
                 bundle = _flight.capture_now(reason="manual")
                 if bundle is None:
@@ -232,7 +317,7 @@ class _Handler(BaseHTTPRequestHandler):
                     200,
                     b"sparse_tpu axon exporter: "
                     b"/metrics /healthz /session /alerts /incidents "
-                    b"/debug/capture\n",
+                    b"/budget /dash /debug/capture\n",
                     "text/plain; charset=utf-8",
                 )
             else:

@@ -351,6 +351,35 @@ def _windowed_rate(read_num, read_den, min_den: int = 1):
     return value
 
 
+def _windowed_rate_by_label(read_counts, min_den: int = 1):
+    """Per-label-value windowed rate (Axon v7 satellite): ``read_counts``
+    returns cumulative ``{label_value: (num, den)}``; the value callable
+    returns the WORST label's ``Δnum/Δden`` this window — so one
+    tenant's breach can't hide inside a healthy aggregate. Labels whose
+    denominator didn't move by ``min_den`` are skipped; ``None`` when no
+    label qualifies (or on the priming tick)."""
+    snap = {"counts": None}
+
+    def value():
+        counts = {k: (float(n), float(d))
+                  for k, (n, d) in read_counts().items()}
+        prev, snap["counts"] = snap["counts"], counts
+        if prev is None:
+            return None
+        worst = None
+        for k, (n1, d1) in counts.items():
+            n0, d0 = prev.get(k, (0.0, 0.0))
+            dd = d1 - d0
+            if dd < min_den:
+                continue
+            rate = (n1 - n0) / dd
+            if worst is None or rate > worst:
+                worst = rate
+        return worst
+
+    return value
+
+
 def _windowed_delta(read):
     """A value callable computing the per-window delta of one cumulative
     reader (``None`` on the priming tick)."""
@@ -367,21 +396,55 @@ def _windowed_delta(read):
     return value
 
 
+def _tenant_miss_counts() -> dict:
+    """Cumulative ``{tenant: (misses, tickets)}``: ``""`` aggregates
+    every ticket; named tenants ride the v7 ``usage.*`` metering
+    families (batch/service.py)."""
+    counts = {
+        "": (
+            float(_metrics.counter("batch.slo_misses").value),
+            float(sum(
+                h.count for h in _metrics.family("batch.ticket_latency")
+            )),
+        )
+    }
+    acc: dict = {}
+    for m in _metrics.family("usage.tickets"):
+        t = m.labels.get("tenant")
+        if t and t != "-":
+            acc.setdefault(t, [0.0, 0.0])[1] += float(m.value)
+    for m in _metrics.family("usage.slo_misses"):
+        t = m.labels.get("tenant")
+        if t and t != "-":
+            acc.setdefault(t, [0.0, 0.0])[0] += float(m.value)
+    counts.update({t: (c[0], c[1]) for t, c in acc.items()})
+    return counts
+
+
 def slo_miss_rate_rule(trigger: float = 0.5, clear: float = 0.1,
                        severity: str = "page", min_tickets: int = 1,
-                       **kw) -> Rule:
+                       per_tenant: bool = False, **kw) -> Rule:
     """Fraction of the window's resolved tickets that missed the session
     SLO (``batch.slo_misses`` over the ``batch.ticket_latency`` family's
-    total observations). The headline serving alert."""
-    return Rule(
-        "slo_miss_rate",
-        _windowed_rate(
+    total observations). The v5 headline serving alert — superseded in
+    :func:`default_rules` by the v7 burn-rate pair (``_budget``) but
+    kept for explicit construction. ``per_tenant=True`` evaluates the
+    worst tenant's window rate instead of the aggregate (Axon v7
+    satellite)."""
+    if per_tenant:
+        value = _windowed_rate_by_label(
+            _tenant_miss_counts, min_den=min_tickets
+        )
+    else:
+        value = _windowed_rate(
             lambda: _metrics.counter("batch.slo_misses").value,
             lambda: sum(
                 h.count for h in _metrics.family("batch.ticket_latency")
             ),
             min_den=min_tickets,
-        ),
+        )
+    return Rule(
+        "slo_miss_rate", value,
         trigger, clear=clear, op=">", severity=severity, **kw)
 
 
@@ -467,9 +530,15 @@ def failover_rule(severity: str = "page", **kw) -> Rule:
 
 def default_rules() -> list:
     """The stock rule set (each factory's defaults; see the rule
-    reference table in docs/telemetry.md)."""
+    reference table in docs/telemetry.md). Axon v7: the instantaneous
+    ``slo_miss_rate`` rule is replaced by the error-budget burn-rate
+    pair (``slo_fast_burn`` pages, ``slo_slow_burn`` warns —
+    :mod:`._budget`); the factory itself stays exported for explicit
+    construction."""
+    from . import _budget
+
     return [
-        slo_miss_rate_rule(),
+        *_budget.default_rules(),
         anomaly_rate_rule(),
         queue_depth_rule(),
         device_occupancy_rule(),

@@ -103,6 +103,7 @@ _QUICK_FILES = {
     "test_fleet.py",
     "test_flight.py",
     "test_grid2d.py",
+    "test_history.py",
     "test_ingest.py",
     "test_io.py",
     "test_loadgen.py",
