@@ -2199,6 +2199,45 @@ PROBE_TIMEOUT_S = 120.0
 MIN_TPU_ATTEMPT_S = 240.0
 
 
+def _try_remesh(timeout_s: float):
+    """Elastic-mesh lane (ISSUE 20): run the S=8 -> S=4 -> S=8
+    ``elastic_remesh`` scenario (``__graft_entry__.dryrun_remesh``) in a
+    subprocess and return its structured row — time-to-first-solve after
+    a shrink (cold vs mesh-keyed-manifest-warm re-plan), the zero-miss
+    warm-shrink gate, and the zero-loss in-flight migration verdict.
+    CPU-only by construction (the dryrun forces the virtual mesh).
+    Returns the parsed dict, or None."""
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)  # never dial the tunnel for this
+    try:
+        proc = subprocess.run(
+            [
+                sys.executable, "-c",
+                "import __graft_entry__ as g; g.dryrun_remesh(8)",
+            ],
+            capture_output=True,
+            text=True,
+            timeout=max(60, timeout_s),
+            cwd=HERE,
+            env=env,
+        )
+    except subprocess.TimeoutExpired:
+        _note_probe_timeout("elastic_remesh", timeout_s)
+        return None
+    for line in reversed(proc.stdout.strip().splitlines()):
+        if line.startswith("MULTICHIP_REMESH_JSON: "):
+            try:
+                return json.loads(line[len("MULTICHIP_REMESH_JSON: "):])
+            except json.JSONDecodeError:
+                break
+    sys.stderr.write(proc.stderr[-1500:])
+    print(
+        f"bench: remesh dryrun rc={proc.returncode} without stats",
+        file=sys.stderr,
+    )
+    return None
+
+
 def main():
     t_start = time.monotonic()
     budget_s = float(os.environ.get("BENCH_BUDGET_S", "870"))
@@ -2284,6 +2323,27 @@ def main():
                                 k: fl.get(k) for k in (
                                     "max_abs_diff", "divergence_pct",
                                     "iters_equal",
+                                )
+                            }),
+                            file=sys.stderr,
+                        )
+                    print(json.dumps(rec))
+                    sys.stdout.flush()
+            except Exception:
+                traceback.print_exc(file=sys.stderr)
+        if rec is not None and remaining() > 150:
+            try:  # elastic remesh lane (ISSUE 20) — structured, never fatal
+                el = _try_remesh(min(300, remaining() - 60))
+                if el:
+                    rec["remesh"] = el
+                    if not el.get("ok"):
+                        print(
+                            "bench: elastic_remesh FAILED its zero-loss/"
+                            "warm-replan gates: " + json.dumps({
+                                k: el.get(k) for k in (
+                                    "tickets_preserved",
+                                    "shrink_warm_misses", "replayed",
+                                    "regain_outcome",
                                 )
                             }),
                             file=sys.stderr,

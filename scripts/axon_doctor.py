@@ -138,6 +138,9 @@ def _summarize(manifest: dict, events: list,
     failover_kernels = set()
     quarantine_reasons: dict = {}
     burn_tenant = None
+    remeshes: list = []  # fleet.remesh / fleet.remesh_failed rows
+    remesh_requeues = 0  # lanes migrated through remesh requeue chains
+    mesh_skipped = 0  # manifest entries skipped on topology mismatch
     for e in events:
         k = str(e.get("kind", "?"))
         kinds[k] = kinds.get(k, 0) + 1
@@ -154,6 +157,24 @@ def _summarize(manifest: dict, events: list,
             quarantine_reasons[r] = quarantine_reasons.get(r, 0) + 1
         elif k == "budget.burn":
             burn_tenant = e.get("tenant")  # latest wins
+        elif k in ("fleet.remesh", "fleet.remesh_failed"):
+            remeshes.append(e)
+        elif k == "batch.requeue" and e.get("action") == "remesh":
+            try:
+                remesh_requeues += int(e.get("lanes", 0))
+            except (TypeError, ValueError):
+                pass
+        elif k == "vault.replay":
+            try:
+                mesh_skipped += int(e.get("mesh_skipped", 0))
+            except (TypeError, ValueError):
+                pass
+    # the flight bundle embeds the remesh transition directly (manifest
+    # 'remesh' block) — union with ring evidence so a tail too short to
+    # hold the events still diagnoses
+    for e in manifest.get("remesh") or ():
+        if isinstance(e, dict) and e not in remeshes:
+            remeshes.append(e)
     trans = manifest.get("transition") or {}
     latches = manifest.get("failover_latches") or {}
     faults_cfg = manifest.get("faults") or {}
@@ -174,6 +195,9 @@ def _summarize(manifest: dict, events: list,
         "deadlines": kinds.get("batch.deadline", 0),
         "degraded": kinds.get("batch.degraded", 0),
         "requeues": kinds.get("batch.requeue", 0),
+        "remeshes": remeshes,
+        "remesh_requeues": remesh_requeues,
+        "mesh_skipped": mesh_skipped,
         "burn_tenant": burn_tenant,
         "burn_onset_t": _burn_onset(history),
         "capture_ts": manifest.get("ts"),
@@ -229,6 +253,49 @@ def _d_injected_io(s):
             [f"{n} fault.injected event(s) at site=io"],
             "clear the fault spec; verify-then-load quarantines and "
             "rebuilds")
+
+
+def _d_remesh(s):
+    rows = s["remeshes"]
+    if not rows:
+        return None
+    ok = [e for e in rows if str(e.get("kind")) == "fleet.remesh"]
+    failed = [e for e in rows if str(e.get("kind")) == "fleet.remesh_failed"]
+    # name the transition by the last executed remesh (latest wins); a
+    # latched flap guard with no executed transition still diagnoses
+    last = ok[-1] if ok else rows[-1]
+    old = str(last.get("old", "?"))
+    new = str(last.get("new", "?"))
+    if ok:
+        cause = (f"mesh topology change: fleet re-planned from "
+                 f"{old} to {new} "
+                 f"(reason={last.get('reason', '?')})")
+    else:
+        cause = (f"mesh topology flapping: remesh flap guard latched "
+                 f"on {old}, session pinned to the single strategy")
+    ev = [f"{len(ok)} fleet.remesh event(s)"
+          + (f", {len(failed)} fleet.remesh_failed" if failed else "")]
+    if s["remesh_requeues"]:
+        ev.append(
+            f"requeue chain migrated {s['remesh_requeues']} in-flight "
+            "lane(s) with best-iterate x0 (batch.requeue action=remesh)")
+    if s["mesh_skipped"]:
+        ev.append(
+            f"vault replay skipped {s['mesh_skipped']} manifest "
+            "entr(ies) keyed to the departed mesh")
+    replayed = sum(int(e.get("replayed", 0) or 0) for e in ok)
+    if replayed:
+        ev.append(f"{replayed} plan(s) replayed warm from the "
+                  "mesh-keyed vault manifest")
+    if failed:
+        return (cause, ev,
+                "topology is oscillating: stabilise the device fleet, "
+                "then session.remesh(mesh) to unpin; raise "
+                "SPARSE_TPU_REMESH_RETRIES only if flaps are expected")
+    return (cause, ev,
+            "expected after a slice loss/regain; verify tickets all "
+            "reached terminal states and gauges read zero "
+            "(docs/resilience.md \"Elastic topology\")")
 
 
 def _d_failover(s):
@@ -367,6 +434,7 @@ _DIAGNOSES = (
     ("injected-dispatch-drop", _d_injected_drop),
     ("injected-matvec-corruption", _d_injected_matvec),
     ("injected-io-fault", _d_injected_io),
+    ("mesh-topology-change", _d_remesh),
     ("pallas-failover", _d_failover),
     ("vault-corruption", _d_vault),
     ("slo-error-budget-burn", _d_burn),

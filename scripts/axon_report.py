@@ -81,6 +81,11 @@ this script never imports sparse_tpu) and lifts
 joins the on-disk history segments across process restarts and prints
 the SLO-miss incident window.
 
+Elastic-mesh additions (ISSUE 20): the bench ``remesh`` row (the
+topology-change tax — time-to-first-solve after a shrink, cold vs
+mesh-keyed-manifest-warm re-plan, zero-miss warm gate) rides both the
+``--compare`` surface (``remesh.*``) and the ``--trend`` table.
+
 Axon v4 additions (ISSUE 7): ``report["comm"]`` rolls up the
 ``comm.measured`` events (parallel/comm.py trace-time accounting) per
 site — measured vs analytic-model bytes, divergence %, and the achieved
@@ -868,6 +873,23 @@ def build_report(records_path: str, bench_paths=(), peak_gflops=None,
                        ("dedup_plan_misses", False)):
             if _num(ingest_row.get(k)) is not None:
                 metrics[f"ingest.{k}"] = {"v": ingest_row[k], "hib": hib}
+    # the bench remesh row (ISSUE 20): the elastic-topology tax —
+    # time-to-first-solve after a shrink, cold vs mesh-keyed-manifest-
+    # warm re-plan (whose serving misses must stay 0), and the warm
+    # replay count — pinned next to cold_start's restart surface
+    remesh_row = None
+    for e in sorted(sessions, key=lambda e: e.get("ts", 0)):
+        rec = e.get("record")
+        if isinstance(rec, dict) and isinstance(rec.get("remesh"), dict):
+            remesh_row = rec["remesh"]
+    if remesh_row:
+        for k, hib in (("shrink_cold_s", False),
+                       ("shrink_warm_s", False),
+                       ("shrink_warm_replan_ms", False),
+                       ("shrink_warm_misses", False),
+                       ("replayed", True)):
+            if _num(remesh_row.get(k)) is not None:
+                metrics[f"remesh.{k}"] = {"v": remesh_row[k], "hib": hib}
     for key, p in programs.items():
         if _num(p.get("achieved_gflops")) is not None:
             metrics[f"program.{key}.achieved_gflops"] = {
@@ -939,6 +961,8 @@ _TREND_EMBEDS = (
     ("auto_cg", ("regret_worst", "ill_speedup_vs_global")),
     ("ingest", ("sort_rows_per_s", "cold_onboard_ms", "dedup_onboard_ms",
                 "dedup_speedup", "dedup_plan_misses")),
+    ("remesh", ("shrink_cold_s", "shrink_warm_s", "shrink_warm_replan_ms",
+                "shrink_warm_misses", "replayed")),
 )
 
 

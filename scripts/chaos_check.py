@@ -122,6 +122,20 @@ chains are missing:
    fingerprint index, dedups the re-arrival of the onboarded
    structure, and serves its first solve at ZERO plan-cache misses —
    dedup proven restart-surviving, not just in-process.
+15. **Error-budget burn** (ISSUE 19 acceptance drill) — injected
+   dispatch delays page the compressed-window ``slo_fast_burn`` rule,
+   the alert bundle embeds the history window, the doctor names the
+   burn signature, and ``axon_report --history`` shows the incident
+   window from a fresh process.
+16. **Elastic mesh** (ISSUE 20 acceptance drill) — a child on the
+   forced 8-device mesh serves seeded loadgen traffic across a LIVE
+   topology shrink-and-regain (``remesh:at=...,to=4`` then ``to=8``):
+   every solve ticket reaches a terminal state (zero lost across both
+   migrations), queue gauges read zero after the drain, the vault
+   manifest carries both mesh fingerprints, the post-recovery window
+   serves at ZERO plan-cache misses (recovery is a warm replay, not a
+   rebuild), and the stdlib doctor over the child's flight bundle
+   names the ``mesh-topology-change`` signature.
 
 Telemetry is pointed at a temp sink (never the committed
 ``results/axon/records.jsonl``). Wired into the quick lane through
@@ -133,7 +147,8 @@ Usage:
 
 (``--vault-child serve|warm`` is the internal entry point of scenario
 6's subprocesses — it reads ``SPARSE_TPU_VAULT`` from the env; the
-``-pipe`` and ``ingest-`` modes are scenarios 10 and 14's children.)
+``-pipe``, ``ingest-`` and ``elastic`` modes are scenarios 10, 14 and
+16's children.)
 """
 
 from __future__ import annotations
@@ -355,6 +370,136 @@ def run(report: dict) -> list:
 
     # -- 15. error-budget burn: fast-burn alert -> history-carrying bundle --
     problems += _budget_burn(report)
+
+    # -- 16. elastic mesh: loadgen traffic across a live 8->4->8 remesh -----
+    problems += _elastic_remesh(report)
+    return problems
+
+
+def _elastic_remesh(report: dict) -> list:
+    """Scenario 16 (ISSUE 20 acceptance drill): a child on the forced
+    8-device mesh serves seeded loadgen traffic ACROSS a live topology
+    shrink-and-regain (``remesh:at=...,to=4`` then ``to=8`` trace
+    clauses): every solve ticket must reach a terminal state (zero
+    lost), the queue gauges must read zero after the drain, the vault
+    manifest must carry BOTH mesh fingerprints (each transition was a
+    warm replay), the post-recovery serving window must run at zero
+    plan-cache misses, and the stdlib doctor over a flight bundle from
+    the child must name the mesh-topology-change signature."""
+    problems = []
+    vdir = tempfile.mkdtemp(prefix="chaos_vault_elastic_")
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    flags = env.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        env["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8"
+        ).strip()
+    env["SPARSE_TPU_VAULT"] = vdir
+    env["SPARSE_TPU_COMPILE_CACHE"] = os.path.join(vdir, "_xla_cache")
+    env["SPARSE_TPU_FLEET"] = "auto"
+    env["SPARSE_TPU_FLEET_MIN_B"] = "2"
+    env.pop("SPARSE_TPU_FAULTS", None)
+
+    child = subprocess.run(
+        [sys.executable, os.path.abspath(__file__),
+         "--vault-child", "elastic"],
+        env=env, capture_output=True, text=True, timeout=300,
+    )
+    out = None
+    for line in child.stdout.splitlines():
+        if line.startswith("ELASTIC "):
+            try:
+                out = json.loads(line[8:])
+            except json.JSONDecodeError:
+                pass
+    if out is None:
+        problems.append(
+            f"elastic: child produced no report (rc={child.returncode}, "
+            f"stderr tail: {child.stderr[-300:]!r})"
+        )
+        return problems
+    report["elastic_remesh"] = out
+    rep = out.get("report", {})
+    if rep.get("failed", 1) != 0:
+        problems.append(
+            f"elastic: {rep.get('failed')} ticket(s) failed across the "
+            "remesh (zero-loss migration broken)"
+        )
+    if rep.get("completed", 0) != rep.get("arrivals", -1):
+        problems.append(
+            f"elastic: {rep.get('completed')}/{rep.get('arrivals')} "
+            "tickets terminal after drain"
+        )
+    if rep.get("remeshes", {}).get("ok", 0) < 1:
+        problems.append(
+            f"elastic: the traced shrink never executed, got "
+            f"{rep.get('remeshes')}"
+        )
+    if out.get("recover", {}).get("outcome") != "ok":
+        problems.append(
+            f"elastic: the recovery remesh did not execute "
+            f"(got {out.get('recover')})"
+        )
+    if out.get("drift", 1) != 0:
+        problems.append(
+            f"elastic: queue gauges drifted by {out.get('drift')} after "
+            "the drain (a ticket was dropped or double-counted)"
+        )
+    fp = str(out.get("mesh", {}).get("fingerprint", ""))
+    if fp.split(":")[1:2] != ["8"]:
+        problems.append(
+            f"elastic: live mesh identity {fp!r} did not recover to the "
+            "8-device mesh (stale identity?)"
+        )
+    meshes = {m for m in out.get("manifest_mesh", []) if m}
+    if len(meshes) < 2:
+        problems.append(
+            f"elastic: vault manifest carries {sorted(meshes)} — both "
+            "topologies' programs should have been vaulted"
+        )
+    d = out.get("delta", {})
+    if d.get("misses", 1) != 0:
+        problems.append(
+            f"elastic: post-recovery window had {d.get('misses')} "
+            "plan-cache misses (recovery must be a warm replay)"
+        )
+    if d.get("hits", 0) < 1:
+        problems.append("elastic: post-recovery window saw no cache hits")
+    bad = [r for r in out.get("resids", [1.0]) if not (r <= 10 * TOL)]
+    if bad:
+        problems.append(
+            f"elastic: {len(bad)} lanes unconverged after recovery "
+            f"(worst ||r||={max(bad):.2e})"
+        )
+    bundle = out.get("bundle")
+    if not bundle or not os.path.isdir(bundle):
+        problems.append("elastic: child captured no flight bundle")
+        return problems
+    doctor = subprocess.run(
+        [sys.executable, os.path.join(HERE, "axon_doctor.py"), bundle,
+         "--json"],
+        capture_output=True, text=True, timeout=60,
+    )
+    try:
+        diag = json.loads(doctor.stdout)
+    except json.JSONDecodeError:
+        diag = None
+    if diag is None:
+        problems.append(
+            f"elastic: doctor produced no JSON diagnosis "
+            f"(rc={doctor.returncode}, stderr: {doctor.stderr[-200:]!r})"
+        )
+        return problems
+    report["elastic_remesh"]["diagnosis"] = {
+        "cause": diag.get("cause"),
+        "probable_cause": diag.get("probable_cause"),
+    }
+    if diag.get("cause") != "mesh-topology-change":
+        problems.append(
+            f"elastic: doctor named {diag.get('cause')!r}, not "
+            "'mesh-topology-change'"
+        )
     return problems
 
 
@@ -1820,12 +1965,14 @@ def _pipeline_restart_admission(report: dict) -> list:
 
 
 def vault_child(mode: str) -> int:
-    """Scenario 6/7/10 child entry (``--vault-child
-    serve|warm|serve-pipe|warm-pipe``): reads the vault dir from
-    ``SPARSE_TPU_VAULT`` (scenario 7 adds the fleet mode on the forced
-    8-device mesh; scenario 10's ``-pipe`` modes run the streaming
-    pipeline — the serve child dies with buckets IN FLIGHT and the warm
-    child races traffic against the async replay)."""
+    """Scenario 6/7/10/16 child entry (``--vault-child
+    serve|warm|serve-pipe|warm-pipe|elastic``): reads the vault dir
+    from ``SPARSE_TPU_VAULT`` (scenario 7 adds the fleet mode on the
+    forced 8-device mesh; scenario 10's ``-pipe`` modes run the
+    streaming pipeline — the serve child dies with buckets IN FLIGHT
+    and the warm child races traffic against the async replay;
+    scenario 16's ``elastic`` mode serves loadgen traffic across a
+    live 8->4->8 remesh)."""
     import jax
 
     jax.config.update("jax_enable_x64", True)
@@ -1878,6 +2025,63 @@ def vault_child(mode: str) -> int:
             "replayed": ses.warm_replayed,
             "resid": float(np.linalg.norm(A @ x - b)),
             "vault": vault.stats(),
+        }), flush=True)
+        return 0
+    if mode == "elastic":
+        # scenario 16 child (ISSUE 20): serve loadgen traffic ACROSS a
+        # live 8->4->8 topology change, then prove the recovery window
+        # serves warm and capture a flight bundle for the doctor
+        from sparse_tpu import fleet as fleet_mod
+        from sparse_tpu import loadgen, telemetry as tel
+        from sparse_tpu.config import settings
+        from sparse_tpu.telemetry import _flight
+
+        vdir = os.environ["SPARSE_TPU_VAULT"]
+        settings.telemetry = True
+        tel.configure(os.path.join(vdir, "_tel.jsonl"))
+        ses = SolveSession("cg", warm_start=False)
+        # warm the 8-device mesh: its sharded programs are built and
+        # vaulted BEFORE the topology starts moving
+        ses.solve_many(mats, rhs, tol=TOL)
+        trace = (
+            loadgen.ArrivalTrace.poisson(rate=40.0, duration=0.6, seed=29)
+            + loadgen.ArrivalTrace.remesh_at(0.3, to=4)
+        )
+        rep = loadgen.run_load(ses, trace, list(zip(mats, rhs)), tol=TOL)
+        # a full batch on the shrunken mesh: the 4-device sharded
+        # programs are built and vaulted under THEIR fingerprint
+        ses.solve_many(mats, rhs, tol=TOL)
+        # manual recovery: regain the 8-device mesh, then prove the
+        # post-recovery serving window runs on plan-cache hits only
+        # (warm replay, zero serving builds)
+        snap = plan_cache.snapshot()
+        rec = ses.remesh(fleet_mod.fleet_mesh(8))
+        X, _i, _r = ses.solve_many(mats, rhs, tol=TOL)
+        resids = [
+            float(np.linalg.norm(m @ x - b))
+            for m, x, b in zip(mats, X, rhs)
+        ]
+        delta = plan_cache.delta(snap)
+        stats = ses.session_stats()
+        _flight.stop_flight()
+        fr = _flight.flight(root=os.path.join(vdir, "_incidents"))
+        bundle = fr.capture(reason="manual")
+        _flight.stop_flight()
+        print("ELASTIC " + json.dumps({
+            "report": {
+                "arrivals": rep.arrivals, "completed": rep.completed,
+                "failed": rep.failed, "remeshes": rep.remeshes,
+            },
+            "drift": stats["tickets"]["queue_depth_drift"],
+            "recover": rec,
+            "mesh": stats.get("mesh", {}),
+            "elastic": stats.get("elastic", {}),
+            "manifest_mesh": [
+                e.get("mesh") for e in vault.manifest_entries()
+            ],
+            "delta": delta,
+            "resids": resids,
+            "bundle": bundle,
         }), flush=True)
         return 0
     if mode == "serve":
@@ -1989,6 +2193,7 @@ def main(argv) -> int:
         ig = report.get("ingest_chaos", {})
         ir = report.get("ingest_restart", {})
         bb = report.get("budget_burn", {})
+        el = report.get("elastic_remesh", {})
         print(
             "chaos check passed: "
             f"{len([k for k in report if k.startswith('solver.')])} solvers "
@@ -2029,7 +2234,13 @@ def main(argv) -> int:
             f"error-budget burn page->clear ok "
             f"({bb.get('bundle_history_points', '?')} history point(s) in "
             f"the bundle, doctor rule "
-            f"{bb.get('diagnosis', {}).get('rule', '?')!r})"
+            f"{bb.get('diagnosis', {}).get('rule', '?')!r}), "
+            f"elastic remesh ok "
+            f"({el.get('report', {}).get('completed', 0)} ticket(s) "
+            "terminal across 8->4->8, "
+            f"{el.get('delta', {}).get('misses', '?')} recovery misses, "
+            f"doctor cause "
+            f"{el.get('diagnosis', {}).get('cause', '?')!r})"
         )
     return 1 if problems else 0
 

@@ -96,6 +96,7 @@ import numpy as np
 
 from .. import autopilot as autopilot_mod
 from .. import fleet as fleet_mod
+from ..fleet import elastic as elastic_mod
 from .. import mixed as mixed_mod
 from .. import plan_cache, telemetry
 from .. import precond as precond_mod
@@ -144,6 +145,13 @@ _STALE_REQUEUES = _metrics.counter(
     "batch.stale_requeues",
     help="unconverged lanes whose requeue was skipped at readback "
     "because the ticket deadline had already passed",
+)
+# elastic-mesh levels (ISSUE 20): executed topology transitions by
+# outcome — 'ok' (quiesce -> retarget -> replay completed) or 'latched'
+# (the flap guard refused and pinned the single-device strategy)
+_REMESHES_HELP = (
+    "executed elastic topology transitions, by outcome "
+    "('ok' | 'latched')"
 )
 
 # live sessions, weakly held: the /session serving endpoint
@@ -704,6 +712,21 @@ class SolveSession:
             fleet, mesh=fleet_mesh, min_b=fleet_min_b,
             row_min_n=row_shard_min_n,
         )
+        # elastic topology monitor (ISSUE 20, docs/resilience.md
+        # "Elastic topology"): fleet sessions only, SPARSE_TPU_REMESH=0
+        # opts out. With no mesh fault active the monitor resolves the
+        # construction-time mesh — clean traffic never sees a change,
+        # so the default path stays byte-identical (pinned by
+        # tests/test_elastic.py).
+        self._elastic = (
+            elastic_mod.MeshMonitor(self.fleet.mesh)
+            if (settings.remesh and self.fleet.mode
+                and self.fleet.mesh is not None)
+            else None
+        )
+        # reentrancy guard for the remesh transition: quiescing retires
+        # buckets whose requeues re-enter _launch's detection gate
+        self._remeshing = False
         # batched preconditioner policy (ISSUE 14, docs/preconditioners
         # .md): resolves SPARSE_TPU_PRECOND / precond= / per-ticket
         # overrides into a per-(pattern, solver, bucket, dtype) choice
@@ -989,6 +1012,8 @@ class SolveSession:
             "patterns": len(self._patterns),
             "dispatches": self.dispatches,
             "mesh": self.fleet.describe(),
+            **({"elastic": self._elastic.describe()}
+               if self._elastic is not None else {}),
             "precond": self.precond.describe(),
             "dtype_policy": self.dtype_policy.describe(),
             **({"autopilot": self.autopilot.describe()}
@@ -1251,7 +1276,15 @@ class SolveSession:
         Returns the number of buckets retired by this call."""
         self._flush_pending()
         n = 0
-        while self._inflight:
+        while self._inflight or self.pending:
+            if not self._inflight:
+                # a remesh migration mid-drain requeued its lanes into
+                # the pending queue (ISSUE 20): dispatch them on the new
+                # topology so the all-terminal contract holds. Bounded —
+                # migrations stop once identities match or the flap
+                # guard latches.
+                self._flush_pending()
+                continue
             self._retire(self._inflight.popleft())
             n += 1
         return n
@@ -1586,6 +1619,20 @@ class SolveSession:
         were already resolved on the eager degraded path."""
         t0 = time.monotonic()
         if _faults.ACTIVE:
+            # elastic detection, forged-world trigger (ISSUE 20): a live
+            # ``mesh`` fault clause changes what the world offers —
+            # checked BEFORE the drop/delay actions so a slice loss
+            # migrates this launch's lanes instead of failing them. The
+            # disrupt draw is the gate: a spent clause budget detects
+            # nothing (the drill then recovers via session.remesh()).
+            if (
+                self._elastic is not None and not self._elastic.latched
+                and not self._remeshing
+            ):
+                tgt = self._elastic.changed(self.fleet)
+                if tgt is not None and _faults.mesh_disrupt() is not None:
+                    self._remesh_migrate(reqs, tgt, reason="fault")
+                    return None
             for act in _faults.dispatch_actions():
                 if act[0] == "drop":
                     raise InjectedDispatchFailure(
@@ -1809,6 +1856,20 @@ class SolveSession:
             out = prog(*args)
             t_dispatched = time.monotonic() if sampled else None
         except Exception as e:  # noqa: BLE001 - degrade, don't strand
+            # elastic detection, dispatch-failure trigger (ISSUE 20): a
+            # classified topology error revalidates the mesh — when the
+            # world really differs, migrate the lanes and re-plan
+            # instead of eagerly degrading onto a dead topology
+            if (
+                self._elastic is not None and not self._elastic.latched
+                and not self._remeshing and _faults.is_topology_error(e)
+            ):
+                tgt = self._elastic.changed(self.fleet)
+                if tgt is not None:
+                    self._remesh_migrate(
+                        reqs, tgt, reason="dispatch_error"
+                    )
+                    return None
             self._degrade(reqs, dt, solver, nb, e)
             return None
         return _InFlight(
@@ -2145,6 +2206,145 @@ class SolveSession:
             # the requeue is best-effort: every lane already holds its
             # first (unconverged) result, which result() returns
             pass
+
+    # -- elastic mesh (ISSUE 20, docs/resilience.md "Elastic topology") ----
+    def remesh(self, mesh=None) -> dict:
+        """Re-plan the session onto a new topology, migrating every
+        queued and in-flight ticket (the explicit production verb; the
+        forged-fault trigger rides ``_launch``). ``mesh=None`` asks the
+        monitor what the world currently offers (under an active mesh
+        fault that is the forged topology; otherwise — and after
+        ``faults.clear()`` — the construction-time mesh, which makes
+        ``remesh()`` the recovery verb of the shrink drill). Returns a
+        JSON-friendly outcome dict; ``outcome='ok'`` carries the old/new
+        fingerprints, lanes requeued and programs warm-replayed."""
+        if not self.fleet.mode:
+            return {"outcome": "disabled"}
+        if mesh is None:
+            mesh = (
+                self._elastic.resolve() if self._elastic is not None
+                else fleet_mod.fleet_mesh()
+            )
+        return self._do_remesh(mesh, reason="manual")
+
+    def _remesh_migrate(self, reqs, target, reason: str) -> None:
+        """Zero-loss lane migration: requeue this launch's lanes into
+        the pending queue — each carrying its ticket's best iterate as
+        ``x0``, so work done on the old topology is kept, not redone —
+        then run the full transition. The lanes re-dispatch on the new
+        topology at the next pipeline drive (flush/drain/result())."""
+        _REQUEUES.inc(len(reqs))
+        if telemetry.enabled():
+            telemetry.record(
+                "batch.requeue", solver=self.solver, lanes=len(reqs),
+                from_solver=self.solver, action="remesh",
+                tickets=[r.ticket.id for r in reqs],
+            )
+        for r in reqs:
+            x0 = r.ticket._out[0] if r.ticket._out is not None else r.x0
+            self._pending.setdefault(id(r.pattern), []).append(
+                _Request(r.pattern, r.values, r.b, r.tol, x0, r.maxiter,
+                         r.ticket, precond=r.precond,
+                         dtype_policy=r.dtype_policy,
+                         precond_dtype=r.precond_dtype)
+            )
+        self._do_remesh(target, reason=reason, requeued=len(reqs))
+
+    def _reset_occupancy(self) -> None:
+        """Drop the per-device occupancy gauges wholesale: after a
+        shrink the old mesh's higher-numbered device series would
+        linger as ghosts — and a zeroed ghost still trips occupancy
+        alerting, so the family is REMOVED, not reset. The next
+        dispatch repopulates it from the live plan."""
+        self._device_occ = []
+        _metrics.remove("fleet.device_occupancy")
+
+    def _do_remesh(self, target, reason: str, requeued: int = 0) -> dict:
+        """One topology transition, in the only legal order: quiesce
+        (admission hold + retire every in-flight bucket, so no program
+        compiled against the old topology is still running), charge the
+        flap guard, re-target the :class:`FleetPolicy`, reset the
+        device-keyed gauges, and warm-replay the manifest against the
+        new fingerprint (mesh-keyed entries make the re-plan warm
+        whenever this topology was ever seen before — shrink then
+        recover is two warm replays, zero serving builds)."""
+        if self._remeshing:
+            return {"outcome": "reentrant"}
+        if self._elastic is not None and self._elastic.latched:
+            return {"outcome": "latched"}
+        old_fp = self.fleet.fingerprint
+        if self.fleet.mesh is not None and (
+            elastic_mod.mesh_identity(target)
+            == elastic_mod.mesh_identity(self.fleet.mesh)
+        ):
+            return {"outcome": "noop"}
+        self._remeshing = True
+        t0 = time.monotonic()
+        try:
+            # quiesce: the admission hold — everything in flight retires
+            # before the policy re-points, and the hold is visible as an
+            # ordinary admission event with reason='remesh'
+            depth = self._unfinalized
+            while self._inflight:
+                self._retire(self._inflight.popleft())
+            if telemetry.enabled():
+                telemetry.record(
+                    "batch.admission", mode="block", reason="remesh",
+                    depth=depth,
+                    waited_ms=round((time.monotonic() - t0) * 1e3, 3),
+                )
+            if self._elastic is not None and self._elastic.guard():
+                # flap budget exhausted: stop chasing the topology —
+                # pin the single-device strategy and serve degraded
+                self.fleet.pin_single("remesh flap guard")
+                _metrics.counter(
+                    "fleet.remeshes", outcome="latched",
+                    help=_REMESHES_HELP,
+                ).inc()
+                if telemetry.enabled():
+                    telemetry.record(
+                        "fleet.remesh_failed", reason="flap_guard",
+                        old=old_fp,
+                        remeshes=self._elastic.remeshes,
+                        retries=self._elastic.retries,
+                    )
+                self._reset_occupancy()
+                return {"outcome": "latched", "old": old_fp}
+            from ..parallel.mesh import mesh_fingerprint
+
+            new_fp = mesh_fingerprint(target)
+            if new_fp == old_fp:
+                # a swap: same fingerprint, different devices — cached
+                # program keys would collide with executables compiled
+                # against the dead mesh, so their entries must go
+                for p in self._patterns.values():
+                    plan_cache.invalidate(p)
+            self.fleet.retarget(target)
+            self._reset_occupancy()
+            from .. import vault
+
+            replayed = (
+                self._replay_manifest() if vault.enabled() else 0
+            )
+            _metrics.counter(
+                "fleet.remeshes", outcome="ok", help=_REMESHES_HELP,
+            ).inc()
+            devices = len(list(target.devices.flat))
+            wall = round((time.monotonic() - t0) * 1e3, 3)
+            if telemetry.enabled():
+                telemetry.record(
+                    "fleet.remesh", old=old_fp, new=new_fp,
+                    reason=reason, requeued=requeued,
+                    replayed=replayed, devices=devices, wall_ms=wall,
+                )
+            return {
+                "outcome": "ok", "old": old_fp, "new": new_fp,
+                "reason": reason, "requeued": requeued,
+                "replayed": replayed, "devices": devices,
+                "wall_ms": wall,
+            }
+        finally:
+            self._remeshing = False
 
     def _solve_degraded(self, reqs, dt, solver: str) -> None:
         """Per-lane eager fallback when the compiled bucket program is

@@ -121,6 +121,16 @@ Flags (all env-overridable):
   SPARSE_TPU_HISTORY_CAP_MB   - committed-segment retention budget in MB (default 64);
                                 oldest segments are deleted past it.
   SPARSE_TPU_HISTORY_INTERVAL - sampler scrape period in seconds (default 1.0).
+  SPARSE_TPU_REMESH           - elastic mesh (sparse_tpu.fleet.elastic, ISSUE 20):
+                                live topology-change survival for fleet sessions —
+                                detect (mesh fault clauses / session.remesh()),
+                                quiesce, migrate tickets, re-plan. On by default
+                                for fleet sessions; '0' disables the monitor (a
+                                topology error then degrades like any dispatch
+                                failure). No effect when SPARSE_TPU_FLEET is off.
+  SPARSE_TPU_REMESH_RETRIES   - flap guard: executed remeshes a session allows
+                                before latching fleet.remesh_latched and pinning
+                                the single-device strategy (default 3).
   SPARSE_TPU_INGEST_DEPTH     - streaming ingestion data plane (sparse_tpu.ingest):
                                 max arrivals queued on the background onboarder
                                 before admission control engages (default 16).
@@ -464,6 +474,24 @@ class Settings:
         default_factory=lambda: max(
             _env_float("SPARSE_TPU_HISTORY_INTERVAL", 1.0), 0.01
         )
+    )
+
+    # -- elastic mesh (sparse_tpu.fleet.elastic, ISSUE 20) -----------------
+    # Live topology-change survival for fleet sessions: a MeshMonitor
+    # revalidates the serving mesh on dispatch failure and on the
+    # explicit session.remesh() verb. On by default — with no mesh
+    # fault and no remesh() call the monitor is inert (one comparison
+    # on paths that only run under faults/errors), so program keys,
+    # jaxprs and host-sync counts stay byte-identical. '0' removes the
+    # monitor entirely. No effect when SPARSE_TPU_FLEET is off.
+    remesh: bool = field(
+        default_factory=lambda: _env_bool("SPARSE_TPU_REMESH", True)
+    )
+    # Flap guard budget: executed remeshes a session allows before the
+    # monitor latches (fleet.remesh_latched), the policy pins to the
+    # single-device strategy and no further migration is attempted.
+    remesh_retries: int = field(
+        default_factory=lambda: max(_env_int("SPARSE_TPU_REMESH_RETRIES", 3), 0)
     )
 
     # -- streaming ingestion data plane (sparse_tpu.ingest, ISSUE 18) ------

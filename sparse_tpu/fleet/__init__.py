@@ -155,6 +155,10 @@ class FleetPolicy:
         )
         self.mesh = None
         self.fingerprint = None
+        # elastic-mesh state (fleet/elastic.py): the flap guard's
+        # single-strategy pin — None normally, a reason string once
+        # latched (decide() then always answers single, like mesh=1)
+        self.pinned = None
         if mode:
             from ..parallel.mesh import mesh_fingerprint
 
@@ -180,7 +184,31 @@ class FleetPolicy:
 
     @property
     def enabled(self) -> bool:
-        return bool(self.mode) and self.S > 1
+        return bool(self.mode) and self.S > 1 and self.pinned is None
+
+    def retarget(self, mesh) -> None:
+        """Re-point the policy at a new serving mesh (the elastic-mesh
+        re-plan hook, fleet/elastic.py): later ``decide()`` calls plan
+        against the new fingerprint, so program keys, bucket multiples
+        and manifest notes all follow the topology. No-op semantics for
+        the caller to enforce (the session's ``_do_remesh`` compares
+        identities first). A mesh collapsed to 1 device degrades to the
+        single-device path through the ordinary ``enabled`` check —
+        nothing special-cased here."""
+        if not self.mode:
+            return  # fleet off: there is no mesh to re-point
+        from ..parallel.mesh import mesh_fingerprint
+
+        self.mesh = mesh if mesh is not None else fleet_mesh()
+        self.fingerprint = mesh_fingerprint(self.mesh)
+
+    def pin_single(self, reason: str) -> None:
+        """Latch the policy to the single-device strategy (the flap
+        guard's terminal state, failover-registry style): ``enabled``
+        goes False, every later ``decide()`` answers single, and
+        ``describe()`` carries the reason so ``/session`` dashboards
+        show WHY the mesh went dark. Sticky for the session's life."""
+        self.pinned = str(reason)
 
     @property
     def S(self) -> int:
@@ -231,6 +259,10 @@ class FleetPolicy:
             "fingerprint": self.fingerprint,
             "min_b": self.min_b,
             "row_min_n": self.row_min_n,
+            # elastic-mesh state (fleet/elastic.py): present only once
+            # the flap guard latched, so pre-elastic consumers of this
+            # dict see no new key on healthy sessions
+            **({"pinned": self.pinned} if self.pinned is not None else {}),
         }
 
 

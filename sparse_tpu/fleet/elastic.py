@@ -1,0 +1,159 @@
+"""Elastic mesh: live topology-change survival for the fleet tier.
+
+The fleet serving tier (ISSUE 10) keys every compiled program, ledger
+and occupancy gauge to ONE immutable mesh fingerprint — before this
+module, a mesh resize or slice loss was only survivable *across
+restarts* (the vault manifest's ``mesh_skipped`` replay path,
+``batch/service.py::_manifest_plan``). This module makes the same seam
+work LIVE (ISSUE 20, docs/resilience.md "Elastic topology"): a
+:class:`MeshMonitor` detects that the world's topology no longer
+matches the mesh a session serves on, the session quiesces and
+migrates in-flight work (``SolveSession._do_remesh``), the
+:class:`~sparse_tpu.fleet.FleetPolicy` re-targets
+(:meth:`~sparse_tpu.fleet.FleetPolicy.retarget`), and the mesh-keyed
+manifest turns the re-plan into a warm replay whenever the new
+topology was ever seen before.
+
+Detection is deliberately conservative — two triggers only:
+
+* a **forged topology** from the ``mesh`` fault-grammar site
+  (``shrink:mesh:to=4`` / ``swap:mesh`` / ``flap:mesh``,
+  :func:`resilience.faults.mesh_view`), which makes the whole path
+  drillable on the forced CPU mesh in CI; and
+* the **explicit** ``session.remesh(mesh)`` verb, the production entry
+  point for a controller that knows the topology changed.
+
+With no mesh fault active, :meth:`MeshMonitor.resolve` returns the
+construction-time mesh — ``changed()`` is False on every clean
+dispatch, so the monitor adds nothing to the default path (the
+default-off invariance contract, pinned by ``tests/test_elastic.py``).
+
+The **flap guard**: every executed remesh counts against a bounded
+budget (``SPARSE_TPU_REMESH_RETRIES``); once exhausted the monitor
+latches (``fleet.remesh_latched`` gauge), the policy pins to the
+single-device strategy (:meth:`FleetPolicy.pin_single`,
+failover-registry style) and no further migration is attempted — a
+topology that will not hold still serves degraded rather than
+thrashing recompiles.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..resilience import faults as _faults
+from ..telemetry import _metrics
+
+__all__ = ["MeshMonitor", "mesh_identity"]
+
+_REMESH_LATCHED = _metrics.gauge(
+    "fleet.remesh_latched",
+    help="1 once a session's flap guard latched (remesh budget "
+    "exhausted; the session is pinned to the single-device strategy)",
+)
+
+
+def mesh_identity(mesh) -> tuple:
+    """Full live identity of a mesh: ``(fingerprint, device-id
+    tuple)``. The fingerprint alone cannot see a *swap* (same platform,
+    same count, different physical devices); the device key alone is
+    volatile across processes. Change detection compares both."""
+    from ..parallel.mesh import mesh_device_key, mesh_fingerprint
+
+    return (mesh_fingerprint(mesh), mesh_device_key(mesh))
+
+
+class MeshMonitor:
+    """Per-session topology watcher (constructed by ``SolveSession``
+    for fleet sessions unless ``SPARSE_TPU_REMESH=0``).
+
+    Holds the construction-time mesh (``mesh0``) as the ground truth of
+    what the world looked like, resolves what the (possibly forged)
+    world looks like NOW, and carries the flap-guard budget. It never
+    mutates the session or the policy — the session's ``_do_remesh``
+    drives every transition so ordering (quiesce -> requeue -> retarget
+    -> replay) lives in one place."""
+
+    def __init__(self, mesh0, retries: int | None = None):
+        from ..config import settings
+
+        self.mesh0 = mesh0
+        self.identity0 = mesh_identity(mesh0)
+        self.retries = int(
+            settings.remesh_retries if retries is None else retries
+        )
+        self.remeshes = 0
+        self.latched = False
+
+    # -- forged-world resolution -----------------------------------------
+    def _submesh(self, k: int):
+        """``mesh0`` shrunk to its first ``k`` devices (the forged
+        shrink: the devices that 'survived' are a prefix, matching how
+        ``get_mesh`` would rebuild over the remaining world)."""
+        from jax.sharding import Mesh
+
+        devs = list(self.mesh0.devices.flat)
+        k = max(min(int(k), len(devs)), 1)
+        return Mesh(np.array(devs[:k]), self.mesh0.axis_names)
+
+    def _swapped(self):
+        """Same-count mesh over ``mesh0``'s devices in reverse order —
+        the forged slice replacement: fingerprint identical, device
+        identity different."""
+        from jax.sharding import Mesh
+
+        devs = list(self.mesh0.devices.flat)
+        return Mesh(np.array(devs[::-1]), self.mesh0.axis_names)
+
+    def resolve(self):
+        """The mesh the world currently offers: the forged topology
+        when a ``mesh`` fault clause is live, else ``mesh0``. Pure and
+        idempotent — consuming a disruption fire is the caller's
+        explicit step (:func:`resilience.faults.mesh_disrupt`)."""
+        if _faults.ACTIVE:
+            view = _faults.mesh_view()
+            if view is not None:
+                kind, to = view
+                if kind == "shrink":
+                    s0 = len(list(self.mesh0.devices.flat))
+                    return self._submesh(
+                        to if to is not None else max(s0 // 2, 1)
+                    )
+                if kind == "swap":
+                    return self._swapped()
+        return self.mesh0
+
+    def changed(self, policy):
+        """The target mesh when the world differs from what ``policy``
+        currently serves on, else ``None``. With no mesh fault active
+        ``resolve()`` is ``mesh0`` — a policy still on its construction
+        mesh always answers ``None`` here, so clean traffic never pays
+        more than this comparison (and only ever reaches it from the
+        fault gate / dispatch-error handler, never the hot path)."""
+        if policy.mesh is None:
+            return None
+        target = self.resolve()
+        if mesh_identity(target) != mesh_identity(policy.mesh):
+            return target
+        return None
+
+    # -- flap guard -------------------------------------------------------
+    def guard(self) -> bool:
+        """Count one executed remesh against the flap budget. Returns
+        True once the budget is exhausted — the caller must then latch
+        (pin the policy single, stop migrating). ``retries`` remeshes
+        are allowed; the next one latches."""
+        self.remeshes += 1
+        if self.remeshes > self.retries:
+            self.latched = True
+            _REMESH_LATCHED.set(1)
+        return self.latched
+
+    def describe(self) -> dict:
+        """JSON-friendly elastic block for ``session_stats()`` /
+        ``/healthz``."""
+        return {
+            "remeshes": self.remeshes,
+            "retries": self.retries,
+            "latched": self.latched,
+        }

@@ -108,6 +108,10 @@ class LoadReport:
     #: latency_ms so onboarding cost can never masquerade as (or hide
     #: in) the serving p95. Empty when the trace had no ingest arrivals.
     onboard: dict = field(default_factory=dict)
+    #: executed topology transitions for traces with ``remesh`` clauses
+    #: (ISSUE 20): outcome -> count (``'ok'``/``'noop'``/``'latched'``/
+    #: ``'error'``). Empty when the trace had no remesh arrivals.
+    remeshes: dict = field(default_factory=dict)
 
     def as_dict(self) -> dict:
         return {
@@ -130,6 +134,7 @@ class LoadReport:
             "requeued": self.requeued,
             "inflight_depth": dict(self.inflight_depth),
             "onboard": dict(self.onboard),
+            "remeshes": dict(self.remeshes),
         }
 
 
@@ -138,7 +143,8 @@ def build_report(trace: ArrivalTrace, outcomes, wall_s: float,
                  queue_depth=(), device_occupancy=(),
                  dispatches: int = 0,
                  inflight_depth: dict | None = None,
-                 onboard=(), onboard_rejected: int = 0) -> LoadReport:
+                 onboard=(), onboard_rejected: int = 0,
+                 remeshes=()) -> LoadReport:
     """Pure rollup of a run: ``outcomes`` is a sequence of
     ``(tenant, latency_s, ok, requeued)`` tuples (what the runner
     collected from the resolved tickets), ``onboard`` a sequence of
@@ -188,12 +194,16 @@ def build_report(trace: ArrivalTrace, outcomes, wall_s: float,
                 "mean": round(sum(olats) / len(olats), 3) if olats else 0.0,
             },
         }
+    rmsh: dict = {}
+    for outcome in remeshes:
+        o = str(outcome)
+        rmsh[o] = rmsh.get(o, 0) + 1
     # offered = the trace's virtual rate mapped to the wall (a pure
-    # closed-loop trace has no timed rate: offered == achieved); ingest
-    # arrivals ride the background onboarding plane, not the solve path,
-    # so they never count toward the solve offered/achieved rates
+    # closed-loop trace has no timed rate: offered == achieved);
+    # non-solve arrivals (ingest onboarding, remesh transitions) never
+    # count toward the solve offered/achieved rates
     solve_arrivals = sum(
-        1 for a in trace.arrivals if getattr(a, "kind", "solve") != "ingest"
+        1 for a in trace.arrivals if getattr(a, "kind", "solve") == "solve"
     )
     if trace.duration > 0 and solve_arrivals:
         offered = solve_arrivals / (trace.duration * time_scale)
@@ -229,6 +239,7 @@ def build_report(trace: ArrivalTrace, outcomes, wall_s: float,
         requeued=requeued,
         inflight_depth=dict(inflight_depth or {}),
         onboard=onb,
+        remeshes=dict(rmsh),
     )
 
 
@@ -342,6 +353,7 @@ def run_load(session, trace: ArrivalTrace, systems, *, pattern=None,
     ingest_src = ingest_source or _default_ingest_source
     ingest_tickets: list = []  # IngestTickets in submit order
     ingest_rejected = 0
+    remesh_outcomes: list = []  # remesh-arrival outcomes in order
     t0 = time.monotonic()
     sampler = _Sampler(t0, sample_period_s)
     entries: list = []  # (tenant, ticket)
@@ -397,7 +409,8 @@ def run_load(session, trace: ArrivalTrace, systems, *, pattern=None,
                 session.poll()  # retire whatever already finished
             sampler.sample()
             time.sleep(min(target - now, coalesce))
-        if getattr(a, "kind", "solve") == "ingest":
+        kind = getattr(a, "kind", "solve")
+        if kind == "ingest":
             # background onboarding plane: never a solve ticket, never
             # a flush — the Onboarder's worker thread does the rest
             try:
@@ -407,6 +420,22 @@ def run_load(session, trace: ArrivalTrace, systems, *, pattern=None,
                 ))
             except Exception:  # noqa: BLE001 - admission-reject counted
                 ingest_rejected += 1
+        elif kind == "remesh":
+            # scheduled topology change (ISSUE 20): route through the
+            # session's elastic path; to=N forges the target mesh,
+            # to=0 re-resolves the live default. In-flight lanes
+            # migrate with their best iterate — the trace's solve
+            # tickets must still all reach terminal states.
+            try:
+                mesh = None
+                if a.size > 0:
+                    from ..fleet import fleet_mesh
+                    mesh = fleet_mesh(a.size)
+                res = session.remesh(mesh)
+                remesh_outcomes.append(
+                    str((res or {}).get("outcome", "?")))
+            except Exception:  # noqa: BLE001 - rolled up as 'error'
+                remesh_outcomes.append("error")
         else:
             submit(a.tenant)
         sampler.sample()
@@ -483,6 +512,7 @@ def run_load(session, trace: ArrivalTrace, systems, *, pattern=None,
             for tk in ingest_tickets
         ],
         onboard_rejected=ingest_rejected,
+        remeshes=remesh_outcomes,
     )
     if record:
         _recorder.record(
@@ -503,5 +533,6 @@ def run_load(session, trace: ArrivalTrace, systems, *, pattern=None,
             **({"inflight_depth": rep.inflight_depth}
                if rep.inflight_depth else {}),
             **({"onboard": rep.onboard} if rep.onboard else {}),
+            **({"remeshes": rep.remeshes} if rep.remeshes else {}),
         )
     return rep

@@ -17,6 +17,7 @@ Spec grammar (mirrors the ``SPARSE_TPU_FAULTS`` clause style —
     uniform:rate=50,duration=2                  # evenly spaced
     closed:concurrency=4,requests=64            # completion-driven
     ingest:rate=2,duration=2,seed=0,size=48     # unseen-pattern arrivals
+    remesh:at=1.0,to=4                          # topology change mid-trace
 
 Every timed clause accepts ``tenant=`` (a label stamped onto each
 request — the fairness dimension) and ``weight=`` (the tenant's fair
@@ -34,6 +35,14 @@ a solve, and the runner routes it through
 onboarding traffic that must never disturb it. Ingest arrivals are
 excluded from the solve latency/fairness rollups; their onboarding
 latency percentiles report separately (``LoadReport.onboard``).
+
+``remesh`` clauses (ISSUE 20) schedule a *topology change* at a fixed
+virtual time: the arrival carries ``kind='remesh'`` and the runner
+routes it through ``SolveSession.remesh`` (``to=N`` forges an
+``N``-device target mesh; ``to=0`` re-resolves the live default) —
+so one trace drives serving traffic ACROSS a mesh shrink/regain, the
+elastic-survival shape ``scripts/chaos_check.py`` scenario 16 pins.
+Remesh arrivals never count toward the solve offered rate.
 """
 
 from __future__ import annotations
@@ -59,9 +68,11 @@ class LoadSpecError(ValueError):
 class Arrival:
     """One scheduled request: virtual arrival time (seconds from trace
     start) and the tenant label it carries ('' = the default tenant).
-    ``kind`` is ``'solve'`` (classic) or ``'ingest'`` (an
-    unseen-pattern matrix arrival, ISSUE 18); ``size`` is the ingest
-    clause's matrix-dimension profile (0 for solves)."""
+    ``kind`` is ``'solve'`` (classic), ``'ingest'`` (an unseen-pattern
+    matrix arrival, ISSUE 18) or ``'remesh'`` (a scheduled topology
+    change, ISSUE 20); ``size`` is the ingest clause's matrix-dimension
+    profile or the remesh clause's target device count (0 for
+    solves)."""
 
     t: float
     tenant: str = ""
@@ -202,6 +213,20 @@ class ArrivalTrace:
              for t in times],
             duration, weights={tenant: float(weight)}, spec=spec,
         )
+
+    @classmethod
+    def remesh_at(cls, at: float, to: int = 0) -> "ArrivalTrace":
+        """A scheduled topology change (ISSUE 20): one ``kind='remesh'``
+        arrival at virtual time ``at``, targeting a forged ``to``-device
+        mesh (``to=0`` re-resolves the live default). Merge with a timed
+        traffic clause to shrink/regain the fleet mid-trace."""
+        if not (at > 0):
+            raise LoadSpecError(f"at={at} must be > 0")
+        if int(to) < 0:
+            raise LoadSpecError(f"to={to} must be >= 0")
+        spec = _clause("remesh", at=at, **({"to": int(to)} if to else {}))
+        return cls([Arrival(float(at), "", kind="remesh", size=int(to))],
+                   float(at), spec=spec)
 
     @classmethod
     def closed_loop(cls, concurrency: int, requests: int,
@@ -364,4 +389,5 @@ _PATTERNS = {
         "rate": float, "duration": float, "seed": int, "size": int,
         "tenant": str, "weight": float,
     }),
+    "remesh": (ArrivalTrace.remesh_at, {"at": float, "to": int}),
 }

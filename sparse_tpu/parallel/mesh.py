@@ -119,6 +119,20 @@ def mesh_fingerprint(mesh: Mesh) -> str:
     return f"{'+'.join(kinds)}:{shape}:{','.join(mesh.axis_names)}"
 
 
+def mesh_device_key(mesh: Mesh) -> tuple:
+    """Concrete device identity of a mesh: the ordered tuple of device
+    ids. Complements :func:`mesh_fingerprint` for LIVE topology-change
+    detection (``sparse_tpu.fleet.elastic``): a *swap* — same platform,
+    same count, different physical devices — keeps the fingerprint but
+    changes this key, so the elastic tier can tell "same shape" from
+    "same devices". Never persisted (ids are volatile across
+    processes); the vault manifest keys on the fingerprint alone."""
+    return tuple(
+        int(getattr(d, "id", i))
+        for i, d in enumerate(mesh.devices.flat)
+    )
+
+
 def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
 

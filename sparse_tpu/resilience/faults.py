@@ -21,6 +21,9 @@ semicolon-separated list of clauses::
     drop:dispatch:p=0.5                # SolveSession dispatch failure
     delay:dispatch:ms=25               # dispatch latency injection
     preempt:chunk:p=0.1,seed=3         # preemption at chunk boundaries
+    shrink:mesh:to=4                   # serving mesh forged down to 4
+    swap:mesh                          # same-size mesh, devices replaced
+    flap:mesh:n=6                      # topology toggles per disruption
     truncate:io:p=0.5                  # vault write survives torn/half
     bitflip:io:p=0.1,seed=5            # flip one byte on artifact read
     stale:io                           # write with an outdated format
@@ -58,7 +61,9 @@ __all__ = [
     "ACTIVE",
     "FaultClause",
     "FaultSpecError",
+    "InjectedMeshFailure",
     "Preempted",
+    "TopologyError",
     "active",
     "check_preempt",
     "clear",
@@ -67,6 +72,9 @@ __all__ = [
     "corrupt_traced",
     "dispatch_actions",
     "io_actions",
+    "is_topology_error",
+    "mesh_disrupt",
+    "mesh_view",
     "parse_spec",
     "reload_from_env",
     "should_fail_pallas",
@@ -95,6 +103,16 @@ SITES = {
     # bitflip (one corrupted byte). Every one must quarantine + rebuild,
     # never crash or mis-serve (docs/resilience.md).
     "io": ("truncate", "bitflip", "stale", "enospc"),
+    # serving-mesh topology (sparse_tpu.fleet.elastic): forge a
+    # deterministic topology change on the forced CPU mesh so the
+    # elastic-mesh path (detect -> quiesce -> migrate -> re-plan) is
+    # drillable in CI. ``shrink:mesh:to=4`` — the forged world lost
+    # devices (default: half the mesh); ``swap:mesh`` — same count,
+    # different physical devices (a slice replacement); ``flap:mesh`` —
+    # the topology toggles between shrunk and original on every
+    # disruption, the flap-guard drill (docs/resilience.md "Elastic
+    # topology").
+    "mesh": ("shrink", "swap", "flap"),
 }
 
 #: which io faults apply on which half of the artifact IO path
@@ -121,6 +139,42 @@ class Preempted(RuntimeError):
     analog of the process being preempted mid-solve. Recovery drivers
     (``resilience.policy``) catch it and resume from the last
     checkpoint/iterate."""
+
+
+class TopologyError(RuntimeError):
+    """A failure attributable to the device topology itself — a lost
+    slice, a replaced device, a mesh the program was compiled for that
+    no longer exists. The classification the elastic-mesh machinery
+    (``fleet/elastic.py``, the recovery ladder's ``remesh`` rung) keys
+    off, as distinct from numeric failures."""
+
+
+class InjectedMeshFailure(TopologyError):
+    """A ``mesh``-site fault clause fired (:func:`mesh_disrupt`) — the
+    injected stand-in for a dispatch lost to a topology change."""
+
+
+#: substrings that mark a backend error as topology-caused; deliberately
+#: narrow — a mis-classified numeric failure would spend a remesh where
+#: a solver escalation was owed
+_TOPOLOGY_MARKERS = (
+    "topology changed", "slice lost", "device unavailable",
+    "device failure", "data_loss", "mesh mismatch",
+)
+
+
+def is_topology_error(exc) -> bool:
+    """Classify an exception as a device/topology failure (vs numeric):
+    the :class:`TopologyError` family, or a backend ``RuntimeError``/
+    ``OSError`` carrying one of the known topology markers. The gate
+    ahead of the recovery ladder's ``remesh`` rung and the session's
+    dispatch-error revalidation."""
+    if isinstance(exc, TopologyError):
+        return True
+    if isinstance(exc, (RuntimeError, OSError)):
+        msg = str(exc).lower()
+        return any(m in msg for m in _TOPOLOGY_MARKERS)
+    return False
 
 
 @dataclass(frozen=True)
@@ -432,6 +486,83 @@ def dispatch_actions() -> list:
             _record_fire(c, ms=c.ms)
             acts.append(("delay", c.ms))
     return acts
+
+
+def _mesh_to(c: FaultClause) -> int | None:
+    """The ``to=`` option of a mesh clause (rides the extras path —
+    ``to`` is grammar only this site understands). ``None`` = the
+    consumer's default (half the current mesh)."""
+    for k, v in c.extras:
+        if k == "to":
+            try:
+                return int(v)
+            except ValueError as e:
+                raise FaultSpecError(
+                    f"mesh clause: bad value for 'to': {v!r}"
+                ) from e
+    return None
+
+
+def mesh_view():
+    """The forged topology the active mesh clause currently presents,
+    WITHOUT consuming a fire: ``None`` when no mesh clause is live, else
+    ``(kind, to)`` — ``('shrink', n)`` for a world that lost devices,
+    ``('swap', None)`` for same-count replaced devices, ``('none',
+    None)`` for a flap clause currently back on the original topology.
+    Deterministic and idempotent: the session's :class:`~sparse_tpu.
+    fleet.elastic.MeshMonitor` polls this to decide whether the forged
+    world differs from the mesh it is serving on; only when it does is
+    a fire consumed (:func:`mesh_disrupt`). A flap clause alternates
+    its view on the clause's fire parity — each consumed disruption
+    toggles the forged world, so remeshes ping-pong until the flap
+    guard latches."""
+    inj = _INJECTOR
+    if inj is None or _SUSPEND > 0:
+        return None
+    for i in inj.by_site.get("mesh", ()):
+        c = inj.clauses[i]
+        if c.n is not None and inj._fires[i] >= c.n:
+            continue  # budget spent: the forged world is gone
+        if c.fault == "shrink":
+            return ("shrink", _mesh_to(c))
+        if c.fault == "swap":
+            return ("swap", None)
+        if c.fault == "flap":
+            return (
+                ("shrink", _mesh_to(c)) if inj._fires[i] % 2 == 0
+                else ("none", None)
+            )
+    return None
+
+
+def mesh_disrupt():
+    """Consume one mesh-site fire: the budget-counted draw behind a
+    topology disruption (the session raises its
+    :class:`InjectedMeshFailure` / migrates on a fired draw). Returns
+    the clause's ``(kind, to)`` directive or ``None``. Call only after
+    :func:`mesh_view` said the forged world differs from the serving
+    mesh — a remeshed session whose mesh already matches the forged
+    topology draws nothing, so fire counts equal actual disruptions."""
+    inj = _INJECTOR
+    if inj is None or _SUSPEND > 0:
+        return None
+    for i in inj.by_site.get("mesh", ()):
+        c = inj.clauses[i]
+        with _LOCK:
+            fire = inj._draw(i)
+        if not fire:
+            continue
+        to = _mesh_to(c)
+        _record_fire(c, **({"to": to} if to is not None else {}))
+        if c.fault == "flap":
+            # the fire just consumed toggled the forged world; report
+            # the view the session must now migrate TO
+            return (
+                ("shrink", to) if (inj._fires[i] - 1) % 2 == 0
+                else ("none", None)
+            )
+        return (c.fault, to)
+    return None
 
 
 def io_actions(op: str) -> list:

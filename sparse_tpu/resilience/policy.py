@@ -27,6 +27,15 @@ nonfinite       with a preconditioner wired, probe M on a pristine
                 clean re-solve from zero
 preempt         injected/real preemption at a chunk boundary: resume
                 from checkpoint/best iterate
+device          a topology failure (``faults.is_topology_error`` — a
+                lost slice, a replaced device, an injected mesh fault):
+                the ``remesh`` rung runs AHEAD of solver escalation —
+                the wired ``on_remesh`` hook re-plans the mesh
+                (``SolveSession._do_remesh`` when a session drives the
+                ladder), the next attempt resumes from the best
+                iterate, and no solver escalation is spent on a
+                failure that was never numeric (ISSUE 20,
+                docs/resilience.md "Elastic topology")
 ==============  =========================================================
 
 Every retry emits a ``solver.retry`` event (+ ``resilience.retries``
@@ -108,7 +117,12 @@ class RecoveryPolicy:
     full length (AIMD, so the cadence tracks the corruption rate).
     ``verify_factor`` relaxes the pristine residual check (the solvers
     test their *recurrence* residual; the true residual can sit slightly
-    above it in low precision)."""
+    above it in low precision).
+    ``on_remesh`` is the elastic-mesh hook (ISSUE 20): a no-arg
+    callable the ``remesh`` rung invokes when an attempt died of a
+    topology error — ``SolveSession._do_remesh`` when a session drives
+    the ladder, anything that re-plans placement otherwise. ``None``
+    (the default) keeps the rung a plain best-iterate resume."""
 
     max_attempts: int = 4
     deadline_s: float | None = None
@@ -116,6 +130,7 @@ class RecoveryPolicy:
     restart_first: int = 1
     segment_iters: int | None = 50
     verify_factor: float = 1.0
+    on_remesh: object = None
 
     def next_solver(self, solver: str) -> str:
         return self.escalate.get(solver, "gmres")
@@ -285,6 +300,17 @@ def solve_with_recovery(
                 {"attempt": attempt, "solver": cur_solver,
                  "reason": "preempt", "error": str(e)}
             )
+        except Exception as e:  # noqa: BLE001 - topology-only; re-raised
+            if not faults.is_topology_error(e):
+                raise
+            # a device/topology failure, not a numeric one (ISSUE 20):
+            # classified distinctly so the ladder can re-plan placement
+            # instead of burning a solver escalation
+            reason, rnorm, finite, ok = "device", math.inf, False, False
+            history.append(
+                {"attempt": attempt, "solver": cur_solver,
+                 "reason": "device", "error": str(e)}
+            )
         if reason is None:
             history.append(
                 {"attempt": attempt, "solver": cur_solver,
@@ -353,11 +379,23 @@ def solve_with_recovery(
 
         # -- ladder ---------------------------------------------------------
         improved = (
-            reason not in ("nonfinite", "nonfinite_m", "preempt")
+            reason not in ("nonfinite", "nonfinite_m", "preempt", "device")
             and math.isfinite(best_rnorm)
             and best_rnorm < prev_best * (1.0 - 1e-3)
         )
-        if reason == "nonfinite_m":
+        if reason == "device":
+            # the remesh rung (ISSUE 20): the attempt died of topology,
+            # not numerics — re-plan placement (the wired hook) and
+            # resume from the best finite iterate; neither a solver
+            # escalation nor the restart budget is spent
+            action = "remesh"
+            if pol.on_remesh is not None:
+                try:
+                    pol.on_remesh()
+                except Exception:  # noqa: BLE001 - re-plan best-effort
+                    pass
+            cur_x0 = best_x  # None => clean re-solve from zero
+        elif reason == "nonfinite_m":
             # the drop-preconditioner rung (ISSUE 14): the corruption
             # came from M's apply, so dropping it IS the fix — resume
             # from the best finite iterate, no solver escalation, no

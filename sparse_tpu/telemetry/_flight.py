@@ -225,6 +225,7 @@ class FlightRecorder:
             path, reason=reason, rule=rule, transition=transition,
             events=len(tail), profile=profile_info,
             captured_ms=round((time.perf_counter() - t0) * 1e3, 3),
+            tail=tail,
         )
         with self._lock:
             self.captures += 1
@@ -317,7 +318,8 @@ class FlightRecorder:
             pass
 
     def _write_manifest(self, path: str, reason: str, rule, transition,
-                        events: int, profile, captured_ms: float) -> None:
+                        events: int, profile, captured_ms: float,
+                        tail: list | None = None) -> None:
         man: dict = {
             "schema": 1,
             "reason": reason,
@@ -365,6 +367,22 @@ class FlightRecorder:
             from ..batch import service
 
             man["sessions"] = service.sessions_stats()
+        except Exception:
+            pass
+        # the elastic-mesh transition (ISSUE 20): a bundle captured near
+        # a topology change embeds the remesh events from its ring tail
+        # — old/new fingerprints, trigger reason, lanes migrated — so
+        # axon_doctor names the transition without re-reading the ring
+        try:
+            remeshes = [
+                {k: _jsonable(v) for k, v in ev.items()
+                 if k in ("kind", "old", "new", "reason", "requeued",
+                          "replayed", "devices", "wall_ms", "ts")}
+                for ev in (tail or ())
+                if ev.get("kind") in ("fleet.remesh", "fleet.remesh_failed")
+            ]
+            if remeshes:
+                man["remesh"] = remeshes[-8:]
         except Exception:
             pass
         try:

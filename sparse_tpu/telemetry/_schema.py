@@ -110,6 +110,18 @@ KINDS: dict[str, frozenset] = {
     # per-device detail of one sharded dispatch: real lanes this device
     # served out of its bucket_lanes-slot block (occupancy numerator)
     "fleet.shard": frozenset({"device", "lanes"}),
+    # one executed live topology migration (fleet/elastic.py, ISSUE 20):
+    # old/new mesh fingerprints, the trigger reason ('fault' |
+    # 'dispatch_error' | 'manual'), lanes requeued through the
+    # migration, manifest programs warm-replayed against the new
+    # topology, and the quiesce+re-plan wall clock. Counts into the
+    # always-on fleet.remeshes{outcome} counter.
+    "fleet.remesh": frozenset({"old", "new", "reason"}),
+    # a remesh that did NOT re-plan: reason 'flap_guard' (the bounded
+    # SPARSE_TPU_REMESH_RETRIES budget latched — the session pinned to
+    # the single-device strategy) or 'noop' is never emitted (identical
+    # topology returns silently)
+    "fleet.remesh_failed": frozenset({"reason"}),
     # -- preconditioners (sparse_tpu.precond, ISSUE 14) ---------------------
     # one pattern-level preconditioner build (diag/block extraction map,
     # ILU(0)/IC(0) symbolic factorization): precond is the kind,

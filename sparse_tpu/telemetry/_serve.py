@@ -127,7 +127,31 @@ def _healthz() -> dict:
         pass  # health must answer even mid-teardown
     wd = _watchdog.state()
     active_alerts = list(wd.get("active") or ())
-    degraded = bool(latches) or bool(anomalies) or bool(active_alerts)
+    # the elastic-mesh block (ISSUE 20): per-session serving topology,
+    # remesh budgets and flap-guard latches, read from the live
+    # FleetPolicy objects — so a shrink that already re-planned shows
+    # the NEW fingerprint here, never the ghost one
+    mesh: dict = {"sessions": [], "latched": 0}
+    try:
+        from ..batch import service as _svc
+
+        for s in _svc.sessions_stats():
+            row = {"mesh": s.get("mesh")}
+            if "elastic" in s:
+                row["elastic"] = s["elastic"]
+                if s["elastic"].get("latched"):
+                    mesh["latched"] += 1
+            mesh["sessions"].append(row)
+    except Exception:
+        pass  # health must answer even with no batch subsystem
+    mesh["remeshes"] = {
+        m.labels.get("outcome", "?"): m.value
+        for m in _metrics.family("fleet.remeshes")
+    }
+    degraded = (
+        bool(latches) or bool(anomalies) or bool(active_alerts)
+        or bool(mesh["latched"])
+    )
     fl = _flight.current()
     return {
         "status": "degraded" if degraded else "ok",
@@ -137,6 +161,7 @@ def _healthz() -> dict:
         "last_solve_anomalies": anomalies,
         "failover_latches": latches,
         "faults": faults_status,
+        "mesh": mesh,
         # failed best-effort device syncs (ISSUE 12 satellite): nonzero
         # means a backend errored inside block_until_ready and the
         # error was swallowed — silent degradation made visible

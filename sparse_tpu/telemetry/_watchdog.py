@@ -54,6 +54,7 @@ __all__ = [
     "default_rules",
     "device_occupancy_rule",
     "failover_rule",
+    "mesh_change_rule",
     "queue_depth_rule",
     "remove_alert_hook",
     "slo_miss_rate_rule",
@@ -511,6 +512,23 @@ def vault_quarantine_rule(trigger: float = 0.0, severity: str = "warn",
         trigger, op=">", severity=severity, **kw)
 
 
+def mesh_change_rule(trigger: float = 0.0, severity: str = "warn",
+                     **kw) -> Rule:
+    """Elastic topology transitions this window (the always-on
+    ``fleet.remeshes{outcome}`` counters, ISSUE 20): any executed
+    remesh — shrink, grow, swap or a flap-guard latch — is an operator
+    event, whether or not the migration succeeded. Summed across
+    outcomes so a latched transition fires the same rule."""
+    return Rule(
+        "mesh_change",
+        _windowed_delta(
+            lambda: sum(
+                float(m.value) for m in _metrics.family("fleet.remeshes")
+            )
+        ),
+        trigger, op=">", severity=severity, **kw)
+
+
 def failover_rule(severity: str = "page", **kw) -> Rule:
     """Latched Pallas→XLA kernel failovers (the resilience registry):
     fires while any kernel is serving on its fallback formulation and
@@ -543,6 +561,7 @@ def default_rules() -> list:
         queue_depth_rule(),
         device_occupancy_rule(),
         vault_quarantine_rule(),
+        mesh_change_rule(),
         failover_rule(),
     ]
 

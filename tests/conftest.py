@@ -100,6 +100,7 @@ _QUICK_FILES = {
     "test_dia.py",
     "test_dia_spmv.py",
     "test_dist.py",
+    "test_elastic.py",
     "test_fleet.py",
     "test_flight.py",
     "test_grid2d.py",

@@ -1,0 +1,429 @@
+"""Elastic mesh (ISSUE 20): slice-loss survival, live re-plan, and
+zero-loss ticket migration.
+
+The load-bearing contracts:
+
+* **Re-plan** — ``session.remesh(mesh)`` quiesces, re-targets the
+  :class:`FleetPolicy` and serves on the new topology: shrink, grow
+  and swap (same fingerprint, different devices) all land in a
+  consistent ``session_stats()`` view.
+* **Zero-loss migration** — a forged slice loss
+  (``shrink:mesh:to=4``) mid-traffic requeues every in-flight lane
+  with its best iterate as ``x0``; every ticket still reaches a
+  terminal state and the solutions match a clean session.
+* **Flap guard** — a topology that will not hold still latches after
+  ``SPARSE_TPU_REMESH_RETRIES`` transitions: the policy pins the
+  single-device strategy and keeps serving degraded.
+* **mesh=1 collapse** — remeshing onto one device disables the fleet
+  tier but never the session.
+* **Ordering** — the transition is visible in telemetry in the only
+  legal order: requeue -> admission hold -> ``fleet.remesh`` ->
+  re-dispatch.
+* **No stale identity** — ``session_stats()['mesh']`` and the
+  per-device occupancy family re-resolve after the transition; the
+  old mesh's higher-numbered devices leave no ghost series.
+* **Default-off invariance** — with no mesh fault and no ``remesh()``
+  call, a remesh-enabled session is byte-identical to a
+  ``SPARSE_TPU_REMESH=0`` one: same program keys, same jaxprs, same
+  dispatch count.
+* **Mesh-keyed replay** — a manifest holding two fingerprints replays
+  exactly the matching subset on restart.
+
+Runs on the conftest-forced 8-device virtual CPU mesh
+(``--xla_force_host_platform_device_count=8``).
+"""
+
+import numpy as np
+import pytest
+import scipy.sparse as sp
+
+import jax
+
+from sparse_tpu import fleet, plan_cache, telemetry, vault
+from sparse_tpu.batch import SolveSession
+from sparse_tpu.batch.operator import SparsityPattern
+from sparse_tpu.config import settings
+from sparse_tpu.fleet.elastic import MeshMonitor, mesh_identity
+from sparse_tpu.parallel.mesh import mesh_fingerprint
+from sparse_tpu.resilience import faults
+from sparse_tpu.telemetry import _metrics
+
+
+@pytest.fixture(autouse=True)
+def _clean_state(tmp_path):
+    """Scratch telemetry sink, no faults, vault off, cold plan cache,
+    and the elastic knobs restored (tests flip them)."""
+    faults.clear()
+    old_vault = settings.vault
+    old_tel = settings.telemetry
+    old_remesh = settings.remesh
+    old_retries = settings.remesh_retries
+    settings.vault = ""
+    telemetry.configure(str(tmp_path / "records.jsonl"))
+    telemetry.reset()
+    plan_cache.clear()
+    yield
+    faults.clear()
+    settings.vault = old_vault
+    settings.telemetry = old_tel
+    settings.remesh = old_remesh
+    settings.remesh_retries = old_retries
+    telemetry.configure(None)
+    telemetry.reset()
+    plan_cache.clear()
+
+
+def _traffic(B=16, n=96, seed=0, dtype=np.float64):
+    rng = np.random.default_rng(seed)
+    e = np.ones(n)
+    mats = []
+    for _ in range(B):
+        A = sp.diags(
+            [-e[:-1], 3.0 * e, -e[:-1]], [-1, 0, 1], format="csr"
+        ).astype(dtype)
+        A.setdiag((3.0 + rng.random(n)).astype(dtype))
+        A.sort_indices()
+        mats.append(A.tocsr())
+    rhs = rng.standard_normal((B, n)).astype(dtype)
+    return mats, rhs
+
+
+def _mesh(S):
+    return fleet.fleet_mesh(S)
+
+
+def _session(**kw):
+    kw.setdefault("batch_max", 16)
+    kw.setdefault("fleet", "auto")
+    kw.setdefault("fleet_mesh", _mesh(8))
+    kw.setdefault("fleet_min_b", 4)
+    return SolveSession("cg", **kw)
+
+
+def _check(mats, X, rhs, tol=1e-8):
+    for A, x, b in zip(mats, X, rhs):
+        assert np.linalg.norm(A @ x - b) < tol
+
+
+# ---------------------------------------------------------------------------
+# explicit re-plan: shrink, grow, swap
+# ---------------------------------------------------------------------------
+def test_shrink_then_grow_replan():
+    mats, rhs = _traffic()
+    ses = _session()
+    X0, _, _ = ses.solve_many(mats, rhs, tol=1e-10)
+    _check(mats, X0, rhs)
+
+    res = ses.remesh(_mesh(4))
+    assert res["outcome"] == "ok"
+    assert res["old"] == mesh_fingerprint(_mesh(8))
+    assert res["new"] == mesh_fingerprint(_mesh(4))
+    assert res["devices"] == 4 and res["reason"] == "manual"
+    st = ses.session_stats()
+    assert st["mesh"]["devices"] == 4
+    assert st["mesh"]["fingerprint"] == mesh_fingerprint(_mesh(4))
+    X1, _, _ = ses.solve_many(mats, rhs, tol=1e-10)
+    _check(mats, X1, rhs)
+    assert np.max(np.abs(X1 - X0)) < 1e-12
+
+    # grow back: the same verb, the same session
+    res = ses.remesh(_mesh(8))
+    assert res["outcome"] == "ok" and res["devices"] == 8
+    assert ses.session_stats()["mesh"]["devices"] == 8
+    X2, _, _ = ses.solve_many(mats, rhs, tol=1e-10)
+    _check(mats, X2, rhs)
+    # a repeated remesh onto the current topology is a no-op
+    assert ses.remesh(_mesh(8))["outcome"] == "noop"
+
+
+def test_swap_same_fingerprint_replans():
+    mats, rhs = _traffic()
+    ses = _session()
+    snap0 = plan_cache.snapshot()
+    ses.solve_many(mats, rhs, tol=1e-10)
+    cold_misses = plan_cache.delta(snap0)["misses"]
+    assert cold_misses >= 1
+
+    # same count, reversed devices: fingerprint identical, identity not
+    mon = MeshMonitor(_mesh(8), retries=8)
+    swapped = mon._swapped()
+    assert mesh_fingerprint(swapped) == mesh_fingerprint(_mesh(8))
+    assert mesh_identity(swapped) != mesh_identity(_mesh(8))
+
+    res = ses.remesh(swapped)
+    assert res["outcome"] == "ok"
+    assert res["old"] == res["new"]  # a swap keeps the fingerprint
+    # cached executables compiled against the dead mesh were dropped:
+    # serving on the replacement slice rebuilds as cold as the first
+    snap1 = plan_cache.snapshot()
+    X, _, _ = ses.solve_many(mats, rhs, tol=1e-10)
+    assert plan_cache.delta(snap1)["misses"] == cold_misses
+    _check(mats, X, rhs)
+
+
+# ---------------------------------------------------------------------------
+# zero-loss migration under a forged slice loss
+# ---------------------------------------------------------------------------
+def test_forged_shrink_migrates_with_x0_carry():
+    settings.telemetry = True
+    mats, rhs = _traffic()
+    clean = _session()
+    Xc, _, _ = clean.solve_many(mats, rhs, tol=1e-10)
+
+    ses = _session()
+    tickets = [
+        ses.submit(A, b, tol=1e-10) for A, b in zip(mats, rhs)
+    ]
+    faults.configure("shrink:mesh:to=4")
+    try:
+        ses.drain()
+    finally:
+        faults.clear()
+    assert all(t.done for t in tickets), "a ticket was lost in migration"
+    X = np.stack([t.result()[0] for t in tickets])
+    _check(mats, X, rhs)
+    assert np.max(np.abs(X - Xc)) < 1e-8
+    # the transition really happened, as a migration not a failure
+    st = ses.session_stats()
+    assert st["mesh"]["devices"] == 4
+    assert st["tickets"]["queue_depth_drift"] == 0
+    rq = [
+        e for e in telemetry.events()
+        if e["kind"] == "batch.requeue" and e.get("action") == "remesh"
+    ]
+    assert rq and rq[0]["lanes"] > 0
+    rm = [e for e in telemetry.events() if e["kind"] == "fleet.remesh"]
+    assert rm and rm[0]["reason"] == "fault"
+    assert rm[0]["requeued"] == rq[0]["lanes"]
+
+    # recovery drill: after faults.clear(), remesh() with no argument
+    # re-resolves the construction-time world
+    rec = ses.remesh()
+    assert rec["outcome"] == "ok" and rec["devices"] == 8
+
+
+def test_admission_hold_release_ordering():
+    settings.telemetry = True
+    mats, rhs = _traffic()
+    ses = _session()
+    faults.configure("shrink:mesh:to=4")
+    try:
+        X, _, _ = ses.solve_many(mats, rhs, tol=1e-10)
+    finally:
+        faults.clear()
+    _check(mats, X, rhs)
+    evs = telemetry.events()
+    kinds = [
+        (e["kind"], e.get("action") or e.get("reason"))
+        for e in evs
+    ]
+    i_rq = kinds.index(("batch.requeue", "remesh"))
+    i_adm = kinds.index(("batch.admission", "remesh"))
+    i_rm = next(
+        i for i, e in enumerate(evs) if e["kind"] == "fleet.remesh"
+    )
+    dispatches_after = [
+        i for i, e in enumerate(evs)
+        if e["kind"] == "batch.dispatch" and i > i_rm
+    ]
+    # requeue -> admission hold -> transition -> re-dispatch
+    assert i_rq < i_adm < i_rm
+    assert dispatches_after, "migrated lanes never re-dispatched"
+
+
+# ---------------------------------------------------------------------------
+# flap guard: latch + single pin
+# ---------------------------------------------------------------------------
+def test_flap_guard_latches_and_pins_single():
+    settings.telemetry = True
+    settings.remesh_retries = 1
+    mats, rhs = _traffic()
+    ses = _session()
+    ses.solve_many(mats, rhs, tol=1e-10)
+
+    assert ses.remesh(_mesh(4))["outcome"] == "ok"  # budget: 1 allowed
+    res = ses.remesh(_mesh(8))  # the second transition latches
+    assert res["outcome"] == "latched"
+    st = ses.session_stats()
+    assert st["elastic"] == {"remeshes": 2, "retries": 1, "latched": True}
+    assert st["mesh"]["pinned"] == "remesh flap guard"
+    assert not ses.fleet.enabled
+    failed = [
+        e for e in telemetry.events()
+        if e["kind"] == "fleet.remesh_failed"
+    ]
+    assert failed and failed[0]["reason"] == "flap_guard"
+
+    # latched is terminal for the monitor: further verbs refuse fast
+    assert ses.remesh(_mesh(8))["outcome"] == "latched"
+    # ... and the session still serves, degraded but correct
+    X, _, _ = ses.solve_many(mats, rhs, tol=1e-10)
+    _check(mats, X, rhs)
+
+
+def test_flap_fault_respects_budget():
+    settings.telemetry = True
+    settings.remesh_retries = 2
+    mats, rhs = _traffic()
+    ses = _session()
+    faults.configure("flap:mesh:n=6")
+    try:
+        for _ in range(4):
+            ses.solve_many(mats, rhs, tol=1e-10)
+    finally:
+        faults.clear()
+    st = ses.session_stats()
+    # the guard bounded the chase regardless of how long the flap ran
+    assert st["elastic"]["remeshes"] <= settings.remesh_retries + 1
+    X, _, _ = ses.solve_many(mats, rhs, tol=1e-10)
+    _check(mats, X, rhs)
+
+
+# ---------------------------------------------------------------------------
+# mesh=1 collapse
+# ---------------------------------------------------------------------------
+def test_remesh_to_one_device_collapses_to_classic():
+    mats, rhs = _traffic()
+    ses = _session()
+    X0, _, _ = ses.solve_many(mats, rhs, tol=1e-10)
+    res = ses.remesh(_mesh(1))
+    assert res["outcome"] == "ok" and res["devices"] == 1
+    assert not ses.fleet.enabled  # one device: fleet tier disabled
+    X1, _, _ = ses.solve_many(mats, rhs, tol=1e-10)
+    _check(mats, X1, rhs)
+    assert np.max(np.abs(X1 - X0)) < 1e-12
+
+
+def test_remesh_on_fleet_off_session_is_disabled():
+    ses = SolveSession("cg", fleet=False)
+    assert ses.remesh(_mesh(4)) == {"outcome": "disabled"}
+    assert "elastic" not in ses.session_stats()
+
+
+# ---------------------------------------------------------------------------
+# stale identity: stats and gauges re-resolve (ISSUE 20 satellite)
+# ---------------------------------------------------------------------------
+def test_no_stale_mesh_identity_after_shrink():
+    settings.telemetry = True
+    mats, rhs = _traffic()
+    ses = _session()
+    ses.solve_many(mats, rhs, tol=1e-10)
+    assert len(ses.session_stats()["device_occupancy"]) == 8
+    assert len(_metrics.family("fleet.device_occupancy")) == 8
+
+    ses.remesh(_mesh(4))
+    # the transition REMOVES the per-device family outright — a zeroed
+    # ghost for devices 4..7 would still trip occupancy alerting
+    assert ses.session_stats()["device_occupancy"] == []
+    assert _metrics.family("fleet.device_occupancy") == []
+    st = ses.session_stats()
+    assert st["mesh"]["devices"] == 4
+    assert st["mesh"]["fingerprint"] == mesh_fingerprint(_mesh(4))
+
+    ses.solve_many(mats, rhs, tol=1e-10)
+    occ = ses.session_stats()["device_occupancy"]
+    assert len(occ) == 4  # no ghost devices from the 8-mesh era
+    assert len(_metrics.family("fleet.device_occupancy")) == 4
+
+
+# ---------------------------------------------------------------------------
+# default-off invariance: no fault + no remesh() = byte-identical
+# ---------------------------------------------------------------------------
+def test_default_off_invariance_pin():
+    mats, rhs = _traffic()
+    pat = SparsityPattern.from_csr(mats[0])
+    runs = {}
+    for enabled in (True, False):
+        plan_cache.clear()
+        settings.remesh = enabled
+        ses = _session()
+        assert (ses._elastic is not None) is enabled
+        snap = plan_cache.snapshot()
+        X, iters, r2 = ses.solve_many(mats, rhs, tol=1e-10)
+        plan = ses.fleet.decide(pat, 16, "cg")
+        B, n = 16, pat.shape[0]
+        args = (
+            np.zeros((B, pat.nnz)), np.zeros((B, n)),
+            np.zeros((B, n)), np.zeros(B), 100,
+        )
+        jx = jax.make_jaxpr(
+            ses._build_program(pat, B, np.dtype(np.float64), plan=plan)
+        )(*args)
+        runs[enabled] = (
+            X, iters, plan_cache.delta(snap), ses.dispatches, str(jx)
+        )
+    X1, it1, d1, n1, j1 = runs[True]
+    X0, it0, d0, n0, j0 = runs[False]
+    assert np.array_equal(X1, X0) and np.array_equal(it1, it0)
+    assert d1 == d0 and n1 == n0
+    assert j1 == j0  # the monitor perturbs nothing compiled
+
+
+# ---------------------------------------------------------------------------
+# mesh-keyed manifest: two fingerprints, matching subset replays
+# ---------------------------------------------------------------------------
+def test_manifest_two_fingerprints_replays_matching_subset(tmp_path):
+    settings.telemetry = True
+    settings.vault = str(tmp_path / "vault")
+    mats, rhs = _traffic()
+    ses = _session()
+    ses.solve_many(mats, rhs, tol=1e-10)  # vaulted under cpu:8
+    assert ses.remesh(_mesh(4))["outcome"] == "ok"
+    ses.solve_many(mats, rhs, tol=1e-10)  # vaulted under cpu:4
+
+    fps = [e.get("mesh") for e in vault.manifest_entries()]
+    assert set(fps) == {
+        mesh_fingerprint(_mesh(8)), mesh_fingerprint(_mesh(4))
+    }
+    n4 = fps.count(mesh_fingerprint(_mesh(4)))
+
+    # a 4-mesh restart replays exactly the 4-mesh subset, serves warm
+    plan_cache.clear()
+    telemetry.reset()
+    s2 = _session(
+        fleet_mesh=_mesh(4), warm_start=True, warm_async=False
+    )
+    assert s2.warm_replayed == n4
+    rp = [e for e in telemetry.events() if e["kind"] == "vault.replay"]
+    assert rp and rp[0]["mesh_skipped"] == len(fps) - n4
+    snap = plan_cache.snapshot()
+    X, _, _ = s2.solve_many(mats, rhs, tol=1e-10)
+    assert plan_cache.delta(snap)["misses"] == 0
+    _check(mats, X, rhs)
+
+    # a live remesh onto the OTHER vaulted topology is also warm: the
+    # transition's replay pulls the 8-mesh subset back in
+    rec = s2.remesh(_mesh(8))
+    assert rec["outcome"] == "ok"
+    assert rec["replayed"] == len(fps) - n4
+    snap = plan_cache.snapshot()
+    s2.solve_many(mats, rhs, tol=1e-10)
+    assert plan_cache.delta(snap)["misses"] == 0
+
+
+# ---------------------------------------------------------------------------
+# monitor unit surface
+# ---------------------------------------------------------------------------
+def test_monitor_resolve_and_guard_unit():
+    mon = MeshMonitor(_mesh(8), retries=2)
+    assert mon.describe() == {"remeshes": 0, "retries": 2, "latched": False}
+    # clean world: resolve is mesh0, changed is None
+    assert mesh_identity(mon.resolve()) == mesh_identity(_mesh(8))
+    pol = fleet.FleetPolicy("auto", mesh=_mesh(8), min_b=2)
+    assert mon.changed(pol) is None
+    # forged shrink: resolve offers the submesh, changed names it
+    faults.configure("shrink:mesh:to=4")
+    try:
+        tgt = mon.changed(pol)
+        assert tgt is not None
+        assert mesh_fingerprint(tgt) == mesh_fingerprint(_mesh(4))
+        # a policy already serving the forged world sees no change
+        pol4 = fleet.FleetPolicy("auto", mesh=tgt, min_b=2)
+        assert mon.changed(pol4) is None
+    finally:
+        faults.clear()
+    assert mon.changed(pol) is None  # cleared: the world healed
+    # guard: `retries` transitions pass, the next latches
+    assert not mon.guard() and not mon.guard()
+    assert mon.guard() and mon.latched
+    assert mon.describe()["latched"]

@@ -1021,14 +1021,14 @@ def test_watchdog_default_rules_construct_and_tick():
     wd = _watchdog.Watchdog()  # the stock rule set
     names = {r.name for st in [wd._states] for r in
              [s.rule for s in st.values()]}
-    assert {"slo_miss_rate", "anomaly_rate", "queue_depth",
-            "device_occupancy", "vault_quarantine",
-            "failover_latched"} <= names
+    assert {"slo_fast_burn", "slo_slow_burn", "anomaly_rate",
+            "queue_depth", "device_occupancy", "vault_quarantine",
+            "mesh_change", "failover_latched"} <= names
     wd.evaluate()
     wd.evaluate()  # two ticks: windowed rules produce values, no crash
     st = wd.state()
     assert st["enabled"] and st["ticks"] == 2
-    assert isinstance(st["rules"], list) and len(st["rules"]) == 6
+    assert isinstance(st["rules"], list) and len(st["rules"]) == len(names)
 
 
 def test_watchdog_thread_start_stop():
